@@ -1,0 +1,24 @@
+"""consul_tpu — a TPU-native service-discovery / health / KV framework.
+
+A brand-new framework with the capability surface of HashiCorp Consul
+v0.5.2 (the reference, see SURVEY.md), built TPU-first:
+
+- **Gossip plane (TPU / JAX).**  SWIM failure detection and epidemic
+  dissemination run as one jit-compiled, batched message-passing round
+  step over HBM-resident membership arrays (``consul_tpu.gossip``),
+  sharded over a `jax.sharding.Mesh`.  The same kernel backs the real
+  agent's membership layer and a million-node simulator.
+- **Control plane (host / Python + C++).**  Raft-replicated state
+  machine, MVCC state store with blocking-query watches, RPC mesh with
+  forwarding, HTTP/DNS/CLI edge interfaces, ACLs, sessions/locks — the
+  strongly-consistent side of the system (``consul_tpu.server``,
+  ``consul_tpu.state``, ``consul_tpu.agent``).
+
+Layer map and parity citations: SURVEY.md §1-§2; each module's docstring
+cites the reference file:line it matches.
+"""
+
+from consul_tpu.version import VERSION, PROTOCOL_VERSION
+
+__version__ = VERSION
+__all__ = ["VERSION", "PROTOCOL_VERSION"]

@@ -1,0 +1,13 @@
+"""Gossip plane: SWIM failure detection + epidemic dissemination on TPU.
+
+This package is the TPU-native re-design of the reference's L0/L1 layers
+(memberlist SWIM + Serf, SURVEY.md §1): instead of per-node goroutines
+and timers, the membership protocol for N nodes executes as one
+jit-compiled, batched message-passing round step over HBM-resident
+arrays (``kernel.py``).  The same kernel is both the membership engine
+behind the agent and a million-node simulator cross-validated against a
+discrete-event reference model of memberlist semantics (``refmodel.py``).
+"""
+
+from consul_tpu.gossip.params import SwimParams  # noqa: F401
+from consul_tpu.gossip.kernel import SwimState, init_state, swim_round, run_rounds  # noqa: F401

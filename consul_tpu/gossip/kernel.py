@@ -1,0 +1,384 @@
+"""The SWIM round kernel: failure detection + dissemination as batched array ops.
+
+Re-design of the reference's gossip substrate (memberlist SWIM + Serf
+dissemination; behavior contract at
+``website/source/docs/internals/gossip.html.markdown:10-43``, consumed by
+Consul at ``consul/server.go:257-273`` / ``consul/config.go:266-272``)
+as a single jit-compiled synchronous-rounds step.
+
+**State compression.**  A faithful N-node cluster has N distinct views —
+an N×N belief matrix, hopeless at 1M nodes.  SWIM's structure makes the
+compression exact enough for its statistics: all information about a
+subject node travels as a small set of totally-ordered messages
+(suspect@inc < dead < alive@inc+1 within one suspicion episode), so an
+observer's belief about a subject is just "the highest message it has
+heard, and when".  At any instant only nodes with an in-flight rumor
+need tracking.  We therefore keep an S×N matrix over "subject slots":
+
+    heard[s, i]  (uint8):  bits 7-6  msg   (0 none, 1 suspect, 2 dead, 3 refute)
+                           bits 5-4  conf  (independent suspicion confirmations, Lifeguard)
+                           bits 3-0  age   (rounds since this node heard the msg)
+
+The bit layout makes "merge = numeric max" give message priority
+ordering for scatter-marking; the gossip merge itself uses explicit
+logic.  Slots are allocated when a probe failure starts a suspicion
+episode, recycled after the episode resolves (dead / refuted) and its
+verdict has disseminated; overflow is *counted* (``drops``), never
+silent.
+
+**Communication as gathers.**  Each round every node pushes its active
+rumors to ``fanout`` peers.  The round's communication graph is
+``fanout`` keyed Feistel permutations (consul_tpu.ops.feistel), so the
+senders into node d are ``perm_f^{-1}(d)`` — delivery is ``fanout``
+vectorized gathers along the observer axis, no sort/scatter.
+
+**Timers.**  One round = one gossip interval; probes fire every
+``probe_every`` rounds.  Suspicion timeouts follow Lifeguard
+(params.timeout_table): all observers time from the episode start
+(slot_start) — the first suspector's timer governs first-detection in
+both models, so detection-time statistics are preserved (validated in
+tests against the discrete-event reference model).
+
+Known approximations vs stock memberlist: exactly-``fanout`` in-degree
+per round (permutation gossip) instead of Poisson(fanout); uniform
+random probe targets instead of shuffled round-robin sweeps;
+episode-start-based suspicion timers; confirmation counts capped at 3
+and approximated by receipt rounds rather than distinct-origin tracking.
+Each is quantified against the discrete-event reference model
+(gossip/refmodel.py) by the cross-validation test tier.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.gossip.params import SwimParams
+from consul_tpu.ops.feistel import feistel_inverse, random_targets
+
+MSG_NONE = 0
+MSG_SUSPECT = 1
+MSG_DEAD = 2
+MSG_REFUTE = 3
+
+PHASE_FREE = 0
+PHASE_SUSPECT = 1
+PHASE_DEAD = 2
+PHASE_REFUTED = 3
+
+NEVER = np.int32(2**31 - 1)  # fail_round value for "never fails"
+
+_MSG_SHIFT = 6
+_CONF_SHIFT = 4
+_CONF_MASK = 0x3
+_AGE_MASK = 0xF
+
+
+def _enc(msg: int, conf: int = 0, age: int = 0) -> int:
+    return (msg << _MSG_SHIFT) | (conf << _CONF_SHIFT) | age
+
+
+class SwimState(NamedTuple):
+    """One LAN pool's protocol state. All arrays live in HBM."""
+
+    round: jnp.ndarray          # i32 scalar — current gossip round
+    heard: jnp.ndarray          # u8  [S, N] — per-(slot, observer) belief
+    slot_node: jnp.ndarray      # i32 [S] — subject node id, -1 = free
+    slot_phase: jnp.ndarray     # i32 [S] — PHASE_*
+    slot_inc: jnp.ndarray       # i32 [S] — incarnation under suspicion (diagnostic
+                                #   only for now: message ordering within an episode
+                                #   is positional — suspect < dead < refute — so the
+                                #   incarnation guard is implicit; joins/rejoins will
+                                #   consume this field when they land)
+    slot_start: jnp.ndarray     # i32 [S] — round the episode began
+    slot_nsusp: jnp.ndarray     # i32 [S] — independent suspicion initiators
+    slot_dead_round: jnp.ndarray  # i32 [S] — round dead was declared, -1
+    slot_of_node: jnp.ndarray   # i32 [N] — node -> slot, -1 = none
+    incarnation: jnp.ndarray    # i32 [N] — per-node incarnation counter
+    member: jnp.ndarray         # bool [N] — current cluster membership
+    drops: jnp.ndarray          # i32 — suspicion initiations lost to full slots
+    n_detected: jnp.ndarray     # i32 — true failures detected (at slot GC)
+    sum_detect_rounds: jnp.ndarray  # i32 — sum of (dead_round - fail_round)
+    n_false_dead: jnp.ndarray   # i32 — alive nodes declared dead
+    n_refuted: jnp.ndarray      # i32 — episodes ended by refutation
+
+
+def init_state(p: SwimParams) -> SwimState:
+    S, N = p.slots, p.n
+    return SwimState(
+        round=jnp.int32(0),
+        heard=jnp.zeros((S, N), jnp.uint8),
+        slot_node=jnp.full((S,), -1, jnp.int32),
+        slot_phase=jnp.zeros((S,), jnp.int32),
+        slot_inc=jnp.zeros((S,), jnp.int32),
+        slot_start=jnp.zeros((S,), jnp.int32),
+        slot_nsusp=jnp.zeros((S,), jnp.int32),
+        slot_dead_round=jnp.full((S,), -1, jnp.int32),
+        slot_of_node=jnp.full((N,), -1, jnp.int32),
+        incarnation=jnp.zeros((N,), jnp.int32),
+        member=jnp.ones((N,), bool),
+        drops=jnp.int32(0),
+        n_detected=jnp.int32(0),
+        sum_detect_rounds=jnp.int32(0),
+        n_false_dead=jnp.int32(0),
+        n_refuted=jnp.int32(0),
+    )
+
+
+def _age_tick(heard: jnp.ndarray) -> jnp.ndarray:
+    msg = heard >> _MSG_SHIFT
+    age = heard & _AGE_MASK
+    aged = (heard & ~jnp.uint8(_AGE_MASK)) | jnp.minimum(age + 1, _AGE_MASK).astype(jnp.uint8)
+    return jnp.where(msg > 0, aged, heard)
+
+
+def _probe_tick(p: SwimParams, rnd, keys, alive, state_tuple):
+    """One probe interval: direct probe -> k indirect probes -> suspicion
+    initiation, batched over all N probers (reference per-node behavior:
+    memberlist probe cycle as configured at consul/config.go:266-272).
+
+    Helpers are sampled uniformly excluding the prober (collision with
+    the target has probability k/N — negligible, accepted)."""
+    (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
+     slot_dead_round, slot_of_node, incarnation, member, drops) = state_tuple
+    k_t, k_dl, k_h, k_hl = keys
+    N, S = p.n, p.slots
+    ids = jnp.arange(N, dtype=jnp.int32)
+
+    tgt = random_targets(k_t, N, (N,))
+    prober_ok = member & alive
+    tgt_member = member[tgt]
+    tgt_alive = alive[tgt]
+
+    u = jax.random.uniform(k_dl, (N,))
+    direct_fail = tgt_member & (~tgt_alive | (u < p.p_direct_fail_alive))
+
+    helpers = random_targets(k_h, N, (N, p.indirect_k))
+    hu = jax.random.uniform(k_hl, (N, p.indirect_k))
+    ind_ok = (alive[helpers] & member[helpers]
+              & tgt_alive[:, None] & tgt_member[:, None]
+              & (hu >= p.p_indirect_fail_alive))
+    init = prober_ok & direct_fail & ~jnp.any(ind_ok, axis=1)
+
+    # Don't re-suspect a target this prober already believes dead.
+    s_t = slot_of_node[tgt]
+    cur = heard[jnp.clip(s_t, 0, S - 1), ids]
+    init = init & ~((s_t >= 0) & ((cur >> _MSG_SHIFT) == MSG_DEAD))
+
+    # Aggregate per target.
+    nsusp_add = jnp.zeros((N,), jnp.int32).at[tgt].add(init.astype(jnp.int32))
+    want = nsusp_add > 0
+
+    node_c = jnp.clip(slot_node, 0, N - 1)
+    valid = slot_node >= 0
+    slot_want = valid & want[node_c]
+    add_here = jnp.where(valid, nsusp_add[node_c], 0)
+
+    # Existing suspect episodes absorb new initiators.
+    slot_nsusp = jnp.where((slot_phase == PHASE_SUSPECT) & slot_want,
+                           slot_nsusp + add_here, slot_nsusp)
+
+    # A refuted episode whose subject fails probes again re-arms at the
+    # bumped incarnation (memberlist: suspect at inc >= alive inc).
+    rearm = (slot_phase == PHASE_REFUTED) & slot_want
+    slot_phase = jnp.where(rearm, PHASE_SUSPECT, slot_phase)
+    slot_inc = jnp.where(rearm, incarnation[node_c], slot_inc)
+    slot_start = jnp.where(rearm, rnd, slot_start)
+    slot_nsusp = jnp.where(rearm, add_here, slot_nsusp)
+    slot_dead_round = jnp.where(rearm, -1, slot_dead_round)
+    heard = jnp.where(rearm[:, None], jnp.uint8(0), heard)
+
+    # Allocate fresh slots: k-th needer takes the k-th free slot.
+    need = want & (slot_of_node < 0) & member
+    free = ~valid
+    free_order = jnp.argsort(jnp.where(free, 0, 1), stable=True).astype(jnp.int32)
+    n_free = jnp.sum(free)
+    rank = jnp.cumsum(need.astype(jnp.int32)) - 1
+    can = need & (rank < n_free)
+    slot_for = free_order[jnp.clip(rank, 0, S - 1)]
+    sidx = jnp.where(can, slot_for, S)  # S = out of range -> dropped
+    slot_node = slot_node.at[sidx].set(ids, mode="drop")
+    slot_phase = slot_phase.at[sidx].set(PHASE_SUSPECT, mode="drop")
+    slot_inc = slot_inc.at[sidx].set(incarnation, mode="drop")
+    slot_start = slot_start.at[sidx].set(rnd, mode="drop")
+    slot_nsusp = slot_nsusp.at[sidx].set(nsusp_add, mode="drop")
+    slot_dead_round = slot_dead_round.at[sidx].set(-1, mode="drop")
+    slot_of_node = jnp.where(can, slot_for, slot_of_node)
+    drops = drops + jnp.sum((need & ~can).astype(jnp.int32))
+
+    # Initiators record their own suspicion with a *fresh* age so the
+    # rumor re-enters circulation (memberlist re-enqueues the suspect
+    # broadcast on every independent suspicion — this is what carries
+    # confirmations outward and shrinks the Lifeguard timeout).
+    s_t2 = slot_of_node[tgt]
+    cur2 = heard[jnp.clip(s_t2, 0, S - 1), ids]
+    mark_ok = init & (s_t2 >= 0) & ((cur2 >> _MSG_SHIFT) <= MSG_SUSPECT)
+    fresh = (jnp.uint8(_enc(MSG_SUSPECT)) | (cur2 & jnp.uint8(_CONF_MASK << _CONF_SHIFT)))
+    heard = heard.at[jnp.where(mark_ok, s_t2, S), ids].set(fresh, mode="drop")
+
+    return (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
+            slot_dead_round, slot_of_node, incarnation, member, drops)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
+               p: SwimParams) -> SwimState:
+    """Advance the pool by one gossip round."""
+    rnd = state.round
+    key = jax.random.fold_in(base_key, rnd)
+    k_probe = jax.random.split(jax.random.fold_in(key, 1), 4)
+    k_gossip = jax.random.fold_in(key, 2)
+
+    N, S = p.n, p.slots
+    alive = fail_round > rnd
+
+    # -- 1. age every in-flight rumor ------------------------------------
+    heard = _age_tick(state.heard)
+
+    # -- 2. probe tick ----------------------------------------------------
+    carry = (heard, state.slot_node, state.slot_phase, state.slot_inc,
+             state.slot_start, state.slot_nsusp, state.slot_dead_round,
+             state.slot_of_node, state.incarnation, state.member, state.drops)
+    carry = jax.lax.cond(
+        rnd % p.probe_every == 0,
+        lambda c: _probe_tick(p, rnd, k_probe, alive, c),
+        lambda c: c,
+        carry,
+    )
+    (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
+     slot_dead_round, slot_of_node, incarnation, member, drops) = carry
+
+    # -- 3. gossip dissemination (push via inverse-permutation gathers) ---
+    cur_msg = (heard >> _MSG_SHIFT).astype(jnp.uint8)
+    rx_ok = alive & member
+    in_msg = jnp.zeros_like(cur_msg)
+    n_sus_in = jnp.zeros(heard.shape, jnp.uint8)
+    for f in range(p.fanout):
+        kf = jax.random.fold_in(k_gossip, f)
+        srcs = feistel_inverse(jnp.arange(N, dtype=jnp.uint32), kf, N).astype(jnp.int32)
+        src_ok = alive[srcs] & member[srcs]
+        hin = heard[:, srcs]
+        active = src_ok[None, :] & ((hin & _AGE_MASK) < p.spread_budget_rounds)
+        m = jnp.where(active, (hin >> _MSG_SHIFT).astype(jnp.uint8), jnp.uint8(0))
+        in_msg = jnp.maximum(in_msg, m)
+        n_sus_in = n_sus_in + (m == MSG_SUSPECT).astype(jnp.uint8)
+
+    age = heard & _AGE_MASK
+    conf = ((heard >> _CONF_SHIFT) & _CONF_MASK).astype(jnp.int32)
+    upgraded = (in_msg > cur_msg) & rx_ok[None, :]
+    # Lifeguard confirmations: extra suspect receipts while already
+    # suspecting, capped by the number of other independent suspectors.
+    # The same cap clamps the timer lookup below — keep them identical.
+    conf_cap = jnp.minimum(p.max_confirmations,
+                           jnp.maximum(slot_nsusp - 1, 0))[:, None]
+    bump = (cur_msg == MSG_SUSPECT) & (in_msg == MSG_SUSPECT) & rx_ok[None, :]
+    conf = jnp.where(bump, jnp.minimum(conf + n_sus_in.astype(jnp.int32), conf_cap), conf)
+
+    out_msg = jnp.where(upgraded, in_msg, cur_msg)
+    out_age = jnp.where(upgraded, jnp.uint8(0), age.astype(jnp.uint8))
+    out_conf = jnp.where(upgraded, 0, conf).astype(jnp.uint8)
+    heard = ((out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age).astype(jnp.uint8)
+
+    # -- 4. refutation: a live subject that hears of its own suspicion
+    # bumps its incarnation and spreads alive@inc+1 (Serf/memberlist
+    # refutation; Lifeguard's false-positive escape hatch) ---------------
+    srows = jnp.arange(S, dtype=jnp.int32)
+    node_c = jnp.clip(slot_node, 0, N - 1)
+    n_refuted = state.n_refuted
+    if p.refute:
+        own_msg = heard[srows, node_c] >> _MSG_SHIFT
+        refutable = (slot_phase == PHASE_SUSPECT) | (slot_phase == PHASE_DEAD)
+        refute_now = (refutable & (slot_node >= 0) & alive[node_c]
+                      & member[node_c]
+                      & ((own_msg == MSG_SUSPECT) | (own_msg == MSG_DEAD)))
+        incarnation = incarnation.at[jnp.where(refute_now, node_c, N)].add(1, mode="drop")
+        slot_phase = jnp.where(refute_now, PHASE_REFUTED, slot_phase)
+        heard = heard.at[srows, node_c].max(
+            jnp.where(refute_now, jnp.uint8(_enc(MSG_REFUTE)), jnp.uint8(0)))
+        n_refuted = n_refuted + jnp.sum(refute_now.astype(jnp.int32))
+
+    # -- 5. suspicion timers fire -> dead declared ------------------------
+    tbl = jnp.asarray(p.timeout_table())
+    c_eff = jnp.minimum(((heard >> _CONF_SHIFT) & _CONF_MASK).astype(jnp.int32),
+                        conf_cap)
+    elapsed = rnd - slot_start
+    fire = ((slot_phase == PHASE_SUSPECT)[:, None]
+            & ((heard >> _MSG_SHIFT) == MSG_SUSPECT)
+            & rx_ok[None, :]
+            & (elapsed[:, None] >= tbl[c_eff]))
+    slot_fired = jnp.any(fire, axis=1)
+    new_dead = slot_fired & (slot_dead_round < 0)
+    slot_phase = jnp.where(slot_fired, PHASE_DEAD, slot_phase)
+    slot_dead_round = jnp.where(new_dead, rnd, slot_dead_round)
+    heard = jnp.where(fire, jnp.uint8(_enc(MSG_DEAD)), heard)
+
+    # Detection stats are recorded at declaration time.
+    truly_dead = fail_round[node_c] <= rnd
+    n_detected = state.n_detected + jnp.sum((new_dead & truly_dead).astype(jnp.int32))
+    sum_detect_rounds = state.sum_detect_rounds + jnp.sum(
+        jnp.where(new_dead & truly_dead, rnd - fail_round[node_c], 0))
+    n_false_dead = state.n_false_dead + jnp.sum((new_dead & ~truly_dead).astype(jnp.int32))
+
+    # -- 6. episode GC: recycle slots, apply verdicts ---------------------
+    expired = (slot_phase > PHASE_FREE) & (rnd - slot_start > p.slot_ttl_rounds)
+    is_dead = expired & (slot_phase == PHASE_DEAD)
+    member = member.at[jnp.where(is_dead, node_c, N)].set(False, mode="drop")
+    slot_of_node = slot_of_node.at[jnp.where(expired, node_c, N)].set(-1, mode="drop")
+    heard = jnp.where(expired[:, None], jnp.uint8(0), heard)
+    slot_node = jnp.where(expired, -1, slot_node)
+    slot_phase = jnp.where(expired, PHASE_FREE, slot_phase)
+    slot_dead_round = jnp.where(expired, -1, slot_dead_round)
+
+    return SwimState(
+        round=rnd + 1,
+        heard=heard,
+        slot_node=slot_node,
+        slot_phase=slot_phase,
+        slot_inc=slot_inc,
+        slot_start=slot_start,
+        slot_nsusp=slot_nsusp,
+        slot_dead_round=slot_dead_round,
+        slot_of_node=slot_of_node,
+        incarnation=incarnation,
+        member=member,
+        drops=drops,
+        n_detected=n_detected,
+        sum_detect_rounds=sum_detect_rounds,
+        n_false_dead=n_false_dead,
+        n_refuted=n_refuted,
+    )
+
+
+class RoundTrace(NamedTuple):
+    """Per-round observables emitted by run_rounds (small: O(S))."""
+
+    slot_node: jnp.ndarray       # [T, S]
+    slot_phase: jnp.ndarray      # [T, S]
+    slot_start: jnp.ndarray      # [T, S]
+    slot_dead_round: jnp.ndarray  # [T, S]
+    n_heard_dead: jnp.ndarray    # [T, S] — members that hold the dead verdict
+
+
+@functools.partial(jax.jit, static_argnames=("p", "steps", "trace"))
+def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
+               p: SwimParams, steps: int, trace: bool = False):
+    """Scan ``steps`` rounds.  With ``trace``, also return per-round slot
+    snapshots for detection-curve analysis (adds one S×N reduction/round)."""
+
+    def body(st, _):
+        st = swim_round(st, base_key, fail_round, p)
+        if trace:
+            n_heard_dead = jnp.sum(
+                (((st.heard >> _MSG_SHIFT) == MSG_DEAD) & st.member[None, :]),
+                axis=1, dtype=jnp.int32)
+            y = RoundTrace(st.slot_node, st.slot_phase, st.slot_start,
+                           st.slot_dead_round, n_heard_dead)
+        else:
+            y = None
+        return st, y
+
+    return jax.lax.scan(body, state, None, length=steps)

@@ -1,0 +1,282 @@
+"""Discrete-event reference model of SWIM/Lifeguard membership semantics.
+
+This is the cross-validation oracle for the TPU kernel (BASELINE.md
+config 2): a clean-room, per-node implementation of the protocol the
+reference consumes through memberlist/Serf (behavior contract:
+``website/source/docs/internals/gossip.html.markdown``; SWIM paper;
+Lifeguard, PAPERS.md #1).  Unlike the kernel it keeps *faithful*
+per-node state — shuffled round-robin probe lists, Poisson gossip
+in-degree (independent uniform targets), per-node suspicion timers
+started at local hearing time, distinct-origin confirmation sets, and
+per-message retransmit budgets — so the kernel's batched approximations
+can be quantified against it.
+
+Time advances in gossip ticks (same granularity as the kernel's rounds)
+so distributions are directly comparable.  It is event-sparse: beliefs
+are stored only for subjects that deviate from "alive@0", which keeps
+pure-Python simulation tractable to a few thousand nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from consul_tpu.gossip.params import SwimParams
+
+ALIVE, SUSPECT, DEAD = 0, 1, 2
+
+
+@dataclasses.dataclass
+class Message:
+    kind: int          # SUSPECT / DEAD / ALIVE(refute) — ALIVE encoded as 3
+    subject: int
+    inc: int
+    origin: int        # original suspector/declarer (drives Lifeguard distinctness)
+
+
+REFUTE = 3
+
+
+@dataclasses.dataclass
+class Belief:
+    status: int = ALIVE
+    inc: int = 0
+    heard_tick: int = 0
+    confirmers: Optional[Set[int]] = None  # distinct suspicion origins seen
+
+
+class Broadcast:
+    __slots__ = ("msg", "remaining")
+
+    def __init__(self, msg: Message, remaining: int):
+        self.msg = msg
+        self.remaining = remaining
+
+
+@dataclasses.dataclass
+class DetectionEvent:
+    subject: int
+    fail_tick: int
+    first_suspect_tick: int
+    dead_tick: int
+
+
+class RefModel:
+    """Per-node discrete-event SWIM simulation."""
+
+    def __init__(self, p: SwimParams, fail_tick: Dict[int, int], seed: int = 0):
+        self.p = p
+        self.n = p.n
+        self.rng = random.Random(seed)
+        self.fail_tick = dict(fail_tick)
+        self.tick = 0
+        # Per-node protocol state (sparse: only deviations from alive@0).
+        self.beliefs: List[Dict[int, Belief]] = [dict() for _ in range(self.n)]
+        self.queues: List[List[Broadcast]] = [[] for _ in range(self.n)]
+        self.incarnation = [0] * self.n
+        self.members: List[Set[int]] = [set(range(self.n)) - {i} for i in range(self.n)]
+        # Round-robin probe lists (memberlist: shuffled sweep, reshuffle at end).
+        self.probe_list: List[List[int]] = [self._shuffled(i) for i in range(self.n)]
+        self.probe_pos = [0] * self.n
+        self.probe_offset = [self.rng.randrange(p.probe_every) for _ in range(self.n)]
+        # Suspicion timers: (observer, subject) -> deadline handled lazily.
+        self.first_suspect: Dict[int, int] = {}
+        self.dead_declared: Dict[int, int] = {}
+        self.events: List[DetectionEvent] = []
+        self.n_refuted = 0
+        self.n_false_dead = 0
+        self.dissemination: Dict[int, List[Tuple[int, int]]] = defaultdict(list)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _shuffled(self, i: int) -> List[int]:
+        lst = [x for x in range(self.n) if x != i]
+        self.rng.shuffle(lst)
+        return lst
+
+    def _alive_truth(self, i: int) -> bool:
+        return self.fail_tick.get(i, 1 << 60) > self.tick
+
+    def _lost(self) -> bool:
+        return self.rng.random() < self.p.loss_rate
+
+    def _belief(self, i: int, subject: int) -> Belief:
+        b = self.beliefs[i].get(subject)
+        if b is None:
+            b = Belief(inc=0)
+            self.beliefs[i][subject] = b
+        return b
+
+    def _transmit_limit(self) -> int:
+        return self.p.transmit_limit
+
+    def _enqueue(self, i: int, msg: Message) -> None:
+        # memberlist queue invalidates older broadcasts about the same subject
+        self.queues[i] = [b for b in self.queues[i] if b.msg.subject != msg.subject]
+        self.queues[i].append(Broadcast(msg, self._transmit_limit()))
+
+    def _suspicion_timeout(self, nconf: int) -> int:
+        lo, hi = self.p.suspicion_min_rounds, self.p.suspicion_max_rounds
+        k = self.p.max_confirmations
+        frac = math.log(nconf + 1) / math.log(k + 1) if k > 0 else 1.0
+        return int(max(lo, math.ceil(hi - (hi - lo) * frac)))
+
+    # -- message handling (SWIM semantics) --------------------------------
+
+    def _handle(self, i: int, msg: Message) -> None:
+        if not self._alive_truth(i):
+            return
+        subject = msg.subject
+        if subject == i:
+            # About me: refute suspicion/death (alive with bumped incarnation).
+            if msg.kind in (SUSPECT, DEAD) and self.p.refute and msg.inc >= self.incarnation[i]:
+                self.incarnation[i] = msg.inc + 1
+                self.n_refuted += 1
+                self._enqueue(i, Message(REFUTE, i, self.incarnation[i], i))
+            return
+        b = self._belief(i, subject)
+        if msg.kind == SUSPECT:
+            if b.status == DEAD or msg.inc < b.inc:
+                return
+            if b.status == SUSPECT and msg.inc == b.inc:
+                if b.confirmers is not None and msg.origin not in b.confirmers:
+                    b.confirmers.add(msg.origin)
+                    self._enqueue(i, msg)
+                return
+            b.status, b.inc, b.heard_tick = SUSPECT, msg.inc, self.tick
+            b.confirmers = {msg.origin}
+            self.first_suspect.setdefault(subject, self.tick)
+            self._enqueue(i, msg)
+        elif msg.kind == DEAD:
+            if b.status == DEAD or msg.inc < b.inc:
+                return
+            b.status, b.inc, b.heard_tick = DEAD, msg.inc, self.tick
+            self.members[i].discard(subject)
+            self._enqueue(i, msg)
+        elif msg.kind == REFUTE:
+            if msg.inc <= b.inc and b.status != ALIVE:
+                return
+            if msg.inc > b.inc:
+                b.status, b.inc, b.heard_tick = ALIVE, msg.inc, self.tick
+                b.confirmers = None
+                self._enqueue(i, msg)
+
+    def _declare_dead(self, i: int, subject: int, b: Belief) -> None:
+        b.status = DEAD
+        self.members[i].discard(subject)
+        if subject not in self.dead_declared:
+            self.dead_declared[subject] = self.tick
+            truly = not self._alive_truth(subject)
+            if truly:
+                self.events.append(DetectionEvent(
+                    subject, self.fail_tick[subject],
+                    self.first_suspect.get(subject, self.tick), self.tick))
+            else:
+                self.n_false_dead += 1
+        self._enqueue(i, Message(DEAD, subject, b.inc, i))
+
+    # -- per-tick phases --------------------------------------------------
+
+    def _probe(self, i: int) -> None:
+        if not self.members[i]:
+            return
+        # next round-robin target still believed a member
+        for _ in range(len(self.probe_list[i]) + 1):
+            if self.probe_pos[i] >= len(self.probe_list[i]):
+                self.probe_list[i] = self._shuffled(i)
+                self.probe_list[i] = [t for t in self.probe_list[i] if t in self.members[i]]
+                self.probe_pos[i] = 0
+                if not self.probe_list[i]:
+                    return
+            t = self.probe_list[i][self.probe_pos[i]]
+            self.probe_pos[i] += 1
+            if t in self.members[i]:
+                break
+        else:
+            return
+        target_up = self._alive_truth(t)
+        ok = target_up and not self._lost() and not self._lost()
+        if not ok:
+            helpers = self.rng.sample(sorted(self.members[i] - {t}),
+                                      min(self.p.indirect_k, max(0, len(self.members[i]) - 1)))
+            for h in helpers:
+                if not self._alive_truth(h):
+                    continue
+                if target_up and not any(self._lost() for _ in range(4)):
+                    ok = True
+                    break
+        if not ok:
+            b = self._belief(i, t)
+            if b.status == ALIVE:
+                inc = max(b.inc, 0)
+                b.status, b.inc, b.heard_tick = SUSPECT, inc, self.tick
+                b.confirmers = {i}  # creator seed; not a confirmation
+                self.first_suspect.setdefault(t, self.tick)
+                self._enqueue(i, Message(SUSPECT, t, inc, i))
+            elif b.status == SUSPECT:
+                # memberlist suspectNode on an existing suspicion: the local
+                # failed probe is an independent confirmation, re-gossiped.
+                if b.confirmers is not None and i not in b.confirmers:
+                    b.confirmers.add(i)
+                    self._enqueue(i, Message(SUSPECT, t, b.inc, i))
+
+    def _gossip(self, i: int) -> None:
+        if not self.queues[i] or not self.members[i]:
+            return
+        k = min(self.p.fanout, len(self.members[i]))
+        targets = self.rng.sample(sorted(self.members[i]), k)
+        for b in list(self.queues[i]):
+            for t in targets:
+                if b.remaining <= 0:
+                    break
+                b.remaining -= 1
+                if self._alive_truth(t) and not self._lost():
+                    self._handle(t, b.msg)
+        self.queues[i] = [b for b in self.queues[i] if b.remaining > 0]
+
+    def _timers(self, i: int) -> None:
+        for subject, b in list(self.beliefs[i].items()):
+            if b.status != SUSPECT:
+                continue
+            # memberlist seeds the suspicion with its creator, which does not
+            # count as a confirmation; n = distinct origins seen since.
+            nconf = min(self.p.max_confirmations, max(0, len(b.confirmers or ()) - 1))
+            if self.tick - b.heard_tick >= self._suspicion_timeout(nconf):
+                self._declare_dead(i, subject, b)
+
+    def step(self) -> None:
+        t = self.tick
+        for i in range(self.n):
+            if not self._alive_truth(i):
+                continue
+            if (t + self.probe_offset[i]) % self.p.probe_every == 0:
+                self._probe(i)
+        order = list(range(self.n))
+        self.rng.shuffle(order)
+        for i in order:
+            if self._alive_truth(i):
+                self._gossip(i)
+        for i in range(self.n):
+            if self._alive_truth(i):
+                self._timers(i)
+        # dissemination curve for failed subjects
+        for subject in self.dead_declared:
+            knows = sum(1 for i in range(self.n)
+                        if self._alive_truth(i)
+                        and self.beliefs[i].get(subject) is not None
+                        and self.beliefs[i][subject].status == DEAD)
+            self.dissemination[subject].append((t, knows))
+        self.tick += 1
+
+    def run(self, ticks: int) -> None:
+        for _ in range(ticks):
+            self.step()
+
+    # -- summary ----------------------------------------------------------
+
+    def detection_latencies(self) -> List[int]:
+        return [e.dead_tick - e.fail_tick for e in self.events]

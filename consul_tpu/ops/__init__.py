@@ -1,0 +1,7 @@
+"""TPU-friendly primitive ops used by the gossip kernel."""
+
+from consul_tpu.ops.feistel import (  # noqa: F401
+    feistel_permute,
+    feistel_inverse,
+    random_targets,
+)

@@ -1,0 +1,4 @@
+"""Shared request/reply wire types + codec (reference: consul/structs/)."""
+
+from consul_tpu.structs.structs import *  # noqa: F401,F403
+from consul_tpu.structs.codec import encode, decode, encode_payload, decode_payload  # noqa: F401

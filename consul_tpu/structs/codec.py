@@ -1,0 +1,39 @@
+"""Msgpack framing with a leading type byte.
+
+Parity target: ``consul/structs/structs.go:575-588`` — Encode prepends a
+one-byte message type to the msgpack body; Decode strips it.  Used for
+Raft log entries and snapshot records.  Generic payload helpers wrap
+dataclass <-> msgpack conversion for the RPC layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple, Type
+
+import msgpack
+
+
+def encode_payload(obj: Any) -> bytes:
+    """Serialize a Struct/dataclass (or plain value) to msgpack bytes."""
+    if hasattr(obj, "to_wire"):
+        obj = obj.to_wire()
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def decode_payload(buf: bytes, cls: Type | None = None) -> Any:
+    out = msgpack.unpackb(buf, raw=False, strict_map_key=False)
+    if cls is not None and hasattr(cls, "from_wire"):
+        return cls.from_wire(out)
+    return out
+
+
+def encode(msg_type: int, obj: Any) -> bytes:
+    """Type byte + msgpack body (structs.go:575-581)."""
+    return bytes([msg_type & 0xFF]) + encode_payload(obj)
+
+
+def decode(buf: bytes, cls: Type | None = None) -> Tuple[int, Any]:
+    """Split type byte, decode body (structs.go:583-588)."""
+    if not buf:
+        raise ValueError("empty buffer")
+    return buf[0], decode_payload(buf[1:], cls)

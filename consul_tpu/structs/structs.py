@@ -1,0 +1,475 @@
+"""Wire types shared by the RPC, Raft/FSM, and HTTP layers.
+
+Parity target: ``consul/structs/structs.go`` (648 LoC) in the reference —
+message-type bytes for the replicated log, QueryOptions/QueryMeta for
+blocking queries and consistency modes, and the request/reply structs for
+every endpoint.  We keep the same *semantics* (field meaning, defaults,
+the RPCInfo forwarding contract) but express them as slotted dataclasses
+that serialize to msgpack maps, which is the natural codec for a Python
+host plane (the reference uses go-msgpack, structs.go:575-588).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "MessageType", "Struct",
+    "HEALTH_ANY", "HEALTH_UNKNOWN", "HEALTH_PASSING", "HEALTH_WARNING",
+    "HEALTH_CRITICAL", "VALID_HEALTH_STATES",
+    "SERF_CHECK_ID", "SERF_CHECK_NAME", "SERF_ALIVE_OUTPUT", "SERF_FAILED_OUTPUT",
+    "CONSUL_SERVICE_ID", "CONSUL_SERVICE_NAME",
+    "QueryOptions", "QueryMeta", "WriteRequest",
+    "NodeService", "HealthCheck", "Node", "RegisterRequest", "DeregisterRequest",
+    "NodeServices", "ServiceNode", "CheckServiceNode",
+    "KVSOp", "DirEntry", "KVSRequest", "KeyRequest", "KeyListRequest",
+    "SESSION_BEHAVIOR_RELEASE", "SESSION_BEHAVIOR_DELETE",
+    "SESSION_TTL_MIN", "SESSION_TTL_MAX", "SESSION_TTL_MULTIPLIER",
+    "Session", "SessionOp", "SessionRequest",
+    "ACL_TYPE_CLIENT", "ACL_TYPE_MANAGEMENT", "ACL_ANONYMOUS_ID",
+    "ACL", "ACLOp", "ACLRequest", "ACLPolicyRequest", "ACLPolicyReply",
+    "TombstoneRequest", "UserEvent", "CompoundResponse",
+    "KeyringRequest", "KeyringResponse", "now",
+]
+
+
+class MessageType(enum.IntEnum):
+    """Raft log entry type byte (reference: consul/structs/structs.go:20-34).
+
+    The FSM dispatches on this leading byte.  IGNORE_UNKNOWN_FLAG mirrors
+    msgpackHandle's ignore bit (consul/fsm.go:83-88): entries whose type
+    has the high bit set may be safely skipped by older versions.
+    """
+
+    REGISTER = 0
+    DEREGISTER = 1
+    KVS = 2
+    SESSION = 3
+    ACL = 4
+    TOMBSTONE = 5
+
+    @staticmethod
+    def ignore_unknown(t: int) -> int:
+        return t | 0x80
+
+
+# ---------------------------------------------------------------------------
+# Health check states (reference: consul/structs/structs.go:36-47)
+# ---------------------------------------------------------------------------
+
+HEALTH_ANY = "any"
+HEALTH_UNKNOWN = "unknown"
+HEALTH_PASSING = "passing"
+HEALTH_WARNING = "warning"
+HEALTH_CRITICAL = "critical"
+
+VALID_HEALTH_STATES = (HEALTH_PASSING, HEALTH_WARNING, HEALTH_CRITICAL, HEALTH_UNKNOWN)
+
+# Built-in serf-health check (reference: consul/leader.go:17-22).
+SERF_CHECK_ID = "serfHealth"
+SERF_CHECK_NAME = "Serf Health Status"
+SERF_ALIVE_OUTPUT = "Agent alive and reachable"
+SERF_FAILED_OUTPUT = "Agent not live or unreachable"
+
+CONSUL_SERVICE_ID = "consul"
+CONSUL_SERVICE_NAME = "consul"
+
+
+def _wire(v: Any) -> Any:
+    if dataclasses.is_dataclass(v):
+        return {f.name: _wire(getattr(v, f.name)) for f in dataclasses.fields(v)}
+    if isinstance(v, list):
+        return [_wire(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _wire(x) for k, x in v.items()}
+    return v
+
+
+def _asdict(obj) -> Dict[str, Any]:
+    return _wire(obj)
+
+
+class Struct:
+    """Base for wire structs: dict round-trip used by the msgpack codec."""
+
+    def to_wire(self) -> Dict[str, Any]:
+        return _asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]):
+        names = {f.name for f in dataclasses.fields(cls)}
+        kw = {}
+        for k, v in d.items():
+            if k in names:
+                kw[k] = v
+        obj = cls(**kw)  # type: ignore[call-arg]
+        obj._rehydrate()
+        return obj
+
+    def _rehydrate(self) -> None:
+        """Re-nest child dataclasses after a wire decode (override as needed)."""
+
+
+# ---------------------------------------------------------------------------
+# Query options / meta — blocking queries + consistency modes
+# (reference: consul/structs/structs.go:78-147)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QueryOptions(Struct):
+    token: str = ""
+    datacenter: str = ""
+    # Blocking query: re-run until index > min_query_index or wait expires.
+    min_query_index: int = 0
+    max_query_time: float = 0.0  # seconds; server clamps (rpc.go:29-41)
+    # Consistency: allow_stale serves from any server (rpc.go:191-193);
+    # require_consistent forces a leader round-trip (rpc.go:413-417).
+    allow_stale: bool = False
+    require_consistent: bool = False
+
+    def request_datacenter(self) -> str:
+        return self.datacenter
+
+    def is_read(self) -> bool:
+        return True
+
+    def blocking_allowed(self) -> bool:
+        return True
+
+
+@dataclass
+class QueryMeta(Struct):
+    index: int = 0
+    last_contact: float = 0.0  # seconds since last leader contact (stale reads)
+    known_leader: bool = True
+
+
+@dataclass
+class WriteRequest(Struct):
+    token: str = ""
+    datacenter: str = ""
+
+    def request_datacenter(self) -> str:
+        return self.datacenter
+
+    def is_read(self) -> bool:
+        return False
+
+    def blocking_allowed(self) -> bool:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Catalog / node / service / check types
+# (reference: consul/structs/structs.go:149-319)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeService(Struct):
+    id: str = ""
+    service: str = ""
+    tags: List[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+
+
+@dataclass
+class HealthCheck(Struct):
+    node: str = ""
+    check_id: str = ""
+    name: str = ""
+    status: str = HEALTH_CRITICAL
+    notes: str = ""
+    output: str = ""
+    service_id: str = ""
+    service_name: str = ""
+
+
+@dataclass
+class Node(Struct):
+    node: str = ""
+    address: str = ""
+
+
+@dataclass
+class RegisterRequest(WriteRequest):
+    """Catalog registration; node + optional service + optional check(s).
+
+    Reference: structs.go:149-162 — a register may update any subset.
+    """
+
+    node: str = ""
+    address: str = ""
+    service: Optional[NodeService] = None
+    check: Optional[HealthCheck] = None
+    checks: List[HealthCheck] = field(default_factory=list)
+
+    def _rehydrate(self) -> None:
+        if isinstance(self.service, dict):
+            self.service = NodeService.from_wire(self.service)
+        if isinstance(self.check, dict):
+            self.check = HealthCheck.from_wire(self.check)
+        self.checks = [
+            HealthCheck.from_wire(c) if isinstance(c, dict) else c for c in self.checks
+        ]
+
+
+@dataclass
+class DeregisterRequest(WriteRequest):
+    """Reference: structs.go:170-180 — node / service / check granularity."""
+
+    node: str = ""
+    service_id: str = ""
+    check_id: str = ""
+
+
+@dataclass
+class NodeServices(Struct):
+    node: Optional[Node] = None
+    services: Dict[str, NodeService] = field(default_factory=dict)
+
+    def _rehydrate(self) -> None:
+        if isinstance(self.node, dict):
+            self.node = Node.from_wire(self.node)
+        self.services = {
+            k: (NodeService.from_wire(v) if isinstance(v, dict) else v)
+            for k, v in self.services.items()
+        }
+
+
+@dataclass
+class ServiceNode(Struct):
+    node: str = ""
+    address: str = ""
+    service_id: str = ""
+    service_name: str = ""
+    service_tags: List[str] = field(default_factory=list)
+    service_address: str = ""
+    service_port: int = 0
+
+
+@dataclass
+class CheckServiceNode(Struct):
+    node: Optional[Node] = None
+    service: Optional[NodeService] = None
+    checks: List[HealthCheck] = field(default_factory=list)
+
+    def _rehydrate(self) -> None:
+        if isinstance(self.node, dict):
+            self.node = Node.from_wire(self.node)
+        if isinstance(self.service, dict):
+            self.service = NodeService.from_wire(self.service)
+        self.checks = [
+            HealthCheck.from_wire(c) if isinstance(c, dict) else c for c in self.checks
+        ]
+
+
+# ---------------------------------------------------------------------------
+# KV types (reference: consul/structs/structs.go:321-389)
+# ---------------------------------------------------------------------------
+
+
+class KVSOp(str, enum.Enum):
+    SET = "set"
+    DELETE = "delete"
+    DELETE_CAS = "delete-cas"
+    DELETE_TREE = "delete-tree"
+    CAS = "cas"
+    LOCK = "lock"
+    UNLOCK = "unlock"
+
+
+@dataclass
+class DirEntry(Struct):
+    """One KV entry.  lock_index counts successful acquisitions
+    (structs.go:350-358); session is the current lock holder."""
+
+    key: str = ""
+    value: bytes = b""
+    flags: int = 0
+    session: str = ""
+    lock_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+
+    def clone(self) -> "DirEntry":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class KVSRequest(WriteRequest):
+    op: str = KVSOp.SET.value
+    dir_ent: Optional[DirEntry] = None
+
+    def _rehydrate(self) -> None:
+        if isinstance(self.dir_ent, dict):
+            self.dir_ent = DirEntry.from_wire(self.dir_ent)
+
+
+@dataclass
+class KeyRequest(QueryOptions):
+    key: str = ""
+
+
+@dataclass
+class KeyListRequest(QueryOptions):
+    prefix: str = ""
+    separator: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Session types (reference: consul/structs/structs.go:391-448)
+# ---------------------------------------------------------------------------
+
+SESSION_BEHAVIOR_RELEASE = "release"
+SESSION_BEHAVIOR_DELETE = "delete"
+
+SESSION_TTL_MIN = 10.0  # seconds (session_endpoint.go bounds)
+SESSION_TTL_MAX = 3600.0
+SESSION_TTL_MULTIPLIER = 2  # grace multiplier (session_ttl.go:11)
+
+
+@dataclass
+class Session(Struct):
+    id: str = ""
+    name: str = ""
+    node: str = ""
+    checks: List[str] = field(default_factory=list)
+    lock_delay: float = 15.0  # seconds, max 60 (state_store lock-delay)
+    behavior: str = SESSION_BEHAVIOR_RELEASE
+    ttl: str = ""  # duration string, e.g. "15s"; empty = no TTL
+    create_index: int = 0
+    modify_index: int = 0
+
+
+class SessionOp(str, enum.Enum):
+    CREATE = "create"
+    DESTROY = "destroy"
+
+
+@dataclass
+class SessionRequest(WriteRequest):
+    op: str = SessionOp.CREATE.value
+    session: Optional[Session] = None
+
+    def _rehydrate(self) -> None:
+        if isinstance(self.session, dict):
+            self.session = Session.from_wire(self.session)
+
+
+# ---------------------------------------------------------------------------
+# ACL types (reference: consul/structs/structs.go:450-500)
+# ---------------------------------------------------------------------------
+
+ACL_TYPE_CLIENT = "client"
+ACL_TYPE_MANAGEMENT = "management"
+ACL_ANONYMOUS_ID = "anonymous"
+
+
+@dataclass
+class ACL(Struct):
+    id: str = ""
+    name: str = ""
+    type: str = ACL_TYPE_CLIENT
+    rules: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+
+class ACLOp(str, enum.Enum):
+    SET = "set"
+    DELETE = "delete"
+
+
+@dataclass
+class ACLRequest(WriteRequest):
+    op: str = ACLOp.SET.value
+    acl: Optional[ACL] = None
+
+    def _rehydrate(self) -> None:
+        if isinstance(self.acl, dict):
+            self.acl = ACL.from_wire(self.acl)
+
+
+@dataclass
+class ACLPolicyRequest(QueryOptions):
+    acl_id: str = ""
+    etag: str = ""
+
+
+@dataclass
+class ACLPolicyReply(Struct):
+    etag: str = ""
+    ttl: float = 30.0
+    parent: str = "deny"
+    policy: Optional[Dict[str, Any]] = None  # serialized acl.Policy
+
+
+# ---------------------------------------------------------------------------
+# Tombstone reap (reference: consul/structs/structs.go:502-514)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TombstoneRequest(WriteRequest):
+    op: str = "reap"
+    reap_index: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Events (reference: command/agent/user_event.go:19-44)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class UserEvent(Struct):
+    id: str = ""
+    name: str = ""
+    payload: bytes = b""
+    node_filter: str = ""
+    service_filter: str = ""
+    tag_filter: str = ""
+    version: int = 1
+    ltime: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Cross-DC fan-out (reference: consul/structs/structs.go:590-597)
+# ---------------------------------------------------------------------------
+
+
+class CompoundResponse:
+    """Merges per-DC responses for globalRPC fan-out."""
+
+    def __init__(self) -> None:
+        self.responses: List[Any] = []
+
+    def add(self, resp: Any) -> None:
+        self.responses.append(resp)
+
+
+@dataclass
+class KeyringRequest(WriteRequest):
+    op: str = "list"  # list|install|use|remove
+    key: str = ""
+    forwarded: bool = False
+
+
+@dataclass
+class KeyringResponse(Struct):
+    wan: bool = False
+    datacenter: str = ""
+    messages: Dict[str, str] = field(default_factory=dict)
+    keys: Dict[str, int] = field(default_factory=dict)
+    num_nodes: int = 0
+    error: str = ""
+
+
+def now() -> float:
+    return time.time()

@@ -1,0 +1,15 @@
+"""Framework version.
+
+Mirrors the role of the reference's ``version.go:8`` (Consul v0.5.2): a
+single place that names the release and the protocol versions spoken on
+the wire.  Protocol versioning follows the reference's scheme
+(``consul/config.go:31-37``): a [min, max] range advertised in gossip
+tags so mixed-version clusters can negotiate.
+"""
+
+VERSION = "0.1.0"
+
+# Protocol versions (analogue of consul.ProtocolVersionMin/Max).
+PROTOCOL_VERSION_MIN = 1
+PROTOCOL_VERSION_MAX = 2
+PROTOCOL_VERSION = PROTOCOL_VERSION_MAX

@@ -1,0 +1,14 @@
+"""Test harness config.
+
+Multi-chip behavior is tested the way SURVEY.md §4 prescribes for the
+reference (multi-node simulated in one process with compressed timers):
+an 8-device virtual CPU mesh via XLA host-platform device count.  Must
+run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,52 @@
+"""Permutation op: bijectivity, invertibility, uniformity smoke checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.ops.feistel import feistel_permute, feistel_inverse, random_targets
+
+
+@pytest.mark.parametrize("n", [4, 97, 1024, 1000, 4096, 12345])
+def test_bijection_and_inverse(n):
+    key = jax.random.key(7)
+    x = jnp.arange(n, dtype=jnp.uint32)
+    y = feistel_permute(x, key, n)
+    assert len(np.unique(np.asarray(y))) == n
+    assert int(jnp.max(y)) < n
+    back = feistel_inverse(y, key, n)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_different_keys_differ():
+    n = 1024
+    x = jnp.arange(n, dtype=jnp.uint32)
+    y1 = feistel_permute(x, jax.random.key(1), n)
+    y2 = feistel_permute(x, jax.random.key(2), n)
+    assert np.asarray(y1 != y2).mean() > 0.9
+
+
+def test_permutation_is_mixing():
+    # A fixed point or near-identity permutation would break gossip.
+    n = 4096
+    x = jnp.arange(n, dtype=jnp.uint32)
+    y = np.asarray(feistel_permute(x, jax.random.key(3), n))
+    assert (y == np.arange(n)).mean() < 0.01
+    # displacement roughly uniform: mean |y - x| ~ n/3 for random perm
+    disp = np.abs(y.astype(np.int64) - np.arange(n)).mean()
+    assert n / 5 < disp < n / 2
+
+
+def test_random_targets_excludes_self():
+    key = jax.random.key(0)
+    t = np.asarray(random_targets(key, 50, (50,)))
+    assert (t == np.arange(50)).sum() == 0
+    assert t.min() >= 0 and t.max() < 50
+
+
+def test_random_targets_2d():
+    key = jax.random.key(0)
+    t = np.asarray(random_targets(key, 33, (33, 3)))
+    assert (t == np.arange(33)[:, None]).sum() == 0
+    assert t.min() >= 0 and t.max() < 33

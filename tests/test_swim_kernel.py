@@ -1,0 +1,136 @@
+"""SWIM kernel behavior: detection, dissemination, refutation, recycling.
+
+Mirrors the reference's deterministic-logic test tier (SURVEY.md §4):
+seeded PRNG, compressed timers, assertions on protocol invariants.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.gossip.kernel import (
+    MSG_DEAD, NEVER, PHASE_DEAD, PHASE_FREE, PHASE_REFUTED,
+    init_state, run_rounds, swim_round,
+)
+from consul_tpu.gossip.params import SwimParams
+
+
+def small_params(n=64, **kw):
+    kw.setdefault("slots", 8)
+    kw.setdefault("probe_every", 2)
+    return SwimParams(n=n, **kw)
+
+
+def run(p, fail_round, steps, seed=0, trace=False):
+    st = init_state(p)
+    fr = jnp.asarray(fail_round, jnp.int32)
+    return run_rounds(st, jax.random.key(seed), fr, p, steps, trace=trace)
+
+
+def test_no_failures_no_rumors():
+    p = small_params()
+    fail = np.full(p.n, NEVER, np.int32)
+    st, _ = run(p, fail, 40)
+    assert int(st.n_detected) == 0
+    assert int(st.n_false_dead) == 0
+    assert int(jnp.sum(st.slot_phase)) == 0
+    assert bool(jnp.all(st.member))
+    assert int(jnp.sum(st.heard)) == 0
+
+
+def test_single_failure_detected_and_disseminated():
+    p = small_params(n=64)
+    fail = np.full(p.n, NEVER, np.int32)
+    fail[17] = 10
+    steps = 10 + p.slot_ttl_rounds + 40
+    st, tr = run(p, fail, steps, trace=True)
+    assert int(st.n_detected) == 1
+    assert int(st.n_false_dead) == 0
+    assert not bool(st.member[17])
+    assert bool(jnp.all(st.member[np.arange(64) != 17]))
+    # dead verdict reached (nearly) every member before the slot recycled
+    dead_counts = np.asarray(tr.n_heard_dead).max(axis=0)
+    assert dead_counts.max() >= 0.95 * 63
+    # detection happened after the failure and within the suspicion bound
+    mean_rounds = int(st.sum_detect_rounds) / int(st.n_detected)
+    assert 0 < mean_rounds <= p.suspicion_max_rounds + 4 * p.probe_every
+
+
+def test_multiple_failures():
+    p = small_params(n=128, slots=16)
+    rng = np.random.default_rng(1)
+    fail = np.full(p.n, NEVER, np.int32)
+    victims = rng.choice(p.n, 6, replace=False)
+    fail[victims] = rng.integers(5, 40, 6)
+    steps = 40 + p.slot_ttl_rounds + 60
+    st, _ = run(p, fail, steps)
+    assert int(st.n_detected) == 6
+    assert int(st.n_false_dead) == 0
+    assert not np.asarray(st.member)[victims].any()
+    assert np.asarray(st.member).sum() == p.n - 6
+
+
+def test_no_false_positives_without_loss():
+    p = small_params(n=256, slots=8)
+    fail = np.full(p.n, NEVER, np.int32)
+    st, _ = run(p, fail, 200)
+    assert int(st.n_false_dead) == 0
+    assert int(st.n_refuted) == 0
+
+
+def test_lossy_network_refutation_protects():
+    # With heavy packet loss some probes fail and suspicion starts, but
+    # refutation (plus indirect probes) must keep false deaths rare.
+    p = small_params(n=128, slots=32, loss_rate=0.30)
+    fail = np.full(p.n, NEVER, np.int32)
+    st, _ = run(p, fail, 400, seed=3)
+    # suspicion should actually have been exercised
+    assert int(st.n_refuted) > 0
+    assert int(st.n_false_dead) <= 2
+    assert np.asarray(st.member).sum() >= p.n - 2
+
+
+def test_refute_disabled_causes_false_positives():
+    p = small_params(n=128, slots=32, loss_rate=0.45, refute=False,
+                     suspicion_mult=1.0, suspicion_max_mult=1.0, indirect_k=0)
+    fail = np.full(p.n, NEVER, np.int32)
+    st, _ = run(p, fail, 400, seed=3)
+    assert int(st.n_false_dead) > 0
+
+
+def test_slots_recycle():
+    p = small_params(n=64, slots=4)
+    rng = np.random.default_rng(2)
+    fail = np.full(p.n, NEVER, np.int32)
+    # 8 failures through 4 slots — forces recycling
+    victims = rng.choice(p.n, 8, replace=False)
+    fail[victims[:4]] = 5
+    fail[victims[4:]] = 5 + p.slot_ttl_rounds + 30
+    steps = int(fail[victims[4:]][0]) + p.slot_ttl_rounds + 60
+    st, _ = run(p, fail, steps)
+    assert int(st.n_detected) == 8
+    assert int(jnp.sum(st.slot_phase == PHASE_FREE)) == 4
+
+
+def test_determinism():
+    p = small_params(n=64)
+    fail = np.full(p.n, NEVER, np.int32)
+    fail[5] = 7
+    st1, _ = run(p, fail, 80, seed=9)
+    st2, _ = run(p, fail, 80, seed=9)
+    for a, b in zip(st1, st2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_detection_time_scales_with_suspicion_mult():
+    fail = None
+    times = []
+    for mult in (2.0, 8.0):
+        p = small_params(n=64, suspicion_mult=mult, suspicion_max_mult=1.0)
+        fail = np.full(p.n, NEVER, np.int32)
+        fail[11] = 6
+        st, _ = run(p, fail, 6 + p.slot_ttl_rounds + 50, seed=4)
+        assert int(st.n_detected) == 1
+        times.append(int(st.sum_detect_rounds))
+    assert times[1] > times[0]
