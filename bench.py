@@ -1,0 +1,75 @@
+"""North-star benchmark: SWIM gossip rounds/sec at 1M simulated nodes.
+
+Target from BASELINE.json config #5: >=10k gossip rounds/sec at 1M nodes
+(reference substrate: memberlist's event-driven gossip, which the TPU
+kernel re-designs as batched synchronous rounds — see
+consul_tpu/gossip/kernel.py).  vs_baseline is measured rounds/sec over
+that 10k/s target.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+TARGET_ROUNDS_PER_SEC = 10_000.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000, help="simulated cluster size")
+    ap.add_argument("--slots", type=int, default=64, help="concurrent rumor slots")
+    ap.add_argument("--steps", type=int, default=512, help="rounds per timed block")
+    ap.add_argument("--repeats", type=int, default=3, help="timed blocks (best taken)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.kernel import init_state, run_rounds
+    from consul_tpu.gossip.params import lan_profile
+
+    p = lan_profile(args.n, slots=args.slots)
+    state = init_state(p)
+    key = jax.random.PRNGKey(42)
+    # Steady-state failure churn: a fixed 0.1% of nodes fail at staggered
+    # rounds so probe/suspect/dead/GC paths all stay hot during timing.
+    n_fail = max(1, args.n // 1000)
+    fail_round = (
+        jnp.full((p.n,), 2**31 - 1, jnp.int32)
+        .at[: n_fail]
+        .set(jnp.arange(n_fail, dtype=jnp.int32) % (args.steps * args.repeats))
+    )
+
+    # Compile + warm up.
+    state, _ = run_rounds(state, key, fail_round, p, steps=args.steps)
+    jax.block_until_ready(state)
+
+    best = float("inf")
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        state, _ = run_rounds(state, key, fail_round, p, steps=args.steps)
+        jax.block_until_ready(state)
+        best = min(best, time.perf_counter() - t0)
+
+    rounds_per_sec = args.steps / best
+    print(
+        json.dumps(
+            {
+                "metric": f"swim_gossip_rounds_per_sec_{args.n}_nodes",
+                "value": round(rounds_per_sec, 1),
+                "unit": "rounds/s",
+                "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 3),
+            }
+        )
+    )
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

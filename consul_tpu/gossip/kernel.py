@@ -43,7 +43,11 @@ Known approximations vs stock memberlist: exactly-``fanout`` in-degree
 per round (permutation gossip) instead of Poisson(fanout); uniform
 random probe targets instead of shuffled round-robin sweeps;
 episode-start-based suspicion timers; confirmation counts capped at 3
-and approximated by receipt rounds rather than distinct-origin tracking.
+and approximated by receipt rounds rather than distinct-origin tracking;
+refutation is globally instantaneous (a refute cancels every observer's
+pending dead declaration in the same round, rather than racing its
+propagation against each observer's local timer — biases false-positive
+counts low vs event-driven memberlist).
 Each is quantified against the discrete-event reference model
 (gossip/refmodel.py) by the cross-validation test tier.
 """
@@ -260,7 +264,10 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     for f in range(p.fanout):
         kf = jax.random.fold_in(k_gossip, f)
         srcs = feistel_inverse(jnp.arange(N, dtype=jnp.uint32), kf, N).astype(jnp.int32)
-        src_ok = alive[srcs] & member[srcs]
+        # Permutation fixed points would deliver a node's own rumor back to
+        # it (and count as a Lifeguard confirmation); memberlist never
+        # gossips to self.
+        src_ok = alive[srcs] & member[srcs] & (srcs != jnp.arange(N, dtype=jnp.int32))
         hin = heard[:, srcs]
         active = src_ok[None, :] & ((hin & _AGE_MASK) < p.spread_budget_rounds)
         m = jnp.where(active, (hin >> _MSG_SHIFT).astype(jnp.uint8), jnp.uint8(0))

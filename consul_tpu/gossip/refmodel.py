@@ -20,7 +20,6 @@ pure-Python simulation tractable to a few thousand nodes.
 from __future__ import annotations
 
 import dataclasses
-import math
 import random
 from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
@@ -90,6 +89,8 @@ class RefModel:
         self.n_refuted = 0
         self.n_false_dead = 0
         self.dissemination: Dict[int, List[Tuple[int, int]]] = defaultdict(list)
+        # Same Lifeguard decay the kernel uses — one source of truth.
+        self._timeouts = p.timeout_table()
 
     # -- helpers ----------------------------------------------------------
 
@@ -120,10 +121,7 @@ class RefModel:
         self.queues[i].append(Broadcast(msg, self._transmit_limit()))
 
     def _suspicion_timeout(self, nconf: int) -> int:
-        lo, hi = self.p.suspicion_min_rounds, self.p.suspicion_max_rounds
-        k = self.p.max_confirmations
-        frac = math.log(nconf + 1) / math.log(k + 1) if k > 0 else 1.0
-        return int(max(lo, math.ceil(hi - (hi - lo) * frac)))
+        return int(self._timeouts[min(nconf, self.p.max_confirmations)])
 
     # -- message handling (SWIM semantics) --------------------------------
 

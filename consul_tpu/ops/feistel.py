@@ -69,19 +69,16 @@ def _half_bits(n: int) -> int:
     return (b + 1) // 2
 
 
-@functools.partial(jax.jit, static_argnames=("n", "rounds"))
-def feistel_permute(x: jnp.ndarray, key: jax.Array, n: int, rounds: int = 4) -> jnp.ndarray:
-    """Apply a keyed pseudorandom permutation of ``[0, n)`` to ``x``.
-
-    ``x`` must contain values in ``[0, n)``.  Cycle-walks out-of-domain
-    intermediate points, so this is an exact bijection for any ``n``.
-    """
+def _cycle_walk(x: jnp.ndarray, key: jax.Array, n: int, rounds: int,
+                forward: bool) -> jnp.ndarray:
+    """Apply the (possibly inverse) Feistel network, cycle-walking
+    out-of-domain points so the map is an exact bijection on ``[0, n)``."""
     h = _half_bits(n)
     rk = _derive_round_keys(key, rounds)
     x = x.astype(jnp.uint32)
 
     if n == 1 << (2 * h):
-        return _feistel(x, rk, h, True)
+        return _feistel(x, rk, h, forward)
 
     def cond(state):
         y, _ = state
@@ -89,38 +86,29 @@ def feistel_permute(x: jnp.ndarray, key: jax.Array, n: int, rounds: int = 4) -> 
 
     def body(state):
         y, _ = state
-        walk = _feistel(y, rk, h, True)
+        walk = _feistel(y, rk, h, forward)
         y = jnp.where(y >= n, walk, y)
         return y, 0
 
-    y = _feistel(x, rk, h, True)
+    y = _feistel(x, rk, h, forward)
     y, _ = lax.while_loop(cond, body, (y, 0))
     return y
 
 
 @functools.partial(jax.jit, static_argnames=("n", "rounds"))
+def feistel_permute(x: jnp.ndarray, key: jax.Array, n: int, rounds: int = 4) -> jnp.ndarray:
+    """Apply a keyed pseudorandom permutation of ``[0, n)`` to ``x``.
+
+    ``x`` must contain values in ``[0, n)``.  Cycle-walks out-of-domain
+    intermediate points, so this is an exact bijection for any ``n``.
+    """
+    return _cycle_walk(x, key, n, rounds, True)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rounds"))
 def feistel_inverse(y: jnp.ndarray, key: jax.Array, n: int, rounds: int = 4) -> jnp.ndarray:
     """Inverse of :func:`feistel_permute` under the same key."""
-    h = _half_bits(n)
-    rk = _derive_round_keys(key, rounds)
-    y = y.astype(jnp.uint32)
-
-    if n == 1 << (2 * h):
-        return _feistel(y, rk, h, False)
-
-    def cond(state):
-        x, _ = state
-        return jnp.any(x >= n)
-
-    def body(state):
-        x, _ = state
-        walk = _feistel(x, rk, h, False)
-        x = jnp.where(x >= n, walk, x)
-        return x, 0
-
-    x = _feistel(y, rk, h, False)
-    x, _ = lax.while_loop(cond, body, (x, 0))
-    return x
+    return _cycle_walk(y, key, n, rounds, False)
 
 
 def random_targets(key: jax.Array, n: int, shape) -> jnp.ndarray:

@@ -3,12 +3,16 @@
 Multi-chip behavior is tested the way SURVEY.md §4 prescribes for the
 reference (multi-node simulated in one process with compressed timers):
 an 8-device virtual CPU mesh via XLA host-platform device count.  Must
-run before jax is imported anywhere.
+run before jax is imported anywhere.  The axon sitecustomize pins the
+real-TPU platform at interpreter start; conftest runs after it, so a
+plain assignment here wins — tests always run on the virtual CPU mesh,
+benches on the real chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
