@@ -38,12 +38,14 @@ def main() -> None:
     state = init_state(p)
     key = jax.random.PRNGKey(42)
     # Steady-state failure churn: a fixed 0.1% of nodes fail at staggered
-    # rounds so probe/suspect/dead/GC paths all stay hot during timing.
+    # rounds spanning warmup AND every timed block, so probe/suspect/dead/GC
+    # paths stay hot in whichever block min() selects.
     n_fail = max(1, args.n // 1000)
+    total_rounds = args.steps * (args.repeats + 1)
     fail_round = (
         jnp.full((p.n,), 2**31 - 1, jnp.int32)
         .at[: n_fail]
-        .set(jnp.arange(n_fail, dtype=jnp.int32) % (args.steps * args.repeats))
+        .set(jnp.arange(n_fail, dtype=jnp.int32) % total_rounds)
     )
 
     # Compile + warm up.

@@ -1,0 +1,11 @@
+"""Host-plane state: MVCC-style tables, watches, tombstones, sessions.
+
+Parity layer for the reference's ``consul/state_store.go`` +
+``consul/mdb_table.go`` + ``consul/notify.go`` (SURVEY.md §2.3).
+"""
+
+from consul_tpu.state.notify import NotifyGroup
+from consul_tpu.state.radix import RadixTree
+from consul_tpu.state.store import QUERY_TABLES, StateStore, StateStoreError
+
+__all__ = ["NotifyGroup", "RadixTree", "QUERY_TABLES", "StateStore", "StateStoreError"]

@@ -1,0 +1,41 @@
+"""Watch notification groups.
+
+Parity target: ``consul/notify.go`` — NotifyGroup lets blocking queries
+register a wakeup, mutations fire every registered wakeup exactly once
+and clear the registry (notify.go:11-55: non-blocking channel send, then
+the waiter re-registers on its next loop iteration).
+
+The waiter handle is anything with a ``set()`` method: ``threading.Event``
+for synchronous callers, or an adapter around ``asyncio.Event`` supplied
+by the RPC layer (which routes the set through its event loop).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Set
+
+
+class Waiter(Protocol):
+    def set(self) -> None: ...
+
+
+class NotifyGroup:
+    def __init__(self) -> None:
+        self._waiters: Set[Waiter] = set()
+
+    def wait(self, w: Waiter) -> None:
+        """Register ``w`` for the next notify (reference Wait: notify.go:30)."""
+        self._waiters.add(w)
+
+    def clear(self, w: Waiter) -> None:
+        """Deregister without waiting (reference Clear: notify.go:40)."""
+        self._waiters.discard(w)
+
+    def notify(self) -> None:
+        """Wake everyone registered, exactly once (notify.go:15-27)."""
+        waiters, self._waiters = self._waiters, set()
+        for w in waiters:
+            w.set()
+
+    def __len__(self) -> int:
+        return len(self._waiters)

@@ -1,0 +1,125 @@
+"""Character-trie with path/prefix walks.
+
+Parity target: ``armon/go-radix`` as used by the reference for KV prefix
+watches (``consul/state_store.go:432-491``) and ACL longest-prefix rule
+evaluation (``acl/acl.go:37-127``).  A plain character trie (no edge
+compression) keeps every operation O(len(key)) with trivially correct
+walks; the watch and ACL sets it holds are small (hundreds), so the
+compressed-edge memory optimization of go-radix buys nothing here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+_SENTINEL = object()
+
+
+class _TrieNode:
+    __slots__ = ("children", "value")
+
+    def __init__(self) -> None:
+        self.children: Dict[str, "_TrieNode"] = {}
+        self.value: Any = _SENTINEL
+
+
+class RadixTree:
+    """Insert/get/delete plus the two walks the state store needs:
+
+    - walk_path(key): visit every entry whose key is a prefix of ``key``
+      (go-radix WalkPath — used to notify watchers above a changed key).
+    - walk_prefix(prefix): visit every entry whose key starts with
+      ``prefix`` (go-radix WalkPrefix — used to notify watchers below a
+      deleted tree), and for ACL longest-prefix matching.
+    """
+
+    def __init__(self) -> None:
+        self._root = _TrieNode()
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def insert(self, key: str, value: Any) -> Optional[Any]:
+        node = self._root
+        for ch in key:
+            nxt = node.children.get(ch)
+            if nxt is None:
+                nxt = _TrieNode()
+                node.children[ch] = nxt
+            node = nxt
+        old = node.value
+        node.value = value
+        if old is _SENTINEL:
+            self._size += 1
+            return None
+        return old
+
+    def get(self, key: str) -> Optional[Any]:
+        node = self._find(key)
+        if node is None or node.value is _SENTINEL:
+            return None
+        return node.value
+
+    def delete(self, key: str) -> bool:
+        # Track the path for pruning empty branches on the way back.
+        path = [(None, self._root)]
+        node = self._root
+        for ch in key:
+            nxt = node.children.get(ch)
+            if nxt is None:
+                return False
+            path.append((ch, nxt))
+            node = nxt
+        if node.value is _SENTINEL:
+            return False
+        node.value = _SENTINEL
+        self._size -= 1
+        for i in range(len(path) - 1, 0, -1):
+            ch, nd = path[i]
+            if nd.children or nd.value is not _SENTINEL:
+                break
+            del path[i - 1][1].children[ch]
+        return True
+
+    def _find(self, key: str) -> Optional[_TrieNode]:
+        node = self._root
+        for ch in key:
+            node = node.children.get(ch)
+            if node is None:
+                return None
+        return node
+
+    def walk_path(self, key: str) -> Iterator[Tuple[str, Any]]:
+        """Yield (prefix, value) for every stored key that prefixes ``key``."""
+        node = self._root
+        if node.value is not _SENTINEL:
+            yield "", node.value
+        acc = []
+        for ch in key:
+            node = node.children.get(ch)
+            if node is None:
+                return
+            acc.append(ch)
+            if node.value is not _SENTINEL:
+                yield "".join(acc), node.value
+
+    def walk_prefix(self, prefix: str) -> Iterator[Tuple[str, Any]]:
+        """Yield (key, value) for every stored key starting with ``prefix``."""
+        node = self._find(prefix)
+        if node is None:
+            return
+        stack = [(prefix, node)]
+        while stack:
+            key, nd = stack.pop()
+            if nd.value is not _SENTINEL:
+                yield key, nd.value
+            for ch, child in nd.children.items():
+                stack.append((key + ch, child))
+
+    def longest_prefix(self, key: str) -> Optional[Tuple[str, Any]]:
+        """The longest stored key that is a prefix of ``key`` (ACL rules)."""
+        best = None
+        for item in self.walk_path(key):
+            best = item
+        return best

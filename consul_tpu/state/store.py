@@ -1,0 +1,753 @@
+"""The strongly-consistent state store of the host plane.
+
+Parity target: ``consul/state_store.go`` (2140 LoC) + ``consul/mdb_table.go``
+in the reference — eight tables (nodes, services, checks, kvs, tombstones,
+sessions, session_checks, acls), per-table last-modified indexes feeding
+blocking queries, table-level NotifyGroups plus a radix-tree KV prefix
+watch, KV tombstones, Chubby-style lock delays, and the session
+invalidation cascades that encode the split-brain protections.
+
+Design departure from the reference: the reference stores rows in LMDB
+(cgo) for MVCC reader/writer isolation across goroutines; durability
+always comes from the Raft log above, not the store (state_store.go:190-196
+opens LMDB with NOSYNC).  Our host plane is a single-threaded asyncio
+event loop, so isolation is by construction and the natural store is
+in-process dicts plus sorted key arrays for range scans.  The interface
+is kept narrow and transactional-looking so the planned C++ mmap MVCC
+store (SURVEY.md §2.1) can drop in underneath.
+
+Determinism contract (enforced by scripts/verify_no_uuid — the reference's
+guard, Makefile:37): methods taking an ``index`` are called from the
+replicated apply path and must derive *all* state from their arguments.
+Wall-clock is only read for lock-delay bookkeeping, which the reference
+also keeps node-local and out of the replicated state (KVSLockDelay is
+checked on the leader, kvs_endpoint.go:52-61).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from consul_tpu.state.notify import NotifyGroup, Waiter
+from consul_tpu.state.radix import RadixTree
+from consul_tpu.structs.structs import (
+    ACL,
+    CheckServiceNode,
+    DirEntry,
+    HEALTH_CRITICAL,
+    HealthCheck,
+    Node,
+    NodeService,
+    RegisterRequest,
+    SESSION_BEHAVIOR_DELETE,
+    SESSION_BEHAVIOR_RELEASE,
+    ServiceNode,
+    Session,
+)
+
+MAX_LOCK_DELAY = 60.0  # seconds (reference structs.MaxLockDelay)
+
+TABLE_NODES = "nodes"
+TABLE_SERVICES = "services"
+TABLE_CHECKS = "checks"
+TABLE_KVS = "kvs"
+TABLE_TOMBSTONES = "tombstones"
+TABLE_SESSIONS = "sessions"
+TABLE_ACLS = "acls"
+
+# Which tables a named query watches (reference: state_store.go:397-413).
+QUERY_TABLES: Dict[str, Tuple[str, ...]] = {
+    "Nodes": (TABLE_NODES,),
+    "Services": (TABLE_SERVICES,),
+    "ServiceNodes": (TABLE_NODES, TABLE_SERVICES),
+    "NodeServices": (TABLE_NODES, TABLE_SERVICES),
+    "ChecksInState": (TABLE_CHECKS,),
+    "NodeChecks": (TABLE_CHECKS,),
+    "ServiceChecks": (TABLE_CHECKS,),
+    "CheckServiceNodes": (TABLE_NODES, TABLE_SERVICES, TABLE_CHECKS),
+    "NodeInfo": (TABLE_NODES, TABLE_SERVICES, TABLE_CHECKS),
+    "NodeDump": (TABLE_NODES, TABLE_SERVICES, TABLE_CHECKS),
+    "SessionGet": (TABLE_SESSIONS,),
+    "SessionList": (TABLE_SESSIONS,),
+    "NodeSessions": (TABLE_SESSIONS,),
+    "ACLGet": (TABLE_ACLS,),
+    "ACLList": (TABLE_ACLS,),
+}
+
+
+class StateStoreError(Exception):
+    pass
+
+
+class _SortedKeys:
+    """Sorted key array giving O(log n) prefix range scans (the role LMDB's
+    B-tree 'id_prefix' virtual index plays at mdb_table.go:283-288)."""
+
+    def __init__(self) -> None:
+        self._keys: List[str] = []
+
+    def add(self, key: str) -> None:
+        i = bisect.bisect_left(self._keys, key)
+        if i >= len(self._keys) or self._keys[i] != key:
+            self._keys.insert(i, key)
+
+    def remove(self, key: str) -> None:
+        i = bisect.bisect_left(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            del self._keys[i]
+
+    def prefix_range(self, prefix: str) -> List[str]:
+        if not prefix:
+            return list(self._keys)
+        lo = bisect.bisect_left(self._keys, prefix)
+        hi = lo
+        # Forward scan instead of a synthetic upper-bound key: appending a
+        # sentinel char excludes keys whose next char sorts above it
+        # (e.g. astral code points), and we touch every match anyway.
+        while hi < len(self._keys) and self._keys[hi].startswith(prefix):
+            hi += 1
+        return self._keys[lo:hi]
+
+
+class StateStore:
+    def __init__(self, gc_hint: Optional[Callable[[int], None]] = None) -> None:
+        # nodes: name -> Node
+        self._nodes: Dict[str, Node] = {}
+        # services: (node, service_id) -> ServiceNode
+        self._services: Dict[Tuple[str, str], ServiceNode] = {}
+        # checks: (node, check_id) -> HealthCheck
+        self._checks: Dict[Tuple[str, str], HealthCheck] = {}
+        # kvs: key -> DirEntry (+ sorted keys, + session secondary index)
+        self._kvs: Dict[str, DirEntry] = {}
+        self._kvs_keys = _SortedKeys()
+        self._kvs_by_session: Dict[str, Set[str]] = {}
+        # tombstones: key -> DirEntry with cleared value
+        self._tombstones: Dict[str, DirEntry] = {}
+        self._tombstone_keys = _SortedKeys()
+        # sessions: id -> Session; session_checks: (node, check_id) -> {session}
+        self._sessions: Dict[str, Session] = {}
+        self._session_checks: Dict[Tuple[str, str], Set[str]] = {}
+        # acls: id -> ACL
+        self._acls: Dict[str, ACL] = {}
+
+        self._last_index: Dict[str, int] = {
+            t: 0 for t in (TABLE_NODES, TABLE_SERVICES, TABLE_CHECKS, TABLE_KVS,
+                           TABLE_TOMBSTONES, TABLE_SESSIONS, TABLE_ACLS)
+        }
+        self._watch: Dict[str, NotifyGroup] = {t: NotifyGroup() for t in self._last_index}
+        self._kv_watch = RadixTree()  # prefix -> NotifyGroup
+        # key -> monotonic expiry of the anti-split-brain lock delay
+        self._lock_delay: Dict[str, float] = {}
+        self._gc_hint = gc_hint
+
+    # -- index / watch plumbing -------------------------------------------
+
+    def last_index(self, *tables: str) -> int:
+        return max(self._last_index[t] for t in tables)
+
+    def query_tables(self, q: str) -> Tuple[str, ...]:
+        return QUERY_TABLES[q]
+
+    def watch(self, tables: Iterable[str], waiter: Waiter) -> None:
+        for t in tables:
+            self._watch[t].wait(waiter)
+
+    def stop_watch(self, tables: Iterable[str], waiter: Waiter) -> None:
+        for t in tables:
+            self._watch[t].clear(waiter)
+
+    def watch_kv(self, prefix: str, waiter: Waiter) -> None:
+        grp = self._kv_watch.get(prefix)
+        if grp is None:
+            grp = NotifyGroup()
+            self._kv_watch.insert(prefix, grp)
+        grp.wait(waiter)
+
+    def stop_watch_kv(self, prefix: str, waiter: Waiter) -> None:
+        grp = self._kv_watch.get(prefix)
+        if grp is not None:
+            grp.clear(waiter)
+            if len(grp) == 0:
+                self._kv_watch.delete(prefix)
+
+    def _notify(self, table: str) -> None:
+        self._watch[table].notify()
+
+    def _notify_kv(self, path: str, prefix: bool) -> None:
+        """Wake watchers whose registered prefix covers ``path``
+        (reference notifyKV, state_store.go:463-491)."""
+        matched = list(self._kv_watch.walk_path(path))
+        if prefix:
+            matched += [(p, g) for p, g in self._kv_watch.walk_prefix(path)
+                        if len(p) > len(path)]
+        for p, g in matched:
+            g.notify()
+            # Fired groups are empty until waiters re-register; prune them
+            # so ephemeral prefixes don't accrete (reference toDelete loop,
+            # state_store.go:478-489).
+            if len(g) == 0:
+                self._kv_watch.delete(p)
+
+    # -- catalog: nodes / services / checks --------------------------------
+
+    def ensure_registration(self, index: int, req: RegisterRequest) -> None:
+        """Atomic node+service+check(s) upsert (state_store.go:499-534)."""
+        self._ensure_node(index, Node(node=req.node, address=req.address))
+        if req.service is not None:
+            self._ensure_service(index, req.node, req.service)
+        if req.check is not None:
+            self._ensure_check(index, req.check)
+        for check in req.checks:
+            self._ensure_check(index, check)
+
+    def ensure_node(self, index: int, node: Node) -> None:
+        self._ensure_node(index, node)
+
+    def _ensure_node(self, index: int, node: Node) -> None:
+        self._nodes[node.node] = dataclasses.replace(node)
+        self._last_index[TABLE_NODES] = index
+        self._notify(TABLE_NODES)
+
+    def get_node(self, name: str) -> Tuple[int, Optional[str]]:
+        n = self._nodes.get(name)
+        return self._last_index[TABLE_NODES], (n.address if n else None)
+
+    def nodes(self) -> Tuple[int, List[Node]]:
+        return self._last_index[TABLE_NODES], sorted(
+            self._nodes.values(), key=lambda n: n.node)
+
+    def ensure_service(self, index: int, node: str, ns: NodeService) -> None:
+        self._ensure_service(index, node, ns)
+
+    def _ensure_service(self, index: int, node: str, ns: NodeService) -> None:
+        if node not in self._nodes:
+            raise StateStoreError("Missing node registration")
+        self._services[(node, ns.id)] = ServiceNode(
+            node=node, service_id=ns.id, service_name=ns.service,
+            service_tags=list(ns.tags), service_address=ns.address,
+            service_port=ns.port)
+        self._last_index[TABLE_SERVICES] = index
+        self._notify(TABLE_SERVICES)
+
+    def node_services(self, name: str) -> Tuple[int, Optional[Dict[str, NodeService]]]:
+        idx = self.last_index(TABLE_NODES, TABLE_SERVICES)
+        node = self._nodes.get(name)
+        if node is None:
+            return idx, None
+        out: Dict[str, NodeService] = {}
+        for (n, sid), sn in self._services.items():
+            if n == name:
+                out[sid] = _to_node_service(sn)
+        return idx, out
+
+    def services(self) -> Tuple[int, Dict[str, List[str]]]:
+        """service name -> union of tags (state_store.go:772-795)."""
+        out: Dict[str, List[str]] = {}
+        for sn in self._services.values():
+            tags = out.setdefault(sn.service_name, [])
+            for t in sn.service_tags:
+                if t not in tags:
+                    tags.append(t)
+        return self._last_index[TABLE_SERVICES], out
+
+    def service_nodes(self, service: str, tag: str = "") -> Tuple[int, List[ServiceNode]]:
+        idx = self.last_index(TABLE_NODES, TABLE_SERVICES)
+        out = []
+        for sn in sorted(self._services.values(), key=lambda s: (s.node, s.service_id)):
+            if sn.service_name != service:
+                continue
+            if tag and tag not in sn.service_tags:
+                continue
+            node = self._nodes.get(sn.node)
+            out.append(ServiceNode(
+                node=sn.node, address=node.address if node else "",
+                service_id=sn.service_id, service_name=sn.service_name,
+                service_tags=list(sn.service_tags),
+                service_address=sn.service_address, service_port=sn.service_port))
+        return idx, out
+
+    def delete_node_service(self, index: int, node: str, service_id: str) -> None:
+        """Remove one service and its checks (state_store.go:692-730)."""
+        if self._services.pop((node, service_id), None) is not None:
+            self._last_index[TABLE_SERVICES] = index
+            self._notify(TABLE_SERVICES)
+        victims = [k for k, c in self._checks.items()
+                   if k[0] == node and c.service_id == service_id]
+        for key in victims:
+            self._invalidate_check(index, key[0], key[1])
+        if victims:
+            for key in victims:
+                del self._checks[key]
+            self._last_index[TABLE_CHECKS] = index
+            self._notify(TABLE_CHECKS)
+
+    def delete_node(self, index: int, node: str) -> None:
+        """Remove a node, all its services/checks, and invalidate its
+        sessions (state_store.go:732-770)."""
+        self._invalidate_node(index, node)
+        svc = [k for k in self._services if k[0] == node]
+        for key in svc:
+            del self._services[key]
+        if svc:
+            self._last_index[TABLE_SERVICES] = index
+            self._notify(TABLE_SERVICES)
+        chk = [k for k in self._checks if k[0] == node]
+        for key in chk:
+            del self._checks[key]
+        if chk:
+            self._last_index[TABLE_CHECKS] = index
+            self._notify(TABLE_CHECKS)
+        if self._nodes.pop(node, None) is not None:
+            self._last_index[TABLE_NODES] = index
+            self._notify(TABLE_NODES)
+
+    def ensure_check(self, index: int, check: HealthCheck) -> None:
+        self._ensure_check(index, check)
+
+    def _ensure_check(self, index: int, check: HealthCheck) -> None:
+        """Upsert a check; critical status invalidates dependent sessions
+        (state_store.go:887-934)."""
+        if not check.status:
+            check.status = HEALTH_CRITICAL
+        if check.node not in self._nodes:
+            raise StateStoreError("Missing node registration")
+        if check.service_id:
+            sn = self._services.get((check.node, check.service_id))
+            if sn is None:
+                raise StateStoreError("Missing service registration")
+            check.service_name = sn.service_name
+        if check.status == HEALTH_CRITICAL:
+            self._invalidate_check(index, check.node, check.check_id)
+        self._checks[(check.node, check.check_id)] = dataclasses.replace(check)
+        self._last_index[TABLE_CHECKS] = index
+        self._notify(TABLE_CHECKS)
+
+    def delete_node_check(self, index: int, node: str, check_id: str) -> None:
+        self._invalidate_check(index, node, check_id)
+        if self._checks.pop((node, check_id), None) is not None:
+            self._last_index[TABLE_CHECKS] = index
+            self._notify(TABLE_CHECKS)
+
+    def node_checks(self, node: str) -> Tuple[int, List[HealthCheck]]:
+        return self._last_index[TABLE_CHECKS], sorted(
+            (c for k, c in self._checks.items() if k[0] == node),
+            key=lambda c: c.check_id)
+
+    def service_checks(self, service: str) -> Tuple[int, List[HealthCheck]]:
+        return self._last_index[TABLE_CHECKS], sorted(
+            (c for c in self._checks.values() if c.service_name == service),
+            key=lambda c: (c.node, c.check_id))
+
+    def checks_in_state(self, state: str) -> Tuple[int, List[HealthCheck]]:
+        from consul_tpu.structs.structs import HEALTH_ANY
+        return self._last_index[TABLE_CHECKS], sorted(
+            (c for c in self._checks.values()
+             if state == HEALTH_ANY or c.status == state),
+            key=lambda c: (c.node, c.check_id))
+
+    def check_service_nodes(self, service: str, tag: str = "") -> Tuple[int, List[CheckServiceNode]]:
+        """Join of nodes, service instances, and their checks + node-level
+        checks (state_store.go:998-1076)."""
+        idx = self.last_index(TABLE_NODES, TABLE_SERVICES, TABLE_CHECKS)
+        out = []
+        for sn in sorted(self._services.values(), key=lambda s: (s.node, s.service_id)):
+            if sn.service_name != service:
+                continue
+            if tag and tag not in sn.service_tags:
+                continue
+            node = self._nodes.get(sn.node)
+            if node is None:
+                continue
+            checks = [c for k, c in sorted(self._checks.items())
+                      if k[0] == sn.node and c.service_id in ("", sn.service_id)]
+            out.append(CheckServiceNode(
+                node=node, service=_to_node_service(sn), checks=checks))
+        return idx, out
+
+    def node_info(self, node: str) -> Tuple[int, List[dict]]:
+        idx = self.last_index(TABLE_NODES, TABLE_SERVICES, TABLE_CHECKS)
+        n = self._nodes.get(node)
+        if n is None:
+            return idx, []
+        return idx, [self._dump_one(n)]
+
+    def node_dump(self) -> Tuple[int, List[dict]]:
+        idx = self.last_index(TABLE_NODES, TABLE_SERVICES, TABLE_CHECKS)
+        return idx, [self._dump_one(n)
+                     for _, n in sorted(self._nodes.items())]
+
+    def _dump_one(self, n: Node) -> dict:
+        return {
+            "node": n.node,
+            "address": n.address,
+            "services": [_to_node_service(sn)
+                         for k, sn in sorted(self._services.items()) if k[0] == n.node],
+            "checks": [c for k, c in sorted(self._checks.items()) if k[0] == n.node],
+        }
+
+    # -- KV ----------------------------------------------------------------
+
+    def kvs_set(self, index: int, d: DirEntry) -> None:
+        self._kvs_set(index, d, mode="set")
+
+    def kvs_check_and_set(self, index: int, d: DirEntry) -> bool:
+        return self._kvs_set(index, d, mode="cas")
+
+    def kvs_lock(self, index: int, d: DirEntry) -> bool:
+        return self._kvs_set(index, d, mode="lock")
+
+    def kvs_unlock(self, index: int, d: DirEntry) -> bool:
+        return self._kvs_set(index, d, mode="unlock")
+
+    def _kvs_set(self, index: int, d: DirEntry, mode: str) -> bool:
+        """Reference kvsSet (state_store.go:1469-1564), all four modes."""
+        d = d.clone()  # never alias caller-owned structs into the store
+        exist = self._kvs.get(d.key)
+
+        if mode == "cas":
+            # modify_index 0 = set-if-not-exists, else exact match required.
+            if d.modify_index == 0 and exist is not None:
+                return False
+            if d.modify_index > 0 and (exist is None or exist.modify_index != d.modify_index):
+                return False
+
+        if mode == "lock":
+            if not d.session:
+                raise StateStoreError("Missing session")
+            if exist is not None and exist.session:
+                return False  # already locked
+            if d.session not in self._sessions:
+                raise StateStoreError("Invalid session")
+            d.lock_index = exist.lock_index + 1 if exist is not None else 1
+
+        if mode == "unlock":
+            if exist is None or exist.session != d.session:
+                return False
+
+        if exist is None:
+            d.create_index = index
+        else:
+            # The caller's entry (with its new value) is what gets stored;
+            # lock bookkeeping is inherited per mode (kvsSet's single
+            # copy-forward block, state_store.go:1540-1551 — for unlock the
+            # session was just cleared on `exist` before that block runs).
+            d.create_index = exist.create_index
+            if mode in ("set", "cas"):
+                d.lock_index = exist.lock_index
+                d.session = exist.session
+            elif mode == "unlock":
+                d.lock_index = exist.lock_index
+                d.session = ""
+        d.modify_index = index
+
+        self._put_kv(d, old=exist)
+        self._last_index[TABLE_KVS] = index
+        self._notify_kv(d.key, prefix=False)
+        return True
+
+    def _put_kv(self, d: DirEntry, old: Optional[DirEntry]) -> None:
+        if old is not None and old.session:
+            s = self._kvs_by_session.get(old.session)
+            if s is not None:
+                s.discard(d.key)
+                if not s:
+                    del self._kvs_by_session[old.session]
+        self._kvs[d.key] = d
+        self._kvs_keys.add(d.key)
+        if d.session:
+            self._kvs_by_session.setdefault(d.session, set()).add(d.key)
+
+    def kvs_get(self, key: str) -> Tuple[int, Optional[DirEntry]]:
+        idx = max(self._last_index[TABLE_KVS], self._last_index[TABLE_TOMBSTONES])
+        return idx, self._kvs.get(key)
+
+    def kvs_list(self, prefix: str) -> Tuple[int, int, List[DirEntry]]:
+        """Returns (tombstone_max_index, table_index, entries)
+        (state_store.go:1202-1236): the endpoint uses the tombstone index
+        to keep blocking list queries advancing after deletes."""
+        idx = max(self._last_index[TABLE_KVS], self._last_index[TABLE_TOMBSTONES])
+        ents = [self._kvs[k] for k in self._kvs_keys.prefix_range(prefix)]
+        tomb_idx = 0
+        for k in self._tombstone_keys.prefix_range(prefix):
+            tomb_idx = max(tomb_idx, self._tombstones[k].modify_index)
+        return tomb_idx, idx, ents
+
+    def kvs_list_keys(self, prefix: str, separator: str) -> Tuple[int, List[str]]:
+        """Key listing rolled up to ``separator`` (state_store.go:1238-1320)."""
+        idx = self._last_index[TABLE_KVS]
+        if idx == 0:
+            idx = 1  # non-zero so blocking queries can block (ref comment)
+        keys: List[str] = []
+        max_index = 0
+        last = ""
+        plen = len(prefix)
+        for k in self._kvs_keys.prefix_range(prefix):
+            ent = self._kvs[k]
+            max_index = max(max_index, ent.modify_index)
+            if not separator:
+                keys.append(k)
+                continue
+            pos = k[plen:].find(separator)
+            if pos >= 0:
+                to_sep = k[: plen + pos + len(separator)]
+                if to_sep != last:
+                    keys.append(to_sep)
+                    last = to_sep
+            else:
+                keys.append(k)
+        for k in self._tombstone_keys.prefix_range(prefix):
+            max_index = max(max_index, self._tombstones[k].modify_index)
+        return (max_index or idx), keys
+
+    def kvs_delete(self, index: int, key: str) -> None:
+        self._kvs_delete(index, [key], notify_prefix=False, notify_path=key)
+
+    def kvs_delete_check_and_set(self, index: int, key: str, cas_index: int) -> bool:
+        """Atomic delete-CAS (state_store.go:1327-1361): cas_index 0 means
+        delete-if-exists always proceeds."""
+        exist = self._kvs.get(key)
+        if cas_index > 0 and (exist is None or exist.modify_index != cas_index):
+            return False
+        self._kvs_delete(index, [key] if exist is not None else [],
+                         notify_prefix=False, notify_path=key)
+        return True
+
+    def kvs_delete_tree(self, index: int, prefix: str) -> None:
+        keys = self._kvs_keys.prefix_range(prefix)
+        self._kvs_delete(index, keys, notify_prefix=True, notify_path=prefix)
+
+    def _kvs_delete(self, index: int, keys: List[str], notify_prefix: bool,
+                    notify_path: str) -> None:
+        """Delete + tombstone creation (kvsDeleteWithIndexTxn,
+        state_store.go:1384-1441)."""
+        deleted = 0
+        for key in list(keys):
+            ent = self._kvs.pop(key, None)
+            if ent is None:
+                continue
+            deleted += 1
+            self._kvs_keys.remove(key)
+            if ent.session:
+                s = self._kvs_by_session.get(ent.session)
+                if s is not None:
+                    s.discard(key)
+                    if not s:
+                        del self._kvs_by_session[ent.session]
+            tomb = ent.clone()
+            tomb.modify_index = index
+            tomb.value = b""
+            tomb.session = ""
+            self._tombstones[key] = tomb
+            self._tombstone_keys.add(key)
+        if deleted:
+            self._last_index[TABLE_KVS] = index
+            self._last_index[TABLE_TOMBSTONES] = index
+            self._notify_kv(notify_path, prefix=notify_prefix)
+            if self._gc_hint is not None:
+                self._gc_hint(index)
+
+    def kvs_lock_delay(self, key: str) -> float:
+        """Remaining lock-delay in seconds, 0 if none (state_store.go:1461-1467).
+        Checked on the leader's clock, never inside the replicated path."""
+        exp = self._lock_delay.get(key)
+        if exp is None:
+            return 0.0
+        rem = exp - time.monotonic()
+        if rem <= 0:
+            del self._lock_delay[key]
+            return 0.0
+        return rem
+
+    def reap_tombstones(self, index: int) -> None:
+        """Drop tombstones with modify_index <= index (state_store.go:1566-1613)."""
+        for key in [k for k, t in self._tombstones.items() if t.modify_index <= index]:
+            del self._tombstones[key]
+            self._tombstone_keys.remove(key)
+
+    # -- sessions ----------------------------------------------------------
+
+    def session_create(self, index: int, session: Session) -> None:
+        """Validates node + non-critical checks (state_store.go:1631-1701)."""
+        if not session.id:
+            raise StateStoreError("Missing Session ID")
+        session = dataclasses.replace(session, checks=list(session.checks))
+        if not session.behavior:
+            session.behavior = SESSION_BEHAVIOR_RELEASE
+        elif session.behavior not in (SESSION_BEHAVIOR_RELEASE, SESSION_BEHAVIOR_DELETE):
+            raise StateStoreError(
+                f"Invalid Session Behavior setting '{session.behavior}'")
+        session.create_index = index
+        if session.node not in self._nodes:
+            raise StateStoreError("Missing node registration")
+        for check_id in session.checks:
+            chk = self._checks.get((session.node, check_id))
+            if chk is None:
+                raise StateStoreError(f"Missing check '{check_id}' registration")
+            if chk.status == HEALTH_CRITICAL:
+                raise StateStoreError(f"Check '{check_id}' is in {chk.status} state")
+        self._sessions[session.id] = session
+        for check_id in session.checks:
+            self._session_checks.setdefault((session.node, check_id), set()).add(session.id)
+        self._last_index[TABLE_SESSIONS] = index
+        self._notify(TABLE_SESSIONS)
+
+    def session_get(self, sid: str) -> Tuple[int, Optional[Session]]:
+        return self._last_index[TABLE_SESSIONS], self._sessions.get(sid)
+
+    def session_list(self) -> Tuple[int, List[Session]]:
+        return self._last_index[TABLE_SESSIONS], sorted(
+            self._sessions.values(), key=lambda s: s.id)
+
+    def node_sessions(self, node: str) -> Tuple[int, List[Session]]:
+        return self._last_index[TABLE_SESSIONS], sorted(
+            (s for s in self._sessions.values() if s.node == node),
+            key=lambda s: s.id)
+
+    def session_destroy(self, index: int, sid: str) -> None:
+        self._invalidate_session(index, sid)
+
+    def _invalidate_node(self, index: int, node: str) -> None:
+        for sid in [s.id for s in self._sessions.values() if s.node == node]:
+            self._invalidate_session(index, sid)
+
+    def _invalidate_check(self, index: int, node: str, check_id: str) -> None:
+        for sid in list(self._session_checks.get((node, check_id), ())):
+            self._invalidate_session(index, sid)
+
+    def _invalidate_session(self, index: int, sid: str) -> None:
+        """Destroy a session and handle its held locks per behavior
+        (state_store.go:1820-1869)."""
+        session = self._sessions.get(sid)
+        if session is None:
+            return
+        delay = min(session.lock_delay, MAX_LOCK_DELAY)
+        if session.behavior == SESSION_BEHAVIOR_DELETE:
+            self._delete_locks(index, delay, sid)
+        else:
+            self._invalidate_locks(index, delay, sid)
+        del self._sessions[sid]
+        for check_id in session.checks:
+            grp = self._session_checks.get((session.node, check_id))
+            if grp is not None:
+                grp.discard(sid)
+                if not grp:
+                    del self._session_checks[(session.node, check_id)]
+        self._last_index[TABLE_SESSIONS] = index
+        self._notify(TABLE_SESSIONS)
+
+    def _held_keys(self, sid: str) -> List[str]:
+        return sorted(self._kvs_by_session.get(sid, ()))
+
+    def _invalidate_locks(self, index: int, delay: float, sid: str) -> None:
+        """Release-behavior: clear lock holder, arm lock-delay
+        (state_store.go:1871-1912)."""
+        keys = self._held_keys(sid)
+        expires = time.monotonic() + delay if delay > 0 else 0.0
+        for key in keys:
+            kv = self._kvs[key].clone()
+            kv.session = ""
+            kv.modify_index = index
+            self._put_kv(kv, old=self._kvs[key])
+            if delay > 0:
+                self._lock_delay[key] = expires
+            self._notify_kv(key, prefix=False)
+        if keys:
+            self._last_index[TABLE_KVS] = index
+
+    def _delete_locks(self, index: int, delay: float, sid: str) -> None:
+        """Delete-behavior: remove held keys entirely (state_store.go:1914-1947)."""
+        keys = self._held_keys(sid)
+        expires = time.monotonic() + delay if delay > 0 else 0.0
+        for key in keys:
+            self._kvs_delete(index, [key], notify_prefix=False, notify_path=key)
+            if delay > 0:
+                self._lock_delay[key] = expires
+
+    # -- ACLs --------------------------------------------------------------
+
+    def acl_set(self, index: int, acl: ACL) -> None:
+        """Upsert (state_store.go:1949-1993); ID generation happens in the
+        endpoint on the leader, never here (determinism contract)."""
+        if not acl.id:
+            raise StateStoreError("Missing ACL ID")
+        acl = dataclasses.replace(acl)
+        exist = self._acls.get(acl.id)
+        if exist is None:
+            acl.create_index = index
+        else:
+            acl.create_index = exist.create_index
+        acl.modify_index = index
+        self._acls[acl.id] = acl
+        self._last_index[TABLE_ACLS] = index
+        self._notify(TABLE_ACLS)
+
+    def acl_get(self, aid: str) -> Tuple[int, Optional[ACL]]:
+        return self._last_index[TABLE_ACLS], self._acls.get(aid)
+
+    def acl_list(self) -> Tuple[int, List[ACL]]:
+        return self._last_index[TABLE_ACLS], sorted(
+            self._acls.values(), key=lambda a: a.id)
+
+    def acl_delete(self, index: int, aid: str) -> None:
+        if self._acls.pop(aid, None) is not None:
+            self._last_index[TABLE_ACLS] = index
+            self._notify(TABLE_ACLS)
+
+    # -- snapshot / restore -------------------------------------------------
+
+    def snapshot_records(self):
+        """Deterministic stream of (kind, payload) records mirroring the
+        FSM snapshot layout (consul/fsm.go:262-404): per-node registration
+        with its services and checks, then kvs, tombstones, sessions, acls."""
+        for name, node in sorted(self._nodes.items()):
+            yield ("registration", RegisterRequest(node=node.node, address=node.address))
+            for k, sn in sorted(self._services.items()):
+                if k[0] == name:
+                    yield ("service", (name, _to_node_service(sn)))
+            for k, c in sorted(self._checks.items()):
+                if k[0] == name:
+                    yield ("check", c)
+        for key in self._kvs_keys.prefix_range(""):
+            yield ("kvs", self._kvs[key])
+        for key in self._tombstone_keys.prefix_range(""):
+            yield ("tombstone", self._tombstones[key])
+        for sid, sess in sorted(self._sessions.items()):
+            yield ("session", sess)
+        for aid, acl in sorted(self._acls.items()):
+            yield ("acl", acl)
+
+    def kvs_restore(self, d: DirEntry) -> None:
+        d = d.clone()
+        self._put_kv(d, old=self._kvs.get(d.key))
+        self._last_index[TABLE_KVS] = max(self._last_index[TABLE_KVS], d.modify_index)
+
+    def tombstone_restore(self, d: DirEntry) -> None:
+        d = d.clone()
+        self._tombstones[d.key] = d
+        self._tombstone_keys.add(d.key)
+        self._last_index[TABLE_TOMBSTONES] = max(
+            self._last_index[TABLE_TOMBSTONES], d.modify_index)
+
+    def session_restore(self, session: Session) -> None:
+        session = dataclasses.replace(session, checks=list(session.checks))
+        self._sessions[session.id] = session
+        for check_id in session.checks:
+            self._session_checks.setdefault(
+                (session.node, check_id), set()).add(session.id)
+        self._last_index[TABLE_SESSIONS] = max(
+            self._last_index[TABLE_SESSIONS], session.create_index)
+
+    def acl_restore(self, acl: ACL) -> None:
+        acl = dataclasses.replace(acl)
+        self._acls[acl.id] = acl
+        self._last_index[TABLE_ACLS] = max(
+            self._last_index[TABLE_ACLS], acl.modify_index)
+
+
+def _to_node_service(sn: ServiceNode) -> NodeService:
+    return NodeService(id=sn.service_id, service=sn.service_name,
+                       tags=list(sn.service_tags), address=sn.service_address,
+                       port=sn.service_port)

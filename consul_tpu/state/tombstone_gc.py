@@ -1,0 +1,76 @@
+"""Tombstone garbage collection.
+
+Parity target: ``consul/tombstone_gc.go:22-150`` — KV-delete tombstones
+must eventually be reaped or storage grows without bound, but reaping is
+a Raft write (TombstoneReap, consul/leader.go:553-566), so expiry is
+batched into granularity buckets to bound the number of Raft entries.
+Only the leader arms timers (SetEnabled, leader.go:126-131).
+
+Departure: the reference arms one ``time.AfterFunc`` per bucket; our
+host plane is an asyncio loop, so the GC exposes ``next_deadline()`` /
+``collect(now)`` and the leader loop owns the single timer — same
+batching semantics, one fewer concurrency primitive, and fully
+deterministic under test clocks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+DEFAULT_TOMBSTONE_TTL = 15 * 60.0  # consul/config.go:257
+DEFAULT_GRANULARITY = 30.0         # consul/config.go:258
+
+
+class TombstoneGC:
+    def __init__(self, ttl: float = DEFAULT_TOMBSTONE_TTL,
+                 granularity: float = DEFAULT_GRANULARITY) -> None:
+        if ttl <= 0 or granularity <= 0:
+            raise ValueError("TTL and granularity must be positive")
+        self.ttl = ttl
+        self.granularity = granularity
+        self._enabled = False
+        # bucket expiry time -> highest index hinted into that bucket
+        self._buckets: Dict[float, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, enabled: bool, now: float) -> None:
+        """Leader gate (tombstone_gc.go:49-63): disabling drops all
+        pending buckets — the next leader re-hints via fresh deletes and
+        the periodic reap catches strays."""
+        if enabled == self._enabled:
+            return
+        self._enabled = enabled
+        if not enabled:
+            self._buckets.clear()
+
+    def hint(self, index: int, now: float) -> None:
+        """Record that ``index`` contains tombstones needing expiry
+        (tombstone_gc.go:65-95): rounded up to the granularity bucket."""
+        if not self._enabled:
+            return
+        expires = self._bucket_time(now)
+        cur = self._buckets.get(expires, 0)
+        if index > cur:
+            self._buckets[expires] = index
+
+    def next_deadline(self, now: float) -> Optional[float]:
+        if not self._buckets:
+            return None
+        return min(self._buckets)
+
+    def collect(self, now: float) -> List[int]:
+        """Expired bucket indexes, each destined for one TombstoneReap
+        Raft entry (leader.go:553-566)."""
+        due = sorted(t for t in self._buckets if t <= now)
+        return [self._buckets.pop(t) for t in due]
+
+    def pending_expiration(self) -> bool:
+        return bool(self._buckets)
+
+    def _bucket_time(self, now: float) -> float:
+        expires = now + self.ttl
+        return math.ceil(expires / self.granularity) * self.granularity

@@ -1,0 +1,366 @@
+"""State store semantics, mirroring the reference's state_store_test.go
+coverage tiers (SURVEY.md §4 tier 2 — pure-logic, no networking)."""
+
+import threading
+
+import pytest
+
+from consul_tpu.state import StateStore, StateStoreError
+from consul_tpu.state.tombstone_gc import TombstoneGC
+from consul_tpu.structs.structs import (
+    ACL,
+    DirEntry,
+    HEALTH_CRITICAL,
+    HEALTH_PASSING,
+    HealthCheck,
+    Node,
+    NodeService,
+    RegisterRequest,
+    SESSION_BEHAVIOR_DELETE,
+    Session,
+)
+
+
+def reg(store, index, node="node1", addr="10.0.0.1", service=None, check=None):
+    store.ensure_registration(index, RegisterRequest(
+        node=node, address=addr, service=service, check=check))
+
+
+class TestCatalog:
+    def test_node_register_and_list(self):
+        s = StateStore()
+        reg(s, 1, "n1", "10.0.0.1")
+        reg(s, 2, "n2", "10.0.0.2")
+        idx, nodes = s.nodes()
+        assert idx == 2
+        assert [n.node for n in nodes] == ["n1", "n2"]
+        idx, addr = s.get_node("n1")
+        assert addr == "10.0.0.1"
+
+    def test_service_requires_node(self):
+        s = StateStore()
+        with pytest.raises(StateStoreError):
+            s.ensure_service(1, "ghost", NodeService(id="a", service="a"))
+
+    def test_service_nodes_and_tags(self):
+        s = StateStore()
+        reg(s, 1, "n1")
+        reg(s, 2, "n2", "10.0.0.2")
+        s.ensure_service(3, "n1", NodeService(id="web", service="web", tags=["v1"], port=80))
+        s.ensure_service(4, "n2", NodeService(id="web", service="web", tags=["v2"], port=81))
+        idx, sns = s.service_nodes("web")
+        assert idx == 4 and len(sns) == 2
+        assert sns[0].address == "10.0.0.1"
+        _, tagged = s.service_nodes("web", tag="v2")
+        assert [sn.node for sn in tagged] == ["n2"]
+        _, services = s.services()
+        assert services == {"web": ["v1", "v2"]}
+
+    def test_check_defaults_critical_and_joins(self):
+        s = StateStore()
+        reg(s, 1, "n1")
+        s.ensure_service(2, "n1", NodeService(id="web", service="web"))
+        s.ensure_check(3, HealthCheck(node="n1", check_id="c1", service_id="web", status=""))
+        idx, checks = s.node_checks("n1")
+        assert checks[0].status == HEALTH_CRITICAL
+        assert checks[0].service_name == "web"
+        # node-level check joins into check_service_nodes
+        s.ensure_check(4, HealthCheck(node="n1", check_id="serfHealth",
+                                      status=HEALTH_PASSING))
+        _, csns = s.check_service_nodes("web")
+        assert len(csns) == 1
+        assert {c.check_id for c in csns[0].checks} == {"c1", "serfHealth"}
+
+    def test_delete_node_cascades(self):
+        s = StateStore()
+        reg(s, 1, "n1")
+        s.ensure_service(2, "n1", NodeService(id="web", service="web"))
+        s.ensure_check(3, HealthCheck(node="n1", check_id="c1", status=HEALTH_PASSING))
+        s.delete_node(4, "n1")
+        assert s.nodes()[1] == []
+        assert s.service_nodes("web")[1] == []
+        assert s.node_checks("n1")[1] == []
+
+    def test_node_dump(self):
+        s = StateStore()
+        reg(s, 1, "n1")
+        s.ensure_service(2, "n1", NodeService(id="web", service="web"))
+        _, dump = s.node_dump()
+        assert dump[0]["node"] == "n1"
+        assert dump[0]["services"][0].id == "web"
+
+
+class TestKVS:
+    def test_set_get_indexes(self):
+        s = StateStore()
+        s.kvs_set(5, DirEntry(key="foo", value=b"bar"))
+        idx, ent = s.kvs_get("foo")
+        assert idx == 5 and ent.create_index == 5 and ent.modify_index == 5
+        s.kvs_set(7, DirEntry(key="foo", value=b"baz"))
+        _, ent = s.kvs_get("foo")
+        assert ent.create_index == 5 and ent.modify_index == 7
+
+    def test_cas_semantics(self):
+        s = StateStore()
+        # modify_index=0 -> set-if-not-exists
+        assert s.kvs_check_and_set(1, DirEntry(key="k", value=b"1", modify_index=0))
+        assert not s.kvs_check_and_set(2, DirEntry(key="k", value=b"2", modify_index=0))
+        # wrong index fails, right index wins
+        assert not s.kvs_check_and_set(3, DirEntry(key="k", value=b"3", modify_index=99))
+        assert s.kvs_check_and_set(4, DirEntry(key="k", value=b"4", modify_index=1))
+        _, ent = s.kvs_get("k")
+        assert ent.value == b"4"
+
+    def test_list_and_list_keys(self):
+        s = StateStore()
+        for i, k in enumerate(["web/a", "web/b/c", "web/b/d", "other"], start=1):
+            s.kvs_set(i, DirEntry(key=k, value=b"x"))
+        _, idx, ents = s.kvs_list("web/")
+        assert idx == 4
+        assert [e.key for e in ents] == ["web/a", "web/b/c", "web/b/d"]
+        _, keys = s.kvs_list_keys("web/", "/")
+        assert keys == ["web/a", "web/b/"]
+        _, keys = s.kvs_list_keys("", "/")
+        assert keys == ["other", "web/"]
+
+    def test_delete_tombstone_advances_list_index(self):
+        s = StateStore()
+        s.kvs_set(1, DirEntry(key="web/a", value=b"x"))
+        s.kvs_set(2, DirEntry(key="web/b", value=b"x"))
+        s.kvs_delete(3, "web/b")
+        tomb_idx, idx, ents = s.kvs_list("web/")
+        assert [e.key for e in ents] == ["web/a"]
+        assert tomb_idx == 3 and idx == 3
+        s.reap_tombstones(3)
+        tomb_idx, _, _ = s.kvs_list("web/")
+        assert tomb_idx == 0
+
+    def test_delete_tree(self):
+        s = StateStore()
+        for i, k in enumerate(["a/1", "a/2", "b/1"], start=1):
+            s.kvs_set(i, DirEntry(key=k, value=b"x"))
+        s.kvs_delete_tree(5, "a/")
+        _, _, ents = s.kvs_list("")
+        assert [e.key for e in ents] == ["b/1"]
+        tomb_idx, _, _ = s.kvs_list("a/")
+        assert tomb_idx == 5
+
+    def test_prefix_scan_handles_astral_keys(self):
+        s = StateStore()
+        s.kvs_set(1, DirEntry(key="web/\U0001F600x", value=b"x"))
+        s.kvs_set(2, DirEntry(key="web/a", value=b"x"))
+        _, _, ents = s.kvs_list("web/")
+        assert [e.key for e in ents] == ["web/a", "web/\U0001F600x"]
+        s.kvs_delete_tree(3, "web/")
+        assert s.kvs_list("")[2] == []
+
+    def test_delete_cas(self):
+        s = StateStore()
+        s.kvs_set(1, DirEntry(key="k", value=b"x"))
+        assert not s.kvs_delete_check_and_set(2, "k", 99)
+        assert s.kvs_get("k")[1] is not None
+        assert s.kvs_delete_check_and_set(3, "k", 1)
+        assert s.kvs_get("k")[1] is None
+
+
+def make_session_env(s: StateStore):
+    reg(s, 1, "n1")
+    s.ensure_check(2, HealthCheck(node="n1", check_id="c1", status=HEALTH_PASSING))
+
+
+class TestSessions:
+    def test_create_validations(self):
+        s = StateStore()
+        make_session_env(s)
+        with pytest.raises(StateStoreError):  # no node
+            s.session_create(3, Session(id="s1", node="ghost"))
+        with pytest.raises(StateStoreError):  # missing check
+            s.session_create(3, Session(id="s1", node="n1", checks=["nope"]))
+        s.ensure_check(3, HealthCheck(node="n1", check_id="crit", status=HEALTH_CRITICAL))
+        with pytest.raises(StateStoreError):  # critical check
+            s.session_create(4, Session(id="s1", node="n1", checks=["crit"]))
+        s.session_create(5, Session(id="s1", node="n1", checks=["c1"]))
+        _, sess = s.session_get("s1")
+        assert sess.create_index == 5
+
+    def test_lock_unlock(self):
+        s = StateStore()
+        make_session_env(s)
+        s.session_create(3, Session(id="s1", node="n1"))
+        s.session_create(4, Session(id="s2", node="n1"))
+        assert s.kvs_lock(5, DirEntry(key="k", value=b"v", session="s1"))
+        _, ent = s.kvs_get("k")
+        assert ent.lock_index == 1 and ent.session == "s1"
+        # second session cannot steal
+        assert not s.kvs_lock(6, DirEntry(key="k", value=b"v", session="s2"))
+        # wrong session cannot unlock
+        assert not s.kvs_unlock(7, DirEntry(key="k", session="s2"))
+        assert s.kvs_unlock(8, DirEntry(key="k", session="s1"))
+        _, ent = s.kvs_get("k")
+        assert ent.session == "" and ent.lock_index == 1
+        # re-acquire bumps lock_index
+        assert s.kvs_lock(9, DirEntry(key="k", value=b"v", session="s2"))
+        assert s.kvs_get("k")[1].lock_index == 2
+
+    def test_unlock_writes_new_value(self):
+        # Reference kvsSet inserts the caller's entry on unlock — a
+        # release-with-body updates the value (state_store.go:1540-1551).
+        s = StateStore()
+        make_session_env(s)
+        s.session_create(3, Session(id="s1", node="n1"))
+        s.kvs_lock(4, DirEntry(key="k", value=b"old", session="s1"))
+        assert s.kvs_unlock(5, DirEntry(key="k", value=b"new", session="s1"))
+        _, ent = s.kvs_get("k")
+        assert ent.value == b"new" and ent.session == "" and ent.lock_index == 1
+
+    def test_lock_requires_session(self):
+        s = StateStore()
+        with pytest.raises(StateStoreError):
+            s.kvs_lock(1, DirEntry(key="k"))
+        with pytest.raises(StateStoreError):
+            s.kvs_lock(1, DirEntry(key="k", session="ghost"))
+
+    def test_invalidation_releases_locks_with_delay(self):
+        s = StateStore()
+        make_session_env(s)
+        s.session_create(3, Session(id="s1", node="n1", lock_delay=15.0))
+        s.kvs_lock(4, DirEntry(key="k", value=b"v", session="s1"))
+        s.session_destroy(5, "s1")
+        assert s.session_get("s1")[1] is None
+        _, ent = s.kvs_get("k")
+        assert ent is not None and ent.session == "" and ent.modify_index == 5
+        assert s.kvs_lock_delay("k") > 0
+
+    def test_delete_behavior_deletes_keys(self):
+        s = StateStore()
+        make_session_env(s)
+        s.session_create(3, Session(id="s1", node="n1",
+                                    behavior=SESSION_BEHAVIOR_DELETE, lock_delay=0))
+        s.kvs_lock(4, DirEntry(key="k", value=b"v", session="s1"))
+        s.session_destroy(5, "s1")
+        assert s.kvs_get("k")[1] is None
+        tomb_idx, _, _ = s.kvs_list("k")
+        assert tomb_idx == 5
+
+    def test_critical_check_invalidates_session(self):
+        s = StateStore()
+        make_session_env(s)
+        s.session_create(3, Session(id="s1", node="n1", checks=["c1"], lock_delay=0))
+        s.kvs_lock(4, DirEntry(key="k", value=b"v", session="s1"))
+        s.ensure_check(5, HealthCheck(node="n1", check_id="c1", status=HEALTH_CRITICAL))
+        assert s.session_get("s1")[1] is None
+        assert s.kvs_get("k")[1].session == ""
+
+    def test_node_delete_invalidates_sessions(self):
+        s = StateStore()
+        make_session_env(s)
+        s.session_create(3, Session(id="s1", node="n1"))
+        s.delete_node(4, "n1")
+        assert s.session_get("s1")[1] is None
+
+    def test_node_sessions(self):
+        s = StateStore()
+        make_session_env(s)
+        reg(s, 2, "n2")
+        s.session_create(3, Session(id="s1", node="n1"))
+        s.session_create(4, Session(id="s2", node="n2"))
+        _, out = s.node_sessions("n1")
+        assert [x.id for x in out] == ["s1"]
+
+
+class TestACL:
+    def test_set_get_delete(self):
+        s = StateStore()
+        s.acl_set(1, ACL(id="a1", name="x", rules="key \"\" { policy = \"read\" }"))
+        idx, acl = s.acl_get("a1")
+        assert idx == 1 and acl.create_index == 1
+        s.acl_set(2, ACL(id="a1", name="y"))
+        _, acl = s.acl_get("a1")
+        assert acl.create_index == 1 and acl.modify_index == 2
+        _, acls = s.acl_list()
+        assert len(acls) == 1
+        s.acl_delete(3, "a1")
+        assert s.acl_get("a1")[1] is None
+
+
+class TestWatches:
+    def test_table_watch_fires_once(self):
+        s = StateStore()
+        ev = threading.Event()
+        s.watch(s.query_tables("Nodes"), ev)
+        reg(s, 1, "n1")
+        assert ev.is_set()
+        ev2 = threading.Event()
+        reg(s, 2, "n2")  # not registered -> no cross-talk
+        assert not ev2.is_set()
+
+    def test_kv_prefix_watch(self):
+        s = StateStore()
+        ev = threading.Event()
+        s.watch_kv("web/", ev)
+        s.kvs_set(1, DirEntry(key="other", value=b"x"))
+        assert not ev.is_set()
+        s.kvs_set(2, DirEntry(key="web/a", value=b"x"))
+        assert ev.is_set()
+
+    def test_kv_root_watch_sees_everything(self):
+        s = StateStore()
+        ev = threading.Event()
+        s.watch_kv("", ev)
+        s.kvs_set(1, DirEntry(key="anything", value=b"x"))
+        assert ev.is_set()
+
+    def test_delete_tree_wakes_subtree_watchers(self):
+        s = StateStore()
+        s.kvs_set(1, DirEntry(key="a/b/c", value=b"x"))
+        ev = threading.Event()
+        s.watch_kv("a/b/", ev)
+        s.kvs_delete_tree(2, "a/")
+        assert ev.is_set()
+
+    def test_stop_watch(self):
+        s = StateStore()
+        ev = threading.Event()
+        s.watch_kv("k", ev)
+        s.stop_watch_kv("k", ev)
+        s.kvs_set(1, DirEntry(key="k", value=b"x"))
+        assert not ev.is_set()
+
+
+class TestTombstoneGC:
+    def test_batching_and_collect(self):
+        gc = TombstoneGC(ttl=10.0, granularity=5.0)
+        gc.set_enabled(True, now=0.0)
+        gc.hint(3, now=0.0)
+        gc.hint(7, now=1.0)   # same bucket (expires ceil to 10 vs 15?)
+        assert gc.pending_expiration()
+        assert gc.collect(now=9.0) == []
+        out = gc.collect(now=20.0)
+        assert out and max(out) == 7
+        assert not gc.pending_expiration()
+
+    def test_disable_clears(self):
+        gc = TombstoneGC(ttl=10.0, granularity=5.0)
+        gc.set_enabled(True, now=0.0)
+        gc.hint(3, now=0.0)
+        gc.set_enabled(False, now=1.0)
+        assert not gc.pending_expiration()
+        gc.hint(9, now=2.0)  # disabled -> ignored
+        assert not gc.pending_expiration()
+
+
+class TestRadix:
+    def test_walks(self):
+        from consul_tpu.state.radix import RadixTree
+        t = RadixTree()
+        t.insert("", "root")
+        t.insert("web/", "web")
+        t.insert("web/a", "a")
+        t.insert("wet", "wet")
+        assert dict(t.walk_path("web/a/x")) == {"": "root", "web/": "web", "web/a": "a"}
+        assert dict(t.walk_prefix("we")) == {"web/": "web", "web/a": "a", "wet": "wet"}
+        assert t.longest_prefix("web/a/x") == ("web/a", "a")
+        assert t.delete("web/")
+        assert not t.delete("web/")
+        assert t.get("web/a") == "a"
+        assert len(t) == 3
