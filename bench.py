@@ -42,10 +42,12 @@ def main() -> None:
     # paths stay hot in whichever block min() selects.
     n_fail = max(1, args.n // 1000)
     total_rounds = args.steps * (args.repeats + 1)
+    # Stride, not modulo: failures land uniformly across every block even
+    # when n_fail < total_rounds.
     fail_round = (
         jnp.full((p.n,), 2**31 - 1, jnp.int32)
         .at[: n_fail]
-        .set(jnp.arange(n_fail, dtype=jnp.int32) % total_rounds)
+        .set((jnp.arange(n_fail, dtype=jnp.int32) * total_rounds) // n_fail)
     )
 
     # Compile + warm up.

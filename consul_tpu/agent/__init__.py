@@ -1,0 +1,8 @@
+"""Edge agent: HTTP/DNS surfaces over an embedded server or client.
+
+Parity layer for the reference's command/agent/ (SURVEY.md §2.6).
+"""
+
+from consul_tpu.agent.agent import Agent, AgentConfig
+
+__all__ = ["Agent", "AgentConfig"]

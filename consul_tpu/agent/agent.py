@@ -1,0 +1,140 @@
+"""The edge agent: embeds a server, exposes HTTP + DNS.
+
+Parity target: ``command/agent/agent.go`` (1319 LoC) + the serve()
+choreography of ``command/agent/command.go``.  This slice is the
+single-node "bootstrap" agent of SURVEY.md §7 step 3: embedded server,
+self-registration with a passing serfHealth check (what the leader
+reconcile loop does for real clusters, consul/leader.go:354-421), HTTP
+and DNS front-ends.  Local check runners, anti-entropy, and the
+client-mode agent land with the edge-features stage.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from consul_tpu.agent.dns import DNSServer
+from consul_tpu.agent.http_api import HTTPServer, to_api
+from consul_tpu.server.server import Server, ServerConfig
+from consul_tpu.structs.structs import (
+    CONSUL_SERVICE_ID,
+    CONSUL_SERVICE_NAME,
+    HEALTH_PASSING,
+    HealthCheck,
+    NodeService,
+    RegisterRequest,
+    SERF_ALIVE_OUTPUT,
+    SERF_CHECK_ID,
+    SERF_CHECK_NAME,
+)
+from consul_tpu.version import VERSION
+
+
+@dataclass
+class AgentConfig:
+    node_name: str = "node1"
+    datacenter: str = "dc1"
+    bind_addr: str = "127.0.0.1"
+    advertise_addr: str = ""
+    domain: str = "consul."
+    http_port: int = 8500
+    dns_port: int = 8600
+    server: bool = True
+    bootstrap: bool = True
+    dns_only_passing: bool = False
+    node_ttl: float = 0.0
+    service_ttl: float = 0.0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class Agent:
+    def __init__(self, config: Optional[AgentConfig] = None) -> None:
+        self.config = config or AgentConfig()
+        if not self.config.advertise_addr:
+            self.config.advertise_addr = self.config.bind_addr
+        self.server = Server(ServerConfig(
+            node_name=self.config.node_name,
+            datacenter=self.config.datacenter,
+            domain=self.config.domain,
+            bootstrap=self.config.bootstrap,
+        ))
+        self.http = HTTPServer(self)
+        self.dns = DNSServer(self, domain=self.config.domain,
+                             node_ttl=self.config.node_ttl,
+                             service_ttl=self.config.service_ttl,
+                             only_passing=self.config.dns_only_passing)
+
+    @property
+    def node_name(self) -> str:
+        return self.config.node_name
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        await self._register_self()
+        await self.http.start(self.config.bind_addr, self.config.http_port)
+        await self.dns.start(self.config.bind_addr, self.config.dns_port)
+
+    async def stop(self) -> None:
+        await self.dns.stop()
+        await self.http.stop()
+
+    async def _register_self(self) -> None:
+        """What handleAliveMember does for each live node on the leader
+        (consul/leader.go:354-421): catalog entry + serfHealth check +
+        the consul service for servers."""
+        req = RegisterRequest(
+            node=self.config.node_name,
+            address=self.config.advertise_addr,
+            check=HealthCheck(
+                node=self.config.node_name,
+                check_id=SERF_CHECK_ID, name=SERF_CHECK_NAME,
+                status=HEALTH_PASSING, output=SERF_ALIVE_OUTPUT),
+        )
+        if self.config.server:
+            req.service = NodeService(
+                id=CONSUL_SERVICE_ID, service=CONSUL_SERVICE_NAME, port=8300)
+        await self.server.catalog.register(req)
+
+    # -- HTTP routes owned by the agent (command/agent/agent_endpoint.go) --
+
+    def register_http_routes(self, router, h) -> None:
+        router.add_get("/v1/agent/self", h(self._self))
+        router.add_get("/v1/agent/services", h(self._services))
+        router.add_get("/v1/agent/checks", h(self._checks))
+        router.add_get("/v1/agent/members", h(self._members))
+
+    async def _self(self, request):
+        """/v1/agent/self (agent_endpoint.go:24-34): config + stats."""
+        return {
+            "Config": {
+                "Datacenter": self.config.datacenter,
+                "NodeName": self.config.node_name,
+                "Server": self.config.server,
+                "Bootstrap": self.config.bootstrap,
+                "Domain": self.config.domain,
+                "Version": VERSION,
+            },
+            "Stats": self.server.stats(),
+        }
+
+    async def _services(self, request):
+        _, services = self.server.store.node_services(self.config.node_name)
+        return {sid: to_api(svc) for sid, svc in (services or {}).items()}
+
+    async def _checks(self, request):
+        _, checks = self.server.store.node_checks(self.config.node_name)
+        return {c.check_id: to_api(c) for c in checks}
+
+    async def _members(self, request):
+        """LAN members; one entry until gossip lands."""
+        return [{
+            "Name": self.config.node_name,
+            "Addr": self.config.advertise_addr,
+            "Port": 8301,
+            "Status": 1,  # alive
+            "Tags": {"role": "consul" if self.config.server else "node",
+                     "dc": self.config.datacenter},
+        }]

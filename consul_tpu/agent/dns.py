@@ -1,0 +1,319 @@
+"""DNS interface: service discovery over port 8600.
+
+Parity target: ``command/agent/dns.go`` (683 LoC) — node lookups
+(``<node>.node.<dc>.consul`` → A), service lookups
+(``[tag.]<name>.service.<dc>.consul`` → A / SRV+A-extra), RFC2782
+(``_name._tag.service...``), right-to-left label dispatch (dns.go:272-340),
+critical-check filtering (dns.go:522-541), answer shuffling for load
+balancing (dns.go:543-549), and the UDP 3-answer cap (dns.go:18,502-508).
+
+The reference rides miekg/dns; we carry a small wire codec instead —
+the subset Consul serves (A/SRV/ANY queries, no EDNS, no compression on
+write) is ~100 lines and keeps the agent dependency-free.  Recursor
+forwarding (dns.go:618-656) is configured but refused politely in this
+environment (zero egress).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from consul_tpu.structs.structs import HEALTH_CRITICAL
+
+# Record types / classes
+QTYPE_A = 1
+QTYPE_PTR = 12
+QTYPE_SRV = 33
+QTYPE_ANY = 255
+QCLASS_IN = 1
+
+# Response codes
+RCODE_OK = 0
+RCODE_NXDOMAIN = 3
+RCODE_REFUSED = 5
+
+MAX_UDP_ANSWERS = 3  # dns.go:18 maxServiceResponses (UDP-safety)
+
+
+# -- wire codec --------------------------------------------------------------
+
+
+@dataclass
+class Question:
+    name: str
+    qtype: int
+    qclass: int
+
+
+@dataclass
+class Record:
+    name: str
+    rtype: int
+    ttl: int
+    rdata: bytes
+
+
+@dataclass
+class Message:
+    msg_id: int = 0
+    flags: int = 0
+    questions: List[Question] = field(default_factory=list)
+    answers: List[Record] = field(default_factory=list)
+    authority: List[Record] = field(default_factory=list)
+    additional: List[Record] = field(default_factory=list)
+
+
+def _read_name(buf: bytes, off: int) -> Tuple[str, int]:
+    """Parse a possibly-compressed DNS name."""
+    labels = []
+    jumped = False
+    end = off
+    seen = set()
+    while True:
+        if off in seen:
+            raise ValueError("compression loop")
+        seen.add(off)
+        ln = buf[off]
+        if ln == 0:
+            if not jumped:
+                end = off + 1
+            break
+        if ln & 0xC0 == 0xC0:
+            ptr = ((ln & 0x3F) << 8) | buf[off + 1]
+            if not jumped:
+                end = off + 2
+                jumped = True
+            off = ptr
+            continue
+        labels.append(buf[off + 1: off + 1 + ln].decode("ascii", "replace"))
+        off += 1 + ln
+    return ".".join(labels) + ".", end
+
+
+def _write_name(name: str) -> bytes:
+    out = bytearray()
+    for label in name.rstrip(".").split("."):
+        if label:
+            raw = label.encode("ascii")
+            out.append(len(raw))
+            out += raw
+    out.append(0)
+    return bytes(out)
+
+
+def parse_message(buf: bytes) -> Message:
+    msg_id, flags, qd, an, ns, ar = struct.unpack("!HHHHHH", buf[:12])
+    msg = Message(msg_id=msg_id, flags=flags)
+    off = 12
+    for _ in range(qd):
+        name, off = _read_name(buf, off)
+        qtype, qclass = struct.unpack("!HH", buf[off: off + 4])
+        off += 4
+        msg.questions.append(Question(name, qtype, qclass))
+    return msg  # answers in queries aren't parsed (we never recurse)
+
+
+def build_response(query: Message, rcode: int, answers: List[Record],
+                   additional: List[Record] = (), authoritative: bool = True,
+                   truncated: bool = False) -> bytes:
+    flags = 0x8000  # QR
+    flags |= query.flags & 0x0100  # copy RD
+    if authoritative:
+        flags |= 0x0400
+    if truncated:
+        flags |= 0x0200
+    flags |= rcode & 0xF
+    out = bytearray(struct.pack(
+        "!HHHHHH", query.msg_id, flags, len(query.questions), len(answers),
+        0, len(additional)))
+    for q in query.questions:
+        out += _write_name(q.name) + struct.pack("!HH", q.qtype, q.qclass)
+    for rec in list(answers) + list(additional):
+        out += _write_name(rec.name)
+        out += struct.pack("!HHIH", rec.rtype, QCLASS_IN, rec.ttl, len(rec.rdata))
+        out += rec.rdata
+    return bytes(out)
+
+
+def a_record(name: str, addr: str, ttl: int) -> Optional[Record]:
+    try:
+        rdata = bytes(int(p) for p in addr.split("."))
+        if len(rdata) != 4:
+            return None
+    except ValueError:
+        return None  # non-IPv4 address: reference emits CNAME; we skip
+    return Record(name, QTYPE_A, ttl, rdata)
+
+
+def srv_record(name: str, port: int, target: str, ttl: int) -> Record:
+    rdata = struct.pack("!HHH", 1, 1, port) + _write_name(target)
+    return Record(name, QTYPE_SRV, ttl, rdata)
+
+
+# -- server ------------------------------------------------------------------
+
+
+class DNSServer:
+    def __init__(self, agent, domain: str = "consul.",
+                 node_ttl: float = 0.0, service_ttl: float = 0.0,
+                 only_passing: bool = False) -> None:
+        self.agent = agent
+        self.domain = domain.rstrip(".").lower() + "."
+        self.node_ttl = int(node_ttl)
+        self.service_ttl = int(service_ttl)
+        self.only_passing = only_passing
+        self._transport: Optional[asyncio.DatagramTransport] = None
+        self._tcp_server: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[tuple] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 8600) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _UDPProtocol(self), local_addr=(host, port))
+        self.addr = self._transport.get_extra_info("sockname")[:2]
+        self._tcp_server = await asyncio.start_server(
+            self._handle_tcp, host, self.addr[1])
+
+    async def stop(self) -> None:
+        if self._transport:
+            self._transport.close()
+        if self._tcp_server:
+            self._tcp_server.close()
+            await self._tcp_server.wait_closed()
+
+    async def _handle_tcp(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(2)
+                (ln,) = struct.unpack("!H", hdr)
+                buf = await reader.readexactly(ln)
+                resp = await self.handle(buf, udp=False)
+                writer.write(struct.pack("!H", len(resp)) + resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def handle(self, buf: bytes, udp: bool) -> bytes:
+        try:
+            query = parse_message(buf)
+        except Exception:
+            return b""
+        if not query.questions:
+            return build_response(query, RCODE_REFUSED, [])
+        q = query.questions[0]
+        name = q.name.lower()
+        if not name.endswith(self.domain):
+            # Would recurse (dns.go:618-656); refused without recursors.
+            return build_response(query, RCODE_REFUSED, [], authoritative=False)
+        return await self._dispatch(query, q, name, udp)
+
+    async def _dispatch(self, query: Message, q: Question, name: str,
+                        udp: bool) -> bytes:
+        """Right-to-left label parse (dns.go:272-340)."""
+        sub = name[: -len(self.domain)].rstrip(".")
+        labels = sub.split(".") if sub else []
+        if not labels:
+            # Apex: reference serves SOA/NS; we answer empty-authoritative.
+            return build_response(query, RCODE_OK, [])
+        # [dc] comes last when it matches a known datacenter
+        dc = ""
+        if len(labels) >= 2 and labels[-1] not in ("node", "service") and \
+                labels[-2] in ("node", "service"):
+            dc = labels[-1]
+            labels = labels[:-1]
+            if dc != self.agent.server.config.datacenter:
+                return build_response(query, RCODE_NXDOMAIN, [])
+        kind = labels[-1] if labels else ""
+        rest = labels[:-1]
+        if kind == "node" and len(rest) >= 1:
+            return await self._node_lookup(query, q, ".".join(rest), udp)
+        if kind == "service" and rest:
+            # RFC2782: _name._tag.service (dns.go:303-327)
+            if len(rest) == 2 and rest[0].startswith("_") and rest[1].startswith("_"):
+                svc, tag = rest[0][1:], rest[1][1:]
+                if tag == "tcp":  # _svc._tcp means no tag filter in consul
+                    tag = ""
+                return await self._service_lookup(query, q, svc, tag, udp)
+            if len(rest) == 1:
+                return await self._service_lookup(query, q, rest[0], "", udp)
+            if len(rest) == 2:
+                tag, svc = rest[0], rest[1]
+                return await self._service_lookup(query, q, svc, tag, udp)
+        return build_response(query, RCODE_NXDOMAIN, [])
+
+    async def _node_lookup(self, query: Message, q: Question, node: str,
+                           udp: bool) -> bytes:
+        """A record for a node (dns.go:343-450)."""
+        _, addr = self.agent.server.store.get_node(node)
+        if addr is None:
+            return build_response(query, RCODE_NXDOMAIN, [])
+        rec = a_record(q.name, addr, self.node_ttl)
+        return build_response(query, RCODE_OK, [rec] if rec else [])
+
+    async def _service_lookup(self, query: Message, q: Question, service: str,
+                              tag: str, udp: bool) -> bytes:
+        """Service answers: filter, shuffle, cap (dns.go:452-616)."""
+        idx_unused, csns = self.agent.server.store.check_service_nodes(service, tag)
+        # Drop instances with any critical check (dns.go:522-541); with
+        # only_passing, warning also drops.
+        healthy = []
+        for csn in csns:
+            statuses = [c.status for c in csn.checks]
+            if HEALTH_CRITICAL in statuses:
+                continue
+            if self.only_passing and any(s != "passing" for s in statuses):
+                continue
+            healthy.append(csn)
+        if not healthy:
+            return build_response(query, RCODE_NXDOMAIN, [])
+        random.shuffle(healthy)  # poor-man's LB (dns.go:543-549)
+
+        truncated = False
+        if udp and len(healthy) > MAX_UDP_ANSWERS:
+            healthy = healthy[:MAX_UDP_ANSWERS]
+            truncated = False  # reference caps without TC to avoid TCP retries
+
+        answers: List[Record] = []
+        additional: List[Record] = []
+        if q.qtype in (QTYPE_SRV,):
+            dc = self.agent.server.config.datacenter
+            for csn in healthy:
+                target = f"{csn.node.node}.node.{dc}.{self.domain}"
+                answers.append(srv_record(q.name, csn.service.port, target,
+                                          self.service_ttl))
+                addr = csn.service.address or csn.node.address
+                rec = a_record(target, addr, self.service_ttl)
+                if rec:
+                    additional.append(rec)
+        else:  # A / ANY
+            for csn in healthy:
+                addr = csn.service.address or csn.node.address
+                rec = a_record(q.name, addr, self.service_ttl)
+                if rec:
+                    answers.append(rec)
+        return build_response(query, RCODE_OK, answers, additional,
+                              truncated=truncated)
+
+
+class _UDPProtocol(asyncio.DatagramProtocol):
+    def __init__(self, server: DNSServer) -> None:
+        self.server = server
+        self.transport: Optional[asyncio.DatagramTransport] = None
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        asyncio.ensure_future(self._respond(data, addr))
+
+    async def _respond(self, data: bytes, addr) -> None:
+        resp = await self.server.handle(data, udp=True)
+        if resp and self.transport:
+            self.transport.sendto(resp, addr)

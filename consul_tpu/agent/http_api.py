@@ -1,0 +1,490 @@
+"""HTTP API server: the `/v1/...` surface.
+
+Parity target: ``command/agent/http.go`` (route table :194-279, wrapper
+:282-346, blocking-query params :418-441, consistency :443-457, index
+headers :383-409) plus the per-domain endpoint files
+(``kvs_endpoint.go``, ``session_endpoint.go``, ``catalog_endpoint.go``,
+``health_endpoint.go``, ``status_endpoint.go``, ``ui_endpoint.go``).
+
+JSON key casing follows the reference's Go marshaling (CamelCase with
+ID/TTL acronyms preserved), so existing Consul clients parse our
+responses unchanged; ``Value`` is base64 as in the reference API.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from consul_tpu.server.endpoints import EndpointError, parse_duration
+from consul_tpu.structs.structs import (
+    DeregisterRequest,
+    DirEntry,
+    HealthCheck,
+    KeyListRequest,
+    KeyRequest,
+    KVSOp,
+    KVSRequest,
+    NodeService,
+    QueryMeta,
+    QueryOptions,
+    RegisterRequest,
+    SERF_CHECK_ID,
+    Session,
+    SessionOp,
+    SessionRequest,
+)
+
+# snake_case wire names -> reference JSON keys (Go marshaling).
+_KEY_OVERRIDES = {
+    "id": "ID", "check_id": "CheckID", "service_id": "ServiceID",
+    "ttl": "TTL", "ltime": "LTime",
+}
+
+
+def api_key(name: str) -> str:
+    if name in _KEY_OVERRIDES:
+        return _KEY_OVERRIDES[name]
+    return "".join(_KEY_OVERRIDES.get(p, p.capitalize()) for p in name.split("_"))
+
+
+def to_api(obj: Any) -> Any:
+    """Wire dict/struct -> reference-shaped JSON value."""
+    if hasattr(obj, "to_wire"):
+        obj = obj.to_wire()
+    if isinstance(obj, dict):
+        return {api_key(k): to_api(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_api(v) for v in obj]
+    if isinstance(obj, bytes):
+        return base64.b64encode(obj).decode("ascii")
+    return obj
+
+
+def session_to_api(sess: Session) -> Dict[str, Any]:
+    out = to_api(sess)
+    # Go marshals time.Duration as integer nanoseconds.
+    out["LockDelay"] = int(sess.lock_delay * 1e9)
+    return out
+
+
+class HTTPServer:
+    """Routes + the wrap() conventions of the reference."""
+
+    def __init__(self, agent) -> None:
+        self.agent = agent
+        self.app = web.Application()
+        self._register_routes()
+        self._runner: Optional[web.AppRunner] = None
+        self.addr: Optional[tuple] = None
+
+    @property
+    def srv(self):
+        return self.agent.server
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 8500) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.addr = site._server.sockets[0].getsockname()[:2]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _register_routes(self) -> None:
+        """Route table (command/agent/http.go:194-279)."""
+        r = self.app.router
+        h = self._handler
+        r.add_get("/v1/status/leader", h(self._status_leader))
+        r.add_get("/v1/status/peers", h(self._status_peers))
+
+        r.add_put("/v1/catalog/register", h(self._catalog_register))
+        r.add_put("/v1/catalog/deregister", h(self._catalog_deregister))
+        r.add_get("/v1/catalog/datacenters", h(self._catalog_datacenters))
+        r.add_get("/v1/catalog/nodes", h(self._catalog_nodes))
+        r.add_get("/v1/catalog/services", h(self._catalog_services))
+        r.add_get("/v1/catalog/service/{service}", h(self._catalog_service_nodes))
+        r.add_get("/v1/catalog/node/{node}", h(self._catalog_node_services))
+
+        r.add_get("/v1/health/node/{node}", h(self._health_node_checks))
+        r.add_get("/v1/health/checks/{service}", h(self._health_service_checks))
+        r.add_get("/v1/health/state/{state}", h(self._health_checks_in_state))
+        r.add_get("/v1/health/service/{service}", h(self._health_service_nodes))
+
+        for method in ("GET", "PUT", "DELETE"):
+            r.add_route(method, "/v1/kv/{key:.*}", h(self._kvs))
+
+        r.add_put("/v1/session/create", h(self._session_create))
+        r.add_put("/v1/session/destroy/{id}", h(self._session_destroy))
+        r.add_put("/v1/session/renew/{id}", h(self._session_renew))
+        r.add_get("/v1/session/info/{id}", h(self._session_info))
+        r.add_get("/v1/session/node/{node}", h(self._session_node))
+        r.add_get("/v1/session/list", h(self._session_list))
+
+        r.add_get("/v1/internal/ui/nodes", h(self._ui_nodes))
+        r.add_get("/v1/internal/ui/node/{node}", h(self._ui_node_info))
+        r.add_get("/v1/internal/ui/services", h(self._ui_services))
+
+        self.agent.register_http_routes(r, h)
+
+    def _handler(self, fn):
+        """wrap() (http.go:282-346): invoke, map errors, JSON-encode."""
+
+        async def handle(request: web.Request) -> web.Response:
+            try:
+                resp = await fn(request)
+                if isinstance(resp, web.Response):
+                    return resp
+                return self._json(request, resp)
+            except EndpointError as e:
+                return web.Response(status=400, text=str(e))
+            except PermissionError as e:
+                return web.Response(status=403, text=str(e) or "Permission denied")
+            except NotFound as e:
+                return web.Response(status=404, text=str(e))
+            except Exception as e:  # 500 + message, as the reference wrap()
+                return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+
+        return handle
+
+    def _json(self, request: web.Request, value: Any,
+              meta: Optional[QueryMeta] = None) -> web.Response:
+        pretty = "pretty" in request.query
+        body = json.dumps(value, indent=4 if pretty else None)
+        resp = web.Response(text=body, content_type="application/json")
+        if meta is not None:
+            self._set_index_headers(resp, meta)
+        return resp
+
+    def _set_index_headers(self, resp: web.Response, meta: QueryMeta) -> None:
+        """X-Consul-* headers (http.go:383-409)."""
+        resp.headers["X-Consul-Index"] = str(meta.index)
+        resp.headers["X-Consul-KnownLeader"] = "true" if meta.known_leader else "false"
+        resp.headers["X-Consul-LastContact"] = str(int(meta.last_contact * 1000))
+
+    def _query_opts(self, request: web.Request) -> QueryOptions:
+        """parseWait + parseConsistency + dc/token (http.go:411-485)."""
+        q = request.query
+        opts = QueryOptions(
+            token=q.get("token", ""),
+            datacenter=q.get("dc", ""),
+        )
+        if "index" in q:
+            try:
+                opts.min_query_index = int(q["index"])
+            except ValueError:
+                raise EndpointError("Invalid index")
+        if "wait" in q:
+            try:
+                opts.max_query_time = parse_duration(q["wait"])
+            except ValueError:
+                raise EndpointError("Invalid wait time")
+        if "stale" in q:
+            opts.allow_stale = True
+        if "consistent" in q:
+            opts.require_consistent = True
+        if opts.allow_stale and opts.require_consistent:
+            raise EndpointError("Cannot specify ?stale with ?consistent, conflicting semantics.")
+        return opts
+
+    async def _body_json(self, request: web.Request) -> Any:
+        raw = await request.read()
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise EndpointError(f"Request decode failed: {e}")
+
+    # -- status -------------------------------------------------------------
+
+    async def _status_leader(self, request):
+        return await self.srv.status.leader()
+
+    async def _status_peers(self, request):
+        return await self.srv.status.peers()
+
+    # -- catalog ------------------------------------------------------------
+
+    async def _catalog_register(self, request):
+        body = await self._body_json(request)
+        args = RegisterRequest(
+            node=body.get("Node", ""), address=body.get("Address", ""),
+            datacenter=body.get("Datacenter", ""),
+            token=request.query.get("token", ""))
+        if body.get("Service"):
+            s = body["Service"]
+            args.service = NodeService(
+                id=s.get("ID", ""), service=s.get("Service", ""),
+                tags=s.get("Tags") or [], address=s.get("Address", ""),
+                port=s.get("Port", 0))
+        if body.get("Check"):
+            args.check = _check_from_api(body["Check"])
+        for c in body.get("Checks") or []:
+            args.checks.append(_check_from_api(c))
+        await self.srv.catalog.register(args)
+        return True
+
+    async def _catalog_deregister(self, request):
+        body = await self._body_json(request)
+        args = DeregisterRequest(
+            node=body.get("Node", ""), service_id=body.get("ServiceID", ""),
+            check_id=body.get("CheckID", ""),
+            datacenter=body.get("Datacenter", ""))
+        await self.srv.catalog.deregister(args)
+        return True
+
+    async def _catalog_datacenters(self, request):
+        return await self.srv.catalog.list_datacenters()
+
+    async def _catalog_nodes(self, request):
+        opts = self._query_opts(request)
+        meta, nodes = await self.srv.catalog.list_nodes(opts)
+        return self._json(request, to_api(nodes), meta)
+
+    async def _catalog_services(self, request):
+        opts = self._query_opts(request)
+        meta, services = await self.srv.catalog.list_services(opts)
+        return self._json(request, services, meta)
+
+    async def _catalog_service_nodes(self, request):
+        opts = self._query_opts(request)
+        service = request.match_info["service"]
+        tag = request.query.get("tag", "")
+        meta, nodes = await self.srv.catalog.service_nodes(service, opts, tag)
+        return self._json(request, to_api(nodes), meta)
+
+    async def _catalog_node_services(self, request):
+        opts = self._query_opts(request)
+        meta, ns = await self.srv.catalog.node_services(request.match_info["node"], opts)
+        if ns is None:
+            return self._json(request, None, meta)
+        _, addr = self.srv.store.get_node(request.match_info["node"])
+        out = {
+            "Node": {"Node": request.match_info["node"], "Address": addr},
+            "Services": {sid: to_api(svc) for sid, svc in ns.items()},
+        }
+        return self._json(request, out, meta)
+
+    # -- health -------------------------------------------------------------
+
+    async def _health_node_checks(self, request):
+        opts = self._query_opts(request)
+        meta, checks = await self.srv.health.node_checks(request.match_info["node"], opts)
+        return self._json(request, to_api(checks), meta)
+
+    async def _health_service_checks(self, request):
+        opts = self._query_opts(request)
+        meta, checks = await self.srv.health.service_checks(
+            request.match_info["service"], opts)
+        return self._json(request, to_api(checks), meta)
+
+    async def _health_checks_in_state(self, request):
+        opts = self._query_opts(request)
+        meta, checks = await self.srv.health.checks_in_state(
+            request.match_info["state"], opts)
+        return self._json(request, to_api(checks), meta)
+
+    async def _health_service_nodes(self, request):
+        opts = self._query_opts(request)
+        service = request.match_info["service"]
+        tag = request.query.get("tag", "")
+        passing = "passing" in request.query
+        meta, csns = await self.srv.health.service_nodes(service, opts, tag, passing)
+        return self._json(request, to_api(csns), meta)
+
+    # -- KV -----------------------------------------------------------------
+
+    async def _kvs(self, request):
+        """command/agent/kvs_endpoint.go dispatch by method + params."""
+        key = request.match_info["key"]
+        if request.method == "GET":
+            return await self._kvs_get(request, key)
+        if request.method == "PUT":
+            return await self._kvs_put(request, key)
+        return await self._kvs_delete(request, key)
+
+    async def _kvs_get(self, request, key: str):
+        opts = self._query_opts(request)
+        q = request.query
+        if "keys" in q:
+            args = KeyListRequest(prefix=key, separator=q.get("separator", ""),
+                                  **_opt_kw(opts))
+            meta, keys = await self.srv.kvs.list_keys(args)
+            return self._json(request, keys, meta)
+        if "recurse" in q:
+            args = KeyListRequest(prefix=key, **_opt_kw(opts))
+            meta, ents = await self.srv.kvs.list(args)
+            if not ents:
+                resp = web.Response(status=404, text="")
+                self._set_index_headers(resp, meta)
+                return resp
+            return self._json(request, to_api(ents), meta)
+        args = KeyRequest(key=key, **_opt_kw(opts))
+        meta, ents = await self.srv.kvs.get(args)
+        if not ents:
+            resp = web.Response(status=404, text="")
+            self._set_index_headers(resp, meta)
+            return resp
+        if "raw" in q:
+            resp = web.Response(body=ents[0].value,
+                                content_type="application/octet-stream")
+            self._set_index_headers(resp, meta)
+            return resp
+        return self._json(request, to_api(ents), meta)
+
+    async def _kvs_put(self, request, key: str):
+        q = request.query
+        value = await request.read()
+        d = DirEntry(key=key, value=value)
+        if "flags" in q:
+            d.flags = int(q["flags"])
+        op = KVSOp.SET.value
+        if "cas" in q:
+            d.modify_index = int(q["cas"])
+            op = KVSOp.CAS.value
+        elif "acquire" in q:
+            d.session = q["acquire"]
+            op = KVSOp.LOCK.value
+        elif "release" in q:
+            d.session = q["release"]
+            op = KVSOp.UNLOCK.value
+        args = KVSRequest(op=op, dir_ent=d, token=q.get("token", ""))
+        return await self.srv.kvs.apply(args)
+
+    async def _kvs_delete(self, request, key: str):
+        q = request.query
+        d = DirEntry(key=key)
+        op = KVSOp.DELETE.value
+        if "recurse" in q:
+            op = KVSOp.DELETE_TREE.value
+        elif "cas" in q:
+            d.modify_index = int(q["cas"])
+            op = KVSOp.DELETE_CAS.value
+        args = KVSRequest(op=op, dir_ent=d, token=q.get("token", ""))
+        return await self.srv.kvs.apply(args)
+
+    # -- sessions -----------------------------------------------------------
+
+    async def _session_create(self, request):
+        """Defaults: node = this agent, checks = [serfHealth]
+        (command/agent/session_endpoint.go:20-74)."""
+        body = await self._body_json(request)
+        session = Session(
+            name=body.get("Name", ""),
+            node=body.get("Node") or self.agent.node_name,
+            checks=body.get("Checks") if body.get("Checks") is not None
+                   else [SERF_CHECK_ID],
+            behavior=body.get("Behavior", ""),
+            ttl=body.get("TTL", "") or "",
+        )
+        if "LockDelay" in body:
+            session.lock_delay = _parse_lock_delay(body["LockDelay"])
+        args = SessionRequest(op=SessionOp.CREATE.value, session=session,
+                              token=request.query.get("token", ""))
+        sid = await self.srv.session.apply(args)
+        return {"ID": sid}
+
+    async def _session_destroy(self, request):
+        args = SessionRequest(op=SessionOp.DESTROY.value,
+                              session=Session(id=request.match_info["id"]))
+        await self.srv.session.apply(args)
+        return True
+
+    async def _session_renew(self, request):
+        sess = await self.srv.session.renew(request.match_info["id"])
+        if sess is None:
+            raise NotFound(f'Session id \'{request.match_info["id"]}\' not found')
+        return [session_to_api(sess)]
+
+    async def _session_info(self, request):
+        opts = self._query_opts(request)
+        meta, sess = await self.srv.session.get(request.match_info["id"], opts)
+        out = [session_to_api(sess)] if sess else []
+        return self._json(request, out, meta)
+
+    async def _session_node(self, request):
+        opts = self._query_opts(request)
+        meta, sessions = await self.srv.session.node_sessions(
+            request.match_info["node"], opts)
+        return self._json(request, [session_to_api(s) for s in sessions], meta)
+
+    async def _session_list(self, request):
+        opts = self._query_opts(request)
+        meta, sessions = await self.srv.session.list(opts)
+        return self._json(request, [session_to_api(s) for s in sessions], meta)
+
+    # -- internal UI --------------------------------------------------------
+
+    async def _ui_nodes(self, request):
+        opts = self._query_opts(request)
+        meta, dump = await self.srv.internal.node_dump(opts)
+        return self._json(request, to_api(dump), meta)
+
+    async def _ui_node_info(self, request):
+        opts = self._query_opts(request)
+        meta, dump = await self.srv.internal.node_info(
+            request.match_info["node"], opts)
+        if not dump:
+            raise NotFound("Node not found")
+        return self._json(request, to_api(dump[0]), meta)
+
+    async def _ui_services(self, request):
+        """Service summary rows (command/agent/ui_endpoint.go)."""
+        opts = self._query_opts(request)
+        meta, dump = await self.srv.internal.node_dump(opts)
+        summary: Dict[str, Dict[str, Any]] = {}
+        for node in dump:
+            node_checks = [c for c in node["checks"] if not c.service_id]
+            for svc in node["services"]:
+                row = summary.setdefault(svc.service, {
+                    "Name": svc.service, "Nodes": [], "ChecksPassing": 0,
+                    "ChecksWarning": 0, "ChecksCritical": 0})
+                row["Nodes"].append(node["node"])
+                svc_checks = [c for c in node["checks"] if c.service_id == svc.id]
+                for c in node_checks + svc_checks:
+                    key = {"passing": "ChecksPassing", "warning": "ChecksWarning",
+                           "critical": "ChecksCritical"}.get(c.status)
+                    if key:
+                        row[key] += 1
+        return self._json(request, sorted(summary.values(), key=lambda r: r["Name"]), meta)
+
+
+class NotFound(Exception):
+    pass
+
+
+def _check_from_api(c: Dict[str, Any]) -> HealthCheck:
+    return HealthCheck(
+        node=c.get("Node", ""), check_id=c.get("CheckID", ""),
+        name=c.get("Name", ""), status=c.get("Status", ""),
+        notes=c.get("Notes", ""), output=c.get("Output", ""),
+        service_id=c.get("ServiceID", ""))
+
+
+def _opt_kw(opts: QueryOptions) -> Dict[str, Any]:
+    return dict(token=opts.token, datacenter=opts.datacenter,
+                min_query_index=opts.min_query_index,
+                max_query_time=opts.max_query_time,
+                allow_stale=opts.allow_stale,
+                require_consistent=opts.require_consistent)
+
+
+def _parse_lock_delay(v: Any) -> float:
+    """Accepts Go duration string or nanoseconds int (reference
+    session_endpoint.go FixupLockDelay)."""
+    if isinstance(v, str):
+        return parse_duration(v)
+    n = float(v)
+    # Heuristic from the reference: integers <= 60 are seconds, larger
+    # values are nanoseconds.
+    return n if n <= 60 else n / 1e9

@@ -1,0 +1,9 @@
+"""Consensus plane: deterministic FSM + (soon) Raft replication.
+
+Parity layer for the reference's consul/fsm.go + hashicorp/raft glue
+(SURVEY.md §2.2-2.3).
+"""
+
+from consul_tpu.consensus.fsm import ConsulFSM
+
+__all__ = ["ConsulFSM"]

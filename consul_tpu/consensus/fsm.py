@@ -1,0 +1,193 @@
+"""The replicated state machine: typed log entries -> state store.
+
+Parity target: ``consul/fsm.go`` (537 LoC) — Apply dispatches on the
+leading MessageType byte (fsm.go:76-110), unknown types with the
+ignore-flag bit are skipped (fsm.go:83-88), snapshots are a header plus
+a stream of typed msgpack records (fsm.go:262-404), and Restore rebuilds
+a fresh store (fsm.go:275-363).
+
+Determinism contract: Apply derives everything from (index, payload).
+No clocks, no UUIDs, no map-iteration order leaks (the store sorts its
+scans).  The guard test (test_determinism_guard.py) lints this module
+and the store for wall-clock/uuid reads the way the reference's
+verify_no_uuid.sh gates its FSM (Makefile:37).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Callable, Dict, Optional
+
+import msgpack
+
+from consul_tpu.state.store import StateStore
+from consul_tpu.structs import codec
+from consul_tpu.structs.structs import (
+    ACL,
+    ACLOp,
+    ACLRequest,
+    DeregisterRequest,
+    DirEntry,
+    KVSOp,
+    KVSRequest,
+    MessageType,
+    RegisterRequest,
+    Session,
+    SessionOp,
+    SessionRequest,
+    TombstoneRequest,
+)
+
+IGNORE_UNKNOWN_FLAG = 0x80  # high bit: safe-to-skip for old versions (fsm.go:25-30)
+
+# Snapshot record kinds (one byte each, mirroring fsm.go's persist order).
+SNAP_HEADER = "header"
+SNAP_REGISTRATION = "registration"
+SNAP_SERVICE = "service"
+SNAP_CHECK = "check"
+SNAP_KVS = "kvs"
+SNAP_TOMBSTONE = "tombstone"
+SNAP_SESSION = "session"
+SNAP_ACL = "acl"
+
+
+class ConsulFSM:
+    """Applies Raft log entries to a StateStore."""
+
+    def __init__(self, gc_hint: Optional[Callable[[int], None]] = None) -> None:
+        self._gc_hint = gc_hint
+        self.store = StateStore(gc_hint=gc_hint)
+        self._handlers: Dict[int, Callable[[int, bytes], Any]] = {
+            MessageType.REGISTER: self._apply_register,
+            MessageType.DEREGISTER: self._apply_deregister,
+            MessageType.KVS: self._apply_kvs,
+            MessageType.SESSION: self._apply_session,
+            MessageType.ACL: self._apply_acl,
+            MessageType.TOMBSTONE: self._apply_tombstone,
+        }
+
+    # -- apply -------------------------------------------------------------
+
+    def apply(self, index: int, buf: bytes) -> Any:
+        """Dispatch one log entry (fsm.go:76-110).  Returns the op result
+        (None, bool for CAS-style ops, or an error string surfaced to the
+        caller via raftApply)."""
+        msg_type = buf[0]
+        handler = self._handlers.get(msg_type & ~IGNORE_UNKNOWN_FLAG)
+        if handler is None:
+            if msg_type & IGNORE_UNKNOWN_FLAG:
+                return None  # newer-version entry marked safe to ignore
+            raise ValueError(f"failed to apply request: unknown type {msg_type}")
+        return handler(index, buf[1:])
+
+    def _apply_register(self, index: int, payload: bytes) -> Any:
+        req = codec.decode_payload(payload, RegisterRequest)
+        self.store.ensure_registration(index, req)
+        return None
+
+    def _apply_deregister(self, index: int, payload: bytes) -> Any:
+        """Granularity: check > service > whole node (fsm.go:130-155)."""
+        req = codec.decode_payload(payload, DeregisterRequest)
+        if req.check_id:
+            self.store.delete_node_check(index, req.node, req.check_id)
+        elif req.service_id:
+            self.store.delete_node_service(index, req.node, req.service_id)
+        else:
+            self.store.delete_node(index, req.node)
+        return None
+
+    def _apply_kvs(self, index: int, payload: bytes) -> Any:
+        req = codec.decode_payload(payload, KVSRequest)
+        d = req.dir_ent
+        op = req.op
+        if op == KVSOp.SET.value:
+            self.store.kvs_set(index, d)
+            return None
+        if op == KVSOp.DELETE.value:
+            self.store.kvs_delete(index, d.key)
+            return None
+        if op == KVSOp.DELETE_TREE.value:
+            self.store.kvs_delete_tree(index, d.key)
+            return None
+        if op == KVSOp.DELETE_CAS.value:
+            return self.store.kvs_delete_check_and_set(index, d.key, d.modify_index)
+        if op == KVSOp.CAS.value:
+            return self.store.kvs_check_and_set(index, d)
+        if op == KVSOp.LOCK.value:
+            return self.store.kvs_lock(index, d)
+        if op == KVSOp.UNLOCK.value:
+            return self.store.kvs_unlock(index, d)
+        raise ValueError(f"invalid KVS operation '{op}'")
+
+    def _apply_session(self, index: int, payload: bytes) -> Any:
+        req = codec.decode_payload(payload, SessionRequest)
+        if req.op == SessionOp.CREATE.value:
+            self.store.session_create(index, req.session)
+            return req.session.id
+        if req.op == SessionOp.DESTROY.value:
+            self.store.session_destroy(index, req.session.id)
+            return None
+        raise ValueError(f"invalid session operation '{req.op}'")
+
+    def _apply_acl(self, index: int, payload: bytes) -> Any:
+        req = codec.decode_payload(payload, ACLRequest)
+        if req.op == ACLOp.SET.value:
+            self.store.acl_set(index, req.acl)
+            return req.acl.id
+        if req.op == ACLOp.DELETE.value:
+            self.store.acl_delete(index, req.acl.id)
+            return None
+        raise ValueError(f"invalid ACL operation '{req.op}'")
+
+    def _apply_tombstone(self, index: int, payload: bytes) -> Any:
+        req = codec.decode_payload(payload, TombstoneRequest)
+        self.store.reap_tombstones(req.reap_index)
+        return None
+
+    # -- snapshot / restore --------------------------------------------------
+
+    def snapshot(self, last_index: int) -> bytes:
+        """Serialize to a typed record stream (fsm.go:365-404): header with
+        LastIndex, then every store record."""
+        out = io.BytesIO()
+        packer = msgpack.Packer(use_bin_type=True)
+        out.write(packer.pack([SNAP_HEADER, {"last_index": last_index}]))
+        for kind, payload in self.store.snapshot_records():
+            if kind == SNAP_SERVICE:
+                node, svc = payload
+                wire = {"node": node, "service": svc.to_wire()}
+            else:
+                wire = payload.to_wire()
+            out.write(packer.pack([kind, wire]))
+        return out.getvalue()
+
+    def restore(self, buf: bytes) -> int:
+        """Rebuild a fresh store from a snapshot stream (fsm.go:275-363).
+        Returns the snapshot's last_index."""
+        self.store = StateStore(gc_hint=self._gc_hint)
+        last_index = 0
+        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+        unpacker.feed(buf)
+        from consul_tpu.structs.structs import HealthCheck, NodeService
+        for kind, wire in unpacker:
+            if kind == SNAP_HEADER:
+                last_index = wire["last_index"]
+            elif kind == SNAP_REGISTRATION:
+                req = RegisterRequest.from_wire(wire)
+                self.store.ensure_registration(last_index, req)
+            elif kind == SNAP_SERVICE:
+                svc = NodeService.from_wire(wire["service"])
+                self.store.ensure_service(last_index, wire["node"], svc)
+            elif kind == SNAP_CHECK:
+                self.store.ensure_check(last_index, HealthCheck.from_wire(wire))
+            elif kind == SNAP_KVS:
+                self.store.kvs_restore(DirEntry.from_wire(wire))
+            elif kind == SNAP_TOMBSTONE:
+                self.store.tombstone_restore(DirEntry.from_wire(wire))
+            elif kind == SNAP_SESSION:
+                self.store.session_restore(Session.from_wire(wire))
+            elif kind == SNAP_ACL:
+                self.store.acl_restore(ACL.from_wire(wire))
+            else:
+                raise ValueError(f"unrecognized snapshot record kind {kind!r}")
+        return last_index

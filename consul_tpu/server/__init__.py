@@ -1,0 +1,9 @@
+"""Server RPC core: endpoints, blocking queries, apply path.
+
+Parity layer for the reference's consul/server.go + consul/rpc.go +
+per-domain *_endpoint.go files (SURVEY.md §2.4).
+"""
+
+from consul_tpu.server.server import NotLeaderError, Server, ServerConfig
+
+__all__ = ["NotLeaderError", "Server", "ServerConfig"]

@@ -1,0 +1,107 @@
+"""Blocking (long-poll) query support.
+
+Parity target: ``consul/rpc.go:301-398`` — a read with MinQueryIndex
+registers on the watched tables' NotifyGroups, runs the query, and if
+the result index hasn't advanced past MinQueryIndex, sleeps until a
+mutation notifies or the (clamped, jittered) wait expires, then re-runs.
+Bounds: max 600s, default 300s, jitter subtracts up to 1/16
+(rpc.go:29-41 — jitter staggers the thundering re-poll herd).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable, Iterable, Optional, Tuple
+
+from consul_tpu.state.store import StateStore
+from consul_tpu.structs.structs import QueryMeta, QueryOptions
+
+MAX_QUERY_TIME = 600.0      # rpc.go:31-34
+DEFAULT_QUERY_TIME = 300.0  # rpc.go:36-40
+JITTER_FRACTION = 16
+
+
+class AsyncWaiter:
+    """Adapter giving NotifyGroup a ``set()`` that wakes an asyncio task.
+
+    Safe to call from the event-loop thread (the normal case) or from
+    another thread (e.g. a check runner mutating local state)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+        self._event = asyncio.Event()
+
+    def set(self) -> None:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop:
+            self._event.set()
+        else:
+            self._loop.call_soon_threadsafe(self._event.set)
+
+    async def wait(self, timeout: float) -> None:
+        try:
+            await asyncio.wait_for(self._event.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+
+    def clear(self) -> None:
+        self._event.clear()
+
+
+def clamp_wait(requested: float) -> float:
+    """Apply the default/max/jitter rules (rpc.go:366-377)."""
+    wait = requested if requested > 0 else DEFAULT_QUERY_TIME
+    wait = min(wait, MAX_QUERY_TIME)
+    return wait - random.uniform(0, wait / JITTER_FRACTION)
+
+
+async def blocking_query(
+    store: StateStore,
+    opts: QueryOptions,
+    meta: QueryMeta,
+    run: Callable[[], Awaitable[None]],
+    tables: Iterable[str] = (),
+    kv_prefix: Optional[str] = None,
+    set_meta: Optional[Callable[[QueryMeta], None]] = None,
+) -> None:
+    """Run ``run`` (which must fill meta.index) with long-poll semantics.
+
+    ``tables`` registers on table NotifyGroups; ``kv_prefix`` registers a
+    radix KV watch instead (blockingRPCOpt's kvWatch path,
+    rpc.go:342-360).
+    """
+    if set_meta is not None:
+        set_meta(meta)
+
+    if opts.min_query_index == 0:
+        await run()
+        return
+
+    deadline = asyncio.get_running_loop().time() + clamp_wait(opts.max_query_time)
+    loop = asyncio.get_running_loop()
+    waiter = AsyncWaiter(loop)
+    while True:
+        # Register *before* running so a write between run and sleep
+        # can't be missed (rpc.go:378-391 re-registers each iteration).
+        if kv_prefix is not None:
+            store.watch_kv(kv_prefix, waiter)
+        if tables:
+            store.watch(tables, waiter)
+        try:
+            await run()
+            if meta.index > opts.min_query_index:
+                return
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return
+            await waiter.wait(remaining)
+            waiter.clear()
+        finally:
+            if kv_prefix is not None:
+                store.stop_watch_kv(kv_prefix, waiter)
+            if tables:
+                store.stop_watch(tables, waiter)

@@ -1,0 +1,440 @@
+"""Server RPC endpoints: Status, Catalog, Health, KVS, Session, Internal.
+
+Parity targets (reference, all under ``consul/``):
+``status_endpoint.go`` (30 LoC), ``catalog_endpoint.go`` (208),
+``health_endpoint.go`` (143), ``kvs_endpoint.go`` (212),
+``session_endpoint.go`` (190), ``internal_endpoint.go`` (141).
+
+All share the pattern: validate → (ACL resolve) → ``raft_apply`` for
+writes, ``blocking_query`` + store read for reads.  DC/leader forwarding
+(the ``forward()`` prologue) lands with the RPC mesh; single-node mode
+forwards to nobody.  ACL enforcement is wired through
+``server.resolve_token`` once the ACL engine lands.
+"""
+
+from __future__ import annotations
+
+import re
+import uuid
+from typing import Any, List, Optional
+
+from consul_tpu.server.blocking import blocking_query
+from consul_tpu.structs.structs import (
+    CONSUL_SERVICE_NAME,
+    DeregisterRequest,
+    DirEntry,
+    HEALTH_ANY,
+    KeyListRequest,
+    KeyRequest,
+    KVSOp,
+    KVSRequest,
+    MessageType,
+    QueryMeta,
+    QueryOptions,
+    RegisterRequest,
+    SESSION_BEHAVIOR_DELETE,
+    SESSION_BEHAVIOR_RELEASE,
+    SESSION_TTL_MAX,
+    Session,
+    SessionOp,
+    SessionRequest,
+    VALID_HEALTH_STATES,
+)
+
+_UNIT_S = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration(s: str) -> float:
+    """Go-style duration strings ('10s', '1.5m', '90ms') to seconds."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    total, pos = 0.0, 0
+    matched = False
+    for m in re.finditer(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)", s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration '{s}'")
+        total += float(m.group(1)) * _UNIT_S[m.group(2)]
+        pos = m.end()
+        matched = True
+    if not matched or pos != len(s):
+        raise ValueError(f"invalid duration '{s}'")
+    return total
+
+
+class EndpointError(Exception):
+    """Validation failure surfaced as HTTP 4xx/5xx by the edge layer."""
+
+
+class _Endpoint:
+    def __init__(self, srv) -> None:
+        self.srv = srv
+
+    def _set_meta(self, meta: QueryMeta) -> None:
+        """setQueryMeta (consul/rpc.go:401-409)."""
+        if self.srv.is_leader():
+            meta.last_contact = 0.0
+            meta.known_leader = True
+        else:
+            meta.known_leader = bool(self.srv.leader_addr())
+
+    async def _blocking(self, opts: QueryOptions, meta: QueryMeta, run,
+                        tables=(), kv_prefix=None) -> None:
+        if opts.require_consistent:
+            await self.srv.consistent_read_barrier()
+        await blocking_query(self.srv.store, opts, meta, run,
+                             tables=tables, kv_prefix=kv_prefix,
+                             set_meta=self._set_meta)
+
+
+class Status(_Endpoint):
+    """No forwarding — answers about the local raft state
+    (status_endpoint.go:9-25)."""
+
+    async def ping(self) -> bool:
+        return True
+
+    async def leader(self) -> str:
+        return self.srv.leader_addr()
+
+    async def peers(self) -> List[str]:
+        return self.srv.raft_peers()
+
+
+class Catalog(_Endpoint):
+    async def register(self, args: RegisterRequest) -> None:
+        """catalog_endpoint.go:18-75."""
+        if not args.node or not args.address:
+            raise EndpointError("Must provide node and address")
+        if args.service is not None:
+            if not args.service.id and args.service.service:
+                args.service.id = args.service.service
+            if args.service.id and not args.service.service:
+                raise EndpointError("Must provide service name with ID")
+            if args.service.service != CONSUL_SERVICE_NAME:
+                acl = await self.srv.resolve_token(args.token)
+                if acl is not None and not acl.service_write(args.service.service):
+                    raise PermissionError("Permission denied")
+        if args.check is not None:
+            args.checks.append(args.check)
+            args.check = None
+        for check in args.checks:
+            if not check.check_id and check.name:
+                check.check_id = check.name
+            if not check.node:
+                check.node = args.node
+            if check.status and check.status not in VALID_HEALTH_STATES:
+                raise EndpointError(f"Invalid check status: '{check.status}'")
+        await self.srv.raft_apply(MessageType.REGISTER, args)
+
+    async def deregister(self, args: DeregisterRequest) -> None:
+        if not args.node:
+            raise EndpointError("Must provide node")
+        await self.srv.raft_apply(MessageType.DEREGISTER, args)
+
+    async def list_datacenters(self) -> List[str]:
+        return self.srv.known_datacenters()
+
+    async def list_nodes(self, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, nodes = self.srv.store.nodes()
+            meta.index = idx
+            out[:] = nodes
+
+        await self._blocking(opts, meta, run, tables=self.srv.store.query_tables("Nodes"))
+        return meta, out
+
+    async def list_services(self, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), {}
+
+        async def run():
+            idx, services = self.srv.store.services()
+            meta.index = idx
+            out.clear()
+            out.update(services)
+
+        await self._blocking(opts, meta, run, tables=self.srv.store.query_tables("Services"))
+        return meta, out
+
+    async def service_nodes(self, service: str, opts: QueryOptions, tag: str = "") -> tuple:
+        if not service:
+            raise EndpointError("Must provide service name")
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, nodes = self.srv.store.service_nodes(service, tag)
+            meta.index = idx
+            out[:] = await self.srv.filter_acl_service_nodes(opts.token, nodes)
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("ServiceNodes"))
+        return meta, out
+
+    async def node_services(self, node: str, opts: QueryOptions) -> tuple:
+        if not node:
+            raise EndpointError("Must provide node")
+        meta = QueryMeta()
+        holder: List[Any] = [None]
+
+        async def run():
+            idx, services = self.srv.store.node_services(node)
+            meta.index = idx
+            holder[0] = services
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("NodeServices"))
+        return meta, holder[0]
+
+
+class Health(_Endpoint):
+    """health_endpoint.go:15-143."""
+
+    async def checks_in_state(self, state: str, opts: QueryOptions) -> tuple:
+        if state not in (HEALTH_ANY,) + VALID_HEALTH_STATES:
+            raise EndpointError(f"Invalid state: '{state}'")
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, checks = self.srv.store.checks_in_state(state)
+            meta.index = idx
+            out[:] = checks
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("ChecksInState"))
+        return meta, out
+
+    async def node_checks(self, node: str, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, checks = self.srv.store.node_checks(node)
+            meta.index = idx
+            out[:] = checks
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("NodeChecks"))
+        return meta, out
+
+    async def service_checks(self, service: str, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, checks = self.srv.store.service_checks(service)
+            meta.index = idx
+            out[:] = checks
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("ServiceChecks"))
+        return meta, out
+
+    async def service_nodes(self, service: str, opts: QueryOptions, tag: str = "",
+                            passing_only: bool = False) -> tuple:
+        """CheckServiceNodes join; ?passing filters at the server
+        (health_endpoint.go:75-143)."""
+        if not service:
+            raise EndpointError("Must provide service name")
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, csns = self.srv.store.check_service_nodes(service, tag)
+            meta.index = idx
+            if passing_only:
+                from consul_tpu.structs.structs import HEALTH_PASSING
+                csns = [c for c in csns
+                        if all(ch.status == HEALTH_PASSING for ch in c.checks)]
+            out[:] = csns
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("CheckServiceNodes"))
+        return meta, out
+
+
+class KVS(_Endpoint):
+    """kvs_endpoint.go — Apply with lock-delay enforcement, blocking reads."""
+
+    async def apply(self, args: KVSRequest) -> bool:
+        d = args.dir_ent
+        if d is None or not d.key:
+            raise EndpointError("Must provide key")
+        acl = await self.srv.resolve_token(args.token)
+        if acl is not None and not acl.key_write(d.key):
+            raise PermissionError("Permission denied")
+
+        # Lock-delay must be checked on the leader's wall clock, pre-commit
+        # (kvs_endpoint.go:46-61): a lock attempt within the delay window
+        # after a session invalidation is refused without a Raft write.
+        if args.op == KVSOp.LOCK.value:
+            if self.srv.store.kvs_lock_delay(d.key) > 0:
+                return False
+
+        resp = await self.srv.raft_apply(MessageType.KVS, args)
+        return bool(resp) if isinstance(resp, bool) else True
+
+    async def get(self, args: KeyRequest) -> tuple:
+        acl = await self.srv.resolve_token(args.token)
+        if acl is not None and not acl.key_read(args.key):
+            raise PermissionError("Permission denied")
+        meta = QueryMeta()
+        out: List[DirEntry] = []
+
+        async def run():
+            idx, ent = self.srv.store.kvs_get(args.key)
+            meta.index = ent.modify_index if ent else idx
+            out[:] = [ent] if ent is not None else []
+
+        await self._blocking(args, meta, run, kv_prefix=args.key)
+        return meta, out
+
+    async def list(self, args: KeyListRequest) -> tuple:
+        acl = await self.srv.resolve_token(args.token)
+        meta = QueryMeta()
+        out: List[DirEntry] = []
+
+        async def run():
+            tomb_idx, idx, ents = self.srv.store.kvs_list(args.prefix)
+            if acl is not None:
+                ents = [e for e in ents if acl.key_read(e.key)]
+            # Index semantics (consul/kvs_endpoint.go:116-142): use the max
+            # entry index if non-zero, else the tombstone index, else table.
+            ent_max = max((e.modify_index for e in ents), default=0)
+            meta.index = max(ent_max, tomb_idx) or idx
+            out[:] = ents
+
+        await self._blocking(args, meta, run, kv_prefix=args.prefix)
+        return meta, out
+
+    async def list_keys(self, args: KeyListRequest) -> tuple:
+        acl = await self.srv.resolve_token(args.token)
+        meta = QueryMeta()
+        out: List[str] = []
+
+        async def run():
+            idx, keys = self.srv.store.kvs_list_keys(args.prefix, args.separator)
+            if acl is not None:
+                keys = [k for k in keys if acl.key_read(k)]
+            meta.index = idx
+            out[:] = keys
+
+        await self._blocking(args, meta, run, kv_prefix=args.prefix)
+        return meta, out
+
+
+class SessionEndpoint(_Endpoint):
+    """session_endpoint.go — UUID generation on the leader (NEVER in the
+    FSM: once in the log, the update must be deterministic)."""
+
+    async def apply(self, args: SessionRequest) -> str:
+        session = args.session
+        if args.op == SessionOp.DESTROY.value and not session.id:
+            raise EndpointError("Must provide ID")
+        if args.op == SessionOp.CREATE.value:
+            if not session.node:
+                raise EndpointError("Must provide Node")
+            if not session.behavior:
+                session.behavior = SESSION_BEHAVIOR_RELEASE
+            elif session.behavior not in (SESSION_BEHAVIOR_RELEASE,
+                                          SESSION_BEHAVIOR_DELETE):
+                raise EndpointError(f"Invalid Behavior setting '{session.behavior}'")
+            if session.ttl:
+                try:
+                    ttl = parse_duration(session.ttl)
+                except ValueError as e:
+                    raise EndpointError(f"Session TTL '{session.ttl}' invalid: {e}")
+                if ttl != 0 and not (
+                        self.srv.config.session_ttl_min <= ttl <= SESSION_TTL_MAX):
+                    raise EndpointError(
+                        f"Invalid Session TTL '{session.ttl}', must be between "
+                        f"[{self.srv.config.session_ttl_min}s={SESSION_TTL_MAX}s]")
+            # Generate a unique ID outside the replicated path
+            # (session_endpoint.go:60-74).
+            while True:
+                session.id = str(uuid.uuid4())
+                _, existing = self.srv.store.session_get(session.id)
+                if existing is None:
+                    break
+
+        resp = await self.srv.raft_apply(MessageType.SESSION, args)
+
+        if args.op == SessionOp.CREATE.value and session.ttl:
+            self.srv.reset_session_timer(session.id, session)
+        elif args.op == SessionOp.DESTROY.value:
+            self.srv.clear_session_timer(session.id)
+        return resp if isinstance(resp, str) else session.id
+
+    async def get(self, sid: str, opts: QueryOptions) -> tuple:
+        meta = QueryMeta()
+        holder: List[Optional[Session]] = [None]
+
+        async def run():
+            idx, sess = self.srv.store.session_get(sid)
+            meta.index = idx
+            holder[0] = sess
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("SessionGet"))
+        return meta, holder[0]
+
+    async def list(self, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, sessions = self.srv.store.session_list()
+            meta.index = idx
+            out[:] = sessions
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("SessionList"))
+        return meta, out
+
+    async def node_sessions(self, node: str, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, sessions = self.srv.store.node_sessions(node)
+            meta.index = idx
+            out[:] = sessions
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("NodeSessions"))
+        return meta, out
+
+    async def renew(self, sid: str) -> Optional[Session]:
+        """Reset the TTL timer (session_endpoint.go Renew + session_ttl.go)."""
+        _, session = self.srv.store.session_get(sid)
+        if session is not None and session.ttl:
+            self.srv.reset_session_timer(sid, session)
+        return session
+
+
+class Internal(_Endpoint):
+    """internal_endpoint.go — UI support queries + event fire."""
+
+    async def node_info(self, node: str, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, dump = self.srv.store.node_info(node)
+            meta.index = idx
+            out[:] = dump
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("NodeInfo"))
+        return meta, out
+
+    async def node_dump(self, opts: QueryOptions) -> tuple:
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, dump = self.srv.store.node_dump()
+            meta.index = idx
+            out[:] = dump
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("NodeDump"))
+        return meta, out
+
+    async def event_fire(self, event) -> None:
+        """Internal.EventFire — broadcast a user event.  Routed into the
+        gossip plane once the event pipeline lands."""
+        await self.srv.fire_user_event(event)

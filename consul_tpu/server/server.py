@@ -1,0 +1,158 @@
+"""Server core: FSM ownership, apply path, endpoint registry.
+
+Parity target: ``consul/server.go`` + ``consul/rpc.go`` in the
+reference.  This slice implements the single-node ("bootstrap") shape:
+``raft_apply`` goes straight through the FSM with a monotonically
+increasing index, exercising the same typed-entry codec the replicated
+path uses (consul/rpc.go:280-297 encodes MessageType + msgpack body);
+the Raft engine (consensus/raft.py) slots in behind ``raft_apply``
+without endpoint changes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from consul_tpu.consensus.fsm import ConsulFSM
+from consul_tpu.state.tombstone_gc import TombstoneGC
+from consul_tpu.structs import codec
+from consul_tpu.structs.structs import MessageType
+
+MAX_RAFT_ENTRY_WARN = 1024 * 1024  # 1MB soft cap (consul/rpc.go:42-44)
+
+
+@dataclass
+class ServerConfig:
+    node_name: str = "node1"
+    datacenter: str = "dc1"
+    domain: str = "consul."
+    bootstrap: bool = True
+    # Protocol timing (test configs compress these, consul/server_test.go:50-69)
+    reconcile_interval: float = 60.0
+    tombstone_ttl: float = 15 * 60.0
+    tombstone_granularity: float = 30.0
+    session_ttl_min: float = 10.0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class Server:
+    """In-process server node.  Owns the FSM/state store and the write
+    path; endpoint objects hang off it (consul/server.go:414-431)."""
+
+    def __init__(self, config: Optional[ServerConfig] = None) -> None:
+        self.config = config or ServerConfig()
+        self.gc = TombstoneGC(self.config.tombstone_ttl,
+                              self.config.tombstone_granularity)
+        self.fsm = ConsulFSM(gc_hint=lambda idx: self.gc.hint(idx, time.monotonic()))
+        self._raft_index = 0
+        self._leader = True  # single-node bootstrap; Raft flips this later
+        self.start_time = time.monotonic()
+        # Endpoint registry (server.go:414-431 registers the 7 services).
+        from consul_tpu.server.endpoints import (
+            Catalog, Health, Internal, KVS, SessionEndpoint, Status)
+        self.status = Status(self)
+        self.catalog = Catalog(self)
+        self.health = Health(self)
+        self.kvs = KVS(self)
+        self.session = SessionEndpoint(self)
+        self.internal = Internal(self)
+        self._endpoints = {
+            "Status": self.status, "Catalog": self.catalog, "Health": self.health,
+            "KVS": self.kvs, "Session": self.session, "Internal": self.internal,
+        }
+
+    @property
+    def store(self):
+        return self.fsm.store
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def leader_addr(self) -> str:
+        return self.config.node_name if self._leader else ""
+
+    def raft_last_index(self) -> int:
+        return self._raft_index
+
+    async def raft_apply(self, msg_type: MessageType, req: Any) -> Any:
+        """Apply a write through the consensus path (consul/rpc.go:280-297).
+
+        Single-node: encode (same framing the wire uses), bump the index,
+        apply.  The encode/decode round-trip is deliberate — it keeps the
+        FSM honest about operating on decoded wire payloads only.
+        """
+        buf = codec.encode(int(msg_type), req)
+        if len(buf) > MAX_RAFT_ENTRY_WARN:
+            # Reference warns and proceeds (rpc.go:42-44).
+            pass
+        if not self._leader:
+            raise NotLeaderError("Not the leader")
+        self._raft_index += 1
+        result = self.fsm.apply(self._raft_index, buf)
+        # Yield so watch waiters scheduled by notify() can run promptly.
+        await asyncio.sleep(0)
+        return result
+
+    async def consistent_read_barrier(self) -> None:
+        """VerifyLeader equivalent (consul/rpc.go:413-417): single-node
+        leadership is unconditional; Raft supplies a real barrier later."""
+        if not self._leader:
+            raise NotLeaderError("Not the leader")
+
+    def endpoint(self, name: str):
+        return self._endpoints[name]
+
+    def raft_peers(self) -> list:
+        return [self.config.node_name]
+
+    def known_datacenters(self) -> list:
+        """Sorted DC list (consul/catalog_endpoint.go:97-115); the WAN pool
+        populates remote DCs once gossip lands."""
+        return [self.config.datacenter]
+
+    async def resolve_token(self, token: str):
+        """ACL resolution (consul/acl.go:70-148).  None = ACLs disabled;
+        the ACL engine supplies a real resolver."""
+        return None
+
+    async def filter_acl_service_nodes(self, token: str, nodes: list) -> list:
+        acl = await self.resolve_token(token)
+        if acl is None:
+            return nodes
+        return [n for n in nodes if acl.service_read(n.service_name)]
+
+    def reset_session_timer(self, sid: str, session) -> None:
+        """Leader-owned TTL timer (consul/session_ttl.go); armed once the
+        session-TTL manager lands."""
+
+    def clear_session_timer(self, sid: str) -> None:
+        pass
+
+    async def fire_user_event(self, event) -> None:
+        """Broadcast via the gossip plane (consul/internal_endpoint.go
+        EventFire); local-only until the event pipeline lands."""
+
+    def stats(self) -> Dict[str, Dict[str, str]]:
+        """``consul info`` payload (consul/server.go:709-726)."""
+        return {
+            "consul": {
+                "server": "true",
+                "leader": str(self.is_leader()).lower(),
+                "bootstrap": str(self.config.bootstrap).lower(),
+            },
+            "raft": {
+                "applied_index": str(self._raft_index),
+                "last_log_index": str(self._raft_index),
+                "state": "Leader" if self._leader else "Follower",
+            },
+            "runtime": {
+                "uptime_s": str(int(time.monotonic() - self.start_time)),
+            },
+        }
+
+
+class NotLeaderError(Exception):
+    pass

@@ -194,7 +194,10 @@ class StateStore:
     # -- catalog: nodes / services / checks --------------------------------
 
     def ensure_registration(self, index: int, req: RegisterRequest) -> None:
-        """Atomic node+service+check(s) upsert (state_store.go:499-534)."""
+        """Atomic node+service+check(s) upsert (state_store.go:499-534).
+        The reference aborts the whole LMDB txn on any failure; we get the
+        same all-or-nothing by validating every piece before mutating."""
+        self._validate_registration(req)
         self._ensure_node(index, Node(node=req.node, address=req.address))
         if req.service is not None:
             self._ensure_service(index, req.node, req.service)
@@ -202,6 +205,19 @@ class StateStore:
             self._ensure_check(index, req.check)
         for check in req.checks:
             self._ensure_check(index, check)
+
+    def _validate_registration(self, req: RegisterRequest) -> None:
+        svc_ids = {req.service.id} if req.service is not None else set()
+        checks = list(req.checks) + ([req.check] if req.check is not None else [])
+        for check in checks:
+            if check.node and check.node != req.node:
+                # Reference keys checks by (node, id); a check for another
+                # node would need that node registered already.
+                if check.node not in self._nodes:
+                    raise StateStoreError("Missing node registration")
+            if check.service_id and check.service_id not in svc_ids and \
+                    (req.node, check.service_id) not in self._services:
+                raise StateStoreError("Missing service registration")
 
     def ensure_node(self, index: int, node: Node) -> None:
         self._ensure_node(index, node)
@@ -216,8 +232,9 @@ class StateStore:
         return self._last_index[TABLE_NODES], (n.address if n else None)
 
     def nodes(self) -> Tuple[int, List[Node]]:
-        return self._last_index[TABLE_NODES], sorted(
-            self._nodes.values(), key=lambda n: n.node)
+        return self._last_index[TABLE_NODES], [
+            dataclasses.replace(n)
+            for n in sorted(self._nodes.values(), key=lambda n: n.node)]
 
     def ensure_service(self, index: int, node: str, ns: NodeService) -> None:
         self._ensure_service(index, node, ns)
@@ -310,6 +327,7 @@ class StateStore:
     def _ensure_check(self, index: int, check: HealthCheck) -> None:
         """Upsert a check; critical status invalidates dependent sessions
         (state_store.go:887-934)."""
+        check = dataclasses.replace(check)
         if not check.status:
             check.status = HEALTH_CRITICAL
         if check.node not in self._nodes:
@@ -321,7 +339,7 @@ class StateStore:
             check.service_name = sn.service_name
         if check.status == HEALTH_CRITICAL:
             self._invalidate_check(index, check.node, check.check_id)
-        self._checks[(check.node, check.check_id)] = dataclasses.replace(check)
+        self._checks[(check.node, check.check_id)] = check
         self._last_index[TABLE_CHECKS] = index
         self._notify(TABLE_CHECKS)
 
@@ -332,21 +350,24 @@ class StateStore:
             self._notify(TABLE_CHECKS)
 
     def node_checks(self, node: str) -> Tuple[int, List[HealthCheck]]:
-        return self._last_index[TABLE_CHECKS], sorted(
-            (c for k, c in self._checks.items() if k[0] == node),
-            key=lambda c: c.check_id)
+        return self._last_index[TABLE_CHECKS], [
+            dataclasses.replace(c) for c in sorted(
+                (c for k, c in self._checks.items() if k[0] == node),
+                key=lambda c: c.check_id)]
 
     def service_checks(self, service: str) -> Tuple[int, List[HealthCheck]]:
-        return self._last_index[TABLE_CHECKS], sorted(
-            (c for c in self._checks.values() if c.service_name == service),
-            key=lambda c: (c.node, c.check_id))
+        return self._last_index[TABLE_CHECKS], [
+            dataclasses.replace(c) for c in sorted(
+                (c for c in self._checks.values() if c.service_name == service),
+                key=lambda c: (c.node, c.check_id))]
 
     def checks_in_state(self, state: str) -> Tuple[int, List[HealthCheck]]:
         from consul_tpu.structs.structs import HEALTH_ANY
-        return self._last_index[TABLE_CHECKS], sorted(
-            (c for c in self._checks.values()
-             if state == HEALTH_ANY or c.status == state),
-            key=lambda c: (c.node, c.check_id))
+        return self._last_index[TABLE_CHECKS], [
+            dataclasses.replace(c) for c in sorted(
+                (c for c in self._checks.values()
+                 if state == HEALTH_ANY or c.status == state),
+                key=lambda c: (c.node, c.check_id))]
 
     def check_service_nodes(self, service: str, tag: str = "") -> Tuple[int, List[CheckServiceNode]]:
         """Join of nodes, service instances, and their checks + node-level
@@ -361,10 +382,11 @@ class StateStore:
             node = self._nodes.get(sn.node)
             if node is None:
                 continue
-            checks = [c for k, c in sorted(self._checks.items())
+            checks = [dataclasses.replace(c) for k, c in sorted(self._checks.items())
                       if k[0] == sn.node and c.service_id in ("", sn.service_id)]
             out.append(CheckServiceNode(
-                node=node, service=_to_node_service(sn), checks=checks))
+                node=dataclasses.replace(node), service=_to_node_service(sn),
+                checks=checks))
         return idx, out
 
     def node_info(self, node: str) -> Tuple[int, List[dict]]:
@@ -385,7 +407,8 @@ class StateStore:
             "address": n.address,
             "services": [_to_node_service(sn)
                          for k, sn in sorted(self._services.items()) if k[0] == n.node],
-            "checks": [c for k, c in sorted(self._checks.items()) if k[0] == n.node],
+            "checks": [dataclasses.replace(c)
+                       for k, c in sorted(self._checks.items()) if k[0] == n.node],
         }
 
     # -- KV ----------------------------------------------------------------
@@ -462,14 +485,15 @@ class StateStore:
 
     def kvs_get(self, key: str) -> Tuple[int, Optional[DirEntry]]:
         idx = max(self._last_index[TABLE_KVS], self._last_index[TABLE_TOMBSTONES])
-        return idx, self._kvs.get(key)
+        ent = self._kvs.get(key)
+        return idx, ent.clone() if ent is not None else None
 
     def kvs_list(self, prefix: str) -> Tuple[int, int, List[DirEntry]]:
         """Returns (tombstone_max_index, table_index, entries)
         (state_store.go:1202-1236): the endpoint uses the tombstone index
         to keep blocking list queries advancing after deletes."""
         idx = max(self._last_index[TABLE_KVS], self._last_index[TABLE_TOMBSTONES])
-        ents = [self._kvs[k] for k in self._kvs_keys.prefix_range(prefix)]
+        ents = [self._kvs[k].clone() for k in self._kvs_keys.prefix_range(prefix)]
         tomb_idx = 0
         for k in self._tombstone_keys.prefix_range(prefix):
             tomb_idx = max(tomb_idx, self._tombstones[k].modify_index)
@@ -595,16 +619,21 @@ class StateStore:
         self._notify(TABLE_SESSIONS)
 
     def session_get(self, sid: str) -> Tuple[int, Optional[Session]]:
-        return self._last_index[TABLE_SESSIONS], self._sessions.get(sid)
+        sess = self._sessions.get(sid)
+        return self._last_index[TABLE_SESSIONS], (
+            dataclasses.replace(sess, checks=list(sess.checks))
+            if sess is not None else None)
 
     def session_list(self) -> Tuple[int, List[Session]]:
-        return self._last_index[TABLE_SESSIONS], sorted(
-            self._sessions.values(), key=lambda s: s.id)
+        return self._last_index[TABLE_SESSIONS], [
+            dataclasses.replace(s, checks=list(s.checks))
+            for s in sorted(self._sessions.values(), key=lambda s: s.id)]
 
     def node_sessions(self, node: str) -> Tuple[int, List[Session]]:
-        return self._last_index[TABLE_SESSIONS], sorted(
-            (s for s in self._sessions.values() if s.node == node),
-            key=lambda s: s.id)
+        return self._last_index[TABLE_SESSIONS], [
+            dataclasses.replace(s, checks=list(s.checks))
+            for s in sorted((s for s in self._sessions.values() if s.node == node),
+                            key=lambda s: s.id)]
 
     def session_destroy(self, index: int, sid: str) -> None:
         self._invalidate_session(index, sid)
@@ -685,11 +714,14 @@ class StateStore:
         self._notify(TABLE_ACLS)
 
     def acl_get(self, aid: str) -> Tuple[int, Optional[ACL]]:
-        return self._last_index[TABLE_ACLS], self._acls.get(aid)
+        acl = self._acls.get(aid)
+        return self._last_index[TABLE_ACLS], (
+            dataclasses.replace(acl) if acl is not None else None)
 
     def acl_list(self) -> Tuple[int, List[ACL]]:
-        return self._last_index[TABLE_ACLS], sorted(
-            self._acls.values(), key=lambda a: a.id)
+        return self._last_index[TABLE_ACLS], [
+            dataclasses.replace(a)
+            for a in sorted(self._acls.values(), key=lambda a: a.id)]
 
     def acl_delete(self, index: int, aid: str) -> None:
         if self._acls.pop(aid, None) is not None:

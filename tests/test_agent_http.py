@@ -1,0 +1,324 @@
+"""End-to-end agent slice tests: real HTTP requests against a live agent
+(SURVEY.md §4 tier 3 — the reference drives its in-process Agent's HTTP
+server the same way, command/agent/*_test.go)."""
+
+import asyncio
+import base64
+import json
+import socket
+import struct
+import threading
+import time
+
+import httpx
+import pytest
+
+from consul_tpu.agent import Agent, AgentConfig
+from consul_tpu.agent.dns import (
+    QTYPE_A, QTYPE_SRV, RCODE_NXDOMAIN, RCODE_OK, build_response, parse_message,
+)
+
+
+class AgentHarness:
+    """Runs an Agent in a daemon thread with its own event loop, the way
+    testutil.TestServer runs a forked binary (testutil/server.go)."""
+
+    def __init__(self, config=None):
+        self.config = config or AgentConfig(http_port=0, dns_port=0)
+        self.config.http_port = 0
+        self.config.dns_port = 0
+        self.loop = None
+        self.agent = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+        self.agent = Agent(self.config)
+        self.loop.run_until_complete(self.agent.start())
+        self._ready.set()
+        self.loop.run_forever()
+
+    def start(self):
+        self._thread.start()
+        assert self._ready.wait(10), "agent failed to start"
+        return self
+
+    def stop(self):
+        asyncio.run_coroutine_threadsafe(self.agent.stop(), self.loop).result(5)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(5)
+
+    @property
+    def http_addr(self):
+        host, port = self.agent.http.addr
+        return f"http://{host}:{port}"
+
+    @property
+    def dns_addr(self):
+        return self.agent.dns.addr
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = AgentHarness().start()
+    yield h
+    h.stop()
+
+
+@pytest.fixture()
+def client(harness):
+    with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+        yield c
+
+
+def dns_query(addr, name, qtype=QTYPE_A):
+    """Build + send a raw DNS query over UDP, parse the reply sections."""
+    q = bytearray(struct.pack("!HHHHHH", 0x1234, 0x0100, 1, 0, 0, 0))
+    for label in name.rstrip(".").split("."):
+        q.append(len(label))
+        q += label.encode()
+    q.append(0)
+    q += struct.pack("!HH", qtype, 1)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(5)
+    sock.sendto(bytes(q), addr)
+    buf, _ = sock.recvfrom(4096)
+    sock.close()
+    msg_id, flags, qd, an, ns, ar = struct.unpack("!HHHHHH", buf[:12])
+    return {"rcode": flags & 0xF, "ancount": an, "arcount": ar, "raw": buf}
+
+
+class TestStatus:
+    def test_leader_and_peers(self, client):
+        assert client.get("/v1/status/leader").json() == "node1"
+        assert client.get("/v1/status/peers").json() == ["node1"]
+
+
+class TestKV:
+    def test_put_get_delete(self, client):
+        assert client.put("/v1/kv/foo", content=b"bar").json() is True
+        resp = client.get("/v1/kv/foo")
+        ent = resp.json()[0]
+        assert base64.b64decode(ent["Value"]) == b"bar"
+        assert ent["Key"] == "foo"
+        assert int(resp.headers["X-Consul-Index"]) == ent["ModifyIndex"]
+        assert client.get("/v1/kv/foo?raw").content == b"bar"
+        assert client.delete("/v1/kv/foo").json() is True
+        assert client.get("/v1/kv/foo").status_code == 404
+
+    def test_flags_and_cas(self, client):
+        client.put("/v1/kv/cask?flags=42", content=b"a")
+        ent = client.get("/v1/kv/cask").json()[0]
+        assert ent["Flags"] == 42
+        idx = ent["ModifyIndex"]
+        assert client.put(f"/v1/kv/cask?cas={idx - 1}", content=b"x").json() is False
+        assert client.put(f"/v1/kv/cask?cas={idx}", content=b"b").json() is True
+        assert client.delete(f"/v1/kv/cask?cas={idx - 1}").json() is False
+
+    def test_recurse_and_keys(self, client):
+        for k in ("web/a", "web/b/c", "zother"):
+            client.put(f"/v1/kv/{k}", content=b"x")
+        ents = client.get("/v1/kv/web/?recurse").json()
+        assert [e["Key"] for e in ents] == ["web/a", "web/b/c"]
+        keys = client.get("/v1/kv/web/?keys&separator=/").json()
+        assert keys == ["web/a", "web/b/"]
+        assert client.delete("/v1/kv/web/?recurse").json() is True
+        r = client.get("/v1/kv/web/?recurse")
+        assert r.status_code == 404
+        # tombstone keeps index advancing for blocking queries
+        assert int(r.headers["X-Consul-Index"]) > 0
+
+    def test_blocking_query_wakes_on_write(self, harness, client):
+        client.put("/v1/kv/blk", content=b"v1")
+        idx = int(client.get("/v1/kv/blk").headers["X-Consul-Index"])
+
+        def write_later():
+            time.sleep(0.2)
+            httpx.put(f"{harness.http_addr}/v1/kv/blk", content=b"v2", timeout=5)
+
+        t = threading.Thread(target=write_later)
+        start = time.monotonic()
+        t.start()
+        resp = client.get(f"/v1/kv/blk?index={idx}&wait=10s")
+        elapsed = time.monotonic() - start
+        t.join()
+        assert base64.b64decode(resp.json()[0]["Value"]) == b"v2"
+        assert 0.1 < elapsed < 5
+
+    def test_blocking_query_timeout(self, client):
+        client.put("/v1/kv/blk2", content=b"v")
+        idx = int(client.get("/v1/kv/blk2").headers["X-Consul-Index"])
+        start = time.monotonic()
+        resp = client.get(f"/v1/kv/blk2?index={idx}&wait=300ms")
+        assert time.monotonic() - start < 2
+        assert int(resp.headers["X-Consul-Index"]) == idx
+
+    def test_stale_and_consistent_conflict(self, client):
+        assert client.get("/v1/kv/foo?stale&consistent").status_code == 400
+
+
+class TestCatalog:
+    def test_register_and_queries(self, client):
+        reg = {
+            "Node": "ext1", "Address": "10.1.2.3",
+            "Service": {"Service": "web", "Tags": ["v1"], "Port": 8080},
+            "Check": {"Name": "web alive", "Status": "passing",
+                      "ServiceID": "web"},
+        }
+        assert client.put("/v1/catalog/register", json=reg).json() is True
+        nodes = client.get("/v1/catalog/nodes").json()
+        assert {n["Node"] for n in nodes} >= {"node1", "ext1"}
+        services = client.get("/v1/catalog/services").json()
+        assert "web" in services and "consul" in services
+        sn = client.get("/v1/catalog/service/web").json()
+        assert sn[0]["ServiceName"] == "web" and sn[0]["ServicePort"] == 8080
+        ns = client.get("/v1/catalog/node/ext1").json()
+        assert ns["Node"]["Address"] == "10.1.2.3"
+        assert "web" in ns["Services"]
+        assert client.get("/v1/catalog/datacenters").json() == ["dc1"]
+
+    def test_register_validation(self, client):
+        assert client.put("/v1/catalog/register", json={"Node": "x"}).status_code == 400
+
+    def test_deregister(self, client):
+        reg = {"Node": "bye", "Address": "10.0.0.9"}
+        client.put("/v1/catalog/register", json=reg)
+        assert client.put("/v1/catalog/deregister", json={"Node": "bye"}).json() is True
+        assert all(n["Node"] != "bye"
+                   for n in client.get("/v1/catalog/nodes").json())
+
+
+class TestHealth:
+    def test_health_queries(self, client):
+        reg = {
+            "Node": "hnode", "Address": "10.2.0.1",
+            "Service": {"Service": "db", "Port": 5432},
+            "Checks": [
+                {"Name": "db ok", "CheckID": "db:ok", "Status": "passing",
+                 "ServiceID": "db"},
+                {"Name": "disk", "CheckID": "disk", "Status": "warning"},
+            ],
+        }
+        client.put("/v1/catalog/register", json=reg)
+        checks = client.get("/v1/health/node/hnode").json()
+        assert {c["CheckID"] for c in checks} == {"db:ok", "disk"}
+        svc_checks = client.get("/v1/health/checks/db").json()
+        assert svc_checks[0]["CheckID"] == "db:ok"
+        warn = client.get("/v1/health/state/warning").json()
+        assert any(c["CheckID"] == "disk" for c in warn)
+        csn = client.get("/v1/health/service/db").json()
+        assert csn[0]["Node"]["Node"] == "hnode"
+        assert {c["CheckID"] for c in csn[0]["Checks"]} == {"db:ok", "disk"}
+
+    def test_passing_filter(self, client):
+        reg = {
+            "Node": "pnode", "Address": "10.2.0.2",
+            "Service": {"Service": "cache"},
+            "Check": {"Name": "c", "CheckID": "cache:c", "Status": "critical",
+                      "ServiceID": "cache"},
+        }
+        client.put("/v1/catalog/register", json=reg)
+        assert client.get("/v1/health/service/cache").json() != []
+        assert client.get("/v1/health/service/cache?passing").json() == []
+
+
+class TestSessions:
+    def test_session_lifecycle_and_locks(self, client):
+        sid = client.put("/v1/session/create", json={}).json()["ID"]
+        assert len(sid) == 36
+        info = client.get(f"/v1/session/info/{sid}").json()
+        assert info[0]["Node"] == "node1"
+        # acquire/release via KV
+        assert client.put(f"/v1/kv/lockk?acquire={sid}", content=b"me").json() is True
+        ent = client.get("/v1/kv/lockk").json()[0]
+        assert ent["Session"] == sid and ent["LockIndex"] == 1
+        sid2 = client.put("/v1/session/create", json={}).json()["ID"]
+        assert client.put(f"/v1/kv/lockk?acquire={sid2}", content=b"you").json() is False
+        assert client.put(f"/v1/kv/lockk?release={sid}", content=b"").json() is True
+        assert client.put("/v1/session/destroy/" + sid).json() is True
+        assert client.get(f"/v1/session/info/{sid}").json() == []
+        sessions = client.get("/v1/session/list").json()
+        assert any(s["ID"] == sid2 for s in sessions)
+        node_sessions = client.get("/v1/session/node/node1").json()
+        assert any(s["ID"] == sid2 for s in node_sessions)
+
+    def test_session_ttl_validation(self, client):
+        r = client.put("/v1/session/create", json={"TTL": "1s"})
+        assert r.status_code == 400  # below min 10s
+        r = client.put("/v1/session/create", json={"TTL": "30s"})
+        assert r.status_code == 200
+
+
+class TestAgentEndpoints:
+    def test_self(self, client):
+        me = client.get("/v1/agent/self").json()
+        assert me["Config"]["NodeName"] == "node1"
+        assert me["Config"]["Server"] is True
+        assert me["Stats"]["raft"]["state"] == "Leader"
+
+    def test_services_checks_members(self, client):
+        services = client.get("/v1/agent/services").json()
+        assert "consul" in services
+        checks = client.get("/v1/agent/checks").json()
+        assert "serfHealth" in checks
+        members = client.get("/v1/agent/members").json()
+        assert members[0]["Name"] == "node1"
+
+
+class TestUI:
+    def test_ui_endpoints(self, client):
+        nodes = client.get("/v1/internal/ui/nodes").json()
+        assert any(n["Node"] == "node1" for n in nodes)
+        info = client.get("/v1/internal/ui/node/node1").json()
+        assert info["Node"] == "node1"
+        services = client.get("/v1/internal/ui/services").json()
+        assert any(s["Name"] == "consul" for s in services)
+
+
+class TestDNS:
+    def test_node_a_lookup(self, harness, client):
+        client.put("/v1/catalog/register",
+                   json={"Node": "dnsnode", "Address": "10.9.9.9"})
+        r = dns_query(harness.dns_addr, "dnsnode.node.consul")
+        assert r["rcode"] == RCODE_OK and r["ancount"] == 1
+        assert bytes([10, 9, 9, 9]) in r["raw"]
+
+    def test_node_with_dc(self, harness):
+        r = dns_query(harness.dns_addr, "dnsnode.node.dc1.consul")
+        assert r["rcode"] == RCODE_OK and r["ancount"] == 1
+        r = dns_query(harness.dns_addr, "dnsnode.node.dc9.consul")
+        assert r["rcode"] == RCODE_NXDOMAIN
+
+    def test_service_lookup_filters_critical(self, harness, client):
+        for i, status in enumerate(["passing", "passing", "critical"]):
+            client.put("/v1/catalog/register", json={
+                "Node": f"d{i}", "Address": f"10.8.0.{i + 1}",
+                "Service": {"Service": "dsvc", "Port": 100 + i},
+                "Check": {"Name": "c", "CheckID": "dc", "Status": status,
+                          "ServiceID": "dsvc"},
+            })
+        r = dns_query(harness.dns_addr, "dsvc.service.consul")
+        assert r["rcode"] == RCODE_OK and r["ancount"] == 2
+
+    def test_srv_lookup(self, harness):
+        r = dns_query(harness.dns_addr, "dsvc.service.consul", QTYPE_SRV)
+        assert r["rcode"] == RCODE_OK
+        assert r["ancount"] == 2 and r["arcount"] == 2
+
+    def test_rfc2782(self, harness):
+        r = dns_query(harness.dns_addr, "_dsvc._tcp.service.consul", QTYPE_SRV)
+        assert r["ancount"] == 2
+
+    def test_udp_answer_cap(self, harness, client):
+        for i in range(6):
+            client.put("/v1/catalog/register", json={
+                "Node": f"many{i}", "Address": f"10.7.0.{i + 1}",
+                "Service": {"Service": "many", "Port": 80},
+            })
+        r = dns_query(harness.dns_addr, "many.service.consul")
+        assert r["ancount"] == 3  # dns.go UDP cap
+
+    def test_nxdomain(self, harness):
+        assert dns_query(harness.dns_addr, "ghost.service.consul")["rcode"] == RCODE_NXDOMAIN

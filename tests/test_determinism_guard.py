@@ -1,0 +1,62 @@
+"""Replicated-path determinism lint.
+
+Parity target: the reference's ``scripts/verify_no_uuid.sh`` (run by
+``make test``, Makefile:37): UUIDs — and any other nondeterminism — must
+be generated *outside* the FSM/state-store apply path, or follower state
+machines diverge.  Session/ACL IDs are minted in the endpoints on the
+leader (consul/session_endpoint.go:60-74) before the entry hits the log.
+"""
+
+import io
+import re
+import tokenize
+from pathlib import Path
+
+REPLICATED_MODULES = [
+    "consul_tpu/consensus/fsm.py",
+    "consul_tpu/state/store.py",
+    "consul_tpu/state/radix.py",
+    "consul_tpu/state/notify.py",
+]
+
+# time.monotonic is allowed in store.py ONLY for the lock-delay map, which
+# the reference also keeps node-local and out of replicated state
+# (state_store.go:1461-1467 — "must be checked on the leader ... due to
+# the variability of clocks").
+FORBIDDEN = [
+    (re.compile(r"\buuid\b", re.I), "uuid generation"),
+    (re.compile(r"time\.time\(\)"), "wall-clock read"),
+    (re.compile(r"\brandom\.|np\.random|secrets\."), "randomness"),
+    (re.compile(r"os\.urandom"), "randomness"),
+]
+
+
+def _code_tokens(text):
+    """Source tokens excluding comments and string literals/docstrings."""
+    for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+        if tok.type not in (tokenize.COMMENT, tokenize.STRING):
+            yield tok
+
+
+def test_no_nondeterminism_in_replicated_path():
+    root = Path(__file__).resolve().parent.parent
+    violations = []
+    for rel in REPLICATED_MODULES:
+        text = (root / rel).read_text()
+        for tok in _code_tokens(text):
+            for pat, why in FORBIDDEN:
+                if pat.search(tok.string):
+                    violations.append(
+                        f"{rel}:{tok.start[0]}: {why}: {tok.line.strip()}")
+    assert not violations, "\n".join(violations)
+
+
+def test_lock_delay_is_only_monotonic_use():
+    root = Path(__file__).resolve().parent.parent
+    text = (root / "consul_tpu/state/store.py").read_text()
+    uses = [l for l in text.splitlines() if "time.monotonic" in l.split("#")[0]]
+    # Every monotonic read must be in lock-delay bookkeeping.
+    ok_markers = ("_lock_delay", "expires", "rem = ")
+    for line in uses:
+        assert any(m in line or m in text[max(0, text.find(line) - 400):text.find(line)]
+                   for m in ok_markers), f"unexpected clock read: {line.strip()}"

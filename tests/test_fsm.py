@@ -1,0 +1,122 @@
+"""FSM apply/snapshot/restore (reference tier: consul/fsm_test.go)."""
+
+import pytest
+
+from consul_tpu.consensus.fsm import ConsulFSM, IGNORE_UNKNOWN_FLAG
+from consul_tpu.structs import codec
+from consul_tpu.structs.structs import (
+    ACL,
+    ACLOp,
+    ACLRequest,
+    DeregisterRequest,
+    DirEntry,
+    HEALTH_PASSING,
+    HealthCheck,
+    KVSOp,
+    KVSRequest,
+    MessageType,
+    NodeService,
+    RegisterRequest,
+    Session,
+    SessionOp,
+    SessionRequest,
+    TombstoneRequest,
+)
+
+
+def apply(fsm, index, msg_type, req):
+    return fsm.apply(index, codec.encode(int(msg_type), req))
+
+
+def seed(fsm):
+    apply(fsm, 1, MessageType.REGISTER, RegisterRequest(
+        node="n1", address="10.0.0.1",
+        service=NodeService(id="web", service="web", tags=["v1"], port=80),
+        check=HealthCheck(node="n1", check_id="c1", name="c",
+                          status=HEALTH_PASSING, service_id="web")))
+    apply(fsm, 2, MessageType.KVS, KVSRequest(
+        op=KVSOp.SET.value, dir_ent=DirEntry(key="k1", value=b"v1")))
+    apply(fsm, 3, MessageType.SESSION, SessionRequest(
+        op=SessionOp.CREATE.value, session=Session(id="sess-1", node="n1")))
+    apply(fsm, 4, MessageType.ACL, ACLRequest(
+        op=ACLOp.SET.value, acl=ACL(id="acl-1", name="t", rules="")))
+
+
+class TestApply:
+    def test_register_deregister(self):
+        fsm = ConsulFSM()
+        seed(fsm)
+        assert fsm.store.get_node("n1")[1] == "10.0.0.1"
+        apply(fsm, 5, MessageType.DEREGISTER, DeregisterRequest(node="n1", check_id="c1"))
+        assert fsm.store.node_checks("n1")[1] == []
+        apply(fsm, 6, MessageType.DEREGISTER, DeregisterRequest(node="n1", service_id="web"))
+        assert fsm.store.service_nodes("web")[1] == []
+        apply(fsm, 7, MessageType.DEREGISTER, DeregisterRequest(node="n1"))
+        assert fsm.store.get_node("n1")[1] is None
+
+    def test_kvs_ops_return_bools(self):
+        fsm = ConsulFSM()
+        seed(fsm)
+        assert apply(fsm, 5, MessageType.KVS, KVSRequest(
+            op=KVSOp.CAS.value, dir_ent=DirEntry(key="k1", value=b"x",
+                                                 modify_index=2))) is True
+        assert apply(fsm, 6, MessageType.KVS, KVSRequest(
+            op=KVSOp.CAS.value, dir_ent=DirEntry(key="k1", value=b"y",
+                                                 modify_index=1))) is False
+        assert apply(fsm, 7, MessageType.KVS, KVSRequest(
+            op=KVSOp.LOCK.value, dir_ent=DirEntry(key="k1", value=b"l",
+                                                  session="sess-1"))) is True
+        assert apply(fsm, 8, MessageType.KVS, KVSRequest(
+            op=KVSOp.UNLOCK.value, dir_ent=DirEntry(key="k1", value=b"u",
+                                                    session="sess-1"))) is True
+
+    def test_tombstone_reap(self):
+        fsm = ConsulFSM()
+        seed(fsm)
+        apply(fsm, 5, MessageType.KVS, KVSRequest(
+            op=KVSOp.DELETE.value, dir_ent=DirEntry(key="k1")))
+        assert fsm.store.kvs_list("k")[0] == 5
+        apply(fsm, 6, MessageType.TOMBSTONE, TombstoneRequest(reap_index=5))
+        assert fsm.store.kvs_list("k")[0] == 0
+
+    def test_unknown_type(self):
+        fsm = ConsulFSM()
+        with pytest.raises(ValueError):
+            fsm.apply(1, bytes([99]) + b"\x80")
+        # ignore-flagged unknown type is skipped silently
+        assert fsm.apply(1, bytes([99 | IGNORE_UNKNOWN_FLAG]) + b"\x80") is None
+
+
+class TestSnapshot:
+    def test_round_trip(self):
+        fsm = ConsulFSM()
+        seed(fsm)
+        fsm.store.kvs_delete(5, "k1")  # leave a tombstone
+        snap = fsm.snapshot(last_index=5)
+
+        fsm2 = ConsulFSM()
+        assert fsm2.restore(snap) == 5
+        assert fsm2.store.get_node("n1")[1] == "10.0.0.1"
+        _, sns = fsm2.store.service_nodes("web")
+        assert sns[0].service_port == 80 and sns[0].service_tags == ["v1"]
+        _, checks = fsm2.store.node_checks("n1")
+        assert checks[0].status == HEALTH_PASSING
+        assert fsm2.store.session_get("sess-1")[1].node == "n1"
+        assert fsm2.store.acl_get("acl-1")[1].name == "t"
+        assert fsm2.store.kvs_list("k")[0] == 5  # tombstone survived
+
+    def test_snapshot_deterministic(self):
+        a, b = ConsulFSM(), ConsulFSM()
+        for fsm in (a, b):
+            seed(fsm)
+        assert a.snapshot(4) == b.snapshot(4)
+
+    def test_restore_replaces_state(self):
+        fsm = ConsulFSM()
+        seed(fsm)
+        snap = fsm.snapshot(4)
+        apply(fsm, 5, MessageType.KVS, KVSRequest(
+            op=KVSOp.SET.value, dir_ent=DirEntry(key="extra", value=b"z")))
+        fsm.restore(snap)
+        assert fsm.store.kvs_get("extra")[1] is None
+        assert fsm.store.kvs_get("k1")[1].value == b"v1"

@@ -81,6 +81,31 @@ class TestCatalog:
         assert s.service_nodes("web")[1] == []
         assert s.node_checks("n1")[1] == []
 
+    def test_registration_is_atomic_on_invalid_check(self):
+        # A check naming an unknown service must leave NO partial state
+        # (reference: aborting LMDB txn, state_store.go:499-534).
+        s = StateStore()
+        with pytest.raises(StateStoreError):
+            s.ensure_registration(1, RegisterRequest(
+                node="n1", address="10.0.0.1",
+                service=NodeService(id="web", service="web"),
+                check=HealthCheck(node="n1", check_id="c1", service_id="ghost")))
+        assert s.nodes()[1] == []
+        assert s.service_nodes("web")[1] == []
+        assert s.last_index("nodes", "services", "checks") == 0
+
+    def test_reads_return_copies(self):
+        s = StateStore()
+        reg(s, 1, "n1")
+        s.kvs_set(2, DirEntry(key="k", value=b"v"))
+        _, ent = s.kvs_get("k")
+        ent.value = b"mutated"
+        assert s.kvs_get("k")[1].value == b"v"
+        s.ensure_check(3, HealthCheck(node="n1", check_id="c1", status=HEALTH_PASSING))
+        _, checks = s.node_checks("n1")
+        checks[0].status = "critical"
+        assert s.node_checks("n1")[1][0].status == HEALTH_PASSING
+
     def test_node_dump(self):
         s = StateStore()
         reg(s, 1, "n1")
