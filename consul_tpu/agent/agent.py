@@ -73,6 +73,8 @@ class Agent:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
+        await self.server.start()
+        await self.server.wait_for_leader()
         await self._register_self()
         await self.http.start(self.config.bind_addr, self.config.http_port)
         await self.dns.start(self.config.bind_addr, self.config.dns_port)
@@ -80,6 +82,7 @@ class Agent:
     async def stop(self) -> None:
         await self.dns.stop()
         await self.http.stop()
+        await self.server.stop()
 
     async def _register_self(self) -> None:
         """What handleAliveMember does for each live node on the leader

@@ -1,0 +1,177 @@
+"""Raft log stores: durable append-only entry log + stable kv.
+
+Parity target: the reference wires `raft-boltdb` as both LogStore and
+StableStore plus a LogCache of 512 entries (consul/server.go:51-53,
+357-368).  Here: a single append-only segment file with CRC-framed
+records (msgpack body) and an in-memory index, fsync'd per append batch;
+the stable store (term/vote) is a tiny JSON file written atomically.
+An in-memory variant backs the compressed-timer test tier.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import msgpack
+
+# Entry types (hashicorp/raft LogType equivalents).
+LOG_COMMAND = 0
+LOG_NOOP = 1
+LOG_BARRIER = 2
+LOG_CONFIGURATION = 3  # peer-set change; data = msgpack list of peer ids
+
+
+@dataclass
+class LogEntry:
+    index: int
+    term: int
+    type: int = LOG_COMMAND
+    data: bytes = b""
+
+    def pack(self) -> bytes:
+        return msgpack.packb([self.index, self.term, self.type, self.data],
+                             use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> "LogEntry":
+        i, t, ty, d = msgpack.unpackb(buf, raw=False)
+        return cls(index=i, term=t, type=ty, data=d)
+
+
+class MemoryLogStore:
+    """Volatile log + stable store for in-process cluster tests."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[int, LogEntry] = {}
+        self._first = 0
+        self._last = 0
+        self._stable: Dict[str, int | str] = {}
+
+    def first_index(self) -> int:
+        return self._first
+
+    def last_index(self) -> int:
+        return self._last
+
+    def get(self, index: int) -> Optional[LogEntry]:
+        return self._entries.get(index)
+
+    def append(self, entries: List[LogEntry]) -> None:
+        for e in entries:
+            self._entries[e.index] = e
+            if self._first == 0:
+                self._first = e.index
+            self._last = max(self._last, e.index)
+
+    def delete_from(self, index: int) -> None:
+        """Drop index.. (conflict truncation)."""
+        for i in range(index, self._last + 1):
+            self._entries.pop(i, None)
+        self._last = max(index - 1, 0)
+        if self._last < self._first:
+            self._first = 0
+
+    def delete_to(self, index: int) -> None:
+        """Drop ..index inclusive (post-snapshot compaction)."""
+        lo = self._first or 1
+        for i in range(lo, index + 1):
+            self._entries.pop(i, None)
+        self._first = index + 1 if self._last > index else 0
+        if self._first == 0:
+            self._last = 0
+
+    def set_stable(self, key: str, val) -> None:
+        self._stable[key] = val
+
+    def get_stable(self, key: str, default=None):
+        return self._stable.get(key, default)
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+_REC_HDR = struct.Struct("<II")  # length, crc32
+
+
+class FileLogStore(MemoryLogStore):
+    """Append-only segment file with CRC framing, replayed at open.
+
+    Truncations rewrite a compacted segment (logs are small between
+    snapshots; snapshot+compact bounds replay cost the way the
+    reference's BoltDB + FileSnapshotStore pairing does).
+    """
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self._dir = path
+        os.makedirs(path, exist_ok=True)
+        self._seg_path = os.path.join(path, "log.seg")
+        self._stable_path = os.path.join(path, "stable.json")
+        if os.path.exists(self._stable_path):
+            with open(self._stable_path) as f:
+                self._stable = json.load(f)
+        self._replay()
+        self._f = open(self._seg_path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._seg_path):
+            return
+        with open(self._seg_path, "rb") as f:
+            while True:
+                hdr = f.read(_REC_HDR.size)
+                if len(hdr) < _REC_HDR.size:
+                    break
+                length, crc = _REC_HDR.unpack(hdr)
+                body = f.read(length)
+                if len(body) < length or zlib.crc32(body) != crc:
+                    break  # torn tail write — stop at last good record
+                e = LogEntry.unpack(body)
+                super().append([e])
+
+    def append(self, entries: List[LogEntry]) -> None:
+        super().append(entries)
+        for e in entries:
+            body = e.pack()
+            self._f.write(_REC_HDR.pack(len(body), zlib.crc32(body)) + body)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def _rewrite(self) -> None:
+        self._f.close()
+        tmp = self._seg_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for i in sorted(self._entries):
+                body = self._entries[i].pack()
+                f.write(_REC_HDR.pack(len(body), zlib.crc32(body)) + body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._seg_path)
+        self._f = open(self._seg_path, "ab")
+
+    def delete_from(self, index: int) -> None:
+        super().delete_from(index)
+        self._rewrite()
+
+    def delete_to(self, index: int) -> None:
+        super().delete_to(index)
+        self._rewrite()
+
+    def set_stable(self, key: str, val) -> None:
+        super().set_stable(key, val)
+        tmp = self._stable_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._stable, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._stable_path)
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,655 @@
+"""Raft consensus: leader election, replicated log, snapshots.
+
+Parity target: the reference embeds `hashicorp/raft` wired up at
+``consul/server.go:328-411`` (BoltDB log store, FileSnapshotStore
+retaining 2, `raftApply` at ``consul/rpc.go:280-297``, leadership
+watching via ``monitorLeadership`` → ``consul/leader.go:29``).  This is
+a fresh asyncio implementation of the Raft protocol (Ongaro & Ousterhout)
+— not a port: goroutine-per-connection becomes one task per follower
+replication stream plus one role loop per node, and all message handlers
+are synchronous (await-free) so each RPC is atomic under the event loop,
+which stands in for the reference's per-struct mutexes.
+
+Transport is pluggable: `MemoryTransport` wires an in-process cluster
+for the compressed-timer test tier (SURVEY.md §4); the RPC mesh provides
+the TCP transport (rpc/transport.py) the way the reference multiplexes
+Raft onto port 8300 via RaftLayer (consul/raft_rpc.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from consul_tpu.consensus.log import (
+    LOG_BARRIER, LOG_COMMAND, LOG_CONFIGURATION, LOG_NOOP, LogEntry,
+    MemoryLogStore)
+from consul_tpu.consensus.snapshot import MemorySnapshotStore
+
+import msgpack
+
+FOLLOWER = "Follower"
+CANDIDATE = "Candidate"
+LEADER = "Leader"
+SHUTDOWN = "Shutdown"
+
+
+class TransportError(Exception):
+    pass
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: Optional[str] = None) -> None:
+        super().__init__(f"node is not the leader (leader={leader})")
+        self.leader = leader
+
+
+@dataclass
+class RaftConfig:
+    """Timing knobs; the test tier compresses these the way the
+    reference's testServerConfig does (consul/server_test.go:64-69)."""
+
+    heartbeat_interval: float = 0.25
+    election_timeout_min: float = 1.0
+    election_timeout_max: float = 2.0
+    rpc_timeout: float = 1.0
+    max_append_entries: int = 64
+    snapshot_threshold: int = 8192
+    trailing_logs: int = 128
+
+
+@dataclass
+class VoteReq:
+    term: int
+    candidate: str
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclass
+class VoteResp:
+    term: int
+    granted: bool
+
+
+@dataclass
+class AppendReq:
+    term: int
+    leader: str
+    prev_log_index: int
+    prev_log_term: int
+    entries: List[LogEntry]
+    leader_commit: int
+
+
+@dataclass
+class AppendResp:
+    term: int
+    success: bool
+    match_index: int = 0
+
+
+@dataclass
+class SnapReq:
+    term: int
+    leader: str
+    last_index: int
+    last_term: int
+    peers: List[str]
+    data: bytes
+
+
+@dataclass
+class SnapResp:
+    term: int
+    success: bool
+
+
+class MemoryTransport:
+    """In-process cluster fabric with partition injection for tests."""
+
+    def __init__(self, latency: float = 0.0) -> None:
+        self._nodes: Dict[str, "RaftNode"] = {}
+        self._blocked: set[Tuple[str, str]] = set()
+        self._latency = latency
+
+    def register(self, node: "RaftNode") -> None:
+        self._nodes[node.id] = node
+
+    def partition(self, a: str, b: str) -> None:
+        self._blocked.add((a, b))
+        self._blocked.add((b, a))
+
+    def heal(self, a: str, b: str) -> None:
+        self._blocked.discard((a, b))
+        self._blocked.discard((b, a))
+
+    def isolate(self, node: str) -> None:
+        for other in self._nodes:
+            if other != node:
+                self.partition(node, other)
+
+    def rejoin(self, node: str) -> None:
+        for other in list(self._nodes):
+            self.heal(node, other)
+
+    async def call(self, src: str, dst: str, method: str, msg: Any) -> Any:
+        if (src, dst) in self._blocked or dst not in self._nodes:
+            raise TransportError(f"{src} -> {dst} unreachable")
+        if self._latency:
+            await asyncio.sleep(self._latency)
+        target = self._nodes[dst]
+        if target.role == SHUTDOWN:
+            raise TransportError(f"{dst} is down")
+        resp = target.handle(method, msg)
+        if (dst, src) in self._blocked:  # reply lost
+            raise TransportError(f"{dst} -> {src} reply dropped")
+        return resp
+
+
+class RaftNode:
+    """One Raft participant.  `fsm` needs apply(index, data) -> Any,
+    snapshot(last_index) -> bytes, restore(buf) -> int."""
+
+    def __init__(self, node_id: str, peers: List[str], fsm: Any,
+                 transport: Any, config: Optional[RaftConfig] = None,
+                 log_store: Optional[MemoryLogStore] = None,
+                 snap_store: Optional[Any] = None) -> None:
+        self.id = node_id
+        self.peers = list(peers)  # includes self
+        self.fsm = fsm
+        self.transport = transport
+        self.config = config or RaftConfig()
+        self.log = log_store if log_store is not None else MemoryLogStore()
+        self.snaps = snap_store if snap_store is not None else MemorySnapshotStore()
+
+        self.role = FOLLOWER
+        self.current_term: int = self.log.get_stable("term", 0)
+        self.voted_for: Optional[str] = self.log.get_stable("voted_for", None)
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self._snap_index = 0
+        self._snap_term = 0
+        self._snap_peers: List[str] = list(peers)
+
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._heartbeat_evt = asyncio.Event()
+        self._step_down_evt = asyncio.Event()
+        self._peer_evts: Dict[str, asyncio.Event] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._repl_tasks: List[asyncio.Task] = []
+        self._leader_obs: List[Callable[[bool], None]] = []
+        self._snapshotting = False
+
+        latest = self.snaps.latest()
+        if latest is not None:
+            meta, state = latest
+            self.fsm.restore(state)
+            self._snap_index, self._snap_term = meta.index, meta.term
+            self._snap_peers = list(meta.peers)
+            if meta.peers:
+                self.peers = list(meta.peers)
+            self.last_applied = meta.index
+            self.commit_index = meta.index
+            if self.log.first_index() and self.log.first_index() <= meta.index:
+                self.log.delete_to(meta.index)
+        # Replay any configuration entries so the peer set survives restart.
+        for i in range(self.log.first_index() or 1, self.log.last_index() + 1):
+            e = self.log.get(i)
+            if e is not None and e.type == LOG_CONFIGURATION:
+                self.peers = list(msgpack.unpackb(e.data, raw=False))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if hasattr(self.transport, "register"):
+            self.transport.register(self)
+        loop = asyncio.get_event_loop()
+        if self.peers == [self.id]:
+            # Single-node bootstrap: skip the election timeout and elect
+            # immediately (the reference's EnableSingleNode fast path).
+            self._tasks.append(loop.create_task(self._start_election()))
+        self._tasks.append(loop.create_task(self._run()))
+
+    async def shutdown(self) -> None:
+        self.role = SHUTDOWN
+        for t in self._repl_tasks + self._tasks:
+            t.cancel()
+        for t in self._repl_tasks + self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._fail_pending(NotLeaderError(None))
+        self.log.close() if hasattr(self.log, "close") else None
+
+    def on_leader_change(self, cb: Callable[[bool], None]) -> None:
+        """Register a leadership observer (monitorLeadership equivalent,
+        consul/server.go:409)."""
+        self._leader_obs.append(cb)
+
+    # -- public API --------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    def last_log_index(self) -> int:
+        return max(self.log.last_index(), self._snap_index)
+
+    def last_log_term(self) -> int:
+        last = self.log.last_index()
+        if last:
+            return self.log.get(last).term
+        return self._snap_term
+
+    async def apply(self, data: bytes, timeout: float = 30.0) -> Any:
+        """Append a command; resolves with the FSM's return once committed
+        (raft.Apply / raftApply, consul/rpc.go:280-297)."""
+        return await self._submit(LOG_COMMAND, data, timeout)
+
+    async def barrier(self, timeout: float = 30.0) -> None:
+        """Commit round-trip proving current leadership (raft.Barrier /
+        VerifyLeader, consul/rpc.go:413-417)."""
+        await self._submit(LOG_BARRIER, b"", timeout)
+
+    async def add_peer(self, peer: str, timeout: float = 30.0) -> None:
+        if peer in self.peers:
+            return
+        new = self.peers + [peer]
+        await self._submit(LOG_CONFIGURATION,
+                           msgpack.packb(new, use_bin_type=True), timeout)
+
+    async def remove_peer(self, peer: str, timeout: float = 30.0) -> None:
+        if peer not in self.peers:
+            return
+        new = [p for p in self.peers if p != peer]
+        await self._submit(LOG_CONFIGURATION,
+                           msgpack.packb(new, use_bin_type=True), timeout)
+
+    async def _submit(self, type_: int, data: bytes, timeout: float) -> Any:
+        if self.role != LEADER:
+            raise NotLeaderError(self.leader_id)
+        index = self.last_log_index() + 1
+        entry = LogEntry(index=index, term=self.current_term, type=type_, data=data)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[index] = fut
+        self.log.append([entry])
+        if type_ == LOG_CONFIGURATION:
+            self._apply_configuration(entry)
+        self._kick_replication()
+        self._maybe_advance_commit()
+        return await asyncio.wait_for(fut, timeout)
+
+    # -- role loop ---------------------------------------------------------
+
+    async def _run(self) -> None:
+        try:
+            while self.role != SHUTDOWN:
+                if self.role in (FOLLOWER, CANDIDATE):
+                    timeout = random.uniform(self.config.election_timeout_min,
+                                             self.config.election_timeout_max)
+                    self._heartbeat_evt.clear()
+                    try:
+                        await asyncio.wait_for(self._heartbeat_evt.wait(), timeout)
+                    except asyncio.TimeoutError:
+                        if self.id in self.peers:
+                            await self._start_election()
+                elif self.role == LEADER:
+                    self._step_down_evt.clear()
+                    await self._step_down_evt.wait()
+                    self._stop_leading()
+        except asyncio.CancelledError:
+            pass
+
+    async def _start_election(self) -> None:
+        self.role = CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.id
+        self._persist_term()
+        term = self.current_term
+        votes = 1  # self
+        if votes >= self._quorum():
+            self._become_leader()
+            return
+
+        async def ask(peer: str) -> bool:
+            try:
+                resp = await asyncio.wait_for(
+                    self.transport.call(self.id, peer, "request_vote",
+                                        VoteReq(term, self.id,
+                                                self.last_log_index(),
+                                                self.last_log_term())),
+                    self.config.rpc_timeout)
+            except (TransportError, asyncio.TimeoutError):
+                return False
+            if resp.term > self.current_term:
+                self._become_follower(resp.term, None)
+                return False
+            return resp.granted
+
+        results = await asyncio.gather(
+            *(ask(p) for p in self.peers if p != self.id))
+        if self.role != CANDIDATE or self.current_term != term:
+            return
+        votes += sum(results)
+        if votes >= self._quorum():
+            self._become_leader()
+
+    def _quorum(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader_id = self.id
+        last = self.last_log_index()
+        self.next_index = {p: last + 1 for p in self.peers if p != self.id}
+        self.match_index = {p: 0 for p in self.peers if p != self.id}
+        self._peer_evts = {p: asyncio.Event() for p in self.peers if p != self.id}
+        loop = asyncio.get_event_loop()
+        self._repl_tasks = [loop.create_task(self._replicate(p))
+                            for p in self.peers if p != self.id]
+        # Commit-term guard: a no-op at the new term lets prior-term
+        # entries commit (Raft §5.4.2).
+        entry = LogEntry(index=last + 1, term=self.current_term, type=LOG_NOOP)
+        self.log.append([entry])
+        self._kick_replication()
+        self._maybe_advance_commit()
+        for cb in self._leader_obs:
+            cb(True)
+
+    def _stop_leading(self) -> None:
+        for t in self._repl_tasks:
+            t.cancel()
+        self._repl_tasks = []
+        self._fail_pending(NotLeaderError(self.leader_id))
+        for cb in self._leader_obs:
+            cb(False)
+
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+        was_leader = self.role == LEADER
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_term()
+        self.role = FOLLOWER
+        if leader is not None:
+            self.leader_id = leader
+        if was_leader:
+            self._step_down_evt.set()
+
+    def _persist_term(self) -> None:
+        self.log.set_stable("term", self.current_term)
+        self.log.set_stable("voted_for", self.voted_for)
+
+    def _fail_pending(self, err: Exception) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+
+    # -- replication (leader side) ----------------------------------------
+
+    def _kick_replication(self) -> None:
+        for evt in self._peer_evts.values():
+            evt.set()
+
+    async def _replicate(self, peer: str) -> None:
+        """One follower's replication stream — the task-per-follower
+        equivalent of hashicorp/raft's replicate goroutine."""
+        cfg = self.config
+        try:
+            while self.role == LEADER:
+                try:
+                    await self._replicate_once(peer)
+                except (TransportError, asyncio.TimeoutError):
+                    await asyncio.sleep(cfg.heartbeat_interval)
+                    continue
+                evt = self._peer_evts.get(peer)
+                if evt is None:
+                    return
+                caught_up = self.next_index.get(peer, 1) > self.log.last_index()
+                if caught_up:
+                    try:
+                        await asyncio.wait_for(evt.wait(), cfg.heartbeat_interval)
+                    except asyncio.TimeoutError:
+                        pass
+                    evt.clear()
+        except asyncio.CancelledError:
+            pass
+
+    async def _replicate_once(self, peer: str) -> None:
+        ni = self.next_index.get(peer, 1)
+        first = self.log.first_index()
+        if self._snap_index and ni <= self._snap_index and (
+                not first or ni < first):
+            await self._send_snapshot(peer)
+            return
+        prev_index = ni - 1
+        prev_term = self._term_at(prev_index)
+        entries = []
+        last = self.log.last_index()
+        for i in range(ni, min(last, ni + self.config.max_append_entries - 1) + 1):
+            e = self.log.get(i)
+            if e is None:
+                break
+            entries.append(e)
+        req = AppendReq(self.current_term, self.id, prev_index, prev_term,
+                        entries, self.commit_index)
+        resp = await asyncio.wait_for(
+            self.transport.call(self.id, peer, "append_entries", req),
+            self.config.rpc_timeout)
+        if resp.term > self.current_term:
+            self._become_follower(resp.term, None)
+            return
+        if self.role != LEADER:
+            return
+        if resp.success:
+            if entries:
+                self.match_index[peer] = entries[-1].index
+                self.next_index[peer] = entries[-1].index + 1
+            self._maybe_advance_commit()
+        else:
+            # Conflict: fall back (follower hints its last index).
+            self.next_index[peer] = max(1, min(ni - 1, resp.match_index + 1))
+
+    async def _send_snapshot(self, peer: str) -> None:
+        latest = self.snaps.latest()
+        if latest is None:
+            return
+        meta, state = latest
+        req = SnapReq(self.current_term, self.id, meta.index, meta.term,
+                      meta.peers, state)
+        resp = await asyncio.wait_for(
+            self.transport.call(self.id, peer, "install_snapshot", req),
+            self.config.rpc_timeout * 4)
+        if resp.term > self.current_term:
+            self._become_follower(resp.term, None)
+            return
+        if resp.success:
+            self.match_index[peer] = meta.index
+            self.next_index[peer] = meta.index + 1
+
+    def _term_at(self, index: int) -> int:
+        if index == 0:
+            return 0
+        if index == self._snap_index:
+            return self._snap_term
+        e = self.log.get(index)
+        return e.term if e is not None else 0
+
+    def _maybe_advance_commit(self) -> None:
+        if self.role != LEADER:
+            return
+        matches = sorted([self.last_log_index()]
+                         + [self.match_index.get(p, 0)
+                            for p in self.peers if p != self.id],
+                         reverse=True)
+        n = matches[self._quorum() - 1]
+        if n > self.commit_index and self._term_at(n) == self.current_term:
+            self.commit_index = n
+            self._apply_committed()
+
+    # -- apply -------------------------------------------------------------
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            i = self.last_applied + 1
+            e = self.log.get(i)
+            if e is None:  # compacted under us — snapshot already covers it
+                self.last_applied = i
+                continue
+            result: Any = None
+            if e.type == LOG_COMMAND:
+                try:
+                    result = self.fsm.apply(e.index, e.data)
+                except Exception as exc:  # FSM errors surface to the caller
+                    result = exc
+            self.last_applied = i
+            fut = self._pending.pop(i, None)
+            if fut is not None and not fut.done():
+                if isinstance(result, Exception):
+                    fut.set_exception(result)
+                else:
+                    fut.set_result(result)
+        self._maybe_snapshot()
+
+    def _apply_configuration(self, e: LogEntry) -> None:
+        """Peer-set changes take effect as soon as they're appended
+        (Raft one-at-a-time membership change rule)."""
+        new_peers = list(msgpack.unpackb(e.data, raw=False))
+        old = set(self.peers)
+        self.peers = new_peers
+        if self.role == LEADER:
+            loop = asyncio.get_event_loop()
+            for p in new_peers:
+                if p not in old and p != self.id:
+                    self.next_index[p] = self.last_log_index() + 1
+                    self.match_index[p] = 0
+                    self._peer_evts[p] = asyncio.Event()
+                    self._repl_tasks.append(loop.create_task(self._replicate(p)))
+            if self.id not in new_peers:
+                self._become_follower(self.current_term, None)
+
+    def _maybe_snapshot(self) -> None:
+        since = self.last_applied - self._snap_index
+        if since < self.config.snapshot_threshold or self._snapshotting:
+            return
+        self.take_snapshot()
+
+    def take_snapshot(self) -> None:
+        """Snapshot the FSM at last_applied and compact the log, keeping
+        trailing_logs entries for laggards (FileSnapshotStore retain=2,
+        consul/server.go:371)."""
+        self._snapshotting = True
+        try:
+            state = self.fsm.snapshot(self.last_applied)
+            term = self._term_at(self.last_applied) or self.current_term
+            self.snaps.create(self.last_applied, term, list(self.peers), state)
+            self._snap_index = self.last_applied
+            self._snap_term = term
+            cut = self.last_applied - self.config.trailing_logs
+            if cut > 0 and self.log.first_index() and cut >= self.log.first_index():
+                self.log.delete_to(cut)
+        finally:
+            self._snapshotting = False
+
+    # -- handlers (synchronous => atomic under the event loop) -------------
+
+    def handle(self, method: str, msg: Any) -> Any:
+        if method == "request_vote":
+            return self._on_request_vote(msg)
+        if method == "append_entries":
+            return self._on_append_entries(msg)
+        if method == "install_snapshot":
+            return self._on_install_snapshot(msg)
+        raise ValueError(f"unknown raft rpc {method}")
+
+    def _on_request_vote(self, req: VoteReq) -> VoteResp:
+        if req.term < self.current_term:
+            return VoteResp(self.current_term, False)
+        if req.term > self.current_term:
+            self._become_follower(req.term, None)
+        up_to_date = (req.last_log_term, req.last_log_index) >= (
+            self.last_log_term(), self.last_log_index())
+        if up_to_date and self.voted_for in (None, req.candidate):
+            self.voted_for = req.candidate
+            self._persist_term()
+            self._heartbeat_evt.set()  # granting a vote resets the timer
+            return VoteResp(self.current_term, True)
+        return VoteResp(self.current_term, False)
+
+    def _on_append_entries(self, req: AppendReq) -> AppendResp:
+        if req.term < self.current_term:
+            return AppendResp(self.current_term, False, self.last_log_index())
+        if req.term > self.current_term or self.role != FOLLOWER:
+            self._become_follower(req.term, req.leader)
+        self.leader_id = req.leader
+        self._heartbeat_evt.set()
+
+        if req.prev_log_index > 0:
+            if req.prev_log_index > self.last_log_index():
+                return AppendResp(self.current_term, False, self.last_log_index())
+            if req.prev_log_index > self._snap_index:
+                local = self.log.get(req.prev_log_index)
+                if local is None or local.term != req.prev_log_term:
+                    return AppendResp(self.current_term, False,
+                                      max(self._snap_index,
+                                          req.prev_log_index - 1))
+
+        match = req.prev_log_index
+        for e in req.entries:
+            local = self.log.get(e.index)
+            if local is not None and local.term != e.term:
+                self.log.delete_from(e.index)
+                for i in list(self._pending):
+                    if i >= e.index:
+                        fut = self._pending.pop(i)
+                        if not fut.done():
+                            fut.set_exception(NotLeaderError(req.leader))
+                local = None
+            if local is None and e.index > self.log.last_index():
+                self.log.append([e])
+                if e.type == LOG_CONFIGURATION:
+                    self._apply_configuration(e)
+            match = e.index
+
+        if req.leader_commit > self.commit_index:
+            self.commit_index = min(req.leader_commit, self.last_log_index())
+            self._apply_committed()
+        return AppendResp(self.current_term, True, match)
+
+    def _on_install_snapshot(self, req: SnapReq) -> SnapResp:
+        if req.term < self.current_term:
+            return SnapResp(self.current_term, False)
+        self._become_follower(req.term, req.leader)
+        self._heartbeat_evt.set()
+        if req.last_index <= self._snap_index:
+            return SnapResp(self.current_term, True)
+        self.fsm.restore(req.data)
+        self.snaps.create(req.last_index, req.last_term, req.peers, req.data)
+        if self.log.first_index():
+            self.log.delete_from(self.log.first_index())
+        self._snap_index, self._snap_term = req.last_index, req.last_term
+        self.peers = list(req.peers)
+        self.commit_index = req.last_index
+        self.last_applied = req.last_index
+        return SnapResp(self.current_term, True)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, str]:
+        return {
+            "state": self.role,
+            "term": str(self.current_term),
+            "last_log_index": str(self.last_log_index()),
+            "last_log_term": str(self.last_log_term()),
+            "commit_index": str(self.commit_index),
+            "applied_index": str(self.last_applied),
+            "last_snapshot_index": str(self._snap_index),
+            "num_peers": str(len(self.peers)),
+        }

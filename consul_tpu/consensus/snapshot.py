@@ -1,0 +1,100 @@
+"""File snapshot store, retaining the newest 2 (consul/server.go:38,371).
+
+Each snapshot is a directory `snap-<term>-<index>` holding `meta.json`
+and `state.bin` (the FSM's typed record stream).  Writes go to a temp
+dir then rename — a crash never leaves a half-visible snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+RETAIN = 2
+
+
+@dataclass
+class SnapshotMeta:
+    index: int
+    term: int
+    peers: List[str]
+    size: int = 0
+
+
+class FileSnapshotStore:
+    def __init__(self, path: str, retain: int = RETAIN) -> None:
+        self._dir = path
+        self._retain = retain
+        os.makedirs(path, exist_ok=True)
+
+    def _snap_dir(self, term: int, index: int) -> str:
+        return os.path.join(self._dir, f"snap-{term:020d}-{index:020d}")
+
+    def create(self, index: int, term: int, peers: List[str], state: bytes) -> None:
+        final = self._snap_dir(term, index)
+        tmp = final + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.bin"), "wb") as f:
+            f.write(state)
+            f.flush()
+            os.fsync(f.fileno())
+        meta = SnapshotMeta(index=index, term=term, peers=peers, size=len(state))
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta.__dict__, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        self._reap()
+
+    def list(self) -> List[SnapshotMeta]:
+        """Newest first."""
+        metas = []
+        for name in sorted(os.listdir(self._dir), reverse=True):
+            if not name.startswith("snap-") or name.endswith(".tmp"):
+                continue
+            try:
+                with open(os.path.join(self._dir, name, "meta.json")) as f:
+                    metas.append(SnapshotMeta(**json.load(f)))
+            except (OSError, json.JSONDecodeError, TypeError):
+                continue
+        return metas
+
+    def latest(self) -> Optional[Tuple[SnapshotMeta, bytes]]:
+        for meta in self.list():
+            try:
+                with open(os.path.join(self._snap_dir(meta.term, meta.index),
+                                       "state.bin"), "rb") as f:
+                    return meta, f.read()
+            except OSError:
+                continue
+        return None
+
+    def _reap(self) -> None:
+        names = sorted((n for n in os.listdir(self._dir)
+                        if n.startswith("snap-") and not n.endswith(".tmp")),
+                       reverse=True)
+        for name in names[self._retain:]:
+            shutil.rmtree(os.path.join(self._dir, name), ignore_errors=True)
+
+
+class MemorySnapshotStore:
+    """Test-tier variant: same interface, no disk."""
+
+    def __init__(self) -> None:
+        self._snaps: List[Tuple[SnapshotMeta, bytes]] = []
+
+    def create(self, index: int, term: int, peers: List[str], state: bytes) -> None:
+        meta = SnapshotMeta(index=index, term=term, peers=peers, size=len(state))
+        self._snaps.insert(0, (meta, state))
+        del self._snaps[RETAIN:]
+
+    def list(self) -> List[SnapshotMeta]:
+        return [m for m, _ in self._snaps]
+
+    def latest(self) -> Optional[Tuple[SnapshotMeta, bytes]]:
+        return self._snaps[0] if self._snaps else None

@@ -1,0 +1,8 @@
+"""RPC mesh: single-port wire protocol, stream mux, conn pool, forwarding.
+
+Parity target: ``consul/rpc.go`` + ``consul/pool.go`` +
+``consul/raft_rpc.go`` — one TCP port per server, first byte selects the
+protocol (consul/rpc.go:19-27), msgpack request/response streams
+multiplexed yamux-style, pooled per-address sessions, and
+leader/cross-DC request forwarding.
+"""

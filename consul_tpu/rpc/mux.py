@@ -1,0 +1,142 @@
+"""Message-oriented stream multiplexer over one TCP connection.
+
+Parity target: the yamux/muxado session layer the reference pools RPC
+streams on (consul/pool.go:238-263, deps yamux + muxado).  Design
+departure: yamux is a byte-stream mux and the reference stacks msgpack
+framing on top; our only payloads are discrete msgpack messages, so the
+mux frames whole messages — ``[stream_id:u32][flags:u8][len:u32]`` +
+body — which removes one framing layer and any partial-read states.
+
+Client-opened streams use odd ids, server-opened even (yamux
+convention), so both sides can open without coordination.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Dict, Optional
+
+_HDR = struct.Struct("<IBI")
+
+FLAG_SYN = 0x1
+FLAG_FIN = 0x2
+FLAG_RST = 0x4
+FLAG_DATA = 0x0
+
+MAX_FRAME = 32 * 1024 * 1024
+
+
+class MuxError(Exception):
+    pass
+
+
+class Stream:
+    def __init__(self, session: "MuxSession", sid: int) -> None:
+        self.session = session
+        self.sid = sid
+        self._rx: asyncio.Queue = asyncio.Queue()
+        self.closed = False
+
+    async def send(self, payload: bytes) -> None:
+        if self.closed:
+            raise MuxError(f"stream {self.sid} closed")
+        await self.session._send_frame(self.sid, FLAG_DATA, payload)
+
+    async def recv(self) -> bytes:
+        if self.closed and self._rx.empty():
+            raise MuxError(f"stream {self.sid} closed")
+        msg = await self._rx.get()
+        if msg is None:
+            self.closed = True
+            raise MuxError(f"stream {self.sid} closed by peer")
+        return msg
+
+    async def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                await self.session._send_frame(self.sid, FLAG_FIN, b"")
+            except (MuxError, ConnectionError):
+                pass
+            self.session._streams.pop(self.sid, None)
+
+    def _push(self, payload: Optional[bytes]) -> None:
+        self._rx.put_nowait(payload)
+
+
+class MuxSession:
+    """One multiplexed connection.  `client=True` opens odd stream ids."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, client: bool) -> None:
+        self._reader = reader
+        self._writer = writer
+        self._next_sid = 1 if client else 2
+        self._streams: Dict[int, Stream] = {}
+        self._accept_q: asyncio.Queue = asyncio.Queue()
+        self._wlock = asyncio.Lock()
+        self.closed = False
+        self._pump_task = asyncio.get_event_loop().create_task(self._pump())
+
+    async def open_stream(self) -> Stream:
+        if self.closed:
+            raise MuxError("session closed")
+        sid = self._next_sid
+        self._next_sid += 2
+        st = Stream(self, sid)
+        self._streams[sid] = st
+        await self._send_frame(sid, FLAG_SYN, b"")
+        return st
+
+    async def accept_stream(self) -> Stream:
+        st = await self._accept_q.get()
+        if st is None:
+            raise MuxError("session closed")
+        return st
+
+    async def _send_frame(self, sid: int, flags: int, payload: bytes) -> None:
+        if self.closed:
+            raise MuxError("session closed")
+        async with self._wlock:
+            self._writer.write(_HDR.pack(sid, flags, len(payload)) + payload)
+            await self._writer.drain()
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                hdr = await self._reader.readexactly(_HDR.size)
+                sid, flags, length = _HDR.unpack(hdr)
+                if length > MAX_FRAME:
+                    raise MuxError(f"frame too large: {length}")
+                payload = await self._reader.readexactly(length) if length else b""
+                if flags & FLAG_SYN:
+                    st = Stream(self, sid)
+                    self._streams[sid] = st
+                    self._accept_q.put_nowait(st)
+                elif flags & (FLAG_FIN | FLAG_RST):
+                    st = self._streams.pop(sid, None)
+                    if st is not None:
+                        st._push(None)
+                else:
+                    st = self._streams.get(sid)
+                    if st is not None:
+                        st._push(payload)
+        except (asyncio.IncompleteReadError, ConnectionError, MuxError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self.closed = True
+            for st in self._streams.values():
+                st._push(None)
+            self._streams.clear()
+            self._accept_q.put_nowait(None)
+
+    async def close(self) -> None:
+        self.closed = True
+        self._pump_task.cancel()
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass

@@ -1,0 +1,144 @@
+"""Leader-only duties: establishment barrier, session TTLs, tombstone GC.
+
+Parity target: ``consul/leader.go`` (monitorLeadership/leaderLoop,
+establishLeadership at leader.go:60-140) + ``consul/session_ttl.go`` +
+the tombstone reap timer (leader.go:553-566).  The reference runs a
+goroutine per concern; here one LeaderDuties object owns asyncio timer
+handles, started when the local Raft node gains leadership and torn
+down when it loses it.  Serf→catalog reconciliation plugs in here once
+the gossip event pipeline lands (leader.go:242-339).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional
+
+from consul_tpu.structs.structs import (
+    SESSION_TTL_MULTIPLIER, Session, SessionOp, SessionRequest, MessageType,
+    TombstoneRequest)
+
+
+def _parse_ttl(s: str) -> float:
+    from consul_tpu.server.endpoints import parse_duration
+    try:
+        return parse_duration(s)
+    except (ValueError, TypeError):
+        return 0.0
+
+
+class LeaderDuties:
+    def __init__(self, server) -> None:
+        self.srv = server
+        self._session_timers: Dict[str, asyncio.TimerHandle] = {}
+        self._tombstone_task: Optional[asyncio.Task] = None
+        self._establish_task: Optional[asyncio.Task] = None
+        self._active = False
+
+    # -- leadership transitions (monitorLeadership, leader.go:29-58) -------
+
+    def on_leader_change(self, is_leader: bool) -> None:
+        if is_leader:
+            self._establish_task = asyncio.get_event_loop().create_task(
+                self._establish())
+        else:
+            self.revoke()
+
+    async def _establish(self) -> None:
+        """establishLeadership (leader.go:60-140): barrier so the local FSM
+        is caught up, then arm leader-owned timers."""
+        try:
+            await self.srv.raft.barrier()
+        except Exception:
+            return
+        if not self.srv.raft.is_leader():
+            return
+        self._active = True
+        self.srv.gc.set_enabled(True, time.monotonic())
+        self.initialize_session_timers()
+        self._tombstone_task = asyncio.get_event_loop().create_task(
+            self._tombstone_loop())
+
+    def revoke(self) -> None:
+        """revokeLeadership: drop timers; the next leader re-arms from the
+        replicated state (leader.go:139-152)."""
+        self._active = False
+        self.srv.gc.set_enabled(False, time.monotonic())
+        self.clear_all_session_timers()
+        if self._tombstone_task is not None:
+            self._tombstone_task.cancel()
+            self._tombstone_task = None
+        if self._establish_task is not None:
+            self._establish_task.cancel()
+            self._establish_task = None
+
+    # -- session TTLs (consul/session_ttl.go) ------------------------------
+
+    def initialize_session_timers(self) -> None:
+        """Re-arm a timer per TTL session after failover
+        (initializeSessionTimers, session_ttl.go:14-33)."""
+        _, sessions = self.srv.store.session_list()
+        for session in sessions:
+            if session.ttl:
+                self.reset_session_timer(session.id, session)
+
+    def reset_session_timer(self, sid: str, session: Session) -> None:
+        if not self._active:
+            return
+        ttl = _parse_ttl(session.ttl)
+        if ttl <= 0:
+            return
+        self.clear_session_timer(sid)
+        # 2x grace: lenient on the contract, covers leader failover gaps
+        # (session_ttl.go:11, SESSION_TTL_MULTIPLIER).
+        delay = ttl * SESSION_TTL_MULTIPLIER
+        loop = asyncio.get_event_loop()
+        self._session_timers[sid] = loop.call_later(
+            delay, lambda: loop.create_task(self._invalidate_session(sid)))
+
+    def clear_session_timer(self, sid: str) -> None:
+        h = self._session_timers.pop(sid, None)
+        if h is not None:
+            h.cancel()
+
+    def clear_all_session_timers(self) -> None:
+        for h in self._session_timers.values():
+            h.cancel()
+        self._session_timers.clear()
+
+    async def _invalidate_session(self, sid: str) -> None:
+        """TTL expired → destroy through Raft (invalidateSession,
+        session_ttl.go:120-146)."""
+        self._session_timers.pop(sid, None)
+        if not self._active:
+            return
+        req = SessionRequest(op=SessionOp.DESTROY.value,
+                            session=Session(id=sid))
+        try:
+            await self.srv.raft_apply(MessageType.SESSION, req)
+        except Exception:
+            pass  # lost leadership mid-destroy; next leader re-arms
+
+    def session_timer_count(self) -> int:
+        return len(self._session_timers)
+
+    # -- tombstone reaping (leader.go:553-566) -----------------------------
+
+    async def _tombstone_loop(self) -> None:
+        gran = self.srv.gc.granularity
+        try:
+            while self._active:
+                now = time.monotonic()
+                deadline = self.srv.gc.next_deadline(now)
+                sleep_for = gran / 2 if deadline is None else max(
+                    0.0, min(deadline - now, gran / 2))
+                await asyncio.sleep(sleep_for if sleep_for > 0 else gran / 10)
+                for idx in self.srv.gc.collect(time.monotonic()):
+                    try:
+                        await self.srv.raft_apply(
+                            MessageType.TOMBSTONE, TombstoneRequest(reap_index=idx))
+                    except Exception:
+                        return
+        except asyncio.CancelledError:
+            pass

@@ -1,12 +1,16 @@
-"""Server core: FSM ownership, apply path, endpoint registry.
+"""Server core: Raft-backed apply path, FSM ownership, endpoint registry.
 
-Parity target: ``consul/server.go`` + ``consul/rpc.go`` in the
-reference.  This slice implements the single-node ("bootstrap") shape:
-``raft_apply`` goes straight through the FSM with a monotonically
-increasing index, exercising the same typed-entry codec the replicated
-path uses (consul/rpc.go:280-297 encodes MessageType + msgpack body);
-the Raft engine (consensus/raft.py) slots in behind ``raft_apply``
-without endpoint changes.
+Parity target: ``consul/server.go`` + ``consul/rpc.go``.  Every write
+goes through the local Raft node (``raft_apply``, consul/rpc.go:280-297
+— encode MessageType byte + msgpack body, apply, surface FSM errors);
+reads come straight off the FSM's state store, optionally behind a
+leadership barrier (``consistent_read_barrier`` = VerifyLeader,
+consul/rpc.go:413-417).  Leadership changes arm/disarm the leader
+duties (session TTLs, tombstone GC — server/leader.py).
+
+Single-node "bootstrap" servers run a one-peer Raft cluster (instant
+election); multi-server clusters share a transport — in-process
+MemoryTransport under test, the TCP RPC mesh in production.
 """
 
 from __future__ import annotations
@@ -14,14 +18,20 @@ from __future__ import annotations
 import asyncio
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from consul_tpu.consensus.fsm import ConsulFSM
+from consul_tpu.consensus.log import FileLogStore, MemoryLogStore
+from consul_tpu.consensus.raft import (
+    MemoryTransport, NotLeaderError as RaftNotLeaderError, RaftConfig, RaftNode)
+from consul_tpu.consensus.snapshot import FileSnapshotStore, MemorySnapshotStore
+from consul_tpu.server.leader import LeaderDuties
 from consul_tpu.state.tombstone_gc import TombstoneGC
 from consul_tpu.structs import codec
 from consul_tpu.structs.structs import MessageType
 
 MAX_RAFT_ENTRY_WARN = 1024 * 1024  # 1MB soft cap (consul/rpc.go:42-44)
+ENQUEUE_LIMIT = 30.0               # max wait for the apply (rpc.go:45-50)
 
 
 @dataclass
@@ -30,6 +40,9 @@ class ServerConfig:
     datacenter: str = "dc1"
     domain: str = "consul."
     bootstrap: bool = True
+    peers: List[str] = field(default_factory=list)  # raft peer ids; [] = self only
+    data_dir: str = ""  # "" = in-memory log/snapshots (dev mode)
+    raft: RaftConfig = field(default_factory=RaftConfig)
     # Protocol timing (test configs compress these, consul/server_test.go:50-69)
     reconcile_interval: float = 60.0
     tombstone_ttl: float = 15 * 60.0
@@ -39,17 +52,31 @@ class ServerConfig:
 
 
 class Server:
-    """In-process server node.  Owns the FSM/state store and the write
-    path; endpoint objects hang off it (consul/server.go:414-431)."""
+    """One server node.  Owns the Raft node + FSM/state store and the
+    write path; endpoint objects hang off it (consul/server.go:414-431)."""
 
-    def __init__(self, config: Optional[ServerConfig] = None) -> None:
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 transport: Optional[Any] = None) -> None:
         self.config = config or ServerConfig()
         self.gc = TombstoneGC(self.config.tombstone_ttl,
                               self.config.tombstone_granularity)
         self.fsm = ConsulFSM(gc_hint=lambda idx: self.gc.hint(idx, time.monotonic()))
-        self._raft_index = 0
-        self._leader = True  # single-node bootstrap; Raft flips this later
         self.start_time = time.monotonic()
+
+        peers = self.config.peers or [self.config.node_name]
+        if self.config.data_dir:
+            import os
+            log_store = FileLogStore(os.path.join(self.config.data_dir, "raft"))
+            snap_store = FileSnapshotStore(os.path.join(self.config.data_dir, "snaps"))
+        else:
+            log_store, snap_store = MemoryLogStore(), MemorySnapshotStore()
+        self.raft = RaftNode(self.config.node_name, peers, self.fsm,
+                             transport if transport is not None else MemoryTransport(),
+                             self.config.raft, log_store=log_store,
+                             snap_store=snap_store)
+        self.leader_duties = LeaderDuties(self)
+        self.raft.on_leader_change(self.leader_duties.on_leader_change)
+
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
             Catalog, Health, Internal, KVS, SessionEndpoint, Status)
@@ -64,49 +91,61 @@ class Server:
             "KVS": self.kvs, "Session": self.session, "Internal": self.internal,
         }
 
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self.raft.start()
+
+    async def stop(self) -> None:
+        self.leader_duties.revoke()
+        await self.raft.shutdown()
+
+    async def wait_for_leader(self, timeout: float = 10.0) -> None:
+        """Poll until the cluster has a known leader (WaitForLeader,
+        testutil/wait.go:32-43)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.raft.leader_id is not None:
+                return
+            await asyncio.sleep(0.01)
+        raise TimeoutError("no leader elected")
+
     @property
     def store(self):
         return self.fsm.store
 
     def is_leader(self) -> bool:
-        return self._leader
+        return self.raft.is_leader()
 
     def leader_addr(self) -> str:
-        return self.config.node_name if self._leader else ""
+        return self.raft.leader_id or ""
 
     def raft_last_index(self) -> int:
-        return self._raft_index
+        return self.raft.last_applied
 
     async def raft_apply(self, msg_type: MessageType, req: Any) -> Any:
-        """Apply a write through the consensus path (consul/rpc.go:280-297).
-
-        Single-node: encode (same framing the wire uses), bump the index,
-        apply.  The encode/decode round-trip is deliberate — it keeps the
-        FSM honest about operating on decoded wire payloads only.
-        """
+        """Apply a write through consensus (consul/rpc.go:280-297)."""
         buf = codec.encode(int(msg_type), req)
         if len(buf) > MAX_RAFT_ENTRY_WARN:
             # Reference warns and proceeds (rpc.go:42-44).
             pass
-        if not self._leader:
-            raise NotLeaderError("Not the leader")
-        self._raft_index += 1
-        result = self.fsm.apply(self._raft_index, buf)
-        # Yield so watch waiters scheduled by notify() can run promptly.
-        await asyncio.sleep(0)
-        return result
+        try:
+            return await self.raft.apply(buf, timeout=ENQUEUE_LIMIT)
+        except RaftNotLeaderError as e:
+            raise NotLeaderError(str(e)) from e
 
     async def consistent_read_barrier(self) -> None:
-        """VerifyLeader equivalent (consul/rpc.go:413-417): single-node
-        leadership is unconditional; Raft supplies a real barrier later."""
-        if not self._leader:
-            raise NotLeaderError("Not the leader")
+        """VerifyLeader equivalent (consul/rpc.go:413-417)."""
+        try:
+            await self.raft.barrier(timeout=ENQUEUE_LIMIT)
+        except RaftNotLeaderError as e:
+            raise NotLeaderError(str(e)) from e
 
     def endpoint(self, name: str):
         return self._endpoints[name]
 
     def raft_peers(self) -> list:
-        return [self.config.node_name]
+        return list(self.raft.peers)
 
     def known_datacenters(self) -> list:
         """Sorted DC list (consul/catalog_endpoint.go:97-115); the WAN pool
@@ -125,11 +164,11 @@ class Server:
         return [n for n in nodes if acl.service_read(n.service_name)]
 
     def reset_session_timer(self, sid: str, session) -> None:
-        """Leader-owned TTL timer (consul/session_ttl.go); armed once the
-        session-TTL manager lands."""
+        """Leader-owned TTL timer (consul/session_ttl.go)."""
+        self.leader_duties.reset_session_timer(sid, session)
 
     def clear_session_timer(self, sid: str) -> None:
-        pass
+        self.leader_duties.clear_session_timer(sid)
 
     async def fire_user_event(self, event) -> None:
         """Broadcast via the gossip plane (consul/internal_endpoint.go
@@ -142,12 +181,9 @@ class Server:
                 "server": "true",
                 "leader": str(self.is_leader()).lower(),
                 "bootstrap": str(self.config.bootstrap).lower(),
+                "known_datacenters": str(len(self.known_datacenters())),
             },
-            "raft": {
-                "applied_index": str(self._raft_index),
-                "last_log_index": str(self._raft_index),
-                "state": "Leader" if self._leader else "Follower",
-            },
+            "raft": self.raft.stats(),
             "runtime": {
                 "uptime_s": str(int(time.monotonic() - self.start_time)),
             },

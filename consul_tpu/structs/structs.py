@@ -324,6 +324,37 @@ class KeyListRequest(QueryOptions):
 
 
 # ---------------------------------------------------------------------------
+# Per-domain read request envelopes (reference: consul/structs/structs.go —
+# DCSpecificRequest, NodeSpecificRequest, ServiceSpecificRequest,
+# ChecksInStateRequest, SessionSpecificRequest).  These carry the RPC mesh's
+# method arguments so reads forward across servers/DCs like writes do.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeSpecificRequest(QueryOptions):
+    node: str = ""
+
+
+@dataclass
+class ServiceSpecificRequest(QueryOptions):
+    service_name: str = ""
+    service_tag: str = ""
+    tag_filter: bool = False
+    passing_only: bool = False
+
+
+@dataclass
+class ChecksInStateRequest(QueryOptions):
+    state: str = ""
+
+
+@dataclass
+class SessionSpecificRequest(QueryOptions):
+    session: str = ""
+
+
+# ---------------------------------------------------------------------------
 # Session types (reference: consul/structs/structs.go:391-448)
 # ---------------------------------------------------------------------------
 

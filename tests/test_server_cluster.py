@@ -1,0 +1,189 @@
+"""Multi-server cluster tests: Raft-backed servers + leader duties.
+
+The reference's in-process multi-server tier (SURVEY.md §4 tier 1,
+consul/leader_test.go / session_ttl_test.go shape): N Servers share one
+transport with compressed timers; writes land on the leader, replicate
+everywhere; leader-owned timers (session TTL, tombstone GC) fire through
+Raft so every FSM converges.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from consul_tpu.consensus.raft import MemoryTransport, RaftConfig
+from consul_tpu.server.server import NotLeaderError, Server, ServerConfig
+from consul_tpu.structs.structs import (
+    DirEntry, KVSOp, KVSRequest, KeyRequest, MessageType, QueryOptions,
+    RegisterRequest, Session, SessionOp, SessionRequest)
+
+
+def fast_raft() -> RaftConfig:
+    return RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.06,
+                      election_timeout_max=0.12, rpc_timeout=0.05)
+
+
+def make_servers(n, **cfg_kw):
+    tr = MemoryTransport()
+    names = [f"s{i}" for i in range(n)]
+    servers = [Server(ServerConfig(node_name=name, peers=names,
+                                   raft=fast_raft(), **cfg_kw), transport=tr)
+               for name in names]
+    return tr, servers
+
+
+async def start_and_elect(servers):
+    for s in servers:
+        await s.start()
+    deadline = asyncio.get_event_loop().time() + 5
+    while asyncio.get_event_loop().time() < deadline:
+        leaders = [s for s in servers if s.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        await asyncio.sleep(0.01)
+    raise AssertionError("no leader")
+
+
+async def stop_all(servers):
+    for s in servers:
+        await s.stop()
+
+
+async def wait_until(pred, timeout=5.0, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def test_cluster_replicates_writes():
+    async def main():
+        _, servers = make_servers(3)
+        leader = await start_and_elect(servers)
+        await leader.kvs.apply(KVSRequest(
+            op=KVSOp.SET.value, dir_ent=DirEntry(key="foo", value=b"bar")))
+        await wait_until(
+            lambda: all(s.store.kvs_get("foo")[1] is not None
+                        and s.store.kvs_get("foo")[1].value == b"bar"
+                        for s in servers),
+            msg="KV replication")
+        # Catalog registration replicates too.
+        await leader.catalog.register(
+            RegisterRequest(node="web1", address="10.0.0.1"))
+        await wait_until(
+            lambda: all(any(n.node == "web1" for n in s.store.nodes()[1])
+                        for s in servers),
+            msg="catalog replication")
+        await stop_all(servers)
+    asyncio.run(main())
+
+
+def test_follower_write_raises_not_leader():
+    async def main():
+        _, servers = make_servers(3)
+        leader = await start_and_elect(servers)
+        follower = next(s for s in servers if s is not leader)
+        with pytest.raises(NotLeaderError):
+            await follower.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="x", value=b"y")))
+        await stop_all(servers)
+    asyncio.run(main())
+
+
+def test_session_ttl_expires_on_leader():
+    async def main():
+        _, servers = make_servers(3, session_ttl_min=0.05)
+        leader = await start_and_elect(servers)
+        await leader.catalog.register(
+            RegisterRequest(node="web1", address="10.0.0.1"))
+        sid = await leader.session.apply(SessionRequest(
+            op=SessionOp.CREATE.value,
+            session=Session(node="web1", ttl="0.1s")))
+        assert sid
+        _, got = leader.store.session_get(sid)
+        assert got is not None
+        # TTL*2 grace then destroyed through Raft on every server.
+        await wait_until(
+            lambda: all(s.store.session_get(sid)[1] is None for s in servers),
+            msg="session TTL expiry")
+        await stop_all(servers)
+    asyncio.run(main())
+
+
+def test_session_timers_rearm_on_failover():
+    async def main():
+        _, servers = make_servers(3, session_ttl_min=0.05)
+        leader = await start_and_elect(servers)
+        await leader.catalog.register(
+            RegisterRequest(node="web1", address="10.0.0.1"))
+        sid = await leader.session.apply(SessionRequest(
+            op=SessionOp.CREATE.value,
+            session=Session(node="web1", ttl="0.15s")))
+        await leader.stop()
+        rest = [s for s in servers if s is not leader]
+        new_leader = await start_and_elect(rest)
+        # New leader re-armed the timer (initializeSessionTimers) and the
+        # session still expires.
+        await wait_until(
+            lambda: all(s.store.session_get(sid)[1] is None for s in rest),
+            timeout=8.0, msg="post-failover session expiry")
+        assert new_leader.leader_duties.session_timer_count() == 0
+        await stop_all(rest)
+    asyncio.run(main())
+
+
+def test_tombstone_reap_through_raft():
+    async def main():
+        _, servers = make_servers(3, tombstone_ttl=0.1,
+                                  tombstone_granularity=0.05)
+        leader = await start_and_elect(servers)
+        await leader.kvs.apply(KVSRequest(
+            op=KVSOp.SET.value, dir_ent=DirEntry(key="doomed", value=b"v")))
+        await leader.kvs.apply(KVSRequest(
+            op=KVSOp.DELETE.value, dir_ent=DirEntry(key="doomed")))
+        assert len(leader.store._tombstones) == 1
+        await wait_until(
+            lambda: all(len(s.store._tombstones) == 0 for s in servers),
+            msg="tombstone reap replicated")
+        await stop_all(servers)
+    asyncio.run(main())
+
+
+def test_consistent_read_barrier_on_leader_only():
+    async def main():
+        _, servers = make_servers(3)
+        leader = await start_and_elect(servers)
+        await leader.consistent_read_barrier()
+        follower = next(s for s in servers if s is not leader)
+        with pytest.raises(NotLeaderError):
+            await follower.consistent_read_barrier()
+        await stop_all(servers)
+    asyncio.run(main())
+
+
+def test_blocking_query_wakes_on_replicated_write():
+    async def main():
+        _, servers = make_servers(3)
+        leader = await start_and_elect(servers)
+        follower = next(s for s in servers if s is not leader)
+        idx, _ = follower.store.kvs_get("watched")
+
+        async def writer():
+            await asyncio.sleep(0.05)
+            await leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value,
+                dir_ent=DirEntry(key="watched", value=b"now")))
+
+        w = asyncio.get_event_loop().create_task(writer())
+        # Blocking read against the FOLLOWER's store wakes when the write
+        # replicates through its FSM.
+        meta, out = await follower.kvs.get(KeyRequest(
+            key="watched", min_query_index=max(idx, 1), max_query_time=3.0))
+        await w
+        assert out and out[0].value == b"now"
+        await stop_all(servers)
+    asyncio.run(main())
