@@ -1,0 +1,17 @@
+"""ACL engine: policy language, evaluator, cache.
+
+Parity target: the reference's ``acl/`` package (policy.go, acl.go,
+cache.go) plus the server-side resolution in ``consul/acl.go``.
+"""
+
+from consul_tpu.acl.policy import Policy, KeyPolicy, ServicePolicy, parse_policy
+from consul_tpu.acl.acl import (
+    ACLEval, StaticACL, PolicyACL, allow_all, deny_all, manage_all, root_acl)
+from consul_tpu.acl.cache import ACLCache
+
+__all__ = [
+    "Policy", "KeyPolicy", "ServicePolicy", "parse_policy",
+    "ACLEval", "StaticACL", "PolicyACL",
+    "allow_all", "deny_all", "manage_all", "root_acl",
+    "ACLCache",
+]

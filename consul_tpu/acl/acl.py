@@ -1,0 +1,149 @@
+"""ACL evaluators: static roots + radix longest-prefix policy ACLs.
+
+Parity target: ``acl/acl.go`` (37-127 static roots and rule layout,
+129+ PolicyACL evaluation).  An evaluator answers the seven questions
+the reference interface defines: KeyRead/KeyWrite/KeyWritePrefix,
+ServiceRead/ServiceWrite, ACLList/ACLModify.
+"""
+
+from __future__ import annotations
+
+from consul_tpu.acl.policy import (
+    POLICY_READ, POLICY_WRITE, Policy, parse_policy)
+from consul_tpu.state.radix import RadixTree
+
+
+class ACLEval:
+    """Interface (acl/acl.go:23-35)."""
+
+    def key_read(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def key_write(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def key_write_prefix(self, prefix: str) -> bool:
+        raise NotImplementedError
+
+    def service_read(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def service_write(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def acl_list(self) -> bool:
+        raise NotImplementedError
+
+    def acl_modify(self) -> bool:
+        raise NotImplementedError
+
+
+class StaticACL(ACLEval):
+    """allow-all / deny-all / manage-all roots (acl/acl.go:37-107)."""
+
+    def __init__(self, default_allow: bool, manage: bool = False) -> None:
+        self._allow = default_allow
+        self._manage = manage
+
+    def key_read(self, key: str) -> bool:
+        return self._allow
+
+    def key_write(self, key: str) -> bool:
+        return self._allow
+
+    def key_write_prefix(self, prefix: str) -> bool:
+        return self._allow
+
+    def service_read(self, name: str) -> bool:
+        return self._allow
+
+    def service_write(self, name: str) -> bool:
+        return self._allow
+
+    def acl_list(self) -> bool:
+        return self._manage
+
+    def acl_modify(self) -> bool:
+        return self._manage
+
+
+_ALLOW_ALL = StaticACL(True)
+_DENY_ALL = StaticACL(False)
+_MANAGE_ALL = StaticACL(True, manage=True)
+
+
+def allow_all() -> StaticACL:
+    return _ALLOW_ALL
+
+
+def deny_all() -> StaticACL:
+    return _DENY_ALL
+
+
+def manage_all() -> StaticACL:
+    return _MANAGE_ALL
+
+
+def root_acl(name: str):
+    """RootACL (acl/acl.go:109-120): 'allow' | 'deny' | 'manage' or None."""
+    return {"allow": _ALLOW_ALL, "deny": _DENY_ALL, "manage": _MANAGE_ALL}.get(name)
+
+
+class PolicyACL(ACLEval):
+    """Rule-set evaluation by longest-prefix radix match, falling back to a
+    parent evaluator (acl/acl.go:122-229)."""
+
+    def __init__(self, parent: ACLEval, policy: Policy) -> None:
+        self.parent = parent
+        self._key_rules = RadixTree()
+        self._service_rules = RadixTree()
+        for kp in policy.keys:
+            self._key_rules.insert(kp.prefix, kp.policy)
+        for sp in policy.services:
+            self._service_rules.insert(sp.name, sp.policy)
+
+    @classmethod
+    def from_rules(cls, parent: ACLEval, rules: str) -> "PolicyACL":
+        return cls(parent, parse_policy(rules))
+
+    def key_read(self, key: str) -> bool:
+        hit = self._key_rules.longest_prefix(key)
+        if hit is not None:
+            return hit[1] in (POLICY_READ, POLICY_WRITE)
+        return self.parent.key_read(key)
+
+    def key_write(self, key: str) -> bool:
+        hit = self._key_rules.longest_prefix(key)
+        if hit is not None:
+            return hit[1] == POLICY_WRITE
+        return self.parent.key_write(key)
+
+    def key_write_prefix(self, prefix: str) -> bool:
+        """Write to an entire subtree (DeleteTree): no rule under the prefix
+        may be non-write, and the governing rule at the prefix must allow
+        write (acl/acl.go:188-211)."""
+        for _, disp in self._key_rules.walk_prefix(prefix):
+            if disp != POLICY_WRITE:
+                return False
+        hit = self._key_rules.longest_prefix(prefix)
+        if hit is not None:
+            return hit[1] == POLICY_WRITE
+        return self.parent.key_write_prefix(prefix)
+
+    def service_read(self, name: str) -> bool:
+        hit = self._service_rules.longest_prefix(name)
+        if hit is not None:
+            return hit[1] in (POLICY_READ, POLICY_WRITE)
+        return self.parent.service_read(name)
+
+    def service_write(self, name: str) -> bool:
+        hit = self._service_rules.longest_prefix(name)
+        if hit is not None:
+            return hit[1] == POLICY_WRITE
+        return self.parent.service_write(name)
+
+    def acl_list(self) -> bool:
+        return self.parent.acl_list()
+
+    def acl_modify(self) -> bool:
+        return self.parent.acl_modify()

@@ -1,0 +1,126 @@
+"""ACL compilation cache.
+
+Parity target: ``acl/cache.go`` (164 LoC) — three LRU layers so hot
+tokens never re-parse rules:
+
+- policy cache: rules-hash -> parsed Policy
+- evaluator cache: (parent, rules-hash) -> compiled PolicyACL
+- id cache: token id -> (evaluator, cached-at), backfilled by a fault
+  function when missing (the FaultFunc contract, acl/cache.go:20-28)
+
+The fault function returns ``(parent_name, rules)`` for a token id —
+served locally in the ACL datacenter, fetched over RPC elsewhere
+(consul/acl.go:70-148 wires both).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from collections import OrderedDict
+from typing import Awaitable, Callable, Optional, Tuple
+
+from consul_tpu.acl.acl import ACLEval, PolicyACL, root_acl
+from consul_tpu.acl.policy import Policy, parse_policy
+
+FaultFunc = Callable[[str], Awaitable[Tuple[str, str]]]
+
+
+class ACLNotFound(Exception):
+    """Token id does not exist (reference: errACLNotFound 'ACL not found')."""
+
+
+class _LRU:
+    def __init__(self, size: int) -> None:
+        self._size = size
+        self._d: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        if key not in self._d:
+            return None
+        self._d.move_to_end(key)
+        return self._d[key]
+
+    def put(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self._size:
+            self._d.popitem(last=False)
+
+    def delete(self, key) -> None:
+        self._d.pop(key, None)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+
+def _rules_hash(parent: str, rules: str) -> str:
+    return hashlib.md5((parent + "\x00" + rules).encode()).hexdigest()
+
+
+class CachedACL:
+    __slots__ = ("acl", "expires", "etag")
+
+    def __init__(self, acl: ACLEval, expires: float, etag: str) -> None:
+        self.acl = acl
+        self.expires = expires
+        self.etag = etag
+
+
+class ACLCache:
+    def __init__(self, fault_fn: FaultFunc, ttl: float = 30.0,
+                 size: int = 256) -> None:
+        self._fault = fault_fn
+        self._ttl = ttl
+        self._policies = _LRU(size)
+        self._evals = _LRU(size)
+        self._ids = _LRU(size)
+
+    def get_policy(self, rules: str) -> Policy:
+        h = hashlib.md5(rules.encode()).hexdigest()
+        pol = self._policies.get(h)
+        if pol is None:
+            pol = parse_policy(rules)
+            self._policies.put(h, pol)
+        return pol
+
+    def compile(self, parent_name: str, rules: str) -> ACLEval:
+        """parent + rules -> evaluator, via both content caches."""
+        h = _rules_hash(parent_name, rules)
+        ev = self._evals.get(h)
+        if ev is None:
+            parent = root_acl(parent_name) or root_acl("deny")
+            ev = PolicyACL(parent, self.get_policy(rules))
+            self._evals.put(h, ev)
+        return ev
+
+    async def get_acl(self, token_id: str, now: Optional[float] = None) -> ACLEval:
+        """Resolve a token id, faulting on miss/expiry.  Raises ACLNotFound
+        if the fault function does."""
+        now = time.monotonic() if now is None else now
+        hit: Optional[CachedACL] = self._ids.get(token_id)
+        # ttl <= 0 disables caching entirely (every resolve re-faults),
+        # matching the reference where a zero TTL expires immediately.
+        if hit is not None and self._ttl > 0 and now < hit.expires:
+            return hit.acl
+        parent_name, rules = await self._fault(token_id)
+        acl = self.compile(parent_name, rules)
+        self._ids.put(token_id, CachedACL(
+            acl, now + self._ttl, _rules_hash(parent_name, rules)))
+        return acl
+
+    def get_cached(self, token_id: str) -> Optional[CachedACL]:
+        """The raw cache entry, expired or not — feeds the down-policy
+        'extend-cache' path (consul/acl.go:123-130)."""
+        return self._ids.get(token_id)
+
+    def put_cached(self, token_id: str, acl: ACLEval, etag: str,
+                   now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._ids.put(token_id, CachedACL(acl, now + self._ttl, etag))
+
+    def invalidate(self, token_id: str) -> None:
+        self._ids.delete(token_id)
+
+    def clear(self) -> None:
+        self._ids.clear()

@@ -1,0 +1,187 @@
+"""ACL policy language: HCL-subset + JSON rule documents.
+
+Parity target: ``acl/policy.go`` in the reference (9-46 for the types,
+49+ for hcl.Decode).  Rules look like::
+
+    key "" {
+      policy = "read"
+    }
+    key "foo/" {
+      policy = "write"
+    }
+    service "web" {
+      policy = "deny"
+    }
+
+The reference parses these with the full HCL library; the grammar the
+ACL system actually uses is the tiny block subset above, so we ship a
+self-contained tokenizer/parser for it (plus the JSON object form HCL
+also accepts) rather than a generic HCL engine.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List
+
+POLICY_DENY = "deny"
+POLICY_READ = "read"
+POLICY_WRITE = "write"
+_VALID = (POLICY_DENY, POLICY_READ, POLICY_WRITE)
+
+
+@dataclass
+class KeyPolicy:
+    prefix: str = ""
+    policy: str = POLICY_READ
+
+
+@dataclass
+class ServicePolicy:
+    name: str = ""
+    policy: str = POLICY_READ
+
+
+@dataclass
+class Policy:
+    id: str = ""
+    keys: List[KeyPolicy] = field(default_factory=list)
+    services: List[ServicePolicy] = field(default_factory=list)
+
+
+class PolicyError(ValueError):
+    pass
+
+
+# -- tokenizer --------------------------------------------------------------
+
+_PUNCT = {"{", "}", "=", ","}
+
+
+def _tokenize(src: str) -> List[str]:
+    toks: List[str] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            i += 1
+        elif c == "#" or src.startswith("//", i):
+            while i < n and src[i] != "\n":
+                i += 1
+        elif src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            if j < 0:
+                raise PolicyError("unterminated block comment")
+            i = j + 2
+        elif c == '"':
+            j = i + 1
+            buf = []
+            while j < n and src[j] != '"':
+                if src[j] == "\\" and j + 1 < n:
+                    esc = src[j + 1]
+                    buf.append({"n": "\n", "t": "\t", '"': '"', "\\": "\\"}.get(esc, esc))
+                    j += 2
+                else:
+                    buf.append(src[j])
+                    j += 1
+            if j >= n:
+                raise PolicyError("unterminated string")
+            toks.append('"' + "".join(buf))  # leading quote marks string tokens
+            i = j + 1
+        elif c in _PUNCT:
+            toks.append(c)
+            i += 1
+        else:
+            j = i
+            while j < n and (src[j].isalnum() or src[j] in "_-./"):
+                j += 1
+            if j == i:
+                raise PolicyError(f"unexpected character {c!r}")
+            toks.append(src[i:j])
+            i = j
+    return toks
+
+
+def _parse_hcl(src: str) -> Policy:
+    toks = _tokenize(src)
+    pol = Policy()
+    i = 0
+
+    def expect(tok: str) -> None:
+        nonlocal i
+        if i >= len(toks) or toks[i] != tok:
+            got = toks[i] if i < len(toks) else "<eof>"
+            raise PolicyError(f"expected {tok!r}, got {got!r}")
+        i += 1
+
+    def string() -> str:
+        nonlocal i
+        if i >= len(toks) or not toks[i].startswith('"'):
+            got = toks[i] if i < len(toks) else "<eof>"
+            raise PolicyError(f"expected string, got {got!r}")
+        s = toks[i][1:]
+        i += 1
+        return s
+
+    while i < len(toks):
+        kind = toks[i]
+        i += 1
+        if kind not in ("key", "service"):
+            raise PolicyError(f"unknown block type {kind!r}")
+        name = string()
+        expect("{")
+        attrs = {}
+        while i < len(toks) and toks[i] != "}":
+            attr = toks[i]
+            i += 1
+            expect("=")
+            attrs[attr] = string()
+        expect("}")
+        if set(attrs) - {"policy"}:
+            raise PolicyError(f"unknown attributes {sorted(set(attrs) - {'policy'})}")
+        disp = attrs.get("policy", POLICY_READ)
+        if kind == "key":
+            pol.keys.append(KeyPolicy(prefix=name, policy=disp))
+        else:
+            pol.services.append(ServicePolicy(name=name, policy=disp))
+    return pol
+
+
+def _parse_json(obj: dict) -> Policy:
+    pol = Policy()
+    for kind, target in (("key", pol.keys), ("service", pol.services)):
+        block = obj.get(kind) or {}
+        if not isinstance(block, dict):
+            raise PolicyError(f"{kind!r} must be an object")
+        for name, attrs in block.items():
+            disp = (attrs or {}).get("policy", POLICY_READ)
+            if kind == "key":
+                target.append(KeyPolicy(prefix=name, policy=disp))
+            else:
+                target.append(ServicePolicy(name=name, policy=disp))
+    return pol
+
+
+def parse_policy(rules: str) -> Policy:
+    """Parse + validate a rule document (acl/policy.go:49+).  Accepts the
+    HCL block form or a JSON object; empty rules yield an empty policy."""
+    rules = rules or ""
+    stripped = rules.strip()
+    if not stripped:
+        return Policy()
+    if stripped.startswith("{"):
+        try:
+            obj = json.loads(stripped)
+        except json.JSONDecodeError as e:
+            raise PolicyError(f"invalid JSON policy: {e}") from e
+        pol = _parse_json(obj)
+    else:
+        pol = _parse_hcl(rules)
+    for kp in pol.keys:
+        if kp.policy not in _VALID:
+            raise PolicyError(f"invalid key policy: {kp.policy!r}")
+    for sp in pol.services:
+        if sp.policy not in _VALID:
+            raise PolicyError(f"invalid service policy: {sp.policy!r}")
+    return pol

@@ -46,6 +46,13 @@ class AgentConfig:
     dns_only_passing: bool = False
     node_ttl: float = 0.0
     service_ttl: float = 0.0
+    # ACL passthrough (command/agent/config.go ACL* fields)
+    acl_datacenter: str = ""
+    acl_ttl: float = 30.0
+    acl_default_policy: str = "allow"
+    acl_down_policy: str = "extend-cache"
+    acl_master_token: str = ""
+    acl_token: str = ""  # agent's own default token
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -59,6 +66,11 @@ class Agent:
             datacenter=self.config.datacenter,
             domain=self.config.domain,
             bootstrap=self.config.bootstrap,
+            acl_datacenter=self.config.acl_datacenter,
+            acl_ttl=self.config.acl_ttl,
+            acl_default_policy=self.config.acl_default_policy,
+            acl_down_policy=self.config.acl_down_policy,
+            acl_master_token=self.config.acl_master_token,
         ))
         self.http = HTTPServer(self)
         self.dns = DNSServer(self, domain=self.config.domain,

@@ -130,6 +130,13 @@ class HTTPServer:
         r.add_get("/v1/session/node/{node}", h(self._session_node))
         r.add_get("/v1/session/list", h(self._session_list))
 
+        r.add_put("/v1/acl/create", h(self._acl_create))
+        r.add_put("/v1/acl/update", h(self._acl_update))
+        r.add_put("/v1/acl/destroy/{id}", h(self._acl_destroy))
+        r.add_get("/v1/acl/info/{id}", h(self._acl_info))
+        r.add_put("/v1/acl/clone/{id}", h(self._acl_clone))
+        r.add_get("/v1/acl/list", h(self._acl_list))
+
         r.add_get("/v1/internal/ui/nodes", h(self._ui_nodes))
         r.add_get("/v1/internal/ui/node/{node}", h(self._ui_node_info))
         r.add_get("/v1/internal/ui/services", h(self._ui_services))
@@ -171,11 +178,16 @@ class HTTPServer:
         resp.headers["X-Consul-KnownLeader"] = "true" if meta.known_leader else "false"
         resp.headers["X-Consul-LastContact"] = str(int(meta.last_contact * 1000))
 
+    def _token(self, request: web.Request) -> str:
+        """?token with fallback to the agent's configured default token
+        (http.go parseToken: explicit > agent ACLToken)."""
+        return request.query.get("token", "") or self.agent.config.acl_token
+
     def _query_opts(self, request: web.Request) -> QueryOptions:
         """parseWait + parseConsistency + dc/token (http.go:411-485)."""
         q = request.query
         opts = QueryOptions(
-            token=q.get("token", ""),
+            token=self._token(request),
             datacenter=q.get("dc", ""),
         )
         if "index" in q:
@@ -220,7 +232,7 @@ class HTTPServer:
         args = RegisterRequest(
             node=body.get("Node", ""), address=body.get("Address", ""),
             datacenter=body.get("Datacenter", ""),
-            token=request.query.get("token", ""))
+            token=self._token(request))
         if body.get("Service"):
             s = body["Service"]
             args.service = NodeService(
@@ -358,7 +370,7 @@ class HTTPServer:
         elif "release" in q:
             d.session = q["release"]
             op = KVSOp.UNLOCK.value
-        args = KVSRequest(op=op, dir_ent=d, token=q.get("token", ""))
+        args = KVSRequest(op=op, dir_ent=d, token=self._token(request))
         return await self.srv.kvs.apply(args)
 
     async def _kvs_delete(self, request, key: str):
@@ -370,7 +382,7 @@ class HTTPServer:
         elif "cas" in q:
             d.modify_index = int(q["cas"])
             op = KVSOp.DELETE_CAS.value
-        args = KVSRequest(op=op, dir_ent=d, token=q.get("token", ""))
+        args = KVSRequest(op=op, dir_ent=d, token=self._token(request))
         return await self.srv.kvs.apply(args)
 
     # -- sessions -----------------------------------------------------------
@@ -390,7 +402,7 @@ class HTTPServer:
         if "LockDelay" in body:
             session.lock_delay = _parse_lock_delay(body["LockDelay"])
         args = SessionRequest(op=SessionOp.CREATE.value, session=session,
-                              token=request.query.get("token", ""))
+                              token=self._token(request))
         sid = await self.srv.session.apply(args)
         return {"ID": sid}
 
@@ -422,6 +434,60 @@ class HTTPServer:
         opts = self._query_opts(request)
         meta, sessions = await self.srv.session.list(opts)
         return self._json(request, [session_to_api(s) for s in sessions], meta)
+
+    # -- ACL ----------------------------------------------------------------
+    # command/agent/acl_endpoint.go (197 LoC)
+
+    async def _acl_write(self, request, update: bool):
+        from consul_tpu.structs.structs import (
+            ACL, ACL_TYPE_CLIENT, ACLOp, ACLRequest)
+        body = await self._body_json(request)
+        acl = ACL(id=body.get("ID", ""), name=body.get("Name", ""),
+                  type=body.get("Type") or ACL_TYPE_CLIENT,
+                  rules=body.get("Rules", ""))
+        if update and not acl.id:
+            raise EndpointError("ACL ID must be set")
+        args = ACLRequest(op=ACLOp.SET.value, acl=acl,
+                          token=self._token(request))
+        aid = await self.srv.acl.apply(args)
+        return {"ID": aid}
+
+    async def _acl_create(self, request):
+        return await self._acl_write(request, update=False)
+
+    async def _acl_update(self, request):
+        return await self._acl_write(request, update=True)
+
+    async def _acl_destroy(self, request):
+        from consul_tpu.structs.structs import ACL, ACLOp, ACLRequest
+        args = ACLRequest(op=ACLOp.DELETE.value,
+                          acl=ACL(id=request.match_info["id"]),
+                          token=self._token(request))
+        await self.srv.acl.apply(args)
+        return True
+
+    async def _acl_info(self, request):
+        opts = self._query_opts(request)
+        meta, out = await self.srv.acl.get(request.match_info["id"], opts)
+        return self._json(request, to_api(out), meta)
+
+    async def _acl_clone(self, request):
+        from consul_tpu.structs.structs import ACL, ACLOp, ACLRequest
+        opts = self._query_opts(request)
+        _, out = await self.srv.acl.get(request.match_info["id"], opts)
+        if not out:
+            raise NotFound("ACL not found")
+        src = out[0]
+        args = ACLRequest(op=ACLOp.SET.value,
+                          acl=ACL(name=src.name, type=src.type, rules=src.rules),
+                          token=opts.token)
+        aid = await self.srv.acl.apply(args)
+        return {"ID": aid}
+
+    async def _acl_list(self, request):
+        opts = self._query_opts(request)
+        meta, acls = await self.srv.acl.list(opts)
+        return self._json(request, to_api(acls), meta)
 
     # -- internal UI --------------------------------------------------------
 

@@ -1,0 +1,169 @@
+"""Server-side ACL resolution, replication caching, and result filtering.
+
+Parity target: ``consul/acl.go`` (367 LoC) + ``consul/filter.go`` (70).
+
+Resolution path (consul/acl.go:70-148):
+- ACLs disabled (no ``acl_datacenter`` configured) -> None (no checks).
+- empty token -> the anonymous token; master token short-circuits to
+  manage (in the auth DC).
+- in the ACL datacenter the fault function reads the local state store;
+- other DCs RPC ``ACL.GetPolicy`` to the auth DC with ETag + TTL
+  caching, and on RPC failure apply ``acl_down_policy``
+  (allow / deny / extend-cache).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from consul_tpu.acl.acl import ACLEval, manage_all, root_acl
+from consul_tpu.acl.cache import ACLCache, ACLNotFound
+from consul_tpu.structs.structs import (
+    ACL_ANONYMOUS_ID, ACL_TYPE_MANAGEMENT, ACLPolicyReply)
+
+
+class PermissionDenied(PermissionError):
+    def __init__(self, msg: str = "Permission denied") -> None:
+        super().__init__(msg)
+
+
+class ServerACLResolver:
+    """Owned by Server; answers resolve_token for every endpoint."""
+
+    def __init__(self, server) -> None:
+        self.srv = server
+        cfg = server.config
+        self.enabled = bool(cfg.acl_datacenter)
+        self.is_auth_dc = cfg.acl_datacenter == cfg.datacenter
+        self.cache = ACLCache(self._fault, ttl=cfg.acl_ttl)
+
+    # -- fault path --------------------------------------------------------
+
+    async def _fault(self, token_id: str):
+        """FaultFunc: (parent, rules) for a token id.  Auth DC serves the
+        state store (consul/acl.go:150-172); other DCs fetch the policy
+        from the auth DC."""
+        if self.is_auth_dc:
+            _, acl = self.srv.store.acl_get(token_id)
+            if acl is None:
+                raise ACLNotFound("ACL not found")
+            parent = ("manage" if acl.type == ACL_TYPE_MANAGEMENT
+                      else self.srv.config.acl_default_policy)
+            return parent, acl.rules
+        reply = await self._remote_policy(token_id, etag="")
+        if reply is None:
+            raise ACLNotFound("ACL not found")
+        return reply.parent, (reply.policy or {}).get("rules", "")
+
+    async def _remote_policy(self, token_id: str,
+                             etag: str) -> Optional[ACLPolicyReply]:
+        """RPC ACL.GetPolicy to the auth DC (consul/acl.go:104-121).
+        Raises on transport failure so the down-policy can apply."""
+        return await self.srv.rpc_get_remote_acl_policy(token_id, etag)
+
+    # -- resolution --------------------------------------------------------
+
+    async def resolve(self, token: str) -> Optional[ACLEval]:
+        if not self.enabled:
+            return None
+        token = token or ACL_ANONYMOUS_ID
+        cfg = self.srv.config
+        if cfg.acl_master_token and token == cfg.acl_master_token:
+            return manage_all()
+        try:
+            return await self.cache.get_acl(token)
+        except ACLNotFound:
+            raise PermissionDenied("ACL not found")
+        except (ConnectionError, TimeoutError, OSError):
+            # Only transport failures to the auth DC trigger the
+            # down-policy (consul/acl.go:123-139); local faults (e.g. a
+            # token whose stored rules no longer parse) must NOT fail
+            # open under down-policy=allow — deny-by-error instead.
+            down = cfg.acl_down_policy
+            if down == "extend-cache":
+                hit = self.cache.get_cached(token)
+                if hit is not None:
+                    return hit.acl
+                down = "deny"
+            return root_acl("allow" if down == "allow" else "deny")
+        except Exception as e:
+            raise PermissionDenied(f"ACL resolution failed: {e}")
+
+    # -- serving GetPolicy to other DCs (consul/acl_endpoint.go:141+) ------
+
+    def policy_reply(self, token_id: str, etag: str) -> Optional[ACLPolicyReply]:
+        _, acl = self.srv.store.acl_get(token_id)
+        if acl is None:
+            return None
+        import hashlib
+        new_etag = hashlib.md5(acl.rules.encode()).hexdigest()
+        parent = ("manage" if acl.type == ACL_TYPE_MANAGEMENT
+                  else self.srv.config.acl_default_policy)
+        reply = ACLPolicyReply(etag=new_etag, ttl=self.srv.config.acl_ttl,
+                               parent=parent)
+        if new_etag != etag:
+            reply.policy = {"rules": acl.rules}
+        return reply
+
+
+# -- result filtering (consul/acl.go:199-367 + consul/filter.go) ------------
+
+
+def filter_dir_entries(acl: Optional[ACLEval], entries: List) -> List:
+    if acl is None:
+        return entries
+    return [e for e in entries if acl.key_read(e.key)]
+
+
+def filter_keys(acl: Optional[ACLEval], keys: List[str]) -> List[str]:
+    if acl is None:
+        return keys
+    return [k for k in keys if acl.key_read(k)]
+
+
+def filter_service_nodes(acl: Optional[ACLEval], nodes: List) -> List:
+    if acl is None:
+        return nodes
+    return [n for n in nodes if acl.service_read(n.service_name)]
+
+
+def filter_health_checks(acl: Optional[ACLEval], checks: List) -> List:
+    if acl is None:
+        return checks
+    return [c for c in checks
+            if not c.service_name or acl.service_read(c.service_name)]
+
+
+def filter_check_service_nodes(acl: Optional[ACLEval], csns: List) -> List:
+    if acl is None:
+        return csns
+    return [c for c in csns if acl.service_read(c.service.service)]
+
+
+def filter_node_services(acl: Optional[ACLEval], services):
+    """Compact a node's {service_id: NodeService} map (consul/acl.go:288-301)."""
+    if acl is None or services is None:
+        return services
+    return {sid: svc for sid, svc in services.items()
+            if acl.service_read(svc.service)}
+
+
+def filter_node_dump(acl: Optional[ACLEval], dump: List) -> List:
+    """Filter the NodeInfo/NodeDump rows served to the UI
+    (consul/acl.go:303-324): drop denied services and their checks."""
+    if acl is None:
+        return dump
+    out = []
+    for row in dump:
+        services = [s for s in row["services"] if acl.service_read(s.service)]
+        checks = filter_health_checks(acl, row["checks"])
+        out.append({**row, "services": services, "checks": checks})
+    return out
+
+
+def filter_services_map(acl: Optional[ACLEval], services: dict) -> dict:
+    if acl is None:
+        return services
+    return {name: tags for name, tags in services.items()
+            if acl.service_read(name)}

@@ -147,12 +147,14 @@ class Catalog(_Endpoint):
 
     async def list_services(self, opts: QueryOptions) -> tuple:
         meta, out = QueryMeta(), {}
+        acl = await self.srv.resolve_token(opts.token)
 
         async def run():
+            from consul_tpu.server.acl import filter_services_map
             idx, services = self.srv.store.services()
             meta.index = idx
             out.clear()
-            out.update(services)
+            out.update(filter_services_map(acl, services))
 
         await self._blocking(opts, meta, run, tables=self.srv.store.query_tables("Services"))
         return meta, out
@@ -177,10 +179,13 @@ class Catalog(_Endpoint):
         meta = QueryMeta()
         holder: List[Any] = [None]
 
+        acl = await self.srv.resolve_token(opts.token)
+
         async def run():
+            from consul_tpu.server.acl import filter_node_services
             idx, services = self.srv.store.node_services(node)
             meta.index = idx
-            holder[0] = services
+            holder[0] = filter_node_services(acl, services)
 
         await self._blocking(opts, meta, run,
                              tables=self.srv.store.query_tables("NodeServices"))
@@ -194,11 +199,13 @@ class Health(_Endpoint):
         if state not in (HEALTH_ANY,) + VALID_HEALTH_STATES:
             raise EndpointError(f"Invalid state: '{state}'")
         meta, out = QueryMeta(), []
+        acl = await self.srv.resolve_token(opts.token)
 
         async def run():
+            from consul_tpu.server.acl import filter_health_checks
             idx, checks = self.srv.store.checks_in_state(state)
             meta.index = idx
-            out[:] = checks
+            out[:] = filter_health_checks(acl, checks)
 
         await self._blocking(opts, meta, run,
                              tables=self.srv.store.query_tables("ChecksInState"))
@@ -207,10 +214,13 @@ class Health(_Endpoint):
     async def node_checks(self, node: str, opts: QueryOptions) -> tuple:
         meta, out = QueryMeta(), []
 
+        acl = await self.srv.resolve_token(opts.token)
+
         async def run():
+            from consul_tpu.server.acl import filter_health_checks
             idx, checks = self.srv.store.node_checks(node)
             meta.index = idx
-            out[:] = checks
+            out[:] = filter_health_checks(acl, checks)
 
         await self._blocking(opts, meta, run,
                              tables=self.srv.store.query_tables("NodeChecks"))
@@ -219,10 +229,13 @@ class Health(_Endpoint):
     async def service_checks(self, service: str, opts: QueryOptions) -> tuple:
         meta, out = QueryMeta(), []
 
+        acl = await self.srv.resolve_token(opts.token)
+
         async def run():
+            from consul_tpu.server.acl import filter_health_checks
             idx, checks = self.srv.store.service_checks(service)
             meta.index = idx
-            out[:] = checks
+            out[:] = filter_health_checks(acl, checks)
 
         await self._blocking(opts, meta, run,
                              tables=self.srv.store.query_tables("ServiceChecks"))
@@ -235,9 +248,12 @@ class Health(_Endpoint):
         if not service:
             raise EndpointError("Must provide service name")
         meta, out = QueryMeta(), []
+        acl = await self.srv.resolve_token(opts.token)
 
         async def run():
+            from consul_tpu.server.acl import filter_check_service_nodes
             idx, csns = self.srv.store.check_service_nodes(service, tag)
+            csns = filter_check_service_nodes(acl, csns)
             meta.index = idx
             if passing_only:
                 from consul_tpu.structs.structs import HEALTH_PASSING
@@ -258,8 +274,14 @@ class KVS(_Endpoint):
         if d is None or not d.key:
             raise EndpointError("Must provide key")
         acl = await self.srv.resolve_token(args.token)
-        if acl is not None and not acl.key_write(d.key):
-            raise PermissionError("Permission denied")
+        if acl is not None:
+            # Recursive delete needs write over the whole subtree
+            # (kvs_endpoint.go: KeyWritePrefix for KVSDeleteTree).
+            if args.op == KVSOp.DELETE_TREE.value:
+                if not acl.key_write_prefix(d.key):
+                    raise PermissionError("Permission denied")
+            elif not acl.key_write(d.key):
+                raise PermissionError("Permission denied")
 
         # Lock-delay must be checked on the leader's wall clock, pre-commit
         # (kvs_endpoint.go:46-61): a lock attempt within the delay window
@@ -292,9 +314,9 @@ class KVS(_Endpoint):
         out: List[DirEntry] = []
 
         async def run():
+            from consul_tpu.server.acl import filter_dir_entries
             tomb_idx, idx, ents = self.srv.store.kvs_list(args.prefix)
-            if acl is not None:
-                ents = [e for e in ents if acl.key_read(e.key)]
+            ents = filter_dir_entries(acl, ents)
             # Index semantics (consul/kvs_endpoint.go:116-142): use the max
             # entry index if non-zero, else the tombstone index, else table.
             ent_max = max((e.modify_index for e in ents), default=0)
@@ -310,9 +332,9 @@ class KVS(_Endpoint):
         out: List[str] = []
 
         async def run():
+            from consul_tpu.server.acl import filter_keys
             idx, keys = self.srv.store.kvs_list_keys(args.prefix, args.separator)
-            if acl is not None:
-                keys = [k for k in keys if acl.key_read(k)]
+            keys = filter_keys(acl, keys)
             meta.index = idx
             out[:] = keys
 
@@ -407,16 +429,103 @@ class SessionEndpoint(_Endpoint):
         return session
 
 
+class ACLEndpoint(_Endpoint):
+    """acl_endpoint.go (203 LoC) — Apply is only served in the ACL
+    datacenter; GetPolicy serves other DCs' caches with ETag + TTL."""
+
+    def _check_auth_dc(self) -> None:
+        cfg = self.srv.config
+        if not cfg.acl_datacenter:
+            raise EndpointError("ACL support disabled")
+        if cfg.acl_datacenter != cfg.datacenter:
+            # The RPC mesh forwards to the auth DC before this point;
+            # reaching here means no route exists.
+            raise EndpointError(
+                f"ACL modifications must route to datacenter '{cfg.acl_datacenter}'")
+
+    async def apply(self, args) -> str:
+        """Set/Delete a token (acl_endpoint.go:18-103).  The token id is
+        generated here on the leader, NEVER in the FSM."""
+        from consul_tpu.acl.policy import PolicyError, parse_policy
+        from consul_tpu.structs.structs import (
+            ACL_ANONYMOUS_ID, ACL_TYPE_CLIENT, ACL_TYPE_MANAGEMENT, ACLOp)
+        self._check_auth_dc()
+        acl = await self.srv.resolve_token(args.token)
+        if acl is not None and not acl.acl_modify():
+            raise PermissionError("Permission denied")
+
+        a = args.acl
+        if args.op == ACLOp.SET.value:
+            if a.type not in (ACL_TYPE_CLIENT, ACL_TYPE_MANAGEMENT):
+                raise EndpointError(f"Invalid ACL Type: '{a.type}'")
+            try:
+                parse_policy(a.rules)
+            except PolicyError as e:
+                raise EndpointError(f"ACL rule compilation failed: {e}")
+            if not a.id:
+                while True:
+                    a.id = str(uuid.uuid4())
+                    _, existing = self.srv.store.acl_get(a.id)
+                    if existing is None:
+                        break
+        else:
+            if not a.id:
+                raise EndpointError("Must provide ID")
+            if a.id == ACL_ANONYMOUS_ID:
+                raise EndpointError("Cannot delete anonymous token")
+
+        resp = await self.srv.raft_apply(MessageType.ACL, args)
+        self.srv.acl_resolver.cache.invalidate(a.id)
+        return resp if isinstance(resp, str) else a.id
+
+    async def get(self, acl_id: str, opts: QueryOptions) -> tuple:
+        meta = QueryMeta()
+        out: List[Any] = []
+
+        async def run():
+            idx, acl = self.srv.store.acl_get(acl_id)
+            meta.index = idx
+            out[:] = [acl] if acl is not None else []
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("ACLGet"))
+        return meta, out
+
+    async def get_policy(self, args):
+        """Serve a compiled policy to another DC's cache
+        (acl_endpoint.go:141+)."""
+        if self.srv.config.acl_datacenter != self.srv.config.datacenter:
+            raise EndpointError("ACL replication must query the ACL datacenter")
+        return self.srv.acl_resolver.policy_reply(args.acl_id, args.etag)
+
+    async def list(self, opts: QueryOptions) -> tuple:
+        acl = await self.srv.resolve_token(opts.token)
+        if acl is not None and not acl.acl_list():
+            raise PermissionError("Permission denied")
+        meta, out = QueryMeta(), []
+
+        async def run():
+            idx, acls = self.srv.store.acl_list()
+            meta.index = idx
+            out[:] = acls
+
+        await self._blocking(opts, meta, run,
+                             tables=self.srv.store.query_tables("ACLList"))
+        return meta, out
+
+
 class Internal(_Endpoint):
     """internal_endpoint.go — UI support queries + event fire."""
 
     async def node_info(self, node: str, opts: QueryOptions) -> tuple:
         meta, out = QueryMeta(), []
+        acl = await self.srv.resolve_token(opts.token)
 
         async def run():
+            from consul_tpu.server.acl import filter_node_dump
             idx, dump = self.srv.store.node_info(node)
             meta.index = idx
-            out[:] = dump
+            out[:] = filter_node_dump(acl, dump)
 
         await self._blocking(opts, meta, run,
                              tables=self.srv.store.query_tables("NodeInfo"))
@@ -424,11 +533,13 @@ class Internal(_Endpoint):
 
     async def node_dump(self, opts: QueryOptions) -> tuple:
         meta, out = QueryMeta(), []
+        acl = await self.srv.resolve_token(opts.token)
 
         async def run():
+            from consul_tpu.server.acl import filter_node_dump
             idx, dump = self.srv.store.node_dump()
             meta.index = idx
-            out[:] = dump
+            out[:] = filter_node_dump(acl, dump)
 
         await self._blocking(opts, meta, run,
                              tables=self.srv.store.query_tables("NodeDump"))

@@ -56,9 +56,33 @@ class LeaderDuties:
             return
         self._active = True
         self.srv.gc.set_enabled(True, time.monotonic())
+        await self._bootstrap_acls()
         self.initialize_session_timers()
         self._tombstone_task = asyncio.get_event_loop().create_task(
             self._tombstone_loop())
+
+    async def _bootstrap_acls(self) -> None:
+        """Seed the anonymous token and the configured master token in the
+        auth DC (initializeACL, leader.go:173-236)."""
+        cfg = self.srv.config
+        if not cfg.acl_datacenter or cfg.acl_datacenter != cfg.datacenter:
+            return
+        from consul_tpu.structs.structs import (
+            ACL, ACL_ANONYMOUS_ID, ACL_TYPE_CLIENT, ACL_TYPE_MANAGEMENT,
+            ACLOp, ACLRequest)
+        _, anon = self.srv.store.acl_get(ACL_ANONYMOUS_ID)
+        if anon is None:
+            await self.srv.raft_apply(MessageType.ACL, ACLRequest(
+                op=ACLOp.SET.value,
+                acl=ACL(id=ACL_ANONYMOUS_ID, name="Anonymous Token",
+                        type=ACL_TYPE_CLIENT)))
+        if cfg.acl_master_token:
+            _, master = self.srv.store.acl_get(cfg.acl_master_token)
+            if master is None:
+                await self.srv.raft_apply(MessageType.ACL, ACLRequest(
+                    op=ACLOp.SET.value,
+                    acl=ACL(id=cfg.acl_master_token, name="Master Token",
+                            type=ACL_TYPE_MANAGEMENT)))
 
     def revoke(self) -> None:
         """revokeLeadership: drop timers; the next leader re-arms from the

@@ -48,6 +48,13 @@ class ServerConfig:
     tombstone_ttl: float = 15 * 60.0
     tombstone_granularity: float = 30.0
     session_ttl_min: float = 10.0
+    # ACL knobs (consul/config.go ACLDatacenter/ACLTTL/ACLDefaultPolicy/
+    # ACLDownPolicy/ACLMasterToken; defaults at config.go:253-256)
+    acl_datacenter: str = ""        # "" = ACLs disabled
+    acl_ttl: float = 30.0
+    acl_default_policy: str = "allow"
+    acl_down_policy: str = "extend-cache"
+    acl_master_token: str = ""
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -79,16 +86,20 @@ class Server:
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
-            Catalog, Health, Internal, KVS, SessionEndpoint, Status)
+            ACLEndpoint, Catalog, Health, Internal, KVS, SessionEndpoint, Status)
+        from consul_tpu.server.acl import ServerACLResolver
+        self.acl_resolver = ServerACLResolver(self)
         self.status = Status(self)
         self.catalog = Catalog(self)
         self.health = Health(self)
         self.kvs = KVS(self)
         self.session = SessionEndpoint(self)
         self.internal = Internal(self)
+        self.acl = ACLEndpoint(self)
         self._endpoints = {
             "Status": self.status, "Catalog": self.catalog, "Health": self.health,
             "KVS": self.kvs, "Session": self.session, "Internal": self.internal,
+            "ACL": self.acl,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -153,15 +164,17 @@ class Server:
         return [self.config.datacenter]
 
     async def resolve_token(self, token: str):
-        """ACL resolution (consul/acl.go:70-148).  None = ACLs disabled;
-        the ACL engine supplies a real resolver."""
-        return None
+        """ACL resolution (consul/acl.go:70-148).  None = ACLs disabled."""
+        return await self.acl_resolver.resolve(token)
+
+    async def rpc_get_remote_acl_policy(self, token_id: str, etag: str):
+        """ACL.GetPolicy to the auth DC (consul/acl.go:104-121); wired up
+        by the RPC mesh when this server knows remote DCs."""
+        raise ConnectionError("no route to ACL datacenter")
 
     async def filter_acl_service_nodes(self, token: str, nodes: list) -> list:
-        acl = await self.resolve_token(token)
-        if acl is None:
-            return nodes
-        return [n for n in nodes if acl.service_read(n.service_name)]
+        from consul_tpu.server.acl import filter_service_nodes
+        return filter_service_nodes(await self.resolve_token(token), nodes)
 
     def reset_session_timer(self, sid: str, session) -> None:
         """Leader-owned TTL timer (consul/session_ttl.go)."""

@@ -1,0 +1,321 @@
+"""ACL engine tests: policy parsing, evaluation, cache, server
+enforcement (reference tiers: acl/*_test.go + consul/acl_test.go)."""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.acl import (
+    ACLCache, PolicyACL, allow_all, deny_all, manage_all, parse_policy,
+    root_acl)
+from consul_tpu.acl.cache import ACLNotFound
+from consul_tpu.acl.policy import PolicyError
+
+HCL_RULES = """
+# default deny at the root
+key "" {
+  policy = "read"
+}
+key "foo/" {
+  policy = "write"
+}
+key "foo/private/" {
+  policy = "deny"
+}
+service "" {
+  policy = "read"
+}
+service "web" {
+  policy = "write"
+}
+"""
+
+JSON_RULES = """
+{"key": {"": {"policy": "read"}, "bar/": {"policy": "write"}},
+ "service": {"db": {"policy": "deny"}}}
+"""
+
+
+class TestPolicyParse:
+    def test_hcl(self):
+        pol = parse_policy(HCL_RULES)
+        assert [(k.prefix, k.policy) for k in pol.keys] == [
+            ("", "read"), ("foo/", "write"), ("foo/private/", "deny")]
+        assert [(s.name, s.policy) for s in pol.services] == [
+            ("", "read"), ("web", "write")]
+
+    def test_json(self):
+        pol = parse_policy(JSON_RULES)
+        assert ("bar/", "write") in [(k.prefix, k.policy) for k in pol.keys]
+        assert [(s.name, s.policy) for s in pol.services] == [("db", "deny")]
+
+    def test_empty(self):
+        pol = parse_policy("")
+        assert pol.keys == [] and pol.services == []
+
+    def test_invalid_policy_value(self):
+        with pytest.raises(PolicyError):
+            parse_policy('key "x" { policy = "banana" }')
+
+    def test_invalid_block(self):
+        with pytest.raises(PolicyError):
+            parse_policy('frob "x" { policy = "read" }')
+
+    def test_comments(self):
+        pol = parse_policy('// line\n/* block */ key "a" { policy = "deny" }')
+        assert pol.keys[0].prefix == "a"
+
+
+class TestPolicyACL:
+    def test_longest_prefix_keys(self):
+        acl = PolicyACL.from_rules(deny_all(), HCL_RULES)
+        assert acl.key_read("anything")          # root "" read
+        assert not acl.key_write("anything")
+        assert acl.key_write("foo/bar")
+        assert acl.key_read("foo/bar")
+        assert not acl.key_read("foo/private/x")  # deny beats shorter write
+        assert not acl.key_write("foo/private/x")
+
+    def test_key_write_prefix(self):
+        acl = PolicyACL.from_rules(deny_all(), HCL_RULES)
+        # "foo/" subtree contains a deny rule -> recursive write refused.
+        assert not acl.key_write_prefix("foo/")
+        assert acl.key_write_prefix("foo/bar/")   # no deny below this point
+
+    def test_services(self):
+        acl = PolicyACL.from_rules(deny_all(), HCL_RULES)
+        assert acl.service_read("anything")
+        assert not acl.service_write("anything")
+        assert acl.service_write("web")
+
+    def test_parent_fallback(self):
+        acl = PolicyACL.from_rules(allow_all(), 'key "a/" { policy = "deny" }')
+        assert not acl.key_read("a/x")
+        assert acl.key_read("b/x")  # falls through to allow-all parent
+
+    def test_static_roots(self):
+        assert root_acl("allow").key_write("x")
+        assert not root_acl("deny").key_read("x")
+        assert root_acl("manage").acl_modify()
+        assert not allow_all().acl_list()
+        assert manage_all().acl_list()
+        assert root_acl("bogus") is None
+
+
+class TestACLCache:
+    def run(self, coro):
+        return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+    def test_fault_and_cache(self):
+        calls = []
+
+        async def fault(tid):
+            calls.append(tid)
+            if tid == "missing":
+                raise ACLNotFound("ACL not found")
+            return "deny", 'key "" { policy = "write" }'
+
+        async def body():
+            cache = ACLCache(fault, ttl=30.0)
+            acl = await cache.get_acl("tok1")
+            assert acl.key_write("anything")
+            await cache.get_acl("tok1")
+            assert calls == ["tok1"]  # second hit served from cache
+            with pytest.raises(ACLNotFound):
+                await cache.get_acl("missing")
+
+        self.run(body())
+
+    def test_expiry_refaults(self):
+        calls = []
+
+        async def fault(tid):
+            calls.append(tid)
+            return "deny", ""
+
+        async def body():
+            cache = ACLCache(fault, ttl=30.0)
+            await cache.get_acl("t", now=0.0)
+            await cache.get_acl("t", now=10.0)   # fresh
+            await cache.get_acl("t", now=31.0)   # expired -> refault
+            assert len(calls) == 2
+
+        self.run(body())
+
+    def test_compile_shares_evaluators(self):
+        async def fault(tid):
+            return "deny", ""
+
+        cache = ACLCache(fault)
+        a = cache.compile("deny", 'key "x" { policy = "read" }')
+        b = cache.compile("deny", 'key "x" { policy = "read" }')
+        assert a is b
+
+
+class TestServerEnforcement:
+    """End-to-end: server with ACLs on, default deny, master + client tokens
+    (consul/acl_test.go shape)."""
+
+    @pytest.fixture()
+    def loop(self):
+        loop = asyncio.new_event_loop()
+        yield loop
+        loop.close()
+
+    def _mk_server(self):
+        from consul_tpu.server.server import Server, ServerConfig
+        from consul_tpu.consensus.raft import RaftConfig
+        return Server(ServerConfig(
+            node_name="s1", datacenter="dc1",
+            acl_datacenter="dc1", acl_default_policy="deny",
+            acl_master_token="root",
+            raft=RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.04,
+                            election_timeout_max=0.08)))
+
+    def test_kv_denied_without_token(self, loop):
+        async def body():
+            from consul_tpu.structs.structs import (
+                DirEntry, KVSOp, KVSRequest, KeyRequest)
+            srv = self._mk_server()
+            await srv.start()
+            await srv.wait_for_leader()
+            req = KVSRequest(op=KVSOp.SET.value,
+                             dir_ent=DirEntry(key="secret", value=b"x"))
+            with pytest.raises(PermissionError):
+                await srv.kvs.apply(req)
+            # master token passes
+            req.token = "root"
+            assert await srv.kvs.apply(req)
+            # anonymous read denied under default deny
+            with pytest.raises(PermissionError):
+                await srv.kvs.get(KeyRequest(key="secret"))
+            await srv.stop()
+
+        loop.run_until_complete(body())
+
+    def test_client_token_scoping(self, loop):
+        async def body():
+            from consul_tpu.structs.structs import (
+                ACL, ACLOp, ACLRequest, DirEntry, KVSOp, KVSRequest, KeyRequest)
+            srv = self._mk_server()
+            await srv.start()
+            await srv.wait_for_leader()
+            args = ACLRequest(op=ACLOp.SET.value, token="root", acl=ACL(
+                name="app", rules='key "app/" { policy = "write" }'))
+            tok = await srv.acl.apply(args)
+            assert tok
+
+            ok = KVSRequest(op=KVSOp.SET.value, token=tok,
+                            dir_ent=DirEntry(key="app/cfg", value=b"1"))
+            assert await srv.kvs.apply(ok)
+            bad = KVSRequest(op=KVSOp.SET.value, token=tok,
+                             dir_ent=DirEntry(key="other/cfg", value=b"1"))
+            with pytest.raises(PermissionError):
+                await srv.kvs.apply(bad)
+            meta, ents = await srv.kvs.get(KeyRequest(key="app/cfg", token=tok))
+            assert ents and ents[0].value == b"1"
+            await srv.stop()
+
+        loop.run_until_complete(body())
+
+    def test_delete_tree_needs_write_prefix(self, loop):
+        """Recursive delete must be refused when any rule under the prefix
+        denies write (reference: KeyWritePrefix for KVSDeleteTree)."""
+        async def body():
+            from consul_tpu.structs.structs import (
+                ACL, ACLOp, ACLRequest, DirEntry, KVSOp, KVSRequest)
+            srv = self._mk_server()
+            await srv.start()
+            await srv.wait_for_leader()
+            tok = await srv.acl.apply(ACLRequest(op=ACLOp.SET.value, token="root",
+                acl=ACL(name="app", rules='key "app/" { policy = "write" } '
+                                          'key "app/secret/" { policy = "deny" }')))
+            assert await srv.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, token="root",
+                dir_ent=DirEntry(key="app/secret/k", value=b"s")))
+            with pytest.raises(PermissionError):
+                await srv.kvs.apply(KVSRequest(
+                    op=KVSOp.DELETE_TREE.value, token=tok,
+                    dir_ent=DirEntry(key="app/")))
+            # subtree without a deny below it is fine
+            assert await srv.kvs.apply(KVSRequest(
+                op=KVSOp.DELETE_TREE.value, token=tok,
+                dir_ent=DirEntry(key="app/public/"))) is not False
+            await srv.stop()
+
+        loop.run_until_complete(body())
+
+    def test_ui_dump_filtered(self, loop):
+        async def body():
+            from consul_tpu.structs.structs import (
+                ACL, ACLOp, ACLRequest, NodeService, QueryOptions,
+                RegisterRequest)
+            srv = self._mk_server()
+            await srv.start()
+            await srv.wait_for_leader()
+            await srv.catalog.register(RegisterRequest(
+                node="n1", address="10.0.0.1", token="root",
+                service=NodeService(id="db", service="db", port=5432)))
+            tok = await srv.acl.apply(ACLRequest(op=ACLOp.SET.value, token="root",
+                acl=ACL(name="none", rules="")))
+            meta, dump = await srv.internal.node_dump(QueryOptions(token=tok))
+            assert all(not row["services"] for row in dump)
+            meta, dump = await srv.internal.node_dump(QueryOptions(token="root"))
+            assert any(row["services"] for row in dump)
+            await srv.stop()
+
+        loop.run_until_complete(body())
+
+    def test_acl_endpoint_validation(self, loop):
+        async def body():
+            from consul_tpu.server.endpoints import EndpointError
+            from consul_tpu.structs.structs import ACL, ACLOp, ACLRequest
+            srv = self._mk_server()
+            await srv.start()
+            await srv.wait_for_leader()
+            # bad rules rejected before raft
+            with pytest.raises(EndpointError):
+                await srv.acl.apply(ACLRequest(op=ACLOp.SET.value, token="root",
+                                               acl=ACL(rules='key "x" { policy = "zap" }')))
+            # non-management token can't modify ACLs
+            with pytest.raises(PermissionError):
+                await srv.acl.apply(ACLRequest(op=ACLOp.SET.value, token="",
+                                               acl=ACL(name="x")))
+            # anonymous token bootstrap happened on leader establishment
+            _, anon = srv.store.acl_get("anonymous")
+            assert anon is not None
+            # can't delete the anonymous token
+            with pytest.raises(EndpointError):
+                await srv.acl.apply(ACLRequest(op=ACLOp.DELETE.value, token="root",
+                                               acl=ACL(id="anonymous")))
+            await srv.stop()
+
+        loop.run_until_complete(body())
+
+    def test_health_and_catalog_filtering(self, loop):
+        async def body():
+            from consul_tpu.structs.structs import (
+                ACL, ACLOp, ACLRequest, HealthCheck, NodeService,
+                QueryOptions, RegisterRequest)
+            srv = self._mk_server()
+            await srv.start()
+            await srv.wait_for_leader()
+            for name in ("web", "db"):
+                await srv.catalog.register(RegisterRequest(
+                    node="n1", address="10.0.0.1", token="root",
+                    service=NodeService(id=name, service=name, port=80),
+                    checks=[HealthCheck(node="n1", check_id=f"c-{name}",
+                                        name=f"c-{name}", status="passing",
+                                        service_id=name, service_name=name)]))
+            tok = await srv.acl.apply(ACLRequest(op=ACLOp.SET.value, token="root",
+                acl=ACL(name="webonly", rules='service "web" { policy = "read" }')))
+
+            meta, services = await srv.catalog.list_services(QueryOptions(token=tok))
+            assert "web" in services and "db" not in services
+            meta, csns = await srv.health.service_nodes("db", QueryOptions(token=tok))
+            assert csns == []
+            meta, csns = await srv.health.service_nodes("web", QueryOptions(token=tok))
+            assert len(csns) == 1
+            await srv.stop()
+
+        loop.run_until_complete(body())
