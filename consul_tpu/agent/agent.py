@@ -1,26 +1,30 @@
-"""The edge agent: embeds a server, exposes HTTP + DNS.
+"""The edge agent: embeds a server, runs local checks, syncs the catalog.
 
 Parity target: ``command/agent/agent.go`` (1319 LoC) + the serve()
-choreography of ``command/agent/command.go``.  This slice is the
-single-node "bootstrap" agent of SURVEY.md §7 step 3: embedded server,
-self-registration with a passing serfHealth check (what the leader
-reconcile loop does for real clusters, consul/leader.go:354-421), HTTP
-and DNS front-ends.  Local check runners, anti-entropy, and the
-client-mode agent land with the edge-features stage.
+choreography of ``command/agent/command.go``.  Owns the local
+service/check registries (persisted to data-dir and reloaded at boot,
+agent.go:540-612/890-959/1040-1227), the check runners, the
+anti-entropy loop (local.py), maintenance mode (agent.go:1229-1320),
+and the HTTP/DNS front-ends.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
+import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
+from consul_tpu.agent.checks import CheckRunnerSet, CheckType
 from consul_tpu.agent.dns import DNSServer
-from consul_tpu.agent.http_api import HTTPServer, to_api
+from consul_tpu.agent.http_api import HTTPServer, _service_from_api, to_api
+from consul_tpu.agent.local import LocalState
 from consul_tpu.server.server import Server, ServerConfig
 from consul_tpu.structs.structs import (
     CONSUL_SERVICE_ID,
     CONSUL_SERVICE_NAME,
+    HEALTH_CRITICAL,
     HEALTH_PASSING,
     HealthCheck,
     NodeService,
@@ -30,6 +34,16 @@ from consul_tpu.structs.structs import (
     SERF_CHECK_NAME,
 )
 from consul_tpu.version import VERSION
+
+# Maintenance-mode faux checks (agent.go:24-38)
+NODE_MAINT_CHECK_ID = "_node_maintenance"
+SERVICE_MAINT_PREFIX = "_service_maintenance:"
+DEFAULT_NODE_MAINT_REASON = ("Maintenance mode is enabled for this node, "
+                             "but no reason was provided. This is a default "
+                             "message.")
+DEFAULT_SERVICE_MAINT_REASON = ("Maintenance mode is enabled for this "
+                                "service, but no reason was provided. This "
+                                "is a default message.")
 
 
 @dataclass
@@ -43,9 +57,11 @@ class AgentConfig:
     dns_port: int = 8600
     server: bool = True
     bootstrap: bool = True
+    data_dir: str = ""  # "" = no persistence (dev mode)
     dns_only_passing: bool = False
     node_ttl: float = 0.0
     service_ttl: float = 0.0
+    ae_interval: float = 60.0
     # ACL passthrough (command/agent/config.go ACL* fields)
     acl_datacenter: str = ""
     acl_ttl: float = 30.0
@@ -66,6 +82,8 @@ class Agent:
             datacenter=self.config.datacenter,
             domain=self.config.domain,
             bootstrap=self.config.bootstrap,
+            data_dir=(os.path.join(self.config.data_dir, "server")
+                      if self.config.data_dir else ""),
             acl_datacenter=self.config.acl_datacenter,
             acl_ttl=self.config.acl_ttl,
             acl_default_policy=self.config.acl_default_policy,
@@ -77,10 +95,16 @@ class Agent:
                              node_ttl=self.config.node_ttl,
                              service_ttl=self.config.service_ttl,
                              only_passing=self.config.dns_only_passing)
+        self.local = LocalState(self, sync_interval=self.config.ae_interval)
+        self.runners = CheckRunnerSet()
 
     @property
     def node_name(self) -> str:
         return self.config.node_name
+
+    @property
+    def advertise_addr(self) -> str:
+        return self.config.advertise_addr
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -88,10 +112,14 @@ class Agent:
         await self.server.start()
         await self.server.wait_for_leader()
         await self._register_self()
+        self._load_persisted()
+        self.local.start()
         await self.http.start(self.config.bind_addr, self.config.http_port)
         await self.dns.start(self.config.bind_addr, self.config.dns_port)
 
     async def stop(self) -> None:
+        self.runners.stop_all()
+        self.local.stop()
         await self.dns.stop()
         await self.http.stop()
         await self.server.stop()
@@ -111,7 +139,219 @@ class Agent:
         if self.config.server:
             req.service = NodeService(
                 id=CONSUL_SERVICE_ID, service=CONSUL_SERVICE_NAME, port=8300)
+            # The reference's NewAgent seeds the consul service into local
+            # state in server mode so /v1/agent/services reports it.
+            self.local.services[CONSUL_SERVICE_ID] = req.service
+            self.local._service_sync[CONSUL_SERVICE_ID] = True
         await self.server.catalog.register(req)
+
+    # -- catalog interface for the anti-entropy loop ------------------------
+    # The embedded-server agent talks to its own endpoints; client mode
+    # points these at the RPC mesh.
+
+    async def catalog_register(self, req: RegisterRequest) -> None:
+        await self.server.catalog.register(req)
+
+    async def catalog_deregister(self, req) -> None:
+        await self.server.catalog.deregister(req)
+
+    async def catalog_node_services(self, node: str):
+        _, services = self.server.store.node_services(node)
+        return services
+
+    async def catalog_node_checks(self, node: str):
+        _, checks = self.server.store.node_checks(node)
+        return checks
+
+    def cluster_size(self) -> int:
+        idx, nodes = self.server.store.nodes()
+        return max(1, len(nodes))
+
+    # -- service/check registry (agent.go:54-99 API) ------------------------
+
+    async def add_service(self, service: NodeService,
+                          check_types: Optional[List[CheckType]] = None,
+                          token: str = "", persist: bool = True) -> None:
+        """AddService (agent.go:390-470): register locally, spawn runners
+        for attached checks, persist, trigger sync."""
+        if not service.id and service.service:
+            service.id = service.service
+        if not service.service:
+            raise ValueError("Service name missing")
+        for ct in check_types or []:
+            if not ct.valid():
+                raise ValueError("Check type is not valid")
+        # Re-registration replaces the service's checks wholesale —
+        # stop stale runners so an orphaned TTL can't flip critical later.
+        for cid in [cid for cid, c in list(self.local.checks.items())
+                    if c.service_id == service.id]:
+            await self.remove_check(cid, persist=False)
+        self.local.add_service(service, token)
+        for i, ct in enumerate(check_types or []):
+            suffix = "" if len(check_types) == 1 else f":{i + 1}"
+            check_id = f"service:{service.id}{suffix}"
+            check = HealthCheck(
+                node=self.config.node_name, check_id=check_id,
+                name=f"Service '{service.service}' check",
+                status=HEALTH_CRITICAL, notes=ct.notes,
+                service_id=service.id, service_name=service.service)
+            self.local.add_check(check, token)
+            self.runners.start_check(self.local, check_id, ct)
+        if persist:
+            self._persist("services", service.id, {
+                "service": to_api(service),
+                "check_types": [vars(ct) for ct in (check_types or [])],
+                "token": token})
+
+    async def remove_service(self, service_id: str, persist: bool = True) -> None:
+        self.local.remove_service(service_id)
+        for cid in [cid for cid, c in list(self.local.checks.items())
+                    if c.service_id == service_id]:
+            await self.remove_check(cid, persist=persist)
+        if persist:
+            self._unpersist("services", service_id)
+
+    async def add_check(self, check: HealthCheck,
+                        check_type: Optional[CheckType] = None,
+                        token: str = "", persist: bool = True) -> None:
+        """AddCheck (agent.go:472-538): a standalone check, optionally
+        bound to a local service."""
+        if check.service_id:
+            svc = self.local.services.get(check.service_id)
+            if svc is None:
+                raise ValueError(
+                    f"ServiceID \"{check.service_id}\" does not exist")
+            check.service_name = svc.service
+        if check_type is not None:
+            if not check_type.valid():
+                raise ValueError("Check type is not valid")
+            self.runners.start_check(self.local, check.check_id, check_type)
+        self.local.add_check(check, token)
+        if persist:
+            self._persist("checks", check.check_id, {
+                "check": to_api(check),
+                "check_type": vars(check_type) if check_type else None,
+                "token": token})
+
+    async def remove_check(self, check_id: str, persist: bool = True) -> None:
+        self.runners.stop_check(check_id)
+        self.local.remove_check(check_id)
+        if persist:
+            self._unpersist("checks", check_id)
+
+    def update_ttl_check(self, check_id: str, status: str, output: str) -> None:
+        """TTL heartbeat from the app (agent_endpoint.go pass/warn/fail)."""
+        ttl = self.runners.ttl_check(check_id)
+        if ttl is None:
+            raise ValueError(f'CheckID "{check_id}" does not have '
+                             f'associated TTL')
+        ttl.set_status(status, output)
+
+    # -- maintenance mode (agent.go:1229-1320) ------------------------------
+
+    def enable_node_maintenance(self, reason: str = "") -> None:
+        if NODE_MAINT_CHECK_ID in self.local.checks:
+            return
+        self.local.add_check(HealthCheck(
+            node=self.config.node_name, check_id=NODE_MAINT_CHECK_ID,
+            name="Node Maintenance Mode", status=HEALTH_CRITICAL,
+            notes=reason or DEFAULT_NODE_MAINT_REASON))
+
+    def disable_node_maintenance(self) -> None:
+        if NODE_MAINT_CHECK_ID in self.local.checks:
+            self.local.remove_check(NODE_MAINT_CHECK_ID)
+
+    def enable_service_maintenance(self, service_id: str, reason: str = "") -> None:
+        svc = self.local.services.get(service_id)
+        if svc is None:
+            raise ValueError(f'No service registered with ID "{service_id}"')
+        check_id = SERVICE_MAINT_PREFIX + service_id
+        if check_id in self.local.checks:
+            return
+        self.local.add_check(HealthCheck(
+            node=self.config.node_name, check_id=check_id,
+            name="Service Maintenance Mode", status=HEALTH_CRITICAL,
+            notes=reason or DEFAULT_SERVICE_MAINT_REASON,
+            service_id=service_id, service_name=svc.service))
+
+    def disable_service_maintenance(self, service_id: str) -> None:
+        if service_id not in self.local.services:
+            raise ValueError(f'No service registered with ID "{service_id}"')
+        check_id = SERVICE_MAINT_PREFIX + service_id
+        if check_id in self.local.checks:
+            self.local.remove_check(check_id)
+
+    # -- persistence (agent.go:540-612, 890-959; load :1040-1227) -----------
+
+    def _persist_dir(self, kind: str) -> Optional[str]:
+        if not self.config.data_dir:
+            return None
+        d = os.path.join(self.config.data_dir, kind)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @staticmethod
+    def _safe_id(ident: str) -> str:
+        import hashlib
+        return hashlib.sha1(ident.encode()).hexdigest()
+
+    def _persist(self, kind: str, ident: str, payload: dict) -> None:
+        d = self._persist_dir(kind)
+        if d is None:
+            return
+        path = os.path.join(d, self._safe_id(ident))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    def _unpersist(self, kind: str, ident: str) -> None:
+        d = self._persist_dir(kind)
+        if d is None:
+            return
+        try:
+            os.remove(os.path.join(d, self._safe_id(ident)))
+        except FileNotFoundError:
+            pass
+
+    def _load_persisted(self) -> None:
+        """Reload persisted definitions at boot (loadServices/loadChecks).
+        Persisted checks resume in critical until their runner reports
+        (agent.go:1109-1127)."""
+        if not self.config.data_dir:
+            return
+        loop = asyncio.get_event_loop()
+        d = os.path.join(self.config.data_dir, "services")
+        if os.path.isdir(d):
+            for fn in sorted(os.listdir(d)):
+                try:
+                    with open(os.path.join(d, fn)) as f:
+                        payload = json.load(f)
+                    svc = _service_from_api(payload["service"])
+                    cts = [CheckType(**ct) for ct in payload.get("check_types", [])]
+                    loop.create_task(self.add_service(
+                        svc, cts, payload.get("token", ""), persist=False))
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    continue
+        d = os.path.join(self.config.data_dir, "checks")
+        if os.path.isdir(d):
+            from consul_tpu.agent.http_api import _check_from_api
+            for fn in sorted(os.listdir(d)):
+                try:
+                    with open(os.path.join(d, fn)) as f:
+                        payload = json.load(f)
+                    check = _check_from_api(payload["check"])
+                    check.node = self.config.node_name
+                    # persisted checks resume critical until their runner
+                    # reports (agent.go:1109-1127)
+                    check.status = HEALTH_CRITICAL
+                    check.output = ""
+                    ct = (CheckType(**payload["check_type"])
+                          if payload.get("check_type") else None)
+                    loop.create_task(self.add_check(
+                        check, ct, payload.get("token", ""), persist=False))
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    continue
 
     # -- HTTP routes owned by the agent (command/agent/agent_endpoint.go) --
 
@@ -120,6 +360,19 @@ class Agent:
         router.add_get("/v1/agent/services", h(self._services))
         router.add_get("/v1/agent/checks", h(self._checks))
         router.add_get("/v1/agent/members", h(self._members))
+        router.add_put("/v1/agent/service/register", h(self._service_register))
+        router.add_put("/v1/agent/service/deregister/{id}",
+                       h(self._service_deregister))
+        router.add_put("/v1/agent/service/maintenance/{id}",
+                       h(self._service_maintenance))
+        router.add_put("/v1/agent/check/register", h(self._check_register))
+        router.add_put("/v1/agent/check/deregister/{id}", h(self._check_deregister))
+        router.add_put("/v1/agent/check/pass/{id}", h(self._check_pass))
+        router.add_put("/v1/agent/check/warn/{id}", h(self._check_warn))
+        router.add_put("/v1/agent/check/fail/{id}", h(self._check_fail))
+        router.add_put("/v1/agent/maintenance", h(self._node_maintenance))
+        router.add_put("/v1/agent/join/{address}", h(self._join))
+        router.add_put("/v1/agent/force-leave/{node}", h(self._force_leave))
 
     async def _self(self, request):
         """/v1/agent/self (agent_endpoint.go:24-34): config + stats."""
@@ -136,12 +389,18 @@ class Agent:
         }
 
     async def _services(self, request):
-        _, services = self.server.store.node_services(self.config.node_name)
-        return {sid: to_api(svc) for sid, svc in (services or {}).items()}
+        """Local state, not catalog (agent_endpoint.go:36-40)."""
+        return {sid: to_api(svc) for sid, svc in self.local.services.items()}
 
     async def _checks(self, request):
+        """Local checks plus the node's own serfHealth (which is
+        leader-owned, so it lives in the catalog, not local state)."""
+        out = {c.check_id: to_api(c) for c in self.local.checks.values()}
         _, checks = self.server.store.node_checks(self.config.node_name)
-        return {c.check_id: to_api(c) for c in checks}
+        for c in checks:
+            if c.check_id == SERF_CHECK_ID:
+                out.setdefault(c.check_id, to_api(c))
+        return out
 
     async def _members(self, request):
         """LAN members; one entry until gossip lands."""
@@ -153,3 +412,124 @@ class Agent:
             "Tags": {"role": "consul" if self.config.server else "node",
                      "dc": self.config.datacenter},
         }]
+
+    async def _service_register(self, request):
+        """PUT /v1/agent/service/register (agent_endpoint.go:113-163):
+        a ServiceDefinition with inline Check/Checks."""
+        from consul_tpu.server.endpoints import EndpointError
+        body = await self.http._body_json(request)
+        svc = NodeService(
+            id=body.get("ID", ""), service=body.get("Name", ""),
+            tags=body.get("Tags") or [], address=body.get("Address", ""),
+            port=body.get("Port", 0))
+        cts = []
+        raw_checks = body.get("Checks") or []
+        if body.get("Check"):
+            raw_checks.append(body["Check"])
+        for rc in raw_checks:
+            cts.append(_check_type_from_api(rc))
+        try:
+            await self.add_service(svc, cts, self.http._token(request))
+        except ValueError as e:
+            raise EndpointError(str(e))
+        return ""
+
+    async def _service_deregister(self, request):
+        await self.remove_service(request.match_info["id"])
+        return ""
+
+    async def _service_maintenance(self, request):
+        enable = request.query.get("enable", "").lower()
+        if enable not in ("true", "false"):
+            from consul_tpu.server.endpoints import EndpointError
+            raise EndpointError("Missing value for enable")
+        try:
+            if enable == "true":
+                self.enable_service_maintenance(
+                    request.match_info["id"], request.query.get("reason", ""))
+            else:
+                self.disable_service_maintenance(request.match_info["id"])
+        except ValueError as e:
+            from consul_tpu.agent.http_api import NotFound
+            raise NotFound(str(e))
+        return ""
+
+    async def _check_register(self, request):
+        """PUT /v1/agent/check/register (agent_endpoint.go:165-200)."""
+        from consul_tpu.server.endpoints import EndpointError
+        body = await self.http._body_json(request)
+        ct = _check_type_from_api(body)
+        if not ct.valid():
+            raise EndpointError(
+                "Must provide TTL or Script and Interval!")
+        check = HealthCheck(
+            node=self.config.node_name,
+            check_id=body.get("ID") or body.get("Name", ""),
+            name=body.get("Name", ""), notes=body.get("Notes", ""),
+            status=HEALTH_CRITICAL,
+            service_id=body.get("ServiceID", ""))
+        if not check.check_id:
+            raise EndpointError("Must provide a check name")
+        try:
+            await self.add_check(check, ct, self.http._token(request))
+        except ValueError as e:
+            raise EndpointError(str(e))
+        return ""
+
+    async def _check_deregister(self, request):
+        await self.remove_check(request.match_info["id"])
+        return ""
+
+    def _ttl_update(self, request, status: str):
+        from consul_tpu.agent.http_api import NotFound
+        note = request.query.get("note", "")
+        try:
+            self.update_ttl_check(request.match_info["id"], status, note)
+        except ValueError as e:
+            raise NotFound(str(e))
+        return ""
+
+    async def _check_pass(self, request):
+        return self._ttl_update(request, HEALTH_PASSING)
+
+    async def _check_warn(self, request):
+        from consul_tpu.structs.structs import HEALTH_WARNING
+        return self._ttl_update(request, HEALTH_WARNING)
+
+    async def _check_fail(self, request):
+        return self._ttl_update(request, HEALTH_CRITICAL)
+
+    async def _node_maintenance(self, request):
+        enable = request.query.get("enable", "").lower()
+        if enable not in ("true", "false"):
+            from consul_tpu.server.endpoints import EndpointError
+            raise EndpointError("Missing value for enable")
+        if enable == "true":
+            self.enable_node_maintenance(request.query.get("reason", ""))
+        else:
+            self.disable_node_maintenance()
+        return ""
+
+    async def _join(self, request):
+        """Gossip join lands with the network membership layer; the
+        single-node agent accepts and no-ops (agent_endpoint.go:75-90)."""
+        return ""
+
+    async def _force_leave(self, request):
+        return ""
+
+
+def _check_type_from_api(rc: Dict[str, Any]) -> CheckType:
+    from consul_tpu.server.endpoints import parse_duration
+
+    def dur(key: str) -> float:
+        v = rc.get(key, "")
+        if not v:
+            return 0.0
+        return parse_duration(v) if isinstance(v, str) else float(v)
+
+    return CheckType(script=rc.get("Script", ""), http=rc.get("HTTP", ""),
+                     interval=dur("Interval"), ttl=dur("TTL"),
+                     notes=rc.get("Notes", ""), timeout=dur("Timeout"))
+
+

@@ -1,0 +1,237 @@
+"""Local health-check runners: script, HTTP, and TTL checks.
+
+Parity target: ``command/agent/check.go`` (404 LoC).  A check type is
+one of Script+Interval / HTTP+Interval / TTL (check.go:38-70); runners
+push status transitions into the local state (the ``CheckNotifier``
+contract), which anti-entropy then syncs to the catalog.
+
+The reference runs each check on its own goroutine with timers; here
+every runner is one asyncio task owned by the agent's event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from consul_tpu.structs.structs import (
+    HEALTH_CRITICAL, HEALTH_PASSING, HEALTH_WARNING)
+
+MIN_INTERVAL = 1.0        # checks faster than this are clamped (check.go:17-20)
+OUTPUT_MAX = 4 * 1024     # CheckBufSize circular buffer (check.go:26)
+
+
+@dataclass
+class CheckType:
+    """A check definition from config/API (check.go:38-70): exactly one
+    of script/http/ttl must be set; script+http need an interval."""
+
+    script: str = ""
+    http: str = ""
+    interval: float = 0.0
+    ttl: float = 0.0
+    notes: str = ""
+    timeout: float = 0.0
+
+    def valid(self) -> bool:
+        return self.is_ttl() or self.is_monitor() or self.is_http()
+
+    def is_ttl(self) -> bool:
+        return self.ttl > 0
+
+    def is_monitor(self) -> bool:
+        return bool(self.script) and self.interval > 0
+
+    def is_http(self) -> bool:
+        return bool(self.http) and self.interval > 0
+
+
+def _clip_output(data: bytes) -> str:
+    """Keep the LAST 4KB, like the reference's circular buffer."""
+    if len(data) > OUTPUT_MAX:
+        data = data[-OUTPUT_MAX:]
+    return data.decode("utf-8", errors="replace")
+
+
+class CheckMonitor:
+    """Periodic shell-out (check.go:79-200): exit 0 = passing,
+    1 = warning, anything else (or timeout/spawn failure) = critical."""
+
+    def __init__(self, notify, check_id: str, script: str, interval: float,
+                 logger=None) -> None:
+        self.notify = notify
+        self.check_id = check_id
+        self.script = script
+        self.interval = max(interval, MIN_INTERVAL)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        try:
+            # Initial random stagger so a fleet of agents doesn't thundering-
+            # herd its targets (check.go runs after one full interval).
+            await asyncio.sleep(random.uniform(0, self.interval))
+            while True:
+                await self._check()
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass
+
+    async def _check(self) -> None:
+        try:
+            proc = await asyncio.create_subprocess_shell(
+                self.script,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT)
+        except OSError as e:
+            self.notify.update_check(self.check_id, HEALTH_CRITICAL, str(e))
+            return
+        # 30s hard timeout (check.go:160-170 kills after 30s).
+        try:
+            out, _ = await asyncio.wait_for(proc.communicate(), timeout=30.0)
+        except asyncio.TimeoutError:
+            proc.kill()
+            self.notify.update_check(self.check_id, HEALTH_CRITICAL,
+                                     "Check timed out")
+            return
+        output = _clip_output(out or b"")
+        code = proc.returncode
+        if code == 0:
+            status = HEALTH_PASSING
+        elif code == 1:
+            status = HEALTH_WARNING
+        else:
+            status = HEALTH_CRITICAL
+        self.notify.update_check(self.check_id, status, output)
+
+
+class CheckHTTP:
+    """Periodic GET (check.go:302+): 2xx = passing, 429 = warning,
+    anything else = critical; body is the check output."""
+
+    def __init__(self, notify, check_id: str, url: str, interval: float,
+                 timeout: float = 0.0) -> None:
+        self.notify = notify
+        self.check_id = check_id
+        self.url = url
+        self.interval = max(interval, MIN_INTERVAL)
+        self.timeout = timeout if timeout > 0 else min(10.0, self.interval)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        try:
+            import httpx
+            async with httpx.AsyncClient(timeout=self.timeout) as client:
+                await asyncio.sleep(random.uniform(0, self.interval))
+                while True:
+                    await self._check(client)
+                    await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass
+
+    async def _check(self, client) -> None:
+        try:
+            resp = await client.get(self.url)
+        except Exception as e:
+            self.notify.update_check(self.check_id, HEALTH_CRITICAL, str(e))
+            return
+        output = _clip_output(resp.content)
+        if 200 <= resp.status_code < 300:
+            self.notify.update_check(self.check_id, HEALTH_PASSING, output)
+        elif resp.status_code == 429:
+            self.notify.update_check(self.check_id, HEALTH_WARNING, output)
+        else:
+            self.notify.update_check(
+                self.check_id, HEALTH_CRITICAL,
+                f"HTTP GET {self.url}: {resp.status_code} Output: {output}")
+
+
+class CheckTTL:
+    """Deadman timer (check.go:202-265): the app must call set_status
+    within the TTL or the check flips critical."""
+
+    def __init__(self, notify, check_id: str, ttl: float) -> None:
+        self.notify = notify
+        self.check_id = check_id
+        self.ttl = ttl
+        self._handle: Optional[asyncio.TimerHandle] = None
+
+    def start(self) -> None:
+        self._arm()
+
+    def stop(self) -> None:
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    def _arm(self) -> None:
+        self.stop()
+        self._handle = asyncio.get_event_loop().call_later(self.ttl, self._expire)
+
+    def _expire(self) -> None:
+        self._handle = None
+        self.notify.update_check(
+            self.check_id, HEALTH_CRITICAL,
+            f"TTL expired (no update within {self.ttl}s)")
+
+    def set_status(self, status: str, output: str) -> None:
+        """App heartbeat: record status and re-arm the timer."""
+        self.notify.update_check(self.check_id, status, output)
+        self._arm()
+
+
+class CheckRunnerSet:
+    """Owns every live runner for an agent; keyed by check id."""
+
+    def __init__(self) -> None:
+        self.monitors: Dict[str, CheckMonitor] = {}
+        self.https: Dict[str, CheckHTTP] = {}
+        self.ttls: Dict[str, CheckTTL] = {}
+
+    def start_check(self, notify, check_id: str, ct: CheckType) -> None:
+        self.stop_check(check_id)
+        if ct.is_ttl():
+            r = CheckTTL(notify, check_id, ct.ttl)
+            self.ttls[check_id] = r
+        elif ct.is_http():
+            r = CheckHTTP(notify, check_id, ct.http, ct.interval, ct.timeout)
+            self.https[check_id] = r
+        elif ct.is_monitor():
+            r = CheckMonitor(notify, check_id, ct.script, ct.interval)
+            self.monitors[check_id] = r
+        else:
+            raise ValueError("check must define Script+Interval, "
+                             "HTTP+Interval, or TTL")
+        r.start()
+
+    def stop_check(self, check_id: str) -> None:
+        for pool in (self.monitors, self.https, self.ttls):
+            r = pool.pop(check_id, None)
+            if r is not None:
+                r.stop()
+
+    def stop_all(self) -> None:
+        for pool in (self.monitors, self.https, self.ttls):
+            for r in pool.values():
+                r.stop()
+            pool.clear()
+
+    def ttl_check(self, check_id: str) -> Optional[CheckTTL]:
+        return self.ttls.get(check_id)

@@ -234,11 +234,7 @@ class HTTPServer:
             datacenter=body.get("Datacenter", ""),
             token=self._token(request))
         if body.get("Service"):
-            s = body["Service"]
-            args.service = NodeService(
-                id=s.get("ID", ""), service=s.get("Service", ""),
-                tags=s.get("Tags") or [], address=s.get("Address", ""),
-                port=s.get("Port", 0))
+            args.service = _service_from_api(body["Service"])
         if body.get("Check"):
             args.check = _check_from_api(body["Check"])
         for c in body.get("Checks") or []:
@@ -534,7 +530,15 @@ def _check_from_api(c: Dict[str, Any]) -> HealthCheck:
         node=c.get("Node", ""), check_id=c.get("CheckID", ""),
         name=c.get("Name", ""), status=c.get("Status", ""),
         notes=c.get("Notes", ""), output=c.get("Output", ""),
-        service_id=c.get("ServiceID", ""))
+        service_id=c.get("ServiceID", ""),
+        service_name=c.get("ServiceName", ""))
+
+
+def _service_from_api(s: Dict[str, Any]) -> NodeService:
+    return NodeService(
+        id=s.get("ID", ""), service=s.get("Service", ""),
+        tags=s.get("Tags") or [], address=s.get("Address", ""),
+        port=s.get("Port", 0))
 
 
 def _opt_kw(opts: QueryOptions) -> Dict[str, Any]:

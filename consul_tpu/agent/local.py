@@ -1,0 +1,225 @@
+"""Agent-local service/check state and catalog anti-entropy.
+
+Parity target: ``command/agent/local.go`` (596 LoC).  The agent owns
+the authoritative copy of ITS OWN services and checks; anti-entropy
+diffs that local truth against the (possibly stale) catalog and issues
+register/deregister calls until they agree — sync on change plus a
+periodic full pass whose interval grows log2 with cluster size
+(aeScale, command/agent/util.go:27-37) under random stagger.
+
+The sync target is an async catalog interface; the embedded-server
+agent wires it straight to its own endpoints, a client-mode agent to
+the RPC mesh.  Either way the flow matches §3.2's write path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+from typing import Dict, Optional
+
+from consul_tpu.structs.structs import (
+    DeregisterRequest, HealthCheck, NodeService, RegisterRequest,
+    SERF_CHECK_ID)
+
+AE_BASE_INTERVAL = 60.0   # sync interval floor (agent.go aeInterval)
+AE_SCALE_THRESHOLD = 128  # nodes before the interval starts growing
+
+
+def ae_scale(interval: float, n_nodes: int) -> float:
+    """Scale the anti-entropy interval by ceil(log2(n/128))+1 so catalog
+    write load stays ~constant as the cluster grows (util.go:27-37)."""
+    if n_nodes <= AE_SCALE_THRESHOLD:
+        return interval
+    mult = math.ceil(math.log2(n_nodes) - math.log2(AE_SCALE_THRESHOLD)) + 1
+    return interval * mult
+
+
+class LocalState:
+    def __init__(self, agent, sync_interval: float = AE_BASE_INTERVAL) -> None:
+        self.agent = agent
+        self.sync_interval = sync_interval
+        self.services: Dict[str, NodeService] = {}
+        self.checks: Dict[str, HealthCheck] = {}
+        self.service_tokens: Dict[str, str] = {}
+        self.check_tokens: Dict[str, str] = {}
+        # sync bookkeeping: id -> in_sync; separate deregister sets for
+        # remote entries we no longer own (local.go syncStatus)
+        self._service_sync: Dict[str, bool] = {}
+        self._check_sync: Dict[str, bool] = {}
+        self._deregister_services: set = set()
+        self._deregister_checks: set = set()
+        self._paused = False
+        self._trigger = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    # -- registry mutations (local.go:108-246) ------------------------------
+
+    def add_service(self, service: NodeService, token: str = "") -> None:
+        self.services[service.id] = service
+        self.service_tokens[service.id] = token
+        self._service_sync[service.id] = False
+        self._deregister_services.discard(service.id)
+        self.changed()
+
+    def remove_service(self, service_id: str) -> None:
+        self.services.pop(service_id, None)
+        self.service_tokens.pop(service_id, None)
+        self._service_sync.pop(service_id, None)
+        self._deregister_services.add(service_id)
+        self.changed()
+
+    def add_check(self, check: HealthCheck, token: str = "") -> None:
+        self.checks[check.check_id] = check
+        self.check_tokens[check.check_id] = token
+        self._check_sync[check.check_id] = False
+        self._deregister_checks.discard(check.check_id)
+        self.changed()
+
+    def remove_check(self, check_id: str) -> None:
+        if check_id == SERF_CHECK_ID:
+            # serfHealth is leader-owned (consul/leader.go:17-22); letting a
+            # local deregister delete it would wipe the node from ?passing
+            # queries with nothing to re-register it in single-node mode.
+            return
+        self.checks.pop(check_id, None)
+        self.check_tokens.pop(check_id, None)
+        self._check_sync.pop(check_id, None)
+        self._deregister_checks.add(check_id)
+        self.changed()
+
+    def update_check(self, check_id: str, status: str, output: str) -> None:
+        """Check runner callback (local.go UpdateCheck): no-op unless the
+        visible state changed."""
+        check = self.checks.get(check_id)
+        if check is None:
+            return
+        if check.status == status and check.output == output:
+            return
+        check.status = status
+        check.output = output
+        self._check_sync[check_id] = False
+        self.changed()
+
+    # -- pause/resume for config reloads (local.go:79-104) ------------------
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+        self.changed()
+
+    def changed(self) -> None:
+        self._trigger.set()
+
+    # -- the anti-entropy loop (local.go:290-338) ---------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                interval = ae_scale(self.sync_interval,
+                                    self.agent.cluster_size())
+                # stagger by up to interval/16 like aeStagger
+                timeout = interval + random.uniform(0, interval / 16)
+                try:
+                    await asyncio.wait_for(self._trigger.wait(), timeout)
+                except asyncio.TimeoutError:
+                    pass
+                self._trigger.clear()
+                if self._paused:
+                    continue
+                try:
+                    await self.sync_once()
+                except Exception:
+                    # Catalog unreachable: back off briefly, then re-arm the
+                    # trigger so the retry is immediate rather than a full
+                    # interval away (local.go:318-326 retries on short tick).
+                    await asyncio.sleep(min(1.0, self.sync_interval))
+                    self._trigger.set()
+        except asyncio.CancelledError:
+            pass
+
+    async def sync_once(self) -> None:
+        await self.set_sync_state()
+        await self.sync_changes()
+
+    # -- diff against the catalog (setSyncState, local.go:342-430) ----------
+
+    async def set_sync_state(self) -> None:
+        node = self.agent.node_name
+        remote_services = await self.agent.catalog_node_services(node)
+        remote_checks = await self.agent.catalog_node_checks(node)
+
+        for sid, remote in (remote_services or {}).items():
+            if sid == "consul":
+                continue  # the embedded server's own entry is leader-owned
+            local = self.services.get(sid)
+            if local is None:
+                self._deregister_services.add(sid)
+            else:
+                in_sync = (local.service == remote.service
+                           and sorted(local.tags) == sorted(remote.tags)
+                           and local.address == remote.address
+                           and local.port == remote.port)
+                self._service_sync[sid] = in_sync
+        for sid in self.services:
+            if sid not in (remote_services or {}):
+                self._service_sync[sid] = False
+
+        remote_by_id = {c.check_id: c for c in (remote_checks or [])}
+        for cid, remote in remote_by_id.items():
+            if cid == SERF_CHECK_ID:
+                continue  # serfHealth belongs to the leader reconcile loop
+            local = self.checks.get(cid)
+            if local is None:
+                self._deregister_checks.add(cid)
+            else:
+                self._check_sync[cid] = (local.status == remote.status
+                                         and local.output == remote.output
+                                         and local.name == remote.name)
+        for cid in self.checks:
+            if cid not in remote_by_id:
+                self._check_sync[cid] = False
+
+    # -- push the deltas (syncChanges, local.go:434-476) --------------------
+
+    async def sync_changes(self) -> None:
+        node = self.agent.node_name
+        addr = self.agent.advertise_addr
+
+        for sid in list(self._deregister_services):
+            await self.agent.catalog_deregister(DeregisterRequest(
+                node=node, service_id=sid,
+                token=self.service_tokens.get(sid, "")))
+            self._deregister_services.discard(sid)
+        for cid in list(self._deregister_checks):
+            await self.agent.catalog_deregister(DeregisterRequest(
+                node=node, check_id=cid,
+                token=self.check_tokens.get(cid, "")))
+            self._deregister_checks.discard(cid)
+
+        for sid, in_sync in list(self._service_sync.items()):
+            if in_sync or sid not in self.services:
+                continue
+            await self.agent.catalog_register(RegisterRequest(
+                node=node, address=addr, service=self.services[sid],
+                token=self.service_tokens.get(sid, "")))
+            self._service_sync[sid] = True
+        for cid, in_sync in list(self._check_sync.items()):
+            if in_sync or cid not in self.checks:
+                continue
+            await self.agent.catalog_register(RegisterRequest(
+                node=node, address=addr, check=self.checks[cid],
+                token=self.check_tokens.get(cid, "")))
+            self._check_sync[cid] = True

@@ -1,0 +1,256 @@
+"""Agent edge features: check runners, anti-entropy, maintenance,
+persistence (reference tier: command/agent/check_test.go,
+local_test.go, agent_test.go)."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from consul_tpu.agent.agent import (
+    Agent, AgentConfig, NODE_MAINT_CHECK_ID, SERVICE_MAINT_PREFIX)
+from consul_tpu.agent.checks import CheckTTL, CheckType
+from consul_tpu.agent.local import ae_scale
+from consul_tpu.structs.structs import (
+    HEALTH_CRITICAL, HEALTH_PASSING, HEALTH_WARNING, HealthCheck, NodeService)
+
+
+class Recorder:
+    """Minimal CheckNotifier."""
+
+    def __init__(self):
+        self.updates = []
+
+    def update_check(self, check_id, status, output):
+        self.updates.append((check_id, status, output))
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def _mk_agent(tmp_path=None, **kw):
+    cfg = AgentConfig(http_port=0, dns_port=0, ae_interval=0.2,
+                      data_dir=str(tmp_path) if tmp_path else "", **kw)
+    return Agent(cfg)
+
+
+async def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+class TestCheckType:
+    def test_validity(self):
+        assert CheckType(ttl=10).valid()
+        assert CheckType(script="true", interval=10).valid()
+        assert CheckType(http="http://x", interval=10).valid()
+        assert not CheckType().valid()
+        assert not CheckType(script="true").valid()  # no interval
+        assert not CheckType(http="http://x").valid()
+
+
+class TestRunners:
+    def test_monitor_exit_codes(self, loop):
+        async def body():
+            from consul_tpu.agent.checks import CheckMonitor
+            rec = Recorder()
+            for script, want in (("exit 0", HEALTH_PASSING),
+                                 ("exit 1", HEALTH_WARNING),
+                                 ("exit 2", HEALTH_CRITICAL)):
+                m = CheckMonitor(rec, "c", script, 10)
+                await m._check()
+                assert rec.updates[-1][1] == want
+
+        loop.run_until_complete(body())
+
+    def test_monitor_captures_output(self, loop):
+        async def body():
+            from consul_tpu.agent.checks import CheckMonitor
+            rec = Recorder()
+            m = CheckMonitor(rec, "c", "echo hello-output", 10)
+            await m._check()
+            assert "hello-output" in rec.updates[-1][2]
+
+        loop.run_until_complete(body())
+
+    def test_ttl_expiry_and_heartbeat(self, loop):
+        async def body():
+            rec = Recorder()
+            ttl = CheckTTL(rec, "t", 0.1)
+            ttl.start()
+            await asyncio.sleep(0.25)
+            assert rec.updates[-1][1] == HEALTH_CRITICAL
+            ttl.set_status(HEALTH_PASSING, "ok")
+            assert rec.updates[-1][1] == HEALTH_PASSING
+            # heartbeats keep it alive
+            for _ in range(3):
+                await asyncio.sleep(0.05)
+                ttl.set_status(HEALTH_PASSING, "ok")
+            assert rec.updates[-1][1] == HEALTH_PASSING
+            ttl.stop()
+
+        loop.run_until_complete(body())
+
+
+class TestAEScale:
+    def test_thresholds(self):
+        # util.go:27-37 table: <=128 nodes unscaled; doubles add a multiple
+        assert ae_scale(60, 100) == 60
+        assert ae_scale(60, 128) == 60
+        assert ae_scale(60, 256) == 120
+        assert ae_scale(60, 512) == 180
+        assert ae_scale(60, 8192) == 420
+
+
+class TestAgentRegistry:
+    def test_service_and_check_sync_to_catalog(self, loop):
+        async def body():
+            agent = _mk_agent()
+            await agent.start()
+            await agent.add_service(
+                NodeService(id="web", service="web", port=80),
+                [CheckType(ttl=30)])
+            # anti-entropy pushes it into the catalog
+            ok = await _wait_for(
+                lambda: "web" in (agent.server.store.node_services("node1")[1] or {}))
+            assert ok
+            _, checks = agent.server.store.node_checks("node1")
+            ids = {c.check_id for c in checks}
+            assert "service:web" in ids
+            # TTL pass flows through local -> catalog
+            agent.update_ttl_check("service:web", HEALTH_PASSING, "beating")
+            ok = await _wait_for(lambda: any(
+                c.check_id == "service:web" and c.status == HEALTH_PASSING
+                for c in agent.server.store.node_checks("node1")[1]))
+            assert ok
+            # removal deregisters
+            await agent.remove_service("web")
+            ok = await _wait_for(
+                lambda: "web" not in (agent.server.store.node_services("node1")[1] or {}))
+            assert ok
+            await agent.stop()
+
+        loop.run_until_complete(body())
+
+    def test_maintenance_mode(self, loop):
+        async def body():
+            agent = _mk_agent()
+            await agent.start()
+            await agent.add_service(NodeService(id="db", service="db", port=1))
+            agent.enable_node_maintenance("fixing stuff")
+            agent.enable_service_maintenance("db", "db down")
+            assert NODE_MAINT_CHECK_ID in agent.local.checks
+            maint_id = SERVICE_MAINT_PREFIX + "db"
+            assert maint_id in agent.local.checks
+            assert agent.local.checks[maint_id].status == HEALTH_CRITICAL
+            ok = await _wait_for(lambda: any(
+                c.check_id == NODE_MAINT_CHECK_ID
+                for c in agent.server.store.node_checks("node1")[1]))
+            assert ok
+            agent.disable_node_maintenance()
+            agent.disable_service_maintenance("db")
+            ok = await _wait_for(lambda: not any(
+                c.check_id in (NODE_MAINT_CHECK_ID, maint_id)
+                for c in agent.server.store.node_checks("node1")[1]))
+            assert ok
+            with pytest.raises(ValueError):
+                agent.enable_service_maintenance("nope")
+            await agent.stop()
+
+        loop.run_until_complete(body())
+
+    def test_persistence_roundtrip(self, loop, tmp_path):
+        async def body():
+            agent = _mk_agent(tmp_path)
+            await agent.start()
+            await agent.add_service(
+                NodeService(id="web", service="web", port=80,
+                            tags=["v1"]), [CheckType(ttl=60)])
+            await agent.add_check(
+                HealthCheck(node="node1", check_id="standalone",
+                            name="standalone"), CheckType(ttl=60))
+            await agent.stop()
+
+            # new agent, same data-dir: definitions reload at boot
+            agent2 = _mk_agent(tmp_path)
+            await agent2.start()
+            ok = await _wait_for(lambda: "web" in agent2.local.services
+                                 and "standalone" in agent2.local.checks)
+            assert ok
+            assert agent2.local.services["web"].tags == ["v1"]
+            # reloaded TTL runner is live
+            agent2.update_ttl_check("standalone", HEALTH_PASSING, "ok")
+            assert agent2.local.checks["standalone"].status == HEALTH_PASSING
+            # deregistration removes the persisted file
+            await agent2.remove_service("web")
+            await agent2.stop()
+            agent3 = _mk_agent(tmp_path)
+            await agent3.start()
+            await asyncio.sleep(0.2)
+            assert "web" not in agent3.local.services
+            await agent3.stop()
+
+        loop.run_until_complete(body())
+
+
+class TestAgentHTTPEndpoints:
+    def test_register_ttl_maintenance_over_http(self, loop):
+        async def body():
+            import httpx
+            agent = _mk_agent()
+            await agent.start()
+            host, port = agent.http.addr
+            base = f"http://{host}:{port}"
+            async with httpx.AsyncClient() as c:
+                r = await c.put(f"{base}/v1/agent/service/register", json={
+                    "ID": "redis", "Name": "redis", "Port": 6379,
+                    "Check": {"TTL": "30s"}})
+                assert r.status_code == 200, r.text
+                r = await c.get(f"{base}/v1/agent/services")
+                assert "redis" in r.json()
+                r = await c.put(f"{base}/v1/agent/check/pass/service:redis")
+                assert r.status_code == 200, r.text
+                r = await c.get(f"{base}/v1/agent/checks")
+                assert r.json()["service:redis"]["Status"] == HEALTH_PASSING
+                # unknown TTL check -> 404
+                r = await c.put(f"{base}/v1/agent/check/pass/nope")
+                assert r.status_code == 404
+                # standalone check registration
+                r = await c.put(f"{base}/v1/agent/check/register", json={
+                    "Name": "mem", "TTL": "10s"})
+                assert r.status_code == 200, r.text
+                r = await c.put(f"{base}/v1/agent/check/warn/mem?note=high")
+                assert r.status_code == 200
+                r = await c.get(f"{base}/v1/agent/checks")
+                body_checks = r.json()
+                assert body_checks["mem"]["Status"] == HEALTH_WARNING
+                assert body_checks["mem"]["Output"] == "high"
+                # maintenance
+                r = await c.put(f"{base}/v1/agent/maintenance?enable=true&reason=why")
+                assert r.status_code == 200
+                r = await c.get(f"{base}/v1/agent/checks")
+                assert NODE_MAINT_CHECK_ID in r.json()
+                r = await c.put(f"{base}/v1/agent/maintenance?enable=false")
+                r = await c.get(f"{base}/v1/agent/checks")
+                assert NODE_MAINT_CHECK_ID not in r.json()
+                # bad enable param
+                r = await c.put(f"{base}/v1/agent/maintenance")
+                assert r.status_code == 400
+                # deregister service
+                r = await c.put(f"{base}/v1/agent/service/deregister/redis")
+                assert r.status_code == 200
+                r = await c.get(f"{base}/v1/agent/services")
+                assert "redis" not in r.json()
+            await agent.stop()
+
+        loop.run_until_complete(body())
