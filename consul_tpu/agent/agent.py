@@ -183,9 +183,11 @@ class Agent:
                 raise ValueError("Check type is not valid")
         # Re-registration replaces the service's checks wholesale —
         # stop stale runners so an orphaned TTL can't flip critical later.
+        # Threads this call's persist flag so standalone-check files don't
+        # outlive the checks they describe.
         for cid in [cid for cid, c in list(self.local.checks.items())
                     if c.service_id == service.id]:
-            await self.remove_check(cid, persist=False)
+            await self.remove_check(cid, persist=persist)
         self.local.add_service(service, token)
         for i, ct in enumerate(check_types or []):
             suffix = "" if len(check_types) == 1 else f":{i + 1}"

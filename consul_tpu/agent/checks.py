@@ -81,7 +81,15 @@ class CheckMonitor:
             # herd its targets (check.go runs after one full interval).
             await asyncio.sleep(random.uniform(0, self.interval))
             while True:
-                await self._check()
+                try:
+                    await self._check()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # A runner must never die silently — a frozen check
+                    # would keep serving its last (possibly passing) status.
+                    self.notify.update_check(self.check_id, HEALTH_CRITICAL,
+                                             f"check runner error: {e}")
                 await asyncio.sleep(self.interval)
         except asyncio.CancelledError:
             pass
@@ -99,7 +107,10 @@ class CheckMonitor:
         try:
             out, _ = await asyncio.wait_for(proc.communicate(), timeout=30.0)
         except asyncio.TimeoutError:
-            proc.kill()
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass  # exited in the timeout window
             self.notify.update_check(self.check_id, HEALTH_CRITICAL,
                                      "Check timed out")
             return

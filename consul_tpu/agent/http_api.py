@@ -88,7 +88,9 @@ class HTTPServer:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 8500) -> None:
-        self._runner = web.AppRunner(self.app, access_log=None)
+        # Don't let in-flight blocking queries (up to 600s) stall shutdown.
+        self._runner = web.AppRunner(self.app, access_log=None,
+                                     shutdown_timeout=0.5)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
         await site.start()

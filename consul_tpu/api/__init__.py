@@ -1,0 +1,16 @@
+"""Client SDK: the ``api/`` package of the reference (2661 LoC) —
+an HTTP client with per-domain endpoints plus the Lock/Semaphore
+coordination recipes built on the KV + session substrate.
+"""
+
+from consul_tpu.api.client import (
+    Client, Config, QueryMeta, QueryOptions, WriteOptions, APIError,
+    KVPair)
+from consul_tpu.api.lock import Lock, LockError, LOCK_FLAG_VALUE
+from consul_tpu.api.semaphore import Semaphore, SemaphoreError
+
+__all__ = [
+    "Client", "Config", "QueryMeta", "QueryOptions", "WriteOptions",
+    "APIError", "KVPair", "Lock", "LockError", "LOCK_FLAG_VALUE",
+    "Semaphore", "SemaphoreError",
+]

@@ -1,0 +1,517 @@
+"""HTTP API client.
+
+Parity target: ``api/api.go`` (client core, env config, QueryOptions/
+QueryMeta at api.go:20-46/118-177/384-410) plus the per-domain endpoint
+files (``kv.go``, ``agent.go``, ``catalog.go``, ``health.go``,
+``session.go``, ``event.go``, ``acl.go``, ``status.go``, ``raw.go``).
+
+Synchronous (the reference's client is, too); uses httpx under the
+hood.  Blocking queries: pass ``QueryOptions(wait_index=N)`` and the
+call long-polls until the index moves or the wait elapses.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import httpx
+
+
+class APIError(Exception):
+    def __init__(self, status: int, body: str) -> None:
+        super().__init__(f"Unexpected response code: {status} ({body})")
+        self.status = status
+        self.body = body
+
+
+@dataclass
+class Config:
+    """Client config; env fallbacks mirror api.go:118-177."""
+
+    address: str = "127.0.0.1:8500"
+    scheme: str = "http"
+    datacenter: str = ""
+    token: str = ""
+    timeout: float = 610.0  # > max blocking query wait
+
+    @classmethod
+    def default(cls) -> "Config":
+        cfg = cls()
+        addr = os.environ.get("CONSUL_HTTP_ADDR")
+        if addr:
+            cfg.address = addr
+        token = os.environ.get("CONSUL_HTTP_TOKEN")
+        if token:
+            cfg.token = token
+        if os.environ.get("CONSUL_HTTP_SSL", "").lower() in ("1", "true"):
+            cfg.scheme = "https"
+        return cfg
+
+
+@dataclass
+class QueryOptions:
+    datacenter: str = ""
+    allow_stale: bool = False
+    require_consistent: bool = False
+    wait_index: int = 0
+    wait_time: float = 0.0
+    token: str = ""
+
+
+@dataclass
+class WriteOptions:
+    datacenter: str = ""
+    token: str = ""
+
+
+@dataclass
+class QueryMeta:
+    last_index: int = 0
+    last_contact: float = 0.0
+    known_leader: bool = False
+    request_time: float = 0.0
+
+
+@dataclass
+class KVPair:
+    key: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+    lock_index: int = 0
+    flags: int = 0
+    value: bytes = b""
+    session: str = ""
+
+    @classmethod
+    def from_api(cls, d: Dict[str, Any]) -> "KVPair":
+        value = d.get("Value")
+        return cls(
+            key=d.get("Key", ""),
+            create_index=d.get("CreateIndex", 0),
+            modify_index=d.get("ModifyIndex", 0),
+            lock_index=d.get("LockIndex", 0),
+            flags=d.get("Flags", 0),
+            value=base64.b64decode(value) if value else b"",
+            session=d.get("Session", ""))
+
+
+def _fmt_dur(seconds: float) -> str:
+    ms = int(seconds * 1000)
+    return f"{ms}ms"
+
+
+class Client:
+    def __init__(self, config: Optional[Config] = None) -> None:
+        self.config = config or Config.default()
+        base = f"{self.config.scheme}://{self.config.address}"
+        self._http = httpx.Client(base_url=base, timeout=self.config.timeout)
+        self.kv = KV(self)
+        self.agent = AgentAPI(self)
+        self.catalog = CatalogAPI(self)
+        self.health = HealthAPI(self)
+        self.session = SessionAPI(self)
+        self.event = EventAPI(self)
+        self.acl = ACLAPI(self)
+        self.status = StatusAPI(self)
+
+    def close(self) -> None:
+        self._http.close()
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- raw request machinery (api.go newRequest/doRequest) ----------------
+
+    def _params(self, q: Optional[QueryOptions] = None,
+                w: Optional[WriteOptions] = None) -> Dict[str, str]:
+        params: Dict[str, str] = {}
+        dc = (q.datacenter if q else "") or (w.datacenter if w else "") or \
+            self.config.datacenter
+        if dc:
+            params["dc"] = dc
+        token = (q.token if q else "") or (w.token if w else "") or \
+            self.config.token
+        if token:
+            params["token"] = token
+        if q is not None:
+            if q.allow_stale:
+                params["stale"] = ""
+            if q.require_consistent:
+                params["consistent"] = ""
+            if q.wait_index:
+                params["index"] = str(q.wait_index)
+            if q.wait_time:
+                params["wait"] = _fmt_dur(q.wait_time)
+        return params
+
+    def request(self, method: str, path: str,
+                q: Optional[QueryOptions] = None,
+                w: Optional[WriteOptions] = None,
+                body: Any = None, raw_body: Optional[bytes] = None,
+                extra_params: Optional[Dict[str, str]] = None,
+                ok_statuses: Tuple[int, ...] = (200,),
+                ) -> Tuple[httpx.Response, QueryMeta]:
+        import time
+        params = self._params(q, w)
+        if extra_params:
+            params.update(extra_params)
+        kwargs: Dict[str, Any] = {"params": params}
+        if raw_body is not None:
+            kwargs["content"] = raw_body
+        elif body is not None:
+            kwargs["content"] = json.dumps(body)
+        start = time.monotonic()
+        resp = self._http.request(method, path, **kwargs)
+        meta = QueryMeta(request_time=time.monotonic() - start)
+        h = resp.headers
+        if "X-Consul-Index" in h:
+            meta.last_index = int(h["X-Consul-Index"])
+        if "X-Consul-LastContact" in h:
+            meta.last_contact = int(h["X-Consul-LastContact"]) / 1000.0
+        meta.known_leader = h.get("X-Consul-KnownLeader", "") == "true"
+        if resp.status_code not in ok_statuses:
+            raise APIError(resp.status_code, resp.text)
+        return resp, meta
+
+
+class KV:
+    """api/kv.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def get(self, key: str, q: Optional[QueryOptions] = None
+            ) -> Tuple[Optional[KVPair], QueryMeta]:
+        resp, meta = self.c.request("GET", f"/v1/kv/{key}", q=q,
+                                    ok_statuses=(200, 404))
+        if resp.status_code == 404:
+            return None, meta
+        return KVPair.from_api(resp.json()[0]), meta
+
+    def list(self, prefix: str, q: Optional[QueryOptions] = None
+             ) -> Tuple[List[KVPair], QueryMeta]:
+        resp, meta = self.c.request("GET", f"/v1/kv/{prefix}", q=q,
+                                    extra_params={"recurse": ""},
+                                    ok_statuses=(200, 404))
+        if resp.status_code == 404:
+            return [], meta
+        return [KVPair.from_api(d) for d in resp.json()], meta
+
+    def keys(self, prefix: str, separator: str = "",
+             q: Optional[QueryOptions] = None) -> Tuple[List[str], QueryMeta]:
+        extra = {"keys": ""}
+        if separator:
+            extra["separator"] = separator
+        resp, meta = self.c.request("GET", f"/v1/kv/{prefix}", q=q,
+                                    extra_params=extra, ok_statuses=(200, 404))
+        if resp.status_code == 404:
+            return [], meta
+        return resp.json(), meta
+
+    def put(self, pair: KVPair, w: Optional[WriteOptions] = None) -> bool:
+        extra = {}
+        if pair.flags:
+            extra["flags"] = str(pair.flags)
+        resp, _ = self.c.request("PUT", f"/v1/kv/{pair.key}", w=w,
+                                 raw_body=pair.value, extra_params=extra)
+        return resp.json() is True
+
+    def cas(self, pair: KVPair, w: Optional[WriteOptions] = None) -> bool:
+        extra = {"cas": str(pair.modify_index)}
+        if pair.flags:
+            extra["flags"] = str(pair.flags)
+        resp, _ = self.c.request("PUT", f"/v1/kv/{pair.key}", w=w,
+                                 raw_body=pair.value, extra_params=extra)
+        return resp.json() is True
+
+    def acquire(self, pair: KVPair, w: Optional[WriteOptions] = None) -> bool:
+        extra = {"acquire": pair.session}
+        if pair.flags:
+            extra["flags"] = str(pair.flags)
+        resp, _ = self.c.request("PUT", f"/v1/kv/{pair.key}", w=w,
+                                 raw_body=pair.value, extra_params=extra)
+        return resp.json() is True
+
+    def release(self, pair: KVPair, w: Optional[WriteOptions] = None) -> bool:
+        extra = {"release": pair.session}
+        if pair.flags:
+            extra["flags"] = str(pair.flags)
+        resp, _ = self.c.request("PUT", f"/v1/kv/{pair.key}", w=w,
+                                 raw_body=pair.value, extra_params=extra)
+        return resp.json() is True
+
+    def delete(self, key: str, w: Optional[WriteOptions] = None) -> bool:
+        resp, _ = self.c.request("DELETE", f"/v1/kv/{key}", w=w)
+        return True
+
+    def delete_cas(self, pair: KVPair, w: Optional[WriteOptions] = None) -> bool:
+        resp, _ = self.c.request("DELETE", f"/v1/kv/{pair.key}", w=w,
+                                 extra_params={"cas": str(pair.modify_index)})
+        return resp.json() is True
+
+    def delete_tree(self, prefix: str, w: Optional[WriteOptions] = None) -> bool:
+        resp, _ = self.c.request("DELETE", f"/v1/kv/{prefix}", w=w,
+                                 extra_params={"recurse": ""})
+        return True
+
+
+class AgentAPI:
+    """api/agent.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def self_(self) -> Dict[str, Any]:
+        resp, _ = self.c.request("GET", "/v1/agent/self")
+        return resp.json()
+
+    def node_name(self) -> str:
+        return self.self_()["Config"]["NodeName"]
+
+    def members(self) -> List[Dict[str, Any]]:
+        resp, _ = self.c.request("GET", "/v1/agent/members")
+        return resp.json()
+
+    def services(self) -> Dict[str, Any]:
+        resp, _ = self.c.request("GET", "/v1/agent/services")
+        return resp.json()
+
+    def checks(self) -> Dict[str, Any]:
+        resp, _ = self.c.request("GET", "/v1/agent/checks")
+        return resp.json()
+
+    def service_register(self, definition: Dict[str, Any]) -> None:
+        self.c.request("PUT", "/v1/agent/service/register", body=definition)
+
+    def service_deregister(self, service_id: str) -> None:
+        self.c.request("PUT", f"/v1/agent/service/deregister/{service_id}")
+
+    def check_register(self, definition: Dict[str, Any]) -> None:
+        self.c.request("PUT", "/v1/agent/check/register", body=definition)
+
+    def check_deregister(self, check_id: str) -> None:
+        self.c.request("PUT", f"/v1/agent/check/deregister/{check_id}")
+
+    def pass_ttl(self, check_id: str, note: str = "") -> None:
+        self.c.request("PUT", f"/v1/agent/check/pass/{check_id}",
+                       extra_params={"note": note} if note else None)
+
+    def warn_ttl(self, check_id: str, note: str = "") -> None:
+        self.c.request("PUT", f"/v1/agent/check/warn/{check_id}",
+                       extra_params={"note": note} if note else None)
+
+    def fail_ttl(self, check_id: str, note: str = "") -> None:
+        self.c.request("PUT", f"/v1/agent/check/fail/{check_id}",
+                       extra_params={"note": note} if note else None)
+
+    def join(self, addr: str, wan: bool = False) -> None:
+        extra = {"wan": "1"} if wan else None
+        self.c.request("PUT", f"/v1/agent/join/{addr}", extra_params=extra)
+
+    def force_leave(self, node: str) -> None:
+        self.c.request("PUT", f"/v1/agent/force-leave/{node}")
+
+    def enable_node_maintenance(self, reason: str = "") -> None:
+        self.c.request("PUT", "/v1/agent/maintenance",
+                       extra_params={"enable": "true", "reason": reason})
+
+    def disable_node_maintenance(self) -> None:
+        self.c.request("PUT", "/v1/agent/maintenance",
+                       extra_params={"enable": "false"})
+
+    def enable_service_maintenance(self, service_id: str, reason: str = "") -> None:
+        self.c.request("PUT", f"/v1/agent/service/maintenance/{service_id}",
+                       extra_params={"enable": "true", "reason": reason})
+
+    def disable_service_maintenance(self, service_id: str) -> None:
+        self.c.request("PUT", f"/v1/agent/service/maintenance/{service_id}",
+                       extra_params={"enable": "false"})
+
+
+class CatalogAPI:
+    """api/catalog.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def register(self, reg: Dict[str, Any],
+                 w: Optional[WriteOptions] = None) -> None:
+        self.c.request("PUT", "/v1/catalog/register", w=w, body=reg)
+
+    def deregister(self, dereg: Dict[str, Any],
+                   w: Optional[WriteOptions] = None) -> None:
+        self.c.request("PUT", "/v1/catalog/deregister", w=w, body=dereg)
+
+    def datacenters(self) -> List[str]:
+        resp, _ = self.c.request("GET", "/v1/catalog/datacenters")
+        return resp.json()
+
+    def nodes(self, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", "/v1/catalog/nodes", q=q)
+        return resp.json(), meta
+
+    def services(self, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", "/v1/catalog/services", q=q)
+        return resp.json(), meta
+
+    def service(self, name: str, tag: str = "",
+                q: Optional[QueryOptions] = None):
+        extra = {"tag": tag} if tag else None
+        resp, meta = self.c.request("GET", f"/v1/catalog/service/{name}",
+                                    q=q, extra_params=extra)
+        return resp.json(), meta
+
+    def node(self, name: str, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", f"/v1/catalog/node/{name}", q=q)
+        return resp.json(), meta
+
+
+class HealthAPI:
+    """api/health.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def node(self, name: str, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", f"/v1/health/node/{name}", q=q)
+        return resp.json(), meta
+
+    def checks(self, service: str, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", f"/v1/health/checks/{service}", q=q)
+        return resp.json(), meta
+
+    def service(self, name: str, tag: str = "", passing_only: bool = False,
+                q: Optional[QueryOptions] = None):
+        extra: Dict[str, str] = {}
+        if tag:
+            extra["tag"] = tag
+        if passing_only:
+            extra["passing"] = ""
+        resp, meta = self.c.request("GET", f"/v1/health/service/{name}",
+                                    q=q, extra_params=extra or None)
+        return resp.json(), meta
+
+    def state(self, state: str, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", f"/v1/health/state/{state}", q=q)
+        return resp.json(), meta
+
+
+class SessionAPI:
+    """api/session.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def create(self, entry: Optional[Dict[str, Any]] = None,
+               w: Optional[WriteOptions] = None) -> str:
+        resp, _ = self.c.request("PUT", "/v1/session/create", w=w,
+                                 body=entry or {})
+        return resp.json()["ID"]
+
+    def destroy(self, session_id: str,
+                w: Optional[WriteOptions] = None) -> None:
+        self.c.request("PUT", f"/v1/session/destroy/{session_id}", w=w)
+
+    def renew(self, session_id: str,
+              w: Optional[WriteOptions] = None) -> Optional[Dict[str, Any]]:
+        resp, _ = self.c.request("PUT", f"/v1/session/renew/{session_id}",
+                                 w=w, ok_statuses=(200, 404))
+        if resp.status_code == 404:
+            return None
+        entries = resp.json()
+        return entries[0] if entries else None
+
+    def info(self, session_id: str, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", f"/v1/session/info/{session_id}", q=q)
+        entries = resp.json()
+        return (entries[0] if entries else None), meta
+
+    def node(self, node: str, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", f"/v1/session/node/{node}", q=q)
+        return resp.json(), meta
+
+    def list(self, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", "/v1/session/list", q=q)
+        return resp.json(), meta
+
+
+class EventAPI:
+    """api/event.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def fire(self, name: str, payload: bytes = b"",
+             node_filter: str = "", service_filter: str = "",
+             tag_filter: str = "",
+             w: Optional[WriteOptions] = None) -> str:
+        extra: Dict[str, str] = {}
+        if node_filter:
+            extra["node"] = node_filter
+        if service_filter:
+            extra["service"] = service_filter
+        if tag_filter:
+            extra["tag"] = tag_filter
+        resp, _ = self.c.request("PUT", f"/v1/event/fire/{name}", w=w,
+                                 raw_body=payload, extra_params=extra or None)
+        return resp.json().get("ID", "")
+
+    def list(self, name: str = "", q: Optional[QueryOptions] = None):
+        extra = {"name": name} if name else None
+        resp, meta = self.c.request("GET", "/v1/event/list", q=q,
+                                    extra_params=extra)
+        return resp.json(), meta
+
+
+class ACLAPI:
+    """api/acl.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def create(self, entry: Dict[str, Any],
+               w: Optional[WriteOptions] = None) -> str:
+        resp, _ = self.c.request("PUT", "/v1/acl/create", w=w, body=entry)
+        return resp.json()["ID"]
+
+    def update(self, entry: Dict[str, Any],
+               w: Optional[WriteOptions] = None) -> None:
+        self.c.request("PUT", "/v1/acl/update", w=w, body=entry)
+
+    def destroy(self, acl_id: str, w: Optional[WriteOptions] = None) -> None:
+        self.c.request("PUT", f"/v1/acl/destroy/{acl_id}", w=w)
+
+    def clone(self, acl_id: str, w: Optional[WriteOptions] = None) -> str:
+        resp, _ = self.c.request("PUT", f"/v1/acl/clone/{acl_id}", w=w)
+        return resp.json()["ID"]
+
+    def info(self, acl_id: str, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", f"/v1/acl/info/{acl_id}", q=q)
+        entries = resp.json()
+        return (entries[0] if entries else None), meta
+
+    def list(self, q: Optional[QueryOptions] = None):
+        resp, meta = self.c.request("GET", "/v1/acl/list", q=q)
+        return resp.json(), meta
+
+
+class StatusAPI:
+    """api/status.go."""
+
+    def __init__(self, c: Client) -> None:
+        self.c = c
+
+    def leader(self) -> str:
+        resp, _ = self.c.request("GET", "/v1/status/leader")
+        return resp.json()
+
+    def peers(self) -> List[str]:
+        resp, _ = self.c.request("GET", "/v1/status/peers")
+        return resp.json()

@@ -1,0 +1,182 @@
+"""Distributed mutex on the KV + session substrate.
+
+Parity target: ``api/lock.go`` (115-219): session + ``?acquire`` CAS +
+a monitor thread watching the key with blocking queries, returning a
+"lost lock" event; contention waits ride blocking queries on the key.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from consul_tpu.api.client import APIError, Client, KVPair, QueryOptions
+
+# Flag marking a KV entry as lock-managed (api/lock.go LockFlagValue —
+# a published protocol constant, kept for wire compatibility).
+LOCK_FLAG_VALUE = 0x2DDCCBC058A50C18
+
+DEFAULT_SESSION_NAME = "Consul API Lock"
+DEFAULT_SESSION_TTL = "15s"
+DEFAULT_WAIT = 15.0  # retry pace when contended (lock.go DefaultLockWaitTime)
+
+
+class LockError(Exception):
+    pass
+
+
+class Lock:
+    def __init__(self, client: Client, key: str, value: bytes = b"",
+                 session: str = "", session_name: str = DEFAULT_SESSION_NAME,
+                 session_ttl: str = DEFAULT_SESSION_TTL,
+                 wait_time: float = DEFAULT_WAIT) -> None:
+        if not key:
+            raise LockError("missing key")
+        self.c = client
+        self.key = key
+        self.value = value
+        self.session = session
+        self.session_name = session_name
+        self.session_ttl = session_ttl
+        self.wait_time = wait_time
+        self.is_held = False
+        self._owns_session = False
+        self._renew_stop: Optional[threading.Event] = None
+        self._lost = threading.Event()
+
+    # -- session plumbing (lock.go createSession + RenewPeriodic) -----------
+
+    def _create_session(self) -> str:
+        sid = self.c.session.create({
+            "Name": self.session_name, "TTL": self.session_ttl})
+        self._owns_session = True
+        stop = threading.Event()
+        self._renew_stop = stop
+        ttl_s = float(self.session_ttl.rstrip("s"))
+
+        def renew_loop() -> None:
+            while not stop.wait(ttl_s / 2):
+                try:
+                    if self.c.session.renew(sid) is None:
+                        self._lost.set()  # session gone server-side
+                        return
+                except Exception:
+                    # Transport blip: keep trying each tick; if the session
+                    # TTL-expires meanwhile the monitor thread fires lost.
+                    continue
+
+        threading.Thread(target=renew_loop, daemon=True).start()
+        return sid
+
+    def _cleanup_session(self) -> None:
+        if self._renew_stop is not None:
+            self._renew_stop.set()
+            self._renew_stop = None
+        if self._owns_session and self.session:
+            try:
+                self.c.session.destroy(self.session)
+            except APIError:
+                pass
+            self.session = ""
+            self._owns_session = False
+
+    # -- acquire / release --------------------------------------------------
+
+    def acquire(self, stop: Optional[threading.Event] = None
+                ) -> Optional[threading.Event]:
+        """Block until held (or ``stop`` is set).  Returns an Event that
+        fires if the lock is subsequently lost, None if aborted."""
+        if self.is_held:
+            raise LockError("lock is already held")
+        if not self.session:
+            self.session = self._create_session()
+        self._lost.clear()
+
+        try:
+            wait_index = 0
+            while stop is None or not stop.is_set():
+                # Wait for the current holder to go away (blocking query).
+                pair, meta = self.c.kv.get(self.key, QueryOptions(
+                    wait_index=wait_index, wait_time=self.wait_time))
+                wait_index = meta.last_index
+                if pair is not None and pair.flags != LOCK_FLAG_VALUE:
+                    raise LockError("existing key does not match lock use")
+                if pair is not None and pair.session:
+                    if pair.session == self.session:
+                        self.is_held = True
+                        self._start_monitor()
+                        return self._lost
+                    continue  # held by someone else; re-poll
+
+                acquired = self.c.kv.acquire(KVPair(
+                    key=self.key, value=self.value, session=self.session,
+                    flags=LOCK_FLAG_VALUE))
+                if acquired:
+                    self.is_held = True
+                    self._start_monitor()
+                    return self._lost
+                # Lost the race (or lock-delay active): brief pause, retry.
+                if stop is not None and stop.wait(0.25):
+                    break
+                elif stop is None:
+                    import time
+                    time.sleep(0.25)
+            return None
+        finally:
+            # Every failed/aborted path must tear down the session we
+            # created, or its renew thread keeps the orphan alive forever.
+            if not self.is_held:
+                self._cleanup_session()
+
+    def _start_monitor(self) -> None:
+        """monitorLock (lock.go:221-255): blocking-watch the key; if our
+        session no longer holds it, fire the lost event."""
+
+        def monitor() -> None:
+            import time
+            wait_index = 0
+            while self.is_held:
+                try:
+                    pair, meta = self.c.kv.get(self.key, QueryOptions(
+                        wait_index=wait_index, wait_time=self.wait_time))
+                except Exception:
+                    time.sleep(1.0)  # transport error: back off, re-watch
+                    continue
+                wait_index = meta.last_index
+                if not self.is_held:
+                    return
+                if pair is None or pair.session != self.session:
+                    self._lost.set()
+                    return
+
+        threading.Thread(target=monitor, daemon=True).start()
+
+    def release(self) -> None:
+        if not self.is_held:
+            raise LockError("lock is not held")
+        self.is_held = False
+        try:
+            # Keep the lock flag on the entry so future contenders still see
+            # a lock-managed key (the reference's Unlock sends the full
+            # lockEntry).
+            self.c.kv.release(KVPair(key=self.key, value=self.value,
+                                     session=self.session,
+                                     flags=LOCK_FLAG_VALUE))
+        finally:
+            # Even if the release RPC failed, destroying the session frees
+            # the lock server-side (session invalidation cascade).
+            self._cleanup_session()
+
+    def destroy(self) -> None:
+        """Remove the lock entry if it isn't held (lock.go Destroy)."""
+        if self.is_held:
+            raise LockError("lock is held, release first")
+        pair, _ = self.c.kv.get(self.key)
+        if pair is None:
+            return
+        if pair.flags != LOCK_FLAG_VALUE:
+            raise LockError("existing key does not match lock use")
+        if pair.session:
+            raise LockError("lock in use")
+        if not self.c.kv.delete_cas(pair):
+            raise LockError("failed to remove lock entry")

@@ -1,0 +1,213 @@
+"""Slot-limited distributed semaphore on the KV + session substrate.
+
+Parity target: ``api/semaphore.go`` (135-247): each contender holds a
+session-bound entry under ``<prefix>/<session>``, and the shared state
+lives in ``<prefix>/.lock`` as JSON {"Limit": N, "Holders": {...}}
+updated by CAS.  Dead contenders vanish with their sessions; pruning
+happens on the next CAS.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from consul_tpu.api.client import APIError, Client, KVPair, QueryOptions
+
+SEMAPHORE_FLAG_VALUE = 0xE0F69A2BAA414DE0  # api/semaphore.go magic
+DEFAULT_SESSION_NAME = "Consul API Semaphore"
+DEFAULT_SESSION_TTL = "15s"
+DEFAULT_WAIT = 15.0
+
+
+class SemaphoreError(Exception):
+    pass
+
+
+class Semaphore:
+    def __init__(self, client: Client, prefix: str, limit: int,
+                 session_name: str = DEFAULT_SESSION_NAME,
+                 session_ttl: str = DEFAULT_SESSION_TTL,
+                 wait_time: float = DEFAULT_WAIT) -> None:
+        if not prefix:
+            raise SemaphoreError("missing prefix")
+        if limit <= 0:
+            raise SemaphoreError("semaphore limit must be positive")
+        self.c = client
+        self.prefix = prefix.rstrip("/")
+        self.limit = limit
+        self.session_name = session_name
+        self.session_ttl = session_ttl
+        self.wait_time = wait_time
+        self.session = ""
+        self.is_held = False
+        self._owns_session = False
+        self._renew_stop: Optional[threading.Event] = None
+        self._lost = threading.Event()
+
+    @property
+    def _lock_key(self) -> str:
+        return f"{self.prefix}/.lock"
+
+    @property
+    def _contender_key(self) -> str:
+        return f"{self.prefix}/{self.session}"
+
+    def _create_session(self) -> str:
+        sid = self.c.session.create({
+            "Name": self.session_name, "TTL": self.session_ttl,
+            "Behavior": "delete"})
+        self._owns_session = True
+        stop = threading.Event()
+        self._renew_stop = stop
+        ttl_s = float(self.session_ttl.rstrip("s"))
+
+        def renew_loop() -> None:
+            while not stop.wait(ttl_s / 2):
+                try:
+                    if self.c.session.renew(sid) is None:
+                        self._lost.set()  # session gone server-side
+                        return
+                except Exception:
+                    continue  # transport blip: retry next tick
+
+        threading.Thread(target=renew_loop, daemon=True).start()
+        return sid
+
+    def _cleanup_session(self) -> None:
+        if self._renew_stop is not None:
+            self._renew_stop.set()
+            self._renew_stop = None
+        if self._owns_session and self.session:
+            try:
+                self.c.session.destroy(self.session)
+            except APIError:
+                pass
+            self.session = ""
+            self._owns_session = False
+
+    # -- state helpers ------------------------------------------------------
+
+    def _live_sessions(self) -> set:
+        pairs, _ = self.c.kv.list(self.prefix)
+        return {p.session for p in pairs
+                if p.key != self._lock_key and p.session}
+
+    def _read_state(self) -> tuple:
+        pair, meta = self.c.kv.get(self._lock_key)
+        if pair is None:
+            return {"Limit": self.limit, "Holders": {}}, KVPair(
+                key=self._lock_key, flags=SEMAPHORE_FLAG_VALUE), meta
+        if pair.flags != SEMAPHORE_FLAG_VALUE:
+            raise SemaphoreError("existing key does not match semaphore use")
+        state = json.loads(pair.value.decode() or "{}")
+        state.setdefault("Limit", self.limit)
+        state.setdefault("Holders", {})
+        return state, pair, meta
+
+    def _write_state(self, state: Dict, pair: KVPair) -> bool:
+        return self.c.kv.cas(KVPair(
+            key=self._lock_key, flags=SEMAPHORE_FLAG_VALUE,
+            value=json.dumps(state).encode(),
+            modify_index=pair.modify_index))
+
+    # -- acquire / release --------------------------------------------------
+
+    def acquire(self, stop: Optional[threading.Event] = None
+                ) -> Optional[threading.Event]:
+        if self.is_held:
+            raise SemaphoreError("semaphore is already held")
+        if not self.session:
+            self.session = self._create_session()
+        self._lost.clear()
+
+        try:
+            # Contender entry bound to our session (semaphore.go:167-184).
+            if not self.c.kv.acquire(KVPair(
+                    key=self._contender_key, session=self.session,
+                    flags=SEMAPHORE_FLAG_VALUE)):
+                raise SemaphoreError("failed to create contender entry")
+
+            wait_index = 0
+            while stop is None or not stop.is_set():
+                state, pair, meta = self._read_state()
+                live = self._live_sessions()
+                holders = {s: True for s in state["Holders"] if s in live}
+                if len(holders) < state["Limit"]:
+                    holders[self.session] = True
+                    state["Holders"] = holders
+                    if self._write_state(state, pair):
+                        self.is_held = True
+                        self._start_monitor()
+                        return self._lost
+                    continue  # CAS race; retry immediately
+                # Slots full: block until the lock state changes.
+                wait_index = meta.last_index
+                self.c.kv.get(self._lock_key, QueryOptions(
+                    wait_index=wait_index, wait_time=self.wait_time))
+            return None
+        finally:
+            if not self.is_held:
+                self._abort_contender()
+
+    def _abort_contender(self) -> None:
+        try:
+            self.c.kv.delete(self._contender_key)
+        except APIError:
+            pass
+        self._cleanup_session()
+
+    def _start_monitor(self) -> None:
+        """Watch the lock state; fire lost if our session drops out."""
+
+        def monitor() -> None:
+            import time
+            wait_index = 0
+            while self.is_held:
+                try:
+                    pair, meta = self.c.kv.get(self._lock_key, QueryOptions(
+                        wait_index=wait_index, wait_time=self.wait_time))
+                except Exception:
+                    time.sleep(1.0)  # transport error: back off, re-watch
+                    continue
+                wait_index = meta.last_index
+                if not self.is_held:
+                    return
+                if pair is None:
+                    self._lost.set()
+                    return
+                state = json.loads(pair.value.decode() or "{}")
+                if self.session not in state.get("Holders", {}):
+                    self._lost.set()
+                    return
+
+        threading.Thread(target=monitor, daemon=True).start()
+
+    def release(self) -> None:
+        if not self.is_held:
+            raise SemaphoreError("semaphore is not held")
+        self.is_held = False
+        try:
+            while True:
+                state, pair, _ = self._read_state()
+                if self.session in state["Holders"]:
+                    del state["Holders"][self.session]
+                    if not self._write_state(state, pair):
+                        continue
+                break
+            self.c.kv.delete(self._contender_key)
+        finally:
+            # Session teardown frees the slot server-side even if the CAS
+            # dance above failed (delete-behavior session reaps the entry).
+            self._cleanup_session()
+
+    def destroy(self) -> None:
+        """Remove the semaphore prefix if nobody holds a slot."""
+        if self.is_held:
+            raise SemaphoreError("semaphore is held, release first")
+        state, pair, _ = self._read_state()
+        live = self._live_sessions()
+        if any(s in live for s in state["Holders"]):
+            raise SemaphoreError("semaphore in use")
+        self.c.kv.delete_tree(self.prefix + "/")

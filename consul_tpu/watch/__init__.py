@@ -1,0 +1,9 @@
+"""Watch framework: long-poll plans over the client SDK.
+
+Parity target: the reference's ``watch/`` package (439 LoC).
+"""
+
+from consul_tpu.watch.plan import WatchPlan, parse
+from consul_tpu.watch.handler import make_shell_handler
+
+__all__ = ["WatchPlan", "parse", "make_shell_handler"]

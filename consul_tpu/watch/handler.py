@@ -1,0 +1,41 @@
+"""Shell handler execution for watch firings.
+
+Parity target: ``command/agent/watch_handler.go:36-80`` — spawn the
+configured shell command per firing, JSON result on stdin,
+``CONSUL_INDEX`` in the environment.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import subprocess
+from typing import Any, Callable
+
+
+def _jsonable(value: Any) -> Any:
+    if isinstance(value, bytes):
+        return base64.b64encode(value).decode("ascii")
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    return value
+
+
+def make_shell_handler(script: str, timeout: float = 30.0
+                       ) -> Callable[[int, Any], None]:
+    def handler(index: int, result: Any) -> None:
+        env = dict(os.environ)
+        env["CONSUL_INDEX"] = str(index)
+        payload = json.dumps(_jsonable(result)).encode() + b"\n"
+        try:
+            subprocess.run(["/bin/sh", "-c", script], input=payload,
+                           env=env, timeout=timeout,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+
+    return handler

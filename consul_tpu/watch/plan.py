@@ -1,0 +1,228 @@
+"""Watch plans: parse generic params, run a blocking-query loop.
+
+Parity target: ``watch/watch.go`` (plan parse, :42-104),
+``watch/plan.go`` (run loop: index-change + DeepEqual dedup +
+exponential backoff to 10s, :23-97) and the 7 watch-type factories of
+``watch/funcs.go:16-193``: key, keyprefix, services, nodes, service,
+checks (by service or state), event.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from consul_tpu.api.client import Client, Config, QueryOptions
+
+MAX_BACKOFF = 10.0  # maxBackoffTime (plan.go:16)
+
+# watch type -> (required params, watcher factory)
+_FUNCS: Dict[str, Callable] = {}
+
+
+class WatchError(Exception):
+    pass
+
+
+def _register(name: str):
+    def deco(fn):
+        _FUNCS[name] = fn
+        return fn
+    return deco
+
+
+def _take(params: Dict[str, Any], key: str, required: bool = False,
+          default: Any = None) -> Any:
+    if key in params:
+        return params.pop(key)
+    if required:
+        raise WatchError(f"Must specify a single {key}")
+    return default
+
+
+class WatchPlan:
+    """One watch: type + params + handler, driven by blocking queries."""
+
+    def __init__(self, watch_type: str, watcher: Callable,
+                 params: Dict[str, Any]) -> None:
+        self.type = watch_type
+        self.watcher = watcher  # (client, q) -> (index, result)
+        self.params = params
+        self.handler: Optional[Callable[[int, Any], None]] = None
+        self.token: str = params.pop("token", "")
+        self.datacenter: str = params.pop("datacenter", "")
+        self._stop = threading.Event()
+        self.last_index = 0
+        self.last_result: Any = None
+        self._seen_first = False
+
+    # -- run loop (plan.go:23-97) ------------------------------------------
+
+    def run(self, address: str) -> None:
+        """Blocks until stop(); invokes handler on each observed change."""
+        client = Client(Config(address=address, token=self.token,
+                               datacenter=self.datacenter))
+        try:
+            failures = 0
+            while not self._stop.is_set():
+                q = QueryOptions(wait_index=self.last_index, wait_time=60.0,
+                                 token=self.token, datacenter=self.datacenter)
+                try:
+                    index, result = self.watcher(client, q)
+                except Exception:
+                    failures += 1
+                    backoff = min(MAX_BACKOFF, 0.25 * (2 ** failures))
+                    if self._stop.wait(backoff):
+                        break
+                    continue
+                failures = 0
+                if self._stop.is_set():
+                    break
+                # Index regression guard + dedup identical results
+                # (plan.go:71-85: skip when the index is unchanged, then
+                # skip when the result deep-equals the last one)
+                if index < self.last_index:
+                    index = 0
+                changed = (not self._seen_first
+                           or (index != self.last_index
+                               and result != self.last_result))
+                self.last_index = index
+                if changed:
+                    self._seen_first = True
+                    self.last_result = result
+                    if self.handler is not None:
+                        self.handler(index, result)
+        finally:
+            client.close()
+
+    def run_in_thread(self, address: str) -> threading.Thread:
+        t = threading.Thread(target=self.run, args=(address,), daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# -- factories (watch/funcs.go:16-193) --------------------------------------
+
+
+@_register("key")
+def _key_watch(params: Dict[str, Any]) -> Callable:
+    key = _take(params, "key", required=True)
+
+    def watcher(client: Client, q: QueryOptions) -> Tuple[int, Any]:
+        pair, meta = client.kv.get(key, q)
+        if pair is None:
+            return meta.last_index, None
+        return meta.last_index, {
+            "Key": pair.key, "Value": pair.value,
+            "Flags": pair.flags, "Session": pair.session,
+            "CreateIndex": pair.create_index, "ModifyIndex": pair.modify_index}
+
+    return watcher
+
+
+@_register("keyprefix")
+def _keyprefix_watch(params: Dict[str, Any]) -> Callable:
+    prefix = _take(params, "prefix", required=True)
+
+    def watcher(client: Client, q: QueryOptions) -> Tuple[int, Any]:
+        pairs, meta = client.kv.list(prefix, q)
+        return meta.last_index, [
+            {"Key": p.key, "Value": p.value, "ModifyIndex": p.modify_index}
+            for p in pairs]
+
+    return watcher
+
+
+@_register("services")
+def _services_watch(params: Dict[str, Any]) -> Callable:
+    def watcher(client: Client, q: QueryOptions) -> Tuple[int, Any]:
+        services, meta = client.catalog.services(q)
+        return meta.last_index, services
+
+    return watcher
+
+
+@_register("nodes")
+def _nodes_watch(params: Dict[str, Any]) -> Callable:
+    def watcher(client: Client, q: QueryOptions) -> Tuple[int, Any]:
+        nodes, meta = client.catalog.nodes(q)
+        return meta.last_index, nodes
+
+    return watcher
+
+
+@_register("service")
+def _service_watch(params: Dict[str, Any]) -> Callable:
+    service = _take(params, "service", required=True)
+    tag = _take(params, "tag", default="")
+    raw_passing = _take(params, "passingonly", default=False)
+    if isinstance(raw_passing, str):
+        if raw_passing.lower() not in ("true", "false"):
+            raise WatchError("passingonly must be a boolean")
+        passing = raw_passing.lower() == "true"
+    elif isinstance(raw_passing, bool):
+        passing = raw_passing
+    else:
+        raise WatchError("passingonly must be a boolean")
+
+    def watcher(client: Client, q: QueryOptions) -> Tuple[int, Any]:
+        entries, meta = client.health.service(service, tag, passing, q)
+        return meta.last_index, entries
+
+    return watcher
+
+
+@_register("checks")
+def _checks_watch(params: Dict[str, Any]) -> Callable:
+    service = _take(params, "service", default="")
+    state = _take(params, "state", default="")
+    if service and state:
+        raise WatchError("Cannot specify service and state")
+
+    def watcher(client: Client, q: QueryOptions) -> Tuple[int, Any]:
+        if service:
+            checks, meta = client.health.checks(service, q)
+        else:
+            checks, meta = client.health.state(state or "any", q)
+        return meta.last_index, checks
+
+    return watcher
+
+
+@_register("event")
+def _event_watch(params: Dict[str, Any]) -> Callable:
+    name = _take(params, "name", default="")
+
+    def watcher(client: Client, q: QueryOptions) -> Tuple[int, Any]:
+        events, meta = client.event.list(name, q)
+        return meta.last_index, events
+
+    return watcher
+
+
+def parse(params: Dict[str, Any]) -> WatchPlan:
+    """Build a plan from generic params (watch.go:42-104).  Unconsumed
+    keys are an error, matching the reference's strict parse."""
+    params = dict(params)
+    watch_type = params.pop("type", None)
+    if not watch_type:
+        raise WatchError("Must specify watch type")
+    factory = _FUNCS.get(watch_type)
+    if factory is None:
+        raise WatchError(f"Unsupported watch type: {watch_type}")
+    token = params.pop("token", "")
+    datacenter = params.pop("datacenter", "")
+    handler_cmd = params.pop("handler", None)
+    watcher = factory(params)  # factories pop the params they consume
+    if params:
+        raise WatchError(f"Invalid parameters: {sorted(params)}")
+    plan = WatchPlan(watch_type, watcher,
+                     {"token": token, "datacenter": datacenter})
+    if handler_cmd:
+        from consul_tpu.watch.handler import make_shell_handler
+        plan.handler = make_shell_handler(handler_cmd)
+    return plan

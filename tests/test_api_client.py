@@ -1,0 +1,195 @@
+"""Client SDK tests against a live agent (reference tier: api/*_test.go,
+which drives a forked consul binary; here the in-process AgentHarness
+plays that role)."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.api import (
+    APIError, Client, Config, KVPair, Lock, LockError, QueryOptions,
+    Semaphore)
+from tests.test_agent_http import AgentHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = AgentHarness().start()
+    yield h
+    h.stop()
+
+
+@pytest.fixture()
+def client(harness):
+    host, port = harness.agent.http.addr
+    c = Client(Config(address=f"{host}:{port}"))
+    yield c
+    c.close()
+
+
+class TestKV:
+    def test_put_get_delete(self, client):
+        assert client.kv.put(KVPair(key="sdk/a", value=b"hello", flags=42))
+        pair, meta = client.kv.get("sdk/a")
+        assert pair.value == b"hello" and pair.flags == 42
+        assert meta.last_index > 0 and meta.known_leader
+        assert client.kv.delete("sdk/a")
+        pair, _ = client.kv.get("sdk/a")
+        assert pair is None
+
+    def test_list_keys_cas(self, client):
+        for k in ("sdk/l/x", "sdk/l/y", "sdk/l/z/deep"):
+            client.kv.put(KVPair(key=k, value=b"v"))
+        pairs, _ = client.kv.list("sdk/l/")
+        assert [p.key for p in pairs] == ["sdk/l/x", "sdk/l/y", "sdk/l/z/deep"]
+        keys, _ = client.kv.keys("sdk/l/", separator="/")
+        assert keys == ["sdk/l/x", "sdk/l/y", "sdk/l/z/"]
+        pair, _ = client.kv.get("sdk/l/x")
+        assert client.kv.cas(KVPair(key="sdk/l/x", value=b"new",
+                                    modify_index=pair.modify_index))
+        # stale index loses
+        assert not client.kv.cas(KVPair(key="sdk/l/x", value=b"zzz",
+                                        modify_index=pair.modify_index))
+        client.kv.delete_tree("sdk/l/")
+        pairs, _ = client.kv.list("sdk/l/")
+        assert pairs == []
+
+    def test_blocking_query_wakes(self, client):
+        client.kv.put(KVPair(key="sdk/watch", value=b"1"))
+        pair, meta = client.kv.get("sdk/watch")
+
+        def writer():
+            time.sleep(0.2)
+            c2 = Client(Config(address=client.config.address))
+            c2.kv.put(KVPair(key="sdk/watch", value=b"2"))
+            c2.close()
+
+        threading.Thread(target=writer, daemon=True).start()
+        t0 = time.monotonic()
+        pair2, _ = client.kv.get("sdk/watch", QueryOptions(
+            wait_index=meta.last_index, wait_time=10.0))
+        elapsed = time.monotonic() - t0
+        assert pair2.value == b"2"
+        assert elapsed < 5.0  # woke on write, not timeout
+
+
+class TestAgentCatalogHealth:
+    def test_agent_surface(self, client):
+        assert client.agent.node_name() == "node1"
+        client.agent.service_register({
+            "ID": "sdkweb", "Name": "sdkweb", "Port": 80,
+            "Check": {"TTL": "30s"}})
+        assert "sdkweb" in client.agent.services()
+        client.agent.pass_ttl("service:sdkweb", note="ok")
+        assert client.agent.checks()["service:sdkweb"]["Status"] == "passing"
+        nodes, _ = client.health.service("sdkweb", passing_only=True)
+        deadline = time.monotonic() + 5
+        while not nodes and time.monotonic() < deadline:
+            time.sleep(0.1)
+            nodes, _ = client.health.service("sdkweb", passing_only=True)
+        assert nodes and nodes[0]["Service"]["ID"] == "sdkweb"
+        client.agent.fail_ttl("service:sdkweb")
+        client.agent.service_deregister("sdkweb")
+
+    def test_catalog_surface(self, client):
+        assert client.catalog.datacenters() == ["dc1"]
+        nodes, meta = client.catalog.nodes()
+        assert any(n["Node"] == "node1" for n in nodes)
+        services, _ = client.catalog.services()
+        assert "consul" in services
+        entries, _ = client.catalog.service("consul")
+        assert entries and entries[0]["ServicePort"] == 8300
+
+    def test_status_surface(self, client):
+        assert client.status.leader()
+        assert client.status.peers()
+
+
+class TestSessions:
+    def test_session_lifecycle(self, client):
+        sid = client.session.create({"Name": "sdk", "TTL": "30s"})
+        info, _ = client.session.info(sid)
+        assert info["Name"] == "sdk"
+        sessions, _ = client.session.list()
+        assert any(s["ID"] == sid for s in sessions)
+        renewed = client.session.renew(sid)
+        assert renewed["ID"] == sid
+        client.session.destroy(sid)
+        info, _ = client.session.info(sid)
+        assert info is None
+
+
+class TestLock:
+    def test_acquire_contend_release(self, client, harness):
+        host, port = harness.agent.http.addr
+        l1 = Lock(client, "sdk/locks/leader", value=b"n1")
+        lost1 = l1.acquire()
+        assert lost1 is not None and l1.is_held
+
+        # second contender blocks until release
+        c2 = Client(Config(address=f"{host}:{port}"))
+        l2 = Lock(c2, "sdk/locks/leader", value=b"n2", wait_time=1.0)
+        got2 = {}
+
+        def contender():
+            got2["lost"] = l2.acquire()
+
+        t = threading.Thread(target=contender, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not l2.is_held  # still blocked
+        l1.release()
+        t.join(15)
+        assert l2.is_held and got2["lost"] is not None
+        l2.release()
+        c2.close()
+
+    def test_lost_on_session_destroy(self, client, harness):
+        host, port = harness.agent.http.addr
+        lock = Lock(client, "sdk/locks/ephemeral", wait_time=1.0)
+        lost = lock.acquire()
+        assert lock.is_held
+        # kill the session out from under the lock
+        c2 = Client(Config(address=f"{host}:{port}"))
+        c2.session.destroy(lock.session)
+        assert lost.wait(10), "lost-lock event did not fire"
+        lock.is_held = False
+        c2.close()
+
+    def test_flag_mismatch_rejected(self, client):
+        client.kv.put(KVPair(key="sdk/locks/plain", value=b"x"))
+        lock = Lock(client, "sdk/locks/plain", wait_time=0.5)
+        with pytest.raises(LockError):
+            lock.acquire()
+
+
+class TestSemaphore:
+    def test_slots(self, client, harness):
+        host, port = harness.agent.http.addr
+        clients = [Client(Config(address=f"{host}:{port}")) for _ in range(3)]
+        sems = [Semaphore(c, "sdk/sema", limit=2, wait_time=1.0)
+                for c in clients]
+        assert sems[0].acquire() is not None
+        assert sems[1].acquire() is not None
+
+        got3 = {}
+
+        def third():
+            got3["lost"] = sems[2].acquire()
+
+        t = threading.Thread(target=third, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not sems[2].is_held  # both slots taken
+        sems[0].release()
+        t.join(15)
+        assert sems[2].is_held
+        sems[1].release()
+        sems[2].release()
+        for c in clients:
+            c.close()
+
+    def test_limit_validation(self, client):
+        with pytest.raises(Exception):
+            Semaphore(client, "sdk/sema2", limit=0)

@@ -1,0 +1,163 @@
+"""Watch framework tests (reference tier: watch/*_test.go against a
+test agent)."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.api import Client, Config, KVPair
+from consul_tpu.watch import WatchPlan, parse
+from consul_tpu.watch.plan import WatchError
+from tests.test_agent_http import AgentHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = AgentHarness().start()
+    yield h
+    h.stop()
+
+
+@pytest.fixture()
+def addr(harness):
+    host, port = harness.agent.http.addr
+    return f"{host}:{port}"
+
+
+@pytest.fixture()
+def client(addr):
+    c = Client(Config(address=addr))
+    yield c
+    c.close()
+
+
+def _collect(plan, addr, n_events, timeout=10.0):
+    """Run a plan in a thread; return the first n_events firings."""
+    events = []
+    got = threading.Event()
+
+    def handler(index, result):
+        events.append((index, result))
+        if len(events) >= n_events:
+            got.set()
+            plan.stop()
+
+    plan.handler = handler
+    plan.run_in_thread(addr)
+    got.wait(timeout)
+    plan.stop()
+    return events
+
+
+class TestParse:
+    def test_unknown_type(self):
+        with pytest.raises(WatchError):
+            parse({"type": "bogus"})
+
+    def test_missing_type(self):
+        with pytest.raises(WatchError):
+            parse({})
+
+    def test_missing_required(self):
+        with pytest.raises(WatchError):
+            parse({"type": "key"})
+
+    def test_extra_params_rejected(self):
+        with pytest.raises(WatchError):
+            parse({"type": "key", "key": "a", "bogus": 1})
+
+    def test_checks_exclusive(self):
+        with pytest.raises(WatchError):
+            parse({"type": "checks", "service": "a", "state": "passing"})
+
+    def test_all_seven_types(self):
+        for params in (
+                {"type": "key", "key": "k"},
+                {"type": "keyprefix", "prefix": "p/"},
+                {"type": "services"},
+                {"type": "nodes"},
+                {"type": "service", "service": "web"},
+                {"type": "checks", "state": "passing"},
+                {"type": "event", "name": "deploy"},
+        ):
+            assert parse(params) is not None
+
+
+class TestRun:
+    def test_key_watch_fires_on_change(self, client, addr):
+        client.kv.put(KVPair(key="w/key1", value=b"v0"))
+        plan = parse({"type": "key", "key": "w/key1"})
+
+        def writer():
+            time.sleep(0.4)
+            c = Client(Config(address=addr))
+            c.kv.put(KVPair(key="w/key1", value=b"v1"))
+            c.close()
+
+        threading.Thread(target=writer, daemon=True).start()
+        events = _collect(plan, addr, 2)
+        assert len(events) >= 2
+        assert events[0][1]["Value"] == b"v0"   # initial state
+        assert events[1][1]["Value"] == b"v1"   # the change
+
+    def test_keyprefix_watch(self, client, addr):
+        plan = parse({"type": "keyprefix", "prefix": "w/tree/"})
+
+        def writer():
+            time.sleep(0.4)
+            c = Client(Config(address=addr))
+            c.kv.put(KVPair(key="w/tree/a", value=b"1"))
+            c.close()
+
+        threading.Thread(target=writer, daemon=True).start()
+        events = _collect(plan, addr, 2)
+        assert len(events) >= 2
+        assert any(e["Key"] == "w/tree/a" for e in events[-1][1])
+
+    def test_service_watch(self, client, addr):
+        plan = parse({"type": "service", "service": "wsvc"})
+
+        def register():
+            time.sleep(0.4)
+            c = Client(Config(address=addr))
+            c.agent.service_register({"ID": "wsvc", "Name": "wsvc", "Port": 1})
+            c.close()
+
+        threading.Thread(target=register, daemon=True).start()
+        events = _collect(plan, addr, 2)
+        assert events[0][1] == []  # before registration
+        assert any(e["Service"]["ID"] == "wsvc" for e in events[-1][1])
+        client.agent.service_deregister("wsvc")
+
+    def test_checks_state_watch(self, client, addr):
+        plan = parse({"type": "checks", "state": "warning"})
+
+        def register():
+            time.sleep(0.4)
+            c = Client(Config(address=addr))
+            c.agent.check_register({"Name": "wchk", "TTL": "30s"})
+            c.warn_ttl = c.agent.warn_ttl("wchk", note="careful")
+            c.close()
+
+        threading.Thread(target=register, daemon=True).start()
+        events = _collect(plan, addr, 2)
+        assert any(ch["CheckID"] == "wchk" for ch in events[-1][1])
+        client.agent.check_deregister("wchk")
+
+    def test_shell_handler(self, client, addr, tmp_path):
+        out_file = tmp_path / "fired"
+        plan = parse({
+            "type": "key", "key": "w/handler",
+            "handler": f'cat > {out_file}; echo "$CONSUL_INDEX" >> {out_file}'})
+        client.kv.put(KVPair(key="w/handler", value=b"x"))
+        plan.run_in_thread(addr)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not out_file.exists():
+            time.sleep(0.1)
+        plan.stop()
+        assert out_file.exists()
+        content = out_file.read_text()
+        assert '"Key": "w/handler"' in content
+        # CONSUL_INDEX env appended as the last line
+        assert int(content.strip().rsplit("\n", 1)[-1]) > 0
