@@ -97,6 +97,11 @@ class Agent:
                              only_passing=self.config.dns_only_passing)
         self.local = LocalState(self, sync_interval=self.config.ae_interval)
         self.runners = CheckRunnerSet()
+        from consul_tpu.agent.events import EventManager
+        from consul_tpu.agent.remote_exec import RemoteExecutor
+        self.events = EventManager(self)
+        self.rexec = RemoteExecutor(self)
+        self.server.add_event_sink(self._receive_event)
 
     @property
     def node_name(self) -> str:
@@ -166,6 +171,21 @@ class Agent:
     def cluster_size(self) -> int:
         idx, nodes = self.server.store.nodes()
         return max(1, len(nodes))
+
+    # -- user events (user_event.go receive path) ---------------------------
+
+    async def broadcast_event(self, event) -> None:
+        """Fire through the server's event plane (Internal.EventFire)."""
+        await self.server.fire_user_event(event)
+
+    def _receive_event(self, event) -> None:
+        """Gossip/local delivery: filter against local state, then ingest
+        into the ring (handleEvents → ingestUserEvent)."""
+        if self.events.should_process(event):
+            self.events.ingest(event)
+
+    async def handle_remote_exec(self, event) -> None:
+        await self.rexec.handle(event)
 
     # -- service/check registry (agent.go:54-99 API) ------------------------
 
@@ -375,6 +395,8 @@ class Agent:
         router.add_put("/v1/agent/maintenance", h(self._node_maintenance))
         router.add_put("/v1/agent/join/{address}", h(self._join))
         router.add_put("/v1/agent/force-leave/{node}", h(self._force_leave))
+        router.add_put("/v1/event/fire/{name}", h(self._event_fire))
+        router.add_get("/v1/event/list", h(self._event_list))
 
     async def _self(self, request):
         """/v1/agent/self (agent_endpoint.go:24-34): config + stats."""
@@ -511,6 +533,47 @@ class Agent:
         else:
             self.disable_node_maintenance()
         return ""
+
+    async def _event_fire(self, request):
+        """PUT /v1/event/fire/{name} (event_endpoint.go:24-88)."""
+        from consul_tpu.server.endpoints import EndpointError
+        from consul_tpu.structs.structs import UserEvent
+        q = request.query
+        event = UserEvent(
+            name=request.match_info["name"],
+            payload=await request.read(),
+            node_filter=q.get("node", ""),
+            service_filter=q.get("service", ""),
+            tag_filter=q.get("tag", ""))
+        try:
+            eid = await self.events.fire(event)
+        except ValueError as e:
+            raise EndpointError(str(e))
+        return {"ID": eid, "Name": event.name,
+                "Payload": to_api(event.payload) if event.payload else None,
+                "NodeFilter": event.node_filter,
+                "ServiceFilter": event.service_filter,
+                "TagFilter": event.tag_filter,
+                "Version": event.version, "LTime": 0}
+
+    async def _event_list(self, request):
+        """GET /v1/event/list with blocking support
+        (event_endpoint.go:90-170)."""
+        name = request.query.get("name", "")
+        opts = self.http._query_opts(request)  # validated index/wait -> 400
+        if opts.min_query_index:
+            await self.events.wait_for_change(
+                opts.min_query_index, opts.max_query_time or 300.0)
+        out = [{
+            "ID": e.id, "Name": e.name,
+            "Payload": to_api(e.payload) if e.payload else None,
+            "NodeFilter": e.node_filter, "ServiceFilter": e.service_filter,
+            "TagFilter": e.tag_filter, "Version": e.version,
+            "LTime": e.ltime,
+        } for e in self.events.events(name)]
+        from consul_tpu.structs.structs import QueryMeta
+        meta = QueryMeta(index=self.events.index, known_leader=True)
+        return self.http._json(request, out, meta)
 
     async def _join(self, request):
         """Gossip join lands with the network membership layer; the

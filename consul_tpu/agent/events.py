@@ -1,0 +1,126 @@
+"""User events: fire, filter, buffer, and the remote-exec hook.
+
+Parity target: ``command/agent/user_event.go`` (268 LoC) — the
+UserEvent struct with node/service/tag regex filters (:19-44), the
+fire path through Internal.EventFire per-DC, and the receive path that
+validates filters against local state, stores into a 256-slot ring
+buffer, and notifies event watches; ``_rexec`` events are intercepted
+for remote execution (remote_exec.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import uuid
+from typing import List, Optional
+
+from consul_tpu.structs.structs import UserEvent
+
+USER_EVENT_BUFFER = 256   # ring size (agent.go:87-94)
+REMOTE_EXEC_EVENT = "_rexec"
+
+
+class EventManager:
+    """Owns the agent's received-event ring + lamport-ish event ids."""
+
+    def __init__(self, agent) -> None:
+        self.agent = agent
+        self._ring: List[UserEvent] = []
+        self._index = 0          # monotonic, serves blocking /v1/event/list
+        self._waiters: List[asyncio.Future] = []
+        self._ltime = 0
+        self._tasks: set = set()  # strong refs to in-flight rexec handlers
+
+    # -- fire path (user_event.go UserEvent + internal EventFire) -----------
+
+    def validate(self, event: UserEvent) -> None:
+        if not event.name:
+            raise ValueError("User event missing name")
+        for pat, what in ((event.node_filter, "node"),
+                          (event.service_filter, "service"),
+                          (event.tag_filter, "tag")):
+            if pat:
+                try:
+                    re.compile(pat)
+                except re.error as e:
+                    raise ValueError(f"Invalid {what} filter: {e}")
+        if event.tag_filter and not event.service_filter:
+            raise ValueError("Cannot provide tag filter without service filter")
+
+    async def fire(self, event: UserEvent) -> str:
+        """Assign id + lamport time, broadcast (gossip once the network
+        membership layer lands; local delivery always)."""
+        self.validate(event)
+        if not event.id:
+            event.id = str(uuid.uuid4())
+        self._ltime += 1
+        event.ltime = self._ltime
+        await self.agent.broadcast_event(event)
+        return event.id
+
+    # -- receive path (ingestUserEvent, user_event.go:120-210) --------------
+
+    def should_process(self, event: UserEvent) -> bool:
+        """Apply node/service/tag regex filters against local state."""
+        if event.node_filter and not re.search(event.node_filter,
+                                               self.agent.node_name):
+            return False
+        if event.service_filter:
+            matched = False
+            for svc in self.agent.local.services.values():
+                if re.search(event.service_filter, svc.service):
+                    if event.tag_filter:
+                        if any(re.search(event.tag_filter, t)
+                               for t in svc.tags):
+                            matched = True
+                            break
+                    else:
+                        matched = True
+                        break
+            if not matched:
+                return False
+        return True
+
+    def ingest(self, event: UserEvent) -> None:
+        """Store into the ring and wake blocking list queries."""
+        if event.name == REMOTE_EXEC_EVENT:
+            task = asyncio.get_event_loop().create_task(
+                self.agent.handle_remote_exec(event))
+            # asyncio keeps only weak refs; anchor until done so the job
+            # can't be garbage-collected mid-run.
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            return
+        self._ring.append(event)
+        if len(self._ring) > USER_EVENT_BUFFER:
+            self._ring = self._ring[-USER_EVENT_BUFFER:]
+        self._index += 1
+        waiters, self._waiters = self._waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    # -- blocking list (event_endpoint.go:90-170) ---------------------------
+
+    @property
+    def index(self) -> int:
+        return self._index
+
+    def events(self, name: str = "") -> List[UserEvent]:
+        if name:
+            return [e for e in self._ring if e.name == name]
+        return list(self._ring)
+
+    async def wait_for_change(self, min_index: int, max_wait: float) -> None:
+        if self._index > min_index:
+            return
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, max_wait)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            if fut in self._waiters:
+                self._waiters.remove(fut)

@@ -1,0 +1,142 @@
+"""Remote execution: run a shell job delivered by a ``_rexec`` event and
+stream results back through the KV store.
+
+Parity target: ``command/agent/remote_exec.go`` (321 LoC): on a
+``_rexec`` event the agent fetches the job spec from KV
+``<prefix>/<session>/job``, verifies the session is still alive, writes
+an ack under ``<prefix>/<session>/<node>/ack``, spawns the shell, and
+streams chunked output (4KB / 500ms flush, :28-37) to
+``.../<node>/out/<NNNNN>`` plus the exit code to ``.../<node>/exit``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from consul_tpu.structs.structs import (
+    DirEntry, KVSOp, KVSRequest, KeyRequest, MessageType, UserEvent)
+
+CHUNK_SIZE = 4 * 1024        # remoteExecOutputSize
+FLUSH_INTERVAL = 0.5         # remoteExecOutputDeadline
+EXEC_TIMEOUT = 60.0
+
+
+class RemoteExecutor:
+    """One agent's _rexec handler; KV access goes through the embedded
+    server (client mode will route via RPC)."""
+
+    def __init__(self, agent) -> None:
+        self.agent = agent
+
+    async def _kv_get(self, key: str) -> Optional[DirEntry]:
+        _, ents = await self.agent.server.kvs.get(KeyRequest(key=key))
+        return ents[0] if ents else None
+
+    async def _kv_put(self, key: str, value: bytes,
+                      session: str = "") -> bool:
+        """Session-acquired writes (the reference acquires every result key
+        with the job session) so Behavior=delete reaps them with the job."""
+        d = DirEntry(key=key, value=value)
+        op = KVSOp.SET.value
+        if session:
+            d.session = session
+            op = KVSOp.LOCK.value
+        return bool(await self.agent.server.kvs.apply(
+            KVSRequest(op=op, dir_ent=d)))
+
+    async def handle(self, event: UserEvent) -> None:
+        """handleRemoteExec (remote_exec.go:53-145)."""
+        try:
+            payload = json.loads(event.payload.decode() or "{}")
+            prefix = payload.get("Prefix", "_rexec")
+            session = payload.get("Session", "")
+            if not session:
+                return
+            # Verify the session is still alive — the orchestrator holds it
+            # for the job's lifetime (remote_exec.go:76-90).
+            _, sess = self.agent.server.store.session_get(session)
+            if sess is None:
+                return
+            spec_ent = await self._kv_get(f"{prefix}/{session}/job")
+            if spec_ent is None:
+                return
+            spec = json.loads(spec_ent.value.decode())
+            cmd = spec.get("Command", "")
+            if not cmd:
+                return
+            node = self.agent.node_name
+            if not await self._kv_put(f"{prefix}/{session}/{node}/ack", b"",
+                                      session=session):
+                return  # session died while acking; job is void
+            await self._run(prefix, session, node, cmd,
+                            spec.get("Wait", 0) or EXEC_TIMEOUT)
+        except (json.JSONDecodeError, ValueError):
+            return
+
+    async def _run(self, prefix: str, session: str, node: str,
+                   cmd: str, timeout: float) -> None:
+        """Spawn + stream (remote_exec.go:147-260)."""
+        try:
+            proc = await asyncio.create_subprocess_shell(
+                cmd, stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT)
+        except OSError:
+            await self._kv_put(f"{prefix}/{session}/{node}/exit",
+                               str(127).encode(), session=session)
+            return
+
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        chunk_idx = 0
+        buf = b""
+        last_flush = loop.time()
+
+        async def flush(force: bool = False) -> None:
+            nonlocal buf, chunk_idx, last_flush
+            now = loop.time()
+            if buf and (force or len(buf) >= CHUNK_SIZE
+                        or now - last_flush >= FLUSH_INTERVAL):
+                await self._kv_put(
+                    f"{prefix}/{session}/{node}/out/{chunk_idx:05x}", buf,
+                    session=session)
+                chunk_idx += 1
+                buf = b""
+                last_flush = now
+
+        # The deadline bounds the WHOLE run, not just the post-EOF wait —
+        # a never-exiting command must not leak a subprocess per job.
+        timed_out = False
+        while True:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                timed_out = True
+                break
+            try:
+                data = await asyncio.wait_for(
+                    proc.stdout.read(CHUNK_SIZE),
+                    min(FLUSH_INTERVAL, remaining))
+            except asyncio.TimeoutError:
+                await flush()
+                continue
+            if not data:
+                break
+            buf += data
+            await flush()
+        if not timed_out:
+            try:
+                await asyncio.wait_for(proc.wait(),
+                                       max(0.0, deadline - loop.time()))
+            except asyncio.TimeoutError:
+                timed_out = True
+        if timed_out:
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            await proc.wait()
+        await flush(force=True)
+        code = proc.returncode if proc.returncode is not None else 0
+        await self._kv_put(f"{prefix}/{session}/{node}/exit",
+                           str(code).encode(), session=session)

@@ -1,0 +1,119 @@
+"""Remote-exec orchestration: the client side of ``consul exec``.
+
+Parity target: ``command/exec.go`` (128-601): create a short-TTL
+session (+renew), upload the job spec to KV ``_rexec/<session>/job``,
+fire the ``_rexec`` user event, then poll the KV prefix streaming
+acks / output chunks / exit codes until the quiet-wait elapses.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from consul_tpu.api.client import Client, KVPair, QueryOptions
+
+REXEC_PREFIX = "_rexec"
+SESSION_TTL = "15s"
+QUIET_WAIT = 2.0          # rExecQuietWait: no new data -> done
+DEFAULT_WAIT = 60.0
+
+
+@dataclass
+class ExecResult:
+    acks: List[str] = field(default_factory=list)
+    outputs: Dict[str, bytes] = field(default_factory=dict)
+    exits: Dict[str, int] = field(default_factory=dict)
+
+
+class ExecJob:
+    def __init__(self, client: Client, command: str,
+                 node_filter: str = "", service_filter: str = "",
+                 tag_filter: str = "", wait: float = DEFAULT_WAIT) -> None:
+        self.c = client
+        self.command = command
+        self.node_filter = node_filter
+        self.service_filter = service_filter
+        self.tag_filter = tag_filter
+        self.wait = wait
+
+    def run(self, on_output: Optional[Callable[[str, bytes], None]] = None,
+            on_exit: Optional[Callable[[str, int], None]] = None
+            ) -> ExecResult:
+        session = self.c.session.create({
+            "Name": "Remote Exec", "TTL": SESSION_TTL,
+            "Behavior": "delete"})
+        stop_renew = threading.Event()
+
+        def renew_loop() -> None:
+            while not stop_renew.wait(5.0):
+                try:
+                    if self.c.session.renew(session) is None:
+                        return
+                except Exception:
+                    continue
+
+        threading.Thread(target=renew_loop, daemon=True).start()
+        try:
+            return self._run(session, on_output, on_exit)
+        finally:
+            stop_renew.set()
+            try:
+                self.c.session.destroy(session)
+            except Exception:
+                pass
+
+    def _run(self, session: str, on_output, on_exit) -> ExecResult:
+        prefix = f"{REXEC_PREFIX}/{session}"
+        # Upload the spec (exec.go:547-575), then announce it.
+        spec = json.dumps({"Command": self.command,
+                           "Wait": self.wait}).encode()
+        if not self.c.kv.acquire(KVPair(key=f"{prefix}/job", value=spec,
+                                        session=session)):
+            raise RuntimeError("failed to upload exec spec")
+        self.c.event.fire(
+            REXEC_PREFIX,
+            payload=json.dumps({"Prefix": REXEC_PREFIX,
+                                "Session": session}).encode(),
+            node_filter=self.node_filter,
+            service_filter=self.service_filter,
+            tag_filter=self.tag_filter)
+
+        # Poll the prefix, streaming results (waitForJob, exec.go:251-416).
+        result = ExecResult()
+        seen: set = set()
+        deadline = time.monotonic() + self.wait
+        last_activity = time.monotonic()
+        wait_index = 0
+        while time.monotonic() < deadline:
+            pairs, meta = self.c.kv.list(prefix + "/", QueryOptions(
+                wait_index=wait_index, wait_time=1.0))
+            wait_index = meta.last_index
+            for p in pairs:
+                if p.key in seen or p.key == f"{prefix}/job":
+                    continue
+                seen.add(p.key)
+                last_activity = time.monotonic()
+                rel = p.key[len(prefix) + 1:]
+                parts = rel.split("/")
+                if parts[-1] == "ack":
+                    result.acks.append(parts[0])
+                elif parts[-1] == "exit":
+                    code = int(p.value.decode() or "0")
+                    result.exits[parts[0]] = code
+                    if on_exit:
+                        on_exit(parts[0], code)
+                elif len(parts) >= 2 and parts[1] == "out":
+                    node = parts[0]
+                    result.outputs[node] = result.outputs.get(node, b"") + p.value
+                    if on_output:
+                        on_output(node, p.value)
+            # All acked nodes have exited and things are quiet -> done.
+            done = (result.acks
+                    and all(n in result.exits for n in result.acks))
+            if done and time.monotonic() - last_activity >= QUIET_WAIT:
+                break
+        return result

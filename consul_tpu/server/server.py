@@ -83,6 +83,9 @@ class Server:
                              snap_store=snap_store)
         self.leader_duties = LeaderDuties(self)
         self.raft.on_leader_change(self.leader_duties.on_leader_change)
+        # User-event delivery targets (the agent registers; the gossip
+        # plane will too once cross-node fan-out lands).
+        self.event_sinks: List[Any] = []
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
@@ -184,8 +187,14 @@ class Server:
         self.leader_duties.clear_session_timer(sid)
 
     async def fire_user_event(self, event) -> None:
-        """Broadcast via the gossip plane (consul/internal_endpoint.go
-        EventFire); local-only until the event pipeline lands."""
+        """Broadcast a user event (consul/internal_endpoint.go EventFire →
+        serf.UserEvent).  Delivers to every registered sink; the gossip
+        plane adds cross-node fan-out when it lands."""
+        for sink in self.event_sinks:
+            sink(event)
+
+    def add_event_sink(self, sink) -> None:
+        self.event_sinks.append(sink)
 
     def stats(self) -> Dict[str, Dict[str, str]]:
         """``consul info`` payload (consul/server.go:709-726)."""

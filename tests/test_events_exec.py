@@ -1,0 +1,123 @@
+"""User events + remote exec tests (reference tier:
+command/agent/user_event_test.go, remote_exec_test.go, exec e2e)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from consul_tpu.api import Client, Config
+from consul_tpu.api.exec import ExecJob
+from tests.test_agent_http import AgentHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = AgentHarness().start()
+    yield h
+    h.stop()
+
+
+@pytest.fixture()
+def client(harness):
+    host, port = harness.agent.http.addr
+    c = Client(Config(address=f"{host}:{port}"))
+    yield c
+    c.close()
+
+
+class TestUserEvents:
+    def test_fire_and_list(self, client):
+        eid = client.event.fire("deploy", payload=b"v1.2.3")
+        assert eid
+        events, meta = client.event.list()
+        assert any(e["ID"] == eid for e in events)
+        assert meta.last_index > 0
+        got = [e for e in events if e["ID"] == eid][0]
+        assert got["Name"] == "deploy"
+        import base64
+        assert base64.b64decode(got["Payload"]) == b"v1.2.3"
+        assert got["LTime"] > 0
+
+    def test_name_filter_in_list(self, client):
+        client.event.fire("alpha")
+        client.event.fire("beta")
+        events, _ = client.event.list("alpha")
+        assert events and all(e["Name"] == "alpha" for e in events)
+
+    def test_node_filter_drops_event(self, client):
+        # our node is node1; a filter for another node must not be ingested
+        client.event.fire("targeted", node_filter="^other-node$")
+        events, _ = client.event.list("targeted")
+        assert events == []
+        # matching filter is delivered
+        client.event.fire("targeted2", node_filter="^node1$")
+        events, _ = client.event.list("targeted2")
+        assert len(events) == 1
+
+    def test_service_filter(self, client, harness):
+        client.agent.service_register({"ID": "evsvc", "Name": "evsvc",
+                                       "Port": 1, "Tags": ["blue"]})
+        client.event.fire("svc-ev", service_filter="^evsvc$")
+        events, _ = client.event.list("svc-ev")
+        assert len(events) == 1
+        # tag filter mismatch drops
+        client.event.fire("svc-ev-tag", service_filter="^evsvc$",
+                          tag_filter="^green$")
+        events, _ = client.event.list("svc-ev-tag")
+        assert events == []
+        client.event.fire("svc-ev-tag2", service_filter="^evsvc$",
+                          tag_filter="^blue$")
+        events, _ = client.event.list("svc-ev-tag2")
+        assert len(events) == 1
+        client.agent.service_deregister("evsvc")
+
+    def test_tag_without_service_rejected(self, client):
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError) as ei:
+            client.event.fire("bad", tag_filter="x")
+        assert ei.value.status == 400
+
+    def test_blocking_list(self, client):
+        events, meta = client.event.list()
+        idx = meta.last_index
+
+        def firer():
+            time.sleep(0.3)
+            c2 = Client(Config(address=client.config.address))
+            c2.event.fire("wakeup")
+            c2.close()
+
+        threading.Thread(target=firer, daemon=True).start()
+        t0 = time.monotonic()
+        from consul_tpu.api.client import QueryOptions
+        events, meta2 = client.event.list(q=QueryOptions(
+            wait_index=idx, wait_time=10.0))
+        assert time.monotonic() - t0 < 5.0
+        assert meta2.last_index > idx
+
+
+class TestRemoteExec:
+    def test_exec_roundtrip(self, client):
+        job = ExecJob(client, "echo exec-says-hi", wait=15.0)
+        result = job.run()
+        assert result.acks == ["node1"]
+        assert result.exits == {"node1": 0}
+        assert b"exec-says-hi" in result.outputs.get("node1", b"")
+
+    def test_exec_exit_code(self, client):
+        job = ExecJob(client, "exit 3", wait=15.0)
+        result = job.run()
+        assert result.exits == {"node1": 3}
+
+    def test_exec_node_filter_excludes(self, client):
+        job = ExecJob(client, "echo hi", node_filter="^not-us$", wait=3.0)
+        result = job.run()
+        assert result.acks == [] and result.exits == {}
+
+    def test_rexec_not_in_event_ring(self, client):
+        """_rexec events are intercepted, never listed (user_event.go)."""
+        ExecJob(client, "true", wait=10.0).run()
+        events, _ = client.event.list("_rexec")
+        assert events == []
