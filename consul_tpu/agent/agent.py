@@ -98,10 +98,16 @@ class Agent:
         self.local = LocalState(self, sync_interval=self.config.ae_interval)
         self.runners = CheckRunnerSet()
         from consul_tpu.agent.events import EventManager
+        from consul_tpu.agent.log import LogHub
         from consul_tpu.agent.remote_exec import RemoteExecutor
+        from consul_tpu.ipc.server import IPCServer
         self.events = EventManager(self)
         self.rexec = RemoteExecutor(self)
         self.server.add_event_sink(self._receive_event)
+        self.log = LogHub(self.config.extra.get("log_level", "INFO"))
+        self.ipc = IPCServer(self)
+        self.ipc_port: Optional[int] = self.config.extra.get("ipc_port")
+        self._left: Optional[asyncio.Event] = None  # armed in start()
 
     @property
     def node_name(self) -> str:
@@ -114,6 +120,8 @@ class Agent:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
+        self._left = asyncio.Event()
+        self.log.info(f"consul-tpu agent running, node={self.config.node_name}")
         await self.server.start()
         await self.server.wait_for_leader()
         await self._register_self()
@@ -121,13 +129,76 @@ class Agent:
         self.local.start()
         await self.http.start(self.config.bind_addr, self.config.http_port)
         await self.dns.start(self.config.bind_addr, self.config.dns_port)
+        if self.ipc_port is not None:
+            await self.ipc.start(self.config.bind_addr, self.ipc_port)
 
     async def stop(self) -> None:
         self.runners.stop_all()
         self.local.stop()
+        await self.ipc.stop()
         await self.dns.stop()
         await self.http.stop()
         await self.server.stop()
+
+    async def wait_for_leave(self) -> None:
+        """Block until graceful_leave fires (the daemon's signal loop)."""
+        if self._left is not None:
+            await self._left.wait()
+
+    # -- IPC-facing operations (command/agent/rpc.go dispatch targets) ------
+
+    async def join(self, addrs: List[str], wan: bool = False) -> int:
+        """Gossip join; real network membership lands with the gossip
+        transport.  Single-node agents join nobody."""
+        self.log.info(f"agent: join {'wan ' if wan else ''}{addrs}")
+        return 0
+
+    def lan_members(self) -> List[Dict[str, Any]]:
+        return [{
+            "Name": self.config.node_name,
+            "Addr": self.config.advertise_addr,
+            "Port": 8301,
+            "Status": "alive",
+            "ProtocolCur": 2,
+            "Tags": {"role": "consul" if self.config.server else "node",
+                     "dc": self.config.datacenter},
+        }]
+
+    def wan_members(self) -> List[Dict[str, Any]]:
+        if not self.config.server:
+            return []
+        m = self.lan_members()[0].copy()
+        m["Name"] = f"{self.config.node_name}.{self.config.datacenter}"
+        m["Port"] = 8302
+        return [m]
+
+    async def graceful_leave(self) -> None:
+        """Leave choreography (consul/server.go:516-581): deregister, then
+        signal the daemon loop to shut down."""
+        self.log.info("agent: requesting graceful leave")
+        if self._left is not None:
+            self._left.set()
+
+    async def force_leave(self, node: str) -> None:
+        self.log.info(f"agent: force leave {node}")
+
+    async def reload(self) -> None:
+        """SIGHUP/IPC reload (command.go:835-908): re-sync local state.
+        The daemon wrapper re-reads config files and re-registers
+        services/checks/watches around this hook."""
+        self.log.info("agent: reloading")
+        self.local.resume()
+
+    def log_sink_add(self, sink, level: str = "INFO") -> None:
+        self.log.add_sink(sink, level)
+
+    def log_sink_remove(self, sink) -> None:
+        self.log.remove_sink(sink)
+
+    async def keyring_operation(self, op: str, key: str = "") -> Dict[str, Any]:
+        """Gossip-keyring ops; the encryption keyring lands with the
+        network gossip layer (agent/keyring.go)."""
+        raise ValueError("keyring not configured (gossip encryption disabled)")
 
     async def _register_self(self) -> None:
         """What handleAliveMember does for each live node on the leader

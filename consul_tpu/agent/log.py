@@ -1,0 +1,69 @@
+"""Agent logging: leveled hub with live sinks for ``monitor``.
+
+Parity target: the reference's logging plumbing
+(``command/agent/log_writer.go`` fan-out to monitors, ``log_levels.go``
+level filter, ``gated_writer.go``): a ring of recent lines plus
+attachable sinks, each with its own level filter — the IPC ``monitor``
+command streams through one.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Tuple
+
+LEVELS = {"TRACE": 0, "DEBUG": 1, "INFO": 2, "WARN": 3, "ERR": 4}
+RING = 512  # logBuffer default (log_writer.go:14)
+
+
+class LogHub:
+    def __init__(self, level: str = "INFO") -> None:
+        self.level = LEVELS.get(level.upper(), 2)
+        self._ring: List[Tuple[int, str]] = []  # (level, line)
+        self._sinks: List[Tuple[Callable[[str], None], int]] = []
+
+    def log(self, level: str, msg: str) -> None:
+        lvl = LEVELS.get(level.upper(), 2)
+        if lvl < self.level:
+            return
+        stamp = time.strftime("%Y/%m/%d %H:%M:%S")
+        line = f"{stamp} [{level.upper()}] {msg}"
+        self._ring.append((lvl, line))
+        if len(self._ring) > RING:
+            self._ring = self._ring[-RING:]
+        for sink, sink_lvl in list(self._sinks):
+            if lvl >= sink_lvl:
+                try:
+                    sink(line)
+                except Exception:
+                    self.remove_sink(sink)
+
+    def info(self, msg: str) -> None:
+        self.log("INFO", msg)
+
+    def warn(self, msg: str) -> None:
+        self.log("WARN", msg)
+
+    def err(self, msg: str) -> None:
+        self.log("ERR", msg)
+
+    def debug(self, msg: str) -> None:
+        self.log("DEBUG", msg)
+
+    def add_sink(self, sink: Callable[[str], None],
+                 level: str = "INFO", replay: bool = True) -> None:
+        """Attach a live sink; replays the ring first (logWriter behavior:
+        monitors see recent history)."""
+        lvl = LEVELS.get(level.upper(), 2)
+        if replay:
+            for line_lvl, line in self._ring:
+                if line_lvl < lvl:
+                    continue  # honor the sink's filter during replay too
+                try:
+                    sink(line)
+                except Exception:
+                    return
+        self._sinks.append((sink, lvl))
+
+    def remove_sink(self, sink: Callable[[str], None]) -> None:
+        self._sinks = [(s, l) for s, l in self._sinks if s is not sink]

@@ -1,0 +1,2 @@
+"""Command-line interface: the reference's 16 subcommands
+(``commands.go:19-141``) plus ``version``."""

@@ -1,0 +1,589 @@
+"""CLI entry point and subcommand dispatch.
+
+Parity target: ``main.go`` + ``commands.go:19-141`` + ``command/``
+(2654 LoC): agent, configtest, event, exec, force-leave, info, join,
+keygen, keyring, leave, lock, maint, members, monitor, reload,
+version, watch.  Cluster-facing commands use the HTTP SDK
+(``-http-addr``) or the IPC socket (``-rpc-addr``), matching which
+surface the reference command uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from consul_tpu.version import VERSION
+
+DEFAULT_HTTP = "127.0.0.1:8500"
+DEFAULT_RPC = "127.0.0.1:8400"
+
+
+def _http_client(args):
+    from consul_tpu.api import Client, Config
+    return Client(Config(address=args.http_addr,
+                         token=getattr(args, "token", "") or ""))
+
+
+def _ipc(args):
+    from consul_tpu.ipc import IPCClient
+    return IPCClient(args.rpc_addr)
+
+
+def _add_http_flag(p) -> None:
+    p.add_argument("-http-addr", dest="http_addr", default=DEFAULT_HTTP)
+    p.add_argument("-token", dest="token", default="")
+
+
+def _add_rpc_flag(p) -> None:
+    p.add_argument("-rpc-addr", dest="rpc_addr", default=DEFAULT_RPC)
+
+
+# -- agent (the daemon; command/agent/command.go serve choreography) --------
+
+
+def cmd_agent(args) -> int:
+    import asyncio
+
+    from consul_tpu.agent.agent import Agent
+    from consul_tpu.agent.config import (
+        Config, decode_config, merge_config, read_config_paths,
+        to_agent_config, validate_config)
+
+    cfg = Config()
+    if args.config_file or args.config_dir:
+        paths = list(args.config_file or []) + list(args.config_dir or [])
+        cfg = read_config_paths(paths)
+    # flag overlay (flags beat files, command.go readConfig)
+    flag_doc = {}
+    for name, value in (("node_name", args.node), ("datacenter", args.dc),
+                        ("data_dir", args.data_dir),
+                        ("client_addr", args.client),
+                        ("bind_addr", args.bind)):
+        if value:
+            flag_doc[name] = value
+    if args.server:
+        flag_doc["server"] = True
+    if args.bootstrap:
+        flag_doc["bootstrap"] = True
+    if flag_doc:
+        cfg = merge_config(cfg, decode_config(json.dumps(flag_doc)))
+    if not cfg.server and not cfg.bootstrap:
+        # dev-style default: single node agent is a bootstrap server
+        cfg.server = cfg.bootstrap = True
+    problems = validate_config(cfg)
+    if problems:
+        for p in problems:
+            print(f"==> {p}", file=sys.stderr)
+        return 1
+
+    acfg = to_agent_config(cfg)
+    if args.http_port is not None:
+        acfg.http_port = args.http_port
+    if args.dns_port is not None:
+        acfg.dns_port = args.dns_port
+    acfg.extra["ipc_port"] = (args.rpc_port if args.rpc_port is not None
+                              else cfg.ports.rpc)
+    acfg.extra["log_level"] = cfg.log_level
+
+    agent = Agent(acfg)
+
+    async def serve() -> None:
+        await agent.start()
+        print(f"==> consul-tpu agent running! Node: {acfg.node_name}, "
+              f"HTTP: {agent.http.addr}, DNS: {agent.dns.addr}, "
+              f"IPC: {agent.ipc.addr}")
+        sys.stdout.flush()
+        # register config-defined services/checks/watches (command.go
+        # serve: service/check stanzas + watch plans :710-718)
+        from consul_tpu.agent.agent import _check_type_from_api
+        from consul_tpu.structs.structs import HealthCheck, NodeService
+
+        def norm(d):
+            return {k[0].upper() + k[1:] if k and k[0].islower() else k: v
+                    for k, v in d.items()}
+
+        for svc in cfg.services:
+            raw = norm(svc)
+            service = NodeService(
+                id=raw.get("Id", raw.get("ID", "")),
+                service=raw.get("Name", ""), tags=raw.get("Tags") or [],
+                port=raw.get("Port", 0))
+            cts = []
+            if raw.get("Check"):
+                cts.append(_check_type_from_api(norm(raw["Check"])))
+            await agent.add_service(service, cts, persist=False)
+        for chk in cfg.checks:
+            raw = norm(chk)
+            ct = _check_type_from_api(raw)
+            check = HealthCheck(
+                node=acfg.node_name,
+                check_id=raw.get("Id", raw.get("ID", "")) or raw.get("Name", ""),
+                name=raw.get("Name", ""), notes=raw.get("Notes", ""))
+            await agent.add_check(check, ct if ct.valid() else None,
+                                  persist=False)
+        watch_plans = []
+        if cfg.watches:
+            from consul_tpu.watch import parse as watch_parse
+            http_addr = "%s:%s" % agent.http.addr
+            for wp in cfg.watches:
+                plan = watch_parse(dict(wp))
+                plan.run_in_thread(http_addr)
+                watch_plans.append(plan)
+        loop = asyncio.get_event_loop()
+        stop = asyncio.Event()
+
+        def on_term() -> None:
+            stop.set()
+
+        def on_hup() -> None:
+            loop.create_task(agent.reload())
+
+        loop.add_signal_handler(signal.SIGINT, on_term)
+        loop.add_signal_handler(signal.SIGTERM, on_term)
+        loop.add_signal_handler(signal.SIGHUP, on_hup)
+        leave_task = loop.create_task(agent.wait_for_leave())
+        stop_task = loop.create_task(stop.wait())
+        await asyncio.wait({leave_task, stop_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        print("==> Gracefully shutting down...")
+        for plan in watch_plans:
+            plan.stop()
+        await agent.stop()
+
+    asyncio.run(serve())
+    return 0
+
+
+# -- configtest --------------------------------------------------------------
+
+
+def cmd_configtest(args) -> int:
+    from consul_tpu.agent.config import (
+        ConfigError, read_config_paths, validate_config)
+    paths = list(args.config_file or []) + list(args.config_dir or [])
+    if not paths:
+        print("Must specify config file or directory", file=sys.stderr)
+        return 1
+    try:
+        cfg = read_config_paths(paths)
+    except (ConfigError, OSError) as e:
+        print(f"Config validation failed: {e}", file=sys.stderr)
+        return 1
+    problems = validate_config(cfg)
+    if problems:
+        for p in problems:
+            print(f"Config validation failed: {p}", file=sys.stderr)
+        return 1
+    print("Configuration is valid!")
+    return 0
+
+
+# -- event -------------------------------------------------------------------
+
+
+def cmd_event(args) -> int:
+    with _http_client(args) as c:
+        eid = c.event.fire(args.name, payload=(args.payload or "").encode(),
+                           node_filter=args.node or "",
+                           service_filter=args.service or "",
+                           tag_filter=args.tag or "")
+    print(f"Event ID: {eid}")
+    return 0
+
+
+# -- exec --------------------------------------------------------------------
+
+
+def cmd_exec(args) -> int:
+    from consul_tpu.api.exec import ExecJob
+    command = " ".join(args.command)
+    if not command:
+        print("Must specify a command to execute", file=sys.stderr)
+        return 1
+    with _http_client(args) as c:
+        job = ExecJob(c, command, node_filter=args.node or "",
+                      service_filter=args.service or "",
+                      tag_filter=args.tag or "", wait=args.wait)
+
+        def on_output(node: str, chunk: bytes) -> None:
+            for line in chunk.decode(errors="replace").splitlines():
+                print(f"    {node}: {line}")
+
+        def on_exit(node: str, code: int) -> None:
+            print(f"==> {node}: finished with exit code {code}")
+
+        result = job.run(on_output=on_output, on_exit=on_exit)
+    n_done = len(result.exits)
+    print(f"{n_done} / {len(result.acks) or n_done} node(s) completed / "
+          f"acknowledged")
+    return 0 if all(c == 0 for c in result.exits.values()) else 2
+
+
+# -- membership commands (IPC) ----------------------------------------------
+
+
+def cmd_force_leave(args) -> int:
+    with _ipc(args) as c:
+        c.force_leave(args.node)
+    return 0
+
+
+def cmd_info(args) -> int:
+    with _ipc(args) as c:
+        stats = c.stats()
+    for section in sorted(stats):
+        print(f"{section}:")
+        for k in sorted(stats[section]):
+            print(f"\t{k} = {stats[section][k]}")
+    return 0
+
+
+def cmd_join(args) -> int:
+    with _ipc(args) as c:
+        n = c.join(args.address, wan=args.wan)
+    print(f"Successfully joined cluster by contacting {n} nodes.")
+    return 0
+
+
+def cmd_leave(args) -> int:
+    with _ipc(args) as c:
+        c.leave()
+    print("Graceful leave complete")
+    return 0
+
+
+def cmd_members(args) -> int:
+    with _ipc(args) as c:
+        members = c.members_wan() if args.wan else c.members_lan()
+    for m in members:
+        tags = ",".join(f"{k}={v}" for k, v in sorted(m.get("Tags", {}).items()))
+        print(f"{m['Name']:<20} {m['Addr']}:{m['Port']:<6} "
+              f"{m.get('Status', '?'):<8} {tags}")
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    with _ipc(args) as c:
+        done = threading.Event()
+
+        def handler(line: str) -> None:
+            print(line)
+
+        c.monitor(handler, log_level=args.log_level)
+        try:
+            while not done.is_set():
+                c.pump(timeout=1.0)
+        except KeyboardInterrupt:
+            return 0
+        except Exception as e:
+            print(f"Error streaming logs: {e}", file=sys.stderr)
+            return 1
+    return 0
+
+
+def cmd_reload(args) -> int:
+    with _ipc(args) as c:
+        c.reload()
+    print("Configuration reload triggered")
+    return 0
+
+
+# -- keygen / keyring --------------------------------------------------------
+
+
+def cmd_keygen(args) -> int:
+    print(base64.b64encode(os.urandom(16)).decode("ascii"))
+    return 0
+
+
+def cmd_keyring(args) -> int:
+    ops = [(args.install, "install"), (args.use, "use"),
+           (args.remove, "remove")]
+    chosen = [(v, op) for v, op in ops if v]
+    if len(chosen) > 1 or (chosen and args.list):
+        print("Only a single action is allowed", file=sys.stderr)
+        return 1
+    with _ipc(args) as c:
+        try:
+            if args.list:
+                result = c.keyring("list")
+                for key, count in result.get("Keys", {}).items():
+                    print(f"  {key} [{count}]")
+            elif chosen:
+                key, op = chosen[0]
+                c.keyring(op, key)
+                print("Done!")
+            else:
+                print("Must specify an action", file=sys.stderr)
+                return 1
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    return 0
+
+
+# -- lock --------------------------------------------------------------------
+
+
+def cmd_lock(args) -> int:
+    """Lock (or semaphore with -n>1) holder spawning a child process
+    (command/lock.go:73-339)."""
+    import subprocess
+
+    from consul_tpu.api import Lock, Semaphore
+    child_cmd = " ".join(args.child)
+    if not child_cmd:
+        print("Must specify a command to run", file=sys.stderr)
+        return 1
+    with _http_client(args) as c:
+        prefix = args.prefix.strip("/")
+        if args.n > 1:
+            holder = Semaphore(c, prefix, args.n)
+        else:
+            holder = Lock(c, f"{prefix}/.lock")
+        lost = holder.acquire()
+        if lost is None:
+            print("Failed to acquire lock", file=sys.stderr)
+            return 1
+        try:
+            proc = subprocess.Popen(["/bin/sh", "-c", child_cmd])
+            while True:
+                try:
+                    code = proc.wait(timeout=0.5)
+                    break
+                except subprocess.TimeoutExpired:
+                    if lost.is_set():
+                        proc.terminate()
+                        code = proc.wait()
+                        print("Lock lost, child terminated", file=sys.stderr)
+                        return 1
+            return code
+        finally:
+            if holder.is_held:
+                holder.release()
+
+
+# -- maint -------------------------------------------------------------------
+
+
+def cmd_maint(args) -> int:
+    with _http_client(args) as c:
+        if args.enable and args.disable:
+            print("Only one of -enable or -disable may be provided",
+                  file=sys.stderr)
+            return 1
+        if not args.enable and not args.disable:
+            # show current maintenance state
+            checks = c.agent.checks()
+            found = False
+            for cid, chk in checks.items():
+                if cid == "_node_maintenance":
+                    print("Node:")
+                    print(f"  Name:   {chk.get('Node', '')}")
+                    print(f"  Reason: {chk.get('Notes', '')}")
+                    found = True
+                elif cid.startswith("_service_maintenance:"):
+                    print("Service:")
+                    print(f"  ID:     {cid.split(':', 1)[1]}")
+                    print(f"  Reason: {chk.get('Notes', '')}")
+                    found = True
+            if not found:
+                print("Node and all services are in normal mode.")
+            return 0
+        if args.service:
+            if args.enable:
+                c.agent.enable_service_maintenance(args.service,
+                                                   args.reason or "")
+            else:
+                c.agent.disable_service_maintenance(args.service)
+        else:
+            if args.enable:
+                c.agent.enable_node_maintenance(args.reason or "")
+            else:
+                c.agent.disable_node_maintenance()
+    print("Maintenance mode updated")
+    return 0
+
+
+# -- version / watch ---------------------------------------------------------
+
+
+def cmd_version(args) -> int:
+    print(f"consul-tpu v{VERSION}")
+    return 0
+
+
+def cmd_watch(args) -> int:
+    from consul_tpu.watch import parse
+    params = {"type": args.type}
+    for name in ("key", "prefix", "service", "tag", "state", "name"):
+        v = getattr(args, name, None)
+        if v:
+            params[name] = v
+    if args.passingonly:
+        params["passingonly"] = True
+    if args.handler:
+        params["handler"] = args.handler
+    try:
+        plan = parse(params)
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if not args.handler:
+        plan.handler = lambda idx, result: print(
+            json.dumps(_jsonable(result), indent=2))
+    try:
+        plan.run(args.http_addr)
+    except KeyboardInterrupt:
+        plan.stop()
+    return 0
+
+
+def _jsonable(v):
+    if isinstance(v, bytes):
+        return base64.b64encode(v).decode()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+# -- dispatch ----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="consul-tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("agent", help="Runs an agent")
+    p.add_argument("-config-file", action="append", dest="config_file")
+    p.add_argument("-config-dir", action="append", dest="config_dir")
+    p.add_argument("-node", default="")
+    p.add_argument("-dc", default="")
+    p.add_argument("-data-dir", dest="data_dir", default="")
+    p.add_argument("-client", default="")
+    p.add_argument("-bind", default="")
+    p.add_argument("-server", action="store_true")
+    p.add_argument("-bootstrap", action="store_true")
+    p.add_argument("-http-port", dest="http_port", type=int, default=None)
+    p.add_argument("-dns-port", dest="dns_port", type=int, default=None)
+    p.add_argument("-rpc-port", dest="rpc_port", type=int, default=None)
+    p.set_defaults(fn=cmd_agent)
+
+    p = sub.add_parser("configtest", help="Validates config files/dirs")
+    p.add_argument("-config-file", action="append", dest="config_file")
+    p.add_argument("-config-dir", action="append", dest="config_dir")
+    p.set_defaults(fn=cmd_configtest)
+
+    p = sub.add_parser("event", help="Fire a user event")
+    _add_http_flag(p)
+    p.add_argument("-name", required=True)
+    p.add_argument("-payload", default="")
+    p.add_argument("-node", default="")
+    p.add_argument("-service", default="")
+    p.add_argument("-tag", default="")
+    p.set_defaults(fn=cmd_event)
+
+    p = sub.add_parser("exec", help="Remote execution across the cluster")
+    _add_http_flag(p)
+    p.add_argument("-node", default="")
+    p.add_argument("-service", default="")
+    p.add_argument("-tag", default="")
+    p.add_argument("-wait", type=float, default=60.0)
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("force-leave", help="Force a member to leave")
+    _add_rpc_flag(p)
+    p.add_argument("node")
+    p.set_defaults(fn=cmd_force_leave)
+
+    p = sub.add_parser("info", help="Agent runtime info")
+    _add_rpc_flag(p)
+    p.set_defaults(fn=cmd_info)
+
+    p = sub.add_parser("join", help="Join a cluster")
+    _add_rpc_flag(p)
+    p.add_argument("-wan", action="store_true")
+    p.add_argument("address", nargs="+")
+    p.set_defaults(fn=cmd_join)
+
+    p = sub.add_parser("keygen", help="Generate a gossip encryption key")
+    p.set_defaults(fn=cmd_keygen)
+
+    p = sub.add_parser("keyring", help="Manage gossip keyring")
+    _add_rpc_flag(p)
+    p.add_argument("-install", default="")
+    p.add_argument("-use", default="")
+    p.add_argument("-remove", default="")
+    p.add_argument("-list", action="store_true")
+    p.set_defaults(fn=cmd_keyring)
+
+    p = sub.add_parser("leave", help="Gracefully leave the cluster")
+    _add_rpc_flag(p)
+    p.set_defaults(fn=cmd_leave)
+
+    p = sub.add_parser("lock", help="Run a command holding a lock")
+    _add_http_flag(p)
+    p.add_argument("-n", type=int, default=1,
+                   help="semaphore slots (1 = mutex)")
+    p.add_argument("prefix")
+    p.add_argument("child", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_lock)
+
+    p = sub.add_parser("maint", help="Maintenance mode control")
+    _add_http_flag(p)
+    p.add_argument("-enable", action="store_true")
+    p.add_argument("-disable", action="store_true")
+    p.add_argument("-reason", default="")
+    p.add_argument("-service", default="")
+    p.set_defaults(fn=cmd_maint)
+
+    p = sub.add_parser("members", help="List cluster members")
+    _add_rpc_flag(p)
+    p.add_argument("-wan", action="store_true")
+    p.set_defaults(fn=cmd_members)
+
+    p = sub.add_parser("monitor", help="Stream agent logs")
+    _add_rpc_flag(p)
+    p.add_argument("-log-level", dest="log_level", default="INFO")
+    p.set_defaults(fn=cmd_monitor)
+
+    p = sub.add_parser("reload", help="Trigger config reload")
+    _add_rpc_flag(p)
+    p.set_defaults(fn=cmd_reload)
+
+    p = sub.add_parser("version", help="Print version")
+    p.set_defaults(fn=cmd_version)
+
+    p = sub.add_parser("watch", help="Run a watch plan")
+    _add_http_flag(p)
+    p.add_argument("-type", required=True)
+    p.add_argument("-key", default="")
+    p.add_argument("-prefix", default="")
+    p.add_argument("-service", default="")
+    p.add_argument("-tag", default="")
+    p.add_argument("-state", default="")
+    p.add_argument("-name", default="")
+    p.add_argument("-passingonly", action="store_true")
+    p.add_argument("-handler", default="")
+    p.set_defaults(fn=cmd_watch)
+
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""CLI-RPC (IPC): msgpack seq-based request/response + streaming.
+
+Parity target: ``command/agent/rpc.go`` (701 LoC) + ``rpc_client.go``
+(473) — the agent-side command socket the CLI talks to.
+"""
+
+from consul_tpu.ipc.server import IPCServer
+from consul_tpu.ipc.client import IPCClient, IPCError
+
+__all__ = ["IPCServer", "IPCClient", "IPCError"]

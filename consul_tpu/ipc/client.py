@@ -1,0 +1,160 @@
+"""CLI-side IPC client (sync).
+
+Parity target: ``command/agent/rpc_client.go`` (473 LoC): dial,
+handshake, seq-matched request/response, and the monitor stream
+(a handler receives out-of-band log records until stopped).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+
+class IPCError(Exception):
+    pass
+
+
+class IPCClient:
+    def __init__(self, addr: str, timeout: float = 10.0) -> None:
+        host, _, port = addr.rpartition(":")
+        self._sock = socket.create_connection((host or "127.0.0.1",
+                                               int(port)), timeout=timeout)
+        self._unpacker = msgpack.Unpacker(raw=False)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._monitor_handler: Optional[Callable[[str], None]] = None
+        self._monitor_seq: Optional[int] = None
+        self._handshake()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "IPCClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- wire helpers -------------------------------------------------------
+
+    def _next_obj(self) -> Any:
+        while True:
+            try:
+                return next(self._unpacker)
+            except StopIteration:
+                data = self._sock.recv(4096)
+                if not data:
+                    raise IPCError("connection closed")
+                self._unpacker.feed(data)
+
+    def _send(self, *objs: Any) -> None:
+        buf = b"".join(msgpack.packb(o, use_bin_type=True) for o in objs)
+        self._sock.sendall(buf)
+
+    def _read_response(self, want_seq: int, has_body: bool) -> Any:
+        """Read headers until ours arrives; dispatch monitor records that
+        interleave (rpc_client.go listen/seq-matching)."""
+        while True:
+            header = self._next_obj()
+            seq = header.get("Seq")
+            err = header.get("Error", "")
+            if seq == self._monitor_seq and seq != want_seq:
+                body = self._next_obj()
+                if self._monitor_handler and "Log" in body:
+                    self._monitor_handler(body["Log"])
+                continue
+            if seq != want_seq:
+                # Stale monitor record after stop: swallow its body.
+                continue
+            if err:
+                raise IPCError(err)
+            return self._next_obj() if has_body else None
+
+    def _call(self, command: str, body: Any = None,
+              has_resp_body: bool = False) -> Any:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            objs: List[Any] = [{"Command": command, "Seq": seq}]
+            if body is not None:
+                objs.append(body)
+            self._send(*objs)
+            return self._read_response(seq, has_resp_body)
+
+    def _handshake(self) -> None:
+        self._call("handshake", {"Version": 1})
+
+    # -- commands -----------------------------------------------------------
+
+    def join(self, addrs: List[str], wan: bool = False) -> int:
+        resp = self._call("join", {"Existing": addrs, "WAN": wan},
+                          has_resp_body=True)
+        return resp.get("Num", 0)
+
+    def members_lan(self) -> List[Dict[str, Any]]:
+        return self._call("members-lan", None,
+                          has_resp_body=True).get("Members", [])
+
+    def members_wan(self) -> List[Dict[str, Any]]:
+        return self._call("members-wan", None,
+                          has_resp_body=True).get("Members", [])
+
+    def stats(self) -> Dict[str, Dict[str, str]]:
+        return self._call("stats", None, has_resp_body=True)
+
+    def leave(self) -> None:
+        self._call("leave")
+
+    def force_leave(self, node: str) -> None:
+        self._call("force-leave", {"Node": node})
+
+    def reload(self) -> None:
+        self._call("reload")
+
+    def monitor(self, handler: Callable[[str], None],
+                log_level: str = "INFO") -> int:
+        """Start streaming logs to handler; returns the monitor seq for
+        stop()."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._send({"Command": "monitor", "Seq": seq},
+                       {"LogLevel": log_level})
+            self._monitor_handler = handler
+            self._monitor_seq = seq
+            self._read_response(seq, has_body=False)
+        return seq
+
+    def pump(self, timeout: Optional[float] = None) -> bool:
+        """Process one incoming record (monitor logs); returns False on
+        timeout."""
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            header = self._next_obj()
+        except socket.timeout:
+            return False
+        finally:
+            self._sock.settimeout(None)
+        if header.get("Seq") == self._monitor_seq:
+            body = self._next_obj()
+            if self._monitor_handler and "Log" in body:
+                self._monitor_handler(body["Log"])
+        return True
+
+    def stop_monitor(self, seq: int) -> None:
+        self._call("stop", {"Stop": seq})
+        self._monitor_handler = None
+        self._monitor_seq = None
+
+    def keyring(self, op: str, key: str = "") -> Dict[str, Any]:
+        cmd = {"install": "install-key", "use": "use-key",
+               "remove": "remove-key", "list": "list-keys"}[op]
+        return self._call(cmd, {"Key": key}, has_resp_body=True)

@@ -1,0 +1,193 @@
+"""Agent-side IPC server.
+
+Parity target: ``command/agent/rpc.go``: msgpack request/response
+with client-assigned sequence numbers over TCP (or a unix socket),
+version handshake, and the command set at :45-59 —
+handshake, join, members-lan, members-wan, monitor, stop, leave,
+force-leave, stats, reload, keyring ops.  ``monitor`` subscribes the
+connection to the agent's log stream; records flow as out-of-band
+{Seq: <monitor seq>} headers + log body until ``stop``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import msgpack
+
+MIN_IPC_VERSION = 1
+MAX_IPC_VERSION = 1
+
+COMMANDS = ("handshake", "join", "members-lan", "members-wan", "monitor",
+            "stop", "leave", "force-leave", "stats", "reload",
+            "install-key", "use-key", "remove-key", "list-keys")
+
+
+class IPCServer:
+    def __init__(self, agent) -> None:
+        self.agent = agent
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[tuple] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 8400) -> None:
+        self._server = await asyncio.start_server(self._serve, host, port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        conn = _Conn(self.agent, reader, writer)
+        try:
+            await conn.run()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            # A dropped monitor client must not leak its log sink.
+            for sink in conn._monitors.values():
+                self.agent.log_sink_remove(sink)
+            conn._monitors.clear()
+            writer.close()
+
+
+class _Conn:
+    def __init__(self, agent, reader, writer) -> None:
+        self.agent = agent
+        self.reader = reader
+        self.writer = writer
+        self.unpacker = msgpack.Unpacker(raw=False)
+        self.did_handshake = False
+        self._monitors: Dict[int, Any] = {}  # monitor seq -> log sink
+
+    async def _next_obj(self) -> Any:
+        while True:
+            try:
+                return next(self.unpacker)
+            except StopIteration:
+                data = await self.reader.read(4096)
+                if not data:
+                    raise ConnectionError("client closed")
+                self.unpacker.feed(data)
+
+    def _send(self, *objs: Any) -> None:
+        for obj in objs:
+            self.writer.write(msgpack.packb(obj, use_bin_type=True))
+
+    async def run(self) -> None:
+        while True:
+            header = await self._next_obj()
+            command = header.get("Command", "")
+            seq = header.get("Seq", 0)
+            if command != "handshake" and not self.did_handshake:
+                self._send({"Seq": seq, "Error": "Handshake required"})
+                await self.writer.drain()
+                continue
+            handler = getattr(self, "_cmd_" + command.replace("-", "_"), None)
+            if handler is None:
+                self._send({"Seq": seq, "Error": f"Unknown command: {command}"})
+            else:
+                try:
+                    await handler(seq)
+                except Exception as e:
+                    self._send({"Seq": seq, "Error": str(e)})
+            await self.writer.drain()
+
+    # -- commands -----------------------------------------------------------
+
+    async def _cmd_handshake(self, seq: int) -> None:
+        req = await self._next_obj()
+        version = req.get("Version", 0)
+        if not (MIN_IPC_VERSION <= version <= MAX_IPC_VERSION):
+            self._send({"Seq": seq,
+                        "Error": f"Unsupported version: {version}"})
+            return
+        self.did_handshake = True
+        self._send({"Seq": seq, "Error": ""})
+
+    async def _cmd_join(self, seq: int) -> None:
+        req = await self._next_obj()
+        addrs = req.get("Existing", [])
+        n = await self.agent.join(addrs, wan=req.get("WAN", False))
+        self._send({"Seq": seq, "Error": ""}, {"Num": n})
+
+    async def _cmd_members_lan(self, seq: int) -> None:
+        members = self.agent.lan_members()
+        self._send({"Seq": seq, "Error": ""}, {"Members": members})
+
+    async def _cmd_members_wan(self, seq: int) -> None:
+        members = self.agent.wan_members()
+        self._send({"Seq": seq, "Error": ""}, {"Members": members})
+
+    async def _cmd_stats(self, seq: int) -> None:
+        self._send({"Seq": seq, "Error": ""}, self.agent.server.stats())
+
+    async def _cmd_leave(self, seq: int) -> None:
+        self._send({"Seq": seq, "Error": ""})
+        await self.writer.drain()
+        await self.agent.graceful_leave()
+
+    async def _cmd_force_leave(self, seq: int) -> None:
+        req = await self._next_obj()
+        await self.agent.force_leave(req.get("Node", ""))
+        self._send({"Seq": seq, "Error": ""})
+
+    async def _cmd_reload(self, seq: int) -> None:
+        await self.agent.reload()
+        self._send({"Seq": seq, "Error": ""})
+
+    async def _cmd_monitor(self, seq: int) -> None:
+        req = await self._next_obj()
+        level = req.get("LogLevel", "INFO")
+
+        def sink(line: str) -> None:
+            try:
+                self._send({"Seq": seq, "Error": ""}, {"Log": line})
+                loop = asyncio.get_event_loop()
+                loop.create_task(_drain(self.writer))
+            except Exception:
+                pass
+
+        # Ack FIRST: the client reads one header as the command response;
+        # replayed ring lines must come after it or the stream desyncs.
+        self._send({"Seq": seq, "Error": ""})
+        await self.writer.drain()
+        self._monitors[seq] = sink
+        self.agent.log_sink_add(sink, level)
+
+    async def _cmd_stop(self, seq: int) -> None:
+        req = await self._next_obj()
+        target = req.get("Stop", 0)
+        sink = self._monitors.pop(target, None)
+        if sink is not None:
+            self.agent.log_sink_remove(sink)
+        self._send({"Seq": seq, "Error": ""})
+
+    # -- keyring ops (wired to the gossip keyring when it lands) ------------
+
+    async def _keyring(self, seq: int, op: str) -> None:
+        req = await self._next_obj()
+        result = await self.agent.keyring_operation(op, req.get("Key", ""))
+        self._send({"Seq": seq, "Error": ""}, result)
+
+    async def _cmd_install_key(self, seq: int) -> None:
+        await self._keyring(seq, "install")
+
+    async def _cmd_use_key(self, seq: int) -> None:
+        await self._keyring(seq, "use")
+
+    async def _cmd_remove_key(self, seq: int) -> None:
+        await self._keyring(seq, "remove")
+
+    async def _cmd_list_keys(self, seq: int) -> None:
+        await self._keyring(seq, "list")
+
+
+async def _drain(writer: asyncio.StreamWriter) -> None:
+    try:
+        await writer.drain()
+    except ConnectionError:
+        pass

@@ -1,0 +1,203 @@
+"""CLI + IPC tests.
+
+Tier 4 of SURVEY.md §4: black-box tests that fork/exec the real CLI
+binary (`python -m consul_tpu.cli.main agent ...`) and drive it over
+HTTP/IPC — the closest equivalent of testutil.TestServer's forked
+consul binary."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from consul_tpu.ipc import IPCClient, IPCError
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "PYTHONPATH": os.path.dirname(os.path.dirname(__file__))}
+ENV.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def _cli(*args, timeout=30, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "consul_tpu.cli.main", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV, **kw)
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    """Fork/exec a real agent daemon (testutil/server.go:133-142 shape)."""
+    data_dir = tmp_path_factory.mktemp("agent-data")
+    http, dns, rpc = _free_port(), _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "consul_tpu.cli.main", "agent",
+         "-server", "-bootstrap", "-node", "cli-node",
+         "-data-dir", str(data_dir),
+         "-http-port", str(http), "-dns-port", str(dns),
+         "-rpc-port", str(rpc)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=ENV)
+    # wait for the ready banner
+    deadline = time.time() + 30
+    import httpx
+    while time.time() < deadline:
+        try:
+            r = httpx.get(f"http://127.0.0.1:{http}/v1/status/leader",
+                          timeout=1.0)
+            if r.status_code == 200 and r.json():
+                break
+        except Exception:
+            pass
+        if proc.poll() is not None:
+            out = proc.stdout.read()
+            raise RuntimeError(f"agent died: {out}")
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError("agent never became ready")
+    yield {"http": f"127.0.0.1:{http}", "rpc": f"127.0.0.1:{rpc}",
+           "proc": proc}
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+class TestCLIBasics:
+    def test_version(self):
+        r = _cli("version")
+        assert r.returncode == 0 and "consul-tpu v" in r.stdout
+
+    def test_keygen(self):
+        import base64
+        r = _cli("keygen")
+        assert r.returncode == 0
+        assert len(base64.b64decode(r.stdout.strip())) == 16
+
+    def test_configtest_valid(self, tmp_path):
+        f = tmp_path / "good.json"
+        f.write_text('{"server": true, "bootstrap": true}')
+        r = _cli("configtest", "-config-file", str(f))
+        assert r.returncode == 0 and "valid" in r.stdout
+
+    def test_configtest_invalid(self, tmp_path):
+        f = tmp_path / "bad.json"
+        f.write_text('{"bootstrap": true}')
+        r = _cli("configtest", "-config-file", str(f))
+        assert r.returncode == 1
+
+
+class TestAgainstDaemon:
+    def test_info(self, daemon):
+        r = _cli("info", "-rpc-addr", daemon["rpc"])
+        assert r.returncode == 0
+        assert "raft:" in r.stdout and "state = Leader" in r.stdout
+
+    def test_members(self, daemon):
+        r = _cli("members", "-rpc-addr", daemon["rpc"])
+        assert r.returncode == 0 and "cli-node" in r.stdout
+        r = _cli("members", "-wan", "-rpc-addr", daemon["rpc"])
+        assert "cli-node.dc1" in r.stdout
+
+    def test_event(self, daemon):
+        r = _cli("event", "-name", "deploy", "-http-addr", daemon["http"])
+        assert r.returncode == 0 and "Event ID:" in r.stdout
+
+    def test_exec(self, daemon):
+        r = _cli("exec", "-http-addr", daemon["http"], "-wait", "15",
+                 "echo", "cli-exec-output", timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "cli-exec-output" in r.stdout
+        assert "finished with exit code 0" in r.stdout
+
+    def test_maint(self, daemon):
+        r = _cli("maint", "-enable", "-reason", "upgrades",
+                 "-http-addr", daemon["http"])
+        assert r.returncode == 0
+        r = _cli("maint", "-http-addr", daemon["http"])
+        assert "upgrades" in r.stdout
+        r = _cli("maint", "-disable", "-http-addr", daemon["http"])
+        assert r.returncode == 0
+        r = _cli("maint", "-http-addr", daemon["http"])
+        assert "normal mode" in r.stdout
+
+    def test_lock(self, daemon):
+        r = _cli("lock", "-http-addr", daemon["http"],
+                 "locktest", "echo locked-$$", timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_keyring_not_configured(self, daemon):
+        r = _cli("keyring", "-list", "-rpc-addr", daemon["rpc"])
+        assert r.returncode == 1
+        assert "keyring" in r.stderr.lower()
+
+    def test_reload(self, daemon):
+        r = _cli("reload", "-rpc-addr", daemon["rpc"])
+        assert r.returncode == 0
+
+    def test_ipc_monitor_streams_logs(self, daemon):
+        lines = []
+        with IPCClient(daemon["rpc"]) as c:
+            seq = c.monitor(lines.append)
+            # trigger some log output via a reload
+            with IPCClient(daemon["rpc"]) as c2:
+                c2.reload()
+            deadline = time.time() + 5
+            while time.time() < deadline and not any(
+                    "reload" in l for l in lines):
+                c.pump(timeout=0.5)
+            c.stop_monitor(seq)
+        assert any("agent: reloading" in l for l in lines), lines
+
+    def test_ipc_handshake_required(self, daemon):
+        import msgpack
+        host, _, port = daemon["rpc"].rpartition(":")
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(msgpack.packb({"Command": "stats", "Seq": 1}))
+        unp = msgpack.Unpacker(raw=False)
+        unp.feed(s.recv(4096))
+        resp = next(unp)
+        assert "Handshake" in resp["Error"]
+        s.close()
+
+    def test_ipc_unknown_command(self, daemon):
+        with IPCClient(daemon["rpc"]) as c:
+            with pytest.raises(IPCError):
+                c._call("frobnicate")
+
+
+class TestWatchCLI:
+    def test_watch_via_cli(self, daemon, tmp_path):
+        out = tmp_path / "events"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli.main", "watch",
+             "-http-addr", daemon["http"], "-type", "key",
+             "-key", "cliw/x",
+             "-handler", f"cat >> {out}"],
+            env=ENV, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            time.sleep(1.0)
+            import httpx
+            httpx.put(f"http://{daemon['http']}/v1/kv/cliw/x", content=b"v1")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if out.exists() and "cliw/x" in out.read_text():
+                    break
+                time.sleep(0.2)
+            assert out.exists() and "cliw/x" in out.read_text()
+        finally:
+            proc.terminate()
+            proc.wait(5)

@@ -1,0 +1,150 @@
+"""Config loader tests (reference tier: command/agent/config_test.go)."""
+
+import json
+
+import pytest
+
+from consul_tpu.agent.config import (
+    Config, ConfigError, decode_config, merge_config, read_config_paths,
+    to_agent_config, validate_config)
+
+
+class TestDecode:
+    def test_basic_fields(self):
+        cfg = decode_config(json.dumps({
+            "node_name": "n1", "datacenter": "dc2", "server": True,
+            "bootstrap": True, "data_dir": "/tmp/x",
+            "acl_ttl": "45s",
+        }))
+        assert cfg.node_name == "n1" and cfg.datacenter == "dc2"
+        assert cfg.server and cfg.bootstrap
+        assert cfg.acl_ttl == 45.0
+
+    def test_ports_block(self):
+        cfg = decode_config('{"ports": {"dns": 9600, "http": 9500}}')
+        assert cfg.ports.dns == 9600 and cfg.ports.http == 9500
+        assert cfg.ports.serf_lan == 8301  # default preserved
+
+    def test_dns_config(self):
+        cfg = decode_config(json.dumps({
+            "dns_config": {"node_ttl": "10s", "only_passing": True,
+                           "service_ttl": {"*": "5s", "web": "30s"}}}))
+        assert cfg.dns_config.node_ttl == 10.0
+        assert cfg.dns_config.only_passing
+        assert cfg.dns_config.service_ttl == {"*": 5.0, "web": 30.0}
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ConfigError):
+            decode_config('{"bogus_key": 1}')
+        with pytest.raises(ConfigError):
+            decode_config('{"ports": {"bogus": 1}}')
+
+    def test_service_stanza_singular(self):
+        cfg = decode_config(json.dumps({
+            "service": {"name": "web", "port": 80,
+                        "check": {"script": "true", "interval": "10s"}}}))
+        assert len(cfg.services) == 1
+        assert cfg.services[0]["name"] == "web"
+
+    def test_invalid_json(self):
+        with pytest.raises(ConfigError):
+            decode_config("{nope")
+
+
+class TestMerge:
+    def test_overlay_and_append(self):
+        a = decode_config('{"node_name": "a", "datacenter": "dc1", '
+                          '"service": {"name": "s1"}}')
+        b = decode_config('{"node_name": "b", "service": {"name": "s2"}}')
+        m = merge_config(a, b)
+        assert m.node_name == "b"           # b wins
+        assert m.datacenter == "dc1"        # a preserved
+        assert [s["name"] for s in m.services] == ["s1", "s2"]  # appended
+
+    def test_unset_fields_do_not_clobber(self):
+        a = decode_config('{"server": true}')
+        b = decode_config('{"node_name": "x"}')
+        m = merge_config(a, b)
+        assert m.server is True
+
+    def test_nested_blocks_merge_fieldwise(self):
+        a = decode_config('{"ports": {"dns": 5600}}')
+        b = decode_config('{"ports": {"http": 9500}}')
+        m = merge_config(a, b)
+        assert m.ports.dns == 5600     # earlier override survives
+        assert m.ports.http == 9500
+        assert m.ports.serf_lan == 8301
+        a = decode_config('{"dns_config": {"only_passing": true}}')
+        b = decode_config('{"dns_config": {"node_ttl": "10s"}}')
+        m = merge_config(a, b)
+        assert m.dns_config.only_passing and m.dns_config.node_ttl == 10.0
+
+
+class TestReadPaths:
+    def test_dir_lexical_order(self, tmp_path):
+        d = tmp_path / "conf.d"
+        d.mkdir()
+        (d / "10-base.json").write_text('{"node_name": "early"}')
+        (d / "20-override.json").write_text('{"node_name": "late"}')
+        (d / "ignored.txt").write_text("not json")
+        cfg = read_config_paths([str(d)])
+        assert cfg.node_name == "late"
+
+    def test_file_then_dir(self, tmp_path):
+        f = tmp_path / "base.json"
+        f.write_text('{"datacenter": "dc9", "server": true}')
+        d = tmp_path / "conf.d"
+        d.mkdir()
+        (d / "x.json").write_text('{"node_name": "n"}')
+        cfg = read_config_paths([str(f), str(d)])
+        assert cfg.datacenter == "dc9" and cfg.node_name == "n"
+
+    def test_error_names_file(self, tmp_path):
+        f = tmp_path / "bad.json"
+        f.write_text("{broken")
+        with pytest.raises(ConfigError) as ei:
+            read_config_paths([str(f)])
+        assert "bad.json" in str(ei.value)
+
+
+class TestValidate:
+    def test_bootstrap_needs_server(self):
+        cfg = decode_config('{"bootstrap": true}')
+        assert any("server mode" in p for p in validate_config(cfg))
+
+    def test_bootstrap_expect_conflicts(self):
+        cfg = decode_config('{"server": true, "bootstrap": true, '
+                            '"bootstrap_expect": 3}')
+        assert any("bootstrap-expect" in p for p in validate_config(cfg))
+
+    def test_bad_encrypt_key(self):
+        cfg = decode_config('{"encrypt": "tooshort"}')
+        assert any("16 bytes" in p or "base64" in p
+                   for p in validate_config(cfg))
+
+    def test_good_encrypt_key(self):
+        import base64, os
+        key = base64.b64encode(os.urandom(16)).decode()
+        cfg = decode_config(json.dumps({"encrypt": key}))
+        assert validate_config(cfg) == []
+
+    def test_bad_watch(self):
+        cfg = decode_config('{"watches": [{"type": "bogus"}]}')
+        assert any("watch" in p.lower() for p in validate_config(cfg))
+
+    def test_verify_incoming_needs_certs(self):
+        cfg = decode_config('{"verify_incoming": true}')
+        assert any("ca_file" in p for p in validate_config(cfg))
+
+
+class TestAdapter:
+    def test_to_agent_config(self):
+        cfg = decode_config(json.dumps({
+            "node_name": "n1", "server": True, "bootstrap": True,
+            "ports": {"http": 9500, "dns": 9600},
+            "acl_datacenter": "dc1", "acl_token": "tok",
+            "dns_config": {"only_passing": True}}))
+        a = to_agent_config(cfg)
+        assert a.node_name == "n1" and a.http_port == 9500
+        assert a.dns_port == 9600 and a.dns_only_passing
+        assert a.acl_datacenter == "dc1" and a.acl_token == "tok"
