@@ -26,7 +26,14 @@ def main() -> None:
     ap.add_argument("--slots", type=int, default=64, help="concurrent rumor slots")
     ap.add_argument("--steps", type=int, default=512, help="rounds per timed block")
     ap.add_argument("--repeats", type=int, default=3, help="timed blocks (best taken)")
+    ap.add_argument("--multidc", action="store_true",
+                    help="BASELINE config #5 shape: LAN+WAN pools + events")
+    ap.add_argument("--dcs", type=int, default=4, help="datacenters (multidc)")
     args = ap.parse_args()
+
+    if args.multidc:
+        bench_multidc(args)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -72,6 +79,53 @@ def main() -> None:
             }
         )
     )
+    sys.stdout.flush()
+
+
+def bench_multidc(args) -> None:
+    """Config #5: D LAN pools + WAN pool + cross-DC event propagation."""
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.kernel import NEVER
+    from consul_tpu.gossip.multidc import (
+        fire_in_dc, init_multidc, make_params, run_multidc_rounds)
+
+    n_lan = args.n // args.dcs
+    p = make_params(n_dcs=args.dcs, n_lan=n_lan, n_servers=3,
+                    event_slots=32, slots=args.slots)
+    state = init_multidc(p)
+    state = fire_in_dc(state, dc=0, node=7, p=p)
+    key = jax.random.PRNGKey(42)
+    n_fail = max(1, n_lan // 1000)
+    total_rounds = args.steps * (args.repeats + 1)
+    per_dc = (jnp.arange(n_fail, dtype=jnp.int32) * total_rounds) // n_fail
+    # Offset past the server ids: killing the bridge nodes would bench a
+    # topology with no live LAN<->WAN relay.
+    s0 = p.n_servers
+    lan_fail = (jnp.full((p.n_dcs, n_lan), NEVER, jnp.int32)
+                .at[:, s0:s0 + n_fail].set(per_dc[None, :]))
+    wan_fail = jnp.full((p.n_dcs * p.n_servers,), NEVER, jnp.int32)
+
+    state, _ = run_multidc_rounds(state, key, lan_fail, wan_fail, p,
+                                  steps=args.steps)
+    jax.block_until_ready(state)
+
+    best = float("inf")
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        state, _ = run_multidc_rounds(state, key, lan_fail, wan_fail, p,
+                                      steps=args.steps)
+        jax.block_until_ready(state)
+        best = min(best, time.perf_counter() - t0)
+
+    rounds_per_sec = args.steps / best
+    print(json.dumps({
+        "metric": f"swim_multidc_rounds_per_sec_{args.n}_nodes_{args.dcs}dc",
+        "value": round(rounds_per_sec, 1),
+        "unit": "rounds/s",
+        "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 3),
+    }))
     sys.stdout.flush()
 
 

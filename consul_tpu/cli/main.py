@@ -16,7 +16,6 @@ import json
 import os
 import signal
 import sys
-import threading
 from typing import List, Optional
 
 from consul_tpu.version import VERSION
@@ -271,14 +270,12 @@ def cmd_members(args) -> int:
 
 def cmd_monitor(args) -> int:
     with _ipc(args) as c:
-        done = threading.Event()
-
         def handler(line: str) -> None:
             print(line)
 
         c.monitor(handler, log_level=args.log_level)
         try:
-            while not done.is_set():
+            while True:
                 c.pump(timeout=1.0)
         except KeyboardInterrupt:
             return 0

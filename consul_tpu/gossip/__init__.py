@@ -9,5 +9,11 @@ behind the agent and a million-node simulator cross-validated against a
 discrete-event reference model of memberlist semantics (``refmodel.py``).
 """
 
-from consul_tpu.gossip.params import SwimParams  # noqa: F401
+from consul_tpu.gossip.params import SwimParams, lan_profile, wan_profile  # noqa: F401
 from consul_tpu.gossip.kernel import SwimState, init_state, swim_round, run_rounds  # noqa: F401
+from consul_tpu.gossip.events import (  # noqa: F401
+    EventState, coverage, event_round, fire_events, init_events,
+    run_event_rounds)
+from consul_tpu.gossip.multidc import (  # noqa: F401
+    MultiDCParams, MultiDCState, event_coverage, fire_in_dc, init_multidc,
+    make_params, multidc_round, run_multidc_rounds)

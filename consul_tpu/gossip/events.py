@@ -1,0 +1,199 @@
+"""User-event dissemination kernel: Serf's lamport-clocked broadcast
+queue as batched array ops.
+
+Parity target: Serf's user-event layer as consumed by Consul
+(``consul/serf.go`` user-event handling; behavior contract at
+``website/source/docs/internals/gossip.html.markdown`` §"gossip" and
+the Serf event docs): events are flooded via the same gossip fanout as
+membership rumors, stamped with a cluster-wide Lamport time, buffered
+for dedup, and retransmitted with the standard
+``retransmit_mult * log(n)`` budget.
+
+Kernel layout: E concurrent event slots over N nodes.
+
+    has[e, i]  (uint8)  bits 7: seen   bits 3-0: age (rounds since seen)
+
+A node that has seen event ``e`` gossips it to ``fanout`` peers per
+round while its age is within the spread budget — the identical
+inverse-permutation gather machinery as the membership kernel
+(kernel.py), so both piggyback on one communication pattern.  Lamport
+times live in ``ltime[e]`` (events) and ``node_ltime[i]`` (per-node
+clocks): a node receiving an event witnesses its ltime, advancing the
+local clock to ``max(local, event)+1`` — Serf's lamport rules.
+
+Coverage statistics (rounds to 50%/99%/100%) are what the
+cross-validation tier compares against the discrete-event epidemic
+model (BASELINE config #3: "event convergence statistics match Serf").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.gossip.params import SwimParams
+from consul_tpu.ops.feistel import feistel_inverse
+
+_SEEN = 0x80
+_AGE_MASK = 0x0F
+
+
+class EventState(NamedTuple):
+    round: jnp.ndarray       # i32 scalar
+    has: jnp.ndarray         # u8 [E, N] seen-bit + age
+    slot_used: jnp.ndarray   # bool [E]
+    ltime: jnp.ndarray       # i32 [E] lamport time of each event
+    origin: jnp.ndarray      # i32 [E] firing node
+    start_round: jnp.ndarray  # i32 [E]
+    node_ltime: jnp.ndarray  # i32 [N] per-node lamport clock
+    n_seen: jnp.ndarray      # i32 [E] cumulative deliveries (survives GC
+                             #   until the slot is reused — the convergence
+                             #   statistic of BASELINE config #3)
+    drops: jnp.ndarray       # i32 — fires lost to full slots
+
+
+def init_events(p: SwimParams, slots: int = 64) -> EventState:
+    E, N = slots, p.n
+    return EventState(
+        round=jnp.int32(0),
+        has=jnp.zeros((E, N), jnp.uint8),
+        slot_used=jnp.zeros((E,), bool),
+        ltime=jnp.zeros((E,), jnp.int32),
+        origin=jnp.full((E,), -1, jnp.int32),
+        start_round=jnp.zeros((E,), jnp.int32),
+        node_ltime=jnp.zeros((N,), jnp.int32),
+        n_seen=jnp.zeros((E,), jnp.int32),
+        drops=jnp.int32(0),
+    )
+
+
+def fire_events(state: EventState, nodes: jnp.ndarray) -> EventState:
+    """Originate one event per entry of ``nodes`` (int32 array of firing
+    node ids; -1 entries are ignored).  Each takes a free slot; overflow
+    counts into ``drops``.  Lamport: fire = local clock + 1 (Serf
+    UserEvent stamps the next time)."""
+    E = state.has.shape[0]
+    want = nodes >= 0
+    free = ~state.slot_used
+    free_order = jnp.argsort(jnp.where(free, 0, 1), stable=True).astype(jnp.int32)
+    n_free = jnp.sum(free)
+    rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+    can = want & (rank < n_free)
+    slot_for = free_order[jnp.clip(rank, 0, E - 1)]
+    sidx = jnp.where(can, slot_for, E)
+    node_c = jnp.clip(nodes, 0, state.node_ltime.shape[0] - 1)
+
+    fire_lt = state.node_ltime[node_c] + 1
+    node_ltime = state.node_ltime.at[
+        jnp.where(can, node_c, state.node_ltime.shape[0])].set(
+        fire_lt, mode="drop")
+
+    slot_used = state.slot_used.at[sidx].set(True, mode="drop")
+    ltime = state.ltime.at[sidx].set(fire_lt, mode="drop")
+    origin = state.origin.at[sidx].set(nodes, mode="drop")
+    start_round = state.start_round.at[sidx].set(state.round, mode="drop")
+    has = state.has.at[sidx, node_c].set(jnp.uint8(_SEEN), mode="drop")
+    n_seen = state.n_seen.at[sidx].set(1, mode="drop")  # the origin has it
+    drops = state.drops + jnp.sum((want & ~can).astype(jnp.int32))
+    return state._replace(has=has, slot_used=slot_used, ltime=ltime,
+                          origin=origin, start_round=start_round,
+                          node_ltime=node_ltime, n_seen=n_seen, drops=drops)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def event_round(state: EventState, base_key: jax.Array, alive: jnp.ndarray,
+                p: SwimParams) -> EventState:
+    """One gossip round of event flooding."""
+    rnd = state.round
+    key = jax.random.fold_in(jax.random.fold_in(base_key, 7), rnd)
+    N = p.n
+
+    # Gossip on PRE-tick ages (a copy received last round, age 0, gets
+    # its first send this round even with a 1-round budget); ages tick
+    # when the new state is assembled below.
+    cur = state.has
+    seen = (cur & _SEEN) > 0
+
+    # fanout deliveries via inverse-permutation gathers
+    rx_ok = alive
+    new_seen = jnp.zeros_like(seen)
+    ids = jnp.arange(N, dtype=jnp.int32)
+    for f in range(p.fanout):
+        kf = jax.random.fold_in(key, f)
+        srcs = feistel_inverse(jnp.arange(N, dtype=jnp.uint32), kf, N).astype(jnp.int32)
+        src_ok = alive[srcs] & (srcs != ids)
+        hin = cur[:, srcs]
+        active = (src_ok[None, :] & ((hin & _SEEN) > 0)
+                  & ((hin & _AGE_MASK) < p.spread_budget_rounds))
+        new_seen = new_seen | (active & rx_ok[None, :])
+
+    # push/pull anti-entropy: full-state sync with one partner, spread
+    # budget ignored (this recovers events that aged out under loss)
+    if p.pushpull_every:
+        from consul_tpu.ops.feistel import feistel_permute
+
+        def _pp(ns):
+            kpp = jax.random.fold_in(key, 9)
+            fwd = feistel_inverse(jnp.arange(N, dtype=jnp.uint32),
+                                  kpp, N).astype(jnp.int32)
+            rev = feistel_permute(jnp.arange(N, dtype=jnp.uint32),
+                                  kpp, N).astype(jnp.int32)
+            for partner in (fwd, rev):
+                ok = rx_ok & alive[partner] & (partner != ids)
+                hin = cur[:, partner]
+                ns = ns | (((hin & _SEEN) > 0) & ok[None, :])
+            return ns
+
+        new_seen = jax.lax.cond(
+            rnd % p.pushpull_every == p.pushpull_every - 1,
+            _pp, lambda ns: ns, new_seen)
+
+    fresh = new_seen & ~seen
+    age = cur & _AGE_MASK
+    aged = jnp.where(seen,
+                     jnp.uint8(_SEEN)
+                     | jnp.minimum(age + 1, _AGE_MASK).astype(jnp.uint8),
+                     cur)
+    has = jnp.where(fresh, jnp.uint8(_SEEN), aged)
+    n_seen = state.n_seen + jnp.sum(fresh, axis=1, dtype=jnp.int32)
+
+    # lamport witness: clock = max(clock, max ltime of newly seen events)+1
+    # (Serf witnessedClock). One max over slots is enough per round.
+    wit = jnp.max(jnp.where(fresh, state.ltime[:, None], 0), axis=0)
+    node_ltime = jnp.where(wit > 0,
+                           jnp.maximum(state.node_ltime, wit) + 1,
+                           state.node_ltime)
+
+    # slot GC: recycle after the event TTL (flood window + push/pull
+    # recovery cycles) — Serf's recent-event buffer rotating out.
+    done = state.slot_used & (rnd - state.start_round > p.event_ttl_rounds)
+    has = jnp.where(done[:, None], jnp.uint8(0), has)
+    slot_used = state.slot_used & ~done
+    origin = jnp.where(done, -1, state.origin)
+
+    return state._replace(round=rnd + 1, has=has, slot_used=slot_used,
+                          origin=origin, node_ltime=node_ltime,
+                          n_seen=n_seen)
+
+
+def coverage(state: EventState, alive: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of alive nodes that have seen each event slot [E]."""
+    seen = ((state.has & _SEEN) > 0) & alive[None, :]
+    n_alive = jnp.maximum(jnp.sum(alive), 1)
+    return jnp.sum(seen, axis=1) / n_alive
+
+
+@functools.partial(jax.jit, static_argnames=("p", "steps"))
+def run_event_rounds(state: EventState, base_key: jax.Array,
+                     alive: jnp.ndarray, p: SwimParams, steps: int):
+    """Scan; traces per-round coverage [T, E] for convergence curves."""
+
+    def body(st, _):
+        st = event_round(st, base_key, alive, p)
+        return st, coverage(st, alive)
+
+    return jax.lax.scan(body, state, None, length=steps)

@@ -62,7 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
-from consul_tpu.ops.feistel import feistel_inverse, random_targets
+from consul_tpu.ops.feistel import feistel_inverse, feistel_permute, random_targets
 
 MSG_NONE = 0
 MSG_SUSPECT = 1
@@ -289,6 +289,30 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     out_age = jnp.where(upgraded, jnp.uint8(0), age.astype(jnp.uint8))
     out_conf = jnp.where(upgraded, 0, conf).astype(jnp.uint8)
     heard = ((out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age).astype(jnp.uint8)
+
+    # -- 3b. push/pull anti-entropy (memberlist PushPullInterval): full
+    # belief exchange with one random partner, bidirectional, ignoring
+    # the per-message spread budget — this is what recovers rumors that
+    # aged out before reaching everyone (e.g. under packet loss) --------
+    if p.pushpull_every:
+        def _pushpull(h):
+            kpp = jax.random.fold_in(key, 3)
+            ids_ = jnp.arange(N, dtype=jnp.int32)
+            fwd = feistel_inverse(jnp.arange(N, dtype=jnp.uint32),
+                                  kpp, N).astype(jnp.int32)
+            # fwd = who dials me under the permutation; rev = whom I dial.
+            # Doing both directions makes each pair's exchange symmetric.
+            rev = feistel_permute(jnp.arange(N, dtype=jnp.uint32),
+                                  kpp, N).astype(jnp.int32)
+            for partner in (fwd, rev):
+                ok = rx_ok & alive[partner] & member[partner] & (partner != ids_)
+                hin = h[:, partner]
+                upgraded = ((hin >> _MSG_SHIFT) > (h >> _MSG_SHIFT)) & ok[None, :]
+                h = jnp.where(upgraded, hin, h)
+            return h
+
+        heard = jax.lax.cond(rnd % p.pushpull_every == p.pushpull_every - 1,
+                             _pushpull, lambda h: h, heard)
 
     # -- 4. refutation: a live subject that hears of its own suspicion
     # bumps its incarnation and spreads alive@inc+1 (Serf/memberlist

@@ -1,0 +1,193 @@
+"""Multi-datacenter gossip: per-DC LAN pools + one cross-DC WAN pool.
+
+Parity target: Consul's two-pool topology (``consul/server.go:257-273``:
+every node is in its DC's LAN pool; servers additionally join a global
+WAN pool with coarser timers, ``consul/config.go:266-272``) and Serf
+event propagation across DCs through the WAN members.
+
+Kernel composition (BASELINE config #5, the 1M-node shape):
+
+- ``D`` LAN pools of ``n_lan`` nodes each — one :class:`SwimState`
+  with a leading DC axis, advanced by ``jax.vmap`` of the single-pool
+  round (per-DC PRNG keys).  On hardware the DC axis composes with the
+  node-axis sharding: LAN traffic stays inside a shard group (ICI),
+  and only the small WAN pool crosses slice boundaries (DCN) — the
+  same locality the reference gets from LAN-vs-WAN gossip profiles.
+- One WAN pool of ``D * n_servers`` nodes (server ``j`` of DC ``d`` is
+  WAN id ``d * n_servers + j``) with the WAN timing profile.
+- Events: each DC floods its LAN event pool; every round, server
+  nodes bridge LAN<->WAN (an event any server has seen enters the WAN
+  pool, and an event any WAN member of DC ``d`` carries enters ``d``'s
+  LAN pool at that server) — Consul's actual cross-DC event path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.gossip.events import (
+    EventState, _SEEN, event_round, init_events)
+from consul_tpu.gossip.kernel import SwimState, init_state, swim_round
+from consul_tpu.gossip.params import SwimParams, lan_profile, wan_profile
+
+
+class MultiDCParams(NamedTuple):
+    n_dcs: int
+    n_lan: int          # nodes per DC
+    n_servers: int      # servers per DC (3-5 in the reference posture)
+    event_slots: int
+    lan: SwimParams
+    wan: SwimParams
+
+
+def make_params(n_dcs: int, n_lan: int, n_servers: int = 3,
+                event_slots: int = 32, **kw) -> MultiDCParams:
+    return MultiDCParams(
+        n_dcs=n_dcs, n_lan=n_lan, n_servers=n_servers,
+        event_slots=event_slots,
+        lan=lan_profile(n_lan, **kw),
+        wan=wan_profile(n_dcs * n_servers),
+    )
+
+
+class MultiDCState(NamedTuple):
+    lan: SwimState          # leading axis D on every array
+    lan_events: EventState  # leading axis D
+    wan: SwimState
+    wan_events: EventState
+
+
+def init_multidc(p: MultiDCParams) -> MultiDCState:
+    lan = jax.vmap(lambda _: init_state(p.lan))(jnp.arange(p.n_dcs))
+    lan_events = jax.vmap(lambda _: init_events(p.lan, p.event_slots))(
+        jnp.arange(p.n_dcs))
+    return MultiDCState(
+        lan=lan,
+        lan_events=lan_events,
+        wan=init_state(p.wan),
+        wan_events=init_events(p.wan, p.event_slots),
+    )
+
+
+def _merge_seen(dst: jnp.ndarray, src_seen: jnp.ndarray) -> jnp.ndarray:
+    """Set the seen-bit (age 0) where src has seen and dst hasn't."""
+    newly = src_seen & ((dst & _SEEN) == 0)
+    return jnp.where(newly, jnp.uint8(_SEEN), dst)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def multidc_round(state: MultiDCState, base_key: jax.Array,
+                  lan_fail: jnp.ndarray, wan_fail: jnp.ndarray,
+                  p: MultiDCParams) -> MultiDCState:
+    """One LAN gossip interval across every pool.
+
+    ``lan_fail``: [D, n_lan] per-pool fail rounds; ``wan_fail``:
+    [D*n_servers].  The WAN pool ticks every round too — its *protocol*
+    is slower via its own probe_every/suspicion params (its rounds are
+    LAN-interval sized; wan_profile's probe_every scales accordingly).
+    """
+    D, s = p.n_dcs, p.n_servers
+    keys = jax.random.split(jax.random.fold_in(base_key, 11), D)
+
+    # -- LAN pools: membership + events, vmapped over the DC axis --------
+    lan = jax.vmap(lambda st, k, f: swim_round(st, k, f, p.lan))(
+        state.lan, keys, lan_fail)
+    lan_alive = (lan_fail > state.lan_events.round[:, None])
+    lan_events = jax.vmap(lambda st, k, a: event_round(st, k, a, p.lan))(
+        state.lan_events, keys, lan_alive)
+
+    # -- WAN pool ---------------------------------------------------------
+    wan_key = jax.random.fold_in(base_key, 13)
+    wan = swim_round(state.wan, wan_key, wan_fail, p.wan)
+    wan_alive = wan_fail > state.wan_events.round
+    wan_events = event_round(state.wan_events, wan_key, wan_alive, p.wan)
+
+    # -- event bridge at the servers (serf WAN user-event relay) ---------
+    # Slot ids are GLOBAL: fire_in_dc allocates a slot free in every
+    # pool and stamps ltime/origin/start_round everywhere up front, so
+    # the bridge only merges seen-bits — metadata (Lamport time, GC
+    # clock) already exists on the receiving side, and per-pool GC
+    # (which cleared has+slot_used inside event_round above) is never
+    # overridden from stale pre-round state.
+    E = p.event_slots
+    # LAN server view: [D, E, s] -> [E, D*s]
+    lan_srv_seen = ((lan_events.has[:, :, :s] & _SEEN) > 0)
+    lan_srv_flat = jnp.transpose(lan_srv_seen, (1, 0, 2)).reshape(E, D * s)
+    wan_live = wan_events.slot_used[:, None]
+    wan_has = _merge_seen(wan_events.has, lan_srv_flat & wan_live)
+
+    wan_seen = ((wan_has & _SEEN) > 0)
+    wan_by_dc = jnp.transpose(wan_seen.reshape(E, D, s), (1, 0, 2))  # [D, E, s]
+    lan_live = lan_events.slot_used[:, :, None]
+    lan_srv = lan_events.has[:, :, :s]
+    lan_srv = jax.vmap(_merge_seen)(lan_srv, wan_by_dc & lan_live)
+    lan_has = lan_events.has.at[:, :, :s].set(lan_srv)
+
+    lan_events = lan_events._replace(has=lan_has)
+    wan_events = wan_events._replace(has=wan_has)
+
+    return MultiDCState(lan=lan, lan_events=lan_events,
+                        wan=wan, wan_events=wan_events)
+
+
+def fire_in_dc(state: MultiDCState, dc: int, node: int,
+               p: MultiDCParams) -> MultiDCState:
+    """Originate one user event at (dc, node).
+
+    Allocates a slot that is free in EVERY pool (slot ids are global
+    across DCs — two concurrently-live events must never share an
+    index, or the seen-bit bridge would conflate them) and stamps the
+    slot metadata in every pool so late bridge deliveries carry the
+    right Lamport time and GC clock."""
+    le, we = state.lan_events, state.wan_events
+    free = ~(jnp.any(le.slot_used, axis=0) | we.slot_used)
+    if not bool(jnp.any(free)):
+        le = le._replace(drops=le.drops + 1)
+        return state._replace(lan_events=le)
+    slot = int(jnp.argmax(free))
+
+    fire_lt = int(le.node_ltime[dc, node]) + 1
+    lan_events = le._replace(
+        has=le.has.at[dc, slot, node].set(jnp.uint8(_SEEN)),
+        slot_used=le.slot_used.at[:, slot].set(True),
+        ltime=le.ltime.at[:, slot].set(fire_lt),
+        origin=le.origin.at[:, slot].set(-1).at[dc, slot].set(node),
+        start_round=le.start_round.at[:, slot].set(le.round[:]),
+        node_ltime=le.node_ltime.at[dc, node].set(fire_lt),
+        n_seen=le.n_seen.at[:, slot].set(0).at[dc, slot].set(1),
+    )
+    wan_events = we._replace(
+        slot_used=we.slot_used.at[slot].set(True),
+        ltime=we.ltime.at[slot].set(fire_lt),
+        origin=we.origin.at[slot].set(-1),
+        start_round=we.start_round.at[slot].set(we.round),
+        n_seen=we.n_seen.at[slot].set(0),
+    )
+    return state._replace(lan_events=lan_events, wan_events=wan_events)
+
+
+def event_coverage(state: MultiDCState) -> jnp.ndarray:
+    """[D, E] fraction of each DC's nodes holding each event."""
+    seen = (state.lan_events.has & _SEEN) > 0
+    return jnp.mean(seen.astype(jnp.float32), axis=2)
+
+
+@functools.partial(jax.jit, static_argnames=("p", "steps"))
+def run_multidc_rounds(state: MultiDCState, base_key: jax.Array,
+                       lan_fail: jnp.ndarray, wan_fail: jnp.ndarray,
+                       p: MultiDCParams, steps: int
+                       ) -> Tuple[MultiDCState, jnp.ndarray]:
+    """Scan ``steps`` rounds; traces per-round [D, E] event coverage."""
+
+    def body(st, _):
+        st = multidc_round(st, base_key, lan_fail, wan_fail, p)
+        seen = (st.lan_events.has & _SEEN) > 0
+        cov = jnp.mean(seen.astype(jnp.float32), axis=2)
+        return st, cov
+
+    return jax.lax.scan(body, state, None, length=steps)

@@ -41,6 +41,10 @@ class SwimParams:
     loss_rate: float = 0.0  # iid packet-loss probability per message
     gossip_interval_s: float = 0.2  # for round<->seconds conversion only
     refute: bool = True  # alive subjects refute suspicion (incarnation bump)
+    # Push/pull anti-entropy cadence in rounds; 0 disables.  memberlist
+    # default: 30s LAN = 150 rounds, 60s WAN = 120 rounds (PushPullInterval,
+    # selected by the reference via the LAN/WAN profiles).
+    pushpull_every: int = 0
 
     # ---- derived, all static ----
 
@@ -86,6 +90,18 @@ class SwimParams:
         return min(15, max(1, math.ceil(self.transmit_limit / self.fanout)))
 
     @property
+    def event_ttl_rounds(self) -> int:
+        """Rounds an event slot stays allocated after firing: the flood
+        window plus — when push/pull is enabled — enough anti-entropy
+        cycles for pairwise exchange to double coverage to full
+        (log2(n) syncs), mirroring Serf's recent-event buffer whose
+        entries outlive their broadcast budget for exactly this reason."""
+        ttl = self.spread_budget_rounds + 8
+        if self.pushpull_every:
+            ttl += self.pushpull_every * math.ceil(math.log2(self.n + 1))
+        return ttl
+
+    @property
     def slot_ttl_rounds(self) -> int:
         """Rounds before a rumor slot is recycled: worst-case suspicion
         timer plus two full dissemination sweeps of the final verdict."""
@@ -106,6 +122,7 @@ class SwimParams:
 
 # Ready-made profiles mirroring memberlist's LAN and WAN defaults.
 def lan_profile(n: int, **kw) -> SwimParams:
+    kw.setdefault("pushpull_every", 150)  # 30s / 200ms gossip
     return SwimParams(n=n, probe_every=5, suspicion_mult=4.0, retransmit_mult=4.0,
                       fanout=3, gossip_interval_s=0.2, **kw)
 
@@ -113,5 +130,6 @@ def lan_profile(n: int, **kw) -> SwimParams:
 def wan_profile(n: int, **kw) -> SwimParams:
     """memberlist DefaultWANConfig: probe 5s / gossip 500ms, wider timers
     (selected by the reference at consul/config.go:268)."""
+    kw.setdefault("pushpull_every", 120)  # 60s / 500ms gossip
     return SwimParams(n=n, probe_every=10, suspicion_mult=6.0, retransmit_mult=4.0,
                       fanout=4, gossip_interval_s=0.5, **kw)

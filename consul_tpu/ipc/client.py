@@ -21,6 +21,7 @@ class IPCError(Exception):
 class IPCClient:
     def __init__(self, addr: str, timeout: float = 10.0) -> None:
         host, _, port = addr.rpartition(":")
+        self._timeout = timeout
         self._sock = socket.create_connection((host or "127.0.0.1",
                                                int(port)), timeout=timeout)
         self._unpacker = msgpack.Unpacker(raw=False)
@@ -28,6 +29,7 @@ class IPCClient:
         self._lock = threading.Lock()
         self._monitor_handler: Optional[Callable[[str], None]] = None
         self._monitor_seq: Optional[int] = None
+        self._old_monitor_seqs: set = set()  # stopped monitors still draining
         self._handshake()
 
     def close(self) -> None:
@@ -71,7 +73,10 @@ class IPCClient:
                     self._monitor_handler(body["Log"])
                 continue
             if seq != want_seq:
-                # Stale monitor record after stop: swallow its body.
+                if seq in self._old_monitor_seqs:
+                    # In-flight record from a stopped monitor: its {Log}
+                    # body MUST be consumed or the stream desyncs.
+                    self._next_obj()
                 continue
             if err:
                 raise IPCError(err)
@@ -142,7 +147,7 @@ class IPCClient:
         except socket.timeout:
             return False
         finally:
-            self._sock.settimeout(None)
+            self._sock.settimeout(self._timeout)
         if header.get("Seq") == self._monitor_seq:
             body = self._next_obj()
             if self._monitor_handler and "Log" in body:
@@ -150,9 +155,10 @@ class IPCClient:
         return True
 
     def stop_monitor(self, seq: int) -> None:
-        self._call("stop", {"Stop": seq})
+        self._old_monitor_seqs.add(seq)
         self._monitor_handler = None
         self._monitor_seq = None
+        self._call("stop", {"Stop": seq})
 
     def keyring(self, op: str, key: str = "") -> Dict[str, Any]:
         cmd = {"install": "install-key", "use": "use-key",

@@ -1,0 +1,156 @@
+"""Event dissemination + push/pull + multi-DC kernel tests
+(BASELINE configs #3-#5 functional tier; statistical crossval lives in
+test_gossip_crossval.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.gossip.events import (
+    _SEEN, EventState, coverage, event_round, fire_events, init_events,
+    run_event_rounds)
+from consul_tpu.gossip.kernel import NEVER, init_state, run_rounds
+from consul_tpu.gossip.multidc import (
+    event_coverage, fire_in_dc, init_multidc, make_params,
+    run_multidc_rounds)
+from consul_tpu.gossip.params import SwimParams, lan_profile
+
+
+def _alive(n):
+    return jnp.ones((n,), bool)
+
+
+class TestEventKernel:
+    def test_single_event_full_coverage(self):
+        p = lan_profile(512, pushpull_every=0)
+        st = init_events(p, slots=8)
+        st = fire_events(st, jnp.array([3], jnp.int32))
+        key = jax.random.PRNGKey(0)
+        st, cov = run_event_rounds(st, key, _alive(p.n), p, steps=30)
+        # epidemic flooding: everyone saw it (cumulative count survives GC)
+        assert int(st.n_seen[0]) == p.n
+        # and it reached 50% live coverage well before the end
+        half_round = int(np.argmax(np.asarray(cov[:, 0]) >= 0.5))
+        assert 0 < half_round < 15
+
+    def test_lamport_clocks_advance(self):
+        p = lan_profile(64, pushpull_every=0)
+        st = init_events(p, slots=4)
+        st = fire_events(st, jnp.array([0], jnp.int32))
+        assert int(st.ltime[0]) == 1
+        assert int(st.node_ltime[0]) == 1
+        key = jax.random.PRNGKey(1)
+        st, _ = run_event_rounds(st, key, _alive(p.n), p, steps=20)
+        # receivers witnessed the event: clock >= event ltime everywhere
+        assert int(jnp.min(st.node_ltime)) >= 1
+        # firing again uses a later lamport time
+        st = fire_events(st, jnp.array([5], jnp.int32))
+        idx = int(jnp.argmax(st.origin == 5))
+        assert int(st.ltime[idx]) > 1
+
+    def test_slot_gc_recycles(self):
+        p = lan_profile(128, pushpull_every=0)
+        st = init_events(st_slots := p, slots=2)
+        st = fire_events(st, jnp.array([0, 1], jnp.int32))
+        assert int(jnp.sum(st.slot_used)) == 2
+        key = jax.random.PRNGKey(2)
+        st, _ = run_event_rounds(st, key, _alive(p.n), p, steps=60)
+        # after full spread + aging, slots are recycled
+        assert int(jnp.sum(st.slot_used)) == 0
+        # and can be reused
+        st = fire_events(st, jnp.array([7], jnp.int32))
+        assert int(jnp.sum(st.slot_used)) == 1
+
+    def test_slot_overflow_counted(self):
+        p = lan_profile(64, pushpull_every=0)
+        st = init_events(p, slots=2)
+        st = fire_events(st, jnp.array([0, 1, 2], jnp.int32))
+        assert int(st.drops) == 1
+        assert int(jnp.sum(st.slot_used)) == 2
+
+    def test_dead_nodes_excluded(self):
+        p = lan_profile(256, pushpull_every=0)
+        st = init_events(p, slots=4)
+        st = fire_events(st, jnp.array([10], jnp.int32))
+        alive = _alive(p.n).at[:5].set(False)
+        key = jax.random.PRNGKey(3)
+        st, cov = run_event_rounds(st, key, alive, p, steps=30)
+        # dead nodes never see it; every alive node did
+        assert int(st.n_seen[0]) == p.n - 5
+        assert float(np.asarray(cov[:, 0]).max()) == 1.0
+
+
+class TestPushPull:
+    @pytest.mark.slow
+    def test_pushpull_recovers_lost_rumors(self):
+        """Under heavy packet loss the budgeted flood stalls below full
+        coverage; push/pull anti-entropy completes it (memberlist's
+        documented reason for push/pull)."""
+        n = 512
+        base = dict(n=n, slots=8, loss_rate=0.0)
+        # Events: simulate loss by tiny spread budget (retransmit starved)
+        p_nopp = SwimParams(**base, retransmit_mult=0.35, pushpull_every=0)
+        p_pp = SwimParams(**base, retransmit_mult=0.35, pushpull_every=10)
+        key = jax.random.PRNGKey(4)
+        covs = {}
+        for name, p in (("nopp", p_nopp), ("pp", p_pp)):
+            st = init_events(p, slots=4)
+            st = fire_events(st, jnp.array([0], jnp.int32))
+            st, cov = run_event_rounds(st, key, _alive(n), p, steps=80)
+            covs[name] = int(st.n_seen[0]) / n
+        assert covs["pp"] == 1.0
+        assert covs["nopp"] < covs["pp"]
+
+    def test_pushpull_membership_merge(self):
+        """The dead verdict reaches everyone even when the spread budget
+        is starved, thanks to the belief exchange."""
+        n = 256
+        p = SwimParams(n=n, slots=8, retransmit_mult=0.3, pushpull_every=8)
+        st = init_state(p)
+        fail = jnp.full((n,), NEVER, jnp.int32).at[9].set(5)
+        key = jax.random.PRNGKey(5)
+        st, _ = run_rounds(st, key, fail, p, steps=400)
+        assert int(st.n_detected) == 1
+        assert not bool(st.member[9])
+
+
+class TestMultiDC:
+    def test_event_crosses_datacenters(self):
+        p = make_params(n_dcs=3, n_lan=128, n_servers=3, event_slots=4)
+        st = init_multidc(p)
+        st = fire_in_dc(st, dc=0, node=50, p=p)
+        lan_fail = jnp.full((p.n_dcs, p.n_lan), NEVER, jnp.int32)
+        wan_fail = jnp.full((p.n_dcs * p.n_servers,), NEVER, jnp.int32)
+        key = jax.random.PRNGKey(6)
+        st, cov = run_multidc_rounds(st, key, lan_fail, wan_fail, p, steps=60)
+        peak = np.asarray(cov).max(axis=0)  # [D, E] best live coverage
+        # the event covered every DC, not just its origin
+        assert (peak[:, 0] == 1.0).all(), peak[:, 0]
+        # origin DC converged no later than remote DCs
+        origin_half = int(np.argmax(np.asarray(cov[:, 0, 0]) >= 0.5))
+        remote_half = int(np.argmax(np.asarray(cov[:, 1, 0]) >= 0.5))
+        assert origin_half <= remote_half
+
+    def test_lan_failure_detected_per_dc(self):
+        p = make_params(n_dcs=2, n_lan=128, n_servers=3, event_slots=2)
+        st = init_multidc(p)
+        lan_fail = jnp.full((2, 128), NEVER, jnp.int32).at[1, 60].set(10)
+        wan_fail = jnp.full((6,), NEVER, jnp.int32)
+        key = jax.random.PRNGKey(7)
+        st, _ = run_multidc_rounds(st, key, lan_fail, wan_fail, p, steps=400)
+        # DC1 detected its dead node; DC0 membership untouched
+        assert int(st.lan.n_detected[1]) == 1
+        assert not bool(st.lan.member[1, 60])
+        assert int(st.lan.n_detected[0]) == 0
+        assert bool(st.lan.member[0].all())
+
+    def test_wan_server_failure_detected(self):
+        p = make_params(n_dcs=3, n_lan=64, n_servers=3, event_slots=2)
+        st = init_multidc(p)
+        lan_fail = jnp.full((3, 64), NEVER, jnp.int32)
+        wan_fail = jnp.full((9,), NEVER, jnp.int32).at[4].set(20)
+        key = jax.random.PRNGKey(8)
+        st, _ = run_multidc_rounds(st, key, lan_fail, wan_fail, p, steps=800)
+        assert int(st.wan.n_detected) == 1
+        assert not bool(st.wan.member[4])
