@@ -1,0 +1,12 @@
+"""Native (C++) components: the mmap MVCC store.
+
+Parity: the reference's native deps — LMDB for MVCC state tables and
+BoltDB for the raft log (SURVEY.md §2.1).  ``native/cstore.cpp`` plays
+both roles; this package builds and binds it via ctypes.
+"""
+
+from consul_tpu.native.store import (
+    NativeStore, NativeLogStore, native_available, build_native)
+
+__all__ = ["NativeStore", "NativeLogStore", "native_available",
+           "build_native"]

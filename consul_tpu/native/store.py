@@ -1,0 +1,289 @@
+"""ctypes binding for the C++ MVCC store + the raft-log facade.
+
+Build: ``g++ -O2 -shared -fPIC`` on first use (no pybind11 in the
+image — plain C ABI + ctypes per the environment constraints), cached
+next to the source with a lock against concurrent test workers.
+
+Two facades:
+
+- :class:`NativeStore` — ordered KV with snapshots and prefix scans
+  (the LMDB role behind the state store).
+- :class:`NativeLogStore` — the raft LogStore/StableStore contract of
+  ``consensus/log.py`` (the raft-boltdb role): log entries live at
+  ``l:<index be64>``, stable kv at ``s:<name>``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC = os.path.join(_REPO, "native", "cstore.cpp")
+_LIB = os.path.join(_HERE, "libcstore.so")
+_BUILD_LOCK = threading.Lock()
+
+_lib = None
+_build_error: Optional[str] = None
+
+
+def build_native(force: bool = False) -> Optional[str]:
+    """Compile the shared library; returns its path or None on failure."""
+    global _build_error
+    with _BUILD_LOCK:
+        if not force and os.path.exists(_LIB) and (
+                not os.path.exists(_SRC)
+                or os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        if not os.path.exists(_SRC):
+            _build_error = f"source not found: {_SRC}"
+            return None
+        # Per-process tmp name: the threading lock doesn't cover other
+        # processes (pytest-xdist workers), but os.replace of a complete
+        # per-pid artifact is atomic — last writer wins with a VALID .so.
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-o", tmp, _SRC]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            _build_error = str(e)
+            return None
+        if proc.returncode != 0:
+            _build_error = proc.stderr[-2000:]
+            try:
+                os.remove(tmp)
+            except FileNotFoundError:
+                pass
+            return None
+        os.replace(tmp, _LIB)
+        return _LIB
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build_native()
+    if path is None:
+        raise RuntimeError(f"native store unavailable: {_build_error}")
+    lib = ctypes.CDLL(path)
+    lib.cs_open.restype = ctypes.c_void_p
+    lib.cs_open.argtypes = [ctypes.c_char_p]
+    lib.cs_close.argtypes = [ctypes.c_void_p]
+    lib.cs_error.restype = ctypes.c_char_p
+    lib.cs_error.argtypes = [ctypes.c_void_p]
+    lib.cs_last_seq.restype = ctypes.c_uint64
+    lib.cs_last_seq.argtypes = [ctypes.c_void_p]
+    lib.cs_put.restype = ctypes.c_int64
+    lib.cs_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                           ctypes.c_char_p, ctypes.c_uint32]
+    lib.cs_del.restype = ctypes.c_int64
+    lib.cs_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.cs_snapshot.restype = ctypes.c_uint64
+    lib.cs_snapshot.argtypes = [ctypes.c_void_p]
+    lib.cs_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.cs_get.restype = ctypes.c_int
+    lib.cs_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+                           ctypes.c_uint32,
+                           ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                           ctypes.POINTER(ctypes.c_uint32)]
+    lib.cs_scan_begin.restype = ctypes.c_void_p
+    lib.cs_scan_begin.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                  ctypes.c_char_p, ctypes.c_uint32]
+    lib.cs_scan_next.restype = ctypes.c_int
+    lib.cs_scan_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.cs_scan_end.argtypes = [ctypes.c_void_p]
+    lib.cs_sync.restype = ctypes.c_int
+    lib.cs_sync.argtypes = [ctypes.c_void_p]
+    lib.cs_count.restype = ctypes.c_uint64
+    lib.cs_count.argtypes = [ctypes.c_void_p]
+    lib.cs_compact.restype = ctypes.c_int
+    lib.cs_compact.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    global _build_error
+    try:
+        _load()
+        return True
+    except Exception as e:  # incl. OSError from a corrupt cached .so
+        _build_error = str(e)
+        return False
+
+
+class NativeStore:
+    """Ordered KV with MVCC snapshots over the C++ store."""
+
+    def __init__(self, path: str) -> None:
+        lib = _load()
+        self._lib = lib
+        self._h = lib.cs_open(path.encode())
+        if not self._h:
+            raise RuntimeError(f"cs_open failed for {path}")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.cs_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativeStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def put(self, key: bytes, value: bytes) -> int:
+        seq = self._lib.cs_put(self._h, key, len(key), value, len(value))
+        if seq < 0:
+            raise RuntimeError(self._lib.cs_error(self._h).decode())
+        return seq
+
+    def delete(self, key: bytes) -> int:
+        seq = self._lib.cs_del(self._h, key, len(key))
+        if seq < 0:
+            raise RuntimeError(self._lib.cs_error(self._h).decode())
+        return seq
+
+    def get(self, key: bytes, snap: int = 0) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        out_len = ctypes.c_uint32()
+        rc = self._lib.cs_get(self._h, snap, key, len(key),
+                              ctypes.byref(out), ctypes.byref(out_len))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise RuntimeError(self._lib.cs_error(self._h).decode())
+        return ctypes.string_at(out, out_len.value)
+
+    def snapshot(self) -> int:
+        return self._lib.cs_snapshot(self._h)
+
+    def release(self, snap: int) -> None:
+        self._lib.cs_release(self._h, snap)
+
+    def scan(self, prefix: bytes = b"", snap: int = 0
+             ) -> Iterator[Tuple[bytes, bytes]]:
+        it = self._lib.cs_scan_begin(self._h, snap, prefix, len(prefix))
+        try:
+            key = ctypes.POINTER(ctypes.c_ubyte)()
+            klen = ctypes.c_uint32()
+            val = ctypes.POINTER(ctypes.c_ubyte)()
+            vlen = ctypes.c_uint32()
+            while True:
+                rc = self._lib.cs_scan_next(
+                    it, ctypes.byref(key), ctypes.byref(klen),
+                    ctypes.byref(val), ctypes.byref(vlen))
+                if rc == 1:
+                    return
+                if rc != 0:
+                    raise RuntimeError("scan failed")
+                yield (ctypes.string_at(key, klen.value),
+                       ctypes.string_at(val, vlen.value))
+        finally:
+            self._lib.cs_scan_end(it)
+
+    def count(self) -> int:
+        return self._lib.cs_count(self._h)
+
+    def last_seq(self) -> int:
+        return self._lib.cs_last_seq(self._h)
+
+    def sync(self) -> None:
+        if self._lib.cs_sync(self._h) != 0:
+            raise RuntimeError("fsync failed")
+
+    def compact(self) -> None:
+        if self._lib.cs_compact(self._h) != 0:
+            raise RuntimeError(self._lib.cs_error(self._h).decode())
+
+
+def _log_key(index: int) -> bytes:
+    return b"l:" + struct.pack(">Q", index)
+
+
+class NativeLogStore:
+    """The consensus/log.py LogStore + StableStore contract over the
+    native store (the raft-boltdb role, consul/server.go:357-368)."""
+
+    def __init__(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        self._store = NativeStore(os.path.join(path, "raft.cstore"))
+        self._first = 0
+        self._last = 0
+        for k, _ in self._store.scan(b"l:"):
+            idx = struct.unpack(">Q", k[2:])[0]
+            if self._first == 0:
+                self._first = idx
+            self._first = min(self._first, idx)
+            self._last = max(self._last, idx)
+
+    # -- LogStore ----------------------------------------------------------
+
+    def first_index(self) -> int:
+        return self._first
+
+    def last_index(self) -> int:
+        return self._last
+
+    def get(self, index: int):
+        from consul_tpu.consensus.log import LogEntry
+        raw = self._store.get(_log_key(index))
+        return LogEntry.unpack(raw) if raw is not None else None
+
+    def append(self, entries: List) -> None:
+        for e in entries:
+            self._store.put(_log_key(e.index), e.pack())
+            if self._first == 0:
+                self._first = e.index
+            self._last = max(self._last, e.index)
+        self._store.sync()
+
+    def delete_from(self, index: int) -> None:
+        for i in range(index, self._last + 1):
+            self._store.delete(_log_key(i))
+        self._last = max(index - 1, 0)
+        if self._last < self._first:
+            self._first = 0
+        self._store.sync()
+
+    def delete_to(self, index: int) -> None:
+        lo = self._first or 1
+        for i in range(lo, index + 1):
+            self._store.delete(_log_key(i))
+        self._first = index + 1 if self._last > index else 0
+        if self._first == 0:
+            self._last = 0
+        self._store.compact()  # reclaim the dead range on disk
+        self._store.sync()
+
+    # -- StableStore -------------------------------------------------------
+
+    def set_stable(self, key: str, val) -> None:
+        import json
+        self._store.put(b"s:" + key.encode(), json.dumps(val).encode())
+        self._store.sync()
+
+    def get_stable(self, key: str, default=None):
+        import json
+        raw = self._store.get(b"s:" + key.encode())
+        return json.loads(raw) if raw is not None else default
+
+    def sync(self) -> None:
+        self._store.sync()
+
+    def close(self) -> None:
+        self._store.close()

@@ -73,7 +73,27 @@ class Server:
         peers = self.config.peers or [self.config.node_name]
         if self.config.data_dir:
             import os
-            log_store = FileLogStore(os.path.join(self.config.data_dir, "raft"))
+            raft_dir = os.path.join(self.config.data_dir, "raft")
+            # The on-disk format decides the backend — a toolchain change
+            # must NEVER flip a node to an empty log (that would amnesia
+            # its term/vote and allow double-voting).  Fresh data dirs
+            # prefer the C++ mmap store (the raft-boltdb role; first boot
+            # pays a one-time build) and fall back to the Python segment
+            # log if the toolchain is absent.  Errors opening an EXISTING
+            # store propagate rather than silently starting empty.
+            has_native = os.path.exists(os.path.join(raft_dir, "raft.cstore"))
+            has_file = os.path.exists(os.path.join(raft_dir, "log.seg"))
+            if has_native:
+                from consul_tpu.native import NativeLogStore
+                log_store = NativeLogStore(raft_dir)
+            elif has_file:
+                log_store = FileLogStore(raft_dir)
+            else:
+                from consul_tpu.native import NativeLogStore, native_available
+                if native_available():
+                    log_store = NativeLogStore(raft_dir)
+                else:
+                    log_store = FileLogStore(raft_dir)
             snap_store = FileSnapshotStore(os.path.join(self.config.data_dir, "snaps"))
         else:
             log_store, snap_store = MemoryLogStore(), MemorySnapshotStore()

@@ -1,0 +1,416 @@
+// consul-tpu native store: a single-writer / multi-reader MVCC KV store
+// over an mmap'd append-only segment.
+//
+// Role: the reference's one native dependency is LMDB (mmap B-tree,
+// consul/state_store.go:15 via armon/gomdb) used for MVCC tables, and
+// BoltDB (mmap B-tree) for the raft log (consul/server.go:357-368).
+// This store plays both parts for the TPU-native framework:
+//   - ordered key space with prefix scans (LMDB's id_prefix indexes)
+//   - snapshot isolation for readers against a single writer (LMDB MVCC)
+//   - append-only durable segment with CRC framing + fsync batching
+//     (the raft-log role; durability of *state* still comes from the
+//     Raft log above, mirroring the reference's NOSYNC stance,
+//     state_store.go:190-196)
+//
+// Design: records append to a segment file that is mmap'd for reads.
+// An in-memory ordered index (std::map) holds per-key version chains
+// (seq, offset, len, tombstone).  Readers pin a snapshot sequence; a
+// version is visible to snapshot S if its seq <= S and it is the
+// newest such version.  Old versions are pruned on compaction, which
+// rewrites live records and remaps.
+//
+// Concurrency: one writer at a time (callers serialize; the Python
+// host plane is a single event loop), any number of readers under
+// shared_mutex.  All exported symbols use a C ABI for ctypes.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x43545053;  // "CTPS"
+constexpr uint8_t kOpPut = 1;
+constexpr uint8_t kOpDel = 2;
+
+#pragma pack(push, 1)
+struct RecHdr {
+  uint32_t len;   // bytes after this header (body)
+  uint32_t crc;   // crc32 of body
+};
+struct RecBody {
+  uint64_t seq;
+  uint8_t op;
+  uint16_t klen;
+  uint32_t vlen;
+  // key bytes, then value bytes
+};
+#pragma pack(pop)
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Version {
+  uint64_t seq;
+  uint64_t off;    // offset of value bytes inside the segment
+  uint32_t vlen;
+  bool tombstone;
+};
+
+struct Store {
+  std::string path;
+  int fd = -1;
+  uint8_t* map = nullptr;
+  size_t map_len = 0;     // mapped bytes
+  size_t file_len = 0;    // written bytes
+  uint64_t seq = 0;
+  std::map<std::string, std::vector<Version>> index;
+  std::multiset<uint64_t> snapshots;  // pinned reader sequences
+  // Retired mappings, unmapped only at compaction/close: a reader's
+  // pointer from cs_get/cs_scan_next must stay valid while it copies
+  // (the contract is "valid until the next compaction"), so growth
+  // keeps the old region alive.  Doubling sizes bound the waste to
+  // ~2x the live mapping.
+  std::vector<std::pair<uint8_t*, size_t>> retired;
+  std::shared_mutex mu;
+  std::string err;
+
+  void drop_retired() {
+    for (auto& [p, n] : retired) munmap(p, n);
+    retired.clear();
+  }
+
+  bool remap() {
+    size_t want = std::max<size_t>(file_len, 1);
+    if (map && map_len >= want) return true;
+    size_t new_len = 1;
+    while (new_len < want) new_len <<= 1;
+    new_len = std::max<size_t>(new_len, 1 << 20);
+    if (map) retired.emplace_back(map, map_len);
+    void* m = mmap(nullptr, new_len, PROT_READ, MAP_SHARED, fd, 0);
+    if (m == MAP_FAILED) { map = nullptr; map_len = 0; err = "mmap failed"; return false; }
+    map = static_cast<uint8_t*>(m);
+    map_len = new_len;
+    return true;
+  }
+
+  bool append_record(uint8_t op, const std::string& key,
+                     const uint8_t* val, uint32_t vlen, uint64_t* out_voff) {
+    RecBody body{};
+    body.seq = ++seq;
+    body.op = op;
+    body.klen = static_cast<uint16_t>(key.size());
+    body.vlen = vlen;
+    size_t body_len = sizeof(RecBody) + key.size() + vlen;
+    std::vector<uint8_t> buf(sizeof(RecHdr) + body_len);
+    auto* hdr = reinterpret_cast<RecHdr*>(buf.data());
+    uint8_t* b = buf.data() + sizeof(RecHdr);
+    memcpy(b, &body, sizeof(RecBody));
+    memcpy(b + sizeof(RecBody), key.data(), key.size());
+    if (vlen) memcpy(b + sizeof(RecBody) + key.size(), val, vlen);
+    hdr->len = static_cast<uint32_t>(body_len);
+    hdr->crc = crc32(b, body_len);
+    ssize_t wrote = pwrite(fd, buf.data(), buf.size(), file_len);
+    if (wrote != static_cast<ssize_t>(buf.size())) { err = "short write"; --seq; return false; }
+    *out_voff = file_len + sizeof(RecHdr) + sizeof(RecBody) + key.size();
+    file_len += buf.size();
+    // Growing the file keeps existing mapping valid for old offsets;
+    // remap lazily when a read needs the new tail.
+    return true;
+  }
+
+  bool replay() {
+    struct stat st{};
+    if (fstat(fd, &st) != 0) { err = "fstat failed"; return false; }
+    file_len = 0;
+    size_t end = static_cast<size_t>(st.st_size);
+    if (end == 0) return true;
+    if (!remap_for(end)) return false;
+    size_t pos = 0;
+    while (pos + sizeof(RecHdr) <= end) {
+      auto* hdr = reinterpret_cast<RecHdr*>(map + pos);
+      if (hdr->len == 0 || pos + sizeof(RecHdr) + hdr->len > end) break;
+      const uint8_t* b = map + pos + sizeof(RecHdr);
+      if (crc32(b, hdr->len) != hdr->crc) break;  // torn tail
+      RecBody body{};
+      memcpy(&body, b, sizeof(RecBody));
+      if (sizeof(RecBody) + body.klen + body.vlen != hdr->len) break;
+      std::string key(reinterpret_cast<const char*>(b + sizeof(RecBody)),
+                      body.klen);
+      uint64_t voff = pos + sizeof(RecHdr) + sizeof(RecBody) + body.klen;
+      index[key].push_back(Version{body.seq, voff, body.vlen,
+                                   body.op == kOpDel});
+      seq = std::max(seq, body.seq);
+      pos += sizeof(RecHdr) + hdr->len;
+    }
+    file_len = pos;
+    if (pos != end) {
+      // torn tail: truncate to the last good record
+      if (ftruncate(fd, static_cast<off_t>(pos)) != 0) { err = "truncate failed"; return false; }
+    }
+    return true;
+  }
+
+  bool remap_for(size_t want) {
+    size_t save = file_len;
+    file_len = want;
+    bool ok = remap();
+    file_len = save;
+    return ok;
+  }
+
+  uint64_t min_pinned() const {
+    return snapshots.empty() ? UINT64_MAX : *snapshots.begin();
+  }
+
+  const Version* visible(const std::vector<Version>& chain,
+                         uint64_t snap) const {
+    const Version* best = nullptr;
+    for (const auto& v : chain)
+      if (v.seq <= snap && (!best || v.seq > best->seq)) best = &v;
+    return best;
+  }
+};
+
+struct ScanIter {
+  Store* s;
+  uint64_t snap;
+  std::string prefix;
+  std::map<std::string, std::vector<Version>>::const_iterator it;
+};
+
+bool has_prefix(const std::string& s, const std::string& p) {
+  return s.size() >= p.size() && memcmp(s.data(), p.data(), p.size()) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+Store* cs_open(const char* path) {
+  auto* s = new Store();
+  s->path = path;
+  s->fd = open(path, O_RDWR | O_CREAT, 0644);
+  if (s->fd < 0) { delete s; return nullptr; }
+  if (!s->replay()) { close(s->fd); delete s; return nullptr; }
+  s->remap();
+  return s;
+}
+
+void cs_close(Store* s) {
+  if (!s) return;
+  s->drop_retired();
+  if (s->map) munmap(s->map, s->map_len);
+  if (s->fd >= 0) close(s->fd);
+  delete s;
+}
+
+const char* cs_error(Store* s) { return s ? s->err.c_str() : "null store"; }
+
+uint64_t cs_last_seq(Store* s) {
+  std::shared_lock lk(s->mu);
+  return s->seq;
+}
+
+int64_t cs_put(Store* s, const uint8_t* key, uint32_t klen,
+               const uint8_t* val, uint32_t vlen) {
+  if (klen > UINT16_MAX) { s->err = "key too long"; return -1; }
+  std::unique_lock lk(s->mu);
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  uint64_t voff = 0;
+  if (!s->append_record(kOpPut, k, val, vlen, &voff)) return -1;
+  s->remap();  // writer owns the lock: readers never grow the mapping
+  auto& chain = s->index[k];
+  // prune versions invisible to every pinned snapshot
+  uint64_t keep = std::min(s->min_pinned(), s->seq - 1);
+  const Version* vis = s->visible(chain, keep);
+  uint64_t vis_seq = vis ? vis->seq : 0;
+  chain.erase(std::remove_if(chain.begin(), chain.end(),
+                             [&](const Version& v) { return v.seq < vis_seq; }),
+              chain.end());
+  chain.push_back(Version{s->seq, voff, vlen, false});
+  return static_cast<int64_t>(s->seq);
+}
+
+int64_t cs_del(Store* s, const uint8_t* key, uint32_t klen) {
+  std::unique_lock lk(s->mu);
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  auto it = s->index.find(k);
+  if (it == s->index.end()) return static_cast<int64_t>(s->seq);
+  uint64_t voff = 0;
+  if (!s->append_record(kOpDel, k, nullptr, 0, &voff)) return -1;
+  s->remap();
+  it->second.push_back(Version{s->seq, voff, 0, true});
+  return static_cast<int64_t>(s->seq);
+}
+
+uint64_t cs_snapshot(Store* s) {
+  std::unique_lock lk(s->mu);
+  s->snapshots.insert(s->seq);
+  return s->seq;
+}
+
+void cs_release(Store* s, uint64_t snap) {
+  std::unique_lock lk(s->mu);
+  auto it = s->snapshots.find(snap);
+  if (it != s->snapshots.end()) s->snapshots.erase(it);
+}
+
+// Returns 0 found (out/out_len set; pointer into the mmap, valid until
+// the next compaction), 1 not found, -1 error.
+int cs_get(Store* s, uint64_t snap, const uint8_t* key, uint32_t klen,
+           const uint8_t** out, uint32_t* out_len) {
+  std::shared_lock lk(s->mu);
+  if (snap == 0) snap = s->seq;
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  auto it = s->index.find(k);
+  if (it == s->index.end()) return 1;
+  const Version* v = s->visible(it->second, snap);
+  if (!v || v->tombstone) return 1;
+  if (v->off + v->vlen > s->map_len) return -1;  // writer remaps, not us
+  *out = s->map + v->off;
+  *out_len = v->vlen;
+  return 0;
+}
+
+ScanIter* cs_scan_begin(Store* s, uint64_t snap, const uint8_t* prefix,
+                        uint32_t plen) {
+  auto* iter = new ScanIter();
+  iter->s = s;
+  std::unique_lock lk(s->mu);
+  iter->snap = snap == 0 ? s->seq : snap;
+  // Pin the scan's view: compaction (which would invalidate both the
+  // index iterator and value pointers) refuses while pinned.
+  s->snapshots.insert(iter->snap);
+  iter->prefix.assign(reinterpret_cast<const char*>(prefix), plen);
+  iter->it = s->index.lower_bound(iter->prefix);
+  return iter;
+}
+
+// Returns 0 with key/value set, 1 at end.  Skips tombstones.
+int cs_scan_next(ScanIter* iter, const uint8_t** key, uint32_t* klen,
+                 const uint8_t** val, uint32_t* vlen) {
+  Store* s = iter->s;
+  std::shared_lock lk(s->mu);
+  while (iter->it != s->index.end() &&
+         has_prefix(iter->it->first, iter->prefix)) {
+    const Version* v = s->visible(iter->it->second, iter->snap);
+    const auto& k = iter->it->first;
+    ++iter->it;
+    if (!v || v->tombstone) continue;
+    if (v->off + v->vlen > s->map_len) return -1;
+    *key = reinterpret_cast<const uint8_t*>(k.data());
+    *klen = static_cast<uint32_t>(k.size());
+    *val = s->map + v->off;
+    *vlen = v->vlen;
+    return 0;
+  }
+  return 1;
+}
+
+void cs_scan_end(ScanIter* iter) {
+  Store* s = iter->s;
+  {
+    std::unique_lock lk(s->mu);
+    auto it = s->snapshots.find(iter->snap);
+    if (it != s->snapshots.end()) s->snapshots.erase(it);
+  }
+  delete iter;
+}
+
+int cs_sync(Store* s) {
+  std::shared_lock lk(s->mu);
+  return fsync(s->fd) == 0 ? 0 : -1;
+}
+
+uint64_t cs_count(Store* s) {
+  std::shared_lock lk(s->mu);
+  uint64_t n = 0;
+  for (const auto& [k, chain] : s->index) {
+    const Version* v = s->visible(chain, s->seq);
+    if (v && !v->tombstone) n++;
+  }
+  return n;
+}
+
+// Rewrite live (visible-at-head, non-tombstone) records into a fresh
+// segment; drops history.  Requires no pinned snapshots.
+int cs_compact(Store* s) {
+  std::unique_lock lk(s->mu);
+  if (!s->snapshots.empty()) { s->err = "snapshots pinned"; return -1; }
+  std::string tmp_path = s->path + ".compact";
+  int tfd = open(tmp_path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (tfd < 0) { s->err = "compact open failed"; return -1; }
+
+  if (!s->remap()) { close(tfd); return -1; }
+  std::map<std::string, std::vector<Version>> new_index;
+  size_t new_len = 0;
+  uint64_t new_seq = 0;
+  for (const auto& [k, chain] : s->index) {
+    const Version* v = s->visible(chain, s->seq);
+    if (!v || v->tombstone) continue;
+    RecBody body{};
+    body.seq = ++new_seq;
+    body.op = kOpPut;
+    body.klen = static_cast<uint16_t>(k.size());
+    body.vlen = v->vlen;
+    size_t body_len = sizeof(RecBody) + k.size() + v->vlen;
+    std::vector<uint8_t> buf(sizeof(RecHdr) + body_len);
+    auto* hdr = reinterpret_cast<RecHdr*>(buf.data());
+    uint8_t* b = buf.data() + sizeof(RecHdr);
+    memcpy(b, &body, sizeof(RecBody));
+    memcpy(b + sizeof(RecBody), k.data(), k.size());
+    if (v->vlen) memcpy(b + sizeof(RecBody) + k.size(), s->map + v->off, v->vlen);
+    hdr->len = static_cast<uint32_t>(body_len);
+    hdr->crc = crc32(b, body_len);
+    if (pwrite(tfd, buf.data(), buf.size(), new_len)
+        != static_cast<ssize_t>(buf.size())) {
+      close(tfd); unlink(tmp_path.c_str()); s->err = "compact write failed";
+      return -1;
+    }
+    new_index[k].push_back(Version{
+        new_seq, new_len + sizeof(RecHdr) + sizeof(RecBody) + k.size(),
+        v->vlen, false});
+    new_len += buf.size();
+  }
+  if (fsync(tfd) != 0 || rename(tmp_path.c_str(), s->path.c_str()) != 0) {
+    close(tfd); unlink(tmp_path.c_str()); s->err = "compact swap failed";
+    return -1;
+  }
+  s->drop_retired();
+  if (s->map) { munmap(s->map, s->map_len); s->map = nullptr; s->map_len = 0; }
+  close(s->fd);
+  s->fd = tfd;
+  s->file_len = new_len;
+  s->index = std::move(new_index);
+  // seq keeps monotonically increasing across compactions so pinned
+  // snapshot numbering stays meaningful to callers.
+  s->remap();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,177 @@
+"""C++ MVCC store tests (the LMDB/BoltDB-role native component,
+SURVEY §2.1).  Skipped wholesale if the toolchain can't build it."""
+
+import os
+import struct
+import threading
+
+import pytest
+
+from consul_tpu.native import NativeLogStore, NativeStore, native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = NativeStore(str(tmp_path / "t.cstore"))
+    yield s
+    s.close()
+
+
+class TestKV:
+    def test_put_get_delete(self, store):
+        store.put(b"k1", b"v1")
+        assert store.get(b"k1") == b"v1"
+        store.put(b"k1", b"v2")
+        assert store.get(b"k1") == b"v2"
+        store.delete(b"k1")
+        assert store.get(b"k1") is None
+        assert store.get(b"never") is None
+
+    def test_empty_value_and_binary_keys(self, store):
+        store.put(b"empty", b"")
+        assert store.get(b"empty") == b""
+        key = bytes(range(256))[:200]
+        store.put(key, b"\x00\xff binary")
+        assert store.get(key) == b"\x00\xff binary"
+
+    def test_prefix_scan_ordered(self, store):
+        for k in (b"b/2", b"a", b"b/1", b"b/3", b"c"):
+            store.put(k, k.upper())
+        assert [k for k, _ in store.scan(b"b/")] == [b"b/1", b"b/2", b"b/3"]
+        assert [k for k, _ in store.scan()] == [b"a", b"b/1", b"b/2", b"b/3", b"c"]
+        assert [v for _, v in store.scan(b"b/")] == [b"B/1", b"B/2", b"B/3"]
+
+    def test_mvcc_snapshot_isolation(self, store):
+        store.put(b"x", b"old")
+        snap = store.snapshot()
+        store.put(b"x", b"new")
+        store.put(b"y", b"born-later")
+        store.delete(b"x")
+        assert store.get(b"x", snap) == b"old"
+        assert store.get(b"y", snap) is None
+        assert store.get(b"x") is None
+        assert [k for k, _ in store.scan(b"", snap)] == [b"x"]
+        store.release(snap)
+
+    def test_count_and_seq(self, store):
+        assert store.count() == 0
+        s1 = store.put(b"a", b"1")
+        s2 = store.put(b"b", b"2")
+        assert s2 > s1
+        store.delete(b"a")
+        assert store.count() == 1
+        assert store.last_seq() > s2
+
+    def test_compact_drops_history(self, store, tmp_path):
+        for i in range(100):
+            store.put(b"hot", str(i).encode())
+        store.put(b"cold", b"keep")
+        store.delete(b"hot")
+        pre = os.path.getsize(tmp_path / "t.cstore")
+        store.compact()
+        post = os.path.getsize(tmp_path / "t.cstore")
+        assert post < pre
+        assert store.get(b"cold") == b"keep"
+        assert store.get(b"hot") is None
+
+    def test_compact_refused_with_pinned_snapshot(self, store):
+        store.put(b"a", b"1")
+        snap = store.snapshot()
+        with pytest.raises(RuntimeError):
+            store.compact()
+        store.release(snap)
+        store.compact()
+        assert store.get(b"a") == b"1"
+
+    def test_durability_replay(self, tmp_path):
+        p = str(tmp_path / "d.cstore")
+        s = NativeStore(p)
+        for i in range(50):
+            s.put(f"k{i:03d}".encode(), f"v{i}".encode())
+        s.delete(b"k010")
+        s.sync()
+        s.close()
+        s2 = NativeStore(p)
+        assert s2.count() == 49
+        assert s2.get(b"k011") == b"v11"
+        assert s2.get(b"k010") is None
+        s2.close()
+
+    def test_torn_tail_recovery(self, tmp_path):
+        p = str(tmp_path / "torn.cstore")
+        s = NativeStore(p)
+        s.put(b"good", b"record")
+        s.sync()
+        s.close()
+        # corrupt: append garbage (simulates a torn write at crash)
+        with open(p, "ab") as f:
+            f.write(b"\x50\x00\x00\x00garbage-partial-record")
+        s2 = NativeStore(p)
+        assert s2.get(b"good") == b"record"
+        # store still writable after truncating the torn tail
+        s2.put(b"after", b"crash")
+        assert s2.get(b"after") == b"crash"
+        s2.close()
+
+    def test_concurrent_readers(self, store):
+        for i in range(500):
+            store.put(f"key{i:04d}".encode(), str(i).encode())
+        errors = []
+
+        def reader():
+            try:
+                for _ in range(20):
+                    snap = store.snapshot()
+                    got = list(store.scan(b"key", snap))
+                    assert len(got) == 500
+                    store.release(snap)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(200):
+            store.put(b"churn", os.urandom(32))
+        for t in threads:
+            t.join()
+        assert errors == []
+
+
+class TestNativeLogStore:
+    def test_log_contract(self, tmp_path):
+        from consul_tpu.consensus.log import LOG_COMMAND, LogEntry
+        ls = NativeLogStore(str(tmp_path / "raft"))
+        assert ls.first_index() == 0 and ls.last_index() == 0
+        ls.append([LogEntry(index=i, term=1, type=LOG_COMMAND,
+                            data=f"cmd{i}".encode()) for i in range(1, 11)])
+        assert ls.first_index() == 1 and ls.last_index() == 10
+        assert ls.get(5).data == b"cmd5"
+        # conflict truncation
+        ls.delete_from(8)
+        assert ls.last_index() == 7 and ls.get(9) is None
+        # snapshot compaction
+        ls.delete_to(3)
+        assert ls.first_index() == 4
+        assert ls.get(2) is None and ls.get(4).data == b"cmd4"
+        # stable store
+        ls.set_stable("term", 7)
+        ls.set_stable("voted_for", "n2")
+        assert ls.get_stable("term") == 7
+        ls.close()
+        # reopen: everything durable
+        ls2 = NativeLogStore(str(tmp_path / "raft"))
+        assert ls2.first_index() == 4 and ls2.last_index() == 7
+        assert ls2.get(6).data == b"cmd6"
+        assert ls2.get_stable("voted_for") == "n2"
+        assert ls2.get_stable("missing", "dflt") == "dflt"
+        ls2.close()
+
+    def test_server_uses_native_log(self, tmp_path):
+        """Server with a data_dir picks the native store when buildable."""
+        from consul_tpu.server.server import Server, ServerConfig
+        srv = Server(ServerConfig(node_name="s1", data_dir=str(tmp_path)))
+        assert type(srv.raft.log).__name__ == "NativeLogStore"
