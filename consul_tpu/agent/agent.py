@@ -69,6 +69,7 @@ class AgentConfig:
     acl_down_policy: str = "extend-cache"
     acl_master_token: str = ""
     acl_token: str = ""  # agent's own default token
+    encrypt: str = ""    # base64 16-byte gossip key (enables the keyring)
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -108,6 +109,16 @@ class Agent:
         self.ipc = IPCServer(self)
         self.ipc_port: Optional[int] = self.config.extra.get("ipc_port")
         self._left: Optional[asyncio.Event] = None  # armed in start()
+        # Gossip keyring (setupKeyrings, agent.go:350-388): an encrypt key
+        # or an existing keyring file arms it.
+        keyring_path = (os.path.join(self.config.data_dir, "serf",
+                                     "local.keyring")
+                        if self.config.data_dir else "")
+        if self.config.encrypt or (keyring_path
+                                   and os.path.exists(keyring_path)):
+            from consul_tpu.agent.keyring import Keyring
+            self.server.keyring = Keyring(path=keyring_path,
+                                          initial_key=self.config.encrypt)
 
     @property
     def node_name(self) -> str:
@@ -196,9 +207,19 @@ class Agent:
         self.log.remove_sink(sink)
 
     async def keyring_operation(self, op: str, key: str = "") -> Dict[str, Any]:
-        """Gossip-keyring ops; the encryption keyring lands with the
-        network gossip layer (agent/keyring.go)."""
-        raise ValueError("keyring not configured (gossip encryption disabled)")
+        """Keyring op fanned across every known DC and merged
+        (KeyringOperation via globalRPC, consul/internal_endpoint.go:68+)."""
+        local = await self.server.keyring_operation_local(op, key)
+        merged = {"Keys": dict(local.get("Keys", {})),
+                  "NumNodes": local.get("NumNodes", 1),
+                  "Messages": dict(local.get("Messages", {}))}
+        for dc in list(self.server.remote_dcs):
+            out = await self.server.forward_dc(
+                dc, "Internal.KeyringOperation", {"op": op, "key": key})
+            for k, c in (out or {}).get("Keys", {}).items():
+                merged["Keys"][k] = merged["Keys"].get(k, 0) + c
+            merged["NumNodes"] += (out or {}).get("NumNodes", 0)
+        return merged
 
     async def _register_self(self) -> None:
         """What handleAliveMember does for each live node on the leader

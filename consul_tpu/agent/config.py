@@ -360,4 +360,5 @@ def to_agent_config(cfg: Config):
         acl_down_policy=cfg.acl_down_policy,
         acl_master_token=cfg.acl_master_token,
         acl_token=cfg.acl_token,
+        encrypt=cfg.encrypt,
     )

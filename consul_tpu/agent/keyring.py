@@ -1,0 +1,114 @@
+"""Gossip encryption keyring.
+
+Parity target: ``command/agent/keyring.go`` (22-108: init/load of the
+serf keyring file) + the Serf KeyManager semantics the CLI drives via
+``consul keyring`` (install / use / remove / list) and
+``Internal.KeyringOperation``'s cross-DC fan-out.
+
+The ring is a JSON file of base64 16-byte keys with the primary first
+(the serf snapshot format).  Keys gate the real-network gossip path;
+the in-HBM simulator doesn't encrypt (no wire to protect), so the ring
+is authoritative agent state that the UDP transport will consume.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Dict, List, Optional
+
+KEY_LEN = 16
+
+
+class KeyringError(ValueError):
+    pass
+
+
+def _validate(key: str) -> bytes:
+    try:
+        raw = base64.b64decode(key, validate=True)
+    except Exception:
+        raise KeyringError(f"Invalid key: not base64")
+    if len(raw) != KEY_LEN:
+        raise KeyringError(f"Invalid key: expected {KEY_LEN} bytes, "
+                           f"got {len(raw)}")
+    return raw
+
+
+class Keyring:
+    """Primary + installed keys, optionally persisted to
+    ``<data-dir>/serf/local.keyring`` (loadKeyringFile, keyring.go:57+)."""
+
+    def __init__(self, path: str = "", initial_key: str = "") -> None:
+        self.path = path
+        self.keys: List[str] = []
+        if path and os.path.exists(path):
+            with open(path) as f:
+                keys = json.load(f)
+            if not isinstance(keys, list) or not keys:
+                raise KeyringError(f"keyring file {path} is invalid")
+            for k in keys:
+                _validate(k)
+            self.keys = keys
+        elif initial_key:
+            _validate(initial_key)
+            self.keys = [initial_key]
+            self._save()
+        else:
+            raise KeyringError("no keyring file and no initial key")
+
+    @property
+    def primary(self) -> str:
+        return self.keys[0]
+
+    def _save(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.keys, f)
+        os.replace(tmp, self.path)
+
+    # -- operations (serf KeyManager semantics) -----------------------------
+
+    def install(self, key: str) -> None:
+        _validate(key)
+        if key not in self.keys:
+            self.keys.append(key)
+            self._save()
+
+    def use(self, key: str) -> None:
+        if key not in self.keys:
+            raise KeyringError("key is not installed, install it first")
+        self.keys.remove(key)
+        self.keys.insert(0, key)
+        self._save()
+
+    def remove(self, key: str) -> None:
+        if key == self.primary:
+            raise KeyringError("Removing the primary key is not allowed")
+        if key in self.keys:
+            self.keys.remove(key)
+            self._save()
+
+    def list_keys(self) -> List[str]:
+        return list(self.keys)
+
+    def operation(self, op: str, key: str = "",
+                  node: str = "") -> Dict:
+        """One node's response to a keyring op; the fan-out layer merges
+        these into the per-DC KeyringResponse shape."""
+        if op == "list":
+            return {"Keys": {k: 1 for k in self.keys}, "NumNodes": 1,
+                    "Messages": {}}
+        if op == "install":
+            self.install(key)
+        elif op == "use":
+            self.use(key)
+        elif op == "remove":
+            self.remove(key)
+        else:
+            raise KeyringError(f"unknown keyring op: {op}")
+        return {"Keys": {}, "NumNodes": 1, "Messages": {}}

@@ -1,0 +1,150 @@
+"""Connection pool + pooled RPC client + raft TCP transport.
+
+Parity target: ``consul/pool.go`` (399 LoC — per-address pooled
+multiplexed sessions with stream reuse and a reaper) and
+``consul/raft_rpc.go`` (RaftLayer dialing with a protocol byte).
+
+One :class:`ConnPool` per process: ``rpc(addr, method, body)`` opens a
+stream on the address's pooled mux session (dialing with the
+``RPC_MULTIPLEX`` selector byte on first use) and runs one
+request/response exchange.  :class:`TCPTransport` adapts the pool to
+the consensus layer's ``call(src, dst, method, msg)`` contract, so the
+same port carries raft the way the reference multiplexes RaftLayer
+onto port 8300.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+from typing import Any, Dict, Optional, Tuple
+
+import msgpack
+
+from consul_tpu.rpc.mux import MuxError, MuxSession
+from consul_tpu.rpc.wire import raft_msg_to_wire, raft_resp_from_wire
+
+# Protocol selector bytes (consul/rpc.go:19-27).
+RPC_CONSUL = 0x01
+RPC_RAFT = 0x02
+RPC_TLS = 0x03
+RPC_MULTIPLEX = 0x05  # the yamux-era selector
+
+
+class RPCError(Exception):
+    pass
+
+
+class ConnPool:
+    def __init__(self, tls_wrap: Optional[Any] = None,
+                 dial_timeout: float = 5.0) -> None:
+        self._sessions: Dict[str, MuxSession] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+        self._tls_wrap = tls_wrap  # callable(dc) -> ssl.SSLContext | None
+        self._dial_timeout = dial_timeout
+
+    async def _session(self, addr: str, dc: str = "") -> MuxSession:
+        sess = self._sessions.get(addr)
+        if sess is not None and not sess.closed:
+            return sess
+        lock = self._locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            sess = self._sessions.get(addr)
+            if sess is not None and not sess.closed:
+                return sess
+            host, _, port = addr.rpartition(":")
+            ctx: Optional[ssl.SSLContext] = None
+            if self._tls_wrap is not None:
+                ctx = self._tls_wrap(dc)
+            if ctx is not None:
+                # TLS wrap: selector byte first in the clear, then the
+                # handshake (rpcTLS, consul/rpc.go:100-112).
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)),
+                    self._dial_timeout)
+                writer.write(bytes([RPC_TLS]))
+                await writer.drain()
+                await writer.start_tls(
+                    ctx, server_hostname=self._server_hostname(dc))
+                writer.write(bytes([RPC_MULTIPLEX]))
+                await writer.drain()
+            else:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)),
+                    self._dial_timeout)
+                writer.write(bytes([RPC_MULTIPLEX]))
+                await writer.drain()
+            sess = MuxSession(reader, writer, client=True)
+            self._sessions[addr] = sess
+            return sess
+
+    def _server_hostname(self, dc: str) -> Optional[str]:
+        if self._tls_wrap is None:
+            return None
+        getter = getattr(self._tls_wrap, "server_hostname", None)
+        return getter(dc) if getter else None
+
+    async def rpc(self, addr: str, method: str, body: Any,
+                  dc: str = "", timeout: float = 610.0) -> Any:
+        """One request/response on a pooled stream (ConnPool.RPC,
+        pool.go:342-361).  A dead session is dropped and redialed once."""
+        for attempt in (0, 1):
+            sess = await self._session(addr, dc)
+            try:
+                stream = await sess.open_stream()
+                try:
+                    await stream.send(msgpack.packb(
+                        {"Method": method, "Body": body}, use_bin_type=True))
+                    raw = await asyncio.wait_for(stream.recv(), timeout)
+                finally:
+                    await stream.close()
+                resp = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+                if resp.get("Error"):
+                    raise RPCError(resp["Error"])
+                return resp.get("Body")
+            except (MuxError, ConnectionError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError):
+                self._sessions.pop(addr, None)
+                if attempt:
+                    raise
+        raise RPCError("unreachable")  # pragma: no cover
+
+    async def close(self) -> None:
+        for sess in list(self._sessions.values()):
+            await sess.close()
+        self._sessions.clear()
+
+
+class TCPTransport:
+    """consensus.raft transport over the pooled RPC mesh.
+
+    The address book maps node id -> "host:port" of its RPC listener;
+    register() keeps the reference's MemoryTransport API shape so the
+    Server wiring is backend-agnostic."""
+
+    def __init__(self, pool: Optional[ConnPool] = None) -> None:
+        self.pool = pool or ConnPool()
+        self.addrs: Dict[str, str] = {}
+        self._local: Dict[str, Any] = {}
+
+    def register(self, node) -> None:
+        self._local[node.id] = node
+
+    def set_addr(self, node_id: str, addr: str) -> None:
+        self.addrs[node_id] = addr
+
+    async def call(self, src: str, dst: str, method: str, msg: Any) -> Any:
+        local = self._local.get(dst)
+        if local is not None and dst not in self.addrs:
+            return local.handle(method, msg)
+        addr = self.addrs.get(dst)
+        if addr is None:
+            from consul_tpu.consensus.raft import TransportError
+            raise TransportError(f"no address for {dst}")
+        try:
+            body = await self.pool.rpc(addr, f"Raft.{method}",
+                                       raft_msg_to_wire(msg), timeout=5.0)
+        except (RPCError, OSError, asyncio.TimeoutError) as e:
+            from consul_tpu.consensus.raft import TransportError
+            raise TransportError(str(e)) from e
+        return raft_resp_from_wire(method, body)

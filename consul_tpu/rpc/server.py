@@ -1,0 +1,345 @@
+"""TCP RPC server: one port, protocol-selector byte, mux dispatch.
+
+Parity target: ``consul/rpc.go`` (417 LoC).  The listener reads one
+selector byte per connection (:19-27): ``RPC_CONSUL`` (single-exchange
+msgpack RPC), ``RPC_RAFT`` (raft stream handoff, consul/rpc.go:96-98),
+``RPC_TLS`` (TLS upgrade, then recurse), ``RPC_MULTIPLEX`` (mux
+session; every stream is an independent request/response exchange —
+the yamux path the reference pools, pool.go:238-263).
+
+Dispatch applies the reference's ``forward()`` prologue centrally
+(rpc.go:182-201): a request naming another datacenter hops to a random
+server there (wire-in/wire-out, no re-marshalling); a write or
+consistent read on a non-leader hops to the leader.  Stale reads are
+served wherever they land.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+from consul_tpu.rpc.mux import MuxError, MuxSession
+from consul_tpu.rpc.pool import (
+    RPC_CONSUL, RPC_MULTIPLEX, RPC_RAFT, RPC_TLS, RPCError)
+from consul_tpu.rpc.wire import (
+    raft_msg_to_wire, raft_req_from_wire)
+from consul_tpu.structs.structs import (
+    ACLPolicyRequest, ACLRequest, DeregisterRequest, KeyListRequest,
+    KeyRequest, KVSRequest, MessageType, QueryOptions, RegisterRequest,
+    SessionRequest, UserEvent)
+
+# handler kinds drive the forward() prologue
+LOCAL = "local"   # never forwarded (Status.*, raft internals)
+READ = "read"     # forwarded to leader unless allow_stale
+WRITE = "write"   # always to the leader
+
+
+def _opts(d: Dict) -> QueryOptions:
+    o = d.get("opts") or {}
+    return QueryOptions(
+        token=o.get("token", ""), datacenter=o.get("datacenter", ""),
+        min_query_index=o.get("min_query_index", 0),
+        max_query_time=o.get("max_query_time", 0.0),
+        allow_stale=o.get("allow_stale", False),
+        require_consistent=o.get("require_consistent", False))
+
+
+def _meta_wire(meta) -> Dict:
+    return {"index": meta.index, "known_leader": meta.known_leader,
+            "last_contact": meta.last_contact}
+
+
+def _w(x: Any) -> Any:
+    if hasattr(x, "to_wire"):
+        return x.to_wire()
+    if isinstance(x, dict):
+        return {k: _w(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_w(v) for v in x]
+    return x
+
+
+class RPCServer:
+    def __init__(self, server, tls_incoming=None) -> None:
+        self.srv = server
+        self.tls_incoming = tls_incoming  # ssl.SSLContext or None
+        self._listener: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+        self._handlers = _build_handlers()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._listener = await asyncio.start_server(self._serve, host, port)
+        self.addr = self._listener.sockets[0].getsockname()[:2]
+
+    async def stop(self) -> None:
+        if self._listener is not None:
+            self._listener.close()
+            await self._listener.wait_closed()
+
+    # -- connection handling (handleConn, rpc.go:73-120) --------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            await self._handle(reader, writer, tls_done=False)
+        except (asyncio.IncompleteReadError, ConnectionError, MuxError):
+            pass
+        finally:
+            writer.close()
+
+    async def _handle(self, reader, writer, tls_done: bool) -> None:
+        selector = (await reader.readexactly(1))[0]
+        if selector == RPC_TLS:
+            if self.tls_incoming is None:
+                return  # TLS not configured; drop (rpc.go TLS checks)
+            await writer.start_tls(self.tls_incoming)
+            await self._handle(reader, writer, tls_done=True)
+        elif selector == RPC_MULTIPLEX:
+            sess = MuxSession(reader, writer, client=False)
+            while True:
+                stream = await sess.accept_stream()
+                asyncio.get_event_loop().create_task(
+                    self._serve_stream(stream))
+        elif selector in (RPC_CONSUL, RPC_RAFT):
+            # single-exchange loop on the raw connection
+            unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+            while True:
+                req = await _next_obj(reader, unpacker)
+                resp = await self._dispatch(req)
+                writer.write(msgpack.packb(resp, use_bin_type=True))
+                await writer.drain()
+
+    async def _serve_stream(self, stream) -> None:
+        try:
+            while True:
+                raw = await stream.recv()
+                req = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+                resp = await self._dispatch(req)
+                await stream.send(msgpack.packb(resp, use_bin_type=True))
+        except (MuxError, ConnectionError):
+            pass
+
+    # -- dispatch + forward prologue ---------------------------------------
+
+    async def _dispatch(self, req: Dict) -> Dict:
+        method = req.get("Method", "")
+        body = req.get("Body")
+        entry = self._handlers.get(method)
+        if entry is None:
+            return {"Error": f"rpc: can't find method {method}"}
+        kind, fn = entry
+        try:
+            # forward() (rpc.go:182-201)
+            if kind != LOCAL:
+                dc = (body or {}).get("opts", {}).get("datacenter", "") or \
+                     (body or {}).get("datacenter", "")
+                if dc and dc != self.srv.config.datacenter:
+                    out = await self.srv.forward_dc(dc, method, body)
+                    return {"Error": "", "Body": out}
+                stale = (body or {}).get("opts", {}).get("allow_stale", False)
+                if not self.srv.is_leader() and (kind == WRITE or not stale):
+                    out = await self.srv.forward_leader(method, body)
+                    return {"Error": "", "Body": out}
+            out = await fn(self.srv, body or {})
+            return {"Error": "", "Body": out}
+        except Exception as e:
+            return {"Error": f"{e}" or type(e).__name__}
+
+
+async def _next_obj(reader, unpacker):
+    while True:
+        try:
+            return next(unpacker)
+        except StopIteration:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError("closed")
+            unpacker.feed(data)
+
+
+# -- method handlers ---------------------------------------------------------
+
+
+def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
+    H: Dict[str, Tuple[str, Callable]] = {}
+
+    def reg(name: str, kind: str):
+        def deco(fn):
+            H[name] = (kind, fn)
+            return fn
+        return deco
+
+    # raft internals (the RaftLayer handoff, consul/rpc.go:96-98)
+    for m in ("request_vote", "append_entries", "install_snapshot"):
+        def mk(m):
+            async def fn(srv, body):
+                msg = raft_req_from_wire(m, body)
+                resp = srv.raft.handle(m, msg)
+                return raft_msg_to_wire(resp)
+            return fn
+        H[f"Raft.{m}"] = (LOCAL, mk(m))
+
+    @reg("Status.Ping", LOCAL)
+    async def status_ping(srv, body):
+        return True
+
+    @reg("Status.Leader", LOCAL)
+    async def status_leader(srv, body):
+        return srv.leader_addr()
+
+    @reg("Status.Peers", LOCAL)
+    async def status_peers(srv, body):
+        return srv.raft_peers()
+
+    # The generic write-forward target: the originating server validated
+    # and ACL-checked; the leader applies through consensus.
+    @reg("Server.Apply", WRITE)
+    async def server_apply(srv, body):
+        resp = await srv.raft_apply_raw(body["buf"])
+        return _w(resp)
+
+    @reg("Catalog.Register", WRITE)
+    async def catalog_register(srv, body):
+        await srv.catalog.register(RegisterRequest.from_wire(body))
+        return True
+
+    @reg("Catalog.Deregister", WRITE)
+    async def catalog_deregister(srv, body):
+        await srv.catalog.deregister(DeregisterRequest.from_wire(body))
+        return True
+
+    @reg("Catalog.ListDatacenters", LOCAL)
+    async def catalog_dcs(srv, body):
+        return srv.known_datacenters()
+
+    @reg("Catalog.ListNodes", READ)
+    async def catalog_nodes(srv, body):
+        meta, out = await srv.catalog.list_nodes(_opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Catalog.ListServices", READ)
+    async def catalog_services(srv, body):
+        meta, out = await srv.catalog.list_services(_opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Catalog.ServiceNodes", READ)
+    async def catalog_service_nodes(srv, body):
+        meta, out = await srv.catalog.service_nodes(
+            body.get("service", ""), _opts(body), body.get("tag", ""))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Catalog.NodeServices", READ)
+    async def catalog_node_services(srv, body):
+        meta, out = await srv.catalog.node_services(
+            body.get("node", ""), _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Health.ChecksInState", READ)
+    async def health_state(srv, body):
+        meta, out = await srv.health.checks_in_state(
+            body.get("state", "any"), _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Health.NodeChecks", READ)
+    async def health_node(srv, body):
+        meta, out = await srv.health.node_checks(body.get("node", ""),
+                                                 _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Health.ServiceChecks", READ)
+    async def health_checks(srv, body):
+        meta, out = await srv.health.service_checks(body.get("service", ""),
+                                                    _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Health.ServiceNodes", READ)
+    async def health_service(srv, body):
+        meta, out = await srv.health.service_nodes(
+            body.get("service", ""), _opts(body), body.get("tag", ""),
+            body.get("passing", False))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("KVS.Apply", WRITE)
+    async def kvs_apply(srv, body):
+        return await srv.kvs.apply(KVSRequest.from_wire(body))
+
+    @reg("KVS.Get", READ)
+    async def kvs_get(srv, body):
+        meta, out = await srv.kvs.get(KeyRequest.from_wire(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("KVS.List", READ)
+    async def kvs_list(srv, body):
+        meta, out = await srv.kvs.list(KeyListRequest.from_wire(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("KVS.ListKeys", READ)
+    async def kvs_list_keys(srv, body):
+        meta, out = await srv.kvs.list_keys(KeyListRequest.from_wire(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Session.Apply", WRITE)
+    async def session_apply(srv, body):
+        return await srv.session.apply(SessionRequest.from_wire(body))
+
+    @reg("Session.Get", READ)
+    async def session_get(srv, body):
+        meta, out = await srv.session.get(body.get("id", ""), _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Session.List", READ)
+    async def session_list(srv, body):
+        meta, out = await srv.session.list(_opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Session.NodeSessions", READ)
+    async def session_node(srv, body):
+        meta, out = await srv.session.node_sessions(body.get("node", ""),
+                                                    _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("ACL.Apply", WRITE)
+    async def acl_apply(srv, body):
+        return await srv.acl.apply(ACLRequest.from_wire(body))
+
+    @reg("ACL.Get", READ)
+    async def acl_get(srv, body):
+        meta, out = await srv.acl.get(body.get("id", ""), _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("ACL.GetPolicy", LOCAL)
+    async def acl_get_policy(srv, body):
+        reply = await srv.acl.get_policy(ACLPolicyRequest.from_wire(body))
+        return _w(reply)
+
+    @reg("ACL.List", READ)
+    async def acl_list(srv, body):
+        meta, out = await srv.acl.list(_opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Internal.NodeInfo", READ)
+    async def internal_node_info(srv, body):
+        meta, out = await srv.internal.node_info(body.get("node", ""),
+                                                 _opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Internal.NodeDump", READ)
+    async def internal_node_dump(srv, body):
+        meta, out = await srv.internal.node_dump(_opts(body))
+        return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Internal.EventFire", LOCAL)
+    async def internal_event_fire(srv, body):
+        await srv.fire_user_event(UserEvent.from_wire(body))
+        return True
+
+    @reg("Internal.KeyringOperation", LOCAL)
+    async def internal_keyring(srv, body):
+        return await srv.keyring_operation_local(body.get("op", "list"),
+                                                 body.get("key", ""))
+
+    return H

@@ -1,0 +1,64 @@
+"""Wire codecs for raft messages crossing the TCP RPC port.
+
+Parity target: the reference serializes raft RPCs with msgpack over the
+RaftLayer stream (consul/raft_rpc.go); our equivalents are the
+dataclasses in consensus/raft.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from consul_tpu.consensus.log import LogEntry
+from consul_tpu.consensus.raft import (
+    AppendReq, AppendResp, SnapReq, SnapResp, VoteReq, VoteResp)
+
+
+def entry_to_wire(e: LogEntry) -> list:
+    return [e.index, e.term, e.type, e.data]
+
+
+def entry_from_wire(v: list) -> LogEntry:
+    return LogEntry(index=v[0], term=v[1], type=v[2], data=v[3])
+
+
+_TO_WIRE = {
+    VoteReq: lambda m: {"t": m.term, "c": m.candidate,
+                        "li": m.last_log_index, "lt": m.last_log_term},
+    VoteResp: lambda m: {"t": m.term, "g": m.granted},
+    AppendReq: lambda m: {"t": m.term, "l": m.leader,
+                          "pi": m.prev_log_index, "pt": m.prev_log_term,
+                          "e": [entry_to_wire(x) for x in m.entries],
+                          "lc": m.leader_commit},
+    AppendResp: lambda m: {"t": m.term, "s": m.success, "m": m.match_index},
+    SnapReq: lambda m: {"t": m.term, "l": m.leader, "li": m.last_index,
+                        "lt": m.last_term, "p": m.peers, "d": m.data},
+    SnapResp: lambda m: {"t": m.term, "s": m.success},
+}
+
+_REQ_FROM_WIRE = {
+    "request_vote": lambda d: VoteReq(d["t"], d["c"], d["li"], d["lt"]),
+    "append_entries": lambda d: AppendReq(
+        d["t"], d["l"], d["pi"], d["pt"],
+        [entry_from_wire(x) for x in d["e"]], d["lc"]),
+    "install_snapshot": lambda d: SnapReq(
+        d["t"], d["l"], d["li"], d["lt"], d["p"], d["d"]),
+}
+
+_RESP_FROM_WIRE = {
+    "request_vote": lambda d: VoteResp(d["t"], d["g"]),
+    "append_entries": lambda d: AppendResp(d["t"], d["s"], d["m"]),
+    "install_snapshot": lambda d: SnapResp(d["t"], d["s"]),
+}
+
+
+def raft_msg_to_wire(msg: Any) -> Dict:
+    return _TO_WIRE[type(msg)](msg)
+
+
+def raft_req_from_wire(method: str, d: Dict) -> Any:
+    return _REQ_FROM_WIRE[method](d)
+
+
+def raft_resp_from_wire(method: str, d: Dict) -> Any:
+    return _RESP_FROM_WIRE[method](d)

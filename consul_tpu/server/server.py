@@ -106,6 +106,14 @@ class Server:
         # User-event delivery targets (the agent registers; the gossip
         # plane will too once cross-node fan-out lands).
         self.event_sinks: List[Any] = []
+        # RPC mesh (attach_rpc wires these): pooled client, TCP listener,
+        # node->addr routes in this DC, dc->[addrs] for WAN forwarding
+        # (the localConsuls/remoteConsuls maps, consul/serf.go:239-275).
+        self.pool = None
+        self.rpc_server = None
+        self.route_table: Dict[str, str] = {}
+        self.remote_dcs: Dict[str, List[str]] = {}
+        self.keyring = None  # agent-owned gossip keyring
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
@@ -132,6 +140,10 @@ class Server:
 
     async def stop(self) -> None:
         self.leader_duties.revoke()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+        if self.pool is not None:
+            await self.pool.close()
         await self.raft.shutdown()
 
     async def wait_for_leader(self, timeout: float = 10.0) -> None:
@@ -158,11 +170,25 @@ class Server:
         return self.raft.last_applied
 
     async def raft_apply(self, msg_type: MessageType, req: Any) -> Any:
-        """Apply a write through consensus (consul/rpc.go:280-297)."""
+        """Apply a write through consensus (consul/rpc.go:280-297).
+        Non-leaders with a route to the leader forward the encoded entry
+        (the forwardLeader hop of rpc.go:204)."""
         buf = codec.encode(int(msg_type), req)
         if len(buf) > MAX_RAFT_ENTRY_WARN:
             # Reference warns and proceeds (rpc.go:42-44).
             pass
+        try:
+            return await self.raft.apply(buf, timeout=ENQUEUE_LIMIT)
+        except RaftNotLeaderError as e:
+            if self.pool is not None:
+                leader_addr = self.route_table.get(self.raft.leader_id or "")
+                if leader_addr:
+                    return await self.pool.rpc(leader_addr, "Server.Apply",
+                                               {"buf": buf})
+            raise NotLeaderError(str(e)) from e
+
+    async def raft_apply_raw(self, buf: bytes) -> Any:
+        """Leader-side target of the Server.Apply forward."""
         try:
             return await self.raft.apply(buf, timeout=ENQUEUE_LIMIT)
         except RaftNotLeaderError as e:
@@ -182,18 +208,93 @@ class Server:
         return list(self.raft.peers)
 
     def known_datacenters(self) -> list:
-        """Sorted DC list (consul/catalog_endpoint.go:97-115); the WAN pool
-        populates remote DCs once gossip lands."""
-        return [self.config.datacenter]
+        """Sorted DC list (consul/catalog_endpoint.go:97-115); remote DCs
+        come from the WAN route table."""
+        return sorted({self.config.datacenter, *self.remote_dcs})
+
+    # -- RPC mesh (consul/rpc.go + pool.go) --------------------------------
+
+    async def attach_rpc(self, host: str = "127.0.0.1", port: int = 0,
+                         tls_incoming=None, tls_outgoing=None) -> tuple:
+        """Start the TCP RPC listener + pooled client and rebind the raft
+        transport onto it (setupRPC, consul/server.go:246/414-431)."""
+        from consul_tpu.rpc.pool import ConnPool, TCPTransport
+        from consul_tpu.rpc.server import RPCServer
+        self.pool = ConnPool(tls_wrap=tls_outgoing)
+        self.rpc_server = RPCServer(self, tls_incoming=tls_incoming)
+        await self.rpc_server.start(host, port)
+        transport = TCPTransport(self.pool)
+        transport.register(self.raft)
+        self.raft.transport = transport
+        self._tcp_transport = transport
+        return self.rpc_server.addr
+
+    def set_route(self, node_id: str, addr: str) -> None:
+        self.route_table[node_id] = addr
+        if getattr(self, "_tcp_transport", None) is not None:
+            self._tcp_transport.set_addr(node_id, addr)
+
+    def set_remote_dc(self, dc: str, addrs: List[str]) -> None:
+        self.remote_dcs[dc] = list(addrs)
+
+    async def forward_leader(self, method: str, body: Any) -> Any:
+        """forwardLeader (consul/rpc.go:204-222)."""
+        if self.pool is None:
+            raise NotLeaderError("not the leader and no RPC mesh")
+        addr = self.route_table.get(self.raft.leader_id or "")
+        if not addr:
+            raise NotLeaderError("No cluster leader")
+        return await self.pool.rpc(addr, method, body)
+
+    async def forward_dc(self, dc: str, method: str, body: Any) -> Any:
+        """forwardDC to a random server there (consul/rpc.go:224-242)."""
+        import random
+        addrs = self.remote_dcs.get(dc)
+        if not addrs or self.pool is None:
+            raise ValueError(f"No path to datacenter: {dc}")
+        return await self.pool.rpc(random.choice(addrs), method, body, dc=dc)
+
+    async def global_rpc(self, method: str, body: Any) -> list:
+        """One request to every known DC in parallel, responses merged
+        (globalRPC + CompoundResponse, consul/rpc.go:247-276)."""
+        import asyncio as _asyncio
+        tasks = {self.config.datacenter:
+                 self.rpc_server._dispatch({"Method": method, "Body": body})
+                 if self.rpc_server else None}
+        results = []
+        if tasks[self.config.datacenter] is not None:
+            local = await tasks[self.config.datacenter]
+            if local.get("Error"):
+                raise RuntimeError(local["Error"])
+            results.append((self.config.datacenter, local.get("Body")))
+        remote = [(dc, _asyncio.ensure_future(self.forward_dc(dc, method, body)))
+                  for dc in self.remote_dcs]
+        for dc, fut in remote:
+            results.append((dc, await fut))
+        return results
+
+    async def keyring_operation_local(self, op: str, key: str = "") -> Dict:
+        """This DC's slice of a keyring op (internal_endpoint.go:68+)."""
+        if self.keyring is None:
+            raise ValueError("keyring not configured "
+                             "(gossip encryption disabled)")
+        return self.keyring.operation(op, key, node=self.config.node_name)
 
     async def resolve_token(self, token: str):
         """ACL resolution (consul/acl.go:70-148).  None = ACLs disabled."""
         return await self.acl_resolver.resolve(token)
 
     async def rpc_get_remote_acl_policy(self, token_id: str, etag: str):
-        """ACL.GetPolicy to the auth DC (consul/acl.go:104-121); wired up
-        by the RPC mesh when this server knows remote DCs."""
-        raise ConnectionError("no route to ACL datacenter")
+        """ACL.GetPolicy to the auth DC (consul/acl.go:104-121)."""
+        from consul_tpu.structs.structs import ACLPolicyReply
+        auth_dc = self.config.acl_datacenter
+        if auth_dc not in self.remote_dcs or self.pool is None:
+            raise ConnectionError("no route to ACL datacenter")
+        body = {"acl_id": token_id, "etag": etag}
+        out = await self.forward_dc(auth_dc, "ACL.GetPolicy", body)
+        if out is None:
+            return None
+        return ACLPolicyReply.from_wire(out)
 
     async def filter_acl_service_nodes(self, token: str, nodes: list) -> list:
         from consul_tpu.server.acl import filter_service_nodes

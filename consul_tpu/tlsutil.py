@@ -1,0 +1,71 @@
+"""TLS wrappers for the RPC mesh.
+
+Parity target: ``tlsutil/config.go`` (281 LoC): a Config producing an
+incoming (server-side) SSLContext with optional client-cert
+verification, and per-DC outgoing wrappers that verify the server
+hostname as ``server.<dc>.<domain>`` (consul/config.go:107-113 — the
+name every consul server presents in its certificate).
+"""
+
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass
+class TLSConfig:
+    verify_incoming: bool = False
+    verify_outgoing: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    domain: str = "consul."
+    server_name: str = ""  # override for outgoing verification
+
+    def incoming_context(self) -> Optional[ssl.SSLContext]:
+        """IncomingTLSConfig: server side of the RPC listener."""
+        if not (self.cert_file and self.key_file):
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        if self.verify_incoming:
+            if not self.ca_file:
+                raise ValueError(
+                    "VerifyIncoming set, and no CA certificate provided!")
+            ctx.load_verify_locations(self.ca_file)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def outgoing_wrapper(self) -> Optional["DCWrapper"]:
+        """OutgoingTLSWrapper: per-DC client-side contexts."""
+        if not self.verify_outgoing:
+            return None
+        if not self.ca_file:
+            raise ValueError(
+                "VerifyOutgoing set, and no CA certificate provided!")
+        return DCWrapper(self)
+
+
+class DCWrapper:
+    """Callable(dc) -> SSLContext with server-hostname verification of
+    ``server.<dc>.<domain>`` (tlsutil.SpecificDC, consul/server.go:457)."""
+
+    def __init__(self, cfg: TLSConfig) -> None:
+        self.cfg = cfg
+
+    def __call__(self, dc: str) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(self.cfg.ca_file)
+        if self.cfg.cert_file and self.cfg.key_file:
+            ctx.load_cert_chain(self.cfg.cert_file, self.cfg.key_file)
+        ctx.check_hostname = True
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def server_hostname(self, dc: str) -> str:
+        if self.cfg.server_name:
+            return self.cfg.server_name
+        domain = self.cfg.domain.rstrip(".")
+        return f"server.{dc or 'dc1'}.{domain}"

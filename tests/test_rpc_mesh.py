@@ -1,0 +1,308 @@
+"""RPC mesh tests: multi-server TCP clusters, forwarding, cross-DC,
+TLS, keyring (reference tier: consul/server_test.go multi-server +
+consul/rpc.go forwarding paths, all on loopback with compressed
+timers per SURVEY §4)."""
+
+import asyncio
+import base64
+import os
+import subprocess
+
+import pytest
+
+from consul_tpu.consensus.raft import RaftConfig
+from consul_tpu.server.server import Server, ServerConfig
+from consul_tpu.structs.structs import (
+    DirEntry, KVSOp, KVSRequest, KeyRequest, NodeService, QueryOptions,
+    RegisterRequest)
+
+FAST = RaftConfig(heartbeat_interval=0.03, election_timeout_min=0.06,
+                  election_timeout_max=0.12, rpc_timeout=0.5)
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+async def _mk_cluster(n=3, dc="dc1", name_prefix="s", acl_dc=""):
+    """N servers over real TCP on loopback (testServerConfig shape)."""
+    names = [f"{name_prefix}{i}" for i in range(1, n + 1)]
+    servers = []
+    for name in names:
+        srv = Server(ServerConfig(node_name=name, datacenter=dc,
+                                  bootstrap=(n == 1), peers=list(names),
+                                  raft=FAST, acl_datacenter=acl_dc,
+                                  acl_default_policy="deny",
+                                  acl_master_token="root" if acl_dc else ""))
+        addr = await srv.attach_rpc("127.0.0.1", 0)
+        servers.append((srv, f"{addr[0]}:{addr[1]}"))
+    for srv, _ in servers:
+        for other, addr in servers:
+            srv.set_route(other.config.node_name, addr)
+    for srv, _ in servers:
+        await srv.start()
+    await servers[0][0].wait_for_leader()
+    return servers
+
+
+async def _shutdown(servers):
+    for srv, _ in servers:
+        await srv.stop()
+
+
+class TestTCPCluster:
+    def test_three_server_election_and_replication(self, loop):
+        async def body():
+            servers = await _mk_cluster(3)
+            leaders = {srv.raft.leader_id for srv, _ in servers}
+            assert len(leaders) == 1 and None not in leaders
+            leader = next(srv for srv, _ in servers if srv.is_leader())
+            await leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="k", value=b"v")))
+            # replicated to every FSM
+            for srv, _ in servers:
+                deadline = asyncio.get_event_loop().time() + 5
+                while asyncio.get_event_loop().time() < deadline:
+                    _, ent = srv.store.kvs_get("k")
+                    if ent is not None:
+                        break
+                    await asyncio.sleep(0.02)
+                assert ent is not None and ent.value == b"v"
+            await _shutdown(servers)
+
+        loop.run_until_complete(body())
+
+    def test_follower_write_forwards_to_leader(self, loop):
+        async def body():
+            servers = await _mk_cluster(3)
+            follower = next(srv for srv, _ in servers if not srv.is_leader())
+            # the follower's own endpoint path: raft_apply hops to leader
+            ok = await follower.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value,
+                dir_ent=DirEntry(key="fwd", value=b"from-follower")))
+            assert ok
+            leader = next(srv for srv, _ in servers if srv.is_leader())
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                _, ent = leader.store.kvs_get("fwd")
+                if ent is not None:
+                    break
+                await asyncio.sleep(0.02)
+            assert ent.value == b"from-follower"
+            await _shutdown(servers)
+
+        loop.run_until_complete(body())
+
+    def test_rpc_read_on_follower_forwards(self, loop):
+        async def body():
+            servers = await _mk_cluster(3)
+            leader = next(srv for srv, _ in servers if srv.is_leader())
+            follower_addr = next(addr for srv, addr in servers
+                                 if not srv.is_leader())
+            await leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="r", value=b"x")))
+            # a default-consistency read sent to a follower's RPC port
+            # hops to the leader (rpc.go:196-199)
+            out = await leader.pool.rpc(follower_addr, "KVS.Get",
+                                        {"key": "r", "opts": {}})
+            assert out["data"][0]["value"] == b"x"
+            assert out["meta"]["known_leader"] is True
+            # stale read served locally by the follower
+            out = await leader.pool.rpc(follower_addr, "KVS.Get",
+                                        {"key": "r",
+                                         "opts": {"allow_stale": True}})
+            assert out["data"][0]["value"] == b"x"
+            await _shutdown(servers)
+
+        loop.run_until_complete(body())
+
+    def test_failover_reelection(self, loop):
+        async def body():
+            servers = await _mk_cluster(3)
+            leader = next(srv for srv, _ in servers if srv.is_leader())
+            rest = [srv for srv, _ in servers if srv is not leader]
+            await leader.stop()
+            deadline = asyncio.get_event_loop().time() + 10
+            new_leader = None
+            while asyncio.get_event_loop().time() < deadline:
+                new_leader = next((s for s in rest if s.is_leader()), None)
+                if new_leader is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert new_leader is not None
+            ok = await new_leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="post", value=b"f")))
+            assert ok
+            for srv in rest:
+                await srv.stop()
+
+        loop.run_until_complete(body())
+
+
+class TestCrossDC:
+    def test_forward_dc_and_datacenters(self, loop):
+        async def body():
+            dc1 = await _mk_cluster(1, dc="dc1", name_prefix="a")
+            dc2 = await _mk_cluster(1, dc="dc2", name_prefix="b")
+            s1, addr1 = dc1[0]
+            s2, addr2 = dc2[0]
+            s1.set_remote_dc("dc2", [addr2])
+            s2.set_remote_dc("dc1", [addr1])
+            assert s1.known_datacenters() == ["dc1", "dc2"]
+
+            # register a service in dc2, query it THROUGH dc1's server
+            await s2.catalog.register(RegisterRequest(
+                node="remote-node", address="10.2.0.1",
+                service=NodeService(id="db", service="db", port=5432)))
+            out = await s1.rpc_server._dispatch({
+                "Method": "Catalog.ServiceNodes",
+                "Body": {"service": "db",
+                         "opts": {"datacenter": "dc2"}}})
+            assert not out["Error"], out
+            rows = out["Body"]["data"]
+            assert rows and rows[0]["node"] == "remote-node"
+            await _shutdown(dc1 + dc2)
+
+        loop.run_until_complete(body())
+
+    def test_acl_replication_from_auth_dc(self, loop):
+        async def body():
+            dc1 = await _mk_cluster(1, dc="dc1", name_prefix="a",
+                                    acl_dc="dc1")
+            dc2 = await _mk_cluster(1, dc="dc2", name_prefix="b",
+                                    acl_dc="dc1")
+            s1, addr1 = dc1[0]
+            s2, addr2 = dc2[0]
+            s2.set_remote_dc("dc1", [addr1])
+            s1.set_remote_dc("dc2", [addr2])
+
+            from consul_tpu.structs.structs import ACL, ACLOp, ACLRequest
+            tok = await s1.acl.apply(ACLRequest(
+                op=ACLOp.SET.value, token="root",
+                acl=ACL(name="app", rules='key "app/" { policy = "write" }')))
+            # dc2 resolves the token via ACL.GetPolicy to dc1
+            acl = await s2.resolve_token(tok)
+            assert acl is not None
+            assert acl.key_write("app/x") and not acl.key_write("other")
+            await _shutdown(dc1 + dc2)
+
+        loop.run_until_complete(body())
+
+
+class TestKeyring:
+    def test_keyring_ops(self, tmp_path, loop):
+        async def body():
+            from consul_tpu.agent.keyring import Keyring, KeyringError
+            k1 = base64.b64encode(os.urandom(16)).decode()
+            k2 = base64.b64encode(os.urandom(16)).decode()
+            ring = Keyring(path=str(tmp_path / "local.keyring"),
+                           initial_key=k1)
+            assert ring.primary == k1
+            ring.install(k2)
+            assert set(ring.list_keys()) == {k1, k2}
+            with pytest.raises(KeyringError):
+                ring.remove(k1)  # primary
+            ring.use(k2)
+            assert ring.primary == k2
+            ring.remove(k1)
+            assert ring.list_keys() == [k2]
+            # persistence
+            ring2 = Keyring(path=str(tmp_path / "local.keyring"))
+            assert ring2.primary == k2
+            with pytest.raises(KeyringError):
+                ring.install("not-base64!")
+
+        loop.run_until_complete(body())
+
+    def test_agent_keyring_fanout(self, tmp_path, loop):
+        async def body():
+            from consul_tpu.agent.agent import Agent, AgentConfig
+            key = base64.b64encode(os.urandom(16)).decode()
+            agent = Agent(AgentConfig(http_port=0, dns_port=0,
+                                      data_dir=str(tmp_path), encrypt=key))
+            await agent.start()
+            out = await agent.keyring_operation("list")
+            assert out["Keys"] == {key: 1}
+            k2 = base64.b64encode(os.urandom(16)).decode()
+            await agent.keyring_operation("install", k2)
+            out = await agent.keyring_operation("list")
+            assert set(out["Keys"]) == {key, k2}
+            await agent.stop()
+
+        loop.run_until_complete(body())
+
+
+def _make_certs(tmp_path):
+    """Self-signed CA + server cert for server.dc1.consul via openssl."""
+    ca_key = tmp_path / "ca.key"
+    ca_crt = tmp_path / "ca.crt"
+    sv_key = tmp_path / "sv.key"
+    sv_csr = tmp_path / "sv.csr"
+    sv_crt = tmp_path / "sv.crt"
+    ext = tmp_path / "ext.cnf"
+    ext.write_text("subjectAltName=DNS:server.dc1.consul\n")
+    cmds = [
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+         "-subj", "/CN=ConsulTestCA"],
+        ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(sv_key), "-out", str(sv_csr),
+         "-subj", "/CN=server.dc1.consul"],
+        ["openssl", "x509", "-req", "-in", str(sv_csr), "-CA", str(ca_crt),
+         "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(sv_crt),
+         "-days", "1", "-extfile", str(ext)],
+    ]
+    for cmd in cmds:
+        proc = subprocess.run(cmd, capture_output=True)
+        if proc.returncode != 0:
+            pytest.skip(f"openssl unavailable/failed: {proc.stderr[:200]}")
+    return str(ca_crt), str(sv_crt), str(sv_key)
+
+
+class TestTLS:
+    def test_tls_rpc_roundtrip(self, tmp_path, loop):
+        async def body():
+            from consul_tpu.tlsutil import TLSConfig
+            ca, crt, key = _make_certs(tmp_path)
+            tls = TLSConfig(verify_outgoing=True, ca_file=ca,
+                            cert_file=crt, key_file=key, domain="consul.")
+            srv = Server(ServerConfig(node_name="t1", raft=FAST))
+            addr = await srv.attach_rpc(
+                "127.0.0.1", 0, tls_incoming=tls.incoming_context(),
+                tls_outgoing=tls.outgoing_wrapper())
+            srv.set_route("t1", f"{addr[0]}:{addr[1]}")
+            await srv.start()
+            await srv.wait_for_leader()
+            out = await srv.pool.rpc(f"{addr[0]}:{addr[1]}", "Status.Ping",
+                                     {}, dc="dc1")
+            assert out is True
+            await srv.stop()
+
+        loop.run_until_complete(body())
+
+    def test_wrong_hostname_rejected(self, tmp_path, loop):
+        async def body():
+            from consul_tpu.rpc.pool import ConnPool
+            from consul_tpu.tlsutil import TLSConfig
+            ca, crt, key = _make_certs(tmp_path)
+            tls = TLSConfig(verify_outgoing=True, ca_file=ca,
+                            cert_file=crt, key_file=key, domain="consul.")
+            srv = Server(ServerConfig(node_name="t1", raft=FAST))
+            addr = await srv.attach_rpc("127.0.0.1", 0,
+                                        tls_incoming=tls.incoming_context(),
+                                        tls_outgoing=tls.outgoing_wrapper())
+            await srv.start()
+            await srv.wait_for_leader()
+            # a pool verifying dc2's hostname must refuse dc1's cert
+            pool = ConnPool(tls_wrap=tls.outgoing_wrapper())
+            with pytest.raises(Exception):
+                await pool.rpc(f"{addr[0]}:{addr[1]}", "Status.Ping", {},
+                               dc="dc2", timeout=5.0)
+            await pool.close()
+            await srv.stop()
+
+        loop.run_until_complete(body())
