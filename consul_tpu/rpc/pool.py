@@ -58,12 +58,18 @@ class ConnPool:
                 ctx = self._tls_wrap(dc)
             if ctx is not None:
                 # TLS wrap: selector byte first in the clear, then the
-                # handshake (rpcTLS, consul/rpc.go:100-112).
+                # handshake (rpcTLS, consul/rpc.go:100-112).  Wait for
+                # the server's ack byte before sending the ClientHello —
+                # see RPCServer._handle for the upgrade-race rationale.
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(host, int(port)),
                     self._dial_timeout)
                 writer.write(bytes([RPC_TLS]))
                 await writer.drain()
+                ack = await asyncio.wait_for(reader.readexactly(1),
+                                             self._dial_timeout)
+                if ack[0] != RPC_TLS:
+                    raise ConnectionError("bad TLS upgrade ack")
                 await writer.start_tls(
                     ctx, server_hostname=self._server_hostname(dc))
                 writer.write(bytes([RPC_MULTIPLEX]))
@@ -85,9 +91,13 @@ class ConnPool:
         return getter(dc) if getter else None
 
     async def rpc(self, addr: str, method: str, body: Any,
-                  dc: str = "", timeout: float = 610.0) -> Any:
+                  dc: str = "", timeout: float = 30.0) -> Any:
         """One request/response on a pooled stream (ConnPool.RPC,
-        pool.go:342-361).  A dead session is dropped and redialed once."""
+        pool.go:342-361).  A dead session is dropped and redialed once.
+
+        Default timeout covers plain RPCs; callers forwarding blocking
+        queries pass an explicit budget (max_query_time + margin) —
+        see Server.forward_leader / forward_dc."""
         for attempt in (0, 1):
             sess = await self._session(addr, dc)
             try:
@@ -102,7 +112,15 @@ class ConnPool:
                 if resp.get("Error"):
                     raise RPCError(resp["Error"])
                 return resp.get("Body")
-            except (MuxError, ConnectionError, asyncio.TimeoutError,
+            except asyncio.TimeoutError:
+                # Surface a timed-out exchange immediately (re-waiting
+                # the full budget would double the stall) — and close
+                # the evicted session, or its socket + pump task leak.
+                evicted = self._sessions.pop(addr, None)
+                if evicted is not None:
+                    await evicted.close()
+                raise
+            except (MuxError, ConnectionError,
                     asyncio.IncompleteReadError):
                 self._sessions.pop(addr, None)
                 if attempt:

@@ -70,6 +70,7 @@ class RPCServer:
         self._listener: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[Tuple[str, int]] = None
         self._handlers = _build_handlers()
+        self._conns: set = set()  # live connection writers, closed on stop
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._listener = await asyncio.start_server(self._serve, host, port)
@@ -78,17 +79,25 @@ class RPCServer:
     async def stop(self) -> None:
         if self._listener is not None:
             self._listener.close()
+            # Connection handlers loop until the PEER hangs up; 3.12's
+            # wait_closed() waits for every handler, so without forcing
+            # our side shut, shutdown deadlocks on remote pools' idle
+            # sessions until their 610s timeout.
+            for w in list(self._conns):
+                w.close()
             await self._listener.wait_closed()
 
     # -- connection handling (handleConn, rpc.go:73-120) --------------------
 
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
         try:
             await self._handle(reader, writer, tls_done=False)
         except (asyncio.IncompleteReadError, ConnectionError, MuxError):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     async def _handle(self, reader, writer, tls_done: bool) -> None:
@@ -96,6 +105,14 @@ class RPCServer:
         if selector == RPC_TLS:
             if self.tls_incoming is None:
                 return  # TLS not configured; drop (rpc.go TLS checks)
+            # Ack the upgrade in the clear before the handshake.  The
+            # client MUST NOT send its ClientHello until this byte
+            # arrives: bytes buffered in our StreamReader before
+            # start_tls() switches protocols are silently lost (asyncio
+            # upgrade race; Go's synchronous reads make the reference's
+            # ack-less upgrade safe, ours needs the barrier).
+            writer.write(bytes([RPC_TLS]))
+            await writer.drain()
             await writer.start_tls(self.tls_incoming)
             await self._handle(reader, writer, tls_done=True)
         elif selector == RPC_MULTIPLEX:

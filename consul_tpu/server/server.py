@@ -244,7 +244,8 @@ class Server:
         addr = self.route_table.get(self.raft.leader_id or "")
         if not addr:
             raise NotLeaderError("No cluster leader")
-        return await self.pool.rpc(addr, method, body)
+        return await self.pool.rpc(addr, method, body,
+                                   timeout=_forward_timeout(body))
 
     async def forward_dc(self, dc: str, method: str, body: Any) -> Any:
         """forwardDC to a random server there (consul/rpc.go:224-242)."""
@@ -252,7 +253,8 @@ class Server:
         addrs = self.remote_dcs.get(dc)
         if not addrs or self.pool is None:
             raise ValueError(f"No path to datacenter: {dc}")
-        return await self.pool.rpc(random.choice(addrs), method, body, dc=dc)
+        return await self.pool.rpc(random.choice(addrs), method, body, dc=dc,
+                                   timeout=_forward_timeout(body))
 
     async def global_rpc(self, method: str, body: Any) -> list:
         """One request to every known DC in parallel, responses merged
@@ -335,3 +337,14 @@ class Server:
 
 class NotLeaderError(Exception):
     pass
+
+
+def _forward_timeout(body: Any) -> float:
+    """RPC budget for a forwarded request: plain calls get a tight
+    timeout; a blocking query gets its own wait budget (max 600s,
+    consul/rpc.go:29-41) plus grace for the server-side jitter."""
+    opts = (body or {}).get("opts") if isinstance(body, dict) else None
+    if opts and opts.get("min_query_index"):
+        wait = float(opts.get("max_query_time") or 300.0)
+        return min(wait, 600.0) + 10.0
+    return 30.0

@@ -2,11 +2,16 @@
 
 Multi-chip behavior is tested the way SURVEY.md §4 prescribes for the
 reference (multi-node simulated in one process with compressed timers):
-an 8-device virtual CPU mesh via XLA host-platform device count.  Must
-run before jax is imported anywhere.  The axon sitecustomize pins the
-real-TPU platform at interpreter start; conftest runs after it, so a
-plain assignment here wins — tests always run on the virtual CPU mesh,
-benches on the real chip.
+an 8-device virtual CPU mesh via XLA host-platform device count.
+
+The interpreter-start hook in this environment registers the ``axon``
+TPU-tunnel backend and pins ``jax.config``'s
+``jax_platforms="axon,cpu"`` — env-var overrides after interpreter
+start are ineffective against that, and the first ``jax.devices()``
+would dial the single-chip tunnel (and hang when it is unreachable).
+So conftest overrides BOTH the env (for child processes) and the live
+jax config, before any test imports jax: tests always run on the
+virtual CPU mesh, benches on the real chip.
 """
 
 import os
@@ -16,3 +21,50 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:  # jax was already imported by the interpreter-start hook
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+# -- per-test watchdog -------------------------------------------------------
+# One hung test must not eat the whole suite (round-1 failure: a single
+# deadlocked RPC test blocked the run for the full pool timeout).
+# pytest-timeout isn't in the image; SIGALRM gives the same guarantee
+# for this suite's single-threaded tests.  First jit compiles on the
+# CPU mesh can take ~1-2 min, hence the generous default; tests may
+# override via `@pytest.mark.timeout_s(N)`.
+
+import signal
+
+import pytest
+
+DEFAULT_TEST_TIMEOUT_S = 180
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout_s(n): per-test watchdog seconds (default 180)")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    # Wraps the WHOLE lifecycle (setup/call/teardown): a deadlocked
+    # cluster fixture must trip the watchdog the same as a test body.
+    marker = item.get_closest_marker("timeout_s")
+    budget = int(marker.args[0]) if marker else DEFAULT_TEST_TIMEOUT_S
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"test watchdog: exceeded {budget}s (frame: "
+            f"{frame.f_code.co_filename}:{frame.f_lineno})")
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(budget)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

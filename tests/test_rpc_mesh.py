@@ -111,10 +111,19 @@ class TestTCPCluster:
                                         {"key": "r", "opts": {}})
             assert out["data"][0]["value"] == b"x"
             assert out["meta"]["known_leader"] is True
-            # stale read served locally by the follower
-            out = await leader.pool.rpc(follower_addr, "KVS.Get",
-                                        {"key": "r",
-                                         "opts": {"allow_stale": True}})
+            # stale read served locally by the follower — eventually
+            # consistent by definition (QueryOptions.AllowStale,
+            # consul/structs/structs.go:78-106), so poll for the apply
+            # to land on the follower's FSM
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                out = await leader.pool.rpc(follower_addr, "KVS.Get",
+                                            {"key": "r",
+                                             "opts": {"allow_stale": True}})
+                if out["data"]:
+                    break
+                await asyncio.sleep(0.02)
+            assert out["data"], "stale read did not converge within 5s"
             assert out["data"][0]["value"] == b"x"
             await _shutdown(servers)
 
