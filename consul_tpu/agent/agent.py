@@ -70,6 +70,21 @@ class AgentConfig:
     acl_master_token: str = ""
     acl_token: str = ""  # agent's own default token
     encrypt: str = ""    # base64 16-byte gossip key (enables the keyring)
+    # -- membership plane (command/agent/config.go ports + retry-join) ----
+    serf_lan_port: int = 0         # 0 = ephemeral (production: 8301)
+    serf_wan_port: int = 0         # servers only (production: 8302)
+    # None = no TCP RPC mesh (single-node in-memory raft, dev mode);
+    # an int (0 = ephemeral; production 8300) attaches the mesh listener.
+    rpc_mesh_port: Optional[int] = None
+    bootstrap_expect: int = 0      # self-assembly quorum size (serf.go:185)
+    retry_join: List[str] = field(default_factory=list)
+    retry_interval: float = 30.0
+    retry_max: int = 0             # 0 = retry forever
+    rejoin_after_leave: bool = False
+    # compressed-timer overrides for tests (SerfConfig field -> value)
+    serf_timing: Dict[str, float] = field(default_factory=dict)
+    raft_config: Optional[Any] = None   # RaftConfig override (tests)
+    reconcile_interval: float = 60.0    # leader full-reconcile cadence
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -83,8 +98,12 @@ class Agent:
             datacenter=self.config.datacenter,
             domain=self.config.domain,
             bootstrap=self.config.bootstrap,
+            bootstrap_expect=self.config.bootstrap_expect,
             data_dir=(os.path.join(self.config.data_dir, "server")
                       if self.config.data_dir else ""),
+            **({"raft": self.config.raft_config}
+               if self.config.raft_config is not None else {}),
+            reconcile_interval=self.config.reconcile_interval,
             acl_datacenter=self.config.acl_datacenter,
             acl_ttl=self.config.acl_ttl,
             acl_default_policy=self.config.acl_default_policy,
@@ -119,6 +138,14 @@ class Agent:
             from consul_tpu.agent.keyring import Keyring
             self.server.keyring = Keyring(path=keyring_path,
                                           initial_key=self.config.encrypt)
+        # Gossip pools (setupSerf, consul/server.go:257-273): LAN always,
+        # WAN for servers.  Created in start() (ports bind there).
+        self.lan_pool = None
+        self.wan_pool = None
+        self.rpc_addr: str = ""     # our RPC mesh addr once attached
+        self._bootstrapped = self.config.bootstrap_expect == 0
+        self._wan_servers: Dict[str, Dict[str, str]] = {}  # dc -> name -> addr
+        self._retry_join_task: Optional[asyncio.Task] = None
 
     @property
     def node_name(self) -> str:
@@ -133,9 +160,18 @@ class Agent:
     async def start(self) -> None:
         self._left = asyncio.Event()
         self.log.info(f"consul-tpu agent running, node={self.config.node_name}")
+        if self.config.rpc_mesh_port is not None:
+            host, port = await self.server.attach_rpc(
+                self.config.bind_addr, self.config.rpc_mesh_port)
+            self.rpc_addr = f"{self.config.advertise_addr}:{port}"
         await self.server.start()
-        await self.server.wait_for_leader()
-        await self._register_self()
+        await self._start_gossip()
+        if self.config.bootstrap and not self.config.bootstrap_expect:
+            # Single-node semantics: leadership is immediate; register
+            # ourselves now.  Clustered agents converge via the leader's
+            # reconcile pipeline instead.
+            await self.server.wait_for_leader()
+            await self._register_self()
         self._load_persisted()
         self.local.start()
         await self.http.start(self.config.bind_addr, self.config.http_port)
@@ -143,12 +179,88 @@ class Agent:
         if self.ipc_port is not None:
             await self.ipc.start(self.config.bind_addr, self.ipc_port)
 
+    async def _start_gossip(self) -> None:
+        """Arm the LAN (+WAN for servers) pools, rejoin from snapshots,
+        spawn the retry-join loop (setupSerf + startupJoin + retryJoin,
+        command/agent/command.go:467-528/692-701)."""
+        from consul_tpu.membership import SerfConfig, SerfPool
+        from consul_tpu.membership.serf import client_tags, server_tags
+        rpc_port = int(self.rpc_addr.rpartition(":")[2] or 8300)
+        tags = (server_tags(self.config.datacenter, rpc_port,
+                            bootstrap=self.config.bootstrap,
+                            expect=self.config.bootstrap_expect)
+                if self.config.server else
+                client_tags(self.config.datacenter))
+        snap_dir = (os.path.join(self.config.data_dir, "serf")
+                    if self.config.data_dir else "")
+        timing = dict(self.config.serf_timing)
+        self.lan_pool = SerfPool(SerfConfig(
+            node_name=self.config.node_name,
+            bind_addr=self.config.bind_addr,
+            bind_port=self.config.serf_lan_port,
+            advertise_addr=self.config.advertise_addr,
+            tags=tags,
+            snapshot_path=(os.path.join(snap_dir, "local.snapshot")
+                           if snap_dir else ""),
+            **timing),
+            keyring=self.server.keyring, on_event=self._on_lan_event)
+        await self.lan_pool.start()
+        if self.config.server:
+            # WAN member names are qualified node.dc (consul/server.go:288)
+            self.wan_pool = SerfPool(SerfConfig(
+                node_name=f"{self.config.node_name}.{self.config.datacenter}",
+                bind_addr=self.config.bind_addr,
+                bind_port=self.config.serf_wan_port,
+                advertise_addr=self.config.advertise_addr,
+                tags=server_tags(self.config.datacenter, rpc_port),
+                snapshot_path=(os.path.join(snap_dir, "remote.snapshot")
+                               if snap_dir else ""),
+                **timing),
+                keyring=self.server.keyring, on_event=self._on_wan_event)
+            await self.wan_pool.start()
+        self.server.lan_members_fn = self.lan_pool.members
+        self.server.user_event_broadcaster = self._broadcast_via_gossip
+        # serf snapshot rejoin (consul/server.go:34-35)
+        if snap_dir and self.config.rejoin_after_leave:
+            from consul_tpu.membership import SerfPool as _SP
+            prev = _SP.previous_peers(os.path.join(snap_dir, "local.snapshot"))
+            if prev:
+                await self.lan_pool.join(prev)
+        if self.config.retry_join:
+            self._retry_join_task = asyncio.get_event_loop().create_task(
+                self._retry_join_loop())
+
+    async def _retry_join_loop(self) -> None:
+        """retryJoin (command.go:467-528): keep dialing until one seed
+        answers; bounded by retry_max when configured."""
+        attempt = 0
+        try:
+            while True:
+                n = await self.lan_pool.join(list(self.config.retry_join))
+                if n > 0:
+                    self.log.info(f"agent: (LAN) joined: {n}")
+                    return
+                attempt += 1
+                if self.config.retry_max and attempt >= self.config.retry_max:
+                    self.log.error("agent: max join retry exhausted")
+                    await self.graceful_leave()
+                    return
+                await asyncio.sleep(self.config.retry_interval)
+        except asyncio.CancelledError:
+            pass
+
     async def stop(self) -> None:
         self.runners.stop_all()
         self.local.stop()
+        if self._retry_join_task is not None:
+            self._retry_join_task.cancel()
         await self.ipc.stop()
         await self.dns.stop()
         await self.http.stop()
+        if self.wan_pool is not None:
+            await self.wan_pool.stop()
+        if self.lan_pool is not None:
+            await self.lan_pool.stop()
         await self.server.stop()
 
     async def wait_for_leave(self) -> None:
@@ -156,15 +268,107 @@ class Agent:
         if self._left is not None:
             await self._left.wait()
 
+    # -- gossip event plumbing (lanEventHandler, consul/serf.go:35-88) ------
+
+    def _on_lan_event(self, kind: str, payload: Any) -> None:
+        from consul_tpu.membership.serf import EV_USER, parse_server
+        if kind == EV_USER:
+            self._ingest_gossip_event(payload)
+            return
+        node = payload
+        sp = parse_server(node)
+        if sp is not None and sp["dc"] == self.config.datacenter and \
+                node.name != self.config.node_name:
+            # server routing table (nodeJoined/nodeFailed, serf.go:239-275)
+            if node.state == "alive":
+                self.server.set_route(sp["name"], sp["rpc_addr"])
+                self._maybe_bootstrap()
+            else:
+                self.server.route_table.pop(sp["name"], None)
+        self.server.membership_notify(kind, node)
+
+    def _on_wan_event(self, kind: str, payload: Any) -> None:
+        from consul_tpu.membership.serf import EV_USER, parse_server
+        if kind == EV_USER:
+            return  # WAN pool carries no user events (serf.go:65-86)
+        node = payload
+        sp = parse_server(node)
+        if sp is None or sp["dc"] == self.config.datacenter:
+            return
+        dc_map = self._wan_servers.setdefault(sp["dc"], {})
+        if node.state == "alive":
+            dc_map[node.name] = sp["rpc_addr"]
+        else:
+            dc_map.pop(node.name, None)
+        if dc_map:
+            self.server.set_remote_dc(sp["dc"], list(dc_map.values()))
+        else:
+            self.server.remote_dcs.pop(sp["dc"], None)
+            self._wan_servers.pop(sp["dc"], None)
+
+    def _maybe_bootstrap(self) -> None:
+        """bootstrap-expect self-assembly (maybeBootstrap,
+        consul/serf.go:185-236): once ``expect`` servers are visible, every
+        server independently installs the same sorted peer set and normal
+        election proceeds.  One-shot."""
+        if self._bootstrapped or not self.config.server:
+            return
+        from consul_tpu.membership.serf import parse_server
+        servers = [parse_server(n) for n in self.lan_pool.alive_members()]
+        names = sorted(s["name"] for s in servers
+                       if s and s["dc"] == self.config.datacenter)
+        if len(names) < self.config.bootstrap_expect:
+            return
+        names = names[:self.config.bootstrap_expect]
+        if self.config.node_name not in names:
+            return  # late arrival: wait for the leader's AddPeer instead
+        self.log.info(f"agent: bootstrap_expect quorum found: {names}")
+        self.server.raft.peers = names
+        self._bootstrapped = True
+
+    def _broadcast_via_gossip(self, event) -> None:
+        """user_event_broadcaster target: flood the encoded UserEvent on
+        the LAN pool; local delivery loops back via _on_lan_event."""
+        import msgpack
+        self.lan_pool.user_event(
+            event.name, msgpack.packb(event.to_wire(), use_bin_type=True))
+
+    def _ingest_gossip_event(self, msg: Dict[str, Any]) -> None:
+        import msgpack
+        from consul_tpu.structs.structs import UserEvent
+        try:
+            ev = UserEvent.from_wire(msgpack.unpackb(
+                msg["payload"], raw=False, strict_map_key=False))
+        except Exception:
+            return
+        ev.ltime = int(msg.get("ltime", 0))
+        self._receive_event(ev)
+
     # -- IPC-facing operations (command/agent/rpc.go dispatch targets) ------
 
     async def join(self, addrs: List[str], wan: bool = False) -> int:
-        """Gossip join; real network membership lands with the gossip
-        transport.  Single-node agents join nobody."""
+        """Gossip join (agent.go JoinLAN/JoinWAN)."""
         self.log.info(f"agent: join {'wan ' if wan else ''}{addrs}")
-        return 0
+        pool = self.wan_pool if wan else self.lan_pool
+        if pool is None:
+            raise RuntimeError(
+                "WAN pool requires server mode" if wan
+                else "agent not started: no gossip pool")
+        return await pool.join(addrs)
+
+    @staticmethod
+    def _member_wire(n, default_port: int) -> Dict[str, Any]:
+        return {
+            "Name": n.name, "Addr": n.addr,
+            "Port": n.port or default_port,
+            "Status": n.state, "ProtocolCur": 2,
+            "Tags": dict(n.tags),
+        }
 
     def lan_members(self) -> List[Dict[str, Any]]:
+        if self.lan_pool is not None:
+            return [self._member_wire(n, 8301)
+                    for n in self.lan_pool.members()]
         return [{
             "Name": self.config.node_name,
             "Addr": self.config.advertise_addr,
@@ -178,20 +382,34 @@ class Agent:
     def wan_members(self) -> List[Dict[str, Any]]:
         if not self.config.server:
             return []
+        if self.wan_pool is not None:
+            return [self._member_wire(n, 8302)
+                    for n in self.wan_pool.members()]
         m = self.lan_members()[0].copy()
         m["Name"] = f"{self.config.node_name}.{self.config.datacenter}"
         m["Port"] = 8302
         return [m]
 
     async def graceful_leave(self) -> None:
-        """Leave choreography (consul/server.go:516-581): deregister, then
-        signal the daemon loop to shut down."""
+        """Leave choreography (consul/server.go:516-581): broadcast the
+        leave intent so peers mark us left (not failed), then signal the
+        daemon loop to shut down."""
         self.log.info("agent: requesting graceful leave")
+        if self.wan_pool is not None:
+            await self.wan_pool.leave()
+        if self.lan_pool is not None:
+            await self.lan_pool.leave()
         if self._left is not None:
             self._left.set()
 
     async def force_leave(self, node: str) -> None:
+        """Operator override: failed → left so the catalog reaps it
+        (RemoveFailedNode, consul/server.go:624-632)."""
         self.log.info(f"agent: force leave {node}")
+        if self.lan_pool is not None:
+            self.lan_pool.force_leave(node)
+        if self.wan_pool is not None:
+            self.wan_pool.force_leave(f"{node}.{self.config.datacenter}")
 
     async def reload(self) -> None:
         """SIGHUP/IPC reload (command.go:835-908): re-sync local state.

@@ -1,0 +1,25 @@
+"""Real-network cluster membership: the memberlist/Serf role.
+
+The TPU gossip kernel (consul_tpu.gossip) is this framework's flagship
+substrate — a batched SWIM simulator/engine for huge N.  This package
+is the *wire* plane for small real clusters (BASELINE config #1): an
+asyncio SWIM implementation over UDP/TCP on real sockets, carrying the
+same protocol semantics the reference consumes from hashicorp
+memberlist + serf (behavior contract:
+``website/source/docs/internals/gossip.html.markdown:10-43``, consumed
+at ``consul/server.go:257-273``).
+
+Layering mirrors the reference split:
+
+- :mod:`swim` — failure detection + dissemination (memberlist role):
+  UDP probe/ack/indirect-probe, suspicion + refutation, piggybacked
+  broadcasts, TCP push/pull anti-entropy, AES-GCM gossip encryption.
+- :mod:`serf` — the Serf role on top: node tags, user events with
+  Lamport clocks, membership snapshots for rejoin, join/leave
+  choreography.
+"""
+
+from consul_tpu.membership.swim import (  # noqa: F401
+    Memberlist, MemberConfig, Node, STATE_ALIVE, STATE_DEAD, STATE_LEFT,
+    STATE_SUSPECT)
+from consul_tpu.membership.serf import SerfPool, SerfConfig  # noqa: F401

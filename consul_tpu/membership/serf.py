@@ -1,0 +1,214 @@
+"""The Serf role: tags, user events, snapshots, join/leave choreography.
+
+Parity target: the reference's external ``hashicorp/serf`` dep as
+consumed by Consul (``consul/serf.go``, ``consul/server.go:284-325``
+for the tag scheme, ``consul/server.go:34-35`` for snapshots/rejoin).
+
+Adds on top of :class:`~consul_tpu.membership.swim.Memberlist`:
+
+- **Role tags** — Consul encodes {role, dc, port, vsn, bootstrap,
+  expect} into serf tags; helpers here parse them back into the
+  ``serverParts`` shape (``consul/util.go`` IsConsulServer).
+- **User events** — Lamport-clocked named broadcasts with a dedup
+  window, flooded on the gossip piggyback queue (serf UserEvent).
+- **Membership snapshots** — alive peers + clocks appended to
+  ``<dir>/local.snapshot``; ``previous_peers()`` feeds rejoin-after-
+  restart (RejoinAfterLeave, consul/config.go:131-135).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from consul_tpu.membership.swim import (
+    EV_FAILED, EV_JOIN, EV_LEAVE, EV_UPDATE, MemberConfig, Memberlist, Node,
+    STATE_ALIVE)
+
+EV_USER = "user"
+
+_SNAPSHOT_MAX_LINES = 4096
+
+
+@dataclass
+class SerfConfig:
+    node_name: str = "node1"
+    bind_addr: str = "127.0.0.1"
+    bind_port: int = 0
+    advertise_addr: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+    snapshot_path: str = ""          # "" = no snapshots (dev mode)
+    event_buffer: int = 256
+    # timing profile handed straight to the memberlist config
+    probe_interval: float = 1.0
+    probe_timeout: float = 0.5
+    gossip_interval: float = 0.2
+    suspicion_mult: float = 4.0
+    push_pull_interval: float = 30.0
+    reap_interval: float = 10.0
+    reconnect_timeout: float = 72 * 3600.0
+    tombstone_timeout: float = 24 * 3600.0
+
+
+class SerfPool:
+    """One gossip pool (LAN or WAN) with serf semantics.  Events are
+    delivered as ``(kind, payload)`` to the handler: membership kinds
+    carry a :class:`Node`, ``"user"`` carries the event dict."""
+
+    def __init__(self, config: SerfConfig, keyring: Optional[Any] = None,
+                 on_event: Optional[Callable[[str, Any], None]] = None) -> None:
+        self.config = config
+        self.on_event = on_event or (lambda kind, payload: None)
+        self.event_ltime = 0          # lamport clock for user events
+        self._seen_events: Dict[Tuple[int, str], bool] = {}
+        self.ml = Memberlist(
+            MemberConfig(
+                node_name=config.node_name, bind_addr=config.bind_addr,
+                bind_port=config.bind_port,
+                advertise_addr=config.advertise_addr,
+                tags=dict(config.tags),
+                probe_interval=config.probe_interval,
+                probe_timeout=config.probe_timeout,
+                gossip_interval=config.gossip_interval,
+                suspicion_mult=config.suspicion_mult,
+                push_pull_interval=config.push_pull_interval,
+                reap_interval=config.reap_interval,
+                reconnect_timeout=config.reconnect_timeout,
+                tombstone_timeout=config.tombstone_timeout),
+            keyring=keyring,
+            on_event=self._member_event,
+            on_user_msg=self._user_msg)
+        self._snapshot_lines = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.ml.start()
+
+    async def stop(self) -> None:
+        await self.ml.stop()
+
+    async def join(self, addrs: List[str]) -> int:
+        n = await self.ml.join(addrs)
+        self._snapshot()
+        return n
+
+    async def leave(self) -> None:
+        await self.ml.leave()
+
+    def force_leave(self, name: str) -> bool:
+        return self.ml.force_leave(name)
+
+    @property
+    def local_addr(self) -> Tuple[str, int]:
+        return self.ml.local_addr
+
+    def members(self) -> List[Node]:
+        return self.ml.members()
+
+    def alive_members(self) -> List[Node]:
+        return self.ml.alive_members()
+
+    def set_tags(self, tags: Dict[str, str]) -> None:
+        self.ml.set_tags(tags)
+
+    # -- user events (serf UserEvent) --------------------------------------
+
+    def user_event(self, name: str, payload: bytes,
+                   coalesce: bool = True) -> None:
+        self.event_ltime += 1
+        msg = {"t": "uev", "ltime": self.event_ltime, "name": name,
+               "payload": payload, "cc": coalesce}
+        self._seen_events[(msg["ltime"], name)] = True
+        self.ml.queue_user_msg(msg)
+        self.on_event(EV_USER, msg)
+
+    def _user_msg(self, msg: Dict) -> None:
+        if msg.get("t") != "uev":
+            return
+        ltime = int(msg.get("ltime", 0))
+        key = (ltime, msg.get("name", ""))
+        if key in self._seen_events:
+            return
+        self._seen_events[key] = True
+        if len(self._seen_events) > self.config.event_buffer:
+            for k in sorted(self._seen_events)[:len(self._seen_events)
+                                               - self.config.event_buffer]:
+                del self._seen_events[k]
+        self.event_ltime = max(self.event_ltime, ltime)
+        self.ml.queue_user_msg(msg)  # keep flooding
+        self.on_event(EV_USER, msg)
+
+    # -- membership events + snapshotting ----------------------------------
+
+    def _member_event(self, kind: str, node: Node) -> None:
+        if kind in (EV_JOIN, EV_LEAVE, EV_FAILED):
+            self._snapshot()
+        self.on_event(kind, node)
+
+    def _snapshot(self) -> None:
+        """Append current alive peers (serf's snapshotter keeps an
+        append-only log; we append full lines and rewrite on overflow)."""
+        path = self.config.snapshot_path
+        if not path:
+            return
+        peers = [f"{n.addr}:{n.port}" for n in self.alive_members()
+                 if n.name != self.config.node_name]
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            line = "peers: " + ",".join(peers) + "\n"
+            mode = "a" if self._snapshot_lines < _SNAPSHOT_MAX_LINES else "w"
+            with open(path, mode) as f:
+                f.write(line)
+            self._snapshot_lines = (self._snapshot_lines + 1
+                                    if mode == "a" else 1)
+        except OSError:
+            pass
+
+    @staticmethod
+    def previous_peers(path: str) -> List[str]:
+        """Peers recorded by the last run (rejoin source)."""
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f if ln.startswith("peers: ")]
+        except OSError:
+            return []
+        if not lines:
+            return []
+        last = lines[-1][len("peers: "):].strip()
+        return [p for p in last.split(",") if p]
+
+
+# -- Consul's serf tag scheme (consul/server.go:292-304, consul/util.go) ----
+
+
+def server_tags(dc: str, rpc_port: int, bootstrap: bool = False,
+                expect: int = 0) -> Dict[str, str]:
+    t = {"role": "consul", "dc": dc, "port": str(rpc_port), "vsn": "2"}
+    if bootstrap:
+        t["bootstrap"] = "1"
+    if expect:
+        t["expect"] = str(expect)
+    return t
+
+
+def client_tags(dc: str) -> Dict[str, str]:
+    return {"role": "node", "dc": dc, "vsn": "2"}
+
+
+def parse_server(node: Node) -> Optional[Dict[str, Any]]:
+    """serverParts equivalent (IsConsulServer, consul/util.go): None if
+    the member is not a server in some DC."""
+    t = node.tags
+    if t.get("role") != "consul":
+        return None
+    try:
+        port = int(t.get("port", "0"))
+    except ValueError:
+        return None
+    return {"name": node.name, "dc": t.get("dc", ""), "addr": node.addr,
+            "port": port, "rpc_addr": f"{node.addr}:{port}",
+            "bootstrap": t.get("bootstrap") == "1",
+            "expect": int(t.get("expect", "0") or 0)}

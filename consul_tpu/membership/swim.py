@@ -1,0 +1,676 @@
+"""Asyncio SWIM over real UDP/TCP sockets — the memberlist role.
+
+Protocol semantics follow the reference's external memberlist dep (the
+behavior contract Consul documents at
+``website/source/docs/internals/gossip.html.markdown:10-43`` and tunes
+at ``consul/config.go:266-272`` / ``consul/server_test.go:50-62``):
+
+- **Failure detection**: every ``probe_interval`` one member (from a
+  shuffled round-robin sweep) gets a direct UDP ping; on timeout,
+  ``indirect_checks`` random peers are asked to probe on our behalf;
+  no ack at all ⇒ broadcast a suspect message.
+- **Suspicion**: a suspected node is declared dead after
+  ``suspicion_mult * log10(n+1) * probe_interval`` unless it refutes by
+  re-asserting itself at a higher incarnation (the alive message wins
+  iff its incarnation is strictly newer — the SWIM ordering rule).
+- **Dissemination**: membership messages ride piggybacked on every
+  outbound UDP packet, each retransmitted ``retransmit_mult *
+  log10(n+1)`` times; newer information about a node invalidates queued
+  older messages about it.
+- **Anti-entropy**: periodic TCP push/pull exchanges the full node
+  table with one random peer (join uses the same exchange).
+- **Encryption**: AES-128/256-GCM per packet when a keyring is armed —
+  encrypt with the primary key, decrypt trying every installed key
+  (matches memberlist's multi-key rollover model).
+
+This is intentionally an event-loop state machine, not a thread per
+timer: compressed-timer multi-node tests run in one process the same
+way the reference's do (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+# node states (memberlist stateAlive/stateSuspect/stateDead + serf "left")
+STATE_ALIVE = "alive"
+STATE_SUSPECT = "suspect"
+STATE_DEAD = "failed"
+STATE_LEFT = "left"
+
+# event kinds surfaced to the layer above (serf EventMember*)
+EV_JOIN = "member-join"
+EV_LEAVE = "member-leave"
+EV_FAILED = "member-failed"
+EV_UPDATE = "member-update"
+
+_UDP_BUDGET = 1350  # payload budget per packet (memberlist udpSendBuf)
+_AAD = b"consul-tpu-gossip-v0"
+
+
+@dataclass
+class MemberConfig:
+    node_name: str = "node1"
+    bind_addr: str = "127.0.0.1"
+    bind_port: int = 0            # 0 = ephemeral (tests)
+    advertise_addr: str = ""      # defaults to bind_addr
+    tags: Dict[str, str] = field(default_factory=dict)
+    # LAN-profile timings (memberlist DefaultLANConfig; WAN profile and
+    # the compressed test profile just override these).
+    probe_interval: float = 1.0
+    probe_timeout: float = 0.5
+    indirect_checks: int = 3
+    gossip_interval: float = 0.2
+    gossip_nodes: int = 3
+    suspicion_mult: float = 4.0
+    retransmit_mult: float = 4.0
+    push_pull_interval: float = 30.0
+    # reaping: forget failed nodes after reconnect_timeout and left nodes
+    # after tombstone_timeout (serf's reaper; tests compress these)
+    reap_interval: float = 10.0
+    reconnect_timeout: float = 72 * 3600.0
+    tombstone_timeout: float = 24 * 3600.0
+
+
+@dataclass
+class Node:
+    name: str
+    addr: str
+    port: int
+    incarnation: int = 0
+    state: str = STATE_ALIVE
+    tags: Dict[str, str] = field(default_factory=dict)
+    state_change: float = field(default_factory=time.monotonic)
+
+    def wire(self) -> Dict[str, Any]:
+        return {"name": self.name, "addr": self.addr, "port": self.port,
+                "inc": self.incarnation, "state": self.state,
+                "tags": self.tags}
+
+
+class Memberlist:
+    """One gossip pool member.  ``start()`` binds UDP+TCP on the same
+    port number (the memberlist convention); ``join()`` push/pulls with
+    a seed; events stream to the registered handler."""
+
+    def __init__(self, config: MemberConfig,
+                 keyring: Optional[Any] = None,
+                 on_event: Optional[Callable[[str, Node], None]] = None,
+                 on_user_msg: Optional[Callable[[Dict], None]] = None) -> None:
+        self.config = config
+        if not config.advertise_addr:
+            config.advertise_addr = config.bind_addr
+        self.keyring = keyring  # agent keyring: list_keys()[0] is primary
+        self.on_event = on_event or (lambda kind, node: None)
+        # Hook for the serf layer: unknown message types are handed up
+        # (user events ride the same piggyback queue).
+        self.on_user_msg = on_user_msg or (lambda msg: None)
+        self.incarnation = 0
+        self.nodes: Dict[str, Node] = {}
+        self._seq = 0
+        self._ack_waiters: Dict[int, asyncio.Future] = {}
+        # broadcast queue: name -> (msg, transmits_left); newer info
+        # about a node replaces queued older info (memberlist invalidation)
+        self._bcast: Dict[str, Tuple[Dict, int]] = {}
+        self._extra_bcast: List[Tuple[Dict, int]] = []  # serf-layer msgs
+        self._suspicion_timers: Dict[str, asyncio.TimerHandle] = {}
+        self._probe_ring: List[str] = []
+        self._probe_idx = 0
+        self._udp: Optional[asyncio.DatagramTransport] = None
+        self._tcp: Optional[asyncio.AbstractServer] = None
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+        self.local_addr: Tuple[str, int] = ("", 0)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        loop = asyncio.get_event_loop()
+        self._tcp = await asyncio.start_server(
+            self._serve_tcp, self.config.bind_addr, self.config.bind_port)
+        port = self._tcp.sockets[0].getsockname()[1]
+        self._udp, _ = await loop.create_datagram_endpoint(
+            lambda: _UDPProtocol(self),
+            local_addr=(self.config.bind_addr, port))
+        self.local_addr = (self.config.advertise_addr, port)
+        me = Node(self.config.node_name, self.config.advertise_addr, port,
+                  incarnation=self.incarnation, tags=dict(self.config.tags))
+        self.nodes[me.name] = me
+        self.on_event(EV_JOIN, me)
+        self._tasks = [
+            loop.create_task(self._probe_loop()),
+            loop.create_task(self._gossip_loop()),
+            loop.create_task(self._pushpull_loop()),
+            loop.create_task(self._reap_loop()),
+        ]
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for h in self._suspicion_timers.values():
+            h.cancel()
+        self._suspicion_timers.clear()
+        if self._udp is not None:
+            self._udp.close()
+        if self._tcp is not None:
+            self._tcp.close()
+            await self._tcp.wait_closed()
+
+    async def join(self, addrs: List[str]) -> int:
+        """TCP push/pull with each seed (memberlist Join).  Returns the
+        number of seeds successfully contacted."""
+        ok = 0
+        for a in addrs:
+            host, _, port = a.rpartition(":")
+            try:
+                await self._pushpull(host or a,
+                                     int(port) if port else self.local_addr[1])
+                ok += 1
+            except (OSError, asyncio.TimeoutError, ValueError,
+                    ConnectionError, asyncio.IncompleteReadError):
+                continue
+        return ok
+
+    async def leave(self) -> None:
+        """Graceful leave: broadcast our own death flagged as intent
+        (serf Leave → memberlist dead with node==from), linger a few
+        gossip intervals so it disseminates."""
+        me = self.nodes[self.config.node_name]
+        self.incarnation += 1
+        me.incarnation = self.incarnation
+        me.state = STATE_LEFT
+        me.state_change = time.monotonic()
+        self._queue_bcast({"t": "dead", "node": me.name,
+                           "inc": me.incarnation, "from": me.name})
+        for _ in range(3):
+            await asyncio.sleep(self.config.gossip_interval)
+
+    def force_leave(self, name: str) -> bool:
+        """Operator override for a failed node (RemoveFailedNode,
+        consul/server.go:624-632): transition failed → left so the
+        reaper can claim it without waiting."""
+        node = self.nodes.get(name)
+        if node is None or node.state not in (STATE_DEAD, STATE_SUSPECT):
+            return False
+        node.state = STATE_LEFT
+        node.state_change = time.monotonic()
+        self._queue_bcast({"t": "dead", "node": name,
+                           "inc": node.incarnation, "from": name})
+        return True
+
+    def members(self) -> List[Node]:
+        return sorted(self.nodes.values(), key=lambda n: n.name)
+
+    def alive_members(self) -> List[Node]:
+        return [n for n in self.members() if n.state == STATE_ALIVE]
+
+    def num_alive(self) -> int:
+        return sum(1 for n in self.nodes.values() if n.state == STATE_ALIVE)
+
+    def set_tags(self, tags: Dict[str, str]) -> None:
+        """Re-advertise self with new tags (serf SetTags)."""
+        me = self.nodes[self.config.node_name]
+        self.incarnation += 1
+        me.incarnation = self.incarnation
+        me.tags = dict(tags)
+        self.config.tags = dict(tags)
+        self._queue_bcast({"t": "alive", **me.wire()})
+
+    def queue_user_msg(self, msg: Dict, transmits: Optional[int] = None) -> None:
+        """Serf-layer broadcast (user events) on the piggyback queue."""
+        self._extra_bcast.append((msg, transmits or self._retransmits()))
+
+    # -- wire helpers ------------------------------------------------------
+
+    def _encrypt(self, buf: bytes) -> bytes:
+        if self.keyring is None:
+            return b"\x00" + buf
+        import base64
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        key = base64.b64decode(self.keyring.list_keys()[0])
+        nonce = os.urandom(12)
+        return b"\x01" + nonce + AESGCM(key).encrypt(nonce, buf, _AAD)
+
+    def _decrypt(self, buf: bytes) -> Optional[bytes]:
+        if not buf:
+            return None
+        if buf[0] == 0:
+            # Reject plaintext when encryption is armed (memberlist
+            # GossipVerifyIncoming default).
+            return None if self.keyring is not None else buf[1:]
+        if self.keyring is None:
+            return None
+        import base64
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        nonce, ct = buf[1:13], buf[13:]
+        for k in self.keyring.list_keys():
+            try:
+                return AESGCM(base64.b64decode(k)).decrypt(nonce, ct, _AAD)
+            except Exception:
+                continue
+        return None
+
+    def _send_udp(self, addr: Tuple[str, int], msgs: List[Dict]) -> None:
+        if self._udp is None or self._udp.is_closing():
+            return
+        buf = self._encrypt(msgpack.packb(msgs, use_bin_type=True))
+        try:
+            self._udp.sendto(buf, addr)
+        except OSError:
+            pass
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _retransmits(self) -> int:
+        n = max(len(self.nodes), 1)
+        return max(1, int(math.ceil(
+            self.config.retransmit_mult * math.log10(n + 1))))
+
+    def _queue_bcast(self, msg: Dict) -> None:
+        # alive messages carry the subject under "name", the rest under
+        # "node"; either way newer info replaces queued older info
+        subject = msg.get("node") or msg["name"]
+        self._bcast[subject] = (msg, self._retransmits())
+
+    def _take_piggyback(self, budget: int = _UDP_BUDGET) -> List[Dict]:
+        """Drain up to ``budget`` encoded bytes of queued broadcasts,
+        decrementing retransmit counters (memberlist getBroadcasts)."""
+        out: List[Dict] = []
+        used = 0
+        for name in list(self._bcast):
+            msg, left = self._bcast[name]
+            size = len(msgpack.packb(msg, use_bin_type=True))
+            if used + size > budget:
+                continue
+            out.append(msg)
+            used += size
+            left -= 1
+            if left <= 0:
+                del self._bcast[name]
+            else:
+                self._bcast[name] = (msg, left)
+        kept: List[Tuple[Dict, int]] = []
+        for msg, left in self._extra_bcast:
+            size = len(msgpack.packb(msg, use_bin_type=True))
+            if used + size > budget:
+                kept.append((msg, left))
+                continue
+            out.append(msg)
+            used += size
+            if left - 1 > 0:
+                kept.append((msg, left - 1))
+        self._extra_bcast = kept
+        return out
+
+    # -- protocol loops ----------------------------------------------------
+
+    async def _probe_loop(self) -> None:
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.config.probe_interval)
+                await self._probe_once()
+        except asyncio.CancelledError:
+            pass
+
+    def _next_probe_target(self) -> Optional[Node]:
+        """Shuffled round-robin sweep (memberlist's nextIncarnation of
+        the node ring) — every member probed once per cycle."""
+        candidates = [n.name for n in self.nodes.values()
+                      if n.name != self.config.node_name
+                      and n.state in (STATE_ALIVE, STATE_SUSPECT)]
+        if not candidates:
+            return None
+        if self._probe_idx >= len(self._probe_ring):
+            self._probe_ring = candidates
+            random.shuffle(self._probe_ring)
+            self._probe_idx = 0
+        while self._probe_idx < len(self._probe_ring):
+            node = self.nodes.get(self._probe_ring[self._probe_idx])
+            self._probe_idx += 1
+            if node is not None and node.state in (STATE_ALIVE, STATE_SUSPECT):
+                return node
+        return self._next_probe_target()
+
+    async def _probe_once(self) -> None:
+        target = self._next_probe_target()
+        if target is None:
+            return
+        seq = self._next_seq()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._ack_waiters[seq] = fut
+        self._send_udp((target.addr, target.port),
+                       [{"t": "ping", "seq": seq,
+                         "from": self.config.node_name},
+                        *self._take_piggyback()])
+        try:
+            await asyncio.wait_for(fut, self.config.probe_timeout)
+            return
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._ack_waiters.pop(seq, None)
+        # indirect probes through k random helpers (SWIM §4.1)
+        helpers = [n for n in self.nodes.values()
+                   if n.state == STATE_ALIVE
+                   and n.name not in (self.config.node_name, target.name)]
+        random.shuffle(helpers)
+        seq2 = self._next_seq()
+        fut2: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._ack_waiters[seq2] = fut2
+        for h in helpers[:self.config.indirect_checks]:
+            self._send_udp((h.addr, h.port),
+                           [{"t": "ind", "seq": seq2, "node": target.name,
+                             "addr": target.addr, "port": target.port,
+                             "from": self.config.node_name}])
+        try:
+            await asyncio.wait_for(fut2, self.config.probe_interval)
+            return
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._ack_waiters.pop(seq2, None)
+        self._suspect(target.name, target.incarnation,
+                      self.config.node_name)
+        self._queue_bcast({"t": "suspect", "node": target.name,
+                           "inc": target.incarnation,
+                           "from": self.config.node_name})
+
+    async def _gossip_loop(self) -> None:
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.config.gossip_interval)
+                if not self._bcast and not self._extra_bcast:
+                    continue
+                peers = [n for n in self.nodes.values()
+                         if n.name != self.config.node_name
+                         and n.state in (STATE_ALIVE, STATE_SUSPECT)]
+                random.shuffle(peers)
+                for p in peers[:self.config.gossip_nodes]:
+                    msgs = self._take_piggyback()
+                    if msgs:
+                        self._send_udp((p.addr, p.port), msgs)
+        except asyncio.CancelledError:
+            pass
+
+    async def _pushpull_loop(self) -> None:
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.config.push_pull_interval)
+                peers = [n for n in self.alive_members()
+                         if n.name != self.config.node_name]
+                if not peers:
+                    continue
+                p = random.choice(peers)
+                try:
+                    await self._pushpull(p.addr, p.port)
+                except (OSError, asyncio.TimeoutError, ConnectionError,
+                        asyncio.IncompleteReadError):
+                    continue
+        except asyncio.CancelledError:
+            pass
+
+    async def _reap_loop(self) -> None:
+        """Forget long-departed nodes (serf's reap): failed past
+        reconnect_timeout, left past tombstone_timeout.  Reaped names
+        vanish from members(), which is what lets the leader's full
+        reconcile deregister them from the catalog."""
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.config.reap_interval)
+                now = time.monotonic()
+                for name, n in list(self.nodes.items()):
+                    if name == self.config.node_name:
+                        continue
+                    age = now - n.state_change
+                    if (n.state == STATE_DEAD
+                            and age > self.config.reconnect_timeout) or \
+                       (n.state == STATE_LEFT
+                            and age > self.config.tombstone_timeout):
+                        del self.nodes[name]
+        except asyncio.CancelledError:
+            pass
+
+    # -- TCP push/pull (memberlist pushPullNode) ---------------------------
+
+    def _state_wire(self) -> Dict:
+        return {"nodes": [n.wire() for n in self.nodes.values()]}
+
+    async def _pushpull(self, host: str, port: int) -> None:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), 5.0)
+        try:
+            buf = self._encrypt(msgpack.packb(self._state_wire(),
+                                              use_bin_type=True))
+            writer.write(len(buf).to_bytes(4, "big") + buf)
+            await writer.drain()
+            n = int.from_bytes(await asyncio.wait_for(
+                reader.readexactly(4), 5.0), "big")
+            raw = self._decrypt(await asyncio.wait_for(
+                reader.readexactly(n), 5.0))
+            if raw is None:
+                raise ConnectionError("undecryptable push/pull reply")
+            self._merge_state(msgpack.unpackb(raw, raw=False,
+                                              strict_map_key=False))
+        finally:
+            writer.close()
+
+    async def _serve_tcp(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        try:
+            n = int.from_bytes(await asyncio.wait_for(
+                reader.readexactly(4), 5.0), "big")
+            raw = self._decrypt(await asyncio.wait_for(
+                reader.readexactly(n), 5.0))
+            if raw is None:
+                return
+            remote = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+            buf = self._encrypt(msgpack.packb(self._state_wire(),
+                                              use_bin_type=True))
+            writer.write(len(buf).to_bytes(4, "big") + buf)
+            await writer.drain()
+            self._merge_state(remote)
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                ConnectionError, OSError, msgpack.UnpackException):
+            pass
+        finally:
+            writer.close()
+
+    def _merge_state(self, remote: Dict) -> None:
+        for w in remote.get("nodes", []):
+            state = w.get("state", STATE_ALIVE)
+            if state == STATE_ALIVE:
+                self._alive(w)
+            elif state == STATE_SUSPECT:
+                self._suspect(w["name"], w["inc"], w.get("from", ""))
+            else:
+                self._dead(w["name"], w["inc"], w.get("from", ""),
+                           left=(state == STATE_LEFT))
+
+    # -- message handling --------------------------------------------------
+
+    def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
+        raw = self._decrypt(data)
+        if raw is None:
+            return
+        try:
+            msgs = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+        except Exception:
+            return
+        if not isinstance(msgs, list):
+            msgs = [msgs]
+        for m in msgs:
+            try:
+                self._handle_msg(m, addr)
+            except (KeyError, TypeError, ValueError):
+                continue
+
+    def _handle_msg(self, m: Dict, addr: Tuple[str, int]) -> None:
+        t = m.get("t")
+        if t == "ping":
+            self._send_udp(addr, [{"t": "ack", "seq": m["seq"],
+                                   "from": self.config.node_name},
+                                  *self._take_piggyback()])
+        elif t == "ack":
+            fut = self._ack_waiters.get(m["seq"])
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            # relay leg of an indirect probe we serviced
+            relay = m.get("relay")
+            if relay:
+                self._send_udp((relay["addr"], relay["port"]),
+                               [{"t": "ack", "seq": relay["seq"],
+                                 "from": self.config.node_name}])
+        elif t == "ind":
+            # probe the target on the requester's behalf; ask the target
+            # to have the eventual ack relayed back to the requester
+            requester = self.nodes.get(m["from"])
+            seq = self._next_seq()
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._ack_waiters[seq] = fut
+
+            def _relay(_f, m=m, requester=requester, addr=addr):
+                dest = ((requester.addr, requester.port)
+                        if requester is not None else addr)
+                self._send_udp(dest, [{"t": "ack", "seq": m["seq"],
+                                       "from": self.config.node_name}])
+
+            fut.add_done_callback(
+                lambda f: (_relay(f) if not f.cancelled()
+                           and f.exception() is None else None))
+            asyncio.get_event_loop().call_later(
+                self.config.probe_timeout * 2, self._ack_waiters.pop,
+                seq, None)
+            self._send_udp((m["addr"], m["port"]),
+                           [{"t": "ping", "seq": seq,
+                             "from": self.config.node_name}])
+        elif t == "alive":
+            self._alive(m)
+        elif t == "suspect":
+            self._suspect(m["node"], m["inc"], m["from"])
+        elif t == "dead":
+            self._dead(m["node"], m["inc"], m["from"],
+                       left=(m["node"] == m["from"]))
+        else:
+            self.on_user_msg(m)
+
+    # -- SWIM state transitions (memberlist aliveNode/suspectNode/deadNode) -
+
+    def _alive(self, w: Dict) -> None:
+        name, inc = w["name"], w["inc"]
+        node = self.nodes.get(name)
+        if name == self.config.node_name:
+            # Someone is spreading stale/competing info about us; refute
+            # by outliving its incarnation (memberlist refute()).
+            if node is not None and inc >= self.incarnation and \
+                    w.get("addr") != node.addr:
+                self._refute(inc)
+            return
+        if node is None:
+            node = Node(name, w["addr"], w["port"], incarnation=inc,
+                        tags=w.get("tags") or {})
+            self.nodes[name] = node
+            self._queue_bcast({"t": "alive", **node.wire()})
+            self.on_event(EV_JOIN, node)
+            return
+        if inc <= node.incarnation and node.state == STATE_ALIVE:
+            return
+        if inc < node.incarnation:
+            return
+        was = node.state
+        tags_changed = (w.get("tags") or {}) != node.tags
+        node.incarnation = inc
+        node.addr, node.port = w["addr"], w["port"]
+        node.tags = w.get("tags") or {}
+        node.state = STATE_ALIVE
+        node.state_change = time.monotonic()
+        self._cancel_suspicion(name)
+        self._queue_bcast({"t": "alive", **node.wire()})
+        if was in (STATE_DEAD, STATE_LEFT):
+            self.on_event(EV_JOIN, node)
+        elif tags_changed:
+            self.on_event(EV_UPDATE, node)
+
+    def _suspect(self, name: str, inc: int, from_: str) -> None:
+        node = self.nodes.get(name)
+        if node is None or inc < node.incarnation:
+            return
+        if name == self.config.node_name:
+            self._refute(inc)
+            return
+        if node.state != STATE_ALIVE:
+            return
+        node.state = STATE_SUSPECT
+        node.state_change = time.monotonic()
+        self._queue_bcast({"t": "suspect", "node": name, "inc": inc,
+                           "from": from_})
+        n = max(self.num_alive(), 1)
+        timeout = (self.config.suspicion_mult * max(math.log10(n + 1), 1.0)
+                   * self.config.probe_interval)
+        loop = asyncio.get_event_loop()
+        self._cancel_suspicion(name)
+        self._suspicion_timers[name] = loop.call_later(
+            timeout, self._suspicion_expired, name, inc)
+
+    def _suspicion_expired(self, name: str, inc: int) -> None:
+        self._suspicion_timers.pop(name, None)
+        node = self.nodes.get(name)
+        if node is None or node.state != STATE_SUSPECT:
+            return
+        self._dead(name, inc, self.config.node_name)
+        self._queue_bcast({"t": "dead", "node": name, "inc": inc,
+                           "from": self.config.node_name})
+
+    def _dead(self, name: str, inc: int, from_: str, left: bool = False) -> None:
+        node = self.nodes.get(name)
+        if node is None or inc < node.incarnation:
+            return
+        if name == self.config.node_name:
+            if not left:
+                self._refute(inc)
+            return
+        if node.state in (STATE_DEAD, STATE_LEFT):
+            if left and node.state == STATE_DEAD:
+                node.state = STATE_LEFT  # force-leave upgrade
+            return
+        self._cancel_suspicion(name)
+        node.incarnation = inc
+        node.state = STATE_LEFT if left else STATE_DEAD
+        node.state_change = time.monotonic()
+        self._queue_bcast({"t": "dead", "node": name, "inc": inc,
+                           "from": from_})
+        self.on_event(EV_LEAVE if left else EV_FAILED, node)
+
+    def _refute(self, seen_inc: int) -> None:
+        self.incarnation = max(self.incarnation, seen_inc) + 1
+        me = self.nodes[self.config.node_name]
+        me.incarnation = self.incarnation
+        self._queue_bcast({"t": "alive", **me.wire()})
+
+    def _cancel_suspicion(self, name: str) -> None:
+        h = self._suspicion_timers.pop(name, None)
+        if h is not None:
+            h.cancel()
+
+
+class _UDPProtocol(asyncio.DatagramProtocol):
+    def __init__(self, ml: Memberlist) -> None:
+        self.ml = ml
+
+    def datagram_received(self, data: bytes, addr: Tuple[str, int]) -> None:
+        self.ml._on_datagram(data, addr)
+
+    def error_received(self, exc: Exception) -> None:  # ICMP unreachable etc.
+        pass

@@ -34,6 +34,7 @@ class LeaderDuties:
         self._session_timers: Dict[str, asyncio.TimerHandle] = {}
         self._tombstone_task: Optional[asyncio.Task] = None
         self._establish_task: Optional[asyncio.Task] = None
+        self._reconcile_task: Optional[asyncio.Task] = None
         self._active = False
 
     # -- leadership transitions (monitorLeadership, leader.go:29-58) -------
@@ -60,6 +61,8 @@ class LeaderDuties:
         self.initialize_session_timers()
         self._tombstone_task = asyncio.get_event_loop().create_task(
             self._tombstone_loop())
+        self._reconcile_task = asyncio.get_event_loop().create_task(
+            self._reconcile_loop())
 
     async def _bootstrap_acls(self) -> None:
         """Seed the anonymous token and the configured master token in the
@@ -93,6 +96,9 @@ class LeaderDuties:
         if self._tombstone_task is not None:
             self._tombstone_task.cancel()
             self._tombstone_task = None
+        if self._reconcile_task is not None:
+            self._reconcile_task.cancel()
+            self._reconcile_task = None
         if self._establish_task is not None:
             self._establish_task.cancel()
             self._establish_task = None
@@ -146,6 +152,141 @@ class LeaderDuties:
 
     def session_timer_count(self) -> int:
         return len(self._session_timers)
+
+    # -- serf→catalog reconciliation (leader.go:242-501) -------------------
+
+    async def _reconcile_loop(self) -> None:
+        """Drain gossip member events; on idle, run the periodic full
+        reconcile (leaderLoop's select over reconcileCh + the
+        ReconcileInterval ticker, leader.go:104-117)."""
+        interval = self.srv.config.reconcile_interval
+        try:
+            while self._active:
+                ch = self.srv.reconcile_ch
+                if ch is None:
+                    await asyncio.sleep(interval)
+                    continue
+                try:
+                    _kind, member = await asyncio.wait_for(
+                        ch.get(), timeout=interval)
+                except asyncio.TimeoutError:
+                    await self._reconcile_full()
+                    continue
+                try:
+                    await self._reconcile_member(member)
+                except Exception:
+                    pass  # lost leadership mid-apply; next leader repairs
+        except asyncio.CancelledError:
+            pass
+
+    async def _reconcile_full(self) -> None:
+        """Full pass (reconcile, leader.go:242-260): every pool member is
+        re-checked, and catalog nodes that vanished from the pool while a
+        different server was leader are reaped (reconcileReaped,
+        leader.go:261-306).  Nodes without a serfHealth check are
+        external registrations and never touched."""
+        fn = self.srv.lan_members_fn
+        if fn is None:
+            return
+        members = list(fn())
+        known = set()
+        for m in members:
+            known.add(m.name)
+            try:
+                await self._reconcile_member(m)
+            except Exception:
+                return
+        from consul_tpu.structs.structs import SERF_CHECK_ID
+        _, nodes = self.srv.store.nodes()
+        for node in nodes:
+            if node.node in known:
+                continue
+            _, checks = self.srv.store.node_checks(node.node)
+            if not any(c.check_id == SERF_CHECK_ID for c in checks):
+                continue  # no serfHealth ⇒ externally registered
+            try:
+                await self._handle_left(node.node)
+            except Exception:
+                return
+
+    async def _reconcile_member(self, member) -> None:
+        """Dispatch one member to its state handler (reconcileMember,
+        leader.go:310-339)."""
+        from consul_tpu.membership.swim import (
+            STATE_ALIVE, STATE_DEAD, STATE_LEFT, STATE_SUSPECT)
+        state = getattr(member, "state", STATE_ALIVE)
+        if state in (STATE_ALIVE, STATE_SUSPECT):
+            await self._handle_alive(member)
+        elif state == STATE_DEAD:
+            await self._handle_failed(member)
+        elif state == STATE_LEFT:
+            await self._handle_left(member.name)
+
+    async def _handle_alive(self, member) -> None:
+        """handleAliveMember (leader.go:354-421): ensure the catalog has
+        the node, a passing serfHealth, and the consul service for
+        servers; raft-join new servers (joinConsulServer, leader.go:504)."""
+        from consul_tpu.membership.serf import parse_server
+        from consul_tpu.structs.structs import (
+            CONSUL_SERVICE_ID, CONSUL_SERVICE_NAME, HEALTH_PASSING,
+            HealthCheck, NodeService, RegisterRequest, SERF_ALIVE_OUTPUT,
+            SERF_CHECK_ID, SERF_CHECK_NAME)
+        sp = parse_server(member)
+        if sp is not None and sp["dc"] == self.srv.config.datacenter and \
+                member.name != self.srv.config.node_name and \
+                member.name not in self.srv.raft.peers:
+            await self.srv.raft.add_peer(member.name)
+        # skip if the catalog already matches (leader.go:367-401)
+        _, addr = self.srv.store.get_node(member.name)
+        if addr == member.addr:
+            _, checks = self.srv.store.node_checks(member.name)
+            serf_ok = any(c.check_id == SERF_CHECK_ID
+                          and c.status == HEALTH_PASSING for c in checks)
+            _, svcs = self.srv.store.node_services(member.name)
+            svc_ok = (sp is None or sp["dc"] != self.srv.config.datacenter
+                      or bool(svcs and CONSUL_SERVICE_ID in svcs))
+            if serf_ok and svc_ok:
+                return
+        req = RegisterRequest(
+            node=member.name, address=member.addr,
+            check=HealthCheck(node=member.name, check_id=SERF_CHECK_ID,
+                              name=SERF_CHECK_NAME, status=HEALTH_PASSING,
+                              output=SERF_ALIVE_OUTPUT))
+        if sp is not None and sp["dc"] == self.srv.config.datacenter:
+            req.service = NodeService(id=CONSUL_SERVICE_ID,
+                                      service=CONSUL_SERVICE_NAME,
+                                      port=sp["port"])
+        await self.srv.catalog.register(req)
+
+    async def _handle_failed(self, member) -> None:
+        """handleFailedMember (leader.go:423-460): keep the node, flip
+        serfHealth critical so health-filtered queries drop it."""
+        from consul_tpu.structs.structs import (
+            HEALTH_CRITICAL, HealthCheck, RegisterRequest, SERF_CHECK_ID,
+            SERF_CHECK_NAME)
+        _, checks = self.srv.store.node_checks(member.name)
+        if any(c.check_id == SERF_CHECK_ID and c.status == HEALTH_CRITICAL
+               for c in checks):
+            return
+        await self.srv.catalog.register(RegisterRequest(
+            node=member.name, address=member.addr,
+            check=HealthCheck(node=member.name, check_id=SERF_CHECK_ID,
+                              name=SERF_CHECK_NAME, status=HEALTH_CRITICAL,
+                              output="Agent not live or unreachable")))
+
+    async def _handle_left(self, name: str) -> None:
+        """handleLeftMember/handleReapMember (leader.go:462-501):
+        deregister entirely; a departed server also leaves the raft
+        peer set (removeConsulServer, leader.go:540)."""
+        if name == self.srv.config.node_name:
+            return  # never deregister self (leader.go:468-471)
+        from consul_tpu.structs.structs import DeregisterRequest
+        if name in self.srv.raft.peers:
+            await self.srv.raft.remove_peer(name)
+        _, addr = self.srv.store.get_node(name)
+        if addr is None:
+            return
+        await self.srv.catalog.deregister(DeregisterRequest(node=name))
 
     # -- tombstone reaping (leader.go:553-566) -----------------------------
 

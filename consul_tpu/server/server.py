@@ -41,6 +41,10 @@ class ServerConfig:
     domain: str = "consul."
     bootstrap: bool = True
     peers: List[str] = field(default_factory=list)  # raft peer ids; [] = self only
+    # >0: start as a passive follower with NO raft peers and wait for
+    # bootstrap-expect self-assembly (maybeBootstrap, consul/serf.go:185-236)
+    # or a leader's AddPeer (joinConsulServer, consul/leader.go:504).
+    bootstrap_expect: int = 0
     data_dir: str = ""  # "" = in-memory log/snapshots (dev mode)
     raft: RaftConfig = field(default_factory=RaftConfig)
     # Protocol timing (test configs compress these, consul/server_test.go:50-69)
@@ -70,7 +74,10 @@ class Server:
         self.fsm = ConsulFSM(gc_hint=lambda idx: self.gc.hint(idx, time.monotonic()))
         self.start_time = time.monotonic()
 
-        peers = self.config.peers or [self.config.node_name]
+        if self.config.bootstrap_expect:
+            peers: List[str] = []  # passive until assembly/AddPeer
+        else:
+            peers = self.config.peers or [self.config.node_name]
         if self.config.data_dir:
             import os
             raft_dir = os.path.join(self.config.data_dir, "raft")
@@ -114,6 +121,13 @@ class Server:
         self.route_table: Dict[str, str] = {}
         self.remote_dcs: Dict[str, List[str]] = {}
         self.keyring = None  # agent-owned gossip keyring
+        # Membership plane (wired by the agent): reconcile_ch carries
+        # gossip member events to the leader loop (consul/serf.go:90-110);
+        # lan_members_fn supplies the pool view for full reconciles
+        # (consul/leader.go:242-260).
+        self.reconcile_ch: Optional[asyncio.Queue] = None
+        self.lan_members_fn: Optional[Any] = None
+        self.user_event_broadcaster: Optional[Any] = None
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
@@ -136,7 +150,19 @@ class Server:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
+        self.reconcile_ch = asyncio.Queue(maxsize=256)
         self.raft.start()
+
+    def membership_notify(self, kind: str, member: Any) -> None:
+        """Non-blocking push of a gossip member event toward the leader
+        loop (localMemberEvent's buffered send, consul/serf.go:105-108);
+        drops on overflow — the periodic full reconcile repairs."""
+        if self.reconcile_ch is None:
+            return
+        try:
+            self.reconcile_ch.put_nowait((kind, member))
+        except asyncio.QueueFull:
+            pass
 
     async def stop(self) -> None:
         self.leader_duties.revoke()
@@ -311,8 +337,12 @@ class Server:
 
     async def fire_user_event(self, event) -> None:
         """Broadcast a user event (consul/internal_endpoint.go EventFire →
-        serf.UserEvent).  Delivers to every registered sink; the gossip
-        plane adds cross-node fan-out when it lands."""
+        serf.UserEvent).  With a gossip pool armed, the broadcaster floods
+        the cluster and local delivery arrives via the pool's own event
+        loopback; without one, deliver straight to the local sinks."""
+        if self.user_event_broadcaster is not None:
+            self.user_event_broadcaster(event)
+            return
         for sink in self.event_sinks:
             sink(event)
 

@@ -1,0 +1,223 @@
+"""Real-network membership tests: multi-node pools on loopback with
+compressed timers (the reference tier: memberlist/serf behavior driven
+through consul/server_test.go-style in-process clusters, SURVEY §4)."""
+
+import asyncio
+import base64
+import os
+
+import pytest
+
+from consul_tpu.membership import SerfConfig, SerfPool
+from consul_tpu.membership.serf import (
+    EV_USER, client_tags, parse_server, server_tags)
+from consul_tpu.membership.swim import (
+    EV_FAILED, EV_JOIN, EV_LEAVE, STATE_ALIVE, STATE_DEAD, STATE_LEFT)
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def _fast(name, tags=None, snapshot_path="", **kw):
+    return SerfConfig(node_name=name, bind_addr="127.0.0.1",
+                      tags=tags or {}, snapshot_path=snapshot_path,
+                      probe_interval=0.05, probe_timeout=0.02,
+                      gossip_interval=0.02, suspicion_mult=3.0,
+                      push_pull_interval=1.0, **kw)
+
+
+async def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def _mk_pool(name, seeds=(), tags=None, keyring=None, events=None,
+                   snapshot_path=""):
+    handler = (lambda kind, payload: events.append((kind, payload))) \
+        if events is not None else None
+    pool = SerfPool(_fast(name, tags, snapshot_path), keyring=keyring,
+                    on_event=handler)
+    await pool.start()
+    if seeds:
+        assert await pool.join(list(seeds)) > 0
+    return pool
+
+
+class TestMembership:
+    def test_three_node_join_and_members(self, loop):
+        async def body():
+            a = await _mk_pool("a", tags=server_tags("dc1", 8300))
+            seed = [f"127.0.0.1:{a.local_addr[1]}"]
+            b = await _mk_pool("b", seeds=seed, tags=server_tags("dc1", 8300))
+            c = await _mk_pool("c", seeds=seed, tags=client_tags("dc1"))
+            for p in (a, b, c):
+                assert await _wait(lambda p=p: len(p.alive_members()) == 3), \
+                    f"{p.config.node_name} sees {len(p.alive_members())}"
+            # tag scheme parses into serverParts (consul/util.go)
+            servers = [parse_server(n) for n in a.members()]
+            assert sum(1 for s in servers if s) == 2
+            assert all(s["dc"] == "dc1" and s["port"] == 8300
+                       for s in servers if s)
+            for p in (a, b, c):
+                await p.stop()
+        loop.run_until_complete(body())
+
+    def test_failure_detection_and_events(self, loop):
+        async def body():
+            events = []
+            a = await _mk_pool("a", events=events)
+            seed = [f"127.0.0.1:{a.local_addr[1]}"]
+            b = await _mk_pool("b", seeds=seed)
+            c = await _mk_pool("c", seeds=seed)
+            assert await _wait(lambda: len(a.alive_members()) == 3)
+            await c.stop()  # hard kill: no leave broadcast
+            assert await _wait(
+                lambda: any(n.name == "c" and n.state == STATE_DEAD
+                            for n in a.members()), timeout=15)
+            assert any(k == EV_FAILED and n.name == "c"
+                       for k, n in events if hasattr(n, "name"))
+            # b converges on the same verdict by dissemination
+            assert await _wait(
+                lambda: any(n.name == "c" and n.state == STATE_DEAD
+                            for n in b.members()), timeout=15)
+            await a.stop()
+            await b.stop()
+        loop.run_until_complete(body())
+
+    def test_graceful_leave(self, loop):
+        async def body():
+            events = []
+            a = await _mk_pool("a", events=events)
+            seed = [f"127.0.0.1:{a.local_addr[1]}"]
+            b = await _mk_pool("b", seeds=seed)
+            assert await _wait(lambda: len(a.alive_members()) == 2)
+            await b.leave()
+            await b.stop()
+            assert await _wait(
+                lambda: any(n.name == "b" and n.state == STATE_LEFT
+                            for n in a.members()), timeout=15)
+            assert any(k == EV_LEAVE and getattr(n, "name", "") == "b"
+                       for k, n in events)
+            await a.stop()
+        loop.run_until_complete(body())
+
+    def test_rejoin_after_failure(self, loop):
+        async def body():
+            a = await _mk_pool("a")
+            seed = [f"127.0.0.1:{a.local_addr[1]}"]
+            b = await _mk_pool("b")
+            await b.join(seed)
+            assert await _wait(lambda: len(a.alive_members()) == 2)
+            b_port = b.local_addr[1]
+            await b.stop()
+            assert await _wait(
+                lambda: any(n.name == "b" and n.state == STATE_DEAD
+                            for n in a.members()), timeout=15)
+            # restart under the same name; alive at higher incarnation wins
+            b2 = SerfPool(SerfConfig(
+                node_name="b", bind_addr="127.0.0.1", bind_port=b_port,
+                probe_interval=0.05, probe_timeout=0.02,
+                gossip_interval=0.02, suspicion_mult=3.0,
+                push_pull_interval=1.0))
+            await b2.start()
+            b2.ml.incarnation = 10  # outlive the dead verdict
+            b2.ml.nodes["b"].incarnation = 10
+            await b2.join(seed)
+            assert await _wait(
+                lambda: any(n.name == "b" and n.state == STATE_ALIVE
+                            for n in a.members()), timeout=15)
+            await a.stop()
+            await b2.stop()
+        loop.run_until_complete(body())
+
+    def test_user_event_floods(self, loop):
+        async def body():
+            got = {"a": [], "b": [], "c": []}
+            pools = {}
+            pools["a"] = await _mk_pool("a", events=got["a"])
+            seed = [f"127.0.0.1:{pools['a'].local_addr[1]}"]
+            pools["b"] = await _mk_pool("b", seeds=seed, events=got["b"])
+            pools["c"] = await _mk_pool("c", seeds=seed, events=got["c"])
+            assert await _wait(
+                lambda: all(len(p.alive_members()) == 3
+                            for p in pools.values()))
+            pools["b"].user_event("deploy", b"v2")
+            def all_got():
+                return all(any(k == EV_USER and m["name"] == "deploy"
+                               and m["payload"] == b"v2"
+                               for k, m in evs if isinstance(m, dict))
+                           for evs in got.values())
+            assert await _wait(all_got, timeout=15)
+            for p in pools.values():
+                await p.stop()
+        loop.run_until_complete(body())
+
+
+class TestEncryption:
+    def _keyring(self, tmp_path, key=None):
+        from consul_tpu.agent.keyring import Keyring
+        key = key or base64.b64encode(os.urandom(16)).decode()
+        return Keyring(path=str(tmp_path / "kr.json"), initial_key=key), key
+
+    def test_encrypted_pool_communicates(self, loop, tmp_path):
+        async def body():
+            kr1, key = self._keyring(tmp_path / "1")
+            kr2, _ = self._keyring(tmp_path / "2", key)
+            a = await _mk_pool("a", keyring=kr1)
+            b = await _mk_pool("b", keyring=kr2)
+            assert await b.join([f"127.0.0.1:{a.local_addr[1]}"])
+            assert await _wait(lambda: len(a.alive_members()) == 2)
+            await a.stop()
+            await b.stop()
+        loop.run_until_complete(body())
+
+    def test_plaintext_rejected_by_encrypted_pool(self, loop, tmp_path):
+        async def body():
+            kr, _ = self._keyring(tmp_path)
+            a = await _mk_pool("a", keyring=kr)
+            b = SerfPool(_fast("b"))
+            await b.start()
+            n = await b.join([f"127.0.0.1:{a.local_addr[1]}"])
+            assert n == 0  # push/pull reply undecryptable without the key
+            assert len(a.alive_members()) == 1
+            await a.stop()
+            await b.stop()
+        loop.run_until_complete(body())
+
+    def test_wrong_key_rejected(self, loop, tmp_path):
+        async def body():
+            kr1, _ = self._keyring(tmp_path / "1")
+            kr2, _ = self._keyring(tmp_path / "2")  # different random key
+            a = await _mk_pool("a", keyring=kr1)
+            b = await _mk_pool("b", keyring=kr2)
+            assert await b.join([f"127.0.0.1:{a.local_addr[1]}"]) == 0
+            await a.stop()
+            await b.stop()
+        loop.run_until_complete(body())
+
+
+class TestSnapshots:
+    def test_snapshot_and_previous_peers(self, loop, tmp_path):
+        async def body():
+            snap_a = str(tmp_path / "a" / "local.snapshot")
+            a = await _mk_pool("a", snapshot_path=snap_a)
+            seed = [f"127.0.0.1:{a.local_addr[1]}"]
+            b = await _mk_pool("b", seeds=seed)
+            assert await _wait(lambda: len(a.alive_members()) == 2)
+            # a's snapshot eventually records b as a peer
+            assert await _wait(
+                lambda: any(str(b.local_addr[1]) in p
+                            for p in SerfPool.previous_peers(snap_a)),
+                timeout=10)
+            await a.stop()
+            await b.stop()
+        loop.run_until_complete(body())
