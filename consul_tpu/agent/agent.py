@@ -28,6 +28,7 @@ from consul_tpu.structs.structs import (
     HEALTH_PASSING,
     HealthCheck,
     NodeService,
+    QueryOptions,
     RegisterRequest,
     SERF_ALIVE_OUTPUT,
     SERF_CHECK_ID,
@@ -59,6 +60,9 @@ class AgentConfig:
     bootstrap: bool = True
     data_dir: str = ""  # "" = no persistence (dev mode)
     dns_only_passing: bool = False
+    dns_allow_stale: bool = False
+    dns_max_stale: float = 5.0   # seconds; re-query the leader past this
+    recursors: List[str] = field(default_factory=list)
     node_ttl: float = 0.0
     service_ttl: float = 0.0
     ae_interval: float = 60.0
@@ -93,28 +97,44 @@ class Agent:
         self.config = config or AgentConfig()
         if not self.config.advertise_addr:
             self.config.advertise_addr = self.config.bind_addr
-        self.server = Server(ServerConfig(
-            node_name=self.config.node_name,
-            datacenter=self.config.datacenter,
-            domain=self.config.domain,
-            bootstrap=self.config.bootstrap,
-            bootstrap_expect=self.config.bootstrap_expect,
-            data_dir=(os.path.join(self.config.data_dir, "server")
-                      if self.config.data_dir else ""),
-            **({"raft": self.config.raft_config}
-               if self.config.raft_config is not None else {}),
-            reconcile_interval=self.config.reconcile_interval,
-            acl_datacenter=self.config.acl_datacenter,
-            acl_ttl=self.config.acl_ttl,
-            acl_default_policy=self.config.acl_default_policy,
-            acl_down_policy=self.config.acl_down_policy,
-            acl_master_token=self.config.acl_master_token,
-        ))
+        if self.config.server:
+            # Embedded full server: Raft + state store + endpoints
+            # (consul.NewServer, agent.go:63-66 server branch).
+            self.server = Server(ServerConfig(
+                node_name=self.config.node_name,
+                datacenter=self.config.datacenter,
+                domain=self.config.domain,
+                bootstrap=self.config.bootstrap,
+                bootstrap_expect=self.config.bootstrap_expect,
+                data_dir=(os.path.join(self.config.data_dir, "server")
+                          if self.config.data_dir else ""),
+                **({"raft": self.config.raft_config}
+                   if self.config.raft_config is not None else {}),
+                reconcile_interval=self.config.reconcile_interval,
+                acl_datacenter=self.config.acl_datacenter,
+                acl_ttl=self.config.acl_ttl,
+                acl_default_policy=self.config.acl_default_policy,
+                acl_down_policy=self.config.acl_down_policy,
+                acl_master_token=self.config.acl_master_token,
+            ))
+        else:
+            # Client mode: no Raft, no store — LAN gossip + RPC
+            # forwarding with last-server affinity (consul.NewClient,
+            # consul/client.go:72).
+            from consul_tpu.server.client import ClientConfig, ConsulClient
+            self.server = ConsulClient(ClientConfig(
+                node_name=self.config.node_name,
+                datacenter=self.config.datacenter,
+                domain=self.config.domain,
+            ))
         self.http = HTTPServer(self)
         self.dns = DNSServer(self, domain=self.config.domain,
                              node_ttl=self.config.node_ttl,
                              service_ttl=self.config.service_ttl,
-                             only_passing=self.config.dns_only_passing)
+                             only_passing=self.config.dns_only_passing,
+                             allow_stale=self.config.dns_allow_stale,
+                             max_stale=self.config.dns_max_stale,
+                             recursors=self.config.recursors)
         self.local = LocalState(self, sync_interval=self.config.ae_interval)
         self.runners = CheckRunnerSet()
         from consul_tpu.agent.events import EventManager
@@ -160,13 +180,14 @@ class Agent:
     async def start(self) -> None:
         self._left = asyncio.Event()
         self.log.info(f"consul-tpu agent running, node={self.config.node_name}")
-        if self.config.rpc_mesh_port is not None:
+        if self.config.server and self.config.rpc_mesh_port is not None:
             host, port = await self.server.attach_rpc(
                 self.config.bind_addr, self.config.rpc_mesh_port)
             self.rpc_addr = f"{self.config.advertise_addr}:{port}"
         await self.server.start()
         await self._start_gossip()
-        if self.config.bootstrap and not self.config.bootstrap_expect:
+        if self.config.server and self.config.bootstrap \
+                and not self.config.bootstrap_expect:
             # Single-node semantics: leadership is immediate; register
             # ourselves now.  Clustered agents converge via the leader's
             # reconcile pipeline instead.
@@ -471,15 +492,24 @@ class Agent:
         await self.server.catalog.deregister(req)
 
     async def catalog_node_services(self, node: str):
-        _, services = self.server.store.node_services(node)
+        _, services = await self.server.catalog.node_services(
+            node, QueryOptions(allow_stale=True))
         return services
 
     async def catalog_node_checks(self, node: str):
-        _, checks = self.server.store.node_checks(node)
+        _, checks = await self.server.health.node_checks(
+            node, QueryOptions(allow_stale=True))
         return checks
 
     def cluster_size(self) -> int:
-        idx, nodes = self.server.store.nodes()
+        """aeScale input: LAN pool size when gossip is armed, else the
+        catalog (command/agent/util.go:27-37 uses LANMembers)."""
+        if self.lan_pool is not None:
+            return max(1, len(self.lan_pool.members()))
+        store = getattr(self.server, "fsm", None)
+        if store is None:
+            return 1
+        _, nodes = self.server.store.nodes()
         return max(1, len(nodes))
 
     # -- user events (user_event.go receive path) ---------------------------
@@ -730,7 +760,11 @@ class Agent:
         """Local checks plus the node's own serfHealth (which is
         leader-owned, so it lives in the catalog, not local state)."""
         out = {c.check_id: to_api(c) for c in self.local.checks.values()}
-        _, checks = self.server.store.node_checks(self.config.node_name)
+        try:
+            _, checks = await self.server.health.node_checks(
+                self.config.node_name, QueryOptions(allow_stale=True))
+        except Exception:
+            checks = []
         for c in checks:
             if c.check_id == SERF_CHECK_ID:
                 out.setdefault(c.check_id, to_api(c))

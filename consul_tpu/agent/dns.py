@@ -1,17 +1,21 @@
 """DNS interface: service discovery over port 8600.
 
 Parity target: ``command/agent/dns.go`` (683 LoC) — node lookups
-(``<node>.node.<dc>.consul`` → A), service lookups
-(``[tag.]<name>.service.<dc>.consul`` → A / SRV+A-extra), RFC2782
-(``_name._tag.service...``), right-to-left label dispatch (dns.go:272-340),
-critical-check filtering (dns.go:522-541), answer shuffling for load
-balancing (dns.go:543-549), and the UDP 3-answer cap (dns.go:18,502-508).
+(``<node>.node.<dc>.consul`` → A), PTR lookups (``in-addr.arpa``,
+dns.go:164-217), service lookups (``[tag.]<name>.service.<dc>.consul``
+→ A / SRV+A-extra), RFC2782 (``_name._tag.service...``), right-to-left
+label dispatch (dns.go:272-340), critical-check filtering
+(dns.go:522-541), answer shuffling for load balancing (dns.go:543-549),
+the UDP 3-answer cap (dns.go:18,502-508), recursor forwarding for
+out-of-domain names (dns.go:618-656), and the ``allow_stale`` /
+``max_stale`` re-query loop (dns.go:360-372).
 
 The reference rides miekg/dns; we carry a small wire codec instead —
-the subset Consul serves (A/SRV/ANY queries, no EDNS, no compression on
-write) is ~100 lines and keeps the agent dependency-free.  Recursor
-forwarding (dns.go:618-656) is configured but refused politely in this
-environment (zero egress).
+the subset Consul serves (A/SRV/PTR/ANY queries, no EDNS, no
+compression on write) is ~100 lines and keeps the agent
+dependency-free.  All catalog reads go through the endpoint layer (not
+the store), so the same server works for client-mode agents where the
+endpoints proxy over the RPC mesh.
 """
 
 from __future__ import annotations
@@ -22,7 +26,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from consul_tpu.structs.structs import HEALTH_CRITICAL
+from consul_tpu.structs.structs import HEALTH_CRITICAL, QueryOptions
 
 # Record types / classes
 QTYPE_A = 1
@@ -160,15 +164,34 @@ def srv_record(name: str, port: int, target: str, ttl: int) -> Record:
 class DNSServer:
     def __init__(self, agent, domain: str = "consul.",
                  node_ttl: float = 0.0, service_ttl: float = 0.0,
-                 only_passing: bool = False) -> None:
+                 only_passing: bool = False, allow_stale: bool = False,
+                 max_stale: float = 5.0,
+                 recursors: Optional[List[str]] = None) -> None:
         self.agent = agent
         self.domain = domain.rstrip(".").lower() + "."
         self.node_ttl = int(node_ttl)
         self.service_ttl = int(service_ttl)
         self.only_passing = only_passing
+        self.allow_stale = allow_stale
+        self.max_stale = max_stale
+        self.recursors = list(recursors or [])
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._tcp_server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[tuple] = None
+
+    # -- stale-tolerant catalog reads (dns.go:360-372) ----------------------
+
+    def _opts(self) -> QueryOptions:
+        return QueryOptions(allow_stale=self.allow_stale)
+
+    async def _requery(self, run):
+        """Run an endpoint read; when a stale answer is older than
+        max_stale, retry against the leader (the reference's re-query
+        loop flips AllowStale off for one attempt)."""
+        meta, out = await run(self._opts())
+        if self.allow_stale and meta.last_contact > self.max_stale:
+            meta, out = await run(QueryOptions(allow_stale=False))
+        return meta, out
 
     async def start(self, host: str = "127.0.0.1", port: int = 8600) -> None:
         loop = asyncio.get_running_loop()
@@ -209,10 +232,37 @@ class DNSServer:
             return build_response(query, RCODE_REFUSED, [])
         q = query.questions[0]
         name = q.name.lower()
+        if name.endswith(".in-addr.arpa."):
+            return await self._ptr_lookup(query, q, name)
         if not name.endswith(self.domain):
-            # Would recurse (dns.go:618-656); refused without recursors.
+            # Out-of-domain: forward to recursors when configured
+            # (handleRecurse, dns.go:618-656); refused otherwise.
+            if self.recursors:
+                resp = await self._recurse(buf)
+                if resp is not None:
+                    return resp
             return build_response(query, RCODE_REFUSED, [], authoritative=False)
         return await self._dispatch(query, q, name, udp)
+
+    async def _recurse(self, buf: bytes) -> Optional[bytes]:
+        """Forward the raw query to each recursor in order; first answer
+        wins (dns.go:618-656 tries recursors sequentially)."""
+        loop = asyncio.get_running_loop()
+        for rec in self.recursors:
+            host, _, port = rec.rpartition(":")
+            addr = (host or rec, int(port) if port else 53)
+            try:
+                fut: asyncio.Future = loop.create_future()
+                transport, _ = await loop.create_datagram_endpoint(
+                    lambda: _RecurseProtocol(fut), remote_addr=addr)
+                try:
+                    transport.sendto(buf)
+                    return await asyncio.wait_for(fut, 2.0)
+                finally:
+                    transport.close()
+            except (OSError, asyncio.TimeoutError):
+                continue
+        return None
 
     async def _dispatch(self, query: Message, q: Question, name: str,
                         udp: bool) -> bytes:
@@ -250,17 +300,53 @@ class DNSServer:
 
     async def _node_lookup(self, query: Message, q: Question, node: str,
                            udp: bool) -> bytes:
-        """A record for a node (dns.go:343-450)."""
-        _, addr = self.agent.server.store.get_node(node)
-        if addr is None:
+        """A record for a node (dns.go:343-450), via Internal.NodeInfo
+        so client-mode agents resolve over the mesh."""
+        async def run(opts):
+            return await self.agent.server.internal.node_info(node, opts)
+        try:
+            _, dump = await self._requery(run)
+        except Exception:
+            return build_response(query, RCODE_REFUSED, [],
+                                  authoritative=False)
+        if not dump:
             return build_response(query, RCODE_NXDOMAIN, [])
-        rec = a_record(q.name, addr, self.node_ttl)
+        rec = a_record(q.name, dump[0]["address"], self.node_ttl)
         return build_response(query, RCODE_OK, [rec] if rec else [])
+
+    async def _ptr_lookup(self, query: Message, q: Question,
+                          name: str) -> bytes:
+        """Reverse lookup: octets arrive reversed under in-addr.arpa
+        (handlePtr, dns.go:164-217)."""
+        octets = name[: -len(".in-addr.arpa.")].split(".")
+        addr = ".".join(reversed(octets))
+        async def run(opts):
+            return await self.agent.server.catalog.list_nodes(opts)
+        try:
+            _, nodes = await self._requery(run)
+        except Exception:
+            return build_response(query, RCODE_REFUSED, [],
+                                  authoritative=False)
+        dc = self.agent.server.config.datacenter
+        answers = [
+            Record(q.name, QTYPE_PTR, self.node_ttl,
+                   _write_name(f"{n.node}.node.{dc}.{self.domain}"))
+            for n in nodes if n.address == addr]
+        if not answers:
+            return build_response(query, RCODE_NXDOMAIN, [])
+        return build_response(query, RCODE_OK, answers)
 
     async def _service_lookup(self, query: Message, q: Question, service: str,
                               tag: str, udp: bool) -> bytes:
         """Service answers: filter, shuffle, cap (dns.go:452-616)."""
-        idx_unused, csns = self.agent.server.store.check_service_nodes(service, tag)
+        async def run(opts):
+            return await self.agent.server.health.service_nodes(
+                service, opts, tag)
+        try:
+            _, csns = await self._requery(run)
+        except Exception:
+            return build_response(query, RCODE_REFUSED, [],
+                                  authoritative=False)
         # Drop instances with any critical check (dns.go:522-541); with
         # only_passing, warning also drops.
         healthy = []
@@ -300,6 +386,21 @@ class DNSServer:
                     answers.append(rec)
         return build_response(query, RCODE_OK, answers, additional,
                               truncated=truncated)
+
+
+class _RecurseProtocol(asyncio.DatagramProtocol):
+    """One-shot upstream exchange for recursor forwarding."""
+
+    def __init__(self, fut: asyncio.Future) -> None:
+        self.fut = fut
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        if not self.fut.done():
+            self.fut.set_result(data)
+
+    def error_received(self, exc: Exception) -> None:
+        if not self.fut.done():
+            self.fut.set_exception(exc)
 
 
 class _UDPProtocol(asyncio.DatagramProtocol):

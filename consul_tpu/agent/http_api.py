@@ -278,7 +278,9 @@ class HTTPServer:
         meta, ns = await self.srv.catalog.node_services(request.match_info["node"], opts)
         if ns is None:
             return self._json(request, None, meta)
-        _, addr = self.srv.store.get_node(request.match_info["node"])
+        _, info = await self.srv.internal.node_info(
+            request.match_info["node"], opts)
+        addr = info[0]["address"] if info else ""
         out = {
             "Node": {"Node": request.match_info["node"], "Address": addr},
             "Services": {sid: to_api(svc) for sid, svc in ns.items()},

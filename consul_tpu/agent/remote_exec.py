@@ -56,7 +56,9 @@ class RemoteExecutor:
                 return
             # Verify the session is still alive — the orchestrator holds it
             # for the job's lifetime (remote_exec.go:76-90).
-            _, sess = self.agent.server.store.session_get(session)
+            from consul_tpu.structs.structs import QueryOptions
+            _, sess = await self.agent.server.session.get(
+                session, QueryOptions(allow_stale=True))
             if sess is None:
                 return
             spec_ent = await self._kv_get(f"{prefix}/{session}/job")

@@ -157,7 +157,8 @@ class RPCServer:
                 if dc and dc != self.srv.config.datacenter:
                     out = await self.srv.forward_dc(dc, method, body)
                     return {"Error": "", "Body": out}
-                stale = (body or {}).get("opts", {}).get("allow_stale", False)
+                stale = (body or {}).get("opts", {}).get("allow_stale", False) \
+                    or (body or {}).get("allow_stale", False)
                 if not self.srv.is_leader() and (kind == WRITE or not stale):
                     out = await self.srv.forward_leader(method, body)
                     return {"Error": "", "Body": out}
@@ -318,6 +319,13 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
         meta, out = await srv.session.node_sessions(body.get("node", ""),
                                                     _opts(body))
         return {"meta": _meta_wire(meta), "data": _w(out)}
+
+    @reg("Session.Renew", WRITE)
+    async def session_renew(srv, body):
+        # Renew must land on the leader — the TTL timer lives there
+        # (session_ttl.go ResetSessionTimer).
+        out = await srv.session.renew(body.get("id", ""))
+        return _w(out)
 
     @reg("ACL.Apply", WRITE)
     async def acl_apply(srv, body):

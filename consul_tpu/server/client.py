@@ -1,0 +1,401 @@
+"""Client agent core: no Raft, no state store — RPC forwarding only.
+
+Parity target: ``consul.Client`` (``consul/client.go:72``).  A client
+agent participates in LAN gossip for membership/failure detection and
+forwards every catalog/health/KV/session/ACL operation to a server
+over the pooled RPC mesh.  Server discovery comes from the LAN pool
+(consul/client.go:114-121 → nodeJoin/nodeFail handlers), and request
+routing keeps **last-server affinity**: the most recently working
+server is preferred until it fails, then another is picked at random
+(consul/client.go:333-366).
+
+The class mirrors the slice of :class:`~consul_tpu.server.server.Server`
+surface the agent's HTTP/DNS/IPC/anti-entropy layers touch, with each
+endpoint replaced by a remote proxy that speaks the same method names
+the RPC mesh registers (rpc/server.py handlers), so an ``Agent`` can
+hold either one without branching at every call site.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from consul_tpu.structs.structs import (
+    ACL, CheckServiceNode, DirEntry, HealthCheck, Node, NodeService,
+    QueryMeta, QueryOptions, ServiceNode, Session)
+
+
+@dataclass
+class ClientConfig:
+    node_name: str = "node1"
+    datacenter: str = "dc1"
+    domain: str = "consul."
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class NoServersError(Exception):
+    """No known consul servers (client.go "No known Consul servers")."""
+
+
+def _meta(d: Optional[Dict]) -> QueryMeta:
+    d = d or {}
+    return QueryMeta(index=d.get("index", 0),
+                     known_leader=d.get("known_leader", True),
+                     last_contact=d.get("last_contact", 0.0))
+
+
+def _opts_wire(opts: QueryOptions) -> Dict:
+    return {"token": opts.token, "datacenter": opts.datacenter,
+            "min_query_index": opts.min_query_index,
+            "max_query_time": opts.max_query_time,
+            "allow_stale": opts.allow_stale,
+            "require_consistent": opts.require_consistent}
+
+
+def _rpc_timeout(body: Any) -> float:
+    """Same budget rule as the server's forward path: blocking queries
+    get their wait budget plus grace (consul/rpc.go:29-41).  Options
+    ride either nested under ``opts`` or flat (KeyRequest subclasses
+    QueryOptions)."""
+    if not isinstance(body, dict):
+        return 30.0
+    opts = body.get("opts") or body
+    if opts.get("min_query_index"):
+        wait = float(opts.get("max_query_time") or 300.0)
+        return min(wait, 600.0) + 10.0
+    return 30.0
+
+
+class ConsulClient:
+    """The consul.Client role: LAN-member edge node that owns only a
+    connection pool and a server routing table."""
+
+    def __init__(self, config: Optional[ClientConfig] = None,
+                 tls_outgoing=None) -> None:
+        self.config = config or ClientConfig()
+        self.start_time = time.monotonic()
+        self.pool = None
+        self._tls_outgoing = tls_outgoing
+        # Server routing table, maintained by the agent's LAN event
+        # handler exactly as for a server (set_route/route_table.pop).
+        self.route_table: Dict[str, str] = {}
+        self._preferred: Optional[str] = None  # last-server affinity
+        self.keyring = None
+        self.event_sinks: List[Any] = []
+        self.user_event_broadcaster: Optional[Any] = None
+        self.lan_members_fn: Optional[Any] = None
+        self.remote_dcs: Dict[str, List[str]] = {}  # unused; IPC parity
+        self.reconcile_ch = None
+
+        self.status = _RemoteStatus(self)
+        self.catalog = _RemoteCatalog(self)
+        self.health = _RemoteHealth(self)
+        self.kvs = _RemoteKVS(self)
+        self.session = _RemoteSession(self)
+        self.acl = _RemoteACL(self)
+        self.internal = _RemoteInternal(self)
+
+    # -- lifecycle (Server-compatible surface) ------------------------------
+
+    async def start(self) -> None:
+        from consul_tpu.rpc.pool import ConnPool
+        self.pool = ConnPool(tls_wrap=self._tls_outgoing)
+
+    async def stop(self) -> None:
+        if self.pool is not None:
+            await self.pool.close()
+
+    def membership_notify(self, kind: str, member: Any) -> None:
+        """Clients have no leader loop; membership events only feed the
+        routing table (handled in the agent's LAN event hook)."""
+
+    def is_leader(self) -> bool:
+        return False
+
+    @property
+    def store(self):
+        raise NoServersError(
+            "client agents hold no local state store; use the endpoints")
+
+    # -- server selection + RPC (client.go:333-366) -------------------------
+
+    def set_route(self, node_id: str, addr: str) -> None:
+        self.route_table[node_id] = addr
+
+    def server_count(self) -> int:
+        return len(self.route_table)
+
+    def _pick(self) -> str:
+        if self._preferred and self._preferred in self.route_table.values():
+            return self._preferred
+        if not self.route_table:
+            raise NoServersError("No known Consul servers")
+        return random.choice(list(self.route_table.values()))
+
+    async def rpc(self, method: str, body: Any) -> Any:
+        """One RPC to some server: try the affine server first; on a
+        transport failure rotate through the rest before giving up.
+        Application errors (RPCError with a server-side message) are
+        NOT retried — the server answered."""
+        from consul_tpu.rpc.pool import RPCError
+        timeout = _rpc_timeout(body)
+        last_exc: Optional[Exception] = None
+        tried: set = set()
+        for _ in range(max(1, len(self.route_table))):
+            try:
+                addr = self._pick()
+            except NoServersError:
+                break
+            if addr in tried:
+                remaining = [a for a in self.route_table.values()
+                             if a not in tried]
+                if not remaining:
+                    break
+                addr = random.choice(remaining)
+            tried.add(addr)
+            try:
+                out = await self.pool.rpc(addr, method, body, timeout=timeout)
+                self._preferred = addr
+                return out
+            except RPCError:
+                self._preferred = addr  # server is healthy; error is ours
+                raise
+            except Exception as e:  # transport/mux/timeout: rotate
+                last_exc = e
+                if self._preferred == addr:
+                    self._preferred = None
+                continue
+        if last_exc is not None:
+            raise NoServersError(f"rpc failed on all servers: {last_exc}")
+        raise NoServersError("No known Consul servers")
+
+    # -- event plane --------------------------------------------------------
+
+    async def fire_user_event(self, event) -> None:
+        """Flood via our own LAN pool when armed (clients gossip too);
+        fall back to asking a server (Internal.EventFire)."""
+        if self.user_event_broadcaster is not None:
+            self.user_event_broadcaster(event)
+            return
+        await self.rpc("Internal.EventFire", event.to_wire())
+
+    def add_event_sink(self, sink) -> None:
+        self.event_sinks.append(sink)
+
+    # -- keyring (fanned out via a server's globalRPC) ----------------------
+
+    async def keyring_operation_local(self, op: str, key: str = "") -> Dict:
+        if self.keyring is None:
+            raise ValueError("keyring not configured "
+                             "(gossip encryption disabled)")
+        return self.keyring.operation(op, key, node=self.config.node_name)
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Dict[str, str]]:
+        """``consul info`` payload for a client (consul/client.go Stats)."""
+        return {
+            "consul": {
+                "server": "false",
+                "known_servers": str(len(self.route_table)),
+            },
+            "runtime": {
+                "uptime_s": str(int(time.monotonic() - self.start_time)),
+            },
+        }
+
+    def known_datacenters(self) -> List[str]:
+        return [self.config.datacenter]
+
+    def leader_addr(self) -> str:
+        return ""
+
+    def raft_peers(self) -> List[str]:
+        return []
+
+    async def resolve_token(self, token: str):
+        """ACL enforcement happens on the servers for every forwarded
+        request; the client does not resolve tokens locally (the
+        reference's client has no ACL cache either)."""
+        return None
+
+
+# -- remote endpoint proxies -------------------------------------------------
+# Each mirrors the in-process endpoint signatures (server/endpoints.py) and
+# speaks the registered RPC method names (rpc/server.py _build_handlers).
+
+
+class _Remote:
+    def __init__(self, client: ConsulClient) -> None:
+        self.c = client
+
+
+class _RemoteStatus(_Remote):
+    async def ping(self) -> bool:
+        return bool(await self.c.rpc("Status.Ping", {}))
+
+    async def leader(self) -> str:
+        return await self.c.rpc("Status.Leader", {})
+
+    async def peers(self) -> List[str]:
+        return await self.c.rpc("Status.Peers", {})
+
+
+class _RemoteCatalog(_Remote):
+    async def register(self, args) -> None:
+        await self.c.rpc("Catalog.Register", args.to_wire())
+
+    async def deregister(self, args) -> None:
+        await self.c.rpc("Catalog.Deregister", args.to_wire())
+
+    async def list_datacenters(self) -> List[str]:
+        return await self.c.rpc("Catalog.ListDatacenters", {})
+
+    async def list_nodes(self, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Catalog.ListNodes", {"opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [Node.from_wire(n)
+                                      for n in r.get("data") or []]
+
+    async def list_services(self, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Catalog.ListServices",
+                             {"opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), dict(r.get("data") or {})
+
+    async def service_nodes(self, service: str, opts: QueryOptions,
+                            tag: str = "") -> tuple:
+        r = await self.c.rpc("Catalog.ServiceNodes",
+                             {"service": service, "tag": tag,
+                              "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [ServiceNode.from_wire(n)
+                                      for n in r.get("data") or []]
+
+    async def node_services(self, node: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Catalog.NodeServices",
+                             {"node": node, "opts": _opts_wire(opts)})
+        data = r.get("data")
+        if data is None:
+            return _meta(r.get("meta")), None
+        return _meta(r.get("meta")), {
+            sid: NodeService.from_wire(s) for sid, s in data.items()}
+
+
+class _RemoteHealth(_Remote):
+    async def checks_in_state(self, state: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Health.ChecksInState",
+                             {"state": state, "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [HealthCheck.from_wire(x)
+                                      for x in r.get("data") or []]
+
+    async def node_checks(self, node: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Health.NodeChecks",
+                             {"node": node, "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [HealthCheck.from_wire(x)
+                                      for x in r.get("data") or []]
+
+    async def service_checks(self, service: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Health.ServiceChecks",
+                             {"service": service, "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [HealthCheck.from_wire(x)
+                                      for x in r.get("data") or []]
+
+    async def service_nodes(self, service: str, opts: QueryOptions,
+                            tag: str = "",
+                            passing_only: bool = False) -> tuple:
+        r = await self.c.rpc("Health.ServiceNodes",
+                             {"service": service, "tag": tag,
+                              "passing": passing_only,
+                              "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [CheckServiceNode.from_wire(x)
+                                      for x in r.get("data") or []]
+
+
+class _RemoteKVS(_Remote):
+    async def apply(self, args) -> bool:
+        return bool(await self.c.rpc("KVS.Apply", args.to_wire()))
+
+    async def get(self, args) -> tuple:
+        r = await self.c.rpc("KVS.Get", args.to_wire())
+        return _meta(r.get("meta")), [DirEntry.from_wire(e)
+                                      for e in r.get("data") or []]
+
+    async def list(self, args) -> tuple:
+        r = await self.c.rpc("KVS.List", args.to_wire())
+        return _meta(r.get("meta")), [DirEntry.from_wire(e)
+                                      for e in r.get("data") or []]
+
+    async def list_keys(self, args) -> tuple:
+        r = await self.c.rpc("KVS.ListKeys", args.to_wire())
+        return _meta(r.get("meta")), list(r.get("data") or [])
+
+
+class _RemoteSession(_Remote):
+    async def apply(self, args) -> str:
+        return await self.c.rpc("Session.Apply", args.to_wire())
+
+    async def get(self, sid: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Session.Get",
+                             {"id": sid, "opts": _opts_wire(opts)})
+        data = r.get("data")
+        return _meta(r.get("meta")), (Session.from_wire(data)
+                                      if data is not None else None)
+
+    async def list(self, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Session.List", {"opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [Session.from_wire(s)
+                                      for s in r.get("data") or []]
+
+    async def node_sessions(self, node: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Session.NodeSessions",
+                             {"node": node, "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [Session.from_wire(s)
+                                      for s in r.get("data") or []]
+
+    async def renew(self, sid: str) -> Optional[Session]:
+        data = await self.c.rpc("Session.Renew", {"id": sid})
+        return Session.from_wire(data) if data is not None else None
+
+
+class _RemoteACL(_Remote):
+    async def apply(self, args) -> str:
+        return await self.c.rpc("ACL.Apply", args.to_wire())
+
+    async def get(self, acl_id: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("ACL.Get",
+                             {"id": acl_id, "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [ACL.from_wire(a)
+                                      for a in r.get("data") or []]
+
+    async def list(self, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("ACL.List", {"opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [ACL.from_wire(a)
+                                      for a in r.get("data") or []]
+
+
+def _dump_row(d: Dict) -> Dict:
+    """Rehydrate one node-dump row (state/store.py _dump_one) so UI
+    summarizers can use attribute access on services/checks."""
+    return {
+        "node": d.get("node", ""),
+        "address": d.get("address", ""),
+        "services": [NodeService.from_wire(s) if isinstance(s, dict) else s
+                     for s in d.get("services") or []],
+        "checks": [HealthCheck.from_wire(c) if isinstance(c, dict) else c
+                   for c in d.get("checks") or []],
+    }
+
+
+class _RemoteInternal(_Remote):
+    async def node_info(self, node: str, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Internal.NodeInfo",
+                             {"node": node, "opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [_dump_row(d)
+                                      for d in r.get("data") or []]
+
+    async def node_dump(self, opts: QueryOptions) -> tuple:
+        r = await self.c.rpc("Internal.NodeDump", {"opts": _opts_wire(opts)})
+        return _meta(r.get("meta")), [_dump_row(d)
+                                      for d in r.get("data") or []]

@@ -372,9 +372,13 @@ class NotLeaderError(Exception):
 def _forward_timeout(body: Any) -> float:
     """RPC budget for a forwarded request: plain calls get a tight
     timeout; a blocking query gets its own wait budget (max 600s,
-    consul/rpc.go:29-41) plus grace for the server-side jitter."""
-    opts = (body or {}).get("opts") if isinstance(body, dict) else None
-    if opts and opts.get("min_query_index"):
+    consul/rpc.go:29-41) plus grace for the server-side jitter.
+    Options ride either nested under ``opts`` or flat (KeyRequest
+    subclasses QueryOptions)."""
+    if not isinstance(body, dict):
+        return 30.0
+    opts = body.get("opts") or body
+    if opts.get("min_query_index"):
         wait = float(opts.get("max_query_time") or 300.0)
         return min(wait, 600.0) + 10.0
     return 30.0

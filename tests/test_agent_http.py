@@ -15,7 +15,8 @@ import pytest
 
 from consul_tpu.agent import Agent, AgentConfig
 from consul_tpu.agent.dns import (
-    QTYPE_A, QTYPE_SRV, RCODE_NXDOMAIN, RCODE_OK, build_response, parse_message,
+    QTYPE_A, QTYPE_PTR, QTYPE_SRV, RCODE_NXDOMAIN, RCODE_OK, build_response,
+    parse_message,
 )
 
 
@@ -322,3 +323,58 @@ class TestDNS:
 
     def test_nxdomain(self, harness):
         assert dns_query(harness.dns_addr, "ghost.service.consul")["rcode"] == RCODE_NXDOMAIN
+
+    def test_ptr_lookup(self, harness, client):
+        """dig -x equivalent (handlePtr, dns.go:164-217)."""
+        client.put("/v1/catalog/register",
+                   json={"Node": "revnode", "Address": "10.11.12.13"})
+        r = dns_query(harness.dns_addr, "13.12.11.10.in-addr.arpa",
+                      QTYPE_PTR)
+        assert r["rcode"] == RCODE_OK and r["ancount"] == 1
+        # rdata carries the FQDN as DNS labels
+        assert b"\x07revnode\x04node" in r["raw"]
+
+    def test_ptr_unknown_address(self, harness):
+        # 203.0.113.0/24 is TEST-NET; no registered node has it (the
+        # agent itself sits on 127.0.0.1, which WOULD match)
+        r = dns_query(harness.dns_addr, "77.113.0.203.in-addr.arpa",
+                      QTYPE_PTR)
+        assert r["rcode"] == RCODE_NXDOMAIN
+
+    def test_out_of_domain_refused_without_recursors(self, harness):
+        from consul_tpu.agent.dns import RCODE_REFUSED
+        r = dns_query(harness.dns_addr, "example.com")
+        assert r["rcode"] == RCODE_REFUSED
+
+
+class TestDNSRecursor:
+    def test_forwards_to_recursor(self):
+        """Out-of-domain queries forward to the configured recursor and
+        its answer is relayed verbatim (handleRecurse, dns.go:618-656)."""
+        # fake upstream: answers any query with a fixed A record
+        upstream = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        upstream.bind(("127.0.0.1", 0))
+        upstream.settimeout(10)
+        up_addr = upstream.getsockname()
+
+        def serve_one():
+            buf, addr = upstream.recvfrom(4096)
+            msg = parse_message(buf)
+            from consul_tpu.agent.dns import Record, a_record
+            rec = a_record(msg.questions[0].name, "93.184.216.34", 60)
+            upstream.sendto(
+                build_response(msg, RCODE_OK, [rec], authoritative=False),
+                addr)
+
+        t = threading.Thread(target=serve_one, daemon=True)
+        t.start()
+        h = AgentHarness(AgentConfig(
+            http_port=0, dns_port=0,
+            recursors=[f"127.0.0.1:{up_addr[1]}"])).start()
+        try:
+            r = dns_query(h.dns_addr, "example.com")
+            assert r["rcode"] == RCODE_OK and r["ancount"] == 1
+            assert bytes([93, 184, 216, 34]) in r["raw"]
+        finally:
+            h.stop()
+            upstream.close()

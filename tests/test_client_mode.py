@@ -1,0 +1,274 @@
+"""Client agent mode: no Raft/state on the edge, RPC forwarding only.
+
+Round-3 acceptance tier (VERDICT item 5; reference shape:
+consul/client_test.go + command/agent tests with a client agent):
+server + client agents on loopback — clients discover servers from LAN
+gossip, forward KV/catalog/health traffic over the mesh with
+last-server affinity, sync their local services via anti-entropy RPCs,
+and resolve DNS through the same remote path.
+"""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.agent.agent import Agent, AgentConfig
+from consul_tpu.consensus.raft import RaftConfig
+from consul_tpu.server.client import ConsulClient, NoServersError
+from consul_tpu.structs.structs import (
+    DirEntry, HEALTH_PASSING, KVSOp, KVSRequest, KeyRequest, QueryOptions,
+    SERF_CHECK_ID)
+
+FAST_RAFT = RaftConfig(heartbeat_interval=0.03, election_timeout_min=0.06,
+                       election_timeout_max=0.12, rpc_timeout=0.5)
+TIMING = dict(probe_interval=0.05, probe_timeout=0.02, gossip_interval=0.02,
+              suspicion_mult=3.0, push_pull_interval=0.5, reap_interval=0.2)
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+async def _wait(cond, timeout=15.0, interval=0.03):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def _mk_server(name, seeds=(), expect=0, **kw):
+    cfg = AgentConfig(
+        node_name=name, server=True,
+        bootstrap=not expect, bootstrap_expect=expect,
+        rpc_mesh_port=0, http_port=0, dns_port=0,
+        serf_timing=dict(TIMING), raft_config=FAST_RAFT,
+        reconcile_interval=0.3, ae_interval=0.5, **kw)
+    a = Agent(cfg)
+    await a.start()
+    if seeds:
+        assert await a.join(list(seeds)) > 0
+    return a
+
+
+async def _mk_client(name, seeds, **kw):
+    cfg = AgentConfig(
+        node_name=name, server=False, bootstrap=False,
+        http_port=0, dns_port=0,
+        serf_timing=dict(TIMING), ae_interval=0.5, **kw)
+    a = Agent(cfg)
+    await a.start()
+    assert await a.join(list(seeds)) > 0
+    return a
+
+
+def _lan_seed(agent):
+    return [f"127.0.0.1:{agent.lan_pool.local_addr[1]}"]
+
+
+class TestClientCore:
+    def test_client_has_no_raft_or_store(self, loop):
+        async def body():
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+            assert isinstance(client.server, ConsulClient)
+            assert not hasattr(client.server, "raft")
+            with pytest.raises(NoServersError):
+                client.server.store  # noqa: B018 — the access raises
+            # discovery: the LAN pool taught the client where srv1's
+            # RPC endpoint lives (nodeJoin, consul/client.go:178-192)
+            assert await _wait(lambda: "srv1" in client.server.route_table)
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
+    def test_members_parity_and_tags(self, loop):
+        async def body():
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+            for a in (server, client):
+                assert await _wait(
+                    lambda a=a: len([m for m in a.lan_members()
+                                     if m["Status"] == "alive"]) == 2)
+            tags = {m["Name"]: m["Tags"] for m in server.lan_members()}
+            assert tags["srv1"]["role"] == "consul"
+            assert tags["cli1"]["role"] == "node"
+            # clients never appear in the WAN pool (consul/client.go has
+            # no WAN serf)
+            assert client.wan_members() == []
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
+    def test_kv_write_via_client_lands_on_server(self, loop):
+        async def body():
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+            await _wait(lambda: client.server.server_count() > 0)
+            ok = await client.server.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value,
+                dir_ent=DirEntry(key="edge", value=b"written-by-client")))
+            assert ok
+            _, ent = server.server.store.kvs_get("edge")
+            assert ent is not None and ent.value == b"written-by-client"
+            # read back through the client (leader-consistency path)
+            _, entries = await client.server.kvs.get(KeyRequest(key="edge"))
+            assert entries and entries[0].value == b"written-by-client"
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
+    def test_kv_via_client_http_surface(self, loop):
+        async def body():
+            import aiohttp
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+            await _wait(lambda: client.server.server_count() > 0)
+            host, port = client.http.addr
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"http://{host}:{port}/v1/kv/http-edge",
+                                 data=b"v1") as r:
+                    assert await r.json() is True
+                async with s.get(f"http://{host}:{port}/v1/kv/http-edge") as r:
+                    body_json = await r.json()
+                    assert body_json[0]["Key"] == "http-edge"
+                    assert r.headers.get("X-Consul-Index")
+            _, ent = server.server.store.kvs_get("http-edge")
+            assert ent is not None
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
+
+class TestClientCatalog:
+    def test_reconcile_registers_client_with_serf_health(self, loop):
+        async def body():
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+
+            def registered():
+                _, checks = server.server.store.node_checks("cli1")
+                return any(c.check_id == SERF_CHECK_ID
+                           and c.status == HEALTH_PASSING for c in checks)
+            assert await _wait(registered), \
+                "leader reconcile never registered the client node"
+            # but it is NOT a raft peer and has no consul service
+            assert "cli1" not in server.server.raft.peers
+            _, svcs = server.server.store.node_services("cli1")
+            assert not svcs or "consul" not in svcs
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
+    def test_client_service_syncs_via_anti_entropy(self, loop):
+        async def body():
+            from consul_tpu.structs.structs import NodeService
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+            await _wait(lambda: client.server.server_count() > 0)
+            await client.add_service(NodeService(id="web", service="web",
+                                                 port=80), [])
+
+            def in_catalog():
+                _, nodes = server.server.store.service_nodes("web", "")
+                return any(sn.node == "cli1" for sn in nodes)
+            assert await _wait(in_catalog), \
+                "client service never reached the server catalog"
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
+    def test_client_dns_resolves_over_mesh(self, loop):
+        async def body():
+            from consul_tpu.agent.dns import (
+                QTYPE_SRV, Message, Question, build_response, parse_message)
+            from consul_tpu.structs.structs import NodeService
+            import struct
+
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+            await _wait(lambda: client.server.server_count() > 0)
+            await client.add_service(NodeService(id="web", service="web",
+                                                 port=8080), [])
+
+            def in_catalog():
+                _, nodes = server.server.store.service_nodes("web", "")
+                return bool(nodes)
+            assert await _wait(in_catalog)
+
+            # raw SRV query against the CLIENT's DNS server
+            q = b"\x12\x34" + struct.pack("!HHHHH", 0x0100, 1, 0, 0, 0)
+            for label in ("web", "service", "consul"):
+                q += bytes([len(label)]) + label.encode()
+            q += b"\x00" + struct.pack("!HH", QTYPE_SRV, 1)
+            resp = await client.dns.handle(q, udp=True)
+            msg_id, flags, qd, an, ns, ar = struct.unpack("!HHHHHH",
+                                                          resp[:12])
+            assert an >= 1, "client DNS returned no SRV answers"
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
+
+class TestClientFailover:
+    def test_client_rotates_to_surviving_server(self, loop):
+        async def body():
+            # three servers so quorum (and the committed entry) survives
+            # the kill — with two, the dead leader takes quorum with it
+            s1 = await _mk_server("srv1", expect=3)
+            s2 = await _mk_server("srv2", seeds=_lan_seed(s1), expect=3)
+            s3 = await _mk_server("srv3", seeds=_lan_seed(s1), expect=3)
+            servers = [s1, s2, s3]
+            assert await _wait(lambda: any(a.server.is_leader()
+                                           for a in servers))
+            client = await _mk_client("cli1", _lan_seed(s1))
+            assert await _wait(
+                lambda: client.server.server_count() == 3)
+            # prime affinity
+            ok = await client.server.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="a", value=b"1")))
+            assert ok
+            affine = client.server._preferred
+            victim = next(a for a in servers
+                          if f":{a.server.rpc_server.addr[1]}" in affine)
+            survivors = [a for a in servers if a is not victim]
+            await victim.stop()
+            # next RPC must rotate to a survivor (client.go:352-366);
+            # retried because replication/election need a beat
+            async def read_ok():
+                try:
+                    _, entries = await client.server.kvs.get(
+                        KeyRequest(key="a", allow_stale=True))
+                    return bool(entries)
+                except NoServersError:
+                    return False
+            got = False
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if await read_ok():
+                    got = True
+                    break
+                await asyncio.sleep(0.1)
+            assert got, "client never failed over to a surviving server"
+            assert client.server._preferred != affine
+            for a in survivors:
+                await a.stop()
+            await client.stop()
+        loop.run_until_complete(body())
+
+    def test_client_with_no_servers_errors_loudly(self, loop):
+        async def body():
+            cfg = AgentConfig(node_name="lonely", server=False,
+                              bootstrap=False, http_port=0, dns_port=0,
+                              serf_timing=dict(TIMING))
+            a = Agent(cfg)
+            await a.start()
+            with pytest.raises(NoServersError):
+                await a.server.kvs.get(KeyRequest(key="x"))
+            await a.stop()
+        loop.run_until_complete(body())
