@@ -81,6 +81,9 @@ class AgentConfig:
     # an int (0 = ephemeral; production 8300) attaches the mesh listener.
     rpc_mesh_port: Optional[int] = None
     bootstrap_expect: int = 0      # self-assembly quorum size (serf.go:185)
+    # One-shot synchronous join at startup; failure is FATAL
+    # (startupJoin, command.go:692-701).  retry_join loops instead.
+    start_join: List[str] = field(default_factory=list)
     retry_join: List[str] = field(default_factory=list)
     retry_interval: float = 30.0
     retry_max: int = 0             # 0 = retry forever
@@ -247,6 +250,14 @@ class Agent:
             prev = _SP.previous_peers(os.path.join(snap_dir, "local.snapshot"))
             if prev:
                 await self.lan_pool.join(prev)
+        if self.config.start_join:
+            # Synchronous, fatal on total failure (startupJoin,
+            # command.go:692-701) — unlike the retry loop below.
+            n = await self.lan_pool.join(list(self.config.start_join))
+            if n == 0:
+                raise RuntimeError(
+                    f"agent: failed to join: {self.config.start_join}")
+            self.log.info(f"agent: (LAN) joined: {n}")
         if self.config.retry_join:
             self._retry_join_task = asyncio.get_event_loop().create_task(
                 self._retry_join_loop())
@@ -737,6 +748,14 @@ class Agent:
         router.add_put("/v1/agent/force-leave/{node}", h(self._force_leave))
         router.add_put("/v1/event/fire/{name}", h(self._event_fire))
         router.add_get("/v1/event/list", h(self._event_list))
+        router.add_get("/v1/agent/metrics", h(self._metrics))
+
+    async def _metrics(self, request):
+        """Telemetry snapshot: the inmem sink's interval ring (the
+        go-metrics dump the reference wires to SIGUSR1, served as
+        JSON)."""
+        from consul_tpu.utils.telemetry import metrics
+        return metrics.snapshot()
 
     async def _self(self, request):
         """/v1/agent/self (agent_endpoint.go:24-34): config + stats."""

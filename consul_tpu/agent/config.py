@@ -350,10 +350,23 @@ def to_agent_config(cfg: Config):
         dns_port=cfg.ports.dns,
         server=cfg.server,
         bootstrap=cfg.bootstrap or (cfg.server and not cfg.bootstrap_expect),
+        bootstrap_expect=cfg.bootstrap_expect,
         data_dir=cfg.data_dir,
         dns_only_passing=cfg.dns_config.only_passing,
+        dns_allow_stale=cfg.dns_config.allow_stale,
+        dns_max_stale=cfg.dns_config.max_stale,
+        recursors=list(cfg.recursors),
         node_ttl=cfg.dns_config.node_ttl,
         service_ttl=service_ttl,
+        # membership plane (PortConfig + retry-join, command/agent/config.go)
+        serf_lan_port=cfg.ports.serf_lan,
+        serf_wan_port=cfg.ports.serf_wan,
+        rpc_mesh_port=cfg.ports.server if cfg.server else None,
+        start_join=list(cfg.start_join),
+        retry_join=list(cfg.retry_join),
+        retry_interval=cfg.retry_interval,
+        retry_max=cfg.retry_max,
+        rejoin_after_leave=cfg.rejoin_after_leave,
         acl_datacenter=cfg.acl_datacenter,
         acl_ttl=cfg.acl_ttl,
         acl_default_policy=cfg.acl_default_policy,

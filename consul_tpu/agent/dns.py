@@ -26,7 +26,10 @@ import struct
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from time import monotonic as _monotonic
+
 from consul_tpu.structs.structs import HEALTH_CRITICAL, QueryOptions
+from consul_tpu.utils.telemetry import metrics
 
 # Record types / classes
 QTYPE_A = 1
@@ -232,8 +235,12 @@ class DNSServer:
             return build_response(query, RCODE_REFUSED, [])
         q = query.questions[0]
         name = q.name.lower()
+        t0 = _monotonic()
         if name.endswith(".in-addr.arpa."):
-            return await self._ptr_lookup(query, q, name)
+            try:
+                return await self._ptr_lookup(query, q, name)
+            finally:
+                metrics.measure_since(("consul", "dns", "ptr_query"), t0)
         if not name.endswith(self.domain):
             # Out-of-domain: forward to recursors when configured
             # (handleRecurse, dns.go:618-656); refused otherwise.
@@ -242,7 +249,10 @@ class DNSServer:
                 if resp is not None:
                     return resp
             return build_response(query, RCODE_REFUSED, [], authoritative=False)
-        return await self._dispatch(query, q, name, udp)
+        try:
+            return await self._dispatch(query, q, name, udp)
+        finally:
+            metrics.measure_since(("consul", "dns", "domain_query"), t0)
 
     async def _recurse(self, buf: bytes) -> Optional[bytes]:
         """Forward the raw query to each recursor in order; first answer

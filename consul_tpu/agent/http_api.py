@@ -146,9 +146,14 @@ class HTTPServer:
         self.agent.register_http_routes(r, h)
 
     def _handler(self, fn):
-        """wrap() (http.go:282-346): invoke, map errors, JSON-encode."""
+        """wrap() (http.go:282-346): invoke, time, map errors, JSON-encode."""
+        import time as _time
+
+        from consul_tpu.utils.telemetry import metrics
+        mkey = ("consul", "http", fn.__name__.lstrip("_"))
 
         async def handle(request: web.Request) -> web.Response:
+            t0 = _time.monotonic()
             try:
                 resp = await fn(request)
                 if isinstance(resp, web.Response):
@@ -162,6 +167,8 @@ class HTTPServer:
                 return web.Response(status=404, text=str(e))
             except Exception as e:  # 500 + message, as the reference wrap()
                 return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            finally:
+                metrics.measure_since(mkey, t0)
 
         return handle
 

@@ -93,6 +93,14 @@ def cmd_agent(args) -> int:
 
     agent = Agent(acfg)
 
+    # Telemetry sinks + SIGUSR1 dump (command.go:569-605): the inmem
+    # sink is always on; statsd/statsite attach from the config block.
+    from consul_tpu.utils.telemetry import metrics
+    metrics.configure(statsd_addr=cfg.telemetry.statsd_addr,
+                      statsite_addr=cfg.telemetry.statsite_addr,
+                      hostname=acfg.node_name,
+                      disable_hostname=cfg.telemetry.disable_hostname)
+
     async def serve() -> None:
         await agent.start()
         print(f"==> consul-tpu agent running! Node: {acfg.node_name}, "
@@ -144,9 +152,13 @@ def cmd_agent(args) -> int:
         def on_hup() -> None:
             loop.create_task(agent.reload())
 
+        def on_usr1() -> None:
+            print(metrics.dump(), file=sys.stderr, flush=True)
+
         loop.add_signal_handler(signal.SIGINT, on_term)
         loop.add_signal_handler(signal.SIGTERM, on_term)
         loop.add_signal_handler(signal.SIGHUP, on_hup)
+        loop.add_signal_handler(signal.SIGUSR1, on_usr1)
         leave_task = loop.create_task(agent.wait_for_leave())
         stop_task = loop.create_task(stop.wait())
         await asyncio.wait({leave_task, stop_task},

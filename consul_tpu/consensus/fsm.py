@@ -38,7 +38,15 @@ from consul_tpu.structs.structs import (
     TombstoneRequest,
 )
 
+from time import monotonic as _monotonic
+
+from consul_tpu.utils.telemetry import metrics
+
 IGNORE_UNKNOWN_FLAG = 0x80  # high bit: safe-to-skip for old versions (fsm.go:25-30)
+
+# Pre-built metric keys — apply() is the consistency hot loop.
+_FSM_METRIC_KEYS = {int(t): ("consul", "fsm", t.name.lower())
+                    for t in MessageType}
 
 # Snapshot record kinds (one byte each, mirroring fsm.go's persist order).
 SNAP_HEADER = "header"
@@ -78,7 +86,12 @@ class ConsulFSM:
             if msg_type & IGNORE_UNKNOWN_FLAG:
                 return None  # newer-version entry marked safe to ignore
             raise ValueError(f"failed to apply request: unknown type {msg_type}")
-        return handler(index, buf[1:])
+        # MeasureSince per message type (fsm.go:121 et al.)
+        t0 = _monotonic()
+        try:
+            return handler(index, buf[1:])
+        finally:
+            metrics.measure_since(_FSM_METRIC_KEYS[msg_type & ~IGNORE_UNKNOWN_FLAG], t0)
 
     def _apply_register(self, index: int, payload: bytes) -> Any:
         req = codec.decode_payload(payload, RegisterRequest)

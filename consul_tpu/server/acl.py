@@ -43,7 +43,9 @@ class ServerACLResolver:
     async def _fault(self, token_id: str):
         """FaultFunc: (parent, rules) for a token id.  Auth DC serves the
         state store (consul/acl.go:150-172); other DCs fetch the policy
-        from the auth DC."""
+        from the auth DC.  Counted (MeasureSince at consul/acl.go:49)."""
+        from consul_tpu.utils.telemetry import metrics
+        metrics.incr_counter(("consul", "acl", "fault"))
         if self.is_auth_dc:
             _, acl = self.srv.store.acl_get(token_id)
             if acl is None:

@@ -81,6 +81,10 @@ async def blocking_query(
         await run()
         return
 
+    # Counted once per long-poll entry (consul/rpc.go:386).
+    from consul_tpu.utils.telemetry import metrics
+    metrics.incr_counter(("consul", "rpc", "query"))
+
     deadline = asyncio.get_running_loop().time() + clamp_wait(opts.max_query_time)
     loop = asyncio.get_running_loop()
     waiter = AsyncWaiter(loop)

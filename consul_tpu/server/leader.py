@@ -126,21 +126,32 @@ class LeaderDuties:
         loop = asyncio.get_event_loop()
         self._session_timers[sid] = loop.call_later(
             delay, lambda: loop.create_task(self._invalidate_session(sid)))
+        self._update_ttl_gauge()
 
     def clear_session_timer(self, sid: str) -> None:
         h = self._session_timers.pop(sid, None)
         if h is not None:
             h.cancel()
+        self._update_ttl_gauge()
+
+    def _update_ttl_gauge(self) -> None:
+        """Active-timer gauge (the updateSessionTimers loop,
+        session_ttl.go:150-163, folded into each mutation)."""
+        from consul_tpu.utils.telemetry import metrics
+        metrics.set_gauge(("consul", "session_ttl", "active"),
+                          float(len(self._session_timers)))
 
     def clear_all_session_timers(self) -> None:
         for h in self._session_timers.values():
             h.cancel()
         self._session_timers.clear()
+        self._update_ttl_gauge()
 
     async def _invalidate_session(self, sid: str) -> None:
         """TTL expired → destroy through Raft (invalidateSession,
         session_ttl.go:120-146)."""
         self._session_timers.pop(sid, None)
+        self._update_ttl_gauge()
         if not self._active:
             return
         req = SessionRequest(op=SessionOp.DESTROY.value,
@@ -211,16 +222,21 @@ class LeaderDuties:
 
     async def _reconcile_member(self, member) -> None:
         """Dispatch one member to its state handler (reconcileMember,
-        leader.go:310-339)."""
+        leader.go:310-339; MeasureSince at leader.go:316)."""
         from consul_tpu.membership.swim import (
             STATE_ALIVE, STATE_DEAD, STATE_LEFT, STATE_SUSPECT)
-        state = getattr(member, "state", STATE_ALIVE)
-        if state in (STATE_ALIVE, STATE_SUSPECT):
-            await self._handle_alive(member)
-        elif state == STATE_DEAD:
-            await self._handle_failed(member)
-        elif state == STATE_LEFT:
-            await self._handle_left(member.name)
+        from consul_tpu.utils.telemetry import metrics
+        t0 = time.monotonic()
+        try:
+            state = getattr(member, "state", STATE_ALIVE)
+            if state in (STATE_ALIVE, STATE_SUSPECT):
+                await self._handle_alive(member)
+            elif state == STATE_DEAD:
+                await self._handle_failed(member)
+            elif state == STATE_LEFT:
+                await self._handle_left(member.name)
+        finally:
+            metrics.measure_since(("consul", "leader", "reconcileMember"), t0)
 
     async def _handle_alive(self, member) -> None:
         """handleAliveMember (leader.go:354-421): ensure the catalog has

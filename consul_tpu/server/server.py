@@ -199,6 +199,8 @@ class Server:
         """Apply a write through consensus (consul/rpc.go:280-297).
         Non-leaders with a route to the leader forward the encoded entry
         (the forwardLeader hop of rpc.go:204)."""
+        from consul_tpu.utils.telemetry import metrics
+        metrics.incr_counter(("consul", "raft", "apply"))
         buf = codec.encode(int(msg_type), req)
         if len(buf) > MAX_RAFT_ENTRY_WARN:
             # Reference warns and proceeds (rpc.go:42-44).

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (telemetry, small helpers)."""
+
+from consul_tpu.utils.telemetry import Metrics, metrics
+
+__all__ = ["Metrics", "metrics"]

@@ -1,0 +1,224 @@
+"""In-process metrics: counters/gauges/timers with interval aggregation.
+
+Parity target: the reference's go-metrics wiring
+(``command/agent/command.go:569-605``) — an in-memory sink aggregating
+into fixed intervals (go-metrics ``NewInmemSink(10s, 1min)``), dumped
+on **SIGUSR1**, optionally fanned out to a statsite/statsd UDP
+collector, with ``MeasureSince`` calls at every hot point (e.g. raft
+apply ``consul/fsm.go:121``, blocking queries ``consul/rpc.go:386``,
+leader reconcile ``consul/leader.go:243,316``, ACL faults
+``consul/acl.go:49``).
+
+Design: one process-global :class:`Metrics` registry (``metrics``)
+that call sites hit directly — no plumbing through constructors, same
+as go-metrics' package-global.  Sinks are attached at agent startup
+from the ``telemetry`` config block.  All paths are non-blocking: the
+statsd sink is a fire-and-forget UDP datagram per emission, and the
+inmem sink is plain dict math (the agent is single-threaded asyncio;
+the lock is for the check-runner thread pool).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_INTERVAL_S = 10.0
+DEFAULT_RETAIN = 6  # 6 x 10s = one minute of history (go-metrics default)
+
+
+class AggregateSample:
+    """Running aggregate of one timer/sample series inside an interval."""
+
+    __slots__ = ("count", "sum", "min", "max", "sumsq")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.sumsq = 0.0
+
+    def ingest(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        self.sumsq += v * v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def wire(self) -> Dict[str, float]:
+        return {"count": self.count, "sum": round(self.sum, 3),
+                "min": round(self.min, 3) if self.count else 0.0,
+                "max": round(self.max, 3) if self.count else 0.0,
+                "mean": round(self.mean, 3)}
+
+
+class _Interval:
+    __slots__ = ("start", "counters", "gauges", "samples")
+
+    def __init__(self, start: float) -> None:
+        self.start = start
+        self.counters: Dict[str, AggregateSample] = {}
+        self.gauges: Dict[str, float] = {}
+        self.samples: Dict[str, AggregateSample] = {}
+
+
+class InmemSink:
+    """Fixed-width interval ring (NewInmemSink role)."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 retain: int = DEFAULT_RETAIN) -> None:
+        self.interval_s = interval_s
+        self.retain = retain
+        self._intervals: List[_Interval] = []
+
+    def _bucket(self, now: float) -> _Interval:
+        start = now - (now % self.interval_s)
+        if not self._intervals or self._intervals[-1].start != start:
+            self._intervals.append(_Interval(start))
+            if len(self._intervals) > self.retain:
+                del self._intervals[: len(self._intervals) - self.retain]
+        return self._intervals[-1]
+
+    def incr_counter(self, name: str, n: float, now: float) -> None:
+        b = self._bucket(now)
+        b.counters.setdefault(name, AggregateSample()).ingest(n)
+
+    def set_gauge(self, name: str, v: float, now: float) -> None:
+        self._bucket(now).gauges[name] = v
+
+    def add_sample(self, name: str, v: float, now: float) -> None:
+        b = self._bucket(now)
+        b.samples.setdefault(name, AggregateSample()).ingest(v)
+
+    def snapshot(self) -> List[Dict]:
+        """JSON-able interval dump (/v1/agent/metrics shape)."""
+        out = []
+        for iv in self._intervals:
+            out.append({
+                "Interval": iv.start,
+                "Counters": {k: v.wire() for k, v in sorted(iv.counters.items())},
+                "Gauges": {k: round(v, 3) for k, v in sorted(iv.gauges.items())},
+                "Samples": {k: v.wire() for k, v in sorted(iv.samples.items())},
+            })
+        return out
+
+    def dump(self) -> str:
+        """Human dump, one interval per block (the SIGUSR1 format)."""
+        lines: List[str] = []
+        for iv in self._intervals:
+            ts = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(iv.start))
+            lines.append(f"[{ts}]")
+            for k, v in sorted(iv.gauges.items()):
+                lines.append(f"  [G] '{k}': {v:.3f}")
+            for k, s in sorted(iv.counters.items()):
+                lines.append(f"  [C] '{k}': count={s.count} sum={s.sum:.3f}")
+            for k, s in sorted(iv.samples.items()):
+                lines.append(f"  [S] '{k}': count={s.count} "
+                             f"min={s.min:.3f} mean={s.mean:.3f} "
+                             f"max={s.max:.3f}")
+        return "\n".join(lines)
+
+
+class StatsdSink:
+    """Fire-and-forget UDP `name:value|type` datagrams (statsd line
+    protocol; the statsite sink speaks the same format)."""
+
+    def __init__(self, addr: str) -> None:
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or addr, int(port) if port else 8125)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+
+    def _send(self, line: str) -> None:
+        try:
+            self._sock.sendto(line.encode(), self._addr)
+        except OSError:
+            pass  # metrics must never take the agent down
+
+    def incr_counter(self, name: str, n: float, now: float) -> None:
+        self._send(f"{name}:{n:g}|c")
+
+    def set_gauge(self, name: str, v: float, now: float) -> None:
+        self._send(f"{name}:{v:g}|g")
+
+    def add_sample(self, name: str, v: float, now: float) -> None:
+        self._send(f"{name}:{v:g}|ms")
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class Metrics:
+    """The registry call sites hit.  Key parts are dot-joined; when a
+    hostname is configured (and not disabled) it is interposed after
+    the service name, matching go-metrics' HostName behavior."""
+
+    def __init__(self) -> None:
+        self.inmem = InmemSink()
+        self._sinks: List[object] = [self.inmem]
+        self._lock = threading.Lock()
+        self.hostname = ""
+
+    def configure(self, statsd_addr: str = "", statsite_addr: str = "",
+                  hostname: str = "", disable_hostname: bool = False) -> None:
+        """Apply the agent's telemetry config block
+        (command/agent/command.go:569-605)."""
+        with self._lock:
+            self.hostname = "" if disable_hostname else hostname
+            for s in self._sinks[1:]:
+                if hasattr(s, "close"):
+                    s.close()
+            self._sinks = [self.inmem]
+            for addr in (statsd_addr, statsite_addr):
+                if addr:
+                    self._sinks.append(StatsdSink(addr))
+
+    def _name(self, key: Tuple[str, ...]) -> str:
+        parts = list(key)
+        if self.hostname and len(parts) > 1:
+            parts = [parts[0], self.hostname, *parts[1:]]
+        return ".".join(parts)
+
+    def incr_counter(self, key: Tuple[str, ...], n: float = 1.0) -> None:
+        name, now = self._name(key), time.time()
+        with self._lock:
+            for s in self._sinks:
+                s.incr_counter(name, n, now)
+
+    def set_gauge(self, key: Tuple[str, ...], v: float) -> None:
+        name, now = self._name(key), time.time()
+        with self._lock:
+            for s in self._sinks:
+                s.set_gauge(name, v, now)
+
+    def add_sample(self, key: Tuple[str, ...], v: float) -> None:
+        name, now = self._name(key), time.time()
+        with self._lock:
+            for s in self._sinks:
+                s.add_sample(name, v, now)
+
+    def measure_since(self, key: Tuple[str, ...], t0: float) -> None:
+        """Record elapsed milliseconds since ``t0`` (a time.monotonic()
+        stamp) — the MeasureSince idiom."""
+        self.add_sample(key, (time.monotonic() - t0) * 1000.0)
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            return self.inmem.snapshot()
+
+    def dump(self) -> str:
+        with self._lock:
+            return self.inmem.dump()
+
+
+# The process-global registry, mirroring go-metrics' package global.
+metrics = Metrics()

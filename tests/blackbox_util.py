@@ -1,0 +1,194 @@
+"""Fork/exec test harness: run the REAL consul-tpu agent binary.
+
+Parity target: ``testutil/server.go:85-142`` — TestServer writes a JSON
+config with a per-instance port block (20000+ range), fork/execs the
+real binary found on PATH, and waits for the HTTP API / leader before
+handing control to the test.  Here the "binary" is
+``python -m consul_tpu.cli.main agent`` run as a subprocess, which
+exercises the full stack end-to-end: config files → CLI → agent →
+gossip/raft/RPC mesh → HTTP/DNS/IPC listeners.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+_PORT_BASE = 21000
+_PORT_STRIDE = 10
+_next_idx = [int(os.environ.get("PYTEST_XDIST_WORKER", "gw0")[2:] or 0) * 40]
+
+
+def _port_block() -> Dict[str, int]:
+    """Sequential per-instance port blocks (server.go:85-92)."""
+    idx = _next_idx[0]
+    _next_idx[0] += 1
+    base = _PORT_BASE + idx * _PORT_STRIDE
+    return {"http": base, "dns": base + 1, "rpc": base + 2,
+            "serf_lan": base + 3, "serf_wan": base + 4, "server": base + 5}
+
+
+class TestServer:
+    """One forked agent.  Not a pytest class (helper)."""
+
+    __test__ = False  # keep pytest from collecting it
+
+    def __init__(self, name: str = "bb1", server: bool = True,
+                 bootstrap: bool = True, bootstrap_expect: int = 0,
+                 retry_join: Optional[List[str]] = None,
+                 config_extra: Optional[Dict[str, Any]] = None) -> None:
+        self.name = name
+        self.ports = _port_block()
+        self.tmp = tempfile.TemporaryDirectory(prefix=f"consul-tpu-{name}-")
+        cfg: Dict[str, Any] = {
+            "node_name": name,
+            "server": server,
+            "bootstrap": bootstrap and not bootstrap_expect,
+            "bootstrap_expect": bootstrap_expect,
+            "bind_addr": "127.0.0.1",
+            "client_addr": "127.0.0.1",
+            "data_dir": os.path.join(self.tmp.name, "data"),
+            "ports": self.ports,
+            "log_level": "WARN",
+        }
+        if retry_join:
+            cfg["retry_join"] = retry_join
+            cfg["retry_interval"] = "1s"
+        cfg.update(config_extra or {})
+        self.config_path = os.path.join(self.tmp.name, "config.json")
+        with open(self.config_path, "w") as f:
+            json.dump(cfg, f)
+        self.proc: Optional[subprocess.Popen] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "TestServer":
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # host plane must not dial TPU
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli.main", "agent",
+             "-config-file", self.config_path],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        return self
+
+    def stop(self) -> None:
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(5)
+        self.tmp.cleanup()
+
+    def output(self) -> str:
+        """Diagnostic dump: kills the agent if still running (reading a
+        live process's pipe to EOF would block forever)."""
+        if self.proc is None or self.proc.stdout is None:
+            return ""
+        if self.proc.poll() is None:
+            self.proc.kill()
+        try:
+            out, _ = self.proc.communicate(timeout=5)
+            return out.decode(errors="replace")
+        except Exception:
+            return ""
+
+    # -- readiness (testutil/wait.go WaitForResult/WaitForLeader) ------------
+
+    def wait_for_api(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc is not None and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"agent {self.name} exited rc={self.proc.returncode}")
+            try:
+                self.http_get("/v1/agent/self")
+                return
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.1)
+        raise TimeoutError(f"agent {self.name} HTTP API never came up")
+
+    def wait_for_leader(self, timeout: float = 30.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                leader = self.http_get("/v1/status/leader")
+                if leader:
+                    return leader
+            except (urllib.error.URLError, ConnectionError, OSError):
+                pass
+            time.sleep(0.1)
+        raise TimeoutError(f"agent {self.name} never saw a leader")
+
+    # -- HTTP helpers (server.go HTTP seeding helpers) -----------------------
+
+    def _url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.ports['http']}{path}"
+
+    def http_get(self, path: str) -> Any:
+        with urllib.request.urlopen(self._url(path), timeout=10) as r:
+            body = r.read()
+        return json.loads(body) if body else None
+
+    def http_put(self, path: str, data: Any = None) -> Any:
+        if isinstance(data, (dict, list)):
+            data = json.dumps(data).encode()
+        req = urllib.request.Request(self._url(path), data=data or b"",
+                                     method="PUT")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = r.read()
+        return json.loads(body) if body else None
+
+    def http_delete(self, path: str) -> Any:
+        req = urllib.request.Request(self._url(path), method="DELETE")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = r.read()
+        return json.loads(body) if body else None
+
+    # -- DNS helper ----------------------------------------------------------
+
+    def dns_query(self, name: str, qtype: int = 1) -> Dict[str, Any]:
+        q = bytearray(struct.pack("!HHHHHH", 0x4242, 0x0100, 1, 0, 0, 0))
+        for label in name.rstrip(".").split("."):
+            q.append(len(label))
+            q += label.encode()
+        q.append(0)
+        q += struct.pack("!HH", qtype, 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(5)
+        s.sendto(bytes(q), ("127.0.0.1", self.ports["dns"]))
+        buf, _ = s.recvfrom(4096)
+        s.close()
+        _, flags, _, an, _, ar = struct.unpack("!HHHHHH", buf[:12])
+        return {"rcode": flags & 0xF, "ancount": an, "arcount": ar, "raw": buf}
+
+    # -- CLI-against-IPC helper (the `consul members` path) ------------------
+
+    def cli(self, *args: str, timeout: float = 15.0) -> subprocess.CompletedProcess:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        return subprocess.run(
+            [sys.executable, "-m", "consul_tpu.cli.main", *args,
+             "-rpc-addr", f"127.0.0.1:{self.ports['rpc']}"],
+            capture_output=True, text=True, timeout=timeout, env=env)
+
+    @property
+    def lan_addr(self) -> str:
+        return f"127.0.0.1:{self.ports['serf_lan']}"

@@ -1,0 +1,127 @@
+"""Black-box tier: real forked agents driven over HTTP/DNS/IPC.
+
+Parity target: the reference's ``api/*_test.go`` + ``testutil``
+fork/exec tier (testutil/server.go:85-142) — nothing here touches
+in-process objects; every assertion goes through a public wire surface
+of a subprocess running the real CLI daemon.
+"""
+
+import base64
+import time
+
+import pytest
+
+from blackbox_util import TestServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = TestServer("bb-single").start()
+    try:
+        s.wait_for_api()
+        s.wait_for_leader()
+    except Exception:
+        print(s.output())
+        s.stop()
+        raise
+    yield s
+    s.stop()
+
+
+class TestSingleAgentBlackBox:
+    def test_self_and_leader(self, server):
+        me = server.http_get("/v1/agent/self")
+        assert me["Config"]["NodeName"] == "bb-single"
+        assert server.http_get("/v1/status/leader") == "bb-single"
+
+    def test_kv_roundtrip(self, server):
+        assert server.http_put("/v1/kv/bb/key", b"hello") is True
+        got = server.http_get("/v1/kv/bb/key")
+        assert base64.b64decode(got[0]["Value"]) == b"hello"
+        assert server.http_delete("/v1/kv/bb/key") is True
+
+    def test_service_and_dns(self, server):
+        server.http_put("/v1/agent/service/register",
+                        {"Name": "web", "Port": 8080})
+        # anti-entropy pushes it to the catalog; poll the public surface
+        deadline = time.monotonic() + 15
+        nodes = []
+        while time.monotonic() < deadline:
+            nodes = server.http_get("/v1/catalog/service/web")
+            if nodes:
+                break
+            time.sleep(0.2)
+        assert nodes and nodes[0]["Node"] == "bb-single"
+        r = server.dns_query("web.service.consul", qtype=33)  # SRV
+        assert r["rcode"] == 0 and r["ancount"] == 1
+
+    def test_cli_members_over_ipc(self, server):
+        out = server.cli("members")
+        assert out.returncode == 0, out.stderr
+        assert "bb-single" in out.stdout
+        assert "alive" in out.stdout
+
+    def test_cli_info_over_ipc(self, server):
+        out = server.cli("info")
+        assert out.returncode == 0, out.stderr
+        assert "raft" in out.stdout
+
+    def test_metrics_endpoint(self, server):
+        snap = server.http_get("/v1/agent/metrics")
+        merged = {}
+        for iv in snap:
+            merged.update(iv["Counters"])
+            merged.update(iv["Samples"])
+        assert merged, "no metrics recorded"
+
+
+class TestClusterBlackBox:
+    def test_three_forked_servers_form_a_cluster(self):
+        """BASELINE config #1 shape, fully black-box: three real agent
+        processes join over loopback gossip, elect one leader, replicate
+        a KV write, and report full membership over the CLI."""
+        s1 = TestServer("bb-c1", bootstrap=False, bootstrap_expect=3).start()
+        servers = [s1]
+        try:
+            s1.wait_for_api()
+            for name in ("bb-c2", "bb-c3"):
+                s = TestServer(name, bootstrap=False, bootstrap_expect=3,
+                               retry_join=[s1.lan_addr]).start()
+                servers.append(s)
+                s.wait_for_api()
+            for s in servers:
+                s.wait_for_leader(60)
+            # one leader, agreed on by everyone
+            leaders = {s.http_get("/v1/status/leader") for s in servers}
+            assert len(leaders) == 1
+            # members parity over the CLI (consul members output shape)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                out = servers[0].cli("members")
+                if all(n in out.stdout
+                       for n in ("bb-c1", "bb-c2", "bb-c3")):
+                    break
+                time.sleep(0.3)
+            assert all(n in out.stdout for n in ("bb-c1", "bb-c2", "bb-c3")), \
+                out.stdout
+            # a write via one agent is readable via another
+            assert servers[1].http_put("/v1/kv/cluster/x", b"42") is True
+            deadline = time.monotonic() + 15
+            got = None
+            while time.monotonic() < deadline:
+                try:
+                    got = servers[2].http_get("/v1/kv/cluster/x")
+                    if got:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert got and base64.b64decode(got[0]["Value"]) == b"42"
+        except Exception:
+            for s in servers:
+                print(f"--- {s.name} ---")
+                print(s.output()[-2000:])
+            raise
+        finally:
+            for s in servers:
+                s.stop()

@@ -1,0 +1,131 @@
+"""Telemetry: inmem interval aggregation, statsd emission, HTTP surface.
+
+Reference shape: go-metrics wiring at command/agent/command.go:569-605
+(inmem sink + SIGUSR1 dump + statsd fanout) and MeasureSince sprinkle
+points (consul/fsm.go:121, consul/rpc.go:386)."""
+
+import socket
+import time
+
+from consul_tpu.utils.telemetry import InmemSink, Metrics, metrics
+
+
+class TestInmemSink:
+    def test_counter_aggregates_within_interval(self):
+        s = InmemSink(interval_s=10.0)
+        now = 1000.0
+        s.incr_counter("consul.raft.apply", 1, now)
+        s.incr_counter("consul.raft.apply", 1, now + 1)
+        s.incr_counter("consul.raft.apply", 3, now + 2)
+        snap = s.snapshot()
+        assert len(snap) == 1
+        c = snap[0]["Counters"]["consul.raft.apply"]
+        assert c["count"] == 3 and c["sum"] == 5
+
+    def test_intervals_roll_and_retain(self):
+        s = InmemSink(interval_s=10.0, retain=3)
+        for i in range(6):
+            s.incr_counter("x", 1, 1000.0 + i * 10)
+        snap = s.snapshot()
+        assert len(snap) == 3  # only the newest `retain` kept
+        assert snap[-1]["Interval"] == 1050.0
+
+    def test_sample_min_max_mean(self):
+        s = InmemSink()
+        now = time.time()
+        for v in (2.0, 8.0, 5.0):
+            s.add_sample("consul.fsm.kvs", v, now)
+        w = s.snapshot()[-1]["Samples"]["consul.fsm.kvs"]
+        assert w["min"] == 2.0 and w["max"] == 8.0 and w["mean"] == 5.0
+
+    def test_gauge_last_write_wins(self):
+        s = InmemSink()
+        now = time.time()
+        s.set_gauge("consul.session_ttl.active", 3, now)
+        s.set_gauge("consul.session_ttl.active", 7, now)
+        assert s.snapshot()[-1]["Gauges"]["consul.session_ttl.active"] == 7
+
+    def test_dump_format(self):
+        s = InmemSink()
+        now = time.time()
+        s.incr_counter("c1", 2, now)
+        s.set_gauge("g1", 1.5, now)
+        s.add_sample("s1", 4.0, now)
+        text = s.dump()
+        assert "[C] 'c1': count=1 sum=2.000" in text
+        assert "[G] 'g1': 1.500" in text
+        assert "[S] 's1':" in text
+
+
+class TestMetricsRegistry:
+    def test_hostname_interposed(self):
+        m = Metrics()
+        m.configure(hostname="node9")
+        m.incr_counter(("consul", "raft", "apply"))
+        snap = m.snapshot()
+        assert "consul.node9.raft.apply" in snap[-1]["Counters"]
+
+    def test_hostname_disabled(self):
+        m = Metrics()
+        m.configure(hostname="node9", disable_hostname=True)
+        m.incr_counter(("consul", "raft", "apply"))
+        assert "consul.raft.apply" in m.snapshot()[-1]["Counters"]
+
+    def test_measure_since_records_ms(self):
+        m = Metrics()
+        t0 = time.monotonic() - 0.05  # pretend 50ms elapsed
+        m.measure_since(("op",), t0)
+        w = m.snapshot()[-1]["Samples"]["op"]
+        assert 40.0 <= w["mean"] <= 500.0
+
+    def test_statsd_sink_emits_udp_lines(self):
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(5)
+        port = rx.getsockname()[1]
+        m = Metrics()
+        m.configure(statsd_addr=f"127.0.0.1:{port}")
+        m.incr_counter(("consul", "rpc", "query"), 2)
+        m.set_gauge(("consul", "sessions"), 4.5)
+        m.add_sample(("consul", "fsm", "kvs"), 1.25)
+        lines = set()
+        for _ in range(3):
+            lines.add(rx.recvfrom(1024)[0].decode())
+        rx.close()
+        assert "consul.rpc.query:2|c" in lines
+        assert "consul.sessions:4.5|g" in lines
+        assert "consul.fsm.kvs:1.25|ms" in lines
+
+
+class TestAgentIntegration:
+    def test_hot_paths_emit_and_http_serves_snapshot(self):
+        """Drive KV writes + a DNS query through a live agent, then read
+        /v1/agent/metrics and see fsm/raft/http/dns series populated."""
+        import struct
+
+        import httpx
+
+        from test_agent_http import AgentHarness, dns_query
+
+        h = AgentHarness().start()
+        try:
+            base = h.http_addr
+            with httpx.Client(base_url=base, timeout=10) as c:
+                for i in range(3):
+                    assert c.put(f"/v1/kv/tm{i}", content=b"v").json() is True
+                c.put("/v1/catalog/register",
+                      json={"Node": "tmnode", "Address": "10.0.0.9"})
+                dns_query(h.dns_addr, "tmnode.node.consul")
+                snap = c.get("/v1/agent/metrics").json()
+            merged_counters = {}
+            merged_samples = {}
+            for iv in snap:
+                merged_counters.update(iv["Counters"])
+                merged_samples.update(iv["Samples"])
+            assert any(k.endswith("raft.apply") for k in merged_counters), \
+                merged_counters
+            assert any(".fsm.kvs" in k for k in merged_samples), merged_samples
+            assert any(".http." in k for k in merged_samples)
+            assert any(".dns.domain_query" in k for k in merged_samples)
+        finally:
+            h.stop()
