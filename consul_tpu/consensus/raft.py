@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -178,6 +179,9 @@ class RaftNode:
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
         self._pending: Dict[int, asyncio.Future] = {}
+        # Staleness metadata: monotonic stamp of the last message from a
+        # live leader (feeds QueryMeta.last_contact, consul/rpc.go:406).
+        self.last_leader_contact: float = time.monotonic()
         self._heartbeat_evt = asyncio.Event()
         self._step_down_evt = asyncio.Event()
         self._peer_evts: Dict[str, asyncio.Event] = {}
@@ -589,6 +593,7 @@ class RaftNode:
         if req.term > self.current_term or self.role != FOLLOWER:
             self._become_follower(req.term, req.leader)
         self.leader_id = req.leader
+        self.last_leader_contact = time.monotonic()
         self._heartbeat_evt.set()
 
         if req.prev_log_index > 0:
@@ -627,6 +632,7 @@ class RaftNode:
         if req.term < self.current_term:
             return SnapResp(self.current_term, False)
         self._become_follower(req.term, req.leader)
+        self.last_leader_contact = time.monotonic()
         self._heartbeat_evt.set()
         if req.last_index <= self._snap_index:
             return SnapResp(self.current_term, True)

@@ -32,8 +32,11 @@ rumors to ``fanout`` peers.  The round's communication graph is
 senders into node d are ``perm_f^{-1}(d)`` — delivery is ``fanout``
 vectorized gathers along the observer axis, no sort/scatter.
 
-**Timers.**  One round = one gossip interval; probes fire every
-``probe_every`` rounds.  Suspicion timeouts follow Lifeguard
+**Timers.**  One round = one gossip interval; each node probes once
+every ``probe_every`` rounds, staggered in contiguous id blocks so a
+fixed 1/probe_every of the cluster probes per round (the refmodel
+staggers per-node probe phases the same way — memberlist probe timers
+have random phase).  Suspicion timeouts follow Lifeguard
 (params.timeout_table): all observers time from the episode start
 (slot_start) — the first suspector's timer governs first-detection in
 both models, so detection-time statistics are preserved (validated in
@@ -62,7 +65,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
-from consul_tpu.ops.feistel import feistel_inverse, feistel_permute, random_targets
+from consul_tpu.ops.feistel import (
+    gossip_partners, gossip_sources, random_targets)
 
 MSG_NONE = 0
 MSG_SUSPECT = 1
@@ -140,10 +144,23 @@ def _age_tick(heard: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(msg > 0, aged, heard)
 
 
-def _probe_tick(p: SwimParams, rnd, keys, alive, state_tuple):
-    """One probe interval: direct probe -> k indirect probes -> suspicion
-    initiation, batched over all N probers (reference per-node behavior:
-    memberlist probe cycle as configured at consul/config.go:266-272).
+def _block_size(p: SwimParams) -> int:
+    """Probers per round under staggering: each node probes once per
+    ``probe_every`` rounds, spread across rounds in contiguous id
+    blocks (the refmodel staggers per-node probe phases the same way,
+    refmodel.py probe_offset)."""
+    return max(1, -(-p.n // p.probe_every))
+
+
+def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
+    """One round's probe slice: direct probe -> k indirect probes ->
+    suspicion initiation for this round's prober block (reference
+    per-node behavior: memberlist probe cycle as configured at
+    consul/config.go:266-272, with per-node stagger).
+
+    ``mf`` packs membership and ground truth into one gatherable i32:
+    ``member ? fail_round : -1`` — so ``mf[x] > rnd`` is alive-member
+    and ``mf[x] >= 0`` is member, one gather instead of two.
 
     Helpers are sampled uniformly excluding the prober (collision with
     the target has probability k/N — negligible, accepted)."""
@@ -151,26 +168,33 @@ def _probe_tick(p: SwimParams, rnd, keys, alive, state_tuple):
      slot_dead_round, slot_of_node, incarnation, member, drops) = state_tuple
     k_t, k_dl, k_h, k_hl = keys
     N, S = p.n, p.slots
-    ids = jnp.arange(N, dtype=jnp.int32)
+    B = _block_size(p)
 
-    tgt = random_targets(k_t, N, (N,))
-    prober_ok = member & alive
-    tgt_member = member[tgt]
-    tgt_alive = alive[tgt]
+    # This round's probers: block (rnd % probe_every); ids >= N are
+    # padding lanes on the final block and initiate nothing.
+    pid = (rnd % p.probe_every) * B + jnp.arange(B, dtype=jnp.int32)
+    pid_c = jnp.minimum(pid, N - 1)
+    pvalid = pid < N
 
-    u = jax.random.uniform(k_dl, (N,))
+    tgt = random_targets(k_t, N, (B,), ids=pid_c)
+    prober_ok = pvalid & (mf[pid_c] > rnd)
+    mf_t = mf[tgt]
+    tgt_member = mf_t >= 0
+    tgt_alive = mf_t > rnd
+
+    u = jax.random.uniform(k_dl, (B,))
     direct_fail = tgt_member & (~tgt_alive | (u < p.p_direct_fail_alive))
 
-    helpers = random_targets(k_h, N, (N, p.indirect_k))
-    hu = jax.random.uniform(k_hl, (N, p.indirect_k))
-    ind_ok = (alive[helpers] & member[helpers]
+    helpers = random_targets(k_h, N, (B, p.indirect_k), ids=pid_c)
+    hu = jax.random.uniform(k_hl, (B, p.indirect_k))
+    ind_ok = ((mf[helpers] > rnd)
               & tgt_alive[:, None] & tgt_member[:, None]
               & (hu >= p.p_indirect_fail_alive))
     init = prober_ok & direct_fail & ~jnp.any(ind_ok, axis=1)
 
     # Don't re-suspect a target this prober already believes dead.
     s_t = slot_of_node[tgt]
-    cur = heard[jnp.clip(s_t, 0, S - 1), ids]
+    cur = heard[jnp.clip(s_t, 0, S - 1), pid_c]
     init = init & ~((s_t >= 0) & ((cur >> _MSG_SHIFT) == MSG_DEAD))
 
     # Aggregate per target.
@@ -196,33 +220,39 @@ def _probe_tick(p: SwimParams, rnd, keys, alive, state_tuple):
     slot_dead_round = jnp.where(rearm, -1, slot_dead_round)
     heard = jnp.where(rearm[:, None], jnp.uint8(0), heard)
 
-    # Allocate fresh slots: k-th needer takes the k-th free slot.
+    # Allocate fresh slots: k-th needer (by node id) takes the k-th free
+    # slot.  top_k over the need mask replaces a full-N cumsum ranking —
+    # at most S needers can be served anyway (ties in top_k resolve to
+    # the lowest index, preserving the by-id order).
     need = want & (slot_of_node < 0) & member
     free = ~valid
     free_order = jnp.argsort(jnp.where(free, 0, 1), stable=True).astype(jnp.int32)
     n_free = jnp.sum(free)
-    rank = jnp.cumsum(need.astype(jnp.int32)) - 1
-    can = need & (rank < n_free)
-    slot_for = free_order[jnp.clip(rank, 0, S - 1)]
-    sidx = jnp.where(can, slot_for, S)  # S = out of range -> dropped
-    slot_node = slot_node.at[sidx].set(ids, mode="drop")
+    kk = min(S, N)  # a tiny pool (e.g. a WAN bridge) has fewer nodes than slots
+    vals, cand = jax.lax.top_k(need.astype(jnp.int32), kk)
+    krank = jnp.arange(kk, dtype=jnp.int32)
+    can_k = (vals > 0) & (krank < n_free)
+    slot_k = free_order[krank]
+    sidx = jnp.where(can_k, slot_k, S)  # S = out of range -> dropped
+    slot_node = slot_node.at[sidx].set(cand, mode="drop")
     slot_phase = slot_phase.at[sidx].set(PHASE_SUSPECT, mode="drop")
-    slot_inc = slot_inc.at[sidx].set(incarnation, mode="drop")
+    slot_inc = slot_inc.at[sidx].set(incarnation[cand], mode="drop")
     slot_start = slot_start.at[sidx].set(rnd, mode="drop")
-    slot_nsusp = slot_nsusp.at[sidx].set(nsusp_add, mode="drop")
+    slot_nsusp = slot_nsusp.at[sidx].set(nsusp_add[cand], mode="drop")
     slot_dead_round = slot_dead_round.at[sidx].set(-1, mode="drop")
-    slot_of_node = jnp.where(can, slot_for, slot_of_node)
-    drops = drops + jnp.sum((need & ~can).astype(jnp.int32))
+    slot_of_node = slot_of_node.at[jnp.where(can_k, cand, N)].set(
+        slot_k, mode="drop")
+    drops = drops + jnp.sum(need.astype(jnp.int32)) - jnp.sum(can_k.astype(jnp.int32))
 
     # Initiators record their own suspicion with a *fresh* age so the
     # rumor re-enters circulation (memberlist re-enqueues the suspect
     # broadcast on every independent suspicion — this is what carries
     # confirmations outward and shrinks the Lifeguard timeout).
     s_t2 = slot_of_node[tgt]
-    cur2 = heard[jnp.clip(s_t2, 0, S - 1), ids]
+    cur2 = heard[jnp.clip(s_t2, 0, S - 1), pid_c]
     mark_ok = init & (s_t2 >= 0) & ((cur2 >> _MSG_SHIFT) <= MSG_SUSPECT)
     fresh = (jnp.uint8(_enc(MSG_SUSPECT)) | (cur2 & jnp.uint8(_CONF_MASK << _CONF_SHIFT)))
-    heard = heard.at[jnp.where(mark_ok, s_t2, S), ids].set(fresh, mode="drop")
+    heard = heard.at[jnp.where(mark_ok, s_t2, S), pid_c].set(fresh, mode="drop")
 
     return (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
             slot_dead_round, slot_of_node, incarnation, member, drops)
@@ -239,20 +269,19 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
 
     N, S = p.n, p.slots
     alive = fail_round > rnd
+    # Packed per-node status: member ? fail_round : -1.  One gather
+    # answers both "is x a member" (>= 0) and "is x an alive member"
+    # (> rnd) — the kernel's most common random reads.
+    mf = jnp.where(state.member, fail_round, -1)
 
     # -- 1. age every in-flight rumor ------------------------------------
     heard = _age_tick(state.heard)
 
-    # -- 2. probe tick ----------------------------------------------------
+    # -- 2. probe tick (staggered: block rnd % probe_every probes) --------
     carry = (heard, state.slot_node, state.slot_phase, state.slot_inc,
              state.slot_start, state.slot_nsusp, state.slot_dead_round,
              state.slot_of_node, state.incarnation, state.member, state.drops)
-    carry = jax.lax.cond(
-        rnd % p.probe_every == 0,
-        lambda c: _probe_tick(p, rnd, k_probe, alive, c),
-        lambda c: c,
-        carry,
-    )
+    carry = _probe_tick(p, rnd, k_probe, mf, carry)
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
 
@@ -261,13 +290,14 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     rx_ok = alive & member
     in_msg = jnp.zeros_like(cur_msg)
     n_sus_in = jnp.zeros(heard.shape, jnp.uint8)
+    srcs_all = gossip_sources(k_gossip, N, p.fanout)
+    ids_n = jnp.arange(N, dtype=jnp.int32)
     for f in range(p.fanout):
-        kf = jax.random.fold_in(k_gossip, f)
-        srcs = feistel_inverse(jnp.arange(N, dtype=jnp.uint32), kf, N).astype(jnp.int32)
+        srcs = srcs_all[f]
         # Permutation fixed points would deliver a node's own rumor back to
         # it (and count as a Lifeguard confirmation); memberlist never
         # gossips to self.
-        src_ok = alive[srcs] & member[srcs] & (srcs != jnp.arange(N, dtype=jnp.int32))
+        src_ok = (mf[srcs] > rnd) & (srcs != ids_n)
         hin = heard[:, srcs]
         active = src_ok[None, :] & ((hin & _AGE_MASK) < p.spread_budget_rounds)
         m = jnp.where(active, (hin >> _MSG_SHIFT).astype(jnp.uint8), jnp.uint8(0))
@@ -297,15 +327,11 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     if p.pushpull_every:
         def _pushpull(h):
             kpp = jax.random.fold_in(key, 3)
-            ids_ = jnp.arange(N, dtype=jnp.int32)
-            fwd = feistel_inverse(jnp.arange(N, dtype=jnp.uint32),
-                                  kpp, N).astype(jnp.int32)
             # fwd = who dials me under the permutation; rev = whom I dial.
             # Doing both directions makes each pair's exchange symmetric.
-            rev = feistel_permute(jnp.arange(N, dtype=jnp.uint32),
-                                  kpp, N).astype(jnp.int32)
+            fwd, rev = gossip_partners(kpp, N)
             for partner in (fwd, rev):
-                ok = rx_ok & alive[partner] & member[partner] & (partner != ids_)
+                ok = rx_ok & (mf[partner] > rnd) & (partner != ids_n)
                 hin = h[:, partner]
                 upgraded = ((hin >> _MSG_SHIFT) > (h >> _MSG_SHIFT)) & ok[None, :]
                 h = jnp.where(upgraded, hin, h)
@@ -394,11 +420,14 @@ class RoundTrace(NamedTuple):
     n_heard_dead: jnp.ndarray    # [T, S] — members that hold the dead verdict
 
 
-@functools.partial(jax.jit, static_argnames=("p", "steps", "trace"))
+@functools.partial(jax.jit, static_argnames=("p", "steps", "trace", "unroll"))
 def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
-               p: SwimParams, steps: int, trace: bool = False):
+               p: SwimParams, steps: int, trace: bool = False,
+               unroll: int = 4):
     """Scan ``steps`` rounds.  With ``trace``, also return per-round slot
-    snapshots for detection-curve analysis (adds one S×N reduction/round)."""
+    snapshots for detection-curve analysis (adds one S×N reduction/round).
+    ``unroll`` fuses that many rounds per scan iteration — amortizes
+    per-iteration dispatch/sync on backends where that dominates."""
 
     def body(st, _):
         st = swim_round(st, base_key, fail_round, p)
@@ -412,4 +441,5 @@ def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
             y = None
         return st, y
 
-    return jax.lax.scan(body, state, None, length=steps)
+    return jax.lax.scan(body, state, None, length=steps,
+                        unroll=min(unroll, max(steps, 1)))

@@ -111,16 +111,118 @@ def feistel_inverse(y: jnp.ndarray, key: jax.Array, n: int, rounds: int = 4) -> 
     return _cycle_walk(y, key, n, rounds, False)
 
 
-def random_targets(key: jax.Array, n: int, shape) -> jnp.ndarray:
-    """Uniform random peer ids excluding self for probers ``0..shape[0]``.
+def random_targets(key: jax.Array, n: int, shape,
+                   ids: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Uniform random peer ids excluding self for the given probers.
 
-    Node ``i`` gets a target uniform over ``[0, n) \\ {i}`` via the
+    Prober ``i`` gets a target uniform over ``[0, n) \\ {i}`` via the
     shifted-draw trick (no rejection loop): ``(i + 1 + U[0, n-1)) % n``.
     Matches memberlist's uniform random member selection for probe and
-    indirect-probe targets.
+    indirect-probe targets.  ``ids`` defaults to ``0..shape[0]`` (all
+    nodes probing); pass explicit ids for a staggered prober block.
     """
     offs = jax.random.randint(key, shape, 0, n - 1, dtype=jnp.int32)
-    ids = jnp.arange(shape[0], dtype=jnp.int32)
+    if ids is None:
+        ids = jnp.arange(shape[0], dtype=jnp.int32)
     if len(shape) == 2:
         ids = ids[:, None]
     return (ids + 1 + offs) % n
+
+
+# -- hot-path source permutations (multiply-free, fixed trip count) ----------
+#
+# The exact feistel_permute/inverse above cycle-walk with a
+# data-dependent while_loop and a murmur round function (three u32
+# multiplies per round).  Neither is cheap on the VPU, and the gossip
+# kernel calls this every round for every fanout edge.  gossip_sources
+# is the same balanced-Feistel construction with (a) an ARX round
+# function — xorshift mixing, zero multiplies — and (b) a FIXED number
+# of cycle-walk iterations with a final modulo clamp.  The number of
+# walks is chosen statically from the pad fraction
+# ``(4^h - n) / 4^h`` (up to 3/4 for n just above a power of four) so
+# the residual out-of-domain probability is ≤1%; a clamped straggler
+# draws a ~uniform random source instead of a bijective one.  Effect on
+# the gossip graph: in-degree stays exactly ``fanout`` for every
+# destination; out-degree varies slightly for ≤1% of edges — which is
+# *between* the exact-permutation graph and stock memberlist's push
+# (out-degree exact, in-degree Poisson), so the epidemic statistics
+# stay inside the envelope the cross-validation tier checks.  Exact
+# bijectivity is traded for straight-line code.
+
+
+def _walks_for(n: int, residual: float = 0.01, lo: int = 2, hi: int = 16) -> int:
+    """Static walk count: pad_fraction^walks <= residual."""
+    import math
+    h = _half_bits(n)
+    dom = 1 << (2 * h)
+    pad = (dom - n) / dom
+    if pad <= 0.0:
+        return 1
+    return max(lo, min(hi, math.ceil(math.log(residual) / math.log(pad))))
+
+
+def _arx_round_fn(half: jnp.ndarray, round_key: jnp.ndarray, bits: int) -> jnp.ndarray:
+    v = (half + round_key).astype(jnp.uint32)
+    v = v ^ (v << 13)
+    v = v ^ (v >> 17)
+    v = v ^ (v << 5)
+    return v & jnp.uint32((1 << bits) - 1)
+
+
+def _arx_feistel(x, round_keys, half_bits: int, forward: bool):
+    mask = jnp.uint32((1 << half_bits) - 1)
+    left = (x >> half_bits) & mask
+    right = x & mask
+    rounds = round_keys.shape[0]
+    order = range(rounds) if forward else range(rounds - 1, -1, -1)
+    for r in order:
+        if forward:
+            left, right = right, left ^ _arx_round_fn(right, round_keys[r], half_bits)
+        else:
+            left, right = right ^ _arx_round_fn(left, round_keys[r], half_bits), left
+    return ((left << half_bits) | right).astype(jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "fanout", "rounds", "walks"))
+def gossip_sources(key: jax.Array, n: int, fanout: int,
+                   rounds: int = 4, walks: int = 0) -> jnp.ndarray:
+    """``(fanout, n)`` i32: senders into each destination this round.
+
+    Row ``f`` is (approximately — see module note) the inverse of an
+    independent keyed pseudorandom permutation of ``[0, n)``: delivery
+    of every push is ``fanout`` vectorized gathers.  ``walks=0`` picks
+    the static count for a ≤1% clamp residual.
+    """
+    h = _half_bits(n)
+    walks = walks or _walks_for(n)
+    dests = jnp.broadcast_to(jnp.arange(n, dtype=jnp.uint32), (fanout, n))
+    rk = jax.random.bits(key, (fanout, rounds), dtype=jnp.uint32)
+
+    def per_row(d_row, rk_row):
+        y = _arx_feistel(d_row, rk_row, h, forward=False)
+        for _ in range(walks - 1):
+            y = jnp.where(y >= n, _arx_feistel(y, rk_row, h, forward=False), y)
+        return jnp.where(y >= n, y % jnp.uint32(n), y)
+
+    return jax.vmap(per_row)(dests, rk).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rounds", "walks"))
+def gossip_partners(key: jax.Array, n: int,
+                    rounds: int = 4, walks: int = 0) -> tuple:
+    """One pseudorandom pairing for push/pull: ``(fwd, rev)`` where
+    ``fwd[d]`` dials d and ``rev[i]`` is whom i dials (approximate
+    inverse pair under the same key, same clamp rules as
+    :func:`gossip_sources`)."""
+    h = _half_bits(n)
+    walks = walks or _walks_for(n)
+    ids = jnp.arange(n, dtype=jnp.uint32)
+    rk = jax.random.bits(key, (rounds,), dtype=jnp.uint32)
+
+    def walk(x, forward):
+        y = _arx_feistel(x, rk, h, forward)
+        for _ in range(walks - 1):
+            y = jnp.where(y >= n, _arx_feistel(y, rk, h, forward), y)
+        return jnp.where(y >= n, y % jnp.uint32(n), y).astype(jnp.int32)
+
+    return walk(ids, False), walk(ids, True)

@@ -56,18 +56,8 @@ def _opts_wire(opts: QueryOptions) -> Dict:
             "require_consistent": opts.require_consistent}
 
 
-def _rpc_timeout(body: Any) -> float:
-    """Same budget rule as the server's forward path: blocking queries
-    get their wait budget plus grace (consul/rpc.go:29-41).  Options
-    ride either nested under ``opts`` or flat (KeyRequest subclasses
-    QueryOptions)."""
-    if not isinstance(body, dict):
-        return 30.0
-    opts = body.get("opts") or body
-    if opts.get("min_query_index"):
-        wait = float(opts.get("max_query_time") or 300.0)
-        return min(wait, 600.0) + 10.0
-    return 30.0
+# Blocking-query budget rule shared with the server's forward path.
+from consul_tpu.server.server import _forward_timeout as _rpc_timeout  # noqa: E402
 
 
 class ConsulClient:

@@ -75,7 +75,14 @@ class _Endpoint:
             meta.last_contact = 0.0
             meta.known_leader = True
         else:
+            import time as _t
             meta.known_leader = bool(self.srv.leader_addr())
+            # Staleness: seconds since this server last heard from a
+            # leader — drives the DNS max_stale re-query and clients'
+            # staleness budgeting (rpc.go:404-406).
+            contact = getattr(self.srv.raft, "last_leader_contact", None)
+            if contact is not None:
+                meta.last_contact = max(0.0, _t.monotonic() - contact)
 
     async def _blocking(self, opts: QueryOptions, meta: QueryMeta, run,
                         tables=(), kv_prefix=None) -> None:
