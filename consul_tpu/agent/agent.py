@@ -517,10 +517,10 @@ class Agent:
         catalog (command/agent/util.go:27-37 uses LANMembers)."""
         if self.lan_pool is not None:
             return max(1, len(self.lan_pool.members()))
-        store = getattr(self.server, "fsm", None)
-        if store is None:
-            return 1
-        _, nodes = self.server.store.nodes()
+        fsm = getattr(self.server, "fsm", None)
+        if fsm is None:
+            return 1  # client with no gossip armed yet
+        _, nodes = fsm.store.nodes()
         return max(1, len(nodes))
 
     # -- user events (user_event.go receive path) ---------------------------

@@ -259,8 +259,11 @@ class DNSServer:
         wins (dns.go:618-656 tries recursors sequentially)."""
         loop = asyncio.get_running_loop()
         for rec in self.recursors:
-            host, _, port = rec.rpartition(":")
-            addr = (host or rec, int(port) if port else 53)
+            host, _, port = rec.partition(":")
+            try:
+                addr = (host, int(port) if port else 53)
+            except ValueError:
+                continue  # malformed recursor entry; try the next
             try:
                 fut: asyncio.Future = loop.create_future()
                 transport, _ = await loop.create_datagram_endpoint(

@@ -62,9 +62,13 @@ SNAP_ACL = "acl"
 class ConsulFSM:
     """Applies Raft log entries to a StateStore."""
 
-    def __init__(self, gc_hint: Optional[Callable[[int], None]] = None) -> None:
+    def __init__(self, gc_hint: Optional[Callable[[int], None]] = None,
+                 kv_backend_factory: Optional[Callable[[], Any]] = None) -> None:
         self._gc_hint = gc_hint
-        self.store = StateStore(gc_hint=gc_hint)
+        # Factory, not instance: restore() rebuilds a FRESH store
+        # (fsm.go:275-363), so the backend must be recreatable.
+        self._kv_backend_factory = kv_backend_factory
+        self.store = StateStore(gc_hint=gc_hint, kv_backend=self._new_backend())
         self._handlers: Dict[int, Callable[[int, bytes], Any]] = {
             MessageType.REGISTER: self._apply_register,
             MessageType.DEREGISTER: self._apply_deregister,
@@ -73,6 +77,11 @@ class ConsulFSM:
             MessageType.ACL: self._apply_acl,
             MessageType.TOMBSTONE: self._apply_tombstone,
         }
+
+    def _new_backend(self):
+        if self._kv_backend_factory is None:
+            return None
+        return self._kv_backend_factory()
 
     # -- apply -------------------------------------------------------------
 
@@ -177,7 +186,11 @@ class ConsulFSM:
     def restore(self, buf: bytes) -> int:
         """Rebuild a fresh store from a snapshot stream (fsm.go:275-363).
         Returns the snapshot's last_index."""
-        self.store = StateStore(gc_hint=self._gc_hint)
+        # Close the old backend BEFORE recreating it — the native table
+        # holds an mmap+fd on a file the new one rmtree's.
+        self.store.close()
+        self.store = StateStore(gc_hint=self._gc_hint,
+                                kv_backend=self._new_backend())
         last_index = 0
         unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
         unpacker.feed(buf)

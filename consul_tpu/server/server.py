@@ -71,7 +71,23 @@ class Server:
         self.config = config or ServerConfig()
         self.gc = TombstoneGC(self.config.tombstone_ttl,
                               self.config.tombstone_granularity)
-        self.fsm = ConsulFSM(gc_hint=lambda idx: self.gc.hint(idx, time.monotonic()))
+        # KV table backend: servers with a data dir run the C++ mmap
+        # MVCC store underneath (the LMDB role, state_store.go:15);
+        # dev-mode servers use in-process dicts.  Like the reference's
+        # temp-dir LMDB, the file is recreated per boot — durability is
+        # the raft log's job (state_store.go:190-196).
+        kv_factory = None
+        if self.config.data_dir:
+            from consul_tpu.native import native_available
+            if native_available():
+                import os as _os
+
+                from consul_tpu.state.kvtable import NativeKVTable
+                state_dir = _os.path.join(self.config.data_dir, "state")
+                kv_factory = lambda: NativeKVTable(state_dir)  # noqa: E731
+        self.fsm = ConsulFSM(
+            gc_hint=lambda idx: self.gc.hint(idx, time.monotonic()),
+            kv_backend_factory=kv_factory)
         self.start_time = time.monotonic()
 
         if self.config.bootstrap_expect:
@@ -171,6 +187,7 @@ class Server:
         if self.pool is not None:
             await self.pool.close()
         await self.raft.shutdown()
+        self.fsm.store.close()
 
     async def wait_for_leader(self, timeout: float = 10.0) -> None:
         """Poll until the cluster has a known leader (WaitForLeader,

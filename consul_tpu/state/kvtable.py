@@ -1,0 +1,164 @@
+"""Pluggable KV-table backends for the state store.
+
+Parity target: the reference's LMDB role (``consul/state_store.go:15``,
+``consul/mdb_table.go``).  Key fact about that design: LMDB is opened
+**ephemeral** in a fresh temp dir each boot with NOSYNC
+(state_store.go:190-196) — durability always comes from the Raft log
+and FSM snapshots above; the mmap store exists for MVCC isolation and
+for keeping a dataset bigger than RAM addressable.  We mirror that
+split exactly:
+
+- :class:`DictKVTable` — in-process dict + sorted keys (dev mode, and
+  the fastest option when the dataset fits comfortably in RAM).
+- :class:`NativeKVTable` — rows live in the C++ mmap MVCC store
+  (native/cstore.cpp) as msgpack-encoded DirEntries under ``k:<key>``,
+  with a ``x:<session>\\0<key>`` secondary index maintaining the
+  session→held-keys relation the invalidation cascades walk.  The
+  backing file is recreated empty at open (the reference's temp-dir
+  behavior); crash recovery is raft-log replay, not file reuse.
+
+The surface is the narrow set of row operations ``StateStore`` needs;
+everything above it (CAS/lock modes, tombstones, watches, cascades)
+stays in the store, so both backends share one semantics
+implementation.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import shutil
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+import msgpack
+
+from consul_tpu.structs.structs import DirEntry
+
+
+class DictKVTable:
+    """Rows in a dict; ordered key scans via a sorted list."""
+
+    def __init__(self) -> None:
+        self._rows: Dict[str, DirEntry] = {}
+        self._keys: List[str] = []
+        self._by_session: Dict[str, Set[str]] = {}
+
+    def get(self, key: str) -> Optional[DirEntry]:
+        return self._rows.get(key)
+
+    def put(self, d: DirEntry, old: Optional[DirEntry]) -> None:
+        if old is not None and old.session:
+            s = self._by_session.get(old.session)
+            if s is not None:
+                s.discard(d.key)
+                if not s:
+                    del self._by_session[old.session]
+        if d.key not in self._rows:
+            bisect.insort(self._keys, d.key)
+        self._rows[d.key] = d
+        if d.session:
+            self._by_session.setdefault(d.session, set()).add(d.key)
+
+    def pop(self, key: str) -> Optional[DirEntry]:
+        ent = self._rows.pop(key, None)
+        if ent is None:
+            return None
+        i = bisect.bisect_left(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            del self._keys[i]
+        if ent.session:
+            s = self._by_session.get(ent.session)
+            if s is not None:
+                s.discard(key)
+                if not s:
+                    del self._by_session[ent.session]
+        return ent
+
+    def prefix_keys(self, prefix: str) -> List[str]:
+        if not prefix:
+            return list(self._keys)
+        lo = bisect.bisect_left(self._keys, prefix)
+        hi = lo
+        # Forward scan, not a synthetic upper-bound key: a sentinel char
+        # would exclude keys whose next char sorts above it.
+        while hi < len(self._keys) and self._keys[hi].startswith(prefix):
+            hi += 1
+        return self._keys[lo:hi]
+
+    def items(self, prefix: str = "") -> Iterator[Tuple[str, DirEntry]]:
+        for k in self.prefix_keys(prefix):
+            yield k, self._rows[k]
+
+    def session_keys(self, sid: str) -> List[str]:
+        return sorted(self._by_session.get(sid, ()))
+
+    def close(self) -> None:
+        pass
+
+
+class NativeKVTable:
+    """Rows in the C++ mmap MVCC store (the LMDB role)."""
+
+    _ROW = b"k:"
+    _SIDX = b"x:"
+
+    def __init__(self, directory: str) -> None:
+        from consul_tpu.native.store import NativeStore
+        # Fresh each boot, like the reference's temp-dir LMDB: state is
+        # an FSM product, never read back from a previous run's file.
+        if os.path.isdir(directory):
+            shutil.rmtree(directory, ignore_errors=True)
+        os.makedirs(directory, exist_ok=True)
+        self._store = NativeStore(os.path.join(directory, "kv.cstore"))
+
+    @staticmethod
+    def _encode(d: DirEntry) -> bytes:
+        return msgpack.packb(d.to_wire(), use_bin_type=True)
+
+    @staticmethod
+    def _decode(raw: bytes) -> DirEntry:
+        return DirEntry.from_wire(
+            msgpack.unpackb(raw, raw=False, strict_map_key=False))
+
+    def get(self, key: str) -> Optional[DirEntry]:
+        raw = self._store.get(self._ROW + key.encode())
+        return self._decode(raw) if raw is not None else None
+
+    def put(self, d: DirEntry, old: Optional[DirEntry]) -> None:
+        kb = d.key.encode()
+        if old is not None and old.session and old.session != d.session:
+            self._store.delete(
+                self._SIDX + old.session.encode() + b"\x00" + kb)
+        self._store.put(self._ROW + kb, self._encode(d))
+        if d.session:
+            self._store.put(
+                self._SIDX + d.session.encode() + b"\x00" + kb, b"")
+
+    def pop(self, key: str) -> Optional[DirEntry]:
+        kb = key.encode()
+        raw = self._store.get(self._ROW + kb)
+        if raw is None:
+            return None
+        ent = self._decode(raw)
+        self._store.delete(self._ROW + kb)
+        if ent.session:
+            self._store.delete(
+                self._SIDX + ent.session.encode() + b"\x00" + kb)
+        return ent
+
+    def prefix_keys(self, prefix: str) -> List[str]:
+        pre = self._ROW + prefix.encode()
+        return [k[len(self._ROW):].decode()
+                for k, _ in self._store.scan(pre)]
+
+    def items(self, prefix: str = "") -> Iterator[Tuple[str, DirEntry]]:
+        pre = self._ROW + prefix.encode()
+        for k, v in self._store.scan(pre):
+            yield k[len(self._ROW):].decode(), self._decode(v)
+
+    def session_keys(self, sid: str) -> List[str]:
+        pre = self._SIDX + sid.encode() + b"\x00"
+        return [k[len(pre):].decode() for k, _ in self._store.scan(pre)]
+
+    def close(self) -> None:
+        self._store.close()

@@ -113,17 +113,21 @@ class _SortedKeys:
 
 
 class StateStore:
-    def __init__(self, gc_hint: Optional[Callable[[int], None]] = None) -> None:
+    def __init__(self, gc_hint: Optional[Callable[[int], None]] = None,
+                 kv_backend: Optional[object] = None) -> None:
         # nodes: name -> Node
         self._nodes: Dict[str, Node] = {}
         # services: (node, service_id) -> ServiceNode
         self._services: Dict[Tuple[str, str], ServiceNode] = {}
         # checks: (node, check_id) -> HealthCheck
         self._checks: Dict[Tuple[str, str], HealthCheck] = {}
-        # kvs: key -> DirEntry (+ sorted keys, + session secondary index)
-        self._kvs: Dict[str, DirEntry] = {}
-        self._kvs_keys = _SortedKeys()
-        self._kvs_by_session: Dict[str, Set[str]] = {}
+        # kvs rows live behind a pluggable table backend: in-process
+        # dicts (default) or the C++ mmap MVCC store (the LMDB role) —
+        # see state/kvtable.py for the durability rationale.
+        if kv_backend is None:
+            from consul_tpu.state.kvtable import DictKVTable
+            kv_backend = DictKVTable()
+        self._kv = kv_backend
         # tombstones: key -> DirEntry with cleared value
         self._tombstones: Dict[str, DirEntry] = {}
         self._tombstone_keys = _SortedKeys()
@@ -428,7 +432,7 @@ class StateStore:
     def _kvs_set(self, index: int, d: DirEntry, mode: str) -> bool:
         """Reference kvsSet (state_store.go:1469-1564), all four modes."""
         d = d.clone()  # never alias caller-owned structs into the store
-        exist = self._kvs.get(d.key)
+        exist = self._kv.get(d.key)
 
         if mode == "cas":
             # modify_index 0 = set-if-not-exists, else exact match required.
@@ -466,26 +470,14 @@ class StateStore:
                 d.session = ""
         d.modify_index = index
 
-        self._put_kv(d, old=exist)
+        self._kv.put(d, old=exist)
         self._last_index[TABLE_KVS] = index
         self._notify_kv(d.key, prefix=False)
         return True
 
-    def _put_kv(self, d: DirEntry, old: Optional[DirEntry]) -> None:
-        if old is not None and old.session:
-            s = self._kvs_by_session.get(old.session)
-            if s is not None:
-                s.discard(d.key)
-                if not s:
-                    del self._kvs_by_session[old.session]
-        self._kvs[d.key] = d
-        self._kvs_keys.add(d.key)
-        if d.session:
-            self._kvs_by_session.setdefault(d.session, set()).add(d.key)
-
     def kvs_get(self, key: str) -> Tuple[int, Optional[DirEntry]]:
         idx = max(self._last_index[TABLE_KVS], self._last_index[TABLE_TOMBSTONES])
-        ent = self._kvs.get(key)
+        ent = self._kv.get(key)
         return idx, ent.clone() if ent is not None else None
 
     def kvs_list(self, prefix: str) -> Tuple[int, int, List[DirEntry]]:
@@ -493,7 +485,7 @@ class StateStore:
         (state_store.go:1202-1236): the endpoint uses the tombstone index
         to keep blocking list queries advancing after deletes."""
         idx = max(self._last_index[TABLE_KVS], self._last_index[TABLE_TOMBSTONES])
-        ents = [self._kvs[k].clone() for k in self._kvs_keys.prefix_range(prefix)]
+        ents = [ent.clone() for _, ent in self._kv.items(prefix)]
         tomb_idx = 0
         for k in self._tombstone_keys.prefix_range(prefix):
             tomb_idx = max(tomb_idx, self._tombstones[k].modify_index)
@@ -508,8 +500,7 @@ class StateStore:
         max_index = 0
         last = ""
         plen = len(prefix)
-        for k in self._kvs_keys.prefix_range(prefix):
-            ent = self._kvs[k]
+        for k, ent in self._kv.items(prefix):
             max_index = max(max_index, ent.modify_index)
             if not separator:
                 keys.append(k)
@@ -532,7 +523,7 @@ class StateStore:
     def kvs_delete_check_and_set(self, index: int, key: str, cas_index: int) -> bool:
         """Atomic delete-CAS (state_store.go:1327-1361): cas_index 0 means
         delete-if-exists always proceeds."""
-        exist = self._kvs.get(key)
+        exist = self._kv.get(key)
         if cas_index > 0 and (exist is None or exist.modify_index != cas_index):
             return False
         self._kvs_delete(index, [key] if exist is not None else [],
@@ -540,7 +531,7 @@ class StateStore:
         return True
 
     def kvs_delete_tree(self, index: int, prefix: str) -> None:
-        keys = self._kvs_keys.prefix_range(prefix)
+        keys = self._kv.prefix_keys(prefix)
         self._kvs_delete(index, keys, notify_prefix=True, notify_path=prefix)
 
     def _kvs_delete(self, index: int, keys: List[str], notify_prefix: bool,
@@ -549,17 +540,10 @@ class StateStore:
         state_store.go:1384-1441)."""
         deleted = 0
         for key in list(keys):
-            ent = self._kvs.pop(key, None)
+            ent = self._kv.pop(key)
             if ent is None:
                 continue
             deleted += 1
-            self._kvs_keys.remove(key)
-            if ent.session:
-                s = self._kvs_by_session.get(ent.session)
-                if s is not None:
-                    s.discard(key)
-                    if not s:
-                        del self._kvs_by_session[ent.session]
             tomb = ent.clone()
             tomb.modify_index = index
             tomb.value = b""
@@ -668,7 +652,7 @@ class StateStore:
         self._notify(TABLE_SESSIONS)
 
     def _held_keys(self, sid: str) -> List[str]:
-        return sorted(self._kvs_by_session.get(sid, ()))
+        return self._kv.session_keys(sid)
 
     def _invalidate_locks(self, index: int, delay: float, sid: str) -> None:
         """Release-behavior: clear lock holder, arm lock-delay
@@ -676,10 +660,11 @@ class StateStore:
         keys = self._held_keys(sid)
         expires = time.monotonic() + delay if delay > 0 else 0.0
         for key in keys:
-            kv = self._kvs[key].clone()
+            old = self._kv.get(key)
+            kv = old.clone()
             kv.session = ""
             kv.modify_index = index
-            self._put_kv(kv, old=self._kvs[key])
+            self._kv.put(kv, old=old)
             if delay > 0:
                 self._lock_delay[key] = expires
             self._notify_kv(key, prefix=False)
@@ -742,8 +727,8 @@ class StateStore:
             for k, c in sorted(self._checks.items()):
                 if k[0] == name:
                     yield ("check", c)
-        for key in self._kvs_keys.prefix_range(""):
-            yield ("kvs", self._kvs[key])
+        for _key, ent in self._kv.items(""):
+            yield ("kvs", ent)
         for key in self._tombstone_keys.prefix_range(""):
             yield ("tombstone", self._tombstones[key])
         for sid, sess in sorted(self._sessions.items()):
@@ -751,9 +736,13 @@ class StateStore:
         for aid, acl in sorted(self._acls.items()):
             yield ("acl", acl)
 
+    def close(self) -> None:
+        """Release the KV backend (the native table holds an mmap+fd)."""
+        self._kv.close()
+
     def kvs_restore(self, d: DirEntry) -> None:
         d = d.clone()
-        self._put_kv(d, old=self._kvs.get(d.key))
+        self._kv.put(d, old=self._kv.get(d.key))
         self._last_index[TABLE_KVS] = max(self._last_index[TABLE_KVS], d.modify_index)
 
     def tombstone_restore(self, d: DirEntry) -> None:

@@ -133,8 +133,12 @@ class StatsdSink:
     protocol; the statsite sink speaks the same format)."""
 
     def __init__(self, addr: str) -> None:
-        host, _, port = addr.rpartition(":")
-        self._addr = (host or addr, int(port) if port else 8125)
+        host, _, port = addr.partition(":")
+        try:
+            portno = int(port) if port else 8125
+        except ValueError:
+            portno = 8125  # malformed port: default rather than die
+        self._addr = (host, portno)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.setblocking(False)
 

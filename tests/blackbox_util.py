@@ -24,9 +24,14 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-_PORT_BASE = 21000
 _PORT_STRIDE = 10
-_next_idx = [int(os.environ.get("PYTEST_XDIST_WORKER", "gw0")[2:] or 0) * 40]
+# Keyed off the PID so concurrent test processes (xdist workers, manual
+# harness runs, a straggling daemon from a previous suite) land in
+# disjoint ranges (the reference uses 20000+idx per instance,
+# server.go:85-92; we add per-process spreading).  Each process owns a
+# 200-port range = 20 instance blocks.
+_PORT_BASE = 21000 + (os.getpid() % 199) * 200
+_next_idx = [0]
 
 
 def _port_block() -> Dict[str, int]:

@@ -1,0 +1,159 @@
+"""State store on the C++ mmap MVCC backend (the LMDB role).
+
+Re-runs the KVS/session/watch semantics suites from test_state_store
+with every ``StateStore()`` backed by :class:`NativeKVTable`, plus
+backend-direct tests and a kill-and-restart recovery test through the
+forked daemon (recovery = raft-log replay rebuilding the store, the
+reference's model at state_store.go:190-196).
+"""
+
+import base64
+import signal
+import time
+
+import pytest
+
+import test_state_store as tss
+from consul_tpu.native.store import build_native, native_available
+from consul_tpu.state import store as store_mod
+from consul_tpu.state.kvtable import DictKVTable, NativeKVTable
+from consul_tpu.structs.structs import DirEntry
+
+build_native()
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture(autouse=True)
+def native_backend(tmp_path, monkeypatch):
+    """Every StateStore() in these tests gets a fresh native KV table."""
+    orig = store_mod.StateStore.__init__
+    seq = [0]
+
+    def patched(self, gc_hint=None, kv_backend=None):
+        if kv_backend is None:
+            seq[0] += 1
+            kv_backend = NativeKVTable(str(tmp_path / f"kv{seq[0]}"))
+        orig(self, gc_hint=gc_hint, kv_backend=kv_backend)
+
+    monkeypatch.setattr(store_mod.StateStore, "__init__", patched)
+    yield
+
+
+# The full KV semantics suite (set/CAS/lock/unlock/list/delete-tree/
+# tombstones), the session-invalidation cascades that walk the
+# session->keys index, and the watch plumbing — all on native rows.
+class TestKVSOnNative(tss.TestKVS):
+    pass
+
+
+class TestSessionsOnNative(tss.TestSessions):
+    pass
+
+
+class TestWatchesOnNative(tss.TestWatches):
+    pass
+
+
+class TestBackendDirect:
+    def test_roundtrip_and_prefix_scan(self, tmp_path):
+        t = NativeKVTable(str(tmp_path / "d"))
+        for k in ("a/1", "a/2", "b/1", "a!", "a0"):
+            t.put(DirEntry(key=k, value=k.encode()), old=None)
+        assert t.get("a/1").value == b"a/1"
+        assert t.prefix_keys("a/") == ["a/1", "a/2"]
+        assert [k for k, _ in t.items("a/")] == ["a/1", "a/2"]
+        assert t.pop("a/1").key == "a/1"
+        assert t.get("a/1") is None
+        t.close()
+
+    def test_session_index_maintained(self, tmp_path):
+        t = NativeKVTable(str(tmp_path / "d"))
+        t.put(DirEntry(key="lock1", session="s1"), old=None)
+        t.put(DirEntry(key="lock2", session="s1"), old=None)
+        t.put(DirEntry(key="lock3", session="s2"), old=None)
+        assert t.session_keys("s1") == ["lock1", "lock2"]
+        # steal the lock: index rows follow the session change
+        old = t.get("lock1")
+        t.put(DirEntry(key="lock1", session="s2"), old=old)
+        assert t.session_keys("s1") == ["lock2"]
+        assert sorted(t.session_keys("s2")) == ["lock1", "lock3"]
+        t.pop("lock3")
+        assert t.session_keys("s2") == ["lock1"]
+        t.close()
+
+    def test_unicode_keys(self, tmp_path):
+        t = NativeKVTable(str(tmp_path / "d"))
+        keys = ["café/1", "café/2", "caf\U0001F600"]
+        for k in keys:
+            t.put(DirEntry(key=k, value=b"v"), old=None)
+        assert t.prefix_keys("café/") == ["café/1", "café/2"]
+        assert t.get("caf\U0001F600") is not None
+        t.close()
+
+    def test_parity_with_dict_backend(self, tmp_path):
+        """Same op sequence, byte-identical observable state."""
+        import random
+        rng = random.Random(7)
+        nat = NativeKVTable(str(tmp_path / "d"))
+        ref = DictKVTable()
+        keys = [f"k/{i % 17}" for i in range(200)]
+        for i, k in enumerate(keys):
+            op = rng.choice(["put", "put", "put", "pop"])
+            if op == "put":
+                d = DirEntry(key=k, value=f"v{i}".encode(),
+                             session=rng.choice(["", "s1", "s2"]),
+                             modify_index=i)
+                nat.put(d, old=nat.get(k))
+                ref.put(d.clone(), old=ref.get(k))
+            else:
+                a, b = nat.pop(k), ref.pop(k)
+                assert (a is None) == (b is None)
+        assert nat.prefix_keys("") == ref.prefix_keys("")
+        for k in nat.prefix_keys(""):
+            assert nat.get(k).to_wire() == ref.get(k).to_wire()
+        for s in ("s1", "s2"):
+            assert nat.session_keys(s) == ref.session_keys(s)
+        nat.close()
+
+
+class TestCrashRecovery:
+    def test_kill9_restart_replays_kv_from_raft_log(self, tmp_path):
+        """SIGKILL the daemon mid-flight; a restart on the same data dir
+        must rebuild the KV state by replaying the native raft log into
+        a fresh native KV table."""
+        from blackbox_util import TestServer
+        data_dir = str(tmp_path / "data")
+        s = TestServer("bb-crash",
+                       config_extra={"data_dir": data_dir}).start()
+        ports = s.ports
+        try:
+            s.wait_for_api()
+            s.wait_for_leader()
+            for i in range(5):
+                assert s.http_put(f"/v1/kv/crash/{i}", f"v{i}".encode()) is True
+            # no graceful anything — the store file must not matter
+            s.proc.send_signal(signal.SIGKILL)
+            s.proc.wait(10)
+        finally:
+            s.tmp.cleanup()
+
+        s2 = TestServer("bb-crash", config_extra={"data_dir": data_dir,
+                                                  "ports": ports}).start()
+        s2.ports = ports
+        try:
+            s2.wait_for_api()
+            s2.wait_for_leader()
+            deadline = time.monotonic() + 15
+            got = None
+            while time.monotonic() < deadline:
+                try:
+                    got = s2.http_get("/v1/kv/crash/3")
+                    if got:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert got and base64.b64decode(got[0]["Value"]) == b"v3"
+        finally:
+            s2.stop()
