@@ -143,6 +143,26 @@ class HTTPServer:
         r.add_get("/v1/internal/ui/node/{node}", h(self._ui_node_info))
         r.add_get("/v1/internal/ui/services", h(self._ui_services))
 
+        # Bundled web UI at /ui/ (the reference serves its Ember app the
+        # same way, command/agent/http.go:267-270); config ui_dir
+        # overrides the packaged app.
+        import os as _os
+        ui_dir = (self.agent.config.extra.get("ui_dir")
+                  or _os.path.join(_os.path.dirname(_os.path.dirname(
+                      _os.path.abspath(__file__))), "ui"))
+        index = _os.path.join(ui_dir, "index.html")
+        if _os.path.isfile(index):
+
+            async def ui_root(request):
+                raise web.HTTPFound("/ui/")
+
+            async def ui_index(request):
+                return web.FileResponse(index)
+
+            r.add_get("/ui", h(ui_root))
+            r.add_get("/ui/", h(ui_index))
+            r.add_static("/ui/", ui_dir)
+
         self.agent.register_http_routes(r, h)
 
     def _handler(self, fn):
@@ -156,9 +176,11 @@ class HTTPServer:
             t0 = _time.monotonic()
             try:
                 resp = await fn(request)
-                if isinstance(resp, web.Response):
-                    return resp
+                if isinstance(resp, web.StreamResponse):
+                    return resp  # covers Response AND FileResponse
                 return self._json(request, resp)
+            except web.HTTPException:
+                raise  # redirects/aiohttp statuses pass through untouched
             except EndpointError as e:
                 return web.Response(status=400, text=str(e))
             except PermissionError as e:

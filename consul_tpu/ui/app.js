@@ -1,0 +1,256 @@
+/* Hash-routed single-page UI over the agent's HTTP API.
+ *
+ * Screens mirror the reference Ember app (ui/ in the reference tree):
+ *   #/services            -> /v1/internal/ui/services
+ *   #/services/<name>     -> /v1/health/service/<name>
+ *   #/nodes               -> /v1/internal/ui/nodes
+ *   #/nodes/<name>        -> /v1/internal/ui/node/<name>
+ *   #/kv[/prefix/]        -> /v1/kv/<prefix>?keys&separator=/
+ */
+"use strict";
+
+const view = document.getElementById("view");
+
+async function api(path, opts) {
+  const r = await fetch(path, opts);
+  if (!r.ok) throw new Error(`${r.status} ${await r.text()}`);
+  const text = await r.text();
+  return text ? JSON.parse(text) : null;
+}
+
+function el(tag, attrs = {}, ...children) {
+  const e = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) {
+    if (k === "class") e.className = v;
+    else if (k.startsWith("on")) e.addEventListener(k.slice(2), v);
+    else e.setAttribute(k, v);
+  }
+  for (const c of children.flat()) {
+    e.append(c instanceof Node ? c : document.createTextNode(String(c)));
+  }
+  return e;
+}
+
+/* base64 <-> UTF-8 text (atob alone mangles non-ASCII values). */
+function b64decode(v) {
+  return new TextDecoder().decode(
+    Uint8Array.from(atob(v), c => c.charCodeAt(0)));
+}
+
+/* KV keys may contain ?, #, %… — escape each path segment, keep '/'. */
+function kvPath(key) {
+  return key.split("/").map(encodeURIComponent).join("/");
+}
+
+function goKV(hash) {
+  if (location.hash === hash) route();  // hashchange won't fire
+  else location.hash = hash;            // fires route() once
+}
+
+function badge(n, cls) {
+  return el("span", { class: `badge ${n ? cls : "zero"}` }, n);
+}
+
+function setActiveTab(tab) {
+  document.querySelectorAll("nav a").forEach(a =>
+    a.classList.toggle("active", a.dataset.tab === tab));
+}
+
+function render(...nodes) {
+  view.replaceChildren(...nodes);
+}
+
+function fail(e) {
+  render(el("p", { class: "err" }, `Request failed: ${e.message}`));
+}
+
+/* -- services ------------------------------------------------------------ */
+
+async function showServices() {
+  setActiveTab("services");
+  const rows = await api("/v1/internal/ui/services");
+  render(
+    el("h2", {}, "Services"),
+    el("table", {},
+      el("thead", {}, el("tr", {},
+        el("th", {}, "Service"), el("th", {}, "Health"),
+        el("th", {}, "Nodes"))),
+      el("tbody", {}, rows.map(s =>
+        el("tr", { class: "rowlink",
+                   onclick: () => location.hash = `#/services/${s.Name}` },
+          el("td", {}, s.Name),
+          el("td", {},
+            badge(s.ChecksPassing, "pass"),
+            badge(s.ChecksWarning, "warn"),
+            badge(s.ChecksCritical, "crit")),
+          el("td", {}, s.Nodes.length))))));
+  if (!rows.length) view.append(el("p", { class: "muted" }, "No services registered."));
+}
+
+async function showService(name) {
+  setActiveTab("services");
+  const insts = await api(`/v1/health/service/${encodeURIComponent(name)}`);
+  render(
+    el("p", { class: "back" }, el("a", { href: "#/services" }, "← Services")),
+    el("h2", {}, el("span", { class: "crumb" }, "service / "), name),
+    el("table", {},
+      el("thead", {}, el("tr", {},
+        el("th", {}, "Node"), el("th", {}, "Address"),
+        el("th", {}, "Port"), el("th", {}, "Checks"))),
+      el("tbody", {}, insts.map(i =>
+        el("tr", { class: "rowlink",
+                   onclick: () => location.hash = `#/nodes/${i.Node.Node}` },
+          el("td", {}, i.Node.Node),
+          el("td", {}, i.Service.Address || i.Node.Address),
+          el("td", {}, i.Service.Port),
+          el("td", {}, i.Checks.map(c =>
+            el("div", {}, el("span", { class: `status ${c.Status}` }, c.Status),
+              ` ${c.Name}`))))))));
+}
+
+/* -- nodes --------------------------------------------------------------- */
+
+function checkCounts(checks) {
+  const c = { passing: 0, warning: 0, critical: 0 };
+  for (const ch of checks) c[ch.Status] = (c[ch.Status] || 0) + 1;
+  return c;
+}
+
+async function showNodes() {
+  setActiveTab("nodes");
+  const nodes = await api("/v1/internal/ui/nodes");
+  render(
+    el("h2", {}, "Nodes"),
+    el("table", {},
+      el("thead", {}, el("tr", {},
+        el("th", {}, "Node"), el("th", {}, "Address"),
+        el("th", {}, "Health"), el("th", {}, "Services"))),
+      el("tbody", {}, nodes.map(n => {
+        const c = checkCounts(n.Checks || []);
+        return el("tr", { class: "rowlink",
+                          onclick: () => location.hash = `#/nodes/${n.Node}` },
+          el("td", {}, n.Node),
+          el("td", {}, n.Address),
+          el("td", {}, badge(c.passing, "pass"), badge(c.warning, "warn"),
+            badge(c.critical, "crit")),
+          el("td", {}, (n.Services || []).map(s => s.Service).join(", ")));
+      }))));
+}
+
+async function showNode(name) {
+  setActiveTab("nodes");
+  const n = await api(`/v1/internal/ui/node/${encodeURIComponent(name)}`);
+  render(
+    el("p", { class: "back" }, el("a", { href: "#/nodes" }, "← Nodes")),
+    el("h2", {}, el("span", { class: "crumb" }, "node / "), n.Node,
+      el("span", { class: "muted" }, `  (${n.Address})`)),
+    el("h2", {}, "Services"),
+    el("table", {},
+      el("thead", {}, el("tr", {},
+        el("th", {}, "Service"), el("th", {}, "ID"),
+        el("th", {}, "Port"), el("th", {}, "Tags"))),
+      el("tbody", {}, (n.Services || []).map(s =>
+        el("tr", {},
+          el("td", {}, s.Service), el("td", {}, s.ID || s.Service),
+          el("td", {}, s.Port), el("td", {}, (s.Tags || []).join(", ")))))),
+    el("h2", { style: "margin-top:20px" }, "Checks"),
+    el("table", {},
+      el("thead", {}, el("tr", {},
+        el("th", {}, "Check"), el("th", {}, "Status"),
+        el("th", {}, "Output"))),
+      el("tbody", {}, (n.Checks || []).map(c =>
+        el("tr", {},
+          el("td", {}, c.Name),
+          el("td", {}, el("span", { class: `status ${c.Status}` }, c.Status)),
+          el("td", { class: "muted" }, c.Output || ""))))));
+}
+
+/* -- key/value ----------------------------------------------------------- */
+
+function kvEditor(key, value, { fresh }) {
+  const keyInput = el("input", { type: "text", value: key,
+                                 placeholder: "key (folders end with /)" });
+  if (!fresh) keyInput.setAttribute("disabled", "");
+  const valInput = el("textarea", {}, value);
+  const save = async () => {
+    const k = keyInput.value.trim();
+    if (!k) return;
+    await api(`/v1/kv/${kvPath(k)}`, { method: "PUT", body: valInput.value });
+    goKV(`#/kv/${k.slice(0, k.lastIndexOf("/") + 1)}`);
+  };
+  const row = el("div", { class: "row" }, el("button", { onclick: save }, fresh ? "Create" : "Save"));
+  if (!fresh) {
+    row.append(el("button", {
+      class: "danger",
+      onclick: async () => {
+        await api(`/v1/kv/${kvPath(key)}`, { method: "DELETE" });
+        goKV(`#/kv/${key.slice(0, key.lastIndexOf("/") + 1)}`);
+      },
+    }, "Delete"));
+  }
+  return el("div", { class: "editor" }, keyInput,
+            el("div", { class: "row" }, valInput), row);
+}
+
+async function showKV(prefix) {
+  setActiveTab("kv");
+  if (prefix && !prefix.endsWith("/")) {
+    // leaf: show the editor for one key
+    const ents = await api(`/v1/kv/${kvPath(prefix)}`).catch(() => null);
+    const val = ents && ents[0] && ents[0].Value ? b64decode(ents[0].Value) : "";
+    render(
+      el("p", { class: "back" },
+        el("a", { href: `#/kv/${prefix.slice(0, prefix.lastIndexOf("/") + 1)}` },
+          "← Back")),
+      el("h2", {}, el("span", { class: "crumb" }, "kv / "), prefix),
+      kvEditor(prefix, val, { fresh: !ents }));
+    return;
+  }
+  let keys = [];
+  try {
+    keys = await api(`/v1/kv/${kvPath(prefix)}?keys&separator=/`) || [];
+  } catch (e) { /* 404 = empty prefix */ }
+  const crumbs = el("h2", {}, el("a", { href: "#/kv" }, "kv"), " / ");
+  let acc = "";
+  for (const part of prefix.split("/").filter(Boolean)) {
+    acc += part + "/";
+    crumbs.append(el("a", { href: `#/kv/${acc}` }, part), " / ");
+  }
+  render(
+    crumbs,
+    el("table", {},
+      el("tbody", {}, keys.map(k =>
+        el("tr", { class: "rowlink",
+                   onclick: () => { location.hash = `#/kv/${k}`; } },
+          el("td", {}, k.endsWith("/") ? `📁 ${k.slice(prefix.length)}`
+                                       : k.slice(prefix.length)))))),
+    keys.length ? "" : el("p", { class: "muted" }, "No keys under this prefix."),
+    el("h2", { style: "margin-top:22px" }, "Create key"),
+    kvEditor(prefix, "", { fresh: true }));
+}
+
+/* -- shell --------------------------------------------------------------- */
+
+async function whoami() {
+  try {
+    const me = await api("/v1/agent/self");
+    document.getElementById("whoami").textContent =
+      `${me.Config.NodeName} · ${me.Config.Datacenter}` +
+      (me.Config.Server ? " · server" : " · client");
+  } catch (e) { /* non-fatal */ }
+}
+
+function route() {
+  const h = location.hash || "#/services";
+  const m = h.slice(2).split("/");
+  const go = {
+    services: () => m[1] ? showService(decodeURIComponent(m[1])) : showServices(),
+    nodes: () => m[1] ? showNode(decodeURIComponent(m[1])) : showNodes(),
+    kv: () => showKV(m.slice(1).join("/")),
+  }[m[0]] || showServices;
+  go().catch(fail);
+}
+
+window.addEventListener("hashchange", route);
+whoami();
+route();

@@ -66,6 +66,21 @@ class TestSingleAgentBlackBox:
         assert out.returncode == 0, out.stderr
         assert "raft" in out.stdout
 
+    def test_web_ui_served(self, server):
+        """The bundled UI ships at /ui/ (http.go:267-270 role)."""
+        import urllib.request
+        base = f"http://127.0.0.1:{server.ports['http']}"
+        with urllib.request.urlopen(f"{base}/ui/", timeout=10) as r:
+            html = r.read().decode()
+        assert "<html" in html and "app.js" in html
+        with urllib.request.urlopen(f"{base}/ui/app.js", timeout=10) as r:
+            js = r.read().decode()
+        assert "/v1/internal/ui/services" in js
+        # /ui redirects to /ui/
+        req = urllib.request.Request(f"{base}/ui")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.url.endswith("/ui/")
+
     def test_metrics_endpoint(self, server):
         snap = server.http_get("/v1/agent/metrics")
         merged = {}
