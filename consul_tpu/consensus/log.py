@@ -61,7 +61,7 @@ class MemoryLogStore:
     def get(self, index: int) -> Optional[LogEntry]:
         return self._entries.get(index)
 
-    def append(self, entries: List[LogEntry]) -> None:
+    def append(self, entries: List[LogEntry], sync: bool = True) -> None:
         for e in entries:
             self._entries[e.index] = e
             if self._first == 0:
@@ -136,12 +136,18 @@ class FileLogStore(MemoryLogStore):
                 e = LogEntry.unpack(body)
                 super().append([e])
 
-    def append(self, entries: List[LogEntry]) -> None:
+    def append(self, entries: List[LogEntry], sync: bool = True) -> None:
         super().append(entries)
         for e in entries:
             body = e.pack()
             self._f.write(_REC_HDR.pack(len(body), zlib.crc32(body)) + body)
         self._f.flush()
+        if sync:
+            os.fsync(self._f.fileno())
+
+    def sync(self) -> None:
+        # fd-level only — safe to run in an executor thread while the
+        # event loop keeps appending (the raft durability pump does).
         os.fsync(self._f.fileno())
 
     def _rewrite(self) -> None:

@@ -144,7 +144,7 @@ class MemoryTransport:
         target = self._nodes[dst]
         if target.role == SHUTDOWN:
             raise TransportError(f"{dst} is down")
-        resp = target.handle(method, msg)
+        resp = await target.handle(method, msg)
         if (dst, src) in self._blocked:  # reply lost
             raise TransportError(f"{dst} -> {src} reply dropped")
         return resp
@@ -179,6 +179,17 @@ class RaftNode:
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
         self._pending: Dict[int, asyncio.Future] = {}
+        # Group-commit buffer (see _submit/_flush_appends).
+        self._append_buf: List[LogEntry] = []
+        self._buf_tail = 0
+        self._flush_scheduled = False
+        # Durability pump: appends hit the OS immediately (sync=False);
+        # a background task fsyncs and advances durable_index, off the
+        # event loop so heartbeats never stall behind the disk.  Quorum
+        # accounting only ever counts durable entries.
+        self.durable_index = 0
+        self._dirty_evt = asyncio.Event()
+        self._durable_waiters: List[Tuple[int, asyncio.Future]] = []
         # Staleness metadata: monotonic stamp of the last message from a
         # live leader (feeds QueryMeta.last_contact, consul/rpc.go:406).
         self.last_leader_contact: float = time.monotonic()
@@ -214,14 +225,68 @@ class RaftNode:
         if hasattr(self.transport, "register"):
             self.transport.register(self)
         loop = asyncio.get_event_loop()
+        self.durable_index = self.last_log_index()
+        self._tasks.append(loop.create_task(self._sync_pump()))
         if self.peers == [self.id]:
             # Single-node bootstrap: skip the election timeout and elect
             # immediately (the reference's EnableSingleNode fast path).
             self._tasks.append(loop.create_task(self._start_election()))
         self._tasks.append(loop.create_task(self._run()))
 
+    async def _sync_pump(self) -> None:
+        """Background group fsync: coalesces all appends that landed
+        since the last sync into one fsync (executor thread, fd-level
+        only), then advances durable_index, wakes durability waiters,
+        and lets the leader's commit accounting move."""
+        loop = asyncio.get_event_loop()
+        try:
+            while self.role != SHUTDOWN:
+                await self._dirty_evt.wait()
+                self._dirty_evt.clear()
+                target = self.log.last_index()
+                if target <= self.durable_index:
+                    continue
+                try:
+                    await loop.run_in_executor(None, self.log.sync)
+                except Exception:
+                    # fd can vanish mid-fsync when a truncation rewrite
+                    # swaps the segment file under us; the rewrite is
+                    # itself fsynced, so just retry on the new fd.
+                    self._dirty_evt.set()
+                    await asyncio.sleep(0.01)
+                    continue
+                self.durable_index = max(self.durable_index, target)
+                if self._durable_waiters:
+                    rest = []
+                    for idx, fut in self._durable_waiters:
+                        if idx <= self.durable_index:
+                            if not fut.done():
+                                fut.set_result(None)
+                        else:
+                            rest.append((idx, fut))
+                    self._durable_waiters = rest
+                if self.role == LEADER:
+                    self._maybe_advance_commit()
+        except asyncio.CancelledError:
+            pass
+
+    async def _wait_durable(self, index: int) -> None:
+        if index <= self.durable_index:
+            return
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._durable_waiters.append((index, fut))
+        self._dirty_evt.set()
+        await fut
+
     async def shutdown(self) -> None:
         self.role = SHUTDOWN
+        # Durability waiters would hang forever once the pump dies; an
+        # exception is the only honest answer (the append was never
+        # acknowledged as durable).
+        for _idx, fut in self._durable_waiters:
+            if not fut.done():
+                fut.set_exception(NotLeaderError(None))
+        self._durable_waiters = []
         for t in self._repl_tasks + self._tasks:
             t.cancel()
         for t in self._repl_tasks + self._tasks:
@@ -276,18 +341,47 @@ class RaftNode:
                            msgpack.packb(new, use_bin_type=True), timeout)
 
     async def _submit(self, type_: int, data: bytes, timeout: float) -> Any:
+        """Group commit (hashicorp/raft's applyBatch): entries submitted
+        in the same event-loop tick are buffered and land in ONE
+        log.append — one fsync for the whole batch — before replication
+        is kicked.  Commit quorum only ever counts flushed entries
+        (last_log_index reads the log, not the buffer)."""
         if self.role != LEADER:
             raise NotLeaderError(self.leader_id)
-        index = self.last_log_index() + 1
-        entry = LogEntry(index=index, term=self.current_term, type=type_, data=data)
+        if self._buf_tail == 0:
+            self._buf_tail = self.last_log_index()
+        self._buf_tail += 1
+        entry = LogEntry(index=self._buf_tail, term=self.current_term,
+                         type=type_, data=data)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending[index] = fut
-        self.log.append([entry])
+        self._pending[entry.index] = fut
+        self._append_buf.append(entry)
         if type_ == LOG_CONFIGURATION:
+            # Apply eagerly, not at flush: a second membership change in
+            # the same tick must see the first one's peer set.
             self._apply_configuration(entry)
-        self._kick_replication()
-        self._maybe_advance_commit()
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_event_loop().call_soon(self._flush_appends)
         return await asyncio.wait_for(fut, timeout)
+
+    def _flush_appends(self) -> None:
+        self._flush_scheduled = False
+        batch, self._append_buf = self._append_buf, []
+        self._buf_tail = 0
+        if not batch or self.role != LEADER:
+            for e in batch:
+                fut = self._pending.pop(e.index, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(NotLeaderError(self.leader_id))
+            return
+        self.log.append(batch, sync=False)
+        self._dirty_evt.set()
+        # (LOG_CONFIGURATION entries were applied eagerly in _submit.)
+        # Replication is kicked immediately (pipelined past our own
+        # fsync); _maybe_advance_commit counts only durable_index for
+        # self, so nothing commits before local durability.
+        self._kick_replication()
 
     # -- role loop ---------------------------------------------------------
 
@@ -489,7 +583,11 @@ class RaftNode:
     def _maybe_advance_commit(self) -> None:
         if self.role != LEADER:
             return
-        matches = sorted([self.last_log_index()]
+        # Self contributes its DURABLE prefix, not the buffered tail —
+        # an entry may only count toward quorum where it is on stable
+        # storage (the durability pump advances this and re-calls us).
+        self_match = max(self._snap_index, self.durable_index)
+        matches = sorted([self_match]
                          + [self.match_index.get(p, 0)
                             for p in self.peers if p != self.id],
                          reverse=True)
@@ -564,11 +662,16 @@ class RaftNode:
 
     # -- handlers (synchronous => atomic under the event loop) -------------
 
-    def handle(self, method: str, msg: Any) -> Any:
+    async def handle(self, method: str, msg: Any) -> Any:
         if method == "request_vote":
             return self._on_request_vote(msg)
         if method == "append_entries":
-            return self._on_append_entries(msg)
+            resp = self._on_append_entries(msg)
+            # A successful ack promises the entries are durable HERE —
+            # the leader counts this node toward quorum on it.
+            if resp.success and resp.match_index > self.durable_index:
+                await self._wait_durable(resp.match_index)
+            return resp
         if method == "install_snapshot":
             return self._on_install_snapshot(msg)
         raise ValueError(f"unknown raft rpc {method}")
@@ -607,21 +710,32 @@ class RaftNode:
                                           req.prev_log_index - 1))
 
         match = req.prev_log_index
+        to_append: List[LogEntry] = []
         for e in req.entries:
             local = self.log.get(e.index)
             if local is not None and local.term != e.term:
                 self.log.delete_from(e.index)
+                # Re-written indexes are NOT durable until re-fsynced:
+                # roll the watermark back or the ACK gate + sync pump
+                # would treat the replacements as already on disk.
+                self.durable_index = min(self.durable_index, e.index - 1)
                 for i in list(self._pending):
                     if i >= e.index:
                         fut = self._pending.pop(i)
                         if not fut.done():
                             fut.set_exception(NotLeaderError(req.leader))
                 local = None
-            if local is None and e.index > self.log.last_index():
-                self.log.append([e])
+            if local is None and e.index > self.log.last_index() + len(to_append):
+                to_append.append(e)
+            match = e.index
+        if to_append:
+            # One buffered append for the whole batch; the ACK is held
+            # until the durability pump has fsynced it (handle()).
+            self.log.append(to_append, sync=False)
+            self._dirty_evt.set()
+            for e in to_append:
                 if e.type == LOG_CONFIGURATION:
                     self._apply_configuration(e)
-            match = e.index
 
         if req.leader_commit > self.commit_index:
             self.commit_index = min(req.leader_commit, self.last_log_index())

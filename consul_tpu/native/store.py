@@ -244,13 +244,14 @@ class NativeLogStore:
         raw = self._store.get(_log_key(index))
         return LogEntry.unpack(raw) if raw is not None else None
 
-    def append(self, entries: List) -> None:
+    def append(self, entries: List, sync: bool = True) -> None:
         for e in entries:
             self._store.put(_log_key(e.index), e.pack())
             if self._first == 0:
                 self._first = e.index
             self._last = max(self._last, e.index)
-        self._store.sync()
+        if sync:
+            self._store.sync()
 
     def delete_from(self, index: int) -> None:
         for i in range(index, self._last + 1):

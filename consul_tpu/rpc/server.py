@@ -196,7 +196,7 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
         def mk(m):
             async def fn(srv, body):
                 msg = raft_req_from_wire(m, body)
-                resp = srv.raft.handle(m, msg)
+                resp = await srv.raft.handle(m, msg)
                 return raft_msg_to_wire(resp)
             return fn
         H[f"Raft.{m}"] = (LOCAL, mk(m))

@@ -144,6 +144,7 @@ class Server:
         self.reconcile_ch: Optional[asyncio.Queue] = None
         self.lan_members_fn: Optional[Any] = None
         self.user_event_broadcaster: Optional[Any] = None
+        self._barrier_inflight: Optional[asyncio.Future] = None
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
@@ -240,9 +241,19 @@ class Server:
             raise NotLeaderError(str(e)) from e
 
     async def consistent_read_barrier(self) -> None:
-        """VerifyLeader equivalent (consul/rpc.go:413-417)."""
+        """VerifyLeader equivalent (consul/rpc.go:413-417).
+
+        Concurrent consistent reads coalesce onto one in-flight barrier:
+        any barrier that COMMITS after a read arrived proves leadership
+        held at a moment after the read began, which is the whole
+        guarantee — so sharing is safe and turns a barrier-per-read into
+        a barrier-per-batch."""
+        fut = self._barrier_inflight
+        if fut is None or fut.done():
+            fut = asyncio.ensure_future(self.raft.barrier(timeout=ENQUEUE_LIMIT))
+            self._barrier_inflight = fut
         try:
-            await self.raft.barrier(timeout=ENQUEUE_LIMIT)
+            await asyncio.shield(fut)
         except RaftNotLeaderError as e:
             raise NotLeaderError(str(e)) from e
 

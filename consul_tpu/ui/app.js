@@ -77,7 +77,7 @@ async function showServices() {
         el("th", {}, "Nodes"))),
       el("tbody", {}, rows.map(s =>
         el("tr", { class: "rowlink",
-                   onclick: () => location.hash = `#/services/${s.Name}` },
+                   onclick: () => location.hash = `#/services/${encodeURIComponent(s.Name)}` },
           el("td", {}, s.Name),
           el("td", {},
             badge(s.ChecksPassing, "pass"),
@@ -99,7 +99,7 @@ async function showService(name) {
         el("th", {}, "Port"), el("th", {}, "Checks"))),
       el("tbody", {}, insts.map(i =>
         el("tr", { class: "rowlink",
-                   onclick: () => location.hash = `#/nodes/${i.Node.Node}` },
+                   onclick: () => location.hash = `#/nodes/${encodeURIComponent(i.Node.Node)}` },
           el("td", {}, i.Node.Node),
           el("td", {}, i.Service.Address || i.Node.Address),
           el("td", {}, i.Service.Port),
@@ -128,7 +128,7 @@ async function showNodes() {
       el("tbody", {}, nodes.map(n => {
         const c = checkCounts(n.Checks || []);
         return el("tr", { class: "rowlink",
-                          onclick: () => location.hash = `#/nodes/${n.Node}` },
+                          onclick: () => location.hash = `#/nodes/${encodeURIComponent(n.Node)}` },
           el("td", {}, n.Node),
           el("td", {}, n.Address),
           el("td", {}, badge(c.passing, "pass"), badge(c.warning, "warn"),

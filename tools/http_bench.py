@@ -1,0 +1,149 @@
+"""HTTP KV load bench — the reference's headline workload.
+
+Mirrors ``bench/Makefile`` in the reference: 20,480 requests at 64
+concurrency against ``/v1/kv/bench`` (PUT, then GET in default /
+stale / consistent modes), reported as req/s + latency percentiles.
+Reference numbers to beat (BASELINE.md, 3 servers on 4x DO-16GB,
+1Gbps): PUT 4,092 req/s; GET default 10,470; stale 10,948;
+consistent 10,246; PUT avg 15.6ms / p90 21.8ms.
+
+Topology matches the reference: a 3-server cluster (forked daemons,
+loopback RPC mesh + gossip), load driven at ONE server.  Run:
+
+    python tools/http_bench.py [--requests 20480] [--concurrency 64]
+                               [--single]   # 1-server variant
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+async def drive(base: str, method: str, path: str, body, total: int,
+                concurrency: int):
+    import aiohttp
+
+    latencies = []
+    errors = [0]
+    sample_err = [None]
+    sem_queue = asyncio.Queue()
+    for _ in range(total):
+        sem_queue.put_nowait(None)
+
+    conn = aiohttp.TCPConnector(limit=concurrency)
+    async with aiohttp.ClientSession(connector=conn) as sess:
+        async def worker():
+            while True:
+                try:
+                    sem_queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    async with sess.request(method, base + path,
+                                            data=body) as r:
+                        text = await r.read()
+                        if r.status >= 400:
+                            errors[0] += 1
+                            if sample_err[0] is None:
+                                sample_err[0] = f"{r.status}: {text[:200]!r}"
+                except Exception as e:
+                    errors[0] += 1
+                    if sample_err[0] is None:
+                        sample_err[0] = f"exc: {type(e).__name__}: {e}"
+                latencies.append((time.perf_counter() - t0) * 1000)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker() for _ in range(concurrency)])
+        wall = time.perf_counter() - t0
+    lat = sorted(latencies)
+
+    def pct(q):
+        return lat[min(len(lat) - 1, int(q / 100 * len(lat)))]
+
+    out = {
+        "requests": total, "errors": errors[0],
+        "req_per_sec": round(total / wall, 1),
+        "avg_ms": round(statistics.mean(lat), 2),
+        "p50_ms": round(pct(50), 2), "p90_ms": round(pct(90), 2),
+        "p99_ms": round(pct(99), 2),
+    }
+    if sample_err[0] is not None:
+        out["sample_error"] = sample_err[0]
+    return out
+
+
+async def bench(requests: int, concurrency: int, single: bool):
+    from blackbox_util import TestServer
+
+    servers = []
+    try:
+        if single:
+            s1 = TestServer("hb1").start()
+            servers = [s1]
+            s1.wait_for_api()
+            s1.wait_for_leader()
+        else:
+            s1 = TestServer("hb1", bootstrap=False, bootstrap_expect=3).start()
+            servers = [s1]
+            s1.wait_for_api()
+            for name in ("hb2", "hb3"):
+                s = TestServer(name, bootstrap=False, bootstrap_expect=3,
+                               retry_join=[s1.lan_addr]).start()
+                servers.append(s)
+                s.wait_for_api()
+            for s in servers:
+                s.wait_for_leader(60)
+        base = f"http://127.0.0.1:{s1.ports['http']}"
+        results = {"topology": "1 server" if single else "3-server cluster",
+                   "concurrency": concurrency}
+        print(f"[bench] PUT x{requests} @ {concurrency}", file=sys.stderr)
+        results["kv_put"] = await drive(base, "PUT", "/v1/kv/bench",
+                                        b"74a31e96-1d0f-4fa7-aa14-7212a326986e",
+                                        requests, concurrency)
+        print(f"[bench] GET default x{requests}", file=sys.stderr)
+        results["kv_get"] = await drive(base, "GET", "/v1/kv/bench", None,
+                                        requests, concurrency)
+        print(f"[bench] GET stale x{requests}", file=sys.stderr)
+        results["kv_get_stale"] = await drive(base, "GET",
+                                              "/v1/kv/bench?stale", None,
+                                              requests, concurrency)
+        print(f"[bench] GET consistent x{requests}", file=sys.stderr)
+        results["kv_get_consistent"] = await drive(
+            base, "GET", "/v1/kv/bench?consistent", None,
+            requests, concurrency)
+        return results
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=20480)
+    ap.add_argument("--concurrency", type=int, default=64)
+    ap.add_argument("--single", action="store_true")
+    args = ap.parse_args()
+    out = asyncio.run(bench(args.requests, args.concurrency, args.single))
+    out["reference_v03"] = {
+        "kv_put_req_per_sec": 4092, "kv_get_req_per_sec": 10470,
+        "kv_get_stale_req_per_sec": 10948,
+        "kv_get_consistent_req_per_sec": 10246,
+        "workload": "boom 20480 reqs @64, 3 servers on 4x DO-16GB/1Gbps",
+    }
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
