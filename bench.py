@@ -209,12 +209,42 @@ def main() -> None:
 
     fail_metric = ("swim_multidc_rounds_per_sec" if args.multidc
                    else "swim_gossip_rounds_per_sec")
+    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_last_success.json")
+
+    def _read_last_good() -> dict | None:
+        """Cached measurements, keyed per bench so the LAN and multidc
+        variants never report each other's numbers.  A corrupt cache
+        must never take down the metric emit."""
+        try:
+            with open(last_path) as f:
+                cache = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(cache, dict):
+            return None
+        entry = cache.get(fail_metric)
+        # pre-keying format: a single flat result dict
+        if entry is None and str(cache.get("metric", "")).startswith(fail_metric):
+            entry = cache
+        return entry
+
+    def _emit_failure(err: str) -> None:
+        # The tunnel to the chip wedges occasionally (grant held by a
+        # killed process).  Report the failure honestly, but attach the
+        # last successfully measured value so a flaky tunnel at
+        # round-end doesn't erase a real measurement.
+        payload = {"metric": fail_metric, "value": 0.0,
+                   "unit": "rounds/s", "vs_baseline": 0.0, "error": err}
+        last = _read_last_good()
+        if last is not None:
+            payload["last_known_good"] = last
+        _emit(payload)
+
     try:
         jax = _setup_jax()
     except Exception as e:
-        _emit({"metric": fail_metric, "value": 0.0,
-               "unit": "rounds/s", "vs_baseline": 0.0,
-               "error": f"backend init: {e}"})
+        _emit_failure(f"backend init: {e}")
         return
 
     n = args.n
@@ -229,6 +259,20 @@ def main() -> None:
                                     args.repeats)
             if n != args.n:
                 result["reduced_from_n"] = args.n
+            try:
+                try:
+                    with open(last_path) as f:
+                        cache = json.load(f)
+                    if not isinstance(cache, dict) or "metric" in cache:
+                        cache = {}
+                except (OSError, ValueError):
+                    cache = {}
+                cache[fail_metric] = {**result,
+                                      "measured_unix": int(time.time())}
+                with open(last_path, "w") as f:
+                    json.dump(cache, f)
+            except OSError:
+                pass
             _emit(result)
             return
         except Exception as e:
@@ -239,9 +283,7 @@ def main() -> None:
                 break
             _log(f"falling back to n={n}")
 
-    _emit({"metric": fail_metric, "value": 0.0,
-           "unit": "rounds/s", "vs_baseline": 0.0,
-           "error": f"all sizes failed; last: {type(last_err).__name__}: {last_err}"})
+    _emit_failure(f"all sizes failed; last: {type(last_err).__name__}: {last_err}")
 
 
 if __name__ == "__main__":

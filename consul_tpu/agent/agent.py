@@ -218,6 +218,17 @@ class Agent:
         snap_dir = (os.path.join(self.config.data_dir, "serf")
                     if self.config.data_dir else "")
         timing = dict(self.config.serf_timing)
+        # Merge delegates (consul/merge.go): the LAN pool only admits
+        # members of its own datacenter (:12-38); the WAN pool only
+        # admits consul servers (:39-50).
+        dc = self.config.datacenter
+
+        def lan_ok(node) -> bool:
+            return node.tags.get("dc", dc) == dc
+
+        def wan_ok(node) -> bool:
+            return node.tags.get("role") == "consul"
+
         self.lan_pool = SerfPool(SerfConfig(
             node_name=self.config.node_name,
             bind_addr=self.config.bind_addr,
@@ -227,7 +238,8 @@ class Agent:
             snapshot_path=(os.path.join(snap_dir, "local.snapshot")
                            if snap_dir else ""),
             **timing),
-            keyring=self.server.keyring, on_event=self._on_lan_event)
+            keyring=self.server.keyring, on_event=self._on_lan_event,
+            member_filter=lan_ok)
         await self.lan_pool.start()
         if self.config.server:
             # WAN member names are qualified node.dc (consul/server.go:288)
@@ -240,7 +252,8 @@ class Agent:
                 snapshot_path=(os.path.join(snap_dir, "remote.snapshot")
                                if snap_dir else ""),
                 **timing),
-                keyring=self.server.keyring, on_event=self._on_wan_event)
+                keyring=self.server.keyring, on_event=self._on_wan_event,
+                member_filter=wan_ok)
             await self.wan_pool.start()
         self.server.lan_members_fn = self.lan_pool.members
         self.server.user_event_broadcaster = self._broadcast_via_gossip

@@ -58,7 +58,8 @@ class SerfPool:
     carry a :class:`Node`, ``"user"`` carries the event dict."""
 
     def __init__(self, config: SerfConfig, keyring: Optional[Any] = None,
-                 on_event: Optional[Callable[[str, Any], None]] = None) -> None:
+                 on_event: Optional[Callable[[str, Any], None]] = None,
+                 member_filter: Optional[Callable[[Node], bool]] = None) -> None:
         self.config = config
         self.on_event = on_event or (lambda kind, payload: None)
         self.event_ltime = 0          # lamport clock for user events
@@ -79,7 +80,8 @@ class SerfPool:
                 tombstone_timeout=config.tombstone_timeout),
             keyring=keyring,
             on_event=self._member_event,
-            on_user_msg=self._user_msg)
+            on_user_msg=self._user_msg,
+            member_filter=member_filter)
         self._snapshot_lines = 0
 
     # -- lifecycle ---------------------------------------------------------

@@ -104,7 +104,8 @@ class Memberlist:
     def __init__(self, config: MemberConfig,
                  keyring: Optional[Any] = None,
                  on_event: Optional[Callable[[str, Node], None]] = None,
-                 on_user_msg: Optional[Callable[[Dict], None]] = None) -> None:
+                 on_user_msg: Optional[Callable[[Dict], None]] = None,
+                 member_filter: Optional[Callable[[Node], bool]] = None) -> None:
         self.config = config
         if not config.advertise_addr:
             config.advertise_addr = config.bind_addr
@@ -113,6 +114,10 @@ class Memberlist:
         # Hook for the serf layer: unknown message types are handed up
         # (user events ride the same piggyback queue).
         self.on_user_msg = on_user_msg or (lambda msg: None)
+        # Merge-delegate role (consul/merge.go): a pool can refuse
+        # members that don't belong (the WAN pool only admits consul
+        # servers; the LAN pool only admits its own datacenter).
+        self.member_filter = member_filter
         self.incarnation = 0
         self.nodes: Dict[str, Node] = {}
         self._seq = 0
@@ -581,6 +586,8 @@ class Memberlist:
         if node is None:
             node = Node(name, w["addr"], w["port"], incarnation=inc,
                         tags=w.get("tags") or {})
+            if self.member_filter is not None and not self.member_filter(node):
+                return  # merge delegate refused (consul/merge.go)
             self.nodes[name] = node
             self._queue_bcast({"t": "alive", **node.wire()})
             self.on_event(EV_JOIN, node)
@@ -589,6 +596,14 @@ class Memberlist:
             return
         if inc < node.incarnation:
             return
+        # Re-run the merge delegate on identity updates too — an admitted
+        # member must not be able to mutate into a filtered-out identity
+        # (e.g. a WAN member dropping its server role) and stay.
+        if self.member_filter is not None:
+            probe = Node(name, w["addr"], w["port"], incarnation=inc,
+                         tags=w.get("tags") or {})
+            if not self.member_filter(probe):
+                return
         was = node.state
         tags_changed = (w.get("tags") or {}) != node.tags
         node.incarnation = inc

@@ -221,3 +221,44 @@ class TestSnapshots:
             await a.stop()
             await b.stop()
         loop.run_until_complete(body())
+
+
+class TestMergeDelegates:
+    """consul/merge.go: pools refuse members that don't belong."""
+
+    def test_lan_pool_refuses_wrong_datacenter(self, loop):
+        async def body():
+            def dc1_only(node):
+                return node.tags.get("dc", "dc1") == "dc1"
+
+            a = SerfPool(_fast("a", server_tags("dc1", 8300)),
+                         member_filter=dc1_only)
+            await a.start()
+            stranger = SerfPool(_fast("x", server_tags("dc2", 8300)))
+            await stranger.start()
+            # the stranger CAN push/pull with a, but a never admits it
+            await stranger.join([f"127.0.0.1:{a.local_addr[1]}"])
+            await asyncio.sleep(0.3)
+            assert "x" not in {n.name for n in a.members()}, \
+                "cross-DC member leaked past the LAN merge delegate"
+            await stranger.stop()
+            await a.stop()
+        loop.run_until_complete(body())
+
+    def test_wan_pool_refuses_non_servers(self, loop):
+        async def body():
+            def servers_only(node):
+                return node.tags.get("role") == "consul"
+
+            a = SerfPool(_fast("a.dc1", server_tags("dc1", 8300)),
+                         member_filter=servers_only)
+            await a.start()
+            client = SerfPool(_fast("c1", client_tags("dc1")))
+            await client.start()
+            await client.join([f"127.0.0.1:{a.local_addr[1]}"])
+            await asyncio.sleep(0.3)
+            assert "c1" not in {n.name for n in a.members()}, \
+                "client member leaked into the WAN pool"
+            await client.stop()
+            await a.stop()
+        loop.run_until_complete(body())
