@@ -62,6 +62,7 @@ class AgentConfig:
     dns_only_passing: bool = False
     dns_allow_stale: bool = False
     dns_max_stale: float = 5.0   # seconds; re-query the leader past this
+    dns_enable_truncate: bool = False  # set TC when capping UDP answers
     recursors: List[str] = field(default_factory=list)
     node_ttl: float = 0.0
     service_ttl: float = 0.0
@@ -137,7 +138,8 @@ class Agent:
                              only_passing=self.config.dns_only_passing,
                              allow_stale=self.config.dns_allow_stale,
                              max_stale=self.config.dns_max_stale,
-                             recursors=self.config.recursors)
+                             recursors=self.config.recursors,
+                             enable_truncate=self.config.dns_enable_truncate)
         self.local = LocalState(self, sync_interval=self.config.ae_interval)
         self.runners = CheckRunnerSet()
         from consul_tpu.agent.events import EventManager

@@ -355,6 +355,7 @@ def to_agent_config(cfg: Config):
         dns_only_passing=cfg.dns_config.only_passing,
         dns_allow_stale=cfg.dns_config.allow_stale,
         dns_max_stale=cfg.dns_config.max_stale,
+        dns_enable_truncate=cfg.dns_config.enable_truncate,
         recursors=list(cfg.recursors),
         node_ttl=cfg.dns_config.node_ttl,
         service_ttl=service_ttl,

@@ -169,7 +169,8 @@ class DNSServer:
                  node_ttl: float = 0.0, service_ttl: float = 0.0,
                  only_passing: bool = False, allow_stale: bool = False,
                  max_stale: float = 5.0,
-                 recursors: Optional[List[str]] = None) -> None:
+                 recursors: Optional[List[str]] = None,
+                 enable_truncate: bool = False) -> None:
         self.agent = agent
         self.domain = domain.rstrip(".").lower() + "."
         self.node_ttl = int(node_ttl)
@@ -178,6 +179,7 @@ class DNSServer:
         self.allow_stale = allow_stale
         self.max_stale = max_stale
         self.recursors = list(recursors or [])
+        self.enable_truncate = enable_truncate
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._tcp_server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[tuple] = None
@@ -377,7 +379,10 @@ class DNSServer:
         truncated = False
         if udp and len(healthy) > MAX_UDP_ANSWERS:
             healthy = healthy[:MAX_UDP_ANSWERS]
-            truncated = False  # reference caps without TC to avoid TCP retries
+            # Default: cap silently to avoid TCP retries; with
+            # enable_truncate the TC bit advertises the cut (the
+            # reference's EnableTruncate knob, config.go DNSConfig).
+            truncated = self.enable_truncate
 
         answers: List[Record] = []
         additional: List[Record] = []

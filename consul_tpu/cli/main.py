@@ -73,8 +73,14 @@ def cmd_agent(args) -> int:
         flag_doc["bootstrap"] = True
     if flag_doc:
         cfg = merge_config(cfg, decode_config(json.dumps(flag_doc)))
-    if not cfg.server and not cfg.bootstrap:
-        # dev-style default: single node agent is a bootstrap server
+    if not cfg.server and not cfg.bootstrap \
+            and "server" not in cfg._set_fields:
+        # dev-style default: when nothing configured the role, run as a
+        # single bootstrap server.  A config that explicitly says
+        # server=false MUST stay a client — promoting it would make
+        # every client agent its own one-node leader.  (Config files
+        # that only carry service/check stanzas still get the dev
+        # default: _set_fields tracks exactly what was written.)
         cfg.server = cfg.bootstrap = True
     problems = validate_config(cfg)
     if problems:

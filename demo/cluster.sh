@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# Local demo cluster — the demo/vagrant-cluster role of the reference,
+# without VMs: three server agents + one client agent on loopback with
+# distinct port blocks, formed via bootstrap_expect + retry_join.
+#
+#   ./demo/cluster.sh up      # start 4 agents (data under /tmp/consul-tpu-demo)
+#   ./demo/cluster.sh status  # members + leader via agent 1
+#   ./demo/cluster.sh demo    # seed a service + KV, query HTTP/DNS
+#   ./demo/cluster.sh down    # stop everything
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROOT=/tmp/consul-tpu-demo
+BASE=23000
+
+cfg() { # name idx server expect
+  local name=$1 idx=$2 server=$3 expect=$4
+  local base=$((BASE + idx * 10))
+  mkdir -p "$ROOT/$name"
+  cat > "$ROOT/$name/config.json" <<EOF
+{
+  "node_name": "$name",
+  "server": $server,
+  "bootstrap": false,
+  "bootstrap_expect": $expect,
+  "bind_addr": "127.0.0.1",
+  "client_addr": "127.0.0.1",
+  "data_dir": "$ROOT/$name/data",
+  "retry_join": ["127.0.0.1:$((BASE + 3))"],
+  "retry_interval": "1s",
+  "log_level": "WARN",
+  "ports": {"http": $base, "dns": $((base + 1)), "rpc": $((base + 2)),
+            "serf_lan": $((base + 3)), "serf_wan": $((base + 4)),
+            "server": $((base + 5))}
+}
+EOF
+}
+
+up() {
+  rm -rf "$ROOT"; mkdir -p "$ROOT"
+  cfg s1 0 true 3; cfg s2 1 true 3; cfg s3 2 true 3; cfg c1 3 false 0
+  for n in s1 s2 s3 c1; do
+    env -u PALLAS_AXON_POOL_IPS python -m consul_tpu.cli.main agent \
+      -config-file "$ROOT/$n/config.json" > "$ROOT/$n/log" 2>&1 &
+    echo $! > "$ROOT/$n/pid"
+    echo "started $n (pid $(cat "$ROOT/$n/pid"))"
+  done
+  echo "waiting for leader..."
+  for _ in $(seq 60); do
+    leader=$(curl -sf "127.0.0.1:$BASE/v1/status/leader" 2>/dev/null || true)
+    [ -n "${leader:-}" ] && [ "$leader" != '""' ] && break
+    sleep 0.5
+  done
+  echo "leader: ${leader:-none}"
+  echo "HTTP: 127.0.0.1:$BASE   UI: http://127.0.0.1:$BASE/ui/   DNS: 127.0.0.1:$((BASE + 1))"
+}
+
+status() {
+  env -u PALLAS_AXON_POOL_IPS python -m consul_tpu.cli.main members \
+    -rpc-addr "127.0.0.1:$((BASE + 2))"
+  echo "leader: $(curl -s "127.0.0.1:$BASE/v1/status/leader")"
+}
+
+demo() {
+  c1http=$((BASE + 30))
+  echo "== register service 'web' on the CLIENT agent =="
+  curl -s -X PUT "127.0.0.1:$c1http/v1/agent/service/register" \
+       -d '{"Name": "web", "Port": 8080, "Tags": ["demo"]}'
+  echo "== write KV through the client =="
+  curl -s -X PUT "127.0.0.1:$c1http/v1/kv/demo/greeting" -d 'hello from c1'
+  echo; sleep 2
+  echo "== service catalog (via server s2) =="
+  curl -s "127.0.0.1:$((BASE + 10))/v1/catalog/service/web"; echo
+  echo "== KV read (via server s3) =="
+  curl -s "127.0.0.1:$((BASE + 20))/v1/kv/demo/greeting?raw"; echo
+  echo "== DNS SRV via the client agent =="
+  command -v dig >/dev/null && \
+    dig +short @127.0.0.1 -p $((c1http + 1)) web.service.consul SRV || \
+    echo "(dig not installed; try: dig @127.0.0.1 -p $((c1http + 1)) web.service.consul SRV)"
+}
+
+down() {
+  for n in s1 s2 s3 c1; do
+    [ -f "$ROOT/$n/pid" ] && kill "$(cat "$ROOT/$n/pid")" 2>/dev/null || true
+  done
+  echo "stopped"
+}
+
+case "${1:-}" in
+  up) up ;;
+  status) status ;;
+  demo) demo ;;
+  down) down ;;
+  *) echo "usage: $0 up|status|demo|down"; exit 1 ;;
+esac

@@ -320,6 +320,8 @@ class TestDNS:
             })
         r = dns_query(harness.dns_addr, "many.service.consul")
         assert r["ancount"] == 3  # dns.go UDP cap
+        # default: capped silently, no TC bit (avoids TCP retries)
+        assert not struct.unpack("!H", r["raw"][2:4])[0] & 0x0200
 
     def test_nxdomain(self, harness):
         assert dns_query(harness.dns_addr, "ghost.service.consul")["rcode"] == RCODE_NXDOMAIN
@@ -340,6 +342,24 @@ class TestDNS:
         r = dns_query(harness.dns_addr, "77.113.0.203.in-addr.arpa",
                       QTYPE_PTR)
         assert r["rcode"] == RCODE_NXDOMAIN
+
+    def test_udp_cap_sets_tc_when_enabled(self):
+        """enable_truncate advertises the UDP cut with the TC bit
+        (DNSConfig.EnableTruncate role)."""
+        h = AgentHarness(AgentConfig(http_port=0, dns_port=0,
+                                     dns_enable_truncate=True)).start()
+        try:
+            with httpx.Client(base_url=h.http_addr, timeout=10) as c:
+                for i in range(6):
+                    c.put("/v1/catalog/register", json={
+                        "Node": f"tc{i}", "Address": f"10.6.0.{i + 1}",
+                        "Service": {"Service": "tcsvc", "Port": 80}})
+            r = dns_query(h.dns_addr, "tcsvc.service.consul")
+            assert r["ancount"] == 3
+            flags = struct.unpack("!H", r["raw"][2:4])[0]
+            assert flags & 0x0200, "TC bit not set despite enable_truncate"
+        finally:
+            h.stop()
 
     def test_out_of_domain_refused_without_recursors(self, harness):
         from consul_tpu.agent.dns import RCODE_REFUSED

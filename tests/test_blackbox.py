@@ -90,6 +90,38 @@ class TestSingleAgentBlackBox:
         assert merged, "no metrics recorded"
 
 
+class TestClientAgentBlackBox:
+    def test_forked_client_stays_client(self):
+        """A config with server=false must NOT be promoted to a
+        bootstrap server by the CLI's dev-mode default (regression: the
+        client agent elected itself leader)."""
+        srv = TestServer("bbc-s1").start()
+        cli = None
+        try:
+            srv.wait_for_api()
+            srv.wait_for_leader()
+            cli = TestServer("bbc-c1", server=False, bootstrap=False,
+                             retry_join=[srv.lan_addr]).start()
+            cli.wait_for_api()
+            me = cli.http_get("/v1/agent/self")
+            assert me["Config"]["Server"] is False, me["Config"]
+            # its leader is the REAL server, not itself
+            assert cli.wait_for_leader(30) == "bbc-s1"
+            # KV via the client lands on the server
+            assert cli.http_put("/v1/kv/via-client", b"x") is True
+            got = srv.http_get("/v1/kv/via-client")
+            assert got and got[0]["Key"] == "via-client"
+        except Exception:
+            print(srv.output()[-1500:])
+            if cli:
+                print(cli.output()[-1500:])
+            raise
+        finally:
+            if cli:
+                cli.stop()
+            srv.stop()
+
+
 class TestClusterBlackBox:
     def test_three_forked_servers_form_a_cluster(self):
         """BASELINE config #1 shape, fully black-box: three real agent
