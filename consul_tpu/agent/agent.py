@@ -171,6 +171,7 @@ class Agent:
         self._bootstrapped = self.config.bootstrap_expect == 0
         self._wan_servers: Dict[str, Dict[str, str]] = {}  # dc -> name -> addr
         self._retry_join_task: Optional[asyncio.Task] = None
+        self._check_state_dir_made = False
 
     @property
     def node_name(self) -> str:
@@ -613,6 +614,16 @@ class Agent:
         if check_type is not None:
             if not check_type.valid():
                 raise ValueError("Check type is not valid")
+            # TTL checks with unexpired saved state resume the app's
+            # last heartbeat instead of critical (loadCheckState lives
+            # in AddCheck in the reference, agent.go:929-959 — this
+            # covers config-defined checks too, not just persisted
+            # definitions).
+            if check_type.is_ttl():
+                st = self._load_check_state(check.check_id)
+                if st is not None:
+                    check.status = st["status"]
+                    check.output = st.get("output", "")
             self.runners.start_check(self.local, check.check_id, check_type)
         self.local.add_check(check, token)
         if persist:
@@ -626,6 +637,11 @@ class Agent:
         self.local.remove_check(check_id)
         if persist:
             self._unpersist("checks", check_id)
+            if self.config.data_dir:
+                try:
+                    os.remove(self._check_state_path(check_id))
+                except OSError:
+                    pass
 
     def update_ttl_check(self, check_id: str, status: str, output: str) -> None:
         """TTL heartbeat from the app (agent_endpoint.go pass/warn/fail)."""
@@ -634,6 +650,53 @@ class Agent:
             raise ValueError(f'CheckID "{check_id}" does not have '
                              f'associated TTL')
         ttl.set_status(status, output)
+        self._persist_check_state(check_id, status, output, ttl.ttl)
+
+    # -- TTL check-state persistence (persistCheckState/loadCheckState,
+    # agent.go:890-959): a restart inside the TTL window restores the
+    # app's last heartbeat instead of flipping critical. ------------------
+
+    def _check_state_path(self, check_id: str) -> str:
+        import hashlib
+        h = hashlib.sha1(check_id.encode()).hexdigest()[:16]
+        return os.path.join(self.config.data_dir, "checks", "state", h)
+
+    def _persist_check_state(self, check_id: str, status: str, output: str,
+                             ttl: float) -> None:
+        if not self.config.data_dir:
+            return
+        import time as _t
+        path = self._check_state_path(check_id)
+        try:
+            if not self._check_state_dir_made:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                self._check_state_dir_made = True
+            # Atomic replace: heartbeats rewrite this file constantly,
+            # and a torn write would lose the state in exactly the
+            # crash-restart case it exists for (same idiom as _persist).
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"check_id": check_id, "status": status,
+                           "output": output,
+                           "expires": _t.time() + ttl}, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _load_check_state(self, check_id: str):
+        """Saved TTL state, or None if absent/expired (agent.go:929-959
+        discards stale state)."""
+        if not self.config.data_dir:
+            return None
+        import time as _t
+        try:
+            with open(self._check_state_path(check_id)) as f:
+                st = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if st.get("check_id") != check_id or st.get("expires", 0) < _t.time():
+            return None
+        return st
 
     # -- maintenance mode (agent.go:1229-1320) ------------------------------
 
@@ -725,17 +788,20 @@ class Agent:
         if os.path.isdir(d):
             from consul_tpu.agent.http_api import _check_from_api
             for fn in sorted(os.listdir(d)):
+                if not os.path.isfile(os.path.join(d, fn)):
+                    continue  # e.g. the state/ subdir of TTL heartbeats
                 try:
                     with open(os.path.join(d, fn)) as f:
                         payload = json.load(f)
                     check = _check_from_api(payload["check"])
                     check.node = self.config.node_name
                     # persisted checks resume critical until their runner
-                    # reports (agent.go:1109-1127)
+                    # reports (agent.go:1109-1127)...
                     check.status = HEALTH_CRITICAL
                     check.output = ""
                     ct = (CheckType(**payload["check_type"])
                           if payload.get("check_type") else None)
+                    # (TTL saved-state restore happens inside add_check)
                     loop.create_task(self.add_check(
                         check, ct, payload.get("token", ""), persist=False))
                 except (json.JSONDecodeError, KeyError, TypeError):

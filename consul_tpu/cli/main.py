@@ -73,14 +73,16 @@ def cmd_agent(args) -> int:
         flag_doc["bootstrap"] = True
     if flag_doc:
         cfg = merge_config(cfg, decode_config(json.dumps(flag_doc)))
-    if not cfg.server and not cfg.bootstrap \
-            and "server" not in cfg._set_fields:
+    role_configured = cfg._set_fields & {"server", "bootstrap",
+                                         "bootstrap_expect"}
+    if not cfg.server and not cfg.bootstrap and not role_configured:
         # dev-style default: when nothing configured the role, run as a
-        # single bootstrap server.  A config that explicitly says
-        # server=false MUST stay a client — promoting it would make
-        # every client agent its own one-node leader.  (Config files
-        # that only carry service/check stanzas still get the dev
-        # default: _set_fields tracks exactly what was written.)
+        # single bootstrap server.  Any explicit role statement —
+        # server=false, bootstrap=false, or a bootstrap_expect — must
+        # be honored as written; promoting it would make a would-be
+        # client or joining node its own one-node leader.  (Config
+        # files that only carry service/check stanzas still get the
+        # dev default: _set_fields tracks exactly what was written.)
         cfg.server = cfg.bootstrap = True
     problems = validate_config(cfg)
     if problems:

@@ -169,6 +169,48 @@ class TestAgentRegistry:
 
         loop.run_until_complete(body())
 
+    def test_ttl_state_survives_restart_within_window(self, loop, tmp_path):
+        """persistCheckState/loadCheckState (agent.go:890-959): a TTL
+        check restarted inside its window resumes the app's last
+        heartbeat instead of flipping critical; expired state is
+        discarded."""
+        async def body():
+            agent = _mk_agent(tmp_path)
+            await agent.start()
+            await agent.add_check(
+                HealthCheck(node="node1", check_id="hb", name="hb"),
+                CheckType(ttl=60))
+            agent.update_ttl_check("hb", HEALTH_PASSING, "app alive")
+            await agent.stop()
+
+            agent2 = _mk_agent(tmp_path)
+            await agent2.start()
+            ok = await _wait_for(lambda: "hb" in agent2.local.checks)
+            assert ok
+            assert agent2.local.checks["hb"].status == HEALTH_PASSING
+            assert agent2.local.checks["hb"].output == "app alive"
+            await agent2.stop()
+
+            # expired saved state must NOT be restored
+            import glob
+            import json as _json
+            state_files = glob.glob(str(tmp_path / "checks" / "state" / "*"))
+            assert state_files
+            for sf in state_files:
+                with open(sf) as f:
+                    st = _json.load(f)
+                st["expires"] = 1.0  # long past
+                with open(sf, "w") as f:
+                    _json.dump(st, f)
+            agent3 = _mk_agent(tmp_path)
+            await agent3.start()
+            ok = await _wait_for(lambda: "hb" in agent3.local.checks)
+            assert ok
+            assert agent3.local.checks["hb"].status == HEALTH_CRITICAL
+            await agent3.stop()
+
+        loop.run_until_complete(body())
+
     def test_persistence_roundtrip(self, loop, tmp_path):
         async def body():
             agent = _mk_agent(tmp_path)
