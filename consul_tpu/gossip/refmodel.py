@@ -24,6 +24,8 @@ import random
 from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from consul_tpu.gossip.params import SwimParams
 
 ALIVE, SUSPECT, DEAD = 0, 1, 2
@@ -77,9 +79,15 @@ class RefModel:
         self.beliefs: List[Dict[int, Belief]] = [dict() for _ in range(self.n)]
         self.queues: List[List[Broadcast]] = [[] for _ in range(self.n)]
         self.incarnation = [0] * self.n
-        self.members: List[Set[int]] = [set(range(self.n)) - {i} for i in range(self.n)]
-        # Round-robin probe lists (memberlist: shuffled sweep, reshuffle at end).
-        self.probe_list: List[List[int]] = [self._shuffled(i) for i in range(self.n)]
+        # Membership views are stored SPARSELY as per-node exclusion
+        # sets (nodes believed dead): everyone starts believing everyone
+        # is a member, and a dense per-node member set would be O(n²)
+        # memory — ~13 GB at n=10k, which made large oracle runs swap.
+        self.not_member: List[Set[int]] = [set() for _ in range(self.n)]
+        # Round-robin probe lists (memberlist: shuffled sweep, reshuffle
+        # at end).  Lazy + int32-packed: eager Python lists were the
+        # other O(n²) memory sink (~4 GB at n=10k).
+        self.probe_list: List[Optional[np.ndarray]] = [None] * self.n
         self.probe_pos = [0] * self.n
         self.probe_offset = [self.rng.randrange(p.probe_every) for _ in range(self.n)]
         # Suspicion timers: (observer, subject) -> deadline handled lazily.
@@ -89,15 +97,61 @@ class RefModel:
         self.n_refuted = 0
         self.n_false_dead = 0
         self.dissemination: Dict[int, List[Tuple[int, int]]] = defaultdict(list)
+        # Incremental dissemination bookkeeping: observers currently
+        # holding the dead verdict per subject.  Replaces an O(n) scan
+        # per dead subject per tick, which dominated 10k-node oracle
+        # runs in the cross-validation harness.
+        self._dead_knowers: Dict[int, Set[int]] = defaultdict(set)
         # Same Lifeguard decay the kernel uses — one source of truth.
         self._timeouts = p.timeout_table()
 
     # -- helpers ----------------------------------------------------------
 
-    def _shuffled(self, i: int) -> List[int]:
-        lst = [x for x in range(self.n) if x != i]
-        self.rng.shuffle(lst)
-        return lst
+    def _shuffled(self, i: int) -> np.ndarray:
+        """Fresh shuffled probe ring for node i: current members only,
+        int32-packed (memberlist reshuffles its node ring per sweep)."""
+        rng = np.random.default_rng(self.rng.getrandbits(64))
+        perm = rng.permutation(self.n).astype(np.int32)
+        drop = self.not_member[i] | {i}
+        if drop:
+            mask = np.ones(self.n, bool)
+            mask[list(drop)] = False
+            perm = perm[mask[perm]]
+        return perm
+
+    def _is_member(self, i: int, x: int) -> bool:
+        return x != i and x not in self.not_member[i]
+
+    def _member_count(self, i: int) -> int:
+        return self.n - 1 - len(self.not_member[i])
+
+    def _sample_members(self, i: int, k: int,
+                        exclude: Tuple[int, ...] = ()) -> List[int]:
+        """k distinct members of i's view (rejection sampling — the
+        exclusion set is tiny relative to n, so acceptance is high).
+        Falls back to an explicit scan for tiny viable sets."""
+        viable = self._member_count(i) - sum(
+            1 for e in set(exclude) if self._is_member(i, e))
+        k = min(k, max(0, viable))
+        if k <= 0:
+            return []
+        out: List[int] = []
+        seen = set(exclude)
+        seen.add(i)
+        attempts = 0
+        while len(out) < k and attempts < 20 * (k + 1):
+            attempts += 1
+            x = self.rng.randrange(self.n)
+            if x in seen or x in self.not_member[i]:
+                continue
+            seen.add(x)
+            out.append(x)
+        if len(out) < k:  # dense fallback (view almost empty)
+            pool = [x for x in range(self.n)
+                    if x not in seen and x not in self.not_member[i]]
+            self.rng.shuffle(pool)
+            out.extend(pool[: k - len(out)])
+        return out
 
     def _alive_truth(self, i: int) -> bool:
         return self.fail_tick.get(i, 1 << 60) > self.tick
@@ -153,7 +207,8 @@ class RefModel:
             if b.status == DEAD or msg.inc < b.inc:
                 return
             b.status, b.inc, b.heard_tick = DEAD, msg.inc, self.tick
-            self.members[i].discard(subject)
+            self.not_member[i].add(subject)
+            self._dead_knowers[subject].add(i)
             self._enqueue(i, msg)
         elif msg.kind == REFUTE:
             if msg.inc <= b.inc and b.status != ALIVE:
@@ -161,11 +216,18 @@ class RefModel:
             if msg.inc > b.inc:
                 b.status, b.inc, b.heard_tick = ALIVE, msg.inc, self.tick
                 b.confirmers = None
+                # Faithfulness fix (was a latent oracle bug): memberlist's
+                # aliveNode at a newer incarnation RE-ADMITS the subject to
+                # the membership view; the old dense-set code left a
+                # refuted node permanently excluded from members[i].
+                self.not_member[i].discard(subject)
+                self._dead_knowers[subject].discard(i)
                 self._enqueue(i, msg)
 
     def _declare_dead(self, i: int, subject: int, b: Belief) -> None:
         b.status = DEAD
-        self.members[i].discard(subject)
+        self.not_member[i].add(subject)
+        self._dead_knowers[subject].add(i)
         if subject not in self.dead_declared:
             self.dead_declared[subject] = self.tick
             truly = not self._alive_truth(subject)
@@ -180,27 +242,28 @@ class RefModel:
     # -- per-tick phases --------------------------------------------------
 
     def _probe(self, i: int) -> None:
-        if not self.members[i]:
+        if self._member_count(i) <= 0:
             return
         # next round-robin target still believed a member
-        for _ in range(len(self.probe_list[i]) + 1):
-            if self.probe_pos[i] >= len(self.probe_list[i]):
-                self.probe_list[i] = self._shuffled(i)
-                self.probe_list[i] = [t for t in self.probe_list[i] if t in self.members[i]]
+        ring = self.probe_list[i]
+        if ring is None:
+            ring = self.probe_list[i] = self._shuffled(i)
+        for _ in range(len(ring) + 1):
+            if self.probe_pos[i] >= len(ring):
+                ring = self.probe_list[i] = self._shuffled(i)
                 self.probe_pos[i] = 0
-                if not self.probe_list[i]:
+                if len(ring) == 0:
                     return
-            t = self.probe_list[i][self.probe_pos[i]]
+            t = int(ring[self.probe_pos[i]])
             self.probe_pos[i] += 1
-            if t in self.members[i]:
+            if self._is_member(i, t):
                 break
         else:
             return
         target_up = self._alive_truth(t)
         ok = target_up and not self._lost() and not self._lost()
         if not ok:
-            helpers = self.rng.sample(sorted(self.members[i] - {t}),
-                                      min(self.p.indirect_k, max(0, len(self.members[i]) - 1)))
+            helpers = self._sample_members(i, self.p.indirect_k, exclude=(t,))
             for h in helpers:
                 if not self._alive_truth(h):
                     continue
@@ -223,10 +286,9 @@ class RefModel:
                     self._enqueue(i, Message(SUSPECT, t, b.inc, i))
 
     def _gossip(self, i: int) -> None:
-        if not self.queues[i] or not self.members[i]:
+        if not self.queues[i] or self._member_count(i) <= 0:
             return
-        k = min(self.p.fanout, len(self.members[i]))
-        targets = self.rng.sample(sorted(self.members[i]), k)
+        targets = self._sample_members(i, self.p.fanout)
         for b in list(self.queues[i]):
             for t in targets:
                 if b.remaining <= 0:
@@ -261,13 +323,12 @@ class RefModel:
         for i in range(self.n):
             if self._alive_truth(i):
                 self._timers(i)
-        # dissemination curve for failed subjects
+        # dissemination curve for failed subjects (incremental count;
+        # includes observers that themselves die later — the curve is
+        # monotone either way and its consumers check the peak)
         for subject in self.dead_declared:
-            knows = sum(1 for i in range(self.n)
-                        if self._alive_truth(i)
-                        and self.beliefs[i].get(subject) is not None
-                        and self.beliefs[i][subject].status == DEAD)
-            self.dissemination[subject].append((t, knows))
+            self.dissemination[subject].append(
+                (t, len(self._dead_knowers[subject])))
         self.tick += 1
 
     def run(self, ticks: int) -> None:
