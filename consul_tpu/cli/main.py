@@ -14,6 +14,7 @@ import argparse
 import base64
 import json
 import os
+import re
 import signal
 import sys
 from typing import List, Optional
@@ -281,10 +282,31 @@ def cmd_leave(args) -> int:
 def cmd_members(args) -> int:
     with _ipc(args) as c:
         members = c.members_wan() if args.wan else c.members_lan()
+    # -status / -role regex filters + -detailed protocol column
+    # (command/members.go flags).
+    try:
+        status_pat = re.compile(args.status) if args.status else None
+        role_pat = re.compile(args.role) if args.role else None
+    except re.error as e:
+        print(f"Failed to compile filter regexp: {e}", file=sys.stderr)
+        return 1
+    if status_pat is not None:
+        members = [m for m in members
+                   if status_pat.search(m.get("Status", ""))]
+    if role_pat is not None:
+        members = [m for m in members
+                   if role_pat.search(m.get("Tags", {}).get("role", ""))]
     for m in members:
         tags = ",".join(f"{k}={v}" for k, v in sorted(m.get("Tags", {}).items()))
-        print(f"{m['Name']:<20} {m['Addr']}:{m['Port']:<6} "
-              f"{m.get('Status', '?'):<8} {tags}")
+        line = (f"{m['Name']:<20} {m['Addr']}:{m['Port']:<6} "
+                f"{m.get('Status', '?'):<8} {tags}")
+        if args.detailed:
+            line += f"  protocol={m.get('ProtocolCur', '?')}"
+        print(line)
+    # Filters that leave nothing signal exit 2 (command/members.go),
+    # so scripts can branch on presence.
+    if (status_pat is not None or role_pat is not None) and not members:
+        return 2
     return 0
 
 
@@ -567,6 +589,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("members", help="List cluster members")
     _add_rpc_flag(p)
     p.add_argument("-wan", action="store_true")
+    p.add_argument("-detailed", action="store_true",
+                   help="show protocol versions")
+    p.add_argument("-status", default="", help="regex filter on status")
+    p.add_argument("-role", default="", help="regex filter on role tag")
     p.set_defaults(fn=cmd_members)
 
     p = sub.add_parser("monitor", help="Stream agent logs")
