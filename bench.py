@@ -93,6 +93,16 @@ def _setup_jax(retries: int = 2, probe_timeout_s: float = 240.0):
     return jax
 
 
+def _sync(jax, state) -> None:
+    """Wait for the step to FINISH, not merely be enqueued.  On the
+    tunneled axon backend block_until_ready can return once the handle
+    is committed rather than executed (observed: 2.8M rounds/s, ~1000x
+    the HBM roofline — physically impossible); a device->host scalar
+    fetch cannot lie about completion."""
+    jax.block_until_ready(state)
+    int(state.round if hasattr(state, "round") else jax.tree.leaves(state)[0])
+
+
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int) -> dict:
     import jax.numpy as jnp
 
@@ -118,7 +128,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int) -> dict:
     _log(f"lan n={n} slots={slots}: compiling + warmup ({steps} rounds)")
     t0 = time.perf_counter()
     state, _ = run_rounds(state, key, fail_round, p, steps=steps)
-    jax.block_until_ready(state)
+    _sync(jax, state)
     compile_s = time.perf_counter() - t0
     _log(f"compile+warmup done in {compile_s:.1f}s")
 
@@ -126,7 +136,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int) -> dict:
     for r in range(repeats):
         t0 = time.perf_counter()
         state, _ = run_rounds(state, key, fail_round, p, steps=steps)
-        jax.block_until_ready(state)
+        _sync(jax, state)
         dt = time.perf_counter() - t0
         best = min(best, dt)
         _log(f"block {r + 1}/{repeats}: {steps / dt:.1f} rounds/s")
@@ -171,7 +181,7 @@ def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
     t0 = time.perf_counter()
     state, _ = run_multidc_rounds(state, key, lan_fail, wan_fail, p,
                                   steps=steps)
-    jax.block_until_ready(state)
+    _sync(jax, state.wan)
     compile_s = time.perf_counter() - t0
     _log(f"compile+warmup done in {compile_s:.1f}s")
 
@@ -180,7 +190,7 @@ def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
         t0 = time.perf_counter()
         state, _ = run_multidc_rounds(state, key, lan_fail, wan_fail, p,
                                       steps=steps)
-        jax.block_until_ready(state)
+        _sync(jax, state.wan)
         dt = time.perf_counter() - t0
         best = min(best, dt)
         _log(f"block {r + 1}/{repeats}: {steps / dt:.1f} rounds/s")
@@ -213,9 +223,10 @@ def main() -> None:
                              ".bench_last_success.json")
 
     def _read_last_good() -> dict | None:
-        """Cached measurements, keyed per bench so the LAN and multidc
-        variants never report each other's numbers.  A corrupt cache
-        must never take down the metric emit."""
+        """Cached measurements, keyed by full metric name (bench variant
+        + size) so a small-n smoke run never displaces the headline 1M
+        number.  Lookup prefers the largest n among entries of this
+        variant.  A corrupt cache must never take down the metric emit."""
         try:
             with open(last_path) as f:
                 cache = json.load(f)
@@ -223,11 +234,14 @@ def main() -> None:
             return None
         if not isinstance(cache, dict):
             return None
-        entry = cache.get(fail_metric)
+        candidates = [v for k, v in cache.items()
+                      if k.startswith(fail_metric) and isinstance(v, dict)]
         # pre-keying format: a single flat result dict
-        if entry is None and str(cache.get("metric", "")).startswith(fail_metric):
-            entry = cache
-        return entry
+        if not candidates and str(cache.get("metric", "")).startswith(fail_metric):
+            candidates = [cache]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda v: v.get("n_nodes", 0))
 
     def _emit_failure(err: str) -> None:
         # The tunnel to the chip wedges occasionally (grant held by a
@@ -267,8 +281,8 @@ def main() -> None:
                         cache = {}
                 except (OSError, ValueError):
                     cache = {}
-                cache[fail_metric] = {**result,
-                                      "measured_unix": int(time.time())}
+                cache[result["metric"]] = {**result,
+                                           "measured_unix": int(time.time())}
                 with open(last_path, "w") as f:
                     json.dump(cache, f)
             except OSError:

@@ -93,6 +93,7 @@ class AgentConfig:
     serf_timing: Dict[str, float] = field(default_factory=dict)
     raft_config: Optional[Any] = None   # RaftConfig override (tests)
     reconcile_interval: float = 60.0    # leader full-reconcile cadence
+    enable_debug: bool = False  # route /debug/pprof/* (http.go:259-264)
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

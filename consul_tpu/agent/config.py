@@ -375,4 +375,5 @@ def to_agent_config(cfg: Config):
         acl_master_token=cfg.acl_master_token,
         acl_token=cfg.acl_token,
         encrypt=cfg.encrypt,
+        enable_debug=cfg.enable_debug,
     )

@@ -1,0 +1,123 @@
+"""Runtime profiling endpoints: the ``/debug/pprof/*`` role.
+
+The reference registers Go's pprof handlers when ``EnableDebug`` is set
+(``command/agent/http.go:259-264``): CPU profile, goroutine dump, heap
+profile.  The Python-runtime equivalents served here (text/plain, in
+the spirit of ``pprof?debug=1`` output):
+
+* ``/debug/pprof/profile?seconds=N`` — cProfile capture of the agent's
+  event-loop thread for N seconds (the loop thread is where all agent
+  work happens, so this is the CPU profile that matters).
+* ``/debug/pprof/goroutine`` — every thread's current stack plus every
+  asyncio task's stack (tasks are this runtime's goroutines).
+* ``/debug/pprof/heap?seconds=N`` — tracemalloc growth capture: starts
+  tracing on first use, reports the top allocation sites and the delta
+  over the sample window.
+
+All three are read-only diagnostics; like the reference they are only
+routed when ``enable_debug`` is set in the agent config.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import cProfile
+import io
+import pstats
+import sys
+import threading
+import traceback
+
+
+def _clamp_seconds(request, default: float = 2.0, hi: float = 30.0) -> float:
+    try:
+        s = float(request.query.get("seconds", default))
+    except ValueError:
+        s = default
+    return max(0.1, min(hi, s))
+
+
+_profile_active = False
+
+
+async def profile(request):
+    """CPU profile of the event-loop thread over the sample window."""
+    global _profile_active
+    from aiohttp import web
+
+    # cProfile is process-global: a second concurrent enable() raises.
+    # Mirror net/http/pprof, which serves one CPU profile at a time.
+    if _profile_active:
+        return web.Response(status=503, text="cpu profile already running\n")
+    seconds = _clamp_seconds(request)
+    prof = cProfile.Profile()
+    _profile_active = True
+    try:
+        prof.enable()
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+    finally:
+        _profile_active = False
+    out = io.StringIO()
+    stats = pstats.Stats(prof, stream=out)
+    stats.sort_stats("cumulative").print_stats(60)
+    return web.Response(
+        text=f"# cpu profile: event-loop thread, {seconds:.1f}s window\n"
+             + out.getvalue(),
+        content_type="text/plain")
+
+
+async def goroutine(request):
+    """All thread stacks + all asyncio task stacks."""
+    from aiohttp import web
+
+    out = io.StringIO()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    frames = sys._current_frames()
+    out.write(f"# {len(frames)} threads\n")
+    for ident, frame in frames.items():
+        out.write(f"\n-- thread {names.get(ident, '?')} ({ident}) --\n")
+        out.write("".join(traceback.format_stack(frame)))
+
+    tasks = [t for t in asyncio.all_tasks() if not t.done()]
+    out.write(f"\n# {len(tasks)} asyncio tasks\n")
+    for t in tasks:
+        out.write(f"\n-- task {t.get_name()} --\n")
+        buf = io.StringIO()
+        t.print_stack(limit=12, file=buf)
+        out.write(buf.getvalue())
+    return web.Response(text=out.getvalue(), content_type="text/plain")
+
+
+async def heap(request):
+    """Top allocation sites and growth over the sample window."""
+    import tracemalloc
+
+    from aiohttp import web
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+    seconds = _clamp_seconds(request)
+    before = tracemalloc.take_snapshot()
+    await asyncio.sleep(seconds)
+    after = tracemalloc.take_snapshot()
+
+    out = io.StringIO()
+    cur, peak = tracemalloc.get_traced_memory()
+    out.write(f"# heap: traced={cur / 1024:.0f}KiB peak={peak / 1024:.0f}KiB, "
+              f"{seconds:.1f}s growth window\n\n== top sites ==\n")
+    for stat in after.statistics("lineno")[:30]:
+        out.write(f"{stat}\n")
+    out.write("\n== growth over window ==\n")
+    for stat in after.compare_to(before, "lineno")[:30]:
+        out.write(f"{stat}\n")
+    return web.Response(text=out.getvalue(), content_type="text/plain")
+
+
+def register(router, h) -> None:
+    """Mount the pprof-role routes (call only when enable_debug is set)."""
+    router.add_get("/debug/pprof/profile", h(profile))
+    router.add_get("/debug/pprof/goroutine", h(goroutine))
+    router.add_get("/debug/pprof/heap", h(heap))

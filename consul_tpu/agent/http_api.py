@@ -163,6 +163,12 @@ class HTTPServer:
             r.add_get("/ui/", h(ui_index))
             r.add_static("/ui/", ui_dir)
 
+        # pprof-role profiling endpoints, gated exactly like the
+        # reference's EnableDebug (command/agent/http.go:259-264).
+        if self.agent.config.enable_debug:
+            from consul_tpu.agent import debug
+            debug.register(r, h)
+
         self.agent.register_http_routes(r, h)
 
     def _handler(self, fn):

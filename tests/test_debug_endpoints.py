@@ -1,0 +1,59 @@
+"""Profiling endpoints (/debug/pprof/* role, gated on enable_debug —
+reference: command/agent/http.go:259-264 registers Go pprof only when
+EnableDebug is set)."""
+
+import httpx
+import pytest
+
+from consul_tpu.agent import AgentConfig
+
+from test_agent_http import AgentHarness
+
+
+@pytest.fixture(scope="module")
+def debug_harness():
+    h = AgentHarness(AgentConfig(http_port=0, dns_port=0,
+                                 enable_debug=True)).start()
+    yield h
+    h.stop()
+
+
+def test_debug_routes_absent_without_flag():
+    h = AgentHarness().start()  # enable_debug defaults to False
+    try:
+        r = httpx.get(h.http_addr + "/debug/pprof/goroutine", timeout=5)
+        assert r.status_code == 404
+    finally:
+        h.stop()
+
+
+def test_goroutine_dump(debug_harness):
+    r = httpx.get(debug_harness.http_addr + "/debug/pprof/goroutine",
+                  timeout=5)
+    assert r.status_code == 200
+    # The dump must include real thread stacks and the agent's tasks.
+    assert "threads" in r.text and "asyncio tasks" in r.text
+    assert "-- thread" in r.text
+
+
+def test_cpu_profile(debug_harness):
+    r = httpx.get(debug_harness.http_addr
+                  + "/debug/pprof/profile?seconds=0.2", timeout=10)
+    assert r.status_code == 200
+    assert "cpu profile" in r.text
+    assert "cumulative" in r.text  # pstats table rendered
+
+
+def test_heap_profile(debug_harness):
+    r = httpx.get(debug_harness.http_addr + "/debug/pprof/heap?seconds=0.2",
+                  timeout=10)
+    assert r.status_code == 200
+    assert "top sites" in r.text and "growth over window" in r.text
+
+
+def test_seconds_clamped(debug_harness):
+    # Bogus/huge windows must not hang the endpoint: clamped to [0.1, 30]
+    # (and "bogus" falls back to the default).
+    r = httpx.get(debug_harness.http_addr
+                  + "/debug/pprof/profile?seconds=bogus", timeout=10)
+    assert r.status_code == 200
