@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
-from consul_tpu.ops.feistel import feistel_inverse
+from consul_tpu.ops.feistel import gossip_partners, gossip_sources
 
 _SEEN = 0x80
 _AGE_MASK = 0x0F
@@ -118,13 +118,16 @@ def event_round(state: EventState, base_key: jax.Array, alive: jnp.ndarray,
     cur = state.has
     seen = (cur & _SEEN) > 0
 
-    # fanout deliveries via inverse-permutation gathers
+    # fanout deliveries via inverse-permutation gathers (ARX sources —
+    # the same multiply-free fixed-walk construction the membership
+    # kernel uses; see ops/feistel.py module note on the ≤1% clamp
+    # residual)
     rx_ok = alive
     new_seen = jnp.zeros_like(seen)
     ids = jnp.arange(N, dtype=jnp.int32)
+    srcs_all = gossip_sources(key, N, p.fanout)
     for f in range(p.fanout):
-        kf = jax.random.fold_in(key, f)
-        srcs = feistel_inverse(jnp.arange(N, dtype=jnp.uint32), kf, N).astype(jnp.int32)
+        srcs = srcs_all[f]
         src_ok = alive[srcs] & (srcs != ids)
         hin = cur[:, srcs]
         active = (src_ok[None, :] & ((hin & _SEEN) > 0)
@@ -134,14 +137,9 @@ def event_round(state: EventState, base_key: jax.Array, alive: jnp.ndarray,
     # push/pull anti-entropy: full-state sync with one partner, spread
     # budget ignored (this recovers events that aged out under loss)
     if p.pushpull_every:
-        from consul_tpu.ops.feistel import feistel_permute
-
         def _pp(ns):
             kpp = jax.random.fold_in(key, 9)
-            fwd = feistel_inverse(jnp.arange(N, dtype=jnp.uint32),
-                                  kpp, N).astype(jnp.int32)
-            rev = feistel_permute(jnp.arange(N, dtype=jnp.uint32),
-                                  kpp, N).astype(jnp.int32)
+            fwd, rev = gossip_partners(kpp, N)
             for partner in (fwd, rev):
                 ok = rx_ok & alive[partner] & (partner != ids)
                 hin = cur[:, partner]
