@@ -26,11 +26,13 @@ episode, recycled after the episode resolves (dead / refuted) and its
 verdict has disseminated; overflow is *counted* (``drops``), never
 silent.
 
-**Communication as gathers.**  Each round every node pushes its active
+**Communication as rolls.**  Each round every node pushes its active
 rumors to ``fanout`` peers.  The round's communication graph is
-``fanout`` keyed Feistel permutations (consul_tpu.ops.feistel), so the
-senders into node d are ``perm_f^{-1}(d)`` — delivery is ``fanout``
-vectorized gathers along the observer axis, no sort/scatter.
+``fanout`` random circulant shifts redrawn per round (node ``i`` pushes
+to ``i + o_f``), so the senders into node ``d`` are ``d - o_f`` —
+delivery is ``fanout`` contiguous rolls along the observer axis, which
+move at memory bandwidth where an arbitrary-permutation gather pays
+~6.5ns per random index on TPU (see ``gossip_offsets``).
 
 **Timers.**  One round = one gossip interval; each node probes once
 every ``probe_every`` rounds, staggered in contiguous id blocks so a
@@ -43,7 +45,9 @@ both models, so detection-time statistics are preserved (validated in
 tests against the discrete-event reference model).
 
 Known approximations vs stock memberlist: exactly-``fanout`` in-degree
-per round (permutation gossip) instead of Poisson(fanout); uniform
+per round with round-shared circulant shifts (targets correlated across
+nodes within a round; each node's target sequence over rounds uniform)
+instead of per-node Poisson(fanout) push; uniform
 random probe targets instead of shuffled round-robin sweeps;
 episode-start-based suspicion timers; confirmation counts capped at 3
 and approximated by receipt rounds rather than distinct-origin tracking;
@@ -65,8 +69,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
-from consul_tpu.ops.feistel import (
-    gossip_partners, gossip_sources, random_targets)
 
 MSG_NONE = 0
 MSG_SUSPECT = 1
@@ -158,53 +160,87 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
     per-node behavior: memberlist probe cycle as configured at
     consul/config.go:266-272, with per-node stagger).
 
-    ``mf`` packs membership and ground truth into one gatherable i32:
+    ``mf`` packs membership and ground truth into one readable i32:
     ``member ? fail_round : -1`` — so ``mf[x] > rnd`` is alive-member
-    and ``mf[x] >= 0`` is member, one gather instead of two.
+    and ``mf[x] >= 0`` is member, one read instead of two.
 
-    Helpers are sampled uniformly excluding the prober (collision with
-    the target has probability k/N — negligible, accepted)."""
+    Targets and helpers are circulant like the gossip graph
+    (``tgt = pid + o`` with fresh per-round offsets): each prober's
+    target sequence over cycles is uniform, and within one round the
+    prober block sweeps a contiguous shifted block — closer to
+    memberlist's shuffled round-robin sweep than iid uniform draws, and
+    every membership lookup becomes a slice of a rolled array instead
+    of a ~6.5ns/index random gather (tools/profile_kernel.py).  Helper
+    collision with the target has probability k/N — negligible,
+    accepted."""
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = state_tuple
-    k_t, k_dl, k_h, k_hl = keys
+    k_t, k_dl, _k_h, k_hl = keys
     N, S = p.n, p.slots
     B = _block_size(p)
 
     # This round's probers: block (rnd % probe_every); ids >= N are
     # padding lanes on the final block and initiate nothing.
-    pid = (rnd % p.probe_every) * B + jnp.arange(B, dtype=jnp.int32)
+    blk = (rnd % p.probe_every) * B
+    pid = blk + jnp.arange(B, dtype=jnp.int32)
     pid_c = jnp.minimum(pid, N - 1)
     pvalid = pid < N
 
-    tgt = random_targets(k_t, N, (B,), ids=pid_c)
-    prober_ok = pvalid & (mf[pid_c] > rnd)
-    mf_t = mf[tgt]
+    # mf doubled once: every shifted-block read below is a dynamic
+    # slice of it (wrap-around included), never a random gather.
+    mf2 = jnp.concatenate([mf, mf])
+
+    def _mf_block(offset):
+        return jax.lax.dynamic_slice(mf2, ((blk + offset) % N,), (B,))
+
+    # Direct-probe target: pid + o_t.  Offsets in [1, N-1]: 0 would be
+    # a self-probe.
+    offs = jax.random.randint(k_t, (1 + p.indirect_k,), 1, N, jnp.int32)
+    tgt = (pid_c + offs[0]) % N
+    prober_ok = pvalid & (jax.lax.dynamic_slice(mf2, (blk,), (B,)) > rnd)
+    mf_t = _mf_block(offs[0])
     tgt_member = mf_t >= 0
     tgt_alive = mf_t > rnd
 
     u = jax.random.uniform(k_dl, (B,))
     direct_fail = tgt_member & (~tgt_alive | (u < p.p_direct_fail_alive))
 
-    helpers = random_targets(k_h, N, (B, p.indirect_k), ids=pid_c)
-    hu = jax.random.uniform(k_hl, (B, p.indirect_k))
-    ind_ok = ((mf[helpers] > rnd)
-              & tgt_alive[:, None] & tgt_member[:, None]
-              & (hu >= p.p_indirect_fail_alive))
-    init = prober_ok & direct_fail & ~jnp.any(ind_ok, axis=1)
+    if p.indirect_k:
+        hu = jax.random.uniform(k_hl, (B, p.indirect_k))
+        helper_alive = jnp.stack(
+            [_mf_block(offs[1 + j]) > rnd for j in range(p.indirect_k)], axis=1)
+        ind_ok = (helper_alive
+                  & tgt_alive[:, None] & tgt_member[:, None]
+                  & (hu >= p.p_indirect_fail_alive))
+        rescued = jnp.any(ind_ok, axis=1)
+    else:
+        rescued = jnp.zeros((B,), bool)
+    init = prober_ok & direct_fail & ~rescued
 
     # Don't re-suspect a target this prober already believes dead.
-    s_t = slot_of_node[tgt]
+    s2 = jnp.concatenate([slot_of_node, slot_of_node])
+    s_t = jax.lax.dynamic_slice(s2, ((blk + offs[0]) % N,), (B,))
     cur = heard[jnp.clip(s_t, 0, S - 1), pid_c]
     init = init & ~((s_t >= 0) & ((cur >> _MSG_SHIFT) == MSG_DEAD))
 
-    # Aggregate per target.
-    nsusp_add = jnp.zeros((N,), jnp.int32).at[tgt].add(init.astype(jnp.int32))
-    want = nsusp_add > 0
+    # All slot bookkeeping below runs in B-space (this round's probers)
+    # and S-space — never N-space.  The previous formulation scattered
+    # per-target counts into an N-vector and ranked it with top_k(N);
+    # at 1M nodes those two ops dominated the whole probe tick
+    # (~25 ms/round on a v5e — see tools/profile_kernel.py).
 
     node_c = jnp.clip(slot_node, 0, N - 1)
     valid = slot_node >= 0
-    slot_want = valid & want[node_c]
-    add_here = jnp.where(valid, nsusp_add[node_c], 0)
+
+    # Circulant targets are DISTINCT within a round (tgt = pid + o over
+    # distinct pids), so a slot's subject has at most one initiator this
+    # round: its would-be prober is i = (subject - blk - o) mod N, an
+    # S-sized lookup into ``init`` — no S×B compare, no N-scatter.
+    init_i = init.astype(jnp.int32)
+    i_s = (node_c - blk - offs[0]) % N
+    in_blk = valid & (i_s < B)
+    add_here = jnp.where(in_blk, init_i[jnp.minimum(i_s, B - 1)], 0)
+    slot_want = add_here > 0
 
     # Existing suspect episodes absorb new initiators.
     slot_nsusp = jnp.where((slot_phase == PHASE_SUSPECT) & slot_want,
@@ -220,35 +256,46 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
     slot_dead_round = jnp.where(rearm, -1, slot_dead_round)
     heard = jnp.where(rearm[:, None], jnp.uint8(0), heard)
 
-    # Allocate fresh slots: k-th needer (by node id) takes the k-th free
-    # slot.  top_k over the need mask replaces a full-N cumsum ranking —
-    # at most S needers can be served anyway (ties in top_k resolve to
-    # the lowest index, preserving the by-id order).
-    need = want & (slot_of_node < 0) & member
+    # Allocate fresh slots: the k-th needy target (distinct by
+    # construction) takes the k-th free slot.  Candidates are compacted
+    # to kk entries with top_k over the prober axis.
+    need_b = init & (s_t < 0) & (mf_t >= 0)
+    masked = jnp.where(need_b, tgt, N)
+    kk = min(S, N, B)
+    neg_top, _ = jax.lax.top_k(-masked, kk)
+    cand = -neg_top  # kk smallest needy target ids, ascending
+    in_dom = cand < N
+
     free = ~valid
     free_order = jnp.argsort(jnp.where(free, 0, 1), stable=True).astype(jnp.int32)
     n_free = jnp.sum(free)
-    kk = min(S, N)  # a tiny pool (e.g. a WAN bridge) has fewer nodes than slots
-    vals, cand = jax.lax.top_k(need.astype(jnp.int32), kk)
-    krank = jnp.arange(kk, dtype=jnp.int32)
-    can_k = (vals > 0) & (krank < n_free)
-    slot_k = free_order[krank]
+    rank = jnp.cumsum(in_dom.astype(jnp.int32)) - 1
+    can_k = in_dom & (rank < n_free)
+    slot_k = free_order[jnp.clip(rank, 0, S - 1)]
     sidx = jnp.where(can_k, slot_k, S)  # S = out of range -> dropped
-    slot_node = slot_node.at[sidx].set(cand, mode="drop")
+    cand_c = jnp.clip(cand, 0, N - 1)
+    slot_node = slot_node.at[sidx].set(cand_c, mode="drop")
     slot_phase = slot_phase.at[sidx].set(PHASE_SUSPECT, mode="drop")
-    slot_inc = slot_inc.at[sidx].set(incarnation[cand], mode="drop")
+    slot_inc = slot_inc.at[sidx].set(incarnation[cand_c], mode="drop")
     slot_start = slot_start.at[sidx].set(rnd, mode="drop")
-    slot_nsusp = slot_nsusp.at[sidx].set(nsusp_add[cand], mode="drop")
+    # Exactly one initiator per distinct target this round.
+    slot_nsusp = slot_nsusp.at[sidx].set(1, mode="drop")
     slot_dead_round = slot_dead_round.at[sidx].set(-1, mode="drop")
-    slot_of_node = slot_of_node.at[jnp.where(can_k, cand, N)].set(
+    slot_of_node = slot_of_node.at[jnp.where(can_k, cand_c, N)].set(
         slot_k, mode="drop")
-    drops = drops + jnp.sum(need.astype(jnp.int32)) - jnp.sum(can_k.astype(jnp.int32))
+    # Drop accounting: needy targets that found no free slot this round
+    # (they re-initiate on a later probe cycle while the subject keeps
+    # failing probes; the counter measures slot pressure).
+    n_need = jnp.sum(need_b.astype(jnp.int32))
+    served = jnp.sum(can_k.astype(jnp.int32))
+    drops = drops + (n_need - served)
 
     # Initiators record their own suspicion with a *fresh* age so the
     # rumor re-enters circulation (memberlist re-enqueues the suspect
     # broadcast on every independent suspicion — this is what carries
     # confirmations outward and shrinks the Lifeguard timeout).
-    s_t2 = slot_of_node[tgt]
+    s2b = jnp.concatenate([slot_of_node, slot_of_node])
+    s_t2 = jax.lax.dynamic_slice(s2b, ((blk + offs[0]) % N,), (B,))
     cur2 = heard[jnp.clip(s_t2, 0, S - 1), pid_c]
     mark_ok = init & (s_t2 >= 0) & ((cur2 >> _MSG_SHIFT) <= MSG_SUSPECT)
     fresh = (jnp.uint8(_enc(MSG_SUSPECT)) | (cur2 & jnp.uint8(_CONF_MASK << _CONF_SHIFT)))
@@ -286,39 +333,13 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
 
     # -- 3. gossip dissemination (push via inverse-permutation gathers) ---
-    cur_msg = (heard >> _MSG_SHIFT).astype(jnp.uint8)
     rx_ok = alive & member
-    in_msg = jnp.zeros_like(cur_msg)
-    n_sus_in = jnp.zeros(heard.shape, jnp.uint8)
-    srcs_all = gossip_sources(k_gossip, N, p.fanout)
-    ids_n = jnp.arange(N, dtype=jnp.int32)
-    for f in range(p.fanout):
-        srcs = srcs_all[f]
-        # Permutation fixed points would deliver a node's own rumor back to
-        # it (and count as a Lifeguard confirmation); memberlist never
-        # gossips to self.
-        src_ok = (mf[srcs] > rnd) & (srcs != ids_n)
-        hin = heard[:, srcs]
-        active = src_ok[None, :] & ((hin & _AGE_MASK) < p.spread_budget_rounds)
-        m = jnp.where(active, (hin >> _MSG_SHIFT).astype(jnp.uint8), jnp.uint8(0))
-        in_msg = jnp.maximum(in_msg, m)
-        n_sus_in = n_sus_in + (m == MSG_SUSPECT).astype(jnp.uint8)
-
-    age = heard & _AGE_MASK
-    conf = ((heard >> _CONF_SHIFT) & _CONF_MASK).astype(jnp.int32)
-    upgraded = (in_msg > cur_msg) & rx_ok[None, :]
-    # Lifeguard confirmations: extra suspect receipts while already
-    # suspecting, capped by the number of other independent suspectors.
-    # The same cap clamps the timer lookup below — keep them identical.
+    # Lifeguard confirmations cap: the number of other independent
+    # suspectors.  The same cap clamps the timer lookup below — keep
+    # them identical.
     conf_cap = jnp.minimum(p.max_confirmations,
-                           jnp.maximum(slot_nsusp - 1, 0))[:, None]
-    bump = (cur_msg == MSG_SUSPECT) & (in_msg == MSG_SUSPECT) & rx_ok[None, :]
-    conf = jnp.where(bump, jnp.minimum(conf + n_sus_in.astype(jnp.int32), conf_cap), conf)
-
-    out_msg = jnp.where(upgraded, in_msg, cur_msg)
-    out_age = jnp.where(upgraded, jnp.uint8(0), age.astype(jnp.uint8))
-    out_conf = jnp.where(upgraded, 0, conf).astype(jnp.uint8)
-    heard = ((out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age).astype(jnp.uint8)
+                           jnp.maximum(slot_nsusp - 1, 0))
+    heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap)
 
     # -- 3b. push/pull anti-entropy (memberlist PushPullInterval): full
     # belief exchange with one random partner, bidirectional, ignoring
@@ -327,18 +348,116 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     if p.pushpull_every:
         def _pushpull(h):
             kpp = jax.random.fold_in(key, 3)
-            # fwd = who dials me under the permutation; rev = whom I dial.
-            # Doing both directions makes each pair's exchange symmetric.
-            fwd, rev = gossip_partners(kpp, N)
-            for partner in (fwd, rev):
-                ok = rx_ok & (mf[partner] > rnd) & (partner != ids_n)
-                hin = h[:, partner]
+            # One circulant pairing: i dials i + o.  Merging both
+            # directions (+o and -o rolls) makes each pair's exchange
+            # symmetric, as memberlist's push/pull TCP sync is.
+            o = jax.random.randint(kpp, (), 1, N, dtype=jnp.int32)
+            for shift in (o, -o):
+                ok = rx_ok & (jnp.roll(mf, shift) > rnd)
+                hin = jnp.roll(h, shift, axis=1)
                 upgraded = ((hin >> _MSG_SHIFT) > (h >> _MSG_SHIFT)) & ok[None, :]
                 h = jnp.where(upgraded, hin, h)
             return h
 
         heard = jax.lax.cond(rnd % p.pushpull_every == p.pushpull_every - 1,
                              _pushpull, lambda h: h, heard)
+
+    return _finish_round(p, state, rnd, fail_round, alive, member, heard,
+                         slot_node, slot_phase, slot_inc, slot_start,
+                         slot_nsusp, slot_dead_round, slot_of_node,
+                         incarnation, drops, conf_cap, rx_ok)
+
+
+def gossip_offsets(key: jax.Array, n: int, fanout: int) -> jnp.ndarray:
+    """``fanout`` nonzero circulant shifts for one round's gossip graph.
+
+    Node ``i`` pushes to ``i + o_f (mod n)`` — the round's communication
+    graph is ``fanout`` random circulants, redrawn every round.  vs the
+    keyed-permutation graph this keeps in-degree exactly ``fanout`` and
+    replaces every delivery gather with a contiguous roll: on this TPU a
+    random 1M-index gather costs ~6.5ns/index (~6.5ms) while a roll
+    moves the same row at memory bandwidth (tools/profile_kernel.py) —
+    the difference is the whole kernel's speed.  The trade: within one
+    round every node's targets share the same shifts (targets are
+    correlated ACROSS nodes; each node's own target sequence over rounds
+    is still uniform).  Single-rumor spread over independent per-round
+    shifts is the classic additive sumset process whose coverage curve
+    matches uniform push gossip to within the crossval tier's bounds —
+    quantified, like every kernel approximation, against the
+    discrete-event reference model."""
+    # Uniform in [1, n-1]: zero would be a self-loop (memberlist never
+    # gossips to self); distinctness across the fanout draws is not
+    # enforced (collision probability fanout^2/n, a duplicate edge for
+    # one round — the same rumor delivered twice, absorbed by max-merge).
+    return jax.random.randint(key, (fanout,), 1, n, dtype=jnp.int32)
+
+
+def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
+                 conf_cap) -> jnp.ndarray:
+    """One round of rumor push: ``fanout`` circulant-shift deliveries,
+    merged per destination with message-priority + Lifeguard
+    confirmation counting.
+
+    The belief matrix moves as u32 words holding FOUR slot-rows per
+    element (byte k of word g = row 4g+k); merge logic runs per
+    byte-plane on native u32 lanes instead of sub-lane u8."""
+    S, N = heard.shape
+    S4 = -(-S // 4)
+    pad = 4 * S4 - S
+    h_rows = (jnp.concatenate(
+        [heard, jnp.zeros((pad, N), jnp.uint8)]) if pad else heard)
+    planes = h_rows.reshape(S4, 4, N).astype(jnp.uint32)
+    packed = (planes[:, 0] | (planes[:, 1] << 8)
+              | (planes[:, 2] << 16) | (planes[:, 3] << 24))
+
+    offs = gossip_offsets(k_gossip, N, p.fanout)
+    budget = jnp.uint32(p.spread_budget_rounds)
+    pins = []
+    for f in range(p.fanout):
+        # Sender into d is d - o_f: delivery = roll by +o_f (contiguous).
+        o = offs[f]
+        src_ok = jnp.roll(mf, o) > rnd
+        pins.append((jnp.roll(packed, o, axis=1), src_ok))
+
+    cap4 = (jnp.concatenate([conf_cap, jnp.zeros((pad,), jnp.int32)])
+            if pad else conf_cap).reshape(S4, 4).astype(jnp.uint32)
+
+    out_planes = []
+    for k in range(4):
+        in_msg = jnp.zeros((S4, N), jnp.uint32)
+        n_sus_in = jnp.zeros((S4, N), jnp.uint32)
+        for pin, src_ok in pins:
+            bk = (pin >> (8 * k)) & jnp.uint32(0xFF)
+            active = src_ok[None, :] & ((bk & _AGE_MASK) < budget)
+            m = jnp.where(active, bk >> _MSG_SHIFT, jnp.uint32(0))
+            in_msg = jnp.maximum(in_msg, m)
+            n_sus_in = n_sus_in + (m == MSG_SUSPECT).astype(jnp.uint32)
+
+        cur = planes[:, k]                        # [S4, N] u32 bytes
+        cur_msg = cur >> _MSG_SHIFT
+        age = cur & _AGE_MASK
+        conf = (cur >> _CONF_SHIFT) & _CONF_MASK
+        upgraded = (in_msg > cur_msg) & rx_ok[None, :]
+        bump = ((cur_msg == MSG_SUSPECT) & (in_msg == MSG_SUSPECT)
+                & rx_ok[None, :])
+        conf = jnp.where(bump,
+                         jnp.minimum(conf + n_sus_in, cap4[:, k][:, None]),
+                         conf)
+        out_msg = jnp.where(upgraded, in_msg, cur_msg)
+        out_age = jnp.where(upgraded, jnp.uint32(0), age)
+        out_conf = jnp.where(upgraded, jnp.uint32(0), conf)
+        out_planes.append(
+            (out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age)
+
+    return jnp.stack(out_planes, axis=1).reshape(4 * S4, N)[:S].astype(jnp.uint8)
+
+
+def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
+                  member, heard, slot_node, slot_phase, slot_inc, slot_start,
+                  slot_nsusp, slot_dead_round, slot_of_node, incarnation,
+                  drops, conf_cap, rx_ok) -> SwimState:
+    """Refutation, suspicion-timer firing, episode GC, stats."""
+    N, S = p.n, p.slots
 
     # -- 4. refutation: a live subject that hears of its own suspicion
     # bumps its incarnation and spreads alive@inc+1 (Serf/memberlist
@@ -361,7 +480,7 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     # -- 5. suspicion timers fire -> dead declared ------------------------
     tbl = jnp.asarray(p.timeout_table())
     c_eff = jnp.minimum(((heard >> _CONF_SHIFT) & _CONF_MASK).astype(jnp.int32),
-                        conf_cap)
+                        conf_cap[:, None])
     elapsed = rnd - slot_start
     fire = ((slot_phase == PHASE_SUSPECT)[:, None]
             & ((heard >> _MSG_SHIFT) == MSG_SUSPECT)

@@ -1,11 +1,13 @@
 """Per-phase on-chip timing of the SWIM round kernel.
 
-Times each phase of ``swim_round`` as its own jitted function with forced
-device->host materialization (block_until_ready alone returns at enqueue
-on the tunneled backend — see bench.py:_sync).  Phase boundaries force
-materializations that the fused whole avoids, so the parts can sum to
-more than the whole; the point is finding the dominant phase, not exact
-accounting.
+Times each phase of ``swim_round`` as its own jitted function with a
+tiny on-device checksum reduction (a naive fetch pulls 64MB through the
+tunnel at ~120MB/s and swamps every number; block_until_ready alone
+returns at enqueue — see bench.py:_sync).  The per-dispatch floor on the
+tunneled backend is ~8-9 ms/call: subtract it when reading small
+entries, or compare the amortized scan numbers.  Phase boundaries force
+materializations the fused whole avoids, so parts can sum to more than
+the whole; the point is finding the dominant phase.
 
 Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/profile_kernel.py [--n 1000000]
 """
@@ -27,9 +29,6 @@ import numpy as np
 
 
 def _checksum(out):
-    """Tiny on-device reduction over every output leaf, so forcing
-    completion costs a 4-byte fetch — NOT a 64MB pull through the
-    tunnel (which reads ~120MB/s and swamped the first profile)."""
     tot = jnp.int32(0)
     for leaf in jax.tree.leaves(out):
         tot = tot + jnp.sum(leaf, dtype=jnp.int32)
@@ -60,13 +59,14 @@ def main():
     args = ap.parse_args()
 
     from consul_tpu.gossip.kernel import (
-        NEVER, _AGE_MASK, _MSG_SHIFT, MSG_SUSPECT, _age_tick, _block_size,
-        _probe_tick, init_state, run_rounds, swim_round)
+        NEVER, _age_tick, _disseminate, _probe_tick, init_state, run_rounds,
+        swim_round)
     from consul_tpu.gossip.params import lan_profile
     from consul_tpu.ops.feistel import gossip_sources
 
     n, S = args.n, args.slots
     p = lan_profile(n, slots=S)
+    p_nopp = lan_profile(n, slots=S, pushpull_every=0)
     print(f"device: {jax.devices()[0]}", file=sys.stderr)
 
     # Build a warm, realistically-populated state: run a few hundred
@@ -85,30 +85,34 @@ def main():
     rnd = state.round
     heard = state.heard
     mf = jnp.where(state.member, fail, -1)
+    alive = fail > rnd
+    rx_ok = alive & state.member
+    conf_cap = jnp.minimum(p.max_confirmations,
+                           jnp.maximum(state.slot_nsusp - 1, 0))
 
     class _Results(dict):
-        """Print each timing the moment it lands (remote compiles are
-        slow; a late crash must not eat the measurements)."""
-
         def __setitem__(self, k, v):
             print(f"{k:32s} {v * 1e3:9.2f} ms", flush=True)
             super().__setitem__(k, v)
 
     results = _Results()
 
-    # -- full round (the reference point) --------------------------------
-    f_full = make_timed(functools.partial(swim_round, p=p))
-    results["full_round"] = timed(f_full, state, key, fail)
-
-    # -- scan of 64 rounds / 64 (amortized dispatch) ---------------------
+    # -- full round, amortized over a 64-round scan (the honest number) --
     f_scan = make_timed(lambda st: run_rounds(st, key, fail, p, steps=64)[0])
-    results["full_round_amortized_64"] = timed(f_scan, state, iters=2, warmup=1) / 64
+    results["round_amortized_64"] = timed(f_scan, state, iters=2, warmup=1) / 64
 
-    # -- phase 1: age tick ------------------------------------------------
-    f_age = make_timed(_age_tick)
-    results["age_tick"] = timed(f_age, heard)
+    # -- same without push/pull: if lax.cond is speculated/flattened,
+    # the 2 extra full-width u8 gathers bill EVERY round, not 1-in-150
+    f_scan0 = make_timed(lambda st: run_rounds(st, key, fail, p_nopp, steps=64)[0])
+    results["round_amortized_64_nopp"] = timed(f_scan0, state, iters=2, warmup=1) / 64
 
-    # -- phase 2: probe tick ---------------------------------------------
+    # -- single dispatched round -----------------------------------------
+    results["full_round"] = timed(make_timed(functools.partial(swim_round, p=p)),
+                                  state, key, fail)
+
+    # -- phases -----------------------------------------------------------
+    results["age_tick"] = timed(make_timed(_age_tick), heard)
+
     def f_probe_raw(st, mf_):
         keys = jax.random.split(key, 4)
         carry = (st.heard, st.slot_node, st.slot_phase, st.slot_inc,
@@ -117,63 +121,41 @@ def main():
         return _probe_tick(p, st.round, keys, mf_, carry)[0]
     results["probe_tick"] = timed(make_timed(f_probe_raw), state, mf)
 
-    # -- phase 3a: the fanout source permutations ------------------------
-    f_src = make_timed(lambda k: gossip_sources(k, n, p.fanout))
-    results["gossip_sources"] = timed(f_src, key)
+    results["disseminate"] = timed(
+        make_timed(lambda h, mf_, cc: _disseminate(p, rnd, key, h, mf_, rx_ok, cc)),
+        heard, mf, conf_cap)
 
-    # -- phase 3b: gather + merge (the dissemination data path) ----------
-    def f_gossip(h, mf_, k):
-        srcs_all = gossip_sources(k, n, p.fanout)
-        ids_n = jnp.arange(n, dtype=jnp.int32)
-        cur_msg = (h >> _MSG_SHIFT).astype(jnp.uint8)
-        in_msg = jnp.zeros_like(cur_msg)
-        n_sus_in = jnp.zeros(h.shape, jnp.uint8)
-        for f in range(p.fanout):
-            srcs = srcs_all[f]
-            src_ok = (mf_[srcs] > rnd) & (srcs != ids_n)
-            hin = h[:, srcs]
-            active = src_ok[None, :] & ((hin & _AGE_MASK) < p.spread_budget_rounds)
-            m = jnp.where(active, (hin >> _MSG_SHIFT).astype(jnp.uint8), jnp.uint8(0))
-            in_msg = jnp.maximum(in_msg, m)
-            n_sus_in = n_sus_in + (m == MSG_SUSPECT).astype(jnp.uint8)
-        return in_msg, n_sus_in
-    results["gossip_gather_merge"] = timed(make_timed(f_gossip), heard, mf, key)
+    results["gossip_sources"] = timed(
+        make_timed(lambda k: gossip_sources(k, n, p.fanout)), key)
 
-    # -- phase 3b': ONE gather only --------------------------------------
-    def f_one_gather(h, k):
+    # -- packing + gathers in isolation ----------------------------------
+    S4 = S // 4
+
+    def pack(h):
+        planes = h.reshape(S4, 4, n).astype(jnp.uint32)
+        return (planes[:, 0] | (planes[:, 1] << 8)
+                | (planes[:, 2] << 16) | (planes[:, 3] << 24))
+
+    results["pack_u32"] = timed(make_timed(pack), heard)
+
+    packed = jax.jit(pack)(heard)
+
+    def f_one_gather32(pk, k):
+        srcs = gossip_sources(k, n, 1)[0]
+        return pk[:, srcs]
+    results["one_S4xN_u32_gather"] = timed(make_timed(f_one_gather32), packed, key)
+
+    def f_one_gather8(h, k):
         srcs = gossip_sources(k, n, 1)[0]
         return h[:, srcs]
-    results["one_SxN_gather"] = timed(make_timed(f_one_gather), heard, key)
+    results["one_SxN_u8_gather"] = timed(make_timed(f_one_gather8), heard, key)
 
-    # -- transposed gather: rows of [N, S] -------------------------------
-    heard_t = jnp.asarray(heard.T)  # [N, S]
-    def f_one_gather_t(ht, k):
-        srcs = gossip_sources(k, n, 1)[0]
-        return ht[srcs, :]
-    results["one_NxS_row_gather"] = timed(make_timed(f_one_gather_t), heard_t, key)
-
-    # -- elementwise S×N pass (roofline probe) ---------------------------
-    f_elem = make_timed(lambda h: (h ^ jnp.uint8(3)) + jnp.uint8(1))
-    results["one_SxN_elementwise"] = timed(f_elem, heard)
-
-    # -- u32-packed elementwise (same bytes, wider lanes) ----------------
-    packed = jnp.asarray(np.frombuffer(
-        np.asarray(heard).tobytes(), np.uint32).reshape(S, n // 4))
-    f_elem32 = make_timed(lambda h: (h ^ jnp.uint32(3)) + jnp.uint32(1))
-    results["one_SxN4_u32_elementwise"] = timed(f_elem32, packed)
-
-    # -- timer fire + GC side --------------------------------------------
-    def f_fire(st, h):
+    # -- timeout-table gather (S×N int gather from a 4-entry table) ------
+    def f_tbl(h, cc):
         tbl = jnp.asarray(p.timeout_table())
-        conf_cap = jnp.minimum(p.max_confirmations,
-                               jnp.maximum(st.slot_nsusp - 1, 0))[:, None]
-        c_eff = jnp.minimum(((h >> 4) & 0x3).astype(jnp.int32), conf_cap)
-        elapsed = st.round - st.slot_start
-        fire = ((st.slot_phase == 1)[:, None]
-                & ((h >> _MSG_SHIFT) == MSG_SUSPECT)
-                & (elapsed[:, None] >= tbl[c_eff]))
-        return jnp.any(fire, axis=1)
-    results["timer_fire"] = timed(make_timed(f_fire), state, heard)
+        c_eff = jnp.minimum(((h >> 4) & 0x3).astype(jnp.int32), cc[:, None])
+        return tbl[c_eff]
+    results["timeout_table_lookup"] = timed(make_timed(f_tbl), heard, conf_cap)
 
     print("\n-- sorted --", flush=True)
     for k, v in sorted(results.items(), key=lambda kv: -kv[1]):
