@@ -101,10 +101,59 @@ def main():
     f_scan = make_timed(lambda st: run_rounds(st, key, fail, p, steps=64)[0])
     results["round_amortized_64"] = timed(f_scan, state, iters=2, warmup=1) / 64
 
-    # -- same without push/pull: if lax.cond is speculated/flattened,
-    # the 2 extra full-width u8 gathers bill EVERY round, not 1-in-150
-    f_scan0 = make_timed(lambda st: run_rounds(st, key, fail, p_nopp, steps=64)[0])
-    results["round_amortized_64_nopp"] = timed(f_scan0, state, iters=2, warmup=1) / 64
+    # -- ablation scans: the same 64-round scan with phases removed.
+    # Within-scan attribution — the per-phase standalone timings below
+    # carry materialization-boundary + dispatch noise that makes them
+    # sum to more than the whole.
+    from consul_tpu.gossip.kernel import (
+        _disseminate as _dis, _finish_round as _fin,
+        _probe_tick as _probe)
+
+    def ablated_scan(do_probe, do_dis, do_fin):
+        def round_fn(st, _):
+            rnd = st.round
+            k = jax.random.fold_in(key, rnd)
+            k_probe = jax.random.split(jax.random.fold_in(k, 1), 4)
+            k_gossip = jax.random.fold_in(k, 2)
+            alive_ = fail > rnd
+            mf_ = jnp.where(st.member, fail, -1)
+            heard_ = _age_tick(st.heard)
+            carry = (heard_, st.slot_node, st.slot_phase, st.slot_inc,
+                     st.slot_start, st.slot_nsusp, st.slot_dead_round,
+                     st.slot_of_node, st.incarnation, st.member, st.drops)
+            if do_probe:
+                carry = _probe(p, rnd, k_probe, mf_, carry)
+            (heard_, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
+             slot_dead_round, slot_of_node, incarnation, member_, drops) = carry
+            rx = alive_ & member_
+            cc = jnp.minimum(p.max_confirmations,
+                             jnp.maximum(slot_nsusp - 1, 0))
+            if do_dis:
+                heard_ = _dis(p, rnd, k_gossip, heard_, mf_, rx, cc)
+            if do_fin:
+                st2 = _fin(p, st, rnd, fail, alive_, member_, heard_,
+                           slot_node, slot_phase, slot_inc, slot_start,
+                           slot_nsusp, slot_dead_round, slot_of_node,
+                           incarnation, drops, cc, rx)
+            else:
+                st2 = st._replace(round=rnd + 1, heard=heard_,
+                                  member=member_)
+            return st2, None
+
+        def scan(st):
+            return jax.lax.scan(round_fn, st, None, length=64)[0]
+        return make_timed(scan)
+
+    results["scan64_age_only"] = timed(
+        ablated_scan(False, False, False), state, iters=2, warmup=1) / 64
+    results["scan64_age_probe"] = timed(
+        ablated_scan(True, False, False), state, iters=2, warmup=1) / 64
+    results["scan64_age_probe_dis"] = timed(
+        ablated_scan(True, True, False), state, iters=2, warmup=1) / 64
+    results["scan64_age_dis_fin"] = timed(
+        ablated_scan(False, True, True), state, iters=2, warmup=1) / 64
+    results["scan64_all"] = timed(
+        ablated_scan(True, True, True), state, iters=2, warmup=1) / 64
 
     # -- single dispatched round -----------------------------------------
     results["full_round"] = timed(make_timed(functools.partial(swim_round, p=p)),
@@ -124,6 +173,17 @@ def main():
     results["disseminate"] = timed(
         make_timed(lambda h, mf_, cc: _disseminate(p, rnd, key, h, mf_, rx_ok, cc)),
         heard, mf, conf_cap)
+
+    from consul_tpu.gossip.kernel import _finish_round
+
+    def f_finish(st, h, cc, rx):
+        return _finish_round(p, st, st.round, fail, fail > st.round,
+                             st.member, h, st.slot_node, st.slot_phase,
+                             st.slot_inc, st.slot_start, st.slot_nsusp,
+                             st.slot_dead_round, st.slot_of_node,
+                             st.incarnation, st.drops, cc, rx)
+    results["finish_round"] = timed(make_timed(f_finish), state, heard,
+                                    conf_cap, rx_ok)
 
     results["gossip_sources"] = timed(
         make_timed(lambda k: gossip_sources(k, n, p.fanout)), key)
