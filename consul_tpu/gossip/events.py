@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
-from consul_tpu.ops.feistel import gossip_partners, gossip_sources
+from consul_tpu.gossip.kernel import gossip_offsets
 
 _SEEN = 0x80
 _AGE_MASK = 0x0F
@@ -118,18 +118,16 @@ def event_round(state: EventState, base_key: jax.Array, alive: jnp.ndarray,
     cur = state.has
     seen = (cur & _SEEN) > 0
 
-    # fanout deliveries via inverse-permutation gathers (ARX sources —
-    # the same multiply-free fixed-walk construction the membership
-    # kernel uses; see ops/feistel.py module note on the ≤1% clamp
-    # residual)
+    # fanout deliveries via circulant rolls (the membership kernel's
+    # communication pattern — see kernel.gossip_offsets on why rolls
+    # beat permutation gathers ~by the whole kernel's speed on TPU)
     rx_ok = alive
     new_seen = jnp.zeros_like(seen)
-    ids = jnp.arange(N, dtype=jnp.int32)
-    srcs_all = gossip_sources(key, N, p.fanout)
+    offs = gossip_offsets(key, N, p.fanout)
     for f in range(p.fanout):
-        srcs = srcs_all[f]
-        src_ok = alive[srcs] & (srcs != ids)
-        hin = cur[:, srcs]
+        o = offs[f]
+        src_ok = jnp.roll(alive, o)
+        hin = jnp.roll(cur, o, axis=1)
         active = (src_ok[None, :] & ((hin & _SEEN) > 0)
                   & ((hin & _AGE_MASK) < p.spread_budget_rounds))
         new_seen = new_seen | (active & rx_ok[None, :])
@@ -139,10 +137,10 @@ def event_round(state: EventState, base_key: jax.Array, alive: jnp.ndarray,
     if p.pushpull_every:
         def _pp(ns):
             kpp = jax.random.fold_in(key, 9)
-            fwd, rev = gossip_partners(kpp, N)
-            for partner in (fwd, rev):
-                ok = rx_ok & alive[partner] & (partner != ids)
-                hin = cur[:, partner]
+            o = jax.random.randint(kpp, (), 1, N, dtype=jnp.int32)
+            for shift in (o, -o):
+                ok = rx_ok & jnp.roll(alive, shift)
+                hin = jnp.roll(cur, shift, axis=1)
                 ns = ns | (((hin & _SEEN) > 0) & ok[None, :])
             return ns
 

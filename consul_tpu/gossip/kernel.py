@@ -534,7 +534,17 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
     n_false_dead = state.n_false_dead + jnp.sum((new_dead & ~truly_dead).astype(jnp.int32))
 
     # -- 6. episode GC: recycle slots, apply verdicts ---------------------
-    expired = (slot_phase > PHASE_FREE) & (rnd - slot_start > p.slot_ttl_rounds)
+    # A slot whose timer already fired only needs to outlive the DEAD
+    # verdict's dissemination (two spread budgets, like the slot-TTL
+    # tail), not the worst-case zero-confirmation suspicion timeout —
+    # under churn this recycles slots ~6x sooner at 1M nodes, which is
+    # scarcity relief, not a semantics change (memberlist has no slot
+    # scarcity at all; a recycled-slot subject that still fails probes
+    # re-enters suspicion at the next cycle).
+    dead_done = ((slot_phase == PHASE_DEAD) & (slot_dead_round >= 0)
+                 & (rnd - slot_dead_round > 2 * p.spread_budget_rounds + 8))
+    expired = ((slot_phase > PHASE_FREE)
+               & ((rnd - slot_start > p.slot_ttl_rounds) | dead_done))
     is_dead = expired & (slot_phase == PHASE_DEAD)
     member = member.at[jnp.where(is_dead, node_c, N)].set(False, mode="drop")
     slot_of_node = slot_of_node.at[jnp.where(expired, node_c, N)].set(-1, mode="drop")

@@ -94,12 +94,27 @@ def multidc_round(state: MultiDCState, base_key: jax.Array,
     D, s = p.n_dcs, p.n_servers
     keys = jax.random.split(jax.random.fold_in(base_key, 11), D)
 
-    # -- LAN pools: membership + events, vmapped over the DC axis --------
-    lan = jax.vmap(lambda st, k, f: swim_round(st, k, f, p.lan))(
-        state.lan, keys, lan_fail)
+    # -- LAN pools: membership + events, one static unroll per DC --------
+    # NOT vmapped: under vmap the kernel's circulant rolls and
+    # block slices (traced shifts, batched) lower to random-index
+    # gathers — measured ~100x slower at 4x250k than the same work
+    # unbatched (tools/profile_kernel.py findings; the gather costs
+    # ~6.5ns/index on this TPU).  D is small and static, so a Python
+    # loop compiles D copies that keep the roll/slice lowering.
+    def _per_dc(tree, d):
+        return jax.tree.map(lambda x: x[d], tree)
+
+    lan_list = [
+        swim_round(_per_dc(state.lan, d), keys[d], lan_fail[d], p.lan)
+        for d in range(D)
+    ]
+    lan = jax.tree.map(lambda *xs: jnp.stack(xs), *lan_list)
     lan_alive = (lan_fail > state.lan_events.round[:, None])
-    lan_events = jax.vmap(lambda st, k, a: event_round(st, k, a, p.lan))(
-        state.lan_events, keys, lan_alive)
+    lan_ev_list = [
+        event_round(_per_dc(state.lan_events, d), keys[d], lan_alive[d], p.lan)
+        for d in range(D)
+    ]
+    lan_events = jax.tree.map(lambda *xs: jnp.stack(xs), *lan_ev_list)
 
     # -- WAN pool ---------------------------------------------------------
     wan_key = jax.random.fold_in(base_key, 13)
