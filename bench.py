@@ -103,7 +103,8 @@ def _sync(jax, state) -> None:
     int(state.round if hasattr(state, "round") else jax.tree.leaves(state)[0])
 
 
-def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int) -> dict:
+def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
+               churn_ppm: int = 1000) -> dict:
     import jax.numpy as jnp
 
     from consul_tpu.gossip.kernel import init_state, run_rounds
@@ -112,18 +113,22 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int) -> dict:
     p = lan_profile(n, slots=slots)
     state = init_state(p)
     key = jax.random.PRNGKey(42)
-    # Steady-state failure churn: a fixed 0.1% of nodes fail at staggered
-    # rounds spanning warmup AND every timed block, so probe/suspect/dead/GC
-    # paths stay hot in whichever block min() selects.
-    n_fail = max(1, n // 1000)
+    # Steady-state failure churn (default 0.1% of nodes, staggered over
+    # warmup AND every timed block, so probe/suspect/dead/GC paths stay
+    # hot in whichever block min() selects).  --churn-ppm 0 benches the
+    # healthy-cluster regime: no episodes, rounds take the quiescent
+    # fast path (probe tick only).
+    n_fail = (n * churn_ppm) // 1_000_000 if churn_ppm else 0
+    if churn_ppm and n_fail == 0:
+        n_fail = 1
     total_rounds = steps * (repeats + 1)
     # Stride, not modulo: failures land uniformly across every block even
     # when n_fail < total_rounds.
-    fail_round = (
-        jnp.full((p.n,), 2**31 - 1, jnp.int32)
-        .at[:n_fail]
-        .set((jnp.arange(n_fail, dtype=jnp.int32) * total_rounds) // n_fail)
-    )
+    fail_round = jnp.full((p.n,), 2**31 - 1, jnp.int32)
+    if n_fail:
+        # Stride, not modulo: failures land uniformly across every block.
+        fail_round = fail_round.at[:n_fail].set(
+            (jnp.arange(n_fail, dtype=jnp.int32) * total_rounds) // n_fail)
 
     _log(f"lan n={n} slots={slots}: compiling + warmup ({steps} rounds)")
     t0 = time.perf_counter()
@@ -143,7 +148,8 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int) -> dict:
 
     rps = steps / best
     return {
-        "metric": f"swim_gossip_rounds_per_sec_{n}_nodes",
+        "metric": (f"swim_gossip_rounds_per_sec_{n}_nodes"
+                   + ("" if churn_ppm == 1000 else f"_churn{churn_ppm}ppm")),
         "value": round(rps, 1),
         "unit": "rounds/s",
         "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 3),
@@ -215,6 +221,9 @@ def main() -> None:
     ap.add_argument("--multidc", action="store_true",
                     help="BASELINE config #5 shape: LAN+WAN pools + events")
     ap.add_argument("--dcs", type=int, default=4, help="datacenters (multidc)")
+    ap.add_argument("--churn-ppm", type=int, default=1000,
+                    help="failing nodes per million over the run; 0 = "
+                         "healthy-cluster regime (quiescent fast path)")
     args = ap.parse_args()
 
     fail_metric = ("swim_multidc_rounds_per_sec" if args.multidc
@@ -270,7 +279,7 @@ def main() -> None:
                                         args.steps, args.repeats)
             else:
                 result = _bench_lan(jax, n, args.slots, args.steps,
-                                    args.repeats)
+                                    args.repeats, churn_ppm=args.churn_ppm)
             if n != args.n:
                 result["reduced_from_n"] = args.n
             try:

@@ -139,10 +139,21 @@ def init_state(p: SwimParams) -> SwimState:
     )
 
 
+_AGE_FRESH = 0xF  # sentinel: written by this round's probe marks, pre-aging
+
+
 def _age_tick(heard: jnp.ndarray) -> jnp.ndarray:
+    """Advance every in-flight rumor's age by one round.
+
+    Runs AFTER the probe tick (so the whole age+gossip+timers tail can
+    be skipped when no episode is active): a mark the probe just wrote
+    carries the ``_AGE_FRESH`` sentinel and ages to 0 here — i.e. it is
+    brand new this round — while real ages saturate at 14."""
     msg = heard >> _MSG_SHIFT
     age = heard & _AGE_MASK
-    aged = (heard & ~jnp.uint8(_AGE_MASK)) | jnp.minimum(age + 1, _AGE_MASK).astype(jnp.uint8)
+    new_age = jnp.where(age == _AGE_FRESH, jnp.uint8(0),
+                        jnp.minimum(age + 1, jnp.uint8(_AGE_MASK - 1)))
+    aged = (heard & ~jnp.uint8(_AGE_MASK)) | new_age.astype(jnp.uint8)
     return jnp.where(msg > 0, aged, heard)
 
 
@@ -322,7 +333,7 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
         hblk = jax.lax.dynamic_slice(heard, (0, blk), (S, B))
         cur2 = _row_pick(hblk, rows2)
         mark_ok = init & (s_t2 >= 0) & ((cur2 >> _MSG_SHIFT) <= MSG_SUSPECT)
-        fresh = (jnp.uint8(_enc(MSG_SUSPECT))
+        fresh = (jnp.uint8(_enc(MSG_SUSPECT, age=_AGE_FRESH))
                  | (cur2 & jnp.uint8(_CONF_MASK << _CONF_SHIFT)))
         sel = (srow[:, None] == rows2[None, :]) & mark_ok[None, :]
         heard = jax.lax.dynamic_update_slice(
@@ -330,7 +341,7 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
     else:
         cur2 = heard[rows2, pid_c]
         mark_ok = init & (s_t2 >= 0) & ((cur2 >> _MSG_SHIFT) <= MSG_SUSPECT)
-        fresh = (jnp.uint8(_enc(MSG_SUSPECT))
+        fresh = (jnp.uint8(_enc(MSG_SUSPECT, age=_AGE_FRESH))
                  | (cur2 & jnp.uint8(_CONF_MASK << _CONF_SHIFT)))
         heard = heard.at[jnp.where(mark_ok, s_t2, S), pid_c].set(
             fresh, mode="drop")
@@ -355,51 +366,75 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     # (> rnd) — the kernel's most common random reads.
     mf = jnp.where(state.member, fail_round, -1)
 
-    # -- 1. age every in-flight rumor ------------------------------------
-    heard = _age_tick(state.heard)
-
-    # -- 2. probe tick (staggered: block rnd % probe_every probes) --------
-    carry = (heard, state.slot_node, state.slot_phase, state.slot_inc,
+    # -- 1. probe tick (staggered: block rnd % probe_every probes).  Runs
+    # FIRST, on the un-aged matrix: its decisions read only msg/conf
+    # bits, and its fresh marks carry the _AGE_FRESH sentinel that the
+    # tail's age tick turns into age 0 --------------------------------
+    carry = (state.heard, state.slot_node, state.slot_phase, state.slot_inc,
              state.slot_start, state.slot_nsusp, state.slot_dead_round,
              state.slot_of_node, state.incarnation, state.member, state.drops)
     carry = _probe_tick(p, rnd, k_probe, mf, carry)
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
 
-    # -- 3. gossip dissemination (push via inverse-permutation gathers) ---
     rx_ok = alive & member
     # Lifeguard confirmations cap: the number of other independent
-    # suspectors.  The same cap clamps the timer lookup below — keep
-    # them identical.
+    # suspectors.  The same cap clamps the timer lookup in the finish
+    # phase — keep them identical.
     conf_cap = jnp.minimum(p.max_confirmations,
                            jnp.maximum(slot_nsusp - 1, 0))
-    heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap)
 
-    # -- 3b. push/pull anti-entropy (memberlist PushPullInterval): full
-    # belief exchange with one random partner, bidirectional, ignoring
-    # the per-message spread budget — this is what recovers rumors that
-    # aged out before reaching everyone (e.g. under packet loss) --------
-    if p.pushpull_every:
-        def _pushpull(h):
-            kpp = jax.random.fold_in(key, 3)
-            # One circulant pairing: i dials i + o.  Merging both
-            # directions (+o and -o rolls) makes each pair's exchange
-            # symmetric, as memberlist's push/pull TCP sync is.
-            o = jax.random.randint(kpp, (), 1, N, dtype=jnp.int32)
-            for shift in (o, -o):
-                ok = rx_ok & (jnp.roll(mf, shift) > rnd)
-                hin = jnp.roll(h, shift, axis=1)
-                upgraded = ((hin >> _MSG_SHIFT) > (h >> _MSG_SHIFT)) & ok[None, :]
-                h = jnp.where(upgraded, hin, h)
-            return h
+    def _active_tail(heard):
+        # -- 2. age every in-flight rumor --------------------------------
+        heard = _age_tick(heard)
 
-        heard = jax.lax.cond(rnd % p.pushpull_every == p.pushpull_every - 1,
-                             _pushpull, lambda h: h, heard)
+        # -- 3. gossip dissemination (push via circulant rolls) ----------
+        heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap)
 
-    return _finish_round(p, state, rnd, fail_round, alive, member, heard,
-                         slot_node, slot_phase, slot_inc, slot_start,
-                         slot_nsusp, slot_dead_round, slot_of_node,
-                         incarnation, drops, conf_cap, rx_ok)
+        # -- 3b. push/pull anti-entropy (memberlist PushPullInterval):
+        # full belief exchange with one random partner, bidirectional,
+        # ignoring the per-message spread budget — this is what recovers
+        # rumors that aged out before reaching everyone (e.g. under
+        # packet loss) ---------------------------------------------------
+        if p.pushpull_every:
+            def _pushpull(h):
+                kpp = jax.random.fold_in(key, 3)
+                # One circulant pairing: i dials i + o.  Merging both
+                # directions (+o and -o rolls) makes each pair's exchange
+                # symmetric, as memberlist's push/pull TCP sync is.
+                o = jax.random.randint(kpp, (), 1, N, dtype=jnp.int32)
+                for shift in (o, -o):
+                    ok = rx_ok & (jnp.roll(mf, shift) > rnd)
+                    hin = jnp.roll(h, shift, axis=1)
+                    upgraded = (((hin >> _MSG_SHIFT) > (h >> _MSG_SHIFT))
+                                & ok[None, :])
+                    h = jnp.where(upgraded, hin, h)
+                return h
+
+            heard = jax.lax.cond(rnd % p.pushpull_every == p.pushpull_every - 1,
+                                 _pushpull, lambda h: h, heard)
+
+        return _finish_round(p, state, rnd, fail_round, alive, member, heard,
+                             slot_node, slot_phase, slot_inc, slot_start,
+                             slot_nsusp, slot_dead_round, slot_of_node,
+                             incarnation, drops, conf_cap, rx_ok)
+
+    def _quiescent_tail(heard):
+        # No active episode anywhere: the belief matrix is all-zero and
+        # every age/gossip/timer/GC pass is a no-op.  A healthy cluster
+        # pays only the probe tick per round.
+        return SwimState(
+            round=rnd + 1, heard=heard, slot_node=slot_node,
+            slot_phase=slot_phase, slot_inc=slot_inc, slot_start=slot_start,
+            slot_nsusp=slot_nsusp, slot_dead_round=slot_dead_round,
+            slot_of_node=slot_of_node, incarnation=incarnation, member=member,
+            drops=drops, n_detected=state.n_detected,
+            sum_detect_rounds=state.sum_detect_rounds,
+            n_false_dead=state.n_false_dead, n_refuted=state.n_refuted,
+        )
+
+    any_active = jnp.any(slot_node >= 0)
+    return jax.lax.cond(any_active, _active_tail, _quiescent_tail, heard)
 
 
 def gossip_offsets(key: jax.Array, n: int, fanout: int) -> jnp.ndarray:

@@ -85,9 +85,10 @@ class SwimParams:
     @property
     def spread_budget_rounds(self) -> int:
         """Rounds a node keeps gossiping a message: limit / fanout, i.e. a
-        node spends ``fanout`` transmissions per round.  Capped at 15 to
-        fit the 4-bit age field (only reached at astronomically large n)."""
-        return min(15, max(1, math.ceil(self.transmit_limit / self.fanout)))
+        node spends ``fanout`` transmissions per round.  Capped at 14 to
+        fit the 4-bit age field with its 0xF fresh-mark sentinel
+        (kernel._AGE_FRESH; only reached at astronomically large n)."""
+        return min(14, max(1, math.ceil(self.transmit_limit / self.fanout)))
 
     @property
     def event_ttl_rounds(self) -> int:
