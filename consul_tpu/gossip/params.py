@@ -45,6 +45,16 @@ class SwimParams:
     # default: 30s LAN = 150 rounds, 60s WAN = 120 rounds (PushPullInterval,
     # selected by the reference via the LAN/WAN profiles).
     pushpull_every: int = 0
+    # Hot-tier width: rounds with <= this many live episodes process
+    # only the gathered subset of belief rows (kernel._hot_tail).
+    # 0 disables the tier (two-way cond: quiescent / full).  Default
+    # OFF: measured on the v5e, the subset pipeline runs ~10x SLOWER
+    # than the full-width tail it replaces (15.7 vs 155 rounds/s at 1M
+    # nodes, 10ppm churn) — the traced-index row subset defeats the
+    # roll/slice lowering the full path gets.  Kept as an explicit knob
+    # because the win is real on backends with cheap dynamic row
+    # gathers; re-measure before enabling.
+    hot_slots: int = 0
 
     # ---- derived, all static ----
 
