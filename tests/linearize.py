@@ -1,0 +1,116 @@
+"""Linearizability checker for single-register histories.
+
+The Jepsen role in this tree (the reference documents its partition-
+tolerance posture via Jepsen, ``website/source/docs/internals/
+jepsen.html.markdown``; the actual Jepsen suite lives outside its repo).
+This is the Wing & Gong search with the standard refinements Knossos/
+Porcupine use: only *minimal* pending operations are candidates at each
+step, and visited (linearized-set, model-state) pairs are memoized.
+
+History entries are dicts:
+
+    {"op": "w"|"r", "arg": v, "ret": v_or_None,
+     "t_inv": float, "t_ret": float, "ok": bool}
+
+``ok=False`` marks an operation whose outcome the client never learned
+(timeout / connection lost mid-flight).  An unknown *write* may have
+taken effect at any point after its invocation — or never; the checker
+is free to linearize it anywhere after ``t_inv`` or to omit it.  An
+unknown *read* constrains nothing and should simply not be recorded.
+
+Checking is NP-complete in general; histories here are short (a few
+hundred ops, concurrency ~4), where the minimal-op rule + memoization
+make the search effectively linear in practice.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+
+def check_linearizable(history: List[Dict], initial=None) -> bool:
+    """True iff the register history has a linearization.
+
+    Model: a single register.  ``w`` sets the value (any result), ``r``
+    must return the model value at its linearization point (None = key
+    absent, value ``initial`` before any write).
+    """
+    known: List[Dict] = []
+    unknown: List[Dict] = []
+    for e in history:
+        if e.get("ok", True):
+            known.append(e)
+        elif e["op"] == "w":
+            unknown.append({**e, "t_ret": math.inf})
+        # unknown reads constrain nothing: drop
+
+    ops = known + unknown
+    n = len(ops)
+    if n > 63:
+        return _check_big(ops, len(known), initial)
+    return _search(ops, len(known), initial)
+
+
+def _search(ops, n_known, initial) -> bool:
+    n = len(ops)
+    full_known = 0
+    for i in range(n_known):
+        full_known |= 1 << i
+    t_inv = [o["t_inv"] for o in ops]
+    t_ret = [o["t_ret"] for o in ops]
+    memo = set()
+
+    def dfs(done: int, state) -> bool:
+        if done & full_known == full_known:
+            return True
+        key = (done, state)
+        if key in memo:
+            return False
+        # Minimal ops: invocation precedes every pending completion.
+        min_ret = math.inf
+        for i in range(n):
+            if not (done >> i) & 1 and t_ret[i] < min_ret:
+                min_ret = t_ret[i]
+        for i in range(n):
+            if (done >> i) & 1 or t_inv[i] > min_ret:
+                continue
+            o = ops[i]
+            if o["op"] == "w":
+                if dfs(done | (1 << i), o["arg"]):
+                    return True
+            else:  # known read: result must match the model
+                if o["ret"] == state and dfs(done | (1 << i), state):
+                    return True
+        memo.add(key)
+        return False
+
+    return dfs(0, initial)
+
+
+def _check_big(ops, n_known, initial) -> bool:
+    """>63 ops: same search with frozenset masks (slower, no bit ops)."""
+    t_ret = {id(o): o["t_ret"] for o in ops}
+    known_ids = frozenset(id(o) for o in ops[:n_known])
+    memo = set()
+
+    def dfs(done: frozenset, state) -> bool:
+        if known_ids <= done:
+            return True
+        key = (done, state)
+        if key in memo:
+            return False
+        pending = [o for o in ops if id(o) not in done]
+        min_ret = min(t_ret[id(o)] for o in pending)
+        for o in pending:
+            if o["t_inv"] > min_ret:
+                continue
+            if o["op"] == "w":
+                if dfs(done | {id(o)}, o["arg"]):
+                    return True
+            elif o["ret"] == state and dfs(done | {id(o)}, state):
+                return True
+        memo.add(key)
+        return False
+
+    return dfs(frozenset(), initial)

@@ -1,0 +1,261 @@
+"""Jepsen-role tier: partitions + concurrent clients + linearizability.
+
+The reference's partition-tolerance claims are backed by an external
+Jepsen suite (``website/source/docs/internals/jepsen.html.markdown``:
+CP for consistent reads, writes linearized through Raft).  This tier
+reproduces that posture in-process: a 3-server cluster on the
+partition-injecting MemoryTransport, a nemesis that repeatedly cuts the
+leader away and heals, concurrent clients doing unique-value writes and
+``require_consistent`` reads of one register key, and a Wing&Gong-style
+checker (tests/linearize.py) over the recorded history.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+
+import pytest
+
+from consul_tpu.structs.structs import (
+    DirEntry, KVSOp, KVSRequest, KeyRequest, MessageType, QueryOptions)
+
+from linearize import check_linearizable
+from test_server_cluster import make_servers, start_and_elect, stop_all
+
+# ---------------------------------------------------------------------------
+# Checker self-tests: known-good and known-bad histories.
+# ---------------------------------------------------------------------------
+
+
+def _h(op, arg=None, ret=None, t0=0.0, t1=1.0, ok=True):
+    return {"op": op, "arg": arg, "ret": ret, "t_inv": t0, "t_ret": t1,
+            "ok": ok}
+
+
+def test_sequential_history_ok():
+    hist = [
+        _h("w", 1, t0=0, t1=1),
+        _h("r", ret=1, t0=2, t1=3),
+        _h("w", 2, t0=4, t1=5),
+        _h("r", ret=2, t0=6, t1=7),
+    ]
+    assert check_linearizable(hist)
+
+
+def test_stale_read_rejected():
+    # Read of 1 strictly after w(2) completed: not linearizable.
+    hist = [
+        _h("w", 1, t0=0, t1=1),
+        _h("w", 2, t0=2, t1=3),
+        _h("r", ret=1, t0=4, t1=5),
+    ]
+    assert not check_linearizable(hist)
+
+
+def test_concurrent_read_may_see_either():
+    # r overlaps w(2): may return old or new value.
+    base = [_h("w", 1, t0=0, t1=1), _h("w", 2, t0=2, t1=6)]
+    assert check_linearizable(base + [_h("r", ret=1, t0=3, t1=4)])
+    assert check_linearizable(base + [_h("r", ret=2, t0=3, t1=4)])
+    assert not check_linearizable(base + [_h("r", ret=7, t0=3, t1=4)])
+
+
+def test_lost_write_rejected():
+    # w(2) completed, but a later read still sees 1 and an even later
+    # read sees 2 — the 1-read is a linearizability violation.
+    hist = [
+        _h("w", 1, t0=0, t1=1),
+        _h("w", 2, t0=2, t1=3),
+        _h("r", ret=1, t0=4, t1=5),
+        _h("r", ret=2, t0=6, t1=7),
+    ]
+    assert not check_linearizable(hist)
+
+
+def test_unknown_write_may_apply_late():
+    # w(2) timed out (unknown): a much later read may legally see it.
+    hist = [
+        _h("w", 1, t0=0, t1=1),
+        _h("w", 2, t0=2, t1=3, ok=False),
+        _h("r", ret=1, t0=4, t1=5),
+        _h("r", ret=2, t0=6, t1=7),
+    ]
+    assert check_linearizable(hist)
+
+
+def test_unknown_write_may_never_apply():
+    hist = [
+        _h("w", 1, t0=0, t1=1),
+        _h("w", 2, t0=2, t1=3, ok=False),
+        _h("r", ret=1, t0=4, t1=5),
+        _h("r", ret=1, t0=6, t1=7),
+    ]
+    assert check_linearizable(hist)
+
+
+def test_value_from_nowhere_rejected():
+    hist = [
+        _h("w", 1, t0=0, t1=1),
+        _h("r", ret=9, t0=2, t1=3),
+    ]
+    assert not check_linearizable(hist)
+
+
+def test_big_history_path():
+    # >63 ops exercises the frozenset fallback.
+    hist = []
+    t = 0.0
+    for v in range(40):
+        hist.append(_h("w", v, t0=t, t1=t + 1)); t += 2
+        hist.append(_h("r", ret=v, t0=t, t1=t + 1)); t += 2
+    assert check_linearizable(hist)
+    hist.append(_h("r", ret=0, t0=t, t1=t + 1))
+    assert not check_linearizable(hist)
+
+
+# ---------------------------------------------------------------------------
+# Live tier: 3 servers, nemesis partitions, concurrent register clients.
+# ---------------------------------------------------------------------------
+
+KEY = "jepsen/register"
+
+
+async def _client(cid, servers, clock, history, n_ops, rng):
+    for seq in range(n_ops):
+        val = cid * 10_000 + seq
+        do_write = rng.random() < 0.5
+        t_inv = clock()
+        ok = False
+        ret = None
+        try:
+            if do_write:
+                await asyncio.wait_for(
+                    _write_any(servers, val, rng), timeout=2.0)
+                ok = True
+            else:
+                ret = await asyncio.wait_for(
+                    _read_any(servers, rng), timeout=2.0)
+                ok = True
+        except Exception:
+            ok = False
+        history.append({
+            "op": "w" if do_write else "r",
+            "arg": val if do_write else None,
+            "ret": ret,
+            "t_inv": t_inv,
+            "t_ret": clock() if ok else math.inf,
+            "ok": ok,
+        })
+        await asyncio.sleep(rng.uniform(0.0, 0.03))
+
+
+async def _write_any(servers, val, rng):
+    last = None
+    for s in rng.sample(servers, len(servers)):
+        try:
+            await s.kvs.apply(KVSRequest(
+                datacenter="dc1", op=KVSOp.SET.value,
+                dir_ent=DirEntry(key=KEY, value=str(val).encode())))
+            return
+        except Exception as e:  # not leader / partitioned: try next
+            last = e
+            await asyncio.sleep(0.02)
+    raise last
+
+
+async def _read_any(servers, rng):
+    last = None
+    for s in rng.sample(servers, len(servers)):
+        try:
+            _, out = await s.kvs.get(KeyRequest(
+                datacenter="dc1", key=KEY, require_consistent=True))
+            if not out:
+                return None
+            return int(out[0].value.decode())
+        except Exception as e:
+            last = e
+            await asyncio.sleep(0.02)
+    raise last
+
+
+async def _nemesis(tr, servers, stop_evt, rng):
+    """Repeatedly cut the current leader off from the majority, wait for
+    a new election + traffic under the partition, then heal."""
+    while not stop_evt.is_set():
+        await asyncio.sleep(rng.uniform(0.3, 0.6))
+        leaders = [s for s in servers if s.is_leader()]
+        if not leaders:
+            continue
+        victim = leaders[0].config.node_name
+        tr.isolate(victim)
+        await asyncio.sleep(rng.uniform(0.4, 0.8))
+        tr.rejoin(victim)
+
+
+def test_register_linearizable_under_partitions():
+    asyncio.run(_run_partition_scenario())
+
+
+async def _run_partition_scenario():
+    rng = random.Random(11)
+    tr, servers = make_servers(3)
+    await start_and_elect(servers)
+    loop = asyncio.get_event_loop()
+    t0 = loop.time()
+    clock = lambda: loop.time() - t0
+
+    history = []
+    stop_evt = asyncio.Event()
+    nem = asyncio.create_task(_nemesis(tr, servers, stop_evt, rng))
+    clients = [asyncio.create_task(
+        _client(cid, servers, clock, history, n_ops=25,
+                rng=random.Random(100 + cid)))
+        for cid in range(4)]
+    try:
+        await asyncio.wait_for(asyncio.gather(*clients), timeout=120)
+    finally:
+        stop_evt.set()
+        nem.cancel()
+        for s in servers:
+            tr.rejoin(s.config.node_name)
+        await asyncio.sleep(0)
+        await stop_all(servers)
+
+    n_ok = sum(1 for e in history if e["ok"])
+    n_writes_ok = sum(1 for e in history if e["ok"] and e["op"] == "w")
+    n_reads_ok = sum(1 for e in history if e["ok"] and e["op"] == "r")
+    # The run must have made real progress through the partitions, or
+    # the linearizability claim is vacuous.
+    assert n_ok >= 40, f"only {n_ok} completed ops"
+    assert n_writes_ok >= 10, f"only {n_writes_ok} completed writes"
+    assert n_reads_ok >= 10, f"only {n_reads_ok} completed reads"
+    assert check_linearizable(history), (
+        f"history not linearizable ({len(history)} ops, {n_ok} ok)")
+
+
+def test_register_linearizable_without_nemesis():
+    """Control run: no partitions; everything should complete and check."""
+    asyncio.run(_run_control_scenario())
+
+
+async def _run_control_scenario():
+    rng = random.Random(7)
+    tr, servers = make_servers(3)
+    await start_and_elect(servers)
+    loop = asyncio.get_event_loop()
+    t0 = loop.time()
+    clock = lambda: loop.time() - t0
+
+    history = []
+    clients = [asyncio.create_task(
+        _client(cid, servers, clock, history, n_ops=15,
+                rng=random.Random(200 + cid)))
+        for cid in range(3)]
+    await asyncio.wait_for(asyncio.gather(*clients), timeout=60)
+    await stop_all(servers)
+
+    assert sum(1 for e in history if not e["ok"]) <= 5
+    assert sum(1 for e in history if e["ok"] and e["op"] == "r") >= 10
+    assert check_linearizable(history)
