@@ -6,14 +6,24 @@ behavior: memberlist's gossip tick, documented at
 ``website/source/docs/internals/gossip.html.markdown:10-43`` and consumed
 via Serf at ``consul/config.go:268-272``).  Delivering pushes on TPU
 naively needs a scatter keyed by destination (or a sort of N*fanout
-edges per round).  Instead we draw each round's communication graph as
-``fanout`` independent pseudorandom *permutations* of the node set: node
-``i`` pushes to ``perm_f(i)``, so the senders into node ``d`` are exactly
-``perm_f^{-1}(d)`` — delivery becomes ``fanout`` vectorized gathers.
+edges per round).  Drawing each round's communication graph as
+``fanout`` independent pseudorandom *permutations* of the node set makes
+delivery ``fanout`` vectorized gathers: node ``i`` pushes to
+``perm_f(i)``, so the senders into node ``d`` are ``perm_f^{-1}(d)``.
 The in-degree is exactly ``fanout`` instead of Poisson(fanout); the
 epidemic growth statistics are nearly identical (quantified against the
 discrete-event reference model, gossip/refmodel.py, in the
 cross-validation test tier) and the tails are *tighter*.
+
+HISTORY NOTE (round 3): the production kernels no longer use these —
+on the v5e an arbitrary-permutation gather costs ~6.5ns per random
+index while a contiguous roll moves at memory bandwidth, so
+``kernel.gossip_offsets`` replaced per-node permutations with per-round
+circulant shifts (the same exact-in-degree property, ~25x cheaper
+delivery).  The module remains the general-purpose invertible-PRP op
+(used by the profiler as the gather-cost yardstick and exercised by
+tests/test_feistel.py); anything needing per-node — rather than
+per-round — randomized routing starts here.
 
 The permutation is a balanced Feistel network over ``2^(2*h)`` with a
 murmur-style round function, plus cycle-walking for arbitrary domain
