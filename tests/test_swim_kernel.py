@@ -134,3 +134,35 @@ def test_detection_time_scales_with_suspicion_mult():
         assert int(st.n_detected) == 1
         times.append(int(st.sum_detect_rounds))
     assert times[1] > times[0]
+
+
+def test_hot_tier_matches_full_path():
+    """hot_slots (non-default) must be a pure execution-strategy switch:
+    the gathered-subset tail and the full-width tail produce bit-equal
+    states — inactive rows are all-zero, so excluding them is exact."""
+    fail = np.full(128, NEVER, np.int32)
+    fail[7] = 10
+    fail[90] = 25
+    states = []
+    for hot in (0, 4):
+        p = SwimParams(n=128, slots=16, probe_every=2, hot_slots=hot)
+        st, _ = run(p, fail, 120, seed=3)
+        states.append(st)
+    for a, b in zip(states[0], states[1]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quiescent_path_is_exact():
+    """A run that passes through quiescent -> active -> quiescent again
+    must detect exactly like one that was never quiescent-optimized:
+    the final membership and counters depend only on protocol inputs."""
+    p = small_params(n=96, slots=8)
+    fail = np.full(p.n, NEVER, np.int32)
+    fail[11] = 30  # long quiescent prefix before the only failure
+    st, _ = run(p, fail, 500, seed=5)
+    assert int(st.n_detected) == 1
+    assert not bool(st.member[11])
+    assert int(st.n_false_dead) == 0
+    # All slots recycled after the episode: back to quiescent.
+    assert int(jnp.sum((st.slot_phase != PHASE_FREE).astype(jnp.int32))) == 0
+    assert int(jnp.sum(st.heard)) == 0
