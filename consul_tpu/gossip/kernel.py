@@ -411,9 +411,8 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                             _pushpull, lambda h: h, h)
 
     def _full_tail(heard):
-        # -- 2. age every in-flight rumor --------------------------------
-        heard = _age_tick(heard)
-        # -- 3. gossip dissemination (push via circulant rolls) ----------
+        # -- 2+3. age (fused into the dissemination pack) + gossip push
+        # via circulant rolls ---------------------------------------------
         heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap)
         heard = _maybe_pushpull(heard, rx_ok)
         return _finish_round(p, state, rnd, fail_round, alive, member, heard,
@@ -433,7 +432,6 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
         _, idx = jax.lax.top_k(act, p.hot_slots)
         idx = idx.astype(jnp.int32)
         sub = heard[idx]
-        sub = _age_tick(sub)
         sub = _disseminate(p, rnd, k_gossip, sub, mf, rx_ok, conf_cap[idx])
         sub = _maybe_pushpull(sub, rx_ok)
         return _finish_round(p, state, rnd, fail_round, alive, member, sub,
@@ -506,6 +504,16 @@ def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
     h_rows = (jnp.concatenate(
         [heard, jnp.zeros((pad, N), jnp.uint8)]) if pad else heard)
     planes = h_rows.reshape(S4, 4, N).astype(jnp.uint32)
+    # Age tick, fused into the packing chain on u32 lanes (the
+    # standalone u8 pass costs a full read+write of the matrix): fresh
+    # probe marks (_AGE_FRESH sentinel) become age 0, real ages
+    # saturate at 14.  See _age_tick for the semantics.
+    msg = planes >> _MSG_SHIFT
+    age = planes & _AGE_MASK
+    new_age = jnp.where(age == _AGE_FRESH, jnp.uint32(0),
+                        jnp.minimum(age + 1, jnp.uint32(_AGE_MASK - 1)))
+    planes = jnp.where(msg > 0,
+                       (planes & ~jnp.uint32(_AGE_MASK)) | new_age, planes)
     packed = (planes[:, 0] | (planes[:, 1] << 8)
               | (planes[:, 2] << 16) | (planes[:, 3] << 24))
 

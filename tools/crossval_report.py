@@ -142,19 +142,30 @@ def main() -> None:
               "definition": "latency = dead_declared_round - fail_round; "
                             "relative_error = |kernel - refmodel| / refmodel",
               "configs": []}
+    path = os.path.join(REPO, "CROSSVAL.json")
+
+    def _flush():
+        # Write after EVERY config: the lossy oracle tail can run for
+        # an hour+ of CPU — it must never hold the artifact hostage.
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1, allow_nan=False)
+        print(f"[crossval] wrote {path} ({len(report['configs'])} configs)",
+              file=sys.stderr, flush=True)
+
     for n in (1000, 10000):
         print(f"[crossval] n={n} ...", file=sys.stderr, flush=True)
         report["configs"].append(run_config(n, victims, seeds))
-    # false-positive behavior under heavy loss (BASELINE config #2 tail)
-    print("[crossval] n=1000 loss=0.25 ...", file=sys.stderr, flush=True)
-    report["configs"].append(run_config(1000, victims, max(2, seeds // 2),
-                                        loss=0.25))
-
-    path = os.path.join(REPO, "CROSSVAL.json")
-    with open(path, "w") as f:
-        json.dump(report, f, indent=1, allow_nan=False)
+        _flush()
+    # False-positive behavior under heavy loss (BASELINE config #2
+    # tail).  Loss makes the per-node oracle pathologically slow (every
+    # probe spawns suspicion churn), so this config runs at reduced
+    # scale — the point is comparing false-positive/refute RATES, which
+    # n=500 resolves fine.
+    print("[crossval] n=500 loss=0.25 ...", file=sys.stderr, flush=True)
+    report["configs"].append(run_config(500, max(4, victims // 2),
+                                        max(2, seeds // 4), loss=0.25))
+    _flush()
     print(json.dumps(report, indent=1))
-    print(f"[crossval] wrote {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":
