@@ -106,7 +106,9 @@ class SwimState(NamedTuple):
                                 #   consume this field when they land)
     slot_start: jnp.ndarray     # i32 [S] — round the episode began
     slot_nsusp: jnp.ndarray     # i32 [S] — independent suspicion initiators
-    slot_dead_round: jnp.ndarray  # i32 [S] — round dead was declared, -1
+    slot_dead_round: jnp.ndarray  # i32 [S] — round the episode's verdict was
+                                #   declared (dead by timer, or refute), -1
+                                #   while still in suspicion
     slot_of_node: jnp.ndarray   # i32 [N] — node -> slot, -1 = none
     incarnation: jnp.ndarray    # i32 [N] — per-node incarnation counter
     member: jnp.ndarray         # bool [N] — current cluster membership
@@ -606,6 +608,11 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
                       & ((own_msg == MSG_SUSPECT) | (own_msg == MSG_DEAD)))
         incarnation = incarnation.at[jnp.where(refute_now, node_c, N)].add(1, mode="drop")
         sl_phase = jnp.where(refute_now, PHASE_REFUTED, sl_phase)
+        # The refute IS the episode's verdict: record its round so GC can
+        # recycle the slot as soon as the verdict has disseminated (a
+        # dead-then-refuted slot's dead round is superseded — the refute
+        # is the message that still needs spreading).
+        sl_dead_round = jnp.where(refute_now, rnd, sl_dead_round)
         heard_sub = heard_sub.at[hrows, node_c].max(
             jnp.where(refute_now, jnp.uint8(_enc(MSG_REFUTE)), jnp.uint8(0)))
         n_refuted = n_refuted + jnp.sum(refute_now.astype(jnp.int32))
@@ -633,17 +640,22 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
     n_false_dead = state.n_false_dead + jnp.sum((new_dead & ~truly_dead).astype(jnp.int32))
 
     # -- 6. episode GC: recycle slots, apply verdicts ---------------------
-    # A slot whose timer already fired only needs to outlive the DEAD
-    # verdict's dissemination (two spread budgets, like the slot-TTL
-    # tail), not the worst-case zero-confirmation suspicion timeout —
-    # under churn this recycles slots ~6x sooner at 1M nodes, which is
-    # scarcity relief, not a semantics change (memberlist has no slot
-    # scarcity at all; a recycled-slot subject that still fails probes
-    # re-enters suspicion at the next cycle).
-    dead_done = ((sl_phase == PHASE_DEAD) & (sl_dead_round >= 0)
-                 & (rnd - sl_dead_round > 2 * p.spread_budget_rounds + 8))
+    # A slot whose verdict is in (dead by timer, or refuted) only needs
+    # to outlive that verdict's dissemination (two spread budgets, like
+    # the slot-TTL tail), not the worst-case zero-confirmation suspicion
+    # timeout.  This is scarcity relief, not a semantics change
+    # (memberlist has no slot scarcity at all; a recycled-slot subject
+    # that still fails probes re-enters suspicion at the next cycle).
+    # Fast-recycling REFUTED slots matters most: under heavy loss the
+    # spurious-suspicion rate is high (25% loss: ~0.03*N new refuted
+    # episodes per round), and holding each for the full slot TTL
+    # starved every slot — 87% of true failures went undetected in the
+    # round-3 crossval loss config (CROSSVAL.json config 3: 2/16).
+    verdict_done = ((((sl_phase == PHASE_DEAD) | (sl_phase == PHASE_REFUTED))
+                     & (sl_dead_round >= 0))
+                    & (rnd - sl_dead_round > 2 * p.spread_budget_rounds + 8))
     expired = ((sl_phase > PHASE_FREE)
-               & ((rnd - sl_start > p.slot_ttl_rounds) | dead_done))
+               & ((rnd - sl_start > p.slot_ttl_rounds) | verdict_done))
     is_dead = expired & (sl_phase == PHASE_DEAD)
     member = member.at[jnp.where(is_dead, node_c, N)].set(False, mode="drop")
     slot_of_node = slot_of_node.at[jnp.where(expired, node_c, N)].set(-1, mode="drop")

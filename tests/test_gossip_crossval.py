@@ -2,8 +2,11 @@
 
 BASELINE.md config 2: the kernel's detection-time distribution must track
 the reference model's (which faithfully implements per-node SWIM/Lifeguard
-semantics).  These tests quantify the kernel's documented approximations
-(permutation gossip, episode-start timers, receipt-based confirmations).
+semantics).  These tests gate on the SAME statistics the published
+CROSSVAL.json artifact reports (consul_tpu.gossip.crossval.run_config):
+p99 relative latency error and detection completeness — the round-3
+lesson was that a loose mean-ratio check in-suite let an 87% detection
+loss and a p99 drift ship invisibly.
 """
 
 import jax
@@ -11,54 +14,65 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from consul_tpu.gossip.crossval import (kernel_event_latencies,
+                                        loss_sized_slots, run_config)
 from consul_tpu.gossip.kernel import NEVER, init_state, run_rounds
 from consul_tpu.gossip.params import SwimParams
 from consul_tpu.gossip.refmodel import RefModel
 
 
-def kernel_latencies(p, fail_at, n_seeds):
-    """Mean detection latency (rounds) per seed for one injected failure."""
-    out = []
-    fail = np.full(p.n, NEVER, np.int32)
-    victim = p.n // 3
-    fail[victim] = fail_at
-    steps = fail_at + p.slot_ttl_rounds + 8 * p.probe_every
-    for s in range(n_seeds):
-        st, _ = run_rounds(init_state(p), jax.random.key(s), jnp.asarray(fail), p, steps)
-        det = int(st.n_detected)
-        assert det == 1, f"kernel seed {s}: detected {det} != 1"
-        out.append(int(st.sum_detect_rounds) / det)
-    return np.asarray(out)
-
-
-def refmodel_latencies(p, fail_at, n_seeds):
-    out = []
-    victim = p.n // 3
-    steps = fail_at + p.slot_ttl_rounds + 8 * p.probe_every
-    for s in range(n_seeds):
-        m = RefModel(p, {victim: fail_at}, seed=1000 + s)
-        m.run(steps)
-        lats = m.detection_latencies()
-        assert len(lats) == 1, f"refmodel seed {s}: detected {len(lats)} != 1"
-        out.append(lats[0])
-    return np.asarray(out)
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_detection_latency_tracks_reference():
+    """CI-sized version of the CROSSVAL.json lossless config: n=1k,
+    2 seeds.  Gates: p99 relative error <= 15%, completeness >= 95%
+    (lossless detection must be essentially total), both models inside
+    the Lifeguard envelope.  Tool-run evidence at full seed count:
+    p99 err 2-6% at 1k/10k (CROSSVAL.json)."""
+    out = run_config(n=1000, n_victims=8, seeds=2)
+    assert out["completeness"]["kernel"] >= 0.95, out["completeness"]
+    assert out["completeness"]["refmodel"] >= 0.95, out["completeness"]
+    assert out["relative_error"]["p99"] is not None
+    assert out["relative_error"]["p99"] <= 0.15, out["relative_error"]
+    assert out["relative_error"]["p50"] <= 0.15, out["relative_error"]
+    # Both models must sit within the Lifeguard envelope: fail -> first
+    # probe window + suspicion timeout in [min, max].
+    lo, hi = out["lifeguard_envelope_rounds"]
+    for model in ("kernel", "refmodel"):
+        mean = out["detection_latency_rounds"][model]["mean"]
+        assert lo * 0.8 < mean < hi + 30, (model, mean, lo, hi)
 
 
 @pytest.mark.slow
-def test_detection_latency_tracks_reference():
-    p = SwimParams(n=192, slots=16, probe_every=5)
-    fail_at = 25
-    k = kernel_latencies(p, fail_at, 12)
-    r = refmodel_latencies(p, fail_at, 12)
-    ratio = k.mean() / r.mean()
-    # Observed calibration: ~0.91 (kernel slightly fast — episode-start
-    # timers fire earlier for late hearers; permutation gossip spreads
-    # slightly faster than Poisson push).  Alert if drift exceeds ±30%.
-    assert 0.7 < ratio < 1.3, f"kernel {k.mean():.1f} vs ref {r.mean():.1f} rounds"
-    # Both must sit within the Lifeguard envelope: fail -> first probe
-    # window + suspicion timeout in [min, max].
-    for lat in (k.mean(), r.mean()):
-        assert p.suspicion_min_rounds * 0.8 < lat < p.suspicion_max_rounds + 6 * p.probe_every
+@pytest.mark.timeout_s(600)
+def test_loss_regime_detection_completeness():
+    """Round-3 regression (CROSSVAL config 3): at 25% loss the kernel
+    detected 2/16 injected failures — spurious refuted episodes held
+    their slots for the full TTL and starved the table.  With verdict-
+    based refuted-slot GC + loss-sized provisioning, completeness must
+    stay >= 90% inside the Lifeguard envelope.  Kernel-only (the oracle
+    needs no slots, and its lossy runs cost minutes)."""
+    n, loss = 500, 0.25
+    slots = loss_sized_slots(n, loss)
+    p = SwimParams(n=n, slots=slots, probe_every=5, loss_rate=loss)
+    first_fail = 30
+    spacing = 10
+    n_victims = 8
+    fail_at = {(n // (n_victims + 1)) * (i + 1): first_fail + i * spacing
+               for i in range(n_victims)}
+    steps = (first_fail + n_victims * spacing + p.suspicion_max_rounds
+             + 2 * p.spread_budget_rounds + 8 * p.probe_every)
+    detected = 0
+    expected = 0
+    for seed in (0, 1):
+        lats, _fp, _ref, drops = kernel_event_latencies(p, fail_at, steps,
+                                                        seed=seed)
+        detected += len(lats)
+        expected += len(fail_at)
+    completeness = detected / expected
+    assert completeness >= 0.9, (
+        f"loss-regime completeness {completeness:.2f} ({detected}/{expected})"
+        f" — slot starvation is back? slots={slots}")
 
 
 @pytest.mark.slow

@@ -1,15 +1,12 @@
-"""Cross-validation report: TPU kernel vs discrete-event SWIM oracle.
+"""Cross-validation artifact generator: ``CROSSVAL.json``.
 
-VERDICT round-1 item 6 / BASELINE.md configs #2-#3: quantify the
-kernel's detection-time distribution against the per-node reference
-model at 1k and 10k nodes with matched protocol configs, reporting
-p50/p99 latency error and false-positive counts into ``CROSSVAL.json``
-at the repo root.
-
-Per-event kernel latencies come from the round trace: a victim's
-episode slot records ``slot_dead_round`` when its suspicion timer
-fires; latency = dead_round - fail_round (the same definition
-``RefModel.detection_latencies`` uses: dead_tick - fail_tick).
+BASELINE.md configs #2-#3: quantify the kernel's detection-time
+distribution against the per-node reference model at 1k and 10k nodes
+with matched protocol configs, plus the heavy-loss false-positive
+config.  The statistics core is ``consul_tpu.gossip.crossval`` — the
+same code the in-suite regression tier gates on
+(``tests/test_gossip_crossval.py``), so this artifact can never drift
+from what the suite asserts.
 
 Run:  python tools/crossval_report.py [--quick]
 """
@@ -22,8 +19,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -31,103 +26,7 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
-
-def kernel_event_latencies(p, fail_at: dict, steps: int, seed: int):
-    import jax
-    import jax.numpy as jnp
-
-    from consul_tpu.gossip.kernel import NEVER, init_state, run_rounds
-
-    fail = np.full(p.n, NEVER, np.int32)
-    for v, t in fail_at.items():
-        fail[v] = t
-    st, trace = run_rounds(init_state(p), jax.random.key(seed),
-                           jnp.asarray(fail), p, steps, trace=True)
-    slot_node = np.asarray(trace.slot_node)        # [T, S]
-    slot_dead = np.asarray(trace.slot_dead_round)  # [T, S]
-    lats = []
-    for v, t_fail in fail_at.items():
-        # Only true detections: a lossy run can falsely declare a victim
-        # dead BEFORE its fail round — the refmodel books those under
-        # n_false_dead, not detection latency, so we must too.
-        mask = (slot_node == v) & (slot_dead >= t_fail)
-        if mask.any():
-            lats.append(int(slot_dead[mask].min()) - t_fail)
-    return lats, int(st.n_false_dead), int(st.n_refuted)
-
-
-def refmodel_event_latencies(p, fail_at: dict, steps: int, seed: int):
-    from consul_tpu.gossip.refmodel import RefModel
-    m = RefModel(p, dict(fail_at), seed=seed)
-    m.run(steps)
-    return m.detection_latencies(), m.n_false_dead, m.n_refuted
-
-
-def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0):
-    from consul_tpu.gossip.params import SwimParams
-    p = SwimParams(n=n, slots=64, probe_every=5, loss_rate=loss)
-    first_fail = 30
-    spacing = max(5, p.suspicion_min_rounds // 4)
-    fail_at = {(n // (n_victims + 1)) * (i + 1): first_fail + i * spacing
-               for i in range(n_victims)}
-    steps = (first_fail + n_victims * spacing
-             + p.slot_ttl_rounds + 8 * p.probe_every)
-
-    k_lats, r_lats = [], []
-    k_fp = r_fp = k_ref = r_ref = 0
-    t0 = time.time()
-    for s in range(seeds):
-        kl, kf, kr = kernel_event_latencies(p, fail_at, steps, seed=s)
-        k_lats += kl
-        k_fp += kf
-        k_ref += kr
-    t_kernel = time.time() - t0
-    t0 = time.time()
-    for s in range(seeds):
-        rl, rf, rr = refmodel_event_latencies(p, fail_at, steps,
-                                              seed=1000 + s)
-        r_lats += rl
-        r_fp += rf
-        r_ref += rr
-    t_ref = time.time() - t0
-
-    k = np.asarray(k_lats, float)
-    r = np.asarray(r_lats, float)
-
-    def pct(a, q):
-        return float(np.percentile(a, q)) if len(a) else None
-
-    def rel(kv, rv):
-        if kv is None or rv is None or not rv:
-            return None
-        return round(abs(kv - rv) / rv, 4)
-
-    out = {
-        "n": n,
-        "loss_rate": loss,
-        "victims_per_run": n_victims,
-        "seeds": seeds,
-        "samples": {"kernel": len(k), "refmodel": len(r)},
-        "expected_events": n_victims * seeds,
-        "detection_latency_rounds": {
-            "kernel": {"mean": round(float(k.mean()), 2) if len(k) else None,
-                       "p50": pct(k, 50), "p99": pct(k, 99)},
-            "refmodel": {"mean": round(float(r.mean()), 2) if len(r) else None,
-                         "p50": pct(r, 50), "p99": pct(r, 99)},
-        },
-        "relative_error": {
-            "mean": rel(float(k.mean()) if len(k) else None,
-                        float(r.mean()) if len(r) else None),
-            "p50": rel(pct(k, 50), pct(r, 50)),
-            "p99": rel(pct(k, 99), pct(r, 99)),
-        },
-        "false_dead": {"kernel": k_fp, "refmodel": r_fp},
-        "refutes": {"kernel": k_ref, "refmodel": r_ref},
-        "lifeguard_envelope_rounds": [p.suspicion_min_rounds,
-                                      p.suspicion_max_rounds],
-        "wall_s": {"kernel": round(t_kernel, 1), "refmodel": round(t_ref, 1)},
-    }
-    return out
+from consul_tpu.gossip.crossval import run_config  # noqa: E402
 
 
 def main() -> None:
@@ -140,7 +39,8 @@ def main() -> None:
 
     report = {"generated_unix": int(time.time()),
               "definition": "latency = dead_declared_round - fail_round; "
-                            "relative_error = |kernel - refmodel| / refmodel",
+                            "relative_error = |kernel - refmodel| / refmodel; "
+                            "completeness = detected / injected",
               "configs": []}
     path = os.path.join(REPO, "CROSSVAL.json")
 
@@ -156,11 +56,12 @@ def main() -> None:
         print(f"[crossval] n={n} ...", file=sys.stderr, flush=True)
         report["configs"].append(run_config(n, victims, seeds))
         _flush()
-    # False-positive behavior under heavy loss (BASELINE config #2
-    # tail).  Loss makes the per-node oracle pathologically slow (every
-    # probe spawns suspicion churn), so this config runs at reduced
-    # scale — the point is comparing false-positive/refute RATES, which
-    # n=500 resolves fine.
+    # False-positive + completeness behavior under heavy loss (BASELINE
+    # config #2 tail).  Loss makes the per-node oracle pathologically
+    # slow (every probe spawns suspicion churn), so this config runs at
+    # reduced scale — the point is comparing false-positive/refute
+    # RATES and detection completeness, which n=500 resolves fine.
+    # Slot provisioning is loss-sized (crossval.loss_sized_slots).
     print("[crossval] n=500 loss=0.25 ...", file=sys.stderr, flush=True)
     report["configs"].append(run_config(500, max(4, victims // 2),
                                         max(2, seeds // 4), loss=0.25))
