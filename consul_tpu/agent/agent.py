@@ -55,7 +55,16 @@ class AgentConfig:
     advertise_addr: str = ""
     domain: str = "consul."
     http_port: int = 8500
+    https_port: int = -1   # >0 mounts the API on TLS too (http.go:44-173)
     dns_port: int = 8600
+    # Per-listener address overrides (config.go AddressConfig +
+    # UnixSockets): keys "http"/"rpc", values an IP or "unix:///path".
+    addresses: Dict[str, str] = field(default_factory=dict)
+    # TLS material for the HTTPS listener (tlsutil; config.go:107-113)
+    verify_incoming: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
     server: bool = True
     bootstrap: bool = True
     data_dir: str = ""  # "" = no persistence (dev mode)
@@ -75,6 +84,13 @@ class AgentConfig:
     acl_master_token: str = ""
     acl_token: str = ""  # agent's own default token
     encrypt: str = ""    # base64 16-byte gossip key (enables the keyring)
+    protocol: int = 2    # -protocol: operating protocol version (vsn tag)
+    # LAN membership substrate: "swim" = per-agent asyncio memberlist;
+    # "tpu" = delegate to the TPU gossip plane daemon (the kernel IS the
+    # failure detector; gossip/plane.py).  The WAN pool always runs the
+    # asyncio backend (tiny, servers-only).
+    gossip_backend: str = "swim"
+    gossip_plane: str = ""  # plane rendezvous: host:port or unix://path
     # -- membership plane (command/agent/config.go ports + retry-join) ----
     serf_lan_port: int = 0         # 0 = ephemeral (production: 8301)
     serf_wan_port: int = 0         # servers only (production: 8302)
@@ -202,10 +218,36 @@ class Agent:
             await self._register_self()
         self._load_persisted()
         self.local.start()
-        await self.http.start(self.config.bind_addr, self.config.http_port)
+        await self._start_http()
         await self.dns.start(self.config.bind_addr, self.config.dns_port)
-        if self.ipc_port is not None:
+        ipc_addr = self.config.addresses.get("rpc", "")
+        if ipc_addr.startswith("unix://"):
+            await self.ipc.start(unix_path=ipc_addr[len("unix://"):])
+        elif self.ipc_port is not None:
             await self.ipc.start(self.config.bind_addr, self.ipc_port)
+
+    async def _start_http(self) -> None:
+        """Mount the HTTP API on every configured listener: plain TCP,
+        unix socket (addresses.http = unix://...), and HTTPS when
+        ports.https > 0 (command/agent/http.go:44-173)."""
+        http_addr = self.config.addresses.get("http", "")
+        unix_path = (http_addr[len("unix://"):]
+                     if http_addr.startswith("unix://") else None)
+        ssl_ctx = None
+        if self.config.https_port > 0:
+            from consul_tpu.tlsutil import TLSConfig
+            tls = TLSConfig(verify_incoming=self.config.verify_incoming,
+                            ca_file=self.config.ca_file,
+                            cert_file=self.config.cert_file,
+                            key_file=self.config.key_file)
+            ssl_ctx = tls.incoming_context()
+            if ssl_ctx is None:
+                raise ValueError(
+                    "ports.https set but cert_file/key_file missing")
+        await self.http.start(self.config.bind_addr, self.config.http_port,
+                              unix_path=unix_path,
+                              https_port=self.config.https_port,
+                              ssl_context=ssl_ctx)
 
     async def _start_gossip(self) -> None:
         """Arm the LAN (+WAN for servers) pools, rejoin from snapshots,
@@ -216,9 +258,11 @@ class Agent:
         rpc_port = int(self.rpc_addr.rpartition(":")[2] or 8300)
         tags = (server_tags(self.config.datacenter, rpc_port,
                             bootstrap=self.config.bootstrap,
-                            expect=self.config.bootstrap_expect)
+                            expect=self.config.bootstrap_expect,
+                            protocol=self.config.protocol)
                 if self.config.server else
-                client_tags(self.config.datacenter))
+                client_tags(self.config.datacenter,
+                            protocol=self.config.protocol))
         snap_dir = (os.path.join(self.config.data_dir, "serf")
                     if self.config.data_dir else "")
         timing = dict(self.config.serf_timing)
@@ -233,17 +277,28 @@ class Agent:
         def wan_ok(node) -> bool:
             return node.tags.get("role") == "consul"
 
-        self.lan_pool = SerfPool(SerfConfig(
+        lan_cfg = SerfConfig(
             node_name=self.config.node_name,
             bind_addr=self.config.bind_addr,
             bind_port=self.config.serf_lan_port,
             advertise_addr=self.config.advertise_addr,
             tags=tags,
+            protocol_version=self.config.protocol,
             snapshot_path=(os.path.join(snap_dir, "local.snapshot")
                            if snap_dir else ""),
-            **timing),
-            keyring=self.server.keyring, on_event=self._on_lan_event,
-            member_filter=lan_ok)
+            **timing)
+        if self.config.gossip_backend == "tpu":
+            # The graft: membership substrate = the kernel session in
+            # the gossip plane daemon, behind the same serf boundary.
+            from consul_tpu.membership.tpu_backend import TpuSerfPool
+            self.lan_pool = TpuSerfPool(
+                lan_cfg, keyring=self.server.keyring,
+                on_event=self._on_lan_event, member_filter=lan_ok,
+                plane_addr=self.config.gossip_plane)
+        else:
+            self.lan_pool = SerfPool(
+                lan_cfg, keyring=self.server.keyring,
+                on_event=self._on_lan_event, member_filter=lan_ok)
         await self.lan_pool.start()
         if self.config.server:
             # WAN member names are qualified node.dc (consul/server.go:288)
@@ -252,7 +307,9 @@ class Agent:
                 bind_addr=self.config.bind_addr,
                 bind_port=self.config.serf_wan_port,
                 advertise_addr=self.config.advertise_addr,
-                tags=server_tags(self.config.datacenter, rpc_port),
+                tags=server_tags(self.config.datacenter, rpc_port,
+                                 protocol=self.config.protocol),
+                protocol_version=self.config.protocol,
                 snapshot_path=(os.path.join(snap_dir, "remote.snapshot")
                                if snap_dir else ""),
                 **timing),

@@ -97,6 +97,10 @@ class Config:
     leave_on_terminate: bool = False
     skip_leave_on_interrupt: bool = False
     encrypt: str = ""  # base64 16-byte gossip key
+    # LAN membership substrate: "swim" (asyncio memberlist role) or
+    # "tpu" (kernel session in the gossip plane daemon)
+    gossip_backend: str = "swim"
+    gossip_plane: str = ""  # plane rendezvous (host:port or unix://path)
 
     # DNS
     dns_config: DNSConfig = field(default_factory=DNSConfig)
@@ -305,6 +309,17 @@ def validate_config(cfg: Config) -> List[str]:
                 problems.append("Encrypt key must be 16 bytes")
         except Exception:
             problems.append("Invalid encrypt key (must be base64)")
+    try:
+        from consul_tpu.version import check_protocol_version
+        check_protocol_version(cfg.protocol)
+    except ValueError as e:
+        problems.append(str(e))
+    if cfg.gossip_backend not in ("swim", "tpu"):
+        problems.append(f"Invalid gossip_backend: {cfg.gossip_backend!r} "
+                        "(must be 'swim' or 'tpu')")
+    if cfg.gossip_backend == "tpu" and not cfg.gossip_plane:
+        problems.append("gossip_backend=tpu requires gossip_plane "
+                        "(the plane daemon's address)")
     if cfg.acl_datacenter and cfg.acl_default_policy not in ("allow", "deny"):
         problems.append(f"Invalid ACL default policy: {cfg.acl_default_policy}")
     if cfg.acl_datacenter and cfg.acl_down_policy not in (
@@ -347,6 +362,12 @@ def to_agent_config(cfg: Config):
         advertise_addr=advertise,
         domain=cfg.domain,
         http_port=cfg.ports.http,
+        https_port=cfg.ports.https,
+        addresses=dict(cfg.addresses),
+        verify_incoming=cfg.verify_incoming,
+        ca_file=cfg.ca_file,
+        cert_file=cfg.cert_file,
+        key_file=cfg.key_file,
         dns_port=cfg.ports.dns,
         server=cfg.server,
         bootstrap=cfg.bootstrap or (cfg.server and not cfg.bootstrap_expect),
@@ -375,5 +396,8 @@ def to_agent_config(cfg: Config):
         acl_master_token=cfg.acl_master_token,
         acl_token=cfg.acl_token,
         encrypt=cfg.encrypt,
+        protocol=cfg.protocol,
+        gossip_backend=cfg.gossip_backend,
+        gossip_plane=cfg.gossip_plane,
         enable_debug=cfg.enable_debug,
     )

@@ -80,6 +80,8 @@ class HTTPServer:
         self._register_routes()
         self._runner: Optional[web.AppRunner] = None
         self.addr: Optional[tuple] = None
+        self.https_addr: Optional[tuple] = None
+        self.unix_path: Optional[str] = None
 
     @property
     def srv(self):
@@ -87,14 +89,44 @@ class HTTPServer:
 
     # -- lifecycle ---------------------------------------------------------
 
-    async def start(self, host: str = "127.0.0.1", port: int = 8500) -> None:
+    async def start(self, host: str = "127.0.0.1", port: int = 8500,
+                    unix_path: str | None = None,
+                    https_port: int = -1,
+                    ssl_context=None) -> None:
+        """Mount the API on every configured listener.
+
+        The reference serves the same mux over plain HTTP, HTTPS, and
+        unix sockets through one route table
+        (``command/agent/http.go:44-173``; unix-socket addresses from
+        ``config.go`` UnixSockets).  Here: one aiohttp app, one runner,
+        N sites — ``port`` (TCP) or ``unix_path`` for HTTP (port < 0
+        disables TCP), plus an HTTPS TCPSite when ``https_port > 0``.
+        """
+        import os
+
         # Don't let in-flight blocking queries (up to 600s) stall shutdown.
         self._runner = web.AppRunner(self.app, access_log=None,
                                      shutdown_timeout=0.5)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host, port)
-        await site.start()
-        self.addr = site._server.sockets[0].getsockname()[:2]
+        if unix_path:
+            # The reference unlinks a stale socket before binding
+            # (http.go:71-76).
+            try:
+                os.unlink(unix_path)
+            except FileNotFoundError:
+                pass
+            site = web.UnixSite(self._runner, unix_path)
+            await site.start()
+            self.unix_path = unix_path
+        elif port >= 0:
+            site = web.TCPSite(self._runner, host, port)
+            await site.start()
+            self.addr = site._server.sockets[0].getsockname()[:2]
+        if https_port > 0 and ssl_context is not None:
+            ssite = web.TCPSite(self._runner, host, https_port,
+                                ssl_context=ssl_context)
+            await ssite.start()
+            self.https_addr = ssite._server.sockets[0].getsockname()[:2]
 
     async def stop(self) -> None:
         if self._runner is not None:

@@ -30,13 +30,20 @@ class APIError(Exception):
 
 @dataclass
 class Config:
-    """Client config; env fallbacks mirror api.go:118-177."""
+    """Client config; env fallbacks mirror api.go:118-177.
+
+    ``address`` may be ``host:port`` or ``unix:///path/to/socket`` (the
+    reference dials unix sockets when the address carries the scheme).
+    ``verify_ssl``/``ca_file`` control HTTPS verification
+    (CONSUL_HTTP_SSL_VERIFY / CONSUL_CACERT)."""
 
     address: str = "127.0.0.1:8500"
     scheme: str = "http"
     datacenter: str = ""
     token: str = ""
     timeout: float = 610.0  # > max blocking query wait
+    verify_ssl: bool = True
+    ca_file: str = ""
 
     @classmethod
     def default(cls) -> "Config":
@@ -49,6 +56,11 @@ class Config:
             cfg.token = token
         if os.environ.get("CONSUL_HTTP_SSL", "").lower() in ("1", "true"):
             cfg.scheme = "https"
+        if os.environ.get("CONSUL_HTTP_SSL_VERIFY", "").lower() in ("0", "false"):
+            cfg.verify_ssl = False
+        cacert = os.environ.get("CONSUL_CACERT")
+        if cacert:
+            cfg.ca_file = cacert
         return cfg
 
 
@@ -107,8 +119,23 @@ def _fmt_dur(seconds: float) -> str:
 class Client:
     def __init__(self, config: Optional[Config] = None) -> None:
         self.config = config or Config.default()
-        base = f"{self.config.scheme}://{self.config.address}"
-        self._http = httpx.Client(base_url=base, timeout=self.config.timeout)
+        if self.config.address.startswith("unix://"):
+            # Dial the agent's unix-socket HTTP listener; the base URL
+            # host is a placeholder (ignored by the UDS transport).
+            transport = httpx.HTTPTransport(
+                uds=self.config.address[len("unix://"):])
+            self._http = httpx.Client(base_url="http://localhost",
+                                      timeout=self.config.timeout,
+                                      transport=transport)
+        else:
+            base = f"{self.config.scheme}://{self.config.address}"
+            verify: Any = self.config.verify_ssl
+            if self.config.scheme == "https" and self.config.ca_file:
+                import ssl
+                verify = ssl.create_default_context(cafile=self.config.ca_file)
+            self._http = httpx.Client(base_url=base,
+                                      timeout=self.config.timeout,
+                                      verify=verify)
         self.kv = KV(self)
         self.agent = AgentAPI(self)
         self.catalog = CatalogAPI(self)

@@ -20,10 +20,16 @@ class IPCError(Exception):
 
 class IPCClient:
     def __init__(self, addr: str, timeout: float = 10.0) -> None:
-        host, _, port = addr.rpartition(":")
         self._timeout = timeout
-        self._sock = socket.create_connection((host or "127.0.0.1",
-                                               int(port)), timeout=timeout)
+        if addr.startswith("unix://"):
+            # Unix-socket IPC address (command/rpc.go + util_unix.go).
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(timeout)
+            self._sock.connect(addr[len("unix://"):])
+        else:
+            host, _, port = addr.rpartition(":")
+            self._sock = socket.create_connection((host or "127.0.0.1",
+                                                   int(port)), timeout=timeout)
         self._unpacker = msgpack.Unpacker(raw=False)
         self._seq = 0
         self._lock = threading.Lock()

@@ -29,10 +29,25 @@ class IPCServer:
         self.agent = agent
         self._server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[tuple] = None
+        self.unix_path: Optional[str] = None
 
-    async def start(self, host: str = "127.0.0.1", port: int = 8400) -> None:
-        self._server = await asyncio.start_server(self._serve, host, port)
-        self.addr = self._server.sockets[0].getsockname()[:2]
+    async def start(self, host: str = "127.0.0.1", port: int = 8400,
+                    unix_path: Optional[str] = None) -> None:
+        if unix_path:
+            # Unix-socket IPC address (rpc.go unix support via
+            # command/agent/config.go UnixSockets); stale socket files
+            # are unlinked before bind, as the reference does.
+            import os
+            try:
+                os.unlink(unix_path)
+            except FileNotFoundError:
+                pass
+            self._server = await asyncio.start_unix_server(self._serve,
+                                                           unix_path)
+            self.unix_path = unix_path
+        else:
+            self._server = await asyncio.start_server(self._serve, host, port)
+            self.addr = self._server.sockets[0].getsockname()[:2]
 
     async def stop(self) -> None:
         if self._server is not None:
