@@ -72,6 +72,8 @@ def cmd_agent(args) -> int:
         flag_doc["server"] = True
     if args.bootstrap:
         flag_doc["bootstrap"] = True
+    if args.protocol is not None:
+        flag_doc["protocol"] = args.protocol
     if flag_doc:
         cfg = merge_config(cfg, decode_config(json.dumps(flag_doc)))
     role_configured = cfg._set_fields & {"server", "bootstrap",
@@ -112,9 +114,13 @@ def cmd_agent(args) -> int:
 
     async def serve() -> None:
         await agent.start()
+        http_disp = ("unix://" + agent.http.unix_path
+                     if agent.http.unix_path else agent.http.addr)
+        ipc_disp = ("unix://" + agent.ipc.unix_path
+                    if agent.ipc.unix_path else agent.ipc.addr)
         print(f"==> consul-tpu agent running! Node: {acfg.node_name}, "
-              f"HTTP: {agent.http.addr}, DNS: {agent.dns.addr}, "
-              f"IPC: {agent.ipc.addr}")
+              f"HTTP: {http_disp}, DNS: {agent.dns.addr}, "
+              f"IPC: {ipc_disp}")
         sys.stdout.flush()
         # register config-defined services/checks/watches (command.go
         # serve: service/check stanzas + watch plans :710-718)
@@ -147,7 +153,11 @@ def cmd_agent(args) -> int:
         watch_plans = []
         if cfg.watches:
             from consul_tpu.watch import parse as watch_parse
-            http_addr = "%s:%s" % agent.http.addr
+            # Watch plans dial whichever HTTP listener exists (the api
+            # client speaks unix:// addresses too).
+            http_addr = ("unix://" + agent.http.unix_path
+                         if agent.http.unix_path
+                         else "%s:%s" % agent.http.addr)
             for wp in cfg.watches:
                 plan = watch_parse(dict(wp))
                 plan.run_in_thread(http_addr)
@@ -176,6 +186,67 @@ def cmd_agent(args) -> int:
         for plan in watch_plans:
             plan.stop()
         await agent.stop()
+
+    asyncio.run(serve())
+    return 0
+
+
+# -- gossipd -----------------------------------------------------------------
+
+
+def cmd_gossipd(args) -> int:
+    """Run the TPU gossip plane daemon (gossip/plane.py): the kernel
+    session that real agents with ``gossip_backend=tpu`` delegate their
+    LAN membership to."""
+    import asyncio
+    import os as _os
+
+    # Honor an explicit CPU request before jax's backend initializes
+    # (the interpreter-start hook would otherwise dial the TPU tunnel;
+    # same dance as bench.py / tests/conftest.py).
+    if _os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        _os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    # Persistent compile cache: a restarted plane must not pay the
+    # full kernel compile again (same discipline as bench.py).
+    try:
+        import jax
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__)))), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+    from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+
+    cfg = PlaneConfig(
+        bind_addr=args.bind, bind_port=args.port, unix_path=args.unix,
+        capacity=args.capacity, sim_nodes=args.sim_nodes,
+        gossip_interval_s=args.gossip_interval,
+        hb_lapse_s=args.hb_lapse, suspicion_mult=args.suspicion_mult,
+        slots=args.slots)
+
+    async def serve() -> None:
+        plane = GossipPlane(cfg)
+        await plane.start()
+        addr = cfg.unix_path or "%s:%s" % plane.local_addr
+        print(f"==> gossip plane running at {addr} "
+              f"(capacity={cfg.capacity}, sim_nodes={cfg.sim_nodes}, "
+              f"round={cfg.gossip_interval_s * 1000:.0f}ms)", flush=True)
+        loop = asyncio.get_event_loop()
+        stop = asyncio.Event()
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+        await stop.wait()
+        await plane.stop()
 
     asyncio.run(serve())
     return 0
@@ -515,7 +586,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-http-port", dest="http_port", type=int, default=None)
     p.add_argument("-dns-port", dest="dns_port", type=int, default=None)
     p.add_argument("-rpc-port", dest="rpc_port", type=int, default=None)
+    p.add_argument("-protocol", dest="protocol", type=int, default=None,
+                   help="protocol version to speak (vsn tag; "
+                        "consul/config.go:92-94)")
     p.set_defaults(fn=cmd_agent)
+
+    p = sub.add_parser("gossipd", help="Runs the TPU gossip plane daemon")
+    p.add_argument("-bind", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8310)
+    p.add_argument("-unix", default="", help="serve on a unix socket")
+    p.add_argument("-capacity", type=int, default=256,
+                   help="real-agent universe size")
+    p.add_argument("-sim-nodes", dest="sim_nodes", type=int, default=0,
+                   help="simulated nodes sharing the kernel arrays")
+    p.add_argument("-gossip-interval", dest="gossip_interval", type=float,
+                   default=0.2, help="kernel round length (seconds)")
+    p.add_argument("-hb-lapse", dest="hb_lapse", type=float, default=2.0,
+                   help="heartbeat lapse before a node fails probes")
+    p.add_argument("-suspicion-mult", dest="suspicion_mult", type=float,
+                   default=4.0)
+    p.add_argument("-slots", type=int, default=64)
+    p.set_defaults(fn=cmd_gossipd)
 
     p = sub.add_parser("configtest", help="Validates config files/dirs")
     p.add_argument("-config-file", action="append", dest="config_file")

@@ -50,6 +50,10 @@ class SerfConfig:
     reap_interval: float = 10.0
     reconnect_timeout: float = 72 * 3600.0
     tombstone_timeout: float = 24 * 3600.0
+    # protocol negotiation passthrough (consul -protocol flag)
+    protocol_version: int = 2
+    protocol_min: int = 1
+    protocol_max: int = 2
 
 
 class SerfPool:
@@ -77,7 +81,10 @@ class SerfPool:
                 push_pull_interval=config.push_pull_interval,
                 reap_interval=config.reap_interval,
                 reconnect_timeout=config.reconnect_timeout,
-                tombstone_timeout=config.tombstone_timeout),
+                tombstone_timeout=config.tombstone_timeout,
+                protocol_version=config.protocol_version,
+                protocol_min=config.protocol_min,
+                protocol_max=config.protocol_max),
             keyring=keyring,
             on_event=self._member_event,
             on_user_msg=self._user_msg,
@@ -186,9 +193,21 @@ class SerfPool:
 # -- Consul's serf tag scheme (consul/server.go:292-304, consul/util.go) ----
 
 
+def _vsn_tags(protocol: Optional[int]) -> Dict[str, str]:
+    """vsn/vsn_min/vsn_max per consul/server.go:294-296 /
+    consul/client.go:130-132."""
+    from consul_tpu.version import (PROTOCOL_VERSION, PROTOCOL_VERSION_MAX,
+                                    PROTOCOL_VERSION_MIN)
+    v = PROTOCOL_VERSION if protocol is None else protocol
+    return {"vsn": str(v), "vsn_min": str(PROTOCOL_VERSION_MIN),
+            "vsn_max": str(PROTOCOL_VERSION_MAX)}
+
+
 def server_tags(dc: str, rpc_port: int, bootstrap: bool = False,
-                expect: int = 0) -> Dict[str, str]:
-    t = {"role": "consul", "dc": dc, "port": str(rpc_port), "vsn": "2"}
+                expect: int = 0,
+                protocol: Optional[int] = None) -> Dict[str, str]:
+    t = {"role": "consul", "dc": dc, "port": str(rpc_port),
+         **_vsn_tags(protocol)}
     if bootstrap:
         t["bootstrap"] = "1"
     if expect:
@@ -196,8 +215,8 @@ def server_tags(dc: str, rpc_port: int, bootstrap: bool = False,
     return t
 
 
-def client_tags(dc: str) -> Dict[str, str]:
-    return {"role": "node", "dc": dc, "vsn": "2"}
+def client_tags(dc: str, protocol: Optional[int] = None) -> Dict[str, str]:
+    return {"role": "node", "dc": dc, **_vsn_tags(protocol)}
 
 
 def parse_server(node: Node) -> Optional[Dict[str, Any]]:
@@ -213,4 +232,5 @@ def parse_server(node: Node) -> Optional[Dict[str, Any]]:
     return {"name": node.name, "dc": t.get("dc", ""), "addr": node.addr,
             "port": port, "rpc_addr": f"{node.addr}:{port}",
             "bootstrap": t.get("bootstrap") == "1",
-            "expect": int(t.get("expect", "0") or 0)}
+            "expect": int(t.get("expect", "0") or 0),
+            "version": int(t.get("vsn", "2") or 2)}

@@ -78,6 +78,14 @@ class MemberConfig:
     reap_interval: float = 10.0
     reconnect_timeout: float = 72 * 3600.0
     tombstone_timeout: float = 24 * 3600.0
+    # Protocol negotiation (consul/config.go:31-37; memberlist's
+    # alive-message version check): this node speaks protocol_version
+    # and can interoperate with [protocol_min, protocol_max].  Peers
+    # advertise theirs in vsn/vsn_min/vsn_max tags; incompatible nodes
+    # are refused at admission.
+    protocol_version: int = 2
+    protocol_min: int = 1
+    protocol_max: int = 2
 
 
 @dataclass
@@ -573,6 +581,26 @@ class Memberlist:
 
     # -- SWIM state transitions (memberlist aliveNode/suspectNode/deadNode) -
 
+    def _version_ok(self, node: Node) -> bool:
+        """Protocol compatibility gate (memberlist's alive-message
+        version check; tags per consul/server.go:292-304).
+
+        Admit a peer iff its operating version lies in OUR supported
+        range and our operating version lies in ITS advertised range —
+        the symmetric condition that lets mixed-version clusters roll
+        through an upgrade.  A peer with no version tags (pre-versioning
+        build) defaults to operating version 2 with a point range."""
+        t = node.tags
+        try:
+            vsn = int(t.get("vsn", "2"))
+            vmin = int(t.get("vsn_min", str(vsn)))
+            vmax = int(t.get("vsn_max", str(vsn)))
+        except ValueError:
+            return False
+        c = self.config
+        return (c.protocol_min <= vsn <= c.protocol_max
+                and vmin <= c.protocol_version <= vmax)
+
     def _alive(self, w: Dict) -> None:
         name, inc = w["name"], w["inc"]
         node = self.nodes.get(name)
@@ -586,6 +614,8 @@ class Memberlist:
         if node is None:
             node = Node(name, w["addr"], w["port"], incarnation=inc,
                         tags=w.get("tags") or {})
+            if not self._version_ok(node):
+                return  # incompatible protocol version (rolling upgrade)
             if self.member_filter is not None and not self.member_filter(node):
                 return  # merge delegate refused (consul/merge.go)
             self.nodes[name] = node
@@ -599,11 +629,12 @@ class Memberlist:
         # Re-run the merge delegate on identity updates too — an admitted
         # member must not be able to mutate into a filtered-out identity
         # (e.g. a WAN member dropping its server role) and stay.
-        if self.member_filter is not None:
-            probe = Node(name, w["addr"], w["port"], incarnation=inc,
-                         tags=w.get("tags") or {})
-            if not self.member_filter(probe):
-                return
+        probe = Node(name, w["addr"], w["port"], incarnation=inc,
+                     tags=w.get("tags") or {})
+        if not self._version_ok(probe):
+            return
+        if self.member_filter is not None and not self.member_filter(probe):
+            return
         was = node.state
         tags_changed = (w.get("tags") or {}) != node.tags
         node.incarnation = inc

@@ -13,3 +13,22 @@ VERSION = "0.1.0"
 PROTOCOL_VERSION_MIN = 1
 PROTOCOL_VERSION_MAX = 2
 PROTOCOL_VERSION = PROTOCOL_VERSION_MAX
+
+# Consul-protocol -> gossip-wire-protocol map (the reference masks serf
+# protocol versions behind its own numbering, consul/config.go:26-37:
+# {1: 4, 2: 4, 3: 5}).  Both of our protocol versions speak gossip wire
+# version 1 — the map exists so a future wire change can ride a
+# protocol bump the same way.
+PROTOCOL_VERSION_MAP = {1: 1, 2: 1}
+
+
+def check_protocol_version(v: int) -> None:
+    """consul.Config.CheckVersion (consul/config.go:208-217)."""
+    if v < PROTOCOL_VERSION_MIN:
+        raise ValueError(
+            f"Protocol version '{v}' too low. Must be in range: "
+            f"[{PROTOCOL_VERSION_MIN}, {PROTOCOL_VERSION_MAX}]")
+    if v > PROTOCOL_VERSION_MAX:
+        raise ValueError(
+            f"Protocol version '{v}' too high. Must be in range: "
+            f"[{PROTOCOL_VERSION_MIN}, {PROTOCOL_VERSION_MAX}]")

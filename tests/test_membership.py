@@ -262,3 +262,57 @@ class TestMergeDelegates:
             await client.stop()
             await a.stop()
         loop.run_until_complete(body())
+
+
+class TestProtocolNegotiation:
+    """Protocol version negotiation (consul/config.go:31-37, tags at
+    consul/server.go:292-304): nodes advertise vsn/vsn_min/vsn_max and
+    incompatible versions refuse to merge."""
+
+    def test_incompatible_versions_refuse_to_merge(self, loop):
+        async def body():
+            # a speaks only version 2 ([2, 2]); x speaks only a future
+            # version 9 ([9, 9]) — neither side can pick a common
+            # protocol, so the join must not admit the peer.
+            a = SerfPool(_fast(
+                "a", {"role": "consul", "dc": "dc1", "port": "8300",
+                      "vsn": "2", "vsn_min": "2", "vsn_max": "2"},
+                protocol_version=2, protocol_min=2, protocol_max=2))
+            await a.start()
+            x = SerfPool(_fast(
+                "x", {"role": "consul", "dc": "dc1", "port": "8300",
+                      "vsn": "9", "vsn_min": "9", "vsn_max": "9"},
+                protocol_version=9, protocol_min=9, protocol_max=9))
+            await x.start()
+            await x.join([f"127.0.0.1:{a.local_addr[1]}"])
+            await asyncio.sleep(0.3)
+            assert "x" not in {n.name for n in a.members()}, \
+                "incompatible protocol version admitted"
+            assert "a" not in {n.name for n in x.members()}, \
+                "incompatible protocol version admitted (reverse)"
+            await x.stop()
+            await a.stop()
+        loop.run_until_complete(body())
+
+    def test_version_overlap_merges(self, loop):
+        async def body():
+            # a operates v1 of [1, 2]; b operates v2 of [1, 2]: each
+            # side's operating version lies in the other's supported
+            # range — a mid-rolling-upgrade cluster must stay merged.
+            a = SerfPool(_fast(
+                "a", {"role": "consul", "dc": "dc1", "port": "8300",
+                      "vsn": "1", "vsn_min": "1", "vsn_max": "2"},
+                protocol_version=1, protocol_min=1, protocol_max=2))
+            await a.start()
+            b = SerfPool(_fast(
+                "b", {"role": "consul", "dc": "dc1", "port": "8300",
+                      "vsn": "2", "vsn_min": "1", "vsn_max": "2"},
+                protocol_version=2, protocol_min=1, protocol_max=2))
+            await b.start()
+            await b.join([f"127.0.0.1:{a.local_addr[1]}"])
+            assert await _wait(
+                lambda: {"a", "b"} <= {n.name for n in a.members()}
+                and {"a", "b"} <= {n.name for n in b.members()})
+            await b.stop()
+            await a.stop()
+        loop.run_until_complete(body())
