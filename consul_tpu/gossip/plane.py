@@ -1,0 +1,462 @@
+"""The TPU gossip plane daemon: the kernel as a real membership backend.
+
+This is the graft SURVEY.md §7 and BASELINE.json describe — the point
+where the framework's two planes become one system.  A cluster of real
+agents configured with ``gossip_backend=tpu`` delegates its LAN
+membership substrate (the memberlist role, reference boundary
+``consul/server.go:284-325`` → serf → memberlist) to this daemon:
+
+- **Membership state lives in the kernel arrays.**  Every registered
+  agent is a node id in the SWIM kernel's universe
+  (:mod:`consul_tpu.gossip.kernel`): its probe outcomes, suspicion
+  episode, Lifeguard timeout decay, dissemination, refutation, and the
+  final dead verdict all execute on-device in the jit round step —
+  optionally alongside millions of simulated nodes in the same arrays
+  (``sim_nodes``; the hybrid BASELINE config-#5 posture).
+- **The physical liveness signal is the bridge heartbeat.**  In stock
+  memberlist the raw signal is "probe packet unanswered"; here it is
+  "agent's heartbeat lapsed on the bridge socket" (the agent side runs
+  a native C++ heartbeat thread — ``native/gbridge.cpp`` — so a busy
+  Python event loop cannot starve its own liveness).  A lapsed agent
+  starts failing kernel probes; everything above that signal — the
+  suspicion state machine, confirmation-driven timeout decay, verdict
+  dissemination, refutation on resumed heartbeats — is kernel dynamics,
+  not host code.
+- **Events flow out the serf boundary.**  Membership transitions
+  (join/failed/leave) stream to every connected agent, which raises
+  them through the same ``on_event`` channel the asyncio backend uses
+  (→ server routing tables, leader reconcile → serfHealth, exactly as
+  ``consul/serf.go:90-110`` feeds ``consul/leader.go``).
+
+Wire protocol (shared with the C++ bridge): 4-byte big-endian length +
+msgpack map.  Client→plane: register / hb / leave / force-leave /
+event / members.  Plane→client: welcome snapshot, pushed membership
+events, pushed user events.
+
+One plane serves one LAN pool (one DC).  The WAN pool — tiny,
+servers-only — stays on the asyncio backend; cross-DC remains the
+reference's two-pool topology.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+import msgpack
+import numpy as np
+
+EV_JOIN = "member-join"
+EV_LEAVE = "member-leave"
+EV_FAILED = "member-failed"
+EV_UPDATE = "member-update"
+EV_USER = "user"
+
+# Fixed rounds per kernel dispatch: one compiled variant, wall-clock
+# catch-up runs several dispatches.
+STEPS_PER_TICK = 4
+
+
+@dataclass
+class PlaneConfig:
+    bind_addr: str = "127.0.0.1"
+    bind_port: int = 8310          # the plane's rendezvous port
+    unix_path: str = ""            # serve on a unix socket instead
+    capacity: int = 256            # real-agent universe size (node ids)
+    sim_nodes: int = 0             # extra simulated nodes sharing the arrays
+    gossip_interval_s: float = 0.2  # kernel round length in wall time
+    probe_every: int = 5
+    suspicion_mult: float = 4.0
+    # heartbeat lapse after which an agent starts failing kernel probes
+    # (the "probe packet unanswered" signal); the DEFAULT heartbeat
+    # period the plane hands to clients is lapse/3.
+    hb_lapse_s: float = 2.0
+    slots: int = 64
+
+
+@dataclass
+class PlaneNode:
+    """Host-side metadata for one registered node id."""
+
+    id: int
+    name: str
+    addr: str = ""
+    port: int = 0
+    tags: Dict[str, str] = field(default_factory=dict)
+    last_hb: float = 0.0
+    writer: Optional[asyncio.StreamWriter] = None
+    # lifecycle the AGENTS should believe (derived from kernel verdicts)
+    status: str = "alive"          # alive | failed | left
+
+
+class GossipPlane:
+    """The daemon: kernel session + bridge server + event fanout."""
+
+    def __init__(self, config: Optional[PlaneConfig] = None) -> None:
+        self.config = config or PlaneConfig()
+        self._nodes_by_name: Dict[str, PlaneNode] = {}
+        self._nodes_by_id: Dict[int, PlaneNode] = {}
+        self._free_ids: List[int] = []
+        self._declared_dead: Set[int] = set()
+        self._event_ltime = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._tick_task: Optional[asyncio.Task] = None
+        self._started = False
+        # kernel session state, created in start() (jax import deferred)
+        self._p = None
+        self._state = None
+        self._key = None
+        self._fail: Optional[np.ndarray] = None
+        self._rounds_done = 0
+        self._t0 = 0.0
+
+    # -- universe ----------------------------------------------------------
+
+    @property
+    def n_universe(self) -> int:
+        return self.config.capacity + self.config.sim_nodes
+
+    def _alloc_id(self) -> Optional[int]:
+        if self._free_ids:
+            return self._free_ids.pop()
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        import jax
+
+        from consul_tpu.gossip.kernel import NEVER, init_state
+        from consul_tpu.gossip.params import SwimParams
+
+        c = self.config
+        n = self.n_universe
+        self._p = SwimParams(
+            n=n, slots=c.slots, probe_every=c.probe_every,
+            suspicion_mult=c.suspicion_mult,
+            gossip_interval_s=c.gossip_interval_s)
+        self._state = init_state(self._p)
+        # Only registered agents (and live sim nodes) are members; start
+        # with an empty membership and admit on register.
+        self._state = self._state._replace(
+            member=self._state.member.at[:].set(False))
+        if c.sim_nodes:
+            # Simulated nodes occupy ids [capacity, capacity+sim); they
+            # are members that never fail (load/dissemination substrate).
+            self._state = self._state._replace(
+                member=self._state.member.at[c.capacity:].set(True))
+        self._key = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "big"))
+        self._fail = np.full((n,), int(NEVER), np.int32)
+        self._free_ids = list(range(c.capacity - 1, -1, -1))
+        # Pre-compile the dispatch shape before serving: the first jit
+        # compile takes seconds-to-minutes and must not stall the event
+        # loop (a stalled plane cannot ingest heartbeats, which would
+        # read as every agent lapsing at once).
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.kernel import run_rounds
+        jax.block_until_ready(run_rounds(
+            self._state, self._key, jnp.asarray(self._fail), self._p,
+            steps=STEPS_PER_TICK, trace=True)[0])
+        self._rounds_done = 0
+        self._t0 = time.monotonic()
+
+        if c.unix_path:
+            try:
+                os.unlink(c.unix_path)
+            except FileNotFoundError:
+                pass
+            self._server = await asyncio.start_unix_server(
+                self._serve, c.unix_path)
+        else:
+            self._server = await asyncio.start_server(
+                self._serve, c.bind_addr, c.bind_port)
+        self._tick_task = asyncio.get_event_loop().create_task(self._ticker())
+        self._started = True
+
+    @property
+    def local_addr(self) -> tuple:
+        socks = self._server.sockets if self._server else []
+        return socks[0].getsockname()[:2] if socks else ("", 0)
+
+    async def stop(self) -> None:
+        self._started = False
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for node in list(self._nodes_by_id.values()):
+            if node.writer is not None:
+                try:
+                    node.writer.close()
+                except Exception:
+                    pass
+
+    # -- kernel session ----------------------------------------------------
+
+    def _due_rounds(self) -> int:
+        elapsed = time.monotonic() - self._t0
+        return int(elapsed / self.config.gossip_interval_s) - self._rounds_done
+
+    async def _ticker(self) -> None:
+        """Map wall time onto kernel rounds: every gossip interval one
+        round is due; catch-up runs whole STEPS_PER_TICK dispatches.
+
+        Catch-up is BOUNDED: if the backend cannot sustain the
+        configured round rate (slow CPU kernel, transient recompile),
+        an unbounded drain would monopolize the event loop, starve the
+        heartbeat readers, and mass-declare the cluster dead.  After
+        the burst limit the round clock is re-based — the protocol runs
+        slower than configured, which SWIM tolerates; a frozen plane it
+        does not."""
+        interval = self.config.gossip_interval_s
+        max_burst = 4  # dispatches per wake before yielding/re-basing
+        while True:
+            await asyncio.sleep(interval * STEPS_PER_TICK / 2)
+            try:
+                self._mark_lapsed()
+                burst = 0
+                while self._due_rounds() >= STEPS_PER_TICK:
+                    self._dispatch()
+                    burst += 1
+                    if burst >= max_burst:
+                        if self._due_rounds() >= STEPS_PER_TICK:
+                            # Hopelessly behind: drop the backlog.
+                            self._t0 = (time.monotonic()
+                                        - self._rounds_done * interval)
+                        break
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # keep the plane alive; surface once
+                import sys
+                print(f"[gossip-plane] tick error: {e!r}", file=sys.stderr)
+                await asyncio.sleep(interval * 4)
+
+    def _mark_lapsed(self) -> None:
+        """Heartbeat lapse -> the node starts failing kernel probes (the
+        physical probe-loss signal); resumed heartbeat -> it answers
+        again (the kernel's refutation path takes it from there)."""
+        now = time.monotonic()
+        rnd = self._rounds_done
+        from consul_tpu.gossip.kernel import NEVER
+        for node in self._nodes_by_id.values():
+            if node.status == "left":
+                continue
+            lapsed = (now - node.last_hb) > self.config.hb_lapse_s
+            failing = self._fail[node.id] != int(NEVER)
+            if lapsed and not failing:
+                self._fail[node.id] = rnd
+            elif not lapsed and failing and node.status == "alive":
+                # back before any verdict: stop failing probes; an
+                # active suspicion episode resolves by on-device
+                # refutation (incarnation bump)
+                self._fail[node.id] = int(NEVER)
+
+    def _dispatch(self) -> None:
+        """Advance the kernel by STEPS_PER_TICK rounds and fan out the
+        membership transitions the verdicts imply."""
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.kernel import PHASE_DEAD, run_rounds
+
+        state, trace = run_rounds(
+            self._state, self._key, jnp.asarray(self._fail), self._p,
+            steps=STEPS_PER_TICK, trace=True)
+        self._state = state
+        self._rounds_done += STEPS_PER_TICK
+
+        # Dead verdicts declared during this dispatch (trace carries the
+        # per-round slot registers: subject + phase).
+        slot_node = np.asarray(trace.slot_node)    # [T, S]
+        slot_phase = np.asarray(trace.slot_phase)  # [T, S]
+        dead_mask = (slot_phase == PHASE_DEAD) & (slot_node >= 0)
+        for sid in np.unique(slot_node[dead_mask]):
+            node = self._nodes_by_id.get(int(sid))
+            if node is None or node.id in self._declared_dead:
+                continue
+            if node.status != "alive":
+                continue
+            self._declared_dead.add(node.id)
+            node.status = "failed"
+            self._broadcast_member_event(EV_FAILED, node)
+
+    # -- registration / membership ops ------------------------------------
+
+    def _admit(self, node: PlaneNode) -> None:
+        from consul_tpu.gossip.kernel import NEVER
+        i = node.id
+        self._fail[i] = int(NEVER)
+        st = self._state
+        # Host-side control-plane surgery between dispatches: (re)admit
+        # the id and clear any stale episode registers for it.
+        member = st.member.at[i].set(True)
+        slot = int(st.slot_of_node[i])
+        if slot >= 0:
+            st = st._replace(
+                heard=st.heard.at[slot, :].set(0),
+                slot_node=st.slot_node.at[slot].set(-1),
+                slot_phase=st.slot_phase.at[slot].set(0),
+                slot_dead_round=st.slot_dead_round.at[slot].set(-1),
+                slot_of_node=st.slot_of_node.at[i].set(-1),
+            )
+        self._state = st._replace(member=member)
+        self._declared_dead.discard(i)
+        node.status = "alive"
+        node.last_hb = time.monotonic()
+
+    def _evict(self, node: PlaneNode, status: str) -> None:
+        i = node.id
+        st = self._state
+        st = st._replace(member=st.member.at[i].set(False))
+        slot = int(st.slot_of_node[i])
+        if slot >= 0:
+            st = st._replace(
+                heard=st.heard.at[slot, :].set(0),
+                slot_node=st.slot_node.at[slot].set(-1),
+                slot_phase=st.slot_phase.at[slot].set(0),
+                slot_dead_round=st.slot_dead_round.at[slot].set(-1),
+                slot_of_node=st.slot_of_node.at[i].set(-1),
+            )
+        self._state = st
+        node.status = status
+
+    def members_wire(self) -> List[Dict[str, Any]]:
+        out = []
+        for node in self._nodes_by_name.values():
+            out.append({"name": node.name, "addr": node.addr,
+                        "port": node.port, "tags": node.tags,
+                        "state": ("alive" if node.status == "alive" else
+                                  "dead" if node.status == "failed" else
+                                  "left")})
+        return out
+
+    # -- bridge server -----------------------------------------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        me: Optional[PlaneNode] = None
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = struct.unpack(">I", hdr)
+                if ln > 1 << 20:
+                    break
+                m = msgpack.unpackb(await reader.readexactly(ln), raw=False)
+                t = m.get("t")
+                if t == "register":
+                    me = self._register(m, writer)
+                    if me is None:
+                        self._send(writer, {"t": "err",
+                                            "error": "plane full or name taken"})
+                        break
+                elif me is None:
+                    continue
+                elif t == "hb":
+                    me.last_hb = time.monotonic()
+                    if me.status == "failed":
+                        # heartbeats resumed after a dead verdict: the
+                        # node rejoins at a fresh incarnation (serf
+                        # failed->rejoin choreography)
+                        self._admit(me)
+                        self._broadcast_member_event(EV_JOIN, me)
+                elif t == "leave":
+                    self._evict(me, "left")
+                    self._broadcast_member_event(EV_LEAVE, me)
+                elif t == "force-leave":
+                    tgt = self._nodes_by_name.get(m.get("node", ""))
+                    if tgt is not None and tgt.status == "failed":
+                        self._evict(tgt, "left")
+                        self._broadcast_member_event(EV_LEAVE, tgt)
+                elif t == "tags":
+                    me.tags = dict(m.get("tags") or {})
+                    self._broadcast_member_event(EV_UPDATE, me)
+                elif t == "event":
+                    self._event_ltime += 1
+                    self._broadcast({"t": "user",
+                                     "name": m.get("name", ""),
+                                     "payload": m.get("payload", b""),
+                                     "ltime": self._event_ltime,
+                                     "from": me.name,
+                                     "coalesce": m.get("coalesce", True)})
+                elif t == "members":
+                    self._send(writer, {"t": "members",
+                                        "members": self.members_wire()})
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            # Socket loss is NOT a leave: the kernel's failure detector
+            # owns that verdict (heartbeats just stop arriving).
+            if me is not None and me.writer is writer:
+                me.writer = None
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _register(self, m: Dict[str, Any],
+                  writer: asyncio.StreamWriter) -> Optional[PlaneNode]:
+        name = m.get("name", "")
+        node = self._nodes_by_name.get(name)
+        if node is not None and node.status == "alive" \
+                and node.writer is not None and node.writer is not writer \
+                and (time.monotonic() - node.last_hb) <= self.config.hb_lapse_s:
+            # Name conflict with a LIVE registration: refuse, as
+            # memberlist's name-conflict delegate does.  A dead/lapsed
+            # holder is a restart and may re-register.
+            return None
+        if node is None:
+            nid = self._alloc_id()
+            if nid is None:
+                return None
+            node = PlaneNode(id=nid, name=name)
+            self._nodes_by_name[name] = node
+            self._nodes_by_id[nid] = node
+        node.addr = m.get("addr", "")
+        node.port = int(m.get("port", 0) or 0)
+        node.tags = dict(m.get("tags") or {})
+        node.writer = writer
+        self._admit(node)
+        self._send(writer, {
+            "t": "welcome", "id": node.id, "round": self._rounds_done,
+            "hb_interval_s": self.config.hb_lapse_s / 3.0,
+            "members": self.members_wire()})
+        self._broadcast_member_event(EV_JOIN, node)
+        return node
+
+    def _member_wire(self, node: PlaneNode) -> Dict[str, Any]:
+        return {"name": node.name, "addr": node.addr, "port": node.port,
+                "tags": node.tags,
+                "state": ("alive" if node.status == "alive" else
+                          "dead" if node.status == "failed" else "left")}
+
+    def _broadcast_member_event(self, kind: str, node: PlaneNode) -> None:
+        self._broadcast({"t": "ev", "kind": kind,
+                         "node": self._member_wire(node)})
+
+    def _broadcast(self, payload: Dict[str, Any]) -> None:
+        for node in self._nodes_by_id.values():
+            if node.writer is not None:
+                self._send(node.writer, payload)
+
+    @staticmethod
+    def _send(writer: asyncio.StreamWriter, payload: Dict[str, Any]) -> None:
+        try:
+            raw = msgpack.packb(payload, use_bin_type=True)
+            writer.write(struct.pack(">I", len(raw)) + raw)
+        except Exception:
+            pass
+
+
+async def run_plane(config: PlaneConfig) -> GossipPlane:
+    plane = GossipPlane(config)
+    await plane.start()
+    return plane

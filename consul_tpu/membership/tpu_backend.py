@@ -1,0 +1,360 @@
+"""gossip_backend=tpu: the serf-boundary pool backed by the TPU plane.
+
+Drop-in counterpart of :class:`consul_tpu.membership.serf.SerfPool`
+(same constructor shape, same event channel, same method surface), but
+the membership substrate underneath is the SWIM kernel session hosted
+by the gossip plane daemon (:mod:`consul_tpu.gossip.plane`) instead of
+a per-agent asyncio memberlist.  The reference boundary this preserves
+is ``consul/server.go:284-325`` (setupSerf config surface) +
+``consul/serf.go:90-110`` (events upward into reconcile): the agent
+code above cannot tell which backend it is on — ``consul members``,
+server routing tables, serfHealth reconciliation, and user events all
+flow the same way.
+
+Transport: the native C++ bridge (``native/gbridge.cpp`` via
+:mod:`consul_tpu.native.bridge`) — reader + heartbeat threads outside
+the GIL.  A pure-asyncio fallback transport keeps the backend usable
+where a C++ toolchain is unavailable.
+
+What "join" means here: the plane is the pool's rendezvous — joining
+an address means registering with that plane.  Stopping heartbeats
+(process death) is the failure signal; the kernel's suspicion/
+Lifeguard/refutation dynamics decide when the cluster believes it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+from consul_tpu.membership.serf import SerfConfig
+from consul_tpu.membership.swim import (EV_FAILED, EV_JOIN, EV_LEAVE,
+                                        EV_UPDATE, Node, STATE_ALIVE,
+                                        STATE_DEAD, STATE_LEFT)
+
+EV_USER = "user"
+
+
+class _AsyncioTransport:
+    """Fallback bridge transport: same wire protocol, Python threads
+    replaced by asyncio tasks (no native heartbeat guarantee)."""
+
+    def __init__(self, host: str, port: int, unix_path: str = "") -> None:
+        self._host, self._port, self._unix = host, port, unix_path
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._pump_task: Optional[asyncio.Task] = None
+
+    async def connect(self) -> None:
+        if self._unix:
+            self._reader, self._writer = await asyncio.open_unix_connection(
+                self._unix)
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self._host, self._port)
+        self._pump_task = asyncio.get_event_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                hdr = await self._reader.readexactly(4)
+                (ln,) = struct.unpack(">I", hdr)
+                raw = await self._reader.readexactly(ln)
+                self._inbox.put_nowait(msgpack.unpackb(raw, raw=False))
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            self._inbox.put_nowait(None)  # closed sentinel
+
+    def send(self, payload: Dict[str, Any]) -> None:
+        raw = msgpack.packb(payload, use_bin_type=True)
+        self._writer.write(struct.pack(">I", len(raw)) + raw)
+
+    def set_heartbeat(self, payload: Dict[str, Any], period_s: float) -> None:
+        async def beat():
+            while True:
+                try:
+                    self.send(payload)
+                except Exception:
+                    return
+                await asyncio.sleep(period_s)
+        self.stop_heartbeat()
+        self._hb_task = asyncio.get_event_loop().create_task(beat())
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
+
+    def poll_nowait(self) -> Optional[Dict[str, Any]]:
+        try:
+            m = self._inbox.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if m is None:
+            raise ConnectionError("gossip plane connection closed")
+        return m
+
+    def close(self) -> None:
+        self.stop_heartbeat()
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class TpuSerfPool:
+    """SerfPool-shaped backend over the TPU gossip plane."""
+
+    def __init__(self, config: SerfConfig, keyring: Optional[Any] = None,
+                 on_event: Optional[Callable[[str, Any], None]] = None,
+                 member_filter: Optional[Callable[[Node], bool]] = None,
+                 plane_addr: str = "", use_native: bool = True) -> None:
+        # keyring: gossip encryption is plane-side policy (the bridge is
+        # a point-to-point agent<->plane link, not a gossip fabric);
+        # accepted for interface parity.
+        self.config = config
+        self.on_event = on_event or (lambda kind, payload: None)
+        self.member_filter = member_filter
+        self.plane_addr = plane_addr
+        self.use_native = use_native
+        self.event_ltime = 0
+        self._nodes: Dict[str, Node] = {}
+        self._bridge = None          # BridgeClient | _AsyncioTransport
+        self._native = False
+        self._poll_task: Optional[asyncio.Task] = None
+        self._registered = asyncio.Event()
+        self._register_error = ""
+        self._hb_interval = 0.5
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, retry_interval: float = 1.0) -> None:
+        if not self.plane_addr:
+            return
+        try:
+            await self._connect(self.plane_addr)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            # Plane not up yet: keep dialing in the background (the
+            # retry-join role for the rendezvous model).
+            async def redial():
+                while self._bridge is None:
+                    await asyncio.sleep(retry_interval)
+                    try:
+                        await self._connect(self.plane_addr)
+                    except (ConnectionError, OSError, asyncio.TimeoutError):
+                        continue
+            self._redial_task = asyncio.get_event_loop().create_task(redial())
+
+    async def stop(self) -> None:
+        t = getattr(self, "_redial_task", None)
+        if t is not None:
+            t.cancel()
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            try:
+                await self._poll_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._bridge is not None:
+            self._bridge.close()
+            self._bridge = None
+
+    @staticmethod
+    def _parse_addr(addr: str) -> Tuple[str, int, str]:
+        if addr.startswith("unix://"):
+            return "", 0, addr[len("unix://"):]
+        host, _, port = addr.rpartition(":")
+        return host or "127.0.0.1", int(port), ""
+
+    async def _connect(self, addr: str) -> None:
+        """Dial + register.  ``self._bridge`` is only left set on a
+        CONFIRMED registration — a refused register (plane full, name
+        conflict) or handshake timeout tears the transport down so the
+        redial loop / a later join() can try again."""
+        host, port, unix = self._parse_addr(addr)
+        bridge = None
+        native = False
+        if self.use_native:
+            try:
+                from consul_tpu.native.bridge import BridgeClient
+                bridge = BridgeClient(host, port, unix)
+                native = True
+            except (RuntimeError, ConnectionError):
+                bridge = None
+        if bridge is None:
+            bridge = _AsyncioTransport(host, port, unix)
+            await bridge.connect()
+        self._registered.clear()
+        self._register_error = ""
+        self._bridge, self._native = bridge, native
+        try:
+            bridge.send({
+                "t": "register", "name": self.config.node_name,
+                "addr": self.config.advertise_addr or self.config.bind_addr,
+                "port": self.config.bind_port,
+                "tags": dict(self.config.tags)})
+            self._poll_task = asyncio.get_event_loop().create_task(
+                self._poller())
+            await asyncio.wait_for(self._registered.wait(), timeout=10.0)
+        except (asyncio.TimeoutError, ConnectionError) as e:
+            if self._poll_task is not None:
+                self._poll_task.cancel()
+                self._poll_task = None
+            bridge.close()
+            self._bridge = None
+            reason = self._register_error or str(e) or "handshake timeout"
+            raise ConnectionError(
+                f"gossip plane registration failed: {reason}") from None
+
+    async def _poller(self) -> None:
+        """Drain plane frames into the event channel.  Native transport
+        is polled (frames queue in C++); asyncio transport pushes."""
+        try:
+            while True:
+                m = (self._bridge.poll() if self._native
+                     else self._bridge.poll_nowait())
+                if m is None:
+                    await asyncio.sleep(0.01)
+                    continue
+                self._handle(m)
+        except asyncio.CancelledError:
+            raise
+        except ConnectionError:
+            pass  # plane gone; the agent's retry-join loop re-dials
+
+    def _handle(self, m: Dict[str, Any]) -> None:
+        t = m.get("t")
+        if t == "err":
+            # Registration refused (plane full / live name conflict):
+            # surface to _connect and tear the session down.
+            self._register_error = m.get("error", "refused")
+            raise ConnectionError(self._register_error)
+        if t == "welcome":
+            self._hb_interval = float(m.get("hb_interval_s", 0.5))
+            self._bridge.set_heartbeat(
+                {"t": "hb", "name": self.config.node_name},
+                self._hb_interval)
+            for w in m.get("members", []):
+                node = self._node_from_wire(w)
+                # The merge delegate gates the snapshot too — admission
+                # must not depend on connect ordering.
+                if self.member_filter is not None and \
+                        not self.member_filter(node):
+                    continue
+                known = node.name in self._nodes
+                self._nodes[node.name] = node
+                if not known and node.state == STATE_ALIVE:
+                    self.on_event(EV_JOIN, node)
+            self._registered.set()
+        elif t == "ev":
+            kind = m.get("kind")
+            node = self._node_from_wire(m.get("node") or {})
+            if self.member_filter is not None and \
+                    not self.member_filter(node):
+                return  # merge delegate (consul/merge.go) still applies
+            if kind == EV_LEAVE:
+                node.state = STATE_LEFT
+                self._nodes.pop(node.name, None)
+            elif kind == EV_FAILED:
+                node.state = STATE_DEAD
+                if node.name in self._nodes:
+                    self._nodes[node.name].state = STATE_DEAD
+            else:
+                self._nodes[node.name] = node
+            self.on_event(kind, node)
+        elif t == "user":
+            ltime = int(m.get("ltime", 0))
+            self.event_ltime = max(self.event_ltime, ltime)
+            self.on_event(EV_USER, {
+                "t": "uev", "ltime": ltime, "name": m.get("name", ""),
+                "payload": m.get("payload", b""),
+                "cc": m.get("coalesce", True)})
+
+    @staticmethod
+    def _node_from_wire(w: Dict[str, Any]) -> Node:
+        state = w.get("state", "alive")
+        return Node(name=w.get("name", ""), addr=w.get("addr", ""),
+                    port=int(w.get("port", 0) or 0),
+                    state=(STATE_ALIVE if state == "alive" else
+                           STATE_DEAD if state == "dead" else STATE_LEFT),
+                    tags=dict(w.get("tags") or {}))
+
+    # -- SerfPool surface --------------------------------------------------
+
+    async def join(self, addrs: List[str]) -> int:
+        """Register with the plane (the pool's rendezvous)."""
+        if self._bridge is None:
+            for a in addrs:
+                try:
+                    await self._connect(a)
+                    break
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    continue
+            else:
+                return 0
+        return max(1, len(self.alive_members()) - 1)
+
+    async def leave(self) -> None:
+        if self._bridge is not None:
+            try:
+                self._bridge.stop_heartbeat()
+                self._bridge.send({"t": "leave",
+                                   "name": self.config.node_name})
+                await asyncio.sleep(0.05)  # let the frame flush
+            except Exception:
+                pass
+
+    def force_leave(self, name: str) -> bool:
+        if self._bridge is None:
+            return False
+        try:
+            self._bridge.send({"t": "force-leave", "node": name})
+            return True
+        except Exception:
+            return False
+
+    @property
+    def local_addr(self) -> Tuple[str, int]:
+        # The pool's rendezvous is the plane, not a local socket.
+        host, port, unix = self._parse_addr(self.plane_addr) \
+            if self.plane_addr else ("", 0, "")
+        return (host or unix, port)
+
+    def members(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def alive_members(self) -> List[Node]:
+        return [n for n in self._nodes.values() if n.state == STATE_ALIVE]
+
+    def set_tags(self, tags: Dict[str, str]) -> None:
+        self.config.tags = dict(tags)
+        if self._bridge is not None:
+            try:
+                self._bridge.send({"t": "tags", "tags": dict(tags)})
+            except Exception:
+                pass
+
+    def user_event(self, name: str, payload: bytes,
+                   coalesce: bool = True) -> None:
+        if self._bridge is None:
+            return
+        try:
+            self._bridge.send({"t": "event", "name": name,
+                               "payload": payload, "coalesce": coalesce})
+        except Exception:
+            pass
+
+    # interface parity with SerfPool
+    @staticmethod
+    def previous_peers(path: str) -> List[str]:
+        from consul_tpu.membership.serf import SerfPool
+        return SerfPool.previous_peers(path)

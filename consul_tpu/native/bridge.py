@@ -1,0 +1,145 @@
+"""ctypes binding for the C++ gossip-bridge client (native/gbridge.cpp).
+
+The native library owns the agent↔plane transport and the heartbeat
+clock (a dedicated thread — the agent's liveness signal must survive a
+busy Python event loop / held GIL).  The host side does msgpack
+encode/decode and polls received frames from the native queue.
+
+Build: ``g++ -O2 -shared -fPIC -pthread`` on first use, cached next to
+this file, same discipline as :mod:`consul_tpu.native.store`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Dict, Optional
+
+import msgpack
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC = os.path.join(_REPO, "native", "gbridge.cpp")
+_LIB = os.path.join(_HERE, "libgbridge.so")
+_BUILD_LOCK = threading.Lock()
+
+_lib = None
+_build_error: Optional[str] = None
+
+
+def build_native(force: bool = False) -> Optional[str]:
+    global _build_error
+    with _BUILD_LOCK:
+        if not force and os.path.exists(_LIB) and (
+                not os.path.exists(_SRC)
+                or os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        if not os.path.exists(_SRC):
+            _build_error = f"source missing: {_SRC}"
+            return None
+        tmp = _LIB + f".tmp.{os.getpid()}"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               "-o", tmp, _SRC]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            _build_error = f"g++ invocation failed: {e}"
+            return None
+        if proc.returncode != 0:
+            _build_error = proc.stderr[-2000:]
+            return None
+        os.replace(tmp, _LIB)
+        return _LIB
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build_native()
+    if path is None:
+        raise RuntimeError(f"gbridge build failed: {_build_error}")
+    lib = ctypes.CDLL(path)
+    lib.gb_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]
+    lib.gb_connect.restype = ctypes.c_int64
+    lib.gb_send.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
+    lib.gb_send.restype = ctypes.c_int
+    lib.gb_set_heartbeat.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                     ctypes.c_int, ctypes.c_int]
+    lib.gb_set_heartbeat.restype = ctypes.c_int
+    lib.gb_poll.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
+    lib.gb_poll.restype = ctypes.c_int
+    lib.gb_connected.argtypes = [ctypes.c_int64]
+    lib.gb_connected.restype = ctypes.c_int
+    lib.gb_close.argtypes = [ctypes.c_int64]
+    lib.gb_close.restype = None
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+class BridgeClient:
+    """One connection to the gossip plane over the native transport."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 unix_path: str = "") -> None:
+        lib = _load()
+        h = lib.gb_connect(host.encode(), port,
+                           unix_path.encode() if unix_path else b"")
+        if h <= 0:
+            raise ConnectionError(
+                f"gossip plane unreachable at "
+                f"{unix_path or f'{host}:{port}'} (errno {-h})")
+        self._lib = lib
+        self._h = h
+        self._buf = ctypes.create_string_buffer(1 << 16)
+
+    def send(self, payload: Dict[str, Any]) -> None:
+        raw = msgpack.packb(payload, use_bin_type=True)
+        if self._lib.gb_send(self._h, raw, len(raw)) != 0:
+            raise ConnectionError("gossip plane connection lost")
+
+    def set_heartbeat(self, payload: Dict[str, Any], period_s: float) -> None:
+        """Arm the native heartbeat thread with a preframed message."""
+        raw = msgpack.packb(payload, use_bin_type=True)
+        self._lib.gb_set_heartbeat(self._h, raw, len(raw),
+                                   max(1, int(period_s * 1000)))
+
+    def stop_heartbeat(self) -> None:
+        self._lib.gb_set_heartbeat(self._h, b"", 0, 0)
+
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """One received frame, or None.  Raises on closed connection."""
+        n = self._lib.gb_poll(self._h, self._buf, len(self._buf))
+        if n == 0:
+            return None
+        if n == -1:
+            raise ConnectionError("gossip plane connection closed")
+        if n == -2:  # frame larger than buffer: grow and retry
+            self._buf = ctypes.create_string_buffer(len(self._buf) * 4)
+            return self.poll()
+        return msgpack.unpackb(self._buf.raw[:n], raw=False)
+
+    def connected(self) -> bool:
+        return bool(self._lib.gb_connected(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.gb_close(self._h)
+            self._h = 0
+
+    def __enter__(self) -> "BridgeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

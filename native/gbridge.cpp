@@ -1,0 +1,252 @@
+// gbridge: agent-side bridge client to the TPU gossip plane.
+//
+// The second native artifact SURVEY.md §2.1 calls for (the cgo→bridge
+// role between the real agent and the TPU sidecar; the reference's
+// only native component is LMDB behind cgo).  The agent's LIVENESS
+// signal must not depend on the Python event loop: a GIL-held FSM
+// apply or a long jit compile would otherwise read as a lapsed
+// heartbeat and get the agent declared dead by the kernel.  So the
+// transport runs here: a writer-locked socket, a reader thread that
+// reassembles length-prefixed frames into a queue the host polls, and
+// a heartbeat thread that keeps sending the preframed heartbeat buffer
+// on schedule no matter what Python is doing.
+//
+// Wire format (shared with consul_tpu/gossip/plane.py): 4-byte
+// big-endian length + msgpack payload.  This library moves bytes and
+// owns timing; msgpack encode/decode stays on the host.
+//
+// Plain C ABI for ctypes (no pybind11 in the image):
+//   gb_connect(host, port, unix_path)        -> handle (>0) | -errno
+//   gb_send(h, buf, len)                     -> 0 | -1
+//   gb_set_heartbeat(h, buf, len, period_ms) -> 0   (len 0 stops)
+//   gb_poll(h, buf, cap)                     -> nbytes | 0 none | -1 closed
+//   gb_connected(h)                          -> 1 | 0
+//   gb_close(h)
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <netdb.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Conn {
+    int fd = -1;
+    std::mutex wmu;                      // serializes writes (hb vs host)
+    std::thread reader;
+    std::thread hb;
+    std::mutex qmu;
+    std::deque<std::vector<uint8_t>> q;  // parsed incoming frames
+    std::mutex hbmu;
+    std::vector<uint8_t> hb_frame;       // preframed heartbeat bytes
+    int hb_period_ms = 0;
+    bool closing = false;
+    bool dead = false;                   // reader saw EOF/error
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Conn*> g_conns;
+int64_t g_next = 1;
+
+Conn* get(int64_t h) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_conns.find(h);
+    return it == g_conns.end() ? nullptr : it->second;
+}
+
+bool write_all(Conn* c, const uint8_t* buf, size_t len) {
+    std::lock_guard<std::mutex> lk(c->wmu);
+    size_t off = 0;
+    while (off < len) {
+        ssize_t n = ::send(c->fd, buf + off, len - off, MSG_NOSIGNAL);
+        if (n <= 0) {
+            if (n < 0 && (errno == EINTR)) continue;
+            c->dead = true;
+            return false;
+        }
+        off += static_cast<size_t>(n);
+    }
+    return true;
+}
+
+bool read_exact(int fd, uint8_t* buf, size_t len) {
+    size_t off = 0;
+    while (off < len) {
+        ssize_t n = ::recv(fd, buf + off, len - off, 0);
+        if (n <= 0) {
+            if (n < 0 && errno == EINTR) continue;
+            return false;
+        }
+        off += static_cast<size_t>(n);
+    }
+    return true;
+}
+
+void reader_loop(Conn* c) {
+    for (;;) {
+        uint8_t hdr[4];
+        if (!read_exact(c->fd, hdr, 4)) break;
+        uint32_t ln = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
+                      (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
+        if (ln > (1u << 20)) break;  // oversized frame: protocol error
+        std::vector<uint8_t> frame(ln);
+        if (ln && !read_exact(c->fd, frame.data(), ln)) break;
+        {
+            std::lock_guard<std::mutex> lk(c->qmu);
+            c->q.push_back(std::move(frame));
+            // Bound memory if the host stops polling (drop-oldest: the
+            // newest membership snapshot supersedes older events).
+            while (c->q.size() > 4096) c->q.pop_front();
+        }
+    }
+    c->dead = true;
+}
+
+void hb_loop(Conn* c) {
+    for (;;) {
+        std::vector<uint8_t> frame;
+        int period;
+        {
+            std::lock_guard<std::mutex> lk(c->hbmu);
+            if (c->closing) return;
+            frame = c->hb_frame;
+            period = c->hb_period_ms;
+        }
+        if (frame.empty() || period <= 0) {
+            if (c->closing) return;
+            ::usleep(20 * 1000);
+            continue;
+        }
+        if (!write_all(c, frame.data(), frame.size())) return;
+        int slept = 0;
+        while (slept < period) {
+            if (c->closing) return;
+            int step = period - slept < 20 ? period - slept : 20;
+            ::usleep(step * 1000);
+            slept += step;
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t gb_connect(const char* host, int port, const char* unix_path) {
+    int fd = -1;
+    if (unix_path && unix_path[0]) {
+        fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+        if (fd < 0) return -errno;
+        sockaddr_un sa{};
+        sa.sun_family = AF_UNIX;
+        std::strncpy(sa.sun_path, unix_path, sizeof(sa.sun_path) - 1);
+        if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+            int e = errno; ::close(fd); return -e;
+        }
+    } else {
+        fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) return -errno;
+        sockaddr_in sa{};
+        sa.sin_family = AF_INET;
+        sa.sin_port = htons(static_cast<uint16_t>(port));
+        if (::inet_pton(AF_INET, host, &sa.sin_addr) != 1) {
+            ::close(fd); return -EINVAL;
+        }
+        if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+            int e = errno; ::close(fd); return -e;
+        }
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+    }
+    Conn* c = new Conn();
+    c->fd = fd;
+    c->reader = std::thread(reader_loop, c);
+    c->hb = std::thread(hb_loop, c);
+    std::lock_guard<std::mutex> lk(g_mu);
+    int64_t h = g_next++;
+    g_conns[h] = c;
+    return h;
+}
+
+int gb_send(int64_t h, const uint8_t* buf, int len) {
+    Conn* c = get(h);
+    if (!c || c->dead || len < 0) return -1;
+    uint8_t hdr[4] = {uint8_t(len >> 24), uint8_t(len >> 16),
+                      uint8_t(len >> 8), uint8_t(len)};
+    std::vector<uint8_t> framed;
+    framed.reserve(4 + len);
+    framed.insert(framed.end(), hdr, hdr + 4);
+    framed.insert(framed.end(), buf, buf + len);
+    return write_all(c, framed.data(), framed.size()) ? 0 : -1;
+}
+
+int gb_set_heartbeat(int64_t h, const uint8_t* buf, int len, int period_ms) {
+    Conn* c = get(h);
+    if (!c) return -1;
+    std::vector<uint8_t> framed;
+    if (len > 0) {
+        uint8_t hdr[4] = {uint8_t(len >> 24), uint8_t(len >> 16),
+                          uint8_t(len >> 8), uint8_t(len)};
+        framed.reserve(4 + len);
+        framed.insert(framed.end(), hdr, hdr + 4);
+        framed.insert(framed.end(), buf, buf + len);
+    }
+    std::lock_guard<std::mutex> lk(c->hbmu);
+    c->hb_frame = std::move(framed);
+    c->hb_period_ms = period_ms;
+    return 0;
+}
+
+int gb_poll(int64_t h, uint8_t* buf, int cap) {
+    Conn* c = get(h);
+    if (!c) return -1;
+    {
+        std::lock_guard<std::mutex> lk(c->qmu);
+        if (!c->q.empty()) {
+            std::vector<uint8_t>& f = c->q.front();
+            if (static_cast<int>(f.size()) > cap) return -2;  // grow buffer
+            int n = static_cast<int>(f.size());
+            std::memcpy(buf, f.data(), f.size());
+            c->q.pop_front();
+            return n;
+        }
+    }
+    return c->dead ? -1 : 0;
+}
+
+int gb_connected(int64_t h) {
+    Conn* c = get(h);
+    return (c && !c->dead) ? 1 : 0;
+}
+
+void gb_close(int64_t h) {
+    Conn* c = nullptr;
+    {
+        std::lock_guard<std::mutex> lk(g_mu);
+        auto it = g_conns.find(h);
+        if (it == g_conns.end()) return;
+        c = it->second;
+        g_conns.erase(it);
+    }
+    {
+        std::lock_guard<std::mutex> lk(c->hbmu);
+        c->closing = true;
+    }
+    ::shutdown(c->fd, SHUT_RDWR);
+    if (c->hb.joinable()) c->hb.join();
+    if (c->reader.joinable()) c->reader.join();
+    ::close(c->fd);
+    delete c;
+}
+
+}  // extern "C"

@@ -197,3 +197,79 @@ class TestServer:
     @property
     def lan_addr(self) -> str:
         return f"127.0.0.1:{self.ports['serf_lan']}"
+
+
+class TestPlane:
+    """One forked TPU gossip plane daemon (``consul-tpu gossipd``): the
+    rendezvous for ``gossip_backend=tpu`` black-box agents."""
+
+    __test__ = False
+
+    def __init__(self, gossip_interval: float = 0.05,
+                 hb_lapse: float = 0.5, suspicion_mult: float = 2.0,
+                 capacity: int = 64, slots: int = 32) -> None:
+        self.port = _port_block()["http"]  # own block; any free port
+        self.args = ["gossipd", "-bind", "127.0.0.1",
+                     "-port", str(self.port),
+                     "-capacity", str(capacity), "-slots", str(slots),
+                     "-gossip-interval", str(gossip_interval),
+                     "-hb-lapse", str(hb_lapse),
+                     "-suspicion-mult", str(suspicion_mult)]
+        self.proc: Optional[subprocess.Popen] = None
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "TestPlane":
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"   # forked plane runs the CPU kernel
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli.main", *self.args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        return self
+
+    def wait_ready(self, timeout: float = 240.0) -> None:
+        """Block until the plane accepts connections (the first kernel
+        compile happens inside its start; the persistent cache makes
+        restarts fast)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc is not None and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"gossipd exited rc={self.proc.returncode}:\n"
+                    + self.output()[-2000:])
+            try:
+                s = socket.create_connection(("127.0.0.1", self.port),
+                                             timeout=1.0)
+                s.close()
+                return
+            except OSError:
+                time.sleep(0.3)
+        raise TimeoutError("gossip plane never came up:\n"
+                           + self.output()[-2000:])
+
+    def stop(self) -> None:
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(5)
+
+    def output(self) -> str:
+        if self.proc is None or self.proc.stdout is None:
+            return ""
+        if self.proc.poll() is None:
+            self.proc.kill()
+        try:
+            out, _ = self.proc.communicate(timeout=5)
+            return out.decode(errors="replace")
+        except Exception:
+            return ""

@@ -172,3 +172,193 @@ class TestClusterBlackBox:
         finally:
             for s in servers:
                 s.stop()
+
+
+def _make_ip_certs(tmp_path):
+    """Self-signed CA + server cert valid for 127.0.0.1 (the HTTPS
+    listener's bind address), via openssl."""
+    import subprocess
+    ca_key = tmp_path / "ca.key"
+    ca_crt = tmp_path / "ca.crt"
+    sv_key = tmp_path / "sv.key"
+    sv_csr = tmp_path / "sv.csr"
+    sv_crt = tmp_path / "sv.crt"
+    ext = tmp_path / "ext.cnf"
+    ext.write_text("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
+    cmds = [
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+         "-subj", "/CN=ConsulTestCA"],
+        ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(sv_key), "-out", str(sv_csr),
+         "-subj", "/CN=127.0.0.1"],
+        ["openssl", "x509", "-req", "-in", str(sv_csr), "-CA", str(ca_crt),
+         "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(sv_crt),
+         "-days", "1", "-extfile", str(ext)],
+    ]
+    for cmd in cmds:
+        proc = subprocess.run(cmd, capture_output=True)
+        if proc.returncode != 0:
+            pytest.skip(f"openssl unavailable/failed: {proc.stderr[:200]}")
+    return str(ca_crt), str(sv_crt), str(sv_key)
+
+
+class TestListenersBlackBox:
+    """HTTPS + unix-socket listeners (command/agent/http.go:44-173,
+    config.go UnixSockets): the same API served over every configured
+    transport of a REAL forked agent."""
+
+    def test_kv_over_https(self, tmp_path):
+        import json as _json
+
+        from consul_tpu.api.client import Client, Config
+
+        ca, crt, key = _make_ip_certs(tmp_path)
+        s = TestServer("bb-https")
+        https_port = s.ports["server"] + 1  # +6 in the instance block
+        with open(s.config_path) as f:
+            cfg = _json.load(f)
+        cfg["ports"]["https"] = https_port
+        cfg["cert_file"] = crt
+        cfg["key_file"] = key
+        with open(s.config_path, "w") as f:
+            _json.dump(cfg, f)
+        s.start()
+        try:
+            s.wait_for_api()
+            s.wait_for_leader()
+            with Client(Config(address=f"127.0.0.1:{https_port}",
+                               scheme="https", ca_file=ca)) as c:
+                from consul_tpu.api.client import KVPair
+                assert c.kv.put(KVPair(key="tls/key", value=b"secure"))
+                pair, _ = c.kv.get("tls/key")
+                assert pair is not None and pair.value == b"secure"
+                # Plain HTTP on the same port must NOT work.
+                import httpx
+                with pytest.raises(Exception):
+                    httpx.get(f"http://127.0.0.1:{https_port}/v1/status/leader",
+                              timeout=3).raise_for_status()
+        except Exception:
+            print(s.output()[-2000:])
+            raise
+        finally:
+            s.stop()
+
+    def test_kv_and_ipc_over_unix_sockets(self, tmp_path):
+        from consul_tpu.api.client import Client, Config, KVPair
+        from consul_tpu.ipc import IPCClient
+
+        http_sock = str(tmp_path / "http.sock")
+        ipc_sock = str(tmp_path / "ipc.sock")
+        s = TestServer("bb-unix", config_extra={
+            "addresses": {"http": f"unix://{http_sock}",
+                          "rpc": f"unix://{ipc_sock}"}})
+        s.start()
+        try:
+            with Client(Config(address=f"unix://{http_sock}")) as c:
+                deadline = time.monotonic() + 30
+                leader = ""
+                while time.monotonic() < deadline:
+                    try:
+                        leader = c.status.leader()
+                        if leader:
+                            break
+                    except Exception:
+                        pass
+                    time.sleep(0.3)
+                assert leader == "bb-unix", s.output()[-2000:]
+                assert c.kv.put(KVPair(key="unix/key", value=b"sock"))
+                pair, _ = c.kv.get("unix/key")
+                assert pair is not None and pair.value == b"sock"
+            with IPCClient(f"unix://{ipc_sock}") as ic:
+                members = ic.members_lan()
+                assert [m["Name"] for m in members] == ["bb-unix"]
+        except Exception:
+            print(s.output()[-2000:])
+            raise
+        finally:
+            s.stop()
+
+
+class TestTpuBackendBlackBox:
+    """The graft, end to end: a forked gossip plane daemon + three real
+    forked agents with gossip_backend=tpu.  Membership (join, members
+    output, kill -> serfHealth critical) is decided by the SWIM kernel
+    in the plane; the agents' HTTP/IPC surfaces must be
+    indistinguishable from the asyncio backend."""
+
+    def test_three_agents_kernel_membership(self):
+        from blackbox_util import TestPlane
+
+        plane = TestPlane().start()
+        servers = []
+        try:
+            plane.wait_ready()
+            names = ("bb-t1", "bb-t2", "bb-t3")
+            servers = [TestServer(
+                n, bootstrap=False, bootstrap_expect=3,
+                config_extra={"gossip_backend": "tpu",
+                              "gossip_plane": plane.addr}).start()
+                for n in names]
+            for s in servers:
+                s.wait_for_api(60)
+            for s in servers:
+                s.wait_for_leader(90)
+            # `consul members` over IPC: same output contract as the
+            # asyncio backend (name + alive + role/dc tags).
+            deadline = time.monotonic() + 30
+            out = None
+            while time.monotonic() < deadline:
+                out = servers[0].cli("members")
+                if all(n in out.stdout for n in names):
+                    break
+                time.sleep(0.3)
+            assert all(n in out.stdout for n in names), out.stdout
+            assert "alive" in out.stdout, out.stdout
+            # the catalog converged through reconcile: all 3 nodes
+            nodes = servers[0].http_get("/v1/catalog/nodes")
+            got = {n["Node"] for n in nodes}
+            assert set(names) <= got, got
+            # writes replicate across the quorum
+            assert servers[1].http_put("/v1/kv/tpu/x", b"99") is True
+            deadline = time.monotonic() + 15
+            val = None
+            while time.monotonic() < deadline:
+                try:
+                    val = servers[2].http_get("/v1/kv/tpu/x")
+                    if val:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert val and base64.b64decode(val[0]["Value"]) == b"99"
+            # kill -9: heartbeats stop -> kernel suspicion/Lifeguard ->
+            # dead verdict -> EV_FAILED -> leader reconcile ->
+            # serfHealth critical (the consul/serf.go:90-110 ->
+            # leader.go:423 pipeline, with the kernel deciding timing)
+            victim = servers[2]
+            victim.proc.kill()
+            deadline = time.monotonic() + 60
+            crit = []
+            while time.monotonic() < deadline:
+                try:
+                    crit = servers[0].http_get("/v1/health/state/critical")
+                    if any(c["Node"] == "bb-t3"
+                           and c["CheckID"] == "serfHealth" for c in crit):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            assert any(c["Node"] == "bb-t3" and c["CheckID"] == "serfHealth"
+                       for c in crit), crit
+        except Exception:
+            print("--- plane ---")
+            print(plane.output()[-3000:])
+            for s in servers:
+                print(f"--- {s.name} ---")
+                print(s.output()[-3000:])
+            raise
+        finally:
+            for s in servers:
+                s.stop()
+            plane.stop()

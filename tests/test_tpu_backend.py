@@ -1,0 +1,279 @@
+"""The graft tier: gossip_backend=tpu vs the asyncio SWIM backend.
+
+The same membership behaviors — join visibility, abrupt-death failure
+detection, graceful leave, user events, failed-node rejoin — run
+against BOTH backends behind the serf boundary:
+
+* ``swim`` — per-agent asyncio memberlist (membership/swim.py)
+* ``tpu``  — the kernel session in the gossip plane
+  (gossip/plane.py + membership/tpu_backend.py over the C++ bridge)
+
+If the two backends diverge in what the agent observes, the graft has
+broken the boundary contract (consul/server.go:284-325 + serf event
+channel).  Failure detection on the tpu backend is decided by the SWIM
+kernel's on-device suspicion/Lifeguard dynamics — the plane only feeds
+it the heartbeat-lapse probe signal.
+"""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+from consul_tpu.membership.serf import SerfConfig, SerfPool
+from consul_tpu.membership.swim import (EV_FAILED, EV_JOIN, EV_LEAVE,
+                                        STATE_ALIVE, STATE_DEAD)
+from consul_tpu.membership.tpu_backend import TpuSerfPool
+
+BACKENDS = ("swim", "tpu")
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+async def _wait(cond, timeout=20.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return cond()
+
+
+def _fast_cfg(name: str) -> SerfConfig:
+    return SerfConfig(node_name=name, bind_addr="127.0.0.1",
+                      tags={"role": "node", "dc": "dc1"},
+                      probe_interval=0.05, probe_timeout=0.02,
+                      gossip_interval=0.02, suspicion_mult=3.0,
+                      push_pull_interval=1.0)
+
+
+class Cluster:
+    """Uniform harness: N pools over one backend, recorded events."""
+
+    def __init__(self, backend: str) -> None:
+        self.backend = backend
+        self.plane = None
+        self.pools = {}
+        self.events = {}
+
+    async def start(self, names) -> None:
+        if self.backend == "tpu":
+            self.plane = GossipPlane(PlaneConfig(
+                bind_port=0, capacity=32, slots=16,
+                gossip_interval_s=0.02, probe_every=5,
+                suspicion_mult=1.0, hb_lapse_s=0.3))
+            await self.plane.start()
+        first_addr = None
+        for name in names:
+            ev = []
+            self.events[name] = ev
+
+            def on_event(kind, payload, _ev=ev):
+                _ev.append((kind, payload))
+
+            if self.backend == "tpu":
+                addr = "127.0.0.1:%d" % self.plane.local_addr[1]
+                pool = TpuSerfPool(_fast_cfg(name), on_event=on_event,
+                                   plane_addr=addr)
+                await pool.start()
+            else:
+                pool = SerfPool(_fast_cfg(name), on_event=on_event)
+                await pool.start()
+                if first_addr is not None:
+                    await pool.join([first_addr])
+                first_addr = first_addr or (
+                    "127.0.0.1:%d" % pool.local_addr[1])
+            self.pools[name] = pool
+
+    async def kill(self, name: str) -> None:
+        """Abrupt death: transport stops, no leave message."""
+        pool = self.pools.pop(name)
+        if self.backend == "tpu":
+            await pool.stop()          # closes bridge -> heartbeats stop
+        else:
+            await pool.ml.stop()       # sockets down mid-protocol
+        self.events.pop(name, None)
+
+    async def stop(self) -> None:
+        for pool in self.pools.values():
+            try:
+                await pool.stop()
+            except Exception:
+                pass
+        if self.plane is not None:
+            await self.plane.stop()
+
+    def member_states(self, viewer: str):
+        return {n.name: n.state for n in self.pools[viewer].members()}
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_join_visibility(loop, backend):
+    async def body():
+        c = Cluster(backend)
+        try:
+            await c.start(["a", "b", "c"])
+            for viewer in ("a", "b", "c"):
+                assert await _wait(lambda v=viewer: {
+                    k for k, s in c.member_states(v).items()
+                    if s == STATE_ALIVE} >= {"a", "b", "c"}), \
+                    (viewer, c.member_states(viewer))
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_abrupt_death_detected(loop, backend):
+    async def body():
+        c = Cluster(backend)
+        try:
+            await c.start(["a", "b", "c"])
+            assert await _wait(
+                lambda: len(c.pools["a"].alive_members()) == 3)
+            await c.kill("c")
+            # The failure detector (kernel suspicion/Lifeguard on tpu;
+            # probe/suspect timers on swim) must declare c dead and
+            # surface EV_FAILED through the serf boundary.
+            assert await _wait(lambda: any(
+                k == EV_FAILED and n.name == "c"
+                for k, n in c.events["a"]), timeout=30.0), \
+                [k for k, _ in c.events["a"]]
+            assert c.member_states("a").get("c") == STATE_DEAD
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_graceful_leave(loop, backend):
+    async def body():
+        c = Cluster(backend)
+        try:
+            await c.start(["a", "b"])
+            assert await _wait(
+                lambda: len(c.pools["a"].alive_members()) == 2)
+            await c.pools["b"].leave()
+            assert await _wait(lambda: any(
+                k == EV_LEAVE and n.name == "b"
+                for k, n in c.events["a"])), \
+                [k for k, _ in c.events["a"]]
+            # a left member is not failed — no EV_FAILED for b
+            assert not any(k == EV_FAILED and n.name == "b"
+                           for k, n in c.events["a"])
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_user_events_flood(loop, backend):
+    async def body():
+        c = Cluster(backend)
+        try:
+            await c.start(["a", "b", "c"])
+            assert await _wait(
+                lambda: len(c.pools["a"].alive_members()) == 3)
+            c.pools["a"].user_event("deploy", b"v2")
+
+            def got(name):
+                return any(k == "user" and p.get("name") == "deploy"
+                           and p.get("payload") == b"v2"
+                           for k, p in c.events[name])
+            assert await _wait(lambda: got("b") and got("c")), \
+                {n: [k for k, _ in evs] for n, evs in c.events.items()}
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_tpu_failed_node_rejoins(loop):
+    """Heartbeats resuming after a dead verdict = serf failed->rejoin:
+    the plane re-admits the id and the cluster sees a fresh join."""
+    async def body():
+        c = Cluster("tpu")
+        try:
+            await c.start(["a", "b"])
+            assert await _wait(
+                lambda: len(c.pools["a"].alive_members()) == 2)
+            await c.kill("b")
+            assert await _wait(lambda: any(
+                k == EV_FAILED and n.name == "b"
+                for k, n in c.events["a"]), timeout=30.0)
+            # b comes back (new process, same name)
+            ev_b2 = []
+            addr = "127.0.0.1:%d" % c.plane.local_addr[1]
+            b2 = TpuSerfPool(_fast_cfg("b"),
+                             on_event=lambda k, p: ev_b2.append((k, p)),
+                             plane_addr=addr)
+            await b2.start()
+            c.pools["b"] = b2
+            c.events["b"] = ev_b2
+            assert await _wait(lambda: any(
+                k == EV_JOIN and n.name == "b"
+                for k, n in c.events["a"][::-1])), \
+                [k for k, _ in c.events["a"]]
+            assert await _wait(
+                lambda: c.member_states("a").get("b") == STATE_ALIVE)
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_tpu_backend_uses_native_bridge(loop):
+    """The C++ bridge (native/gbridge.cpp) is the production transport;
+    this asserts it actually built and carried the session."""
+    from consul_tpu.native.bridge import native_available
+    assert native_available(), "gbridge build failed"
+
+    async def body():
+        c = Cluster("tpu")
+        try:
+            await c.start(["a"])
+            assert c.pools["a"]._native, "fell back to asyncio transport"
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_tpu_asyncio_fallback_transport(loop):
+    """Bridge parity: the pure-asyncio fallback speaks the same wire
+    protocol (for toolchain-less hosts)."""
+    async def body():
+        plane = GossipPlane(PlaneConfig(
+            bind_port=0, capacity=8, slots=8, gossip_interval_s=0.02,
+            suspicion_mult=1.0, hb_lapse_s=0.3))
+        await plane.start()
+        addr = "127.0.0.1:%d" % plane.local_addr[1]
+        ev = []
+        pool = TpuSerfPool(_fast_cfg("solo"),
+                           on_event=lambda k, p: ev.append((k, p)),
+                           plane_addr=addr, use_native=False)
+        try:
+            await pool.start()
+            assert not pool._native
+            assert await _wait(lambda: any(
+                k == EV_JOIN and n.name == "solo" for k, n in ev))
+        finally:
+            await pool.stop()
+            await plane.stop()
+    loop.run_until_complete(body())
