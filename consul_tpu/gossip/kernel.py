@@ -424,16 +424,25 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                              drops, conf_cap, rx_ok)
 
     def _hot_tail(heard):
-        # A handful of live episodes: gather just their belief rows, run
+        # A handful of live episodes: slice just their belief rows, run
         # the identical age/gossip/timer pipeline on the [H, N] subset,
-        # scatter back.  Inactive rows are all-zero, so excluding them
+        # write back.  Inactive rows are all-zero, so excluding them
         # is exact.  top_k over the 0/1 activity vector yields H
         # distinct slot ids (lowest-index ties), padding with inactive
         # slots whose rows are no-ops end to end.
+        #
+        # Row IO is H per-row dynamic slices/updates with traced starts
+        # — NOT a single [H] fancy-index gather: on this TPU a traced-
+        # index row gather lowers element-wise (~6.5ns/index ⇒ ~52ms
+        # for 8×1M rows — the round-3 hot tier was 10x SLOWER than the
+        # full tail it replaced), while dynamic_slice moves each row at
+        # memory bandwidth (BENCH_NOTES §1c / axon perf model).
         act = (slot_node >= 0).astype(jnp.int32)
         _, idx = jax.lax.top_k(act, p.hot_slots)
         idx = idx.astype(jnp.int32)
-        sub = heard[idx]
+        sub = jnp.concatenate([
+            jax.lax.dynamic_slice_in_dim(heard, idx[j], 1, axis=0)
+            for j in range(p.hot_slots)], axis=0)
         sub = _disseminate(p, rnd, k_gossip, sub, mf, rx_ok, conf_cap[idx])
         sub = _maybe_pushpull(sub, rx_ok)
         return _finish_round(p, state, rnd, fail_round, alive, member, sub,
@@ -491,6 +500,41 @@ def gossip_offsets(key: jax.Array, n: int, fanout: int) -> jnp.ndarray:
     return jax.random.randint(key, (fanout,), 1, n, dtype=jnp.int32)
 
 
+# SWAR constants: four u8 belief bytes ride one u32 lane (byte k of
+# word g = slot row 4g+k).  All per-byte fields are < 0x80, so the
+# borrow-guard comparison trick below is exact.
+_LSB = 0x01010101
+_B7 = 0x80808080
+_AGE4 = 0x0F0F0F0F
+_MSG4 = 0x03030303
+
+
+def _bcast_byte(b):
+    """Per-byte 0/1 (at each byte's LSB) -> 0x00/0xFF per byte."""
+    return (b << 8) - b  # u32 wrap makes the top byte come out right
+
+
+def _byte_ge(a, b):
+    """Per-byte (a >= b) as a 0x00/0xFF mask; fields must be < 0x80."""
+    t = (a | jnp.uint32(_B7)) - b
+    return _bcast_byte((t >> 7) & jnp.uint32(_LSB))
+
+
+def _byte_eq(a, b):
+    """Per-byte (a == b) as a 0x00/0xFF mask; fields must be < 0x80.
+
+    NOT the classic ``(x-LSB) & ~x & 0x80..`` zero-byte test — that
+    one's per-byte indicators are polluted by borrows propagating past
+    a zero byte (it only answers "is there ANY zero byte").  Two
+    borrow-free >= comparisons are exact."""
+    return _byte_ge(a, b) & _byte_ge(b, a)
+
+
+def _byte_sel(mask, a, b):
+    """Per-byte select: mask bytes are 0x00/0xFF."""
+    return (a & mask) | (b & ~mask)
+
+
 def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
                  conf_cap) -> jnp.ndarray:
     """One round of rumor push: ``fanout`` circulant-shift deliveries,
@@ -498,77 +542,86 @@ def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
     confirmation counting.
 
     The belief matrix moves as u32 words holding FOUR slot-rows per
-    element (byte k of word g = row 4g+k); merge logic runs per
-    byte-plane on native u32 lanes instead of sub-lane u8."""
+    element; the whole merge is SWAR on those words — one fused
+    elementwise pass that reads the current matrix and the ``fanout``
+    rolled copies once each, instead of the previous per-byte-plane
+    loop that produced four separate [S4, N] outputs (each re-reading
+    every pin).  IO per round drops from ~12 pin reads + 4 plane
+    read/writes to fanout+1 reads + 1 write."""
     S, N = heard.shape
     S4 = -(-S // 4)
     pad = 4 * S4 - S
     h_rows = (jnp.concatenate(
         [heard, jnp.zeros((pad, N), jnp.uint8)]) if pad else heard)
     planes = h_rows.reshape(S4, 4, N).astype(jnp.uint32)
-    # Age tick, fused into the packing chain on u32 lanes (the
-    # standalone u8 pass costs a full read+write of the matrix): fresh
-    # probe marks (_AGE_FRESH sentinel) become age 0, real ages
-    # saturate at 14.  See _age_tick for the semantics.
-    msg = planes >> _MSG_SHIFT
-    age = planes & _AGE_MASK
-    new_age = jnp.where(age == _AGE_FRESH, jnp.uint32(0),
-                        jnp.minimum(age + 1, jnp.uint32(_AGE_MASK - 1)))
-    planes = jnp.where(msg > 0,
-                       (planes & ~jnp.uint32(_AGE_MASK)) | new_age, planes)
     packed = (planes[:, 0] | (planes[:, 1] << 8)
               | (planes[:, 2] << 16) | (planes[:, 3] << 24))
 
+    # Age tick, fused into the packed chain (the standalone u8 pass
+    # costs a full read+write of the matrix): fresh probe marks
+    # (_AGE_FRESH sentinel) become age 0, real ages saturate at 14.
+    # See _age_tick for the semantics.
+    age = packed & jnp.uint32(_AGE4)
+    has_msg = ~_byte_eq(packed >> _MSG_SHIFT & jnp.uint32(_MSG4),
+                        jnp.uint32(0))
+    fresh = _byte_eq(age, jnp.uint32(_AGE4))  # == _AGE_FRESH per byte
+    inc = age + jnp.uint32(_LSB)
+    sat = _byte_ge(inc, jnp.uint32((_AGE_MASK - 1) * _LSB))
+    aged = _byte_sel(fresh, jnp.uint32(0),
+                     _byte_sel(sat, jnp.uint32((_AGE_MASK - 1) * _LSB), inc))
+    packed = _byte_sel(has_msg,
+                       (packed & ~jnp.uint32(_AGE4)) | aged, packed)
+
     offs = gossip_offsets(k_gossip, N, p.fanout)
-    budget = jnp.uint32(p.spread_budget_rounds)
-    pins = []
+    budget_b = jnp.uint32(p.spread_budget_rounds * _LSB)
+    rx = jnp.where(rx_ok, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[None, :]
+
+    in_msg = jnp.zeros((S4, N), jnp.uint32)
+    n_sus = jnp.zeros((S4, N), jnp.uint32)
     for f in range(p.fanout):
         # Sender into d is d - o_f: delivery = roll by +o_f (contiguous).
         o = offs[f]
-        src_ok = jnp.roll(mf, o) > rnd
-        pins.append((jnp.roll(packed, o, axis=1), src_ok))
+        src = jnp.where(jnp.roll(mf, o) > rnd,
+                        jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[None, :]
+        pin = jnp.roll(packed, o, axis=1)
+        live = ~_byte_ge(pin & jnp.uint32(_AGE4), budget_b) & src
+        m = (pin >> _MSG_SHIFT) & jnp.uint32(_MSG4) & live
+        in_msg = _byte_sel(_byte_ge(m, in_msg), m, in_msg)
+        n_sus = n_sus + ((_byte_eq(m, jnp.uint32(MSG_SUSPECT * _LSB))
+                          >> 7) & jnp.uint32(_LSB))
 
-    cap4 = (jnp.concatenate([conf_cap, jnp.zeros((pad,), jnp.int32)])
-            if pad else conf_cap).reshape(S4, 4).astype(jnp.uint32)
+    cap_b = (jnp.concatenate([conf_cap, jnp.zeros((pad,), jnp.int32)])
+             if pad else conf_cap).astype(jnp.uint32).reshape(S4, 4)
+    cap_packed = (cap_b[:, 0] | (cap_b[:, 1] << 8)
+                  | (cap_b[:, 2] << 16) | (cap_b[:, 3] << 24))[:, None]
 
-    out_planes = []
-    for k in range(4):
-        in_msg = jnp.zeros((S4, N), jnp.uint32)
-        n_sus_in = jnp.zeros((S4, N), jnp.uint32)
-        for pin, src_ok in pins:
-            bk = (pin >> (8 * k)) & jnp.uint32(0xFF)
-            active = src_ok[None, :] & ((bk & _AGE_MASK) < budget)
-            m = jnp.where(active, bk >> _MSG_SHIFT, jnp.uint32(0))
-            in_msg = jnp.maximum(in_msg, m)
-            n_sus_in = n_sus_in + (m == MSG_SUSPECT).astype(jnp.uint32)
+    cur_msg = (packed >> _MSG_SHIFT) & jnp.uint32(_MSG4)
+    age_c = packed & jnp.uint32(_AGE4)
+    conf = (packed >> _CONF_SHIFT) & jnp.uint32(_MSG4)
+    upgraded = ~_byte_ge(cur_msg, in_msg) & rx
+    sus_b = jnp.uint32(MSG_SUSPECT * _LSB)
+    bump = _byte_eq(cur_msg, sus_b) & _byte_eq(in_msg, sus_b) & rx
+    conf_sum = conf + n_sus  # per-byte <= 6: no cross-byte carry
+    capped = _byte_sel(_byte_ge(cap_packed, conf_sum), conf_sum, cap_packed)
+    conf_new = _byte_sel(bump, capped, conf)
+    # A suspicion heard at a HIGHER confirmation count is a new message
+    # in memberlist (suspect-from-origin-X re-enqueues with its own
+    # retransmit budget — refmodel.py:197-201): model the re-broadcast
+    # by refreshing the entry's spread window whenever the local count
+    # rises.  Bounded: conf can rise at most max_confirmations times
+    # per observer per episode.  Without this, confirmations trickle
+    # instead of flooding and the Lifeguard timeout decays late —
+    # measured as a 61% p99 detection-latency error at 10k nodes
+    # (CROSSVAL.json history).
+    conf_rose = ~_byte_ge(conf, conf_new)
+    out_msg = _byte_sel(upgraded, in_msg, cur_msg)
+    out_age = _byte_sel(upgraded | conf_rose, jnp.uint32(0), age_c)
+    out_conf = _byte_sel(upgraded, jnp.uint32(0), conf_new)
+    out = (out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age
 
-        cur = planes[:, k]                        # [S4, N] u32 bytes
-        cur_msg = cur >> _MSG_SHIFT
-        age = cur & _AGE_MASK
-        conf = (cur >> _CONF_SHIFT) & _CONF_MASK
-        upgraded = (in_msg > cur_msg) & rx_ok[None, :]
-        bump = ((cur_msg == MSG_SUSPECT) & (in_msg == MSG_SUSPECT)
-                & rx_ok[None, :])
-        conf_new = jnp.where(bump,
-                             jnp.minimum(conf + n_sus_in, cap4[:, k][:, None]),
-                             conf)
-        # A suspicion heard at a HIGHER confirmation count is a new
-        # message in memberlist (suspect-from-origin-X re-enqueues with
-        # its own retransmit budget — refmodel.py:197-201): model the
-        # re-broadcast by refreshing the entry's spread window whenever
-        # the local count rises.  Bounded: conf can rise at most
-        # max_confirmations times per observer per episode.  Without
-        # this, confirmations trickle instead of flooding and the
-        # Lifeguard timeout decays late — measured as a 61% p99
-        # detection-latency error at 10k nodes (CROSSVAL.json history).
-        conf_rose = conf_new > conf
-        out_msg = jnp.where(upgraded, in_msg, cur_msg)
-        out_age = jnp.where(upgraded | conf_rose, jnp.uint32(0), age)
-        out_conf = jnp.where(upgraded, jnp.uint32(0), conf_new)
-        out_planes.append(
-            (out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age)
-
-    return jnp.stack(out_planes, axis=1).reshape(4 * S4, N)[:S].astype(jnp.uint8)
+    planes_out = jnp.stack([(out >> (8 * k)) & jnp.uint32(0xFF)
+                            for k in range(4)], axis=1)
+    return planes_out.reshape(4 * S4, N)[:S].astype(jnp.uint8)
 
 
 def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
@@ -669,15 +722,17 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
         slot_node_o, slot_phase_o = sl_node, sl_phase
         slot_dead_o = sl_dead_round
     else:
-        # Write the subset rows back by inverse-map row-gather + select:
-        # a scatter of [H, N] updates lowers element-wise on this TPU
-        # (~6.5ns/element — 50ms for 8 rows at 1M), while a row gather
-        # costs per-INDEX and the select runs at memory bandwidth.
-        inv = jnp.full((S,), -1, jnp.int32).at[idx].set(
-            jnp.arange(H, dtype=jnp.int32))
-        have = inv >= 0
-        heard = jnp.where(have[:, None],
-                          heard_sub[jnp.clip(inv, 0, H - 1)], full_heard)
+        # Write the subset rows back as H per-row dynamic updates with
+        # traced starts: each moves one row at memory bandwidth and the
+        # untouched S-H rows are never rewritten.  (A scatter of [H, N]
+        # updates lowers element-wise on this TPU — ~6.5ns/element,
+        # 50ms for 8 rows at 1M — and the previous inverse-map select
+        # re-wrote the whole S×N matrix to change H rows.)
+        heard = full_heard
+        for j in range(H):
+            heard = jax.lax.dynamic_update_slice(
+                heard, jax.lax.dynamic_slice_in_dim(heard_sub, j, 1, axis=0),
+                (idx[j], jnp.int32(0)))
         slot_node_o = slot_node.at[idx].set(sl_node)
         slot_phase_o = slot_phase.at[idx].set(sl_phase)
         slot_dead_o = slot_dead_round.at[idx].set(sl_dead_round)

@@ -101,6 +101,27 @@ def main():
     f_scan = make_timed(lambda st: run_rounds(st, key, fail, p, steps=64)[0])
     results["round_amortized_64"] = timed(f_scan, state, iters=2, warmup=1) / 64
 
+    # -- realistic-churn regime: 1-2 live episodes (vs the bench's 64
+    # saturated slots), full tail vs the hot tier's sliced-row subset
+    # pipeline.  This is the measurement VERDICT r3 asked for before
+    # enabling hot_slots by default.
+    p_hot = lan_profile(n, slots=S, hot_slots=8)
+    fail2 = (jnp.full((n,), NEVER, jnp.int32)
+             .at[:2].set(jnp.asarray([64, 128], jnp.int32)))
+    state2 = init_state(p)
+    state2, _ = run_rounds(state2, key, fail2, p, steps=192)
+    jax.block_until_ready(state2); int(state2.round)
+    f2_full = make_timed(lambda st: run_rounds(st, key, fail2, p, steps=64)[0])
+    results["realistic_churn_full_64"] = timed(
+        f2_full, state2, iters=2, warmup=1) / 64
+    state2h = init_state(p_hot)
+    state2h, _ = run_rounds(state2h, key, fail2, p_hot, steps=192)
+    jax.block_until_ready(state2h); int(state2h.round)
+    f2_hot = make_timed(
+        lambda st: run_rounds(st, key, fail2, p_hot, steps=64)[0])
+    results["realistic_churn_hot8_64"] = timed(
+        f2_hot, state2h, iters=2, warmup=1) / 64
+
     # -- ablation scans: the same 64-round scan with phases removed.
     # Within-scan attribution — the per-phase standalone timings below
     # carry materialization-boundary + dispatch noise that makes them
