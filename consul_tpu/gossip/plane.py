@@ -328,16 +328,18 @@ class GossipPlane:
             )
         self._state = st
         node.status = status
+        if status == "left":
+            # A left node's id goes back to the pool (name-churn must
+            # not exhaust capacity); the PlaneNode stays listed as
+            # "left" for members-output parity with serf's tombstone
+            # window, and re-registers through the id-less path.
+            self._declared_dead.discard(i)
+            self._nodes_by_id.pop(i, None)
+            self._free_ids.append(i)
+            node.id = -1
 
     def members_wire(self) -> List[Dict[str, Any]]:
-        out = []
-        for node in self._nodes_by_name.values():
-            out.append({"name": node.name, "addr": node.addr,
-                        "port": node.port, "tags": node.tags,
-                        "state": ("alive" if node.status == "alive" else
-                                  "dead" if node.status == "failed" else
-                                  "left")})
-        return out
+        return [self._member_wire(n) for n in self._nodes_by_name.values()]
 
     # -- bridge server -----------------------------------------------------
 
@@ -413,12 +415,15 @@ class GossipPlane:
             # memberlist's name-conflict delegate does.  A dead/lapsed
             # holder is a restart and may re-register.
             return None
-        if node is None:
+        if node is None or node.id < 0:
             nid = self._alloc_id()
             if nid is None:
                 return None
-            node = PlaneNode(id=nid, name=name)
-            self._nodes_by_name[name] = node
+            if node is None:
+                node = PlaneNode(id=nid, name=name)
+                self._nodes_by_name[name] = node
+            else:  # a previously-left name re-registering
+                node.id = nid
             self._nodes_by_id[nid] = node
         node.addr = m.get("addr", "")
         node.port = int(m.get("port", 0) or 0)

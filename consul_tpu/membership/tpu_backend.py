@@ -133,6 +133,7 @@ class TpuSerfPool:
         self._poll_task: Optional[asyncio.Task] = None
         self._registered = asyncio.Event()
         self._register_error = ""
+        self._closed = False
         self._hb_interval = 0.5
 
     # -- lifecycle ---------------------------------------------------------
@@ -145,16 +146,10 @@ class TpuSerfPool:
         except (ConnectionError, OSError, asyncio.TimeoutError):
             # Plane not up yet: keep dialing in the background (the
             # retry-join role for the rendezvous model).
-            async def redial():
-                while self._bridge is None:
-                    await asyncio.sleep(retry_interval)
-                    try:
-                        await self._connect(self.plane_addr)
-                    except (ConnectionError, OSError, asyncio.TimeoutError):
-                        continue
-            self._redial_task = asyncio.get_event_loop().create_task(redial())
+            self._schedule_redial(retry_interval)
 
     async def stop(self) -> None:
+        self._closed = True
         t = getattr(self, "_redial_task", None)
         if t is not None:
             t.cancel()
@@ -185,10 +180,14 @@ class TpuSerfPool:
         native = False
         if self.use_native:
             try:
+                # Off-loop: first use may g++-compile the library, and
+                # the connect(2) is a blocking syscall — neither may
+                # stall the agent's event loop.
                 from consul_tpu.native.bridge import BridgeClient
-                bridge = BridgeClient(host, port, unix)
+                bridge = await asyncio.get_event_loop().run_in_executor(
+                    None, BridgeClient, host, port, unix)
                 native = True
-            except (RuntimeError, ConnectionError):
+            except (RuntimeError, ConnectionError, OSError):
                 bridge = None
         if bridge is None:
             bridge = _AsyncioTransport(host, port, unix)
@@ -205,6 +204,8 @@ class TpuSerfPool:
             self._poll_task = asyncio.get_event_loop().create_task(
                 self._poller())
             await asyncio.wait_for(self._registered.wait(), timeout=10.0)
+            if self._register_error:
+                raise ConnectionError(self._register_error)
         except (asyncio.TimeoutError, ConnectionError) as e:
             if self._poll_task is not None:
                 self._poll_task.cancel()
@@ -229,14 +230,36 @@ class TpuSerfPool:
         except asyncio.CancelledError:
             raise
         except ConnectionError:
-            pass  # plane gone; the agent's retry-join loop re-dials
+            # Plane gone (restart, or it killed a desynced session).
+            # If we had an established session, tear down and redial —
+            # the welcome snapshot is the resync.
+            if self._closed or not self._registered.is_set() \
+                    or self._register_error:
+                return
+            if self._bridge is not None:
+                self._bridge.close()
+                self._bridge = None
+            self._poll_task = None
+            self._schedule_redial()
+
+    def _schedule_redial(self, interval: float = 1.0) -> None:
+        async def redial():
+            while not self._closed and self._bridge is None:
+                await asyncio.sleep(interval)
+                try:
+                    await self._connect(self.plane_addr)
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    continue
+        self._redial_task = asyncio.get_event_loop().create_task(redial())
 
     def _handle(self, m: Dict[str, Any]) -> None:
         t = m.get("t")
         if t == "err":
             # Registration refused (plane full / live name conflict):
-            # surface to _connect and tear the session down.
+            # wake _connect immediately (don't burn its handshake
+            # timeout) and tear the session down.
             self._register_error = m.get("error", "refused")
+            self._registered.set()
             raise ConnectionError(self._register_error)
         if t == "welcome":
             self._hb_interval = float(m.get("hb_interval_s", 0.5))

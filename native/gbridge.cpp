@@ -24,6 +24,7 @@
 //   gb_close(h)
 
 #include <arpa/inet.h>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -50,8 +51,9 @@ struct Conn {
     std::mutex hbmu;
     std::vector<uint8_t> hb_frame;       // preframed heartbeat bytes
     int hb_period_ms = 0;
-    bool closing = false;
-    bool dead = false;                   // reader saw EOF/error
+    // Read/written across the reader, heartbeat, and host threads.
+    std::atomic<bool> closing{false};
+    std::atomic<bool> dead{false};       // reader saw EOF/error/overflow
 };
 
 std::mutex g_mu;
@@ -104,9 +106,14 @@ void reader_loop(Conn* c) {
         {
             std::lock_guard<std::mutex> lk(c->qmu);
             c->q.push_back(std::move(frame));
-            // Bound memory if the host stops polling (drop-oldest: the
-            // newest membership snapshot supersedes older events).
-            while (c->q.size() > 4096) c->q.pop_front();
+            if (c->q.size() > 4096) {
+                // The host stopped polling and the protocol pushes
+                // INCREMENTAL events — silently dropping any frame
+                // would desync the membership view forever.  Kill the
+                // connection instead: the client redials and gets a
+                // fresh welcome snapshot (an explicit resync).
+                break;
+            }
         }
     }
     c->dead = true;
