@@ -1,0 +1,143 @@
+"""UI test tier: the role of the reference's QUnit suite (``ui/tests/``).
+
+No JS runtime ships in this image, so instead of executing app.js we
+test the two contracts that actually break SPAs in practice:
+
+1. **Data contract** — every ``/v1/...`` endpoint app.js fetches must
+   exist on a live agent and return the JSON shape the UI destructures
+   (field names are asserted, since a renamed field fails silently in
+   the browser).  This is what most of the reference's QUnit tests
+   cover via its Ember models.
+2. **Routing/asset contract** — the hash routes the router implements,
+   the nav links in index.html, and the assets it references must
+   agree and be served under ``/ui/``.
+
+Endpoints are EXTRACTED from app.js (regex over fetch paths), so adding
+a fetch to the UI without server support fails here.
+"""
+
+import asyncio
+import re
+
+import pytest
+
+from consul_tpu.agent.agent import AgentConfig
+from test_agent_http import AgentHarness
+
+UI_DIR = "consul_tpu/ui"
+
+
+def _read(name: str) -> str:
+    import os
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(here, UI_DIR, name)) as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def agent_http():
+    """Live agent (own thread + loop) + its HTTP base url, pre-seeded
+    with the service the UI screens browse."""
+    h = AgentHarness(AgentConfig(node_name="ui-test")).start()
+
+    async def seed():
+        from consul_tpu.structs.structs import NodeService
+        await h.agent.add_service(NodeService(
+            id="web1", service="web", port=8080, tags=["ui"]))
+    asyncio.run_coroutine_threadsafe(seed(), h.loop).result(10)
+    yield h.agent, h.http_addr
+    h.stop()
+
+
+def _get(base: str, path: str):
+    import json
+    import urllib.request
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        body = r.read()
+        return r.status, (json.loads(body) if body else None), \
+            r.headers.get("Content-Type", "")
+
+
+class TestUIDataContract:
+    def test_all_fetched_endpoints_are_served(self, agent_http):
+        """Every endpoint pattern app.js fetches answers 200 with JSON."""
+        agent, base = agent_http
+        # seed KV through the same PUT path the UI's editor uses
+        import urllib.request
+        req = urllib.request.Request(base + "/v1/kv/app/config",
+                                     data=b"x=1", method="PUT")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+
+        app_js = _read("app.js")
+        # Concrete instantiations of every fetch pattern in app.js
+        # (all three JS quote styles, or the guarantee is hollow).
+        fetched = set(re.findall(r'["\'`](/v1/[^"\'`?]*)', app_js))
+        concrete = {
+            "/v1/internal/ui/services": "/v1/internal/ui/services",
+            "/v1/health/service/${encodeURIComponent(name)}":
+                "/v1/health/service/web",
+            "/v1/internal/ui/nodes": "/v1/internal/ui/nodes",
+            "/v1/internal/ui/node/${encodeURIComponent(name)}":
+                "/v1/internal/ui/node/ui-test",
+            "/v1/kv/${kvPath(k)}": "/v1/kv/app/config",
+            "/v1/kv/${kvPath(key)}": "/v1/kv/app/config",
+            "/v1/kv/${kvPath(prefix)}": "/v1/kv/app/config",
+            "/v1/agent/self": "/v1/agent/self",
+        }
+        unmapped = fetched - set(concrete)
+        assert not unmapped, f"app.js fetches unmapped endpoints: {unmapped}"
+        for pattern, path in concrete.items():
+            status, body, ctype = _get(base, path)
+            assert status == 200, (pattern, path, status)
+            assert "json" in ctype, (pattern, path, ctype)
+        # the keys-listing variant the KV browser uses
+        status, keys, _ = _get(base, "/v1/kv/app/?keys&separator=/")
+        assert status == 200 and keys == ["app/config"]
+
+    def test_fields_the_ui_destructures(self, agent_http):
+        """Field names app.js reads must exist in the payloads."""
+        agent, base = agent_http
+        _, services, _ = _get(base, "/v1/internal/ui/services")
+        assert services and {"Name", "Nodes", "ChecksPassing",
+                             "ChecksWarning", "ChecksCritical"} <= set(
+            services[0])
+        _, insts, _ = _get(base, "/v1/health/service/web")
+        assert insts and {"Node", "Service", "Checks"} <= set(insts[0])
+        assert {"Node", "Address"} <= set(insts[0]["Node"])
+        assert {"Service", "Port", "Tags"} <= set(insts[0]["Service"])
+        _, nodes, _ = _get(base, "/v1/internal/ui/nodes")
+        assert nodes and {"Node", "Address", "Services",
+                          "Checks"} <= set(nodes[0])
+        _, node, _ = _get(base, "/v1/internal/ui/node/ui-test")
+        assert {"Node", "Services"} <= set(node)
+        _, me, _ = _get(base, "/v1/agent/self")
+        assert "Config" in me and "NodeName" in me["Config"]
+
+
+class TestUIRoutingContract:
+    def test_nav_links_match_router_routes(self):
+        app_js = _read("app.js")
+        index = _read("index.html")
+        nav_routes = set(re.findall(r'href="(#/[a-z]+)"', index))
+        assert nav_routes == {"#/services", "#/nodes", "#/kv"}
+        # Every nav route must have a branch in route()'s dispatch map
+        # (the `name: () =>` entries) — matching the actual dispatch
+        # code, not the route-table comment at the top of the file.
+        router = re.search(r"function route\(\).*?^\}", app_js,
+                           re.S | re.M)
+        assert router, "app.js lost its route() dispatcher"
+        dispatch = set(re.findall(r"^\s*([a-z]+):\s*\(\)\s*=>",
+                                  router.group(0), re.M))
+        assert {r[2:] for r in nav_routes} <= dispatch, \
+            (nav_routes, dispatch)
+
+    def test_assets_served_under_ui(self, agent_http):
+        _, base = agent_http
+        import urllib.request
+        for asset, must_contain in (("/ui/", "<script src=\"app.js\">"),
+                                    ("/ui/app.js", "route()"),
+                                    ("/ui/style.css", "body")):
+            with urllib.request.urlopen(base + asset, timeout=10) as r:
+                body = r.read().decode()
+            assert must_contain in body, asset
