@@ -4,6 +4,8 @@
 # distinct port blocks, formed via bootstrap_expect + retry_join.
 #
 #   ./demo/cluster.sh up      # start 4 agents (data under /tmp/consul-tpu-demo)
+#   ./demo/cluster.sh up-tpu  # same cluster, membership on the TPU gossip
+#                             # plane (gossip_backend=tpu + gossipd daemon)
 #   ./demo/cluster.sh status  # members + leader via agent 1
 #   ./demo/cluster.sh demo    # seed a service + KV, query HTTP/DNS
 #   ./demo/cluster.sh down    # stop everything
@@ -13,8 +15,10 @@ cd "$(dirname "$0")/.."
 ROOT=/tmp/consul-tpu-demo
 BASE=23000
 
-cfg() { # name idx server expect
-  local name=$1 idx=$2 server=$3 expect=$4
+PLANE_PORT=$((BASE + 99))
+
+cfg() { # name idx server expect [gossip_extra]
+  local name=$1 idx=$2 server=$3 expect=$4 gossip_extra=${5:-}
   local base=$((BASE + idx * 10))
   mkdir -p "$ROOT/$name"
   cat > "$ROOT/$name/config.json" <<EOF
@@ -28,7 +32,7 @@ cfg() { # name idx server expect
   "data_dir": "$ROOT/$name/data",
   "retry_join": ["127.0.0.1:$((BASE + 3))"],
   "retry_interval": "1s",
-  "log_level": "WARN",
+  "log_level": "WARN",$gossip_extra
   "ports": {"http": $base, "dns": $((base + 1)), "rpc": $((base + 2)),
             "serf_lan": $((base + 3)), "serf_wan": $((base + 4)),
             "server": $((base + 5))}
@@ -37,8 +41,34 @@ EOF
 }
 
 up() {
+  local gossip_extra=""
   rm -rf "$ROOT"; mkdir -p "$ROOT"
-  cfg s1 0 true 3; cfg s2 1 true 3; cfg s3 2 true 3; cfg c1 3 false 0
+  if [ "${1:-}" = tpu ]; then
+    # Membership substrate = the SWIM kernel in the gossipd daemon:
+    # suspicion/Lifeguard/refutation/dead verdicts run on-device, and
+    # the agents' serf boundary consumes the verdicts.
+    gossip_extra='
+  "gossip_backend": "tpu",
+  "gossip_plane": "127.0.0.1:'$PLANE_PORT'",'
+    # GOSSIPD_JAX_PLATFORMS=axon (plus the axon PYTHONPATH) runs the
+    # plane on the real chip; the demo defaults to the CPU kernel.
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS="${GOSSIPD_JAX_PLATFORMS:-cpu}" \
+      python -m consul_tpu.cli.main gossipd -port $PLANE_PORT \
+      > "$ROOT/gossipd.log" 2>&1 &
+    echo $! > "$ROOT/gossipd.pid"
+    echo "started gossipd (pid $(cat "$ROOT/gossipd.pid"), port $PLANE_PORT)"
+    echo "waiting for the plane (first kernel compile can take ~30s)..."
+    for _ in $(seq 240); do
+      kill -0 "$(cat "$ROOT/gossipd.pid")" 2>/dev/null || {
+        echo "gossipd died:"; tail -5 "$ROOT/gossipd.log"; exit 1; }
+      (echo > /dev/tcp/127.0.0.1/$PLANE_PORT) 2>/dev/null && break
+      sleep 1
+    done
+    (echo > /dev/tcp/127.0.0.1/$PLANE_PORT) 2>/dev/null || {
+      echo "gossip plane never came up:"; tail -5 "$ROOT/gossipd.log"; exit 1; }
+  fi
+  cfg s1 0 true 3 "$gossip_extra"; cfg s2 1 true 3 "$gossip_extra"
+  cfg s3 2 true 3 "$gossip_extra"; cfg c1 3 false 0 "$gossip_extra"
   for n in s1 s2 s3 c1; do
     env -u PALLAS_AXON_POOL_IPS python -m consul_tpu.cli.main agent \
       -config-file "$ROOT/$n/config.json" > "$ROOT/$n/log" 2>&1 &
@@ -83,11 +113,13 @@ down() {
   for n in s1 s2 s3 c1; do
     [ -f "$ROOT/$n/pid" ] && kill "$(cat "$ROOT/$n/pid")" 2>/dev/null || true
   done
+  [ -f "$ROOT/gossipd.pid" ] && kill "$(cat "$ROOT/gossipd.pid")" 2>/dev/null || true
   echo "stopped"
 }
 
 case "${1:-}" in
   up) up ;;
+  up-tpu) up tpu ;;
   status) status ;;
   demo) demo ;;
   down) down ;;

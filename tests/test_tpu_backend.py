@@ -277,3 +277,47 @@ def test_tpu_asyncio_fallback_transport(loop):
             await pool.stop()
             await plane.stop()
     loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_hybrid_universe_sim_nodes(loop):
+    """The hybrid posture: real agents share the kernel arrays with a
+    simulated swarm (PlaneConfig.sim_nodes).  Sim nodes are kernel
+    members — they probe, relay rumors, and count toward dissemination
+    — but are invisible to the agents' members view (they are not
+    registered catalog nodes).  Failure detection of a real agent must
+    still work with the swarm present."""
+    async def body():
+        plane = GossipPlane(PlaneConfig(
+            bind_port=0, capacity=16, sim_nodes=240, slots=16,
+            gossip_interval_s=0.02, probe_every=5,
+            suspicion_mult=1.0, hb_lapse_s=0.3))
+        await plane.start()
+        import numpy as np
+        assert int(np.asarray(plane._state.member).sum()) == 240
+        addr = "127.0.0.1:%d" % plane.local_addr[1]
+        pools, events = {}, {}
+        try:
+            for name in ("a", "b"):
+                ev = []
+                events[name] = ev
+                pools[name] = TpuSerfPool(
+                    _fast_cfg(name),
+                    on_event=lambda k, p, _ev=ev: _ev.append((k, p)),
+                    plane_addr=addr)
+                await pools[name].start()
+            assert await _wait(lambda: len(pools["a"].members()) == 2)
+            # the swarm never leaks into the serf-boundary view
+            assert {n.name for n in pools["a"].members()} == {"a", "b"}
+            # kill b: detection decided by the kernel with 242 members
+            await pools.pop("b").stop()
+            assert await _wait(lambda: any(
+                k == EV_FAILED and n.name == "b"
+                for k, n in events["a"]), timeout=30.0), \
+                [k for k, _ in events["a"]]
+        finally:
+            for pool in pools.values():
+                await pool.stop()
+            await plane.stop()
+    loop.run_until_complete(body())

@@ -19,6 +19,7 @@ a fetch to the UI without server support fails here.
 import asyncio
 import re
 
+import httpx
 import pytest
 
 from consul_tpu.agent.agent import AgentConfig
@@ -50,12 +51,9 @@ def agent_http():
 
 
 def _get(base: str, path: str):
-    import json
-    import urllib.request
-    with urllib.request.urlopen(base + path, timeout=10) as r:
-        body = r.read()
-        return r.status, (json.loads(body) if body else None), \
-            r.headers.get("Content-Type", "")
+    r = httpx.get(base + path, timeout=10)
+    return r.status_code, (r.json() if r.content else None), \
+        r.headers.get("Content-Type", "")
 
 
 class TestUIDataContract:
@@ -63,11 +61,8 @@ class TestUIDataContract:
         """Every endpoint pattern app.js fetches answers 200 with JSON."""
         agent, base = agent_http
         # seed KV through the same PUT path the UI's editor uses
-        import urllib.request
-        req = urllib.request.Request(base + "/v1/kv/app/config",
-                                     data=b"x=1", method="PUT")
-        with urllib.request.urlopen(req, timeout=10) as r:
-            assert r.status == 200
+        assert httpx.put(base + "/v1/kv/app/config", content=b"x=1",
+                         timeout=10).status_code == 200
 
         app_js = _read("app.js")
         # Concrete instantiations of every fetch pattern in app.js
@@ -134,10 +129,8 @@ class TestUIRoutingContract:
 
     def test_assets_served_under_ui(self, agent_http):
         _, base = agent_http
-        import urllib.request
         for asset, must_contain in (("/ui/", "<script src=\"app.js\">"),
                                     ("/ui/app.js", "route()"),
                                     ("/ui/style.css", "body")):
-            with urllib.request.urlopen(base + asset, timeout=10) as r:
-                body = r.read().decode()
-            assert must_contain in body, asset
+            r = httpx.get(base + asset, timeout=10)
+            assert r.status_code == 200 and must_contain in r.text, asset
