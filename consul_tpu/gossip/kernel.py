@@ -539,15 +539,24 @@ def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
                  conf_cap) -> jnp.ndarray:
     """One round of rumor push: ``fanout`` circulant-shift deliveries,
     merged per destination with message-priority + Lifeguard
-    confirmation counting.
+    confirmation counting.  Dispatches on ``p.dissem_swar`` (static):
+    the two strategies are bit-identical (tested); the flag exists for
+    an on-chip A/B and a one-line fallback."""
+    if p.dissem_swar:
+        return _disseminate_swar(p, rnd, k_gossip, heard, mf, rx_ok,
+                                 conf_cap)
+    return _disseminate_planes(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap)
 
-    The belief matrix moves as u32 words holding FOUR slot-rows per
+
+def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
+                      conf_cap) -> jnp.ndarray:
+    """The belief matrix moves as u32 words holding FOUR slot-rows per
     element; the whole merge is SWAR on those words — one fused
     elementwise pass that reads the current matrix and the ``fanout``
-    rolled copies once each, instead of the previous per-byte-plane
-    loop that produced four separate [S4, N] outputs (each re-reading
-    every pin).  IO per round drops from ~12 pin reads + 4 plane
-    read/writes to fanout+1 reads + 1 write."""
+    rolled copies once each, instead of the per-byte-plane loop that
+    produces four separate [S4, N] outputs (each re-reading every
+    pin).  IO per round drops from ~12 pin reads + 4 plane read/writes
+    to fanout+1 reads + 1 write."""
     S, N = heard.shape
     S4 = -(-S // 4)
     pad = 4 * S4 - S
@@ -623,6 +632,83 @@ def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
                             for k in range(4)], axis=1)
     return planes_out.reshape(4 * S4, N)[:S].astype(jnp.uint8)
 
+
+
+def _disseminate_planes(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
+                        conf_cap) -> jnp.ndarray:
+    """The round-3 strategy (kept for A/B + fallback, see
+    ``_disseminate``): merge logic runs per byte-plane on native
+    u32 lanes, producing four [S4, N] plane outputs.  Measured
+    155-166 rounds/s at 1M/64-slot churn on the v5e."""
+    S, N = heard.shape
+    S4 = -(-S // 4)
+    pad = 4 * S4 - S
+    h_rows = (jnp.concatenate(
+        [heard, jnp.zeros((pad, N), jnp.uint8)]) if pad else heard)
+    planes = h_rows.reshape(S4, 4, N).astype(jnp.uint32)
+    # Age tick, fused into the packing chain on u32 lanes (the
+    # standalone u8 pass costs a full read+write of the matrix): fresh
+    # probe marks (_AGE_FRESH sentinel) become age 0, real ages
+    # saturate at 14.  See _age_tick for the semantics.
+    msg = planes >> _MSG_SHIFT
+    age = planes & _AGE_MASK
+    new_age = jnp.where(age == _AGE_FRESH, jnp.uint32(0),
+                        jnp.minimum(age + 1, jnp.uint32(_AGE_MASK - 1)))
+    planes = jnp.where(msg > 0,
+                       (planes & ~jnp.uint32(_AGE_MASK)) | new_age, planes)
+    packed = (planes[:, 0] | (planes[:, 1] << 8)
+              | (planes[:, 2] << 16) | (planes[:, 3] << 24))
+
+    offs = gossip_offsets(k_gossip, N, p.fanout)
+    budget = jnp.uint32(p.spread_budget_rounds)
+    pins = []
+    for f in range(p.fanout):
+        # Sender into d is d - o_f: delivery = roll by +o_f (contiguous).
+        o = offs[f]
+        src_ok = jnp.roll(mf, o) > rnd
+        pins.append((jnp.roll(packed, o, axis=1), src_ok))
+
+    cap4 = (jnp.concatenate([conf_cap, jnp.zeros((pad,), jnp.int32)])
+            if pad else conf_cap).reshape(S4, 4).astype(jnp.uint32)
+
+    out_planes = []
+    for k in range(4):
+        in_msg = jnp.zeros((S4, N), jnp.uint32)
+        n_sus_in = jnp.zeros((S4, N), jnp.uint32)
+        for pin, src_ok in pins:
+            bk = (pin >> (8 * k)) & jnp.uint32(0xFF)
+            active = src_ok[None, :] & ((bk & _AGE_MASK) < budget)
+            m = jnp.where(active, bk >> _MSG_SHIFT, jnp.uint32(0))
+            in_msg = jnp.maximum(in_msg, m)
+            n_sus_in = n_sus_in + (m == MSG_SUSPECT).astype(jnp.uint32)
+
+        cur = planes[:, k]                        # [S4, N] u32 bytes
+        cur_msg = cur >> _MSG_SHIFT
+        age = cur & _AGE_MASK
+        conf = (cur >> _CONF_SHIFT) & _CONF_MASK
+        upgraded = (in_msg > cur_msg) & rx_ok[None, :]
+        bump = ((cur_msg == MSG_SUSPECT) & (in_msg == MSG_SUSPECT)
+                & rx_ok[None, :])
+        conf_new = jnp.where(bump,
+                             jnp.minimum(conf + n_sus_in, cap4[:, k][:, None]),
+                             conf)
+        # A suspicion heard at a HIGHER confirmation count is a new
+        # message in memberlist (suspect-from-origin-X re-enqueues with
+        # its own retransmit budget — refmodel.py:197-201): model the
+        # re-broadcast by refreshing the entry's spread window whenever
+        # the local count rises.  Bounded: conf can rise at most
+        # max_confirmations times per observer per episode.  Without
+        # this, confirmations trickle instead of flooding and the
+        # Lifeguard timeout decays late — measured as a 61% p99
+        # detection-latency error at 10k nodes (CROSSVAL.json history).
+        conf_rose = conf_new > conf
+        out_msg = jnp.where(upgraded, in_msg, cur_msg)
+        out_age = jnp.where(upgraded | conf_rose, jnp.uint32(0), age)
+        out_conf = jnp.where(upgraded, jnp.uint32(0), conf_new)
+        out_planes.append(
+            (out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age)
+
+    return jnp.stack(out_planes, axis=1).reshape(4 * S4, N)[:S].astype(jnp.uint8)
 
 def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
                   member, heard_sub, full_heard, idx, slot_node, slot_phase,

@@ -46,15 +46,22 @@ class SwimParams:
     # selected by the reference via the LAN/WAN profiles).
     pushpull_every: int = 0
     # Hot-tier width: rounds with <= this many live episodes process
-    # only the gathered subset of belief rows (kernel._hot_tail).
+    # only the sliced subset of belief rows (kernel._hot_tail).
     # 0 disables the tier (two-way cond: quiescent / full).  Default
-    # OFF: measured on the v5e, the subset pipeline runs ~10x SLOWER
-    # than the full-width tail it replaces (15.7 vs 155 rounds/s at 1M
-    # nodes, 10ppm churn) — the traced-index row subset defeats the
-    # roll/slice lowering the full path gets.  Kept as an explicit knob
-    # because the win is real on backends with cheap dynamic row
-    # gathers; re-measure before enabling.
+    # OFF pending on-chip re-measurement: the round-3 tier (traced-
+    # index row GATHERS, ~6.5ns/element) measured ~10x slower than the
+    # full tail (15.7 vs 155 r/s at 1M, 10ppm churn); the round-4
+    # rework moves rows with per-row dynamic slices at memory
+    # bandwidth instead (profile_kernel.py realistic_churn_* entries
+    # are the decision gate).
     hot_slots: int = 0
+    # Dissemination merge strategy: True = single SWAR pass over the
+    # packed u32 words (round-4 rewrite, ~2.3x less IO by counting);
+    # False = the round-3 per-byte-plane loop (measured 155-166 r/s at
+    # 1M/64-slot churn).  Both are bit-identical; the switch exists so
+    # an on-chip A/B is one flag and a surprise regression on the real
+    # lowering is a one-line revert.
+    dissem_swar: bool = True
 
     # ---- derived, all static ----
 

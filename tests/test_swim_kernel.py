@@ -166,3 +166,23 @@ def test_quiescent_path_is_exact():
     # All slots recycled after the episode: back to quiescent.
     assert int(jnp.sum((st.slot_phase != PHASE_FREE).astype(jnp.int32))) == 0
     assert int(jnp.sum(st.heard)) == 0
+
+
+def test_dissemination_strategies_bit_identical():
+    """dissem_swar is a pure execution-strategy switch: the SWAR merge
+    and the per-byte-plane merge must produce identical state."""
+    import numpy as np
+    fail = np.full(256, NEVER, np.int32)
+    for i in range(4):
+        fail[50 * (i + 1)] = 20 + 9 * i
+    outs = []
+    for swar in (True, False):
+        p = SwimParams(n=256, slots=16, probe_every=5, loss_rate=0.1,
+                       dissem_swar=swar)
+        st, _ = run_rounds(init_state(p), jax.random.key(11),
+                           jnp.asarray(fail), p, 200)
+        outs.append(st)
+    for name in outs[0]._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(outs[0], name)),
+            np.asarray(getattr(outs[1], name)), err_msg=name)
