@@ -13,8 +13,13 @@ backend session, and the payload carries all three plus compile times
 and the dense-regime roofline estimate:
 
     {"metric": ..., "value": N, "unit": "rounds/s", "vs_baseline": N,
-     "regimes": {"healthy": {...}, "churn1000ppm": {...}, "multidc": {...}},
+     "regimes": {"healthy": {...}, "churn1000ppm": {...},
+                 "churn1000ppm_planes": {...}, "multidc": {...}},
      "roofline_rounds_per_sec": N, ...}
+
+(churn1000ppm vs churn1000ppm_planes is the dissemination-strategy A/B
+— params.dissem_swar — so the better lowering is picked from captured
+evidence.)
 
 The headline metric/value is the healthy-cluster regime (the operating
 point for BASELINE's scale posture — see BENCH_NOTES.md §1c for the
@@ -136,6 +141,8 @@ def _setup_jax(retries: int = 5, probe_timeout_s: float = 75.0):
         _log(f"compilation cache unavailable: {e}")
 
     devs = jax.devices()
+    global _PLATFORM
+    _PLATFORM = devs[0].platform
     _log(f"backend up: {len(devs)}x {devs[0].platform} "
          f"({getattr(devs[0], 'device_kind', '?')})")
     return jax
@@ -152,13 +159,13 @@ def _sync(jax, state) -> None:
 
 
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
-               churn_ppm: int = 1000) -> dict:
+               churn_ppm: int = 1000, dissem_swar: bool = True) -> dict:
     import jax.numpy as jnp
 
     from consul_tpu.gossip.kernel import init_state, run_rounds
     from consul_tpu.gossip.params import lan_profile
 
-    p = lan_profile(n, slots=slots)
+    p = lan_profile(n, slots=slots, dissem_swar=dissem_swar)
     state = init_state(p)
     key = jax.random.PRNGKey(42)
     # Steady-state failure churn (default 0.1% of nodes, staggered over
@@ -197,12 +204,14 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
     rps = steps / best
     return {
         "metric": (f"swim_gossip_rounds_per_sec_{n}_nodes"
-                   + ("" if churn_ppm == 1000 else f"_churn{churn_ppm}ppm")),
+                   + ("" if churn_ppm == 1000 else f"_churn{churn_ppm}ppm")
+                   + ("" if dissem_swar else "_planes")),
         "value": round(rps, 1),
         "unit": "rounds/s",
         "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 3),
         "compile_s": round(compile_s, 1),
         "n_nodes": n,
+        "dissem": "swar" if dissem_swar else "planes",
     }
 
 
@@ -264,29 +273,33 @@ _LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".bench_last_success.json")
 
 # Metric-name shape: swim_{gossip|multidc}_rounds_per_sec_{n}_nodes
-# [+ "_churn{ppm}ppm" for non-default churn | "_{d}dc" for multidc].
+# [+ "_churn{ppm}ppm" for non-default churn | "_{d}dc" for multidc]
+# [+ "_planes" for the fallback dissemination strategy].
 _METRIC_RE = re.compile(
     r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
-    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?$")
+    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(_planes)?$")
 
 
-def _regime_key(multidc: bool, churn_ppm: int) -> tuple:
-    """Cache-matching key: bench variant + churn regime, size-agnostic.
-    The default LAN run (churn 1000 ppm) historically has NO suffix, so
-    the regime must be recovered from the parsed name, not the string
-    prefix — a churn-0 quiescent entry is ~10x the churned number and
-    must never stand in for it."""
+def _regime_key(multidc: bool, churn_ppm: int,
+                planes: bool = False) -> tuple:
+    """Cache-matching key: bench variant + churn regime + dissemination
+    strategy, size-agnostic.  The default LAN run (churn 1000 ppm) has
+    NO suffix historically, so the regime must be recovered from the
+    parsed name, not a string prefix — a churn-0 quiescent entry is
+    ~10x the churned number and must never stand in for it."""
     return ("multidc" if multidc else "gossip",
-            None if multidc else churn_ppm)
+            None if multidc else churn_ppm, planes)
 
 
 def _parse_metric_regime(name: str) -> tuple | None:
+    name = name.rpartition(":")[2]  # strip a non-chip platform prefix
     m = _METRIC_RE.match(name)
     if not m:
         return None
     variant = m.group(1)
     churn = int(m.group(3)) if m.group(3) is not None else 1000
-    return (variant, None if variant == "multidc" else churn)
+    return (variant, None if variant == "multidc" else churn,
+            m.group(5) is not None)
 
 
 def _read_cache() -> dict:
@@ -300,29 +313,43 @@ def _read_cache() -> dict:
     return cache
 
 
-def _read_last_good(multidc: bool, churn_ppm: int) -> dict | None:
-    """Last cached measurement of this exact regime (variant + churn),
-    preferring the largest n.  A corrupt cache must never take down the
-    metric emit."""
-    want = _regime_key(multidc, churn_ppm)
-    candidates = [v for k, v in _read_cache().items()
-                  if isinstance(v, dict) and _parse_metric_regime(k) == want]
+_PLATFORM = "unknown"  # set by _setup_jax; tags every cached result
+
+
+def _read_last_good(multidc: bool, churn_ppm: int,
+                    planes: bool = False) -> dict | None:
+    """Last cached measurement of this exact regime (variant + churn +
+    strategy) ON THIS BACKEND PLATFORM, preferring the largest n.  A
+    CPU smoke run must never stand in for a chip measurement (or vice
+    versa); untagged legacy entries are from the chip.  A corrupt cache
+    must never take down the metric emit."""
+    want = _regime_key(multidc, churn_ppm, planes)
+    candidates = [
+        v for k, v in _read_cache().items()
+        if isinstance(v, dict) and _parse_metric_regime(k) == want
+        and v.get("platform", "axon") == _PLATFORM]
     if not candidates:
         return None
     return max(candidates, key=lambda v: v.get("n_nodes", 0))
 
 
 def _store_result(result: dict) -> None:
+    """Cache keyed by (platform, metric): a smoke run on another
+    backend never displaces the chip's last-known-good."""
     try:
         cache = _read_cache()
-        cache[result["metric"]] = {**result, "measured_unix": int(time.time())}
+        key = (result["metric"] if _PLATFORM in ("axon", "tpu")
+               else f"{_PLATFORM}:{result['metric']}")
+        cache[key] = {**result, "platform": _PLATFORM,
+                      "measured_unix": int(time.time())}
         with open(_LAST_PATH, "w") as f:
             json.dump(cache, f)
     except OSError:
         pass
 
 
-def _run_regime(jax, args, *, multidc: bool, churn_ppm: int) -> dict:
+def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
+                dissem_swar: bool = True) -> dict:
     """One regime with reduced-N fallback.  Returns a result dict; on
     total failure returns an error dict carrying the regime-matched
     last-known-good."""
@@ -335,7 +362,8 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int) -> dict:
                                         args.steps, args.repeats)
             else:
                 result = _bench_lan(jax, n, args.slots, args.steps,
-                                    args.repeats, churn_ppm=churn_ppm)
+                                    args.repeats, churn_ppm=churn_ppm,
+                                    dissem_swar=dissem_swar)
             if n != args.n:
                 result["reduced_from_n"] = args.n
             _store_result(result)
@@ -352,7 +380,7 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int) -> dict:
                "vs_baseline": 0.0,
                "error": f"all sizes failed; last: "
                         f"{type(last_err).__name__}: {last_err}"}
-    last = _read_last_good(multidc, churn_ppm)
+    last = _read_last_good(multidc, churn_ppm, not dissem_swar)
     if last is not None:
         payload["last_known_good"] = last
     return payload
@@ -378,6 +406,9 @@ def main() -> None:
     ap.add_argument("--churn-ppm", type=int, default=None,
                     help="single regime: failing nodes per million; 0 = "
                          "healthy-cluster (quiescent fast path)")
+    ap.add_argument("--dissem", choices=("swar", "planes"), default="swar",
+                    help="dissemination strategy for single-regime runs "
+                         "(the table always measures both)")
     args = ap.parse_args()
 
     single_regime = args.multidc or args.churn_ppm is not None
@@ -404,7 +435,8 @@ def main() -> None:
 
     if single_regime:
         churn = args.churn_ppm if args.churn_ppm is not None else 1000
-        _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn))
+        _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn,
+                          dissem_swar=args.dissem == "swar"))
         return
 
     # -- default: the full regime table, one JSON line -------------------
@@ -412,6 +444,11 @@ def main() -> None:
     regimes["healthy"] = _run_regime(jax, args, multidc=False, churn_ppm=0)
     regimes["churn1000ppm"] = _run_regime(jax, args, multidc=False,
                                           churn_ppm=1000)
+    # Dissemination-strategy A/B in the stress regime: the table
+    # records both so the better lowering is picked from evidence
+    # (params.dissem_swar), not hope.
+    regimes["churn1000ppm_planes"] = _run_regime(
+        jax, args, multidc=False, churn_ppm=1000, dissem_swar=False)
     regimes["multidc"] = _run_regime(jax, args, multidc=True, churn_ppm=0)
 
     headline = regimes["healthy"]

@@ -101,6 +101,14 @@ def main():
     f_scan = make_timed(lambda st: run_rounds(st, key, fail, p, steps=64)[0])
     results["round_amortized_64"] = timed(f_scan, state, iters=2, warmup=1) / 64
 
+    # -- dissemination-strategy A/B: SWAR single-pass (default) vs the
+    # round-3 per-byte-plane loop (params.dissem_swar) -------------------
+    p_planes = lan_profile(n, slots=S, dissem_swar=False)
+    f_scan_pl = make_timed(
+        lambda st: run_rounds(st, key, fail, p_planes, steps=64)[0])
+    results["round_amortized_64_planes"] = timed(
+        f_scan_pl, state, iters=2, warmup=1) / 64
+
     # -- realistic-churn regime: 1-2 live episodes (vs the bench's 64
     # saturated slots), full tail vs the hot tier's sliced-row subset
     # pipeline.  This is the measurement VERDICT r3 asked for before
