@@ -104,6 +104,7 @@ class GossipPlane:
         self._declared_dead: Set[int] = set()
         self._event_ltime = 0
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()   # every live bridge connection's writer
         self._tick_task: Optional[asyncio.Task] = None
         self._started = False
         # kernel session state, created in start() (jax import deferred)
@@ -191,15 +192,18 @@ class GossipPlane:
                 await self._tick_task
             except (asyncio.CancelledError, Exception):
                 pass
+        # Close every live connection BEFORE wait_closed(): since
+        # Python 3.12.1 Server.wait_closed() waits for active handlers,
+        # and agents' native heartbeat threads keep their sockets open
+        # indefinitely — stop() would hang forever otherwise.
+        for writer in list(self._conns):
+            try:
+                writer.close()
+            except Exception:
+                pass
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
-        for node in list(self._nodes_by_id.values()):
-            if node.writer is not None:
-                try:
-                    node.writer.close()
-                except Exception:
-                    pass
 
     # -- kernel session ----------------------------------------------------
 
@@ -346,6 +350,15 @@ class GossipPlane:
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
         me: Optional[PlaneNode] = None
+        if not self._started:
+            # Accepted in the closing window: stop() snapshotted _conns
+            # before this task ran — bail so wait_closed() can finish.
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
+        self._conns.add(writer)
         try:
             while True:
                 hdr = await reader.readexactly(4)
@@ -397,6 +410,7 @@ class GossipPlane:
         finally:
             # Socket loss is NOT a leave: the kernel's failure detector
             # owns that verdict (heartbeats just stop arriving).
+            self._conns.discard(writer)
             if me is not None and me.writer is writer:
                 me.writer = None
             try:

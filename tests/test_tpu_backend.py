@@ -402,3 +402,48 @@ def test_mixed_backend_cross_dc_federation(loop):
                     await a.stop()
             await plane.stop()
     loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_plane_restart_resyncs_agents(loop):
+    """The plane daemon dying is a control-plane outage, not a cluster
+    death: agents keep running, redial the rendezvous, re-register, and
+    the welcome snapshot resyncs their membership view."""
+    async def body():
+        cfg = PlaneConfig(bind_port=0, capacity=16, slots=16,
+                          gossip_interval_s=0.02, suspicion_mult=1.0,
+                          hb_lapse_s=0.3)
+        plane = GossipPlane(cfg)
+        await plane.start()
+        port = plane.local_addr[1]
+        addr = f"127.0.0.1:{port}"
+        pools = {}
+        try:
+            for name in ("a", "b"):
+                pools[name] = TpuSerfPool(_fast_cfg(name),
+                                          plane_addr=addr)
+                await pools[name].start()
+            assert await _wait(lambda: len(pools["a"].members()) == 2)
+            # plane goes down hard...
+            await plane.stop()
+            await asyncio.sleep(0.3)
+            # ...and a fresh one comes up on the same rendezvous port
+            cfg2 = PlaneConfig(bind_port=port, capacity=16, slots=16,
+                               gossip_interval_s=0.02, suspicion_mult=1.0,
+                               hb_lapse_s=0.3)
+            plane = GossipPlane(cfg2)
+            await plane.start()
+            # both agents redial, re-register, and see each other again
+            assert await _wait(
+                lambda: {n.name for n in pools["a"].alive_members()}
+                == {"a", "b"}
+                and {n.name for n in pools["b"].alive_members()}
+                == {"a", "b"}, timeout=30.0), \
+                {n: [m.name for m in p.alive_members()]
+                 for n, p in pools.items()}
+        finally:
+            for pool in pools.values():
+                await pool.stop()
+            await plane.stop()
+    loop.run_until_complete(body())
