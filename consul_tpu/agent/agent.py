@@ -471,6 +471,32 @@ class Agent:
             "Tags": dict(n.tags),
         }
 
+    def gossip_stats(self) -> Dict[str, Dict[str, str]]:
+        """`consul info` serf sections (serf.Stats() role): member
+        counts per state and which membership substrate is serving the
+        LAN pool (the graft's observability hook)."""
+        out: Dict[str, Dict[str, str]] = {}
+
+        def _pool_stats(pool) -> Dict[str, str]:
+            members = pool.members()
+            by_state: Dict[str, int] = {}
+            for n in members:
+                by_state[n.state] = by_state.get(n.state, 0) + 1
+            return {"members": str(len(members)),
+                    "alive": str(by_state.get("alive", 0)),
+                    "failed": str(by_state.get("dead", 0)),
+                    "left": str(by_state.get("left", 0)),
+                    "event_time": str(getattr(pool, "event_ltime", 0))}
+
+        if self.lan_pool is not None:
+            out["serf_lan"] = {
+                **_pool_stats(self.lan_pool),
+                "backend": self.config.gossip_backend,
+            }
+        if self.wan_pool is not None:
+            out["serf_wan"] = _pool_stats(self.wan_pool)
+        return out
+
     def lan_members(self) -> List[Dict[str, Any]]:
         if self.lan_pool is not None:
             return [self._member_wire(n, 8301)

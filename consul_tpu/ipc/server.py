@@ -138,7 +138,9 @@ class _Conn:
         self._send({"Seq": seq, "Error": ""}, {"Members": members})
 
     async def _cmd_stats(self, seq: int) -> None:
-        self._send({"Seq": seq, "Error": ""}, self.agent.server.stats())
+        stats = dict(self.agent.server.stats())
+        stats.update(self.agent.gossip_stats())
+        self._send({"Seq": seq, "Error": ""}, stats)
 
     async def _cmd_leave(self, seq: int) -> None:
         self._send({"Seq": seq, "Error": ""})
