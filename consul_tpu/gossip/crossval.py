@@ -168,3 +168,101 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
                                       p.suspicion_max_rounds],
         "wall_s": {"kernel": round(t_kernel, 1), "refmodel": round(t_ref, 1)},
     }
+
+
+# -- event convergence (BASELINE config #3: "event convergence
+# statistics match Serf") ---------------------------------------------------
+
+
+def event_oracle_curve(n: int, fanout: int, budget: int, steps: int,
+                       seed: int) -> np.ndarray:
+    """Per-node discrete-event flood with stock-gossip semantics: every
+    node that has the event pushes it to ``fanout`` UNIFORM random
+    peers per round while its copy's age is within the transmit budget
+    (iid targets — the behavior the kernel approximates with per-round
+    circulant shifts).  Returns the coverage fraction per round [T]."""
+    rng = np.random.default_rng(seed)
+    receipt = np.full(n, -1, np.int64)
+    receipt[rng.integers(n)] = 0  # origin fired before round 1
+    out = np.empty(steps, np.float64)
+    for t in range(1, steps + 1):
+        senders = np.nonzero((receipt >= 0) & (t - 1 - receipt < budget))[0]
+        if senders.size:
+            tgt = rng.integers(0, n - 1, size=(senders.size, fanout))
+            # shift to skip self (uniform over the other n-1 nodes)
+            tgt = tgt + (tgt >= senders[:, None])
+            fresh = tgt[receipt[tgt] < 0]
+            receipt[fresh] = t
+        out[t - 1] = np.count_nonzero(receipt >= 0) / n
+    return out
+
+
+def kernel_event_curve(p, steps: int, seed: int) -> np.ndarray:
+    """Coverage curve [T] of one kernel-flooded event (slot 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.events import (fire_events, init_events,
+                                          run_event_rounds)
+
+    st = init_events(p, slots=4)
+    origin = int(jax.random.randint(jax.random.key(seed ^ 0x5EED), (),
+                                    0, p.n))
+    st = fire_events(st, jnp.asarray([origin], jnp.int32))
+    alive = jnp.ones((p.n,), bool)
+    _, cov = run_event_rounds(st, jax.random.key(seed), alive, p, steps)
+    return np.asarray(cov)[:, 0]
+
+
+def _rounds_to(curve: np.ndarray, frac: float) -> float:
+    hit = np.nonzero(curve >= frac)[0]
+    return float(hit[0] + 1) if hit.size else float("inf")
+
+
+def run_event_config(n: int, seeds: int) -> dict:
+    """Event-convergence comparison: kernel circulant flood vs the
+    iid-target oracle.  Statistics: rounds to 50% / 99% coverage."""
+    from consul_tpu.gossip.params import SwimParams
+    p = SwimParams(n=n, slots=4, pushpull_every=0)
+    budget = p.spread_budget_rounds
+    # Flood completes in O(log_fanout n) + budget tail; 8x margin.
+    steps = int(8 * (np.log(max(n, 2)) / np.log(p.fanout + 1) + budget))
+
+    t0 = time.time()
+    k50, k99, r50, r99 = [], [], [], []
+    for s in range(seeds):
+        kc = kernel_event_curve(p, steps, seed=s)
+        k50.append(_rounds_to(kc, 0.5))
+        k99.append(_rounds_to(kc, 0.99))
+    t_kernel = time.time() - t0
+    t0 = time.time()
+    for s in range(seeds):
+        oc = event_oracle_curve(n, p.fanout, budget, steps, seed=1000 + s)
+        r50.append(_rounds_to(oc, 0.5))
+        r99.append(_rounds_to(oc, 0.99))
+    t_ref = time.time() - t0
+
+    def m(a):
+        a = [x for x in a if np.isfinite(x)]
+        return round(float(np.mean(a)), 2) if a else None
+
+    def rel(kv, rv):
+        if kv is None or rv is None or not rv:
+            return None
+        return round(abs(kv - rv) / rv, 4)
+
+    out = {
+        "n": n,
+        "seeds": seeds,
+        "fanout": p.fanout,
+        "transmit_budget_rounds": budget,
+        "completed": {"kernel": int(np.sum(np.isfinite(k99))),
+                      "oracle": int(np.sum(np.isfinite(r99)))},
+        "rounds_to_50pct": {"kernel": m(k50), "oracle": m(r50),
+                            "relative_error": rel(m(k50), m(r50))},
+        "rounds_to_99pct": {"kernel": m(k99), "oracle": m(r99),
+                            "relative_error": rel(m(k99), m(r99))},
+        "wall_s": {"kernel": round(t_kernel, 1),
+                   "oracle": round(t_ref, 1)},
+    }
+    return out

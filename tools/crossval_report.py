@@ -26,7 +26,7 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
-from consul_tpu.gossip.crossval import run_config  # noqa: E402
+from consul_tpu.gossip.crossval import run_config, run_event_config  # noqa: E402
 
 
 def main() -> None:
@@ -66,6 +66,14 @@ def main() -> None:
     report["configs"].append(run_config(500, max(4, victims // 2),
                                         max(2, seeds // 4), loss=0.25))
     _flush()
+    # BASELINE config #3's other half: event-convergence statistics
+    # (rounds to 50%/99% coverage) vs the iid-target flood oracle.
+    report["event_convergence"] = []
+    for n in (1000, 10000):
+        print(f"[crossval] events n={n} ...", file=sys.stderr, flush=True)
+        report["event_convergence"].append(
+            run_event_config(n, max(4, seeds // 2)))
+        _flush()
     print(json.dumps(report, indent=1))
 
 
