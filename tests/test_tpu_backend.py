@@ -447,3 +447,38 @@ def test_plane_restart_resyncs_agents(loop):
                 await pool.stop()
             await plane.stop()
     loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_tpu_force_leave_reaps_failed_node(loop):
+    """serf force-leave semantics on the plane: a FAILED node is moved
+    to left (reaped) on request — and an alive node cannot be
+    force-left (the op only acts on failed members, like
+    RemoveFailedNode, consul/server.go:624-632)."""
+    async def body():
+        c = Cluster("tpu")
+        try:
+            await c.start(["a", "b", "c"])
+            assert await _wait(
+                lambda: len(c.pools["a"].alive_members()) == 3)
+            # force-leave on an ALIVE node is a no-op
+            assert c.pools["a"].force_leave("b")
+            await asyncio.sleep(0.5)
+            assert c.member_states("a").get("b") == STATE_ALIVE
+            # kill c, wait for the kernel's dead verdict...
+            await c.kill("c")
+            assert await _wait(lambda: any(
+                k == EV_FAILED and n.name == "c"
+                for k, n in c.events["a"]), timeout=30.0)
+            # ...then force-leave reaps it: EV_LEAVE + gone from members
+            assert c.pools["a"].force_leave("c")
+            assert await _wait(lambda: any(
+                k == EV_LEAVE and n.name == "c"
+                for k, n in c.events["a"])), \
+                [k for k, _ in c.events["a"]]
+            assert await _wait(
+                lambda: "c" not in c.member_states("a"))
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
