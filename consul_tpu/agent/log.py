@@ -67,3 +67,45 @@ class LogHub:
 
     def remove_sink(self, sink: Callable[[str], None]) -> None:
         self._sinks = [(s, l) for s, l in self._sinks if s is not sink]
+
+
+# -- syslog sink (command/agent/syslog.go + logutils wiring,
+# command/agent/command.go:257-297) -----------------------------------------
+
+_FACILITIES = {
+    "KERN": 0, "USER": 1, "MAIL": 2, "DAEMON": 3, "AUTH": 4, "SYSLOG": 5,
+    "LPR": 6, "NEWS": 7, "UUCP": 8, "CRON": 9, "AUTHPRIV": 10, "FTP": 11,
+    "LOCAL0": 16, "LOCAL1": 17, "LOCAL2": 18, "LOCAL3": 19, "LOCAL4": 20,
+    "LOCAL5": 21, "LOCAL6": 22, "LOCAL7": 23,
+}
+_SEVERITY = {0: 7, 1: 7, 2: 6, 3: 4, 4: 3}  # LEVELS idx -> syslog severity
+
+
+def syslog_sink(facility: str = "LOCAL0",
+                tag: str = "consul-tpu") -> Callable[[str], None]:
+    """A LogHub sink writing RFC3164 datagrams to /dev/log (the
+    gsyslog-role of the reference's -syslog support).  Raises OSError
+    when no local syslog socket exists — the caller decides whether
+    that is fatal (the reference retries 5x then dies,
+    command.go:272-281)."""
+    import socket
+
+    fac = _FACILITIES.get(facility.upper(), 16)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    sock.connect("/dev/log")  # raises if unavailable
+
+    def sink(line: str) -> None:
+        # line = "Y/m/d H:M:S [LEVEL] msg"; recover the level for PRI
+        lvl = 2
+        l = line.find("[")
+        r = line.find("]", l + 1)
+        if 0 <= l < r:
+            lvl = LEVELS.get(line[l + 1:r], 2)
+        pri = fac * 8 + _SEVERITY.get(lvl, 6)
+        msg = line[r + 2:] if 0 <= l < r else line
+        try:
+            sock.send(f"<{pri}>{tag}: {msg}".encode())
+        except OSError:
+            pass  # syslog going away must not take the agent down
+
+    return sink

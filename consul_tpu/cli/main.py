@@ -104,6 +104,18 @@ def cmd_agent(args) -> int:
 
     agent = Agent(acfg)
 
+    if cfg.enable_syslog:
+        # -syslog (command.go:272-281): fatal when the local syslog
+        # socket cannot be opened, exactly like the reference after its
+        # retries.
+        from consul_tpu.agent.log import syslog_sink
+        try:
+            agent.log.add_sink(syslog_sink(cfg.syslog_facility),
+                               level=cfg.log_level, replay=False)
+        except OSError as e:
+            print(f"==> Syslog setup failed: {e}", file=sys.stderr)
+            return 1
+
     # Telemetry sinks + SIGUSR1 dump (command.go:569-605): the inmem
     # sink is always on; statsd/statsite attach from the config block.
     from consul_tpu.utils.telemetry import metrics

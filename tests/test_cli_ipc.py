@@ -201,3 +201,45 @@ class TestWatchCLI:
         finally:
             proc.terminate()
             proc.wait(5)
+
+
+class TestSyslogSink:
+    def test_syslog_sink_formats_pri_and_strips_stamp(self, tmp_path):
+        """RFC3164 datagrams: facility*8+severity PRI, tag prefix, level
+        recovered from the hub's line format (syslog.go role).  Served
+        by a local AF_UNIX datagram socket standing in for /dev/log."""
+        import socket
+        from unittest import mock
+
+        from consul_tpu.agent.log import LogHub, syslog_sink
+
+        path = str(tmp_path / "log.sock")
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        srv.bind(path)
+        srv.settimeout(5)
+        real_connect = socket.socket.connect
+        with mock.patch.object(
+                socket.socket, "connect",
+                lambda self, addr: real_connect(
+                    self, path if addr == "/dev/log" else addr)):
+            sink = syslog_sink("LOCAL1", tag="test-agent")
+        hub = LogHub("INFO")
+        hub.add_sink(sink, level="INFO", replay=False)
+        hub.warn("disk almost full")
+        data = srv.recv(4096).decode()
+        srv.close()
+        # LOCAL1=17, WARN severity=4 -> PRI 17*8+4 = 140
+        assert data.startswith("<140>test-agent: "), data
+        assert data.endswith("disk almost full"), data
+        assert "[WARN]" not in data  # stamp/level prefix stripped
+
+    def test_syslog_unavailable_raises(self):
+        import socket
+        from unittest import mock
+
+        from consul_tpu.agent.log import syslog_sink
+        with mock.patch.object(socket.socket, "connect",
+                               side_effect=OSError("no /dev/log")):
+            import pytest as _pytest
+            with _pytest.raises(OSError):
+                syslog_sink()
