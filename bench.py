@@ -363,7 +363,9 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
     last-known-good."""
     n = args.n
     last_err: Exception | None = None
-    while n >= MIN_FALLBACK_N:
+    first = True
+    while first or n >= MIN_FALLBACK_N:
+        first = False
         try:
             if multidc:
                 result = _bench_multidc(jax, n, args.dcs, args.slots,
@@ -452,8 +454,8 @@ def main() -> None:
             }
             payload["regimes_last_known_good"] = {
                 k: v for k, v in lkg.items() if v is not None}
-            if lkg["healthy"] is not None:  # the table's headline regime
-                payload["last_known_good"] = lkg["healthy"]
+            if lkg["churn1000ppm"] is not None:  # the headline regime
+                payload["last_known_good"] = lkg["churn1000ppm"]
         _emit(payload)
         return
 
@@ -475,7 +477,11 @@ def main() -> None:
         jax, args, multidc=False, churn_ppm=1000, dissem_swar=False)
     regimes["multidc"] = _run_regime(jax, args, multidc=True, churn_ppm=0)
 
-    headline = regimes["healthy"]
+    # The historical churn regime stays the headline so cross-round
+    # comparisons (and vs_baseline against the 10k target) remain
+    # apples-to-apples; the regimes dict carries the healthy/multidc
+    # numbers alongside.
+    headline = regimes["churn1000ppm"]
     payload = {
         "metric": headline.get("metric", "swim_gossip_rounds_per_sec"),
         "value": headline.get("value", 0.0),

@@ -478,14 +478,19 @@ class Agent:
         out: Dict[str, Dict[str, str]] = {}
 
         def _pool_stats(pool) -> Dict[str, str]:
+            # Keyed by the Node.state constants (STATE_DEAD is "failed",
+            # not "dead" — a literal lookup here once made `consul info`
+            # report failed=0 during an outage).
+            from consul_tpu.membership.swim import (STATE_ALIVE,
+                                                    STATE_DEAD, STATE_LEFT)
             members = pool.members()
             by_state: Dict[str, int] = {}
             for n in members:
                 by_state[n.state] = by_state.get(n.state, 0) + 1
             return {"members": str(len(members)),
-                    "alive": str(by_state.get("alive", 0)),
-                    "failed": str(by_state.get("dead", 0)),
-                    "left": str(by_state.get("left", 0)),
+                    "alive": str(by_state.get(STATE_ALIVE, 0)),
+                    "failed": str(by_state.get(STATE_DEAD, 0)),
+                    "left": str(by_state.get(STATE_LEFT, 0)),
                     "event_time": str(getattr(pool, "event_ltime", 0))}
 
         if self.lan_pool is not None:

@@ -239,12 +239,20 @@ def cmd_gossipd(args) -> int:
 
     from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
 
+    keys = list(args.encrypt)
+    if args.keyring_file:
+        # Keyring parses AND validates the serf keyring file format —
+        # a malformed file must fail loudly here, not arm the plane
+        # with garbage keys that refuse every agent.
+        from consul_tpu.agent.keyring import Keyring
+        keys.extend(k for k in Keyring(path=args.keyring_file).list_keys()
+                    if k not in keys)
     cfg = PlaneConfig(
         bind_addr=args.bind, bind_port=args.port, unix_path=args.unix,
         capacity=args.capacity, sim_nodes=args.sim_nodes,
         gossip_interval_s=args.gossip_interval,
         hb_lapse_s=args.hb_lapse, suspicion_mult=args.suspicion_mult,
-        slots=args.slots)
+        slots=args.slots, encrypt_keys=keys)
 
     async def serve() -> None:
         plane = GossipPlane(cfg)
@@ -618,6 +626,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-suspicion-mult", dest="suspicion_mult", type=float,
                    default=4.0)
     p.add_argument("-slots", type=int, default=64)
+    p.add_argument("-encrypt", action="append", default=[],
+                   help="gossip key (base64); registrations must carry "
+                        "a keyring HMAC proof (repeatable for rotation)")
+    p.add_argument("-keyring-file", dest="keyring_file", default="",
+                   help="load accepted keys from a serf keyring file")
     p.set_defaults(fn=cmd_gossipd)
 
     p = sub.add_parser("configtest", help="Validates config files/dirs")

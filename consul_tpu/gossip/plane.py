@@ -41,6 +41,9 @@ reference's two-pool topology.
 from __future__ import annotations
 
 import asyncio
+import base64
+import hashlib
+import hmac
 import os
 import struct
 import time
@@ -76,6 +79,19 @@ class PlaneConfig:
     # period the plane hands to clients is lapse/3.
     hb_lapse_s: float = 2.0
     slots: int = 64
+    # Gossip keyring (base64 keys, same format as the agents' encrypt
+    # key).  Non-empty => registration requires an HMAC proof derived
+    # from an installed key (registration_proof) — the plane-side
+    # counterpart of serf enforcing the keyring on the gossip fabric:
+    # without it any process that can reach the plane port could
+    # register nodes, inject events, or force-leave members.
+    encrypt_keys: List[str] = field(default_factory=list)
+    auth_skew_s: float = 30.0      # accepted |now - auth_ts| window
+    # Left-name tombstone window: a "left" PlaneNode stays listed (serf
+    # tombstone parity) until reaped — without a reap, node-name churn
+    # grows the member list and welcome snapshots without bound.
+    # Matches serf's TombstoneTimeout default (24h).
+    tombstone_timeout_s: float = 24 * 3600.0
 
 
 @dataclass
@@ -91,6 +107,28 @@ class PlaneNode:
     writer: Optional[asyncio.StreamWriter] = None
     # lifecycle the AGENTS should believe (derived from kernel verdicts)
     status: str = "alive"          # alive | failed | left
+    left_at: float = 0.0           # monotonic time the node went "left"
+
+
+def registration_proof(key_b64: str, name: str, addr: str, port: int,
+                       ts: int, nonce: bytes,
+                       tags: Optional[Dict[str, str]] = None) -> bytes:
+    """HMAC proof binding a registration to the gossip keyring.
+
+    Shared by the plane (verify) and TpuSerfPool (prove): the agents'
+    ``encrypt`` gossip key doubles as the plane admission secret, so
+    the security posture does not silently downgrade when
+    ``gossip_backend=tpu`` replaces the encrypted serf fabric
+    (reference: serf rejects plaintext when a keyring is armed).
+    The MAC covers every register field — including tags, which carry
+    role/dc routing decisions — so no field is forgeable."""
+    tag_blob = b"&".join(
+        f"{k}={v}".encode() for k, v in sorted((tags or {}).items()))
+    msg = b"|".join((b"consul-tpu-plane-register", name.encode(),
+                     addr.encode(), str(int(port)).encode(),
+                     str(int(ts)).encode(), nonce, tag_blob))
+    return hmac.new(base64.b64decode(key_b64), msg,
+                    hashlib.sha256).digest()
 
 
 class GossipPlane:
@@ -98,6 +136,7 @@ class GossipPlane:
 
     def __init__(self, config: Optional[PlaneConfig] = None) -> None:
         self.config = config or PlaneConfig()
+        self._seen_nonces: Dict[tuple, float] = {}  # (ts, nonce) -> expiry
         self._nodes_by_name: Dict[str, PlaneNode] = {}
         self._nodes_by_id: Dict[int, PlaneNode] = {}
         self._free_ids: List[int] = []
@@ -153,6 +192,12 @@ class GossipPlane:
         self._key = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "big"))
         self._fail = np.full((n,), int(NEVER), np.int32)
         self._free_ids = list(range(c.capacity - 1, -1, -1))
+        # Vectorized lapse bookkeeping (O(capacity) numpy per tick, not
+        # an O(capacity) Python loop): heartbeat times + lifecycle masks
+        # indexed by node id.
+        self._hb_at = np.zeros((c.capacity,), np.float64)
+        self._eligible = np.zeros((c.capacity,), bool)  # registered, not left
+        self._alive_mask = np.zeros((c.capacity,), bool)  # status == alive
         # Pre-compile the dispatch shape before serving: the first jit
         # compile takes seconds-to-minutes and must not stall the event
         # loop (a stalled plane cannot ingest heartbeats, which would
@@ -228,6 +273,7 @@ class GossipPlane:
             await asyncio.sleep(interval * STEPS_PER_TICK / 2)
             try:
                 self._mark_lapsed()
+                self._reap_tombstones()
                 burst = 0
                 while self._due_rounds() >= STEPS_PER_TICK:
                     self._dispatch()
@@ -248,22 +294,32 @@ class GossipPlane:
     def _mark_lapsed(self) -> None:
         """Heartbeat lapse -> the node starts failing kernel probes (the
         physical probe-loss signal); resumed heartbeat -> it answers
-        again (the kernel's refutation path takes it from there)."""
+        again (the kernel's refutation path takes it from there).
+        Pure numpy over the id-indexed arrays: stays cheap at hundreds
+        of live agents and tens-of-thousands capacity."""
         now = time.monotonic()
         rnd = self._rounds_done
         from consul_tpu.gossip.kernel import NEVER
-        for node in self._nodes_by_id.values():
-            if node.status == "left":
-                continue
-            lapsed = (now - node.last_hb) > self.config.hb_lapse_s
-            failing = self._fail[node.id] != int(NEVER)
-            if lapsed and not failing:
-                self._fail[node.id] = rnd
-            elif not lapsed and failing and node.status == "alive":
-                # back before any verdict: stop failing probes; an
-                # active suspicion episode resolves by on-device
-                # refutation (incarnation bump)
-                self._fail[node.id] = int(NEVER)
+        cap = self.config.capacity
+        real = self._fail[:cap]
+        lapsed = (now - self._hb_at) > self.config.hb_lapse_s
+        failing = real != int(NEVER)
+        real[self._eligible & lapsed & ~failing] = rnd
+        # back before any verdict: stop failing probes; an active
+        # suspicion episode resolves by on-device refutation
+        # (incarnation bump)
+        real[self._eligible & self._alive_mask & ~lapsed & failing] = \
+            int(NEVER)
+
+    def _reap_tombstones(self) -> None:
+        """Drop "left" names whose tombstone window expired (serf's
+        tombstone reap): without this, node-name churn grows the member
+        list and every welcome snapshot without bound."""
+        cutoff = time.monotonic() - self.config.tombstone_timeout_s
+        for name in [n for n, node in self._nodes_by_name.items()
+                     if node.status == "left" and node.id < 0
+                     and node.left_at < cutoff]:
+            del self._nodes_by_name[name]
 
     def _dispatch(self) -> None:
         """Advance the kernel by STEPS_PER_TICK rounds and fan out the
@@ -291,6 +347,7 @@ class GossipPlane:
                 continue
             self._declared_dead.add(node.id)
             node.status = "failed"
+            self._alive_mask[node.id] = False
             self._broadcast_member_event(EV_FAILED, node)
 
     # -- registration / membership ops ------------------------------------
@@ -316,9 +373,14 @@ class GossipPlane:
         self._declared_dead.discard(i)
         node.status = "alive"
         node.last_hb = time.monotonic()
+        self._hb_at[i] = node.last_hb
+        self._eligible[i] = True
+        self._alive_mask[i] = True
 
     def _evict(self, node: PlaneNode, status: str) -> None:
         i = node.id
+        self._eligible[i] = False
+        self._alive_mask[i] = False
         st = self._state
         st = st._replace(member=st.member.at[i].set(False))
         slot = int(st.slot_of_node[i])
@@ -341,6 +403,7 @@ class GossipPlane:
             self._nodes_by_id.pop(i, None)
             self._free_ids.append(i)
             node.id = -1
+            node.left_at = time.monotonic()
 
     def members_wire(self) -> List[Dict[str, Any]]:
         return [self._member_wire(n) for n in self._nodes_by_name.values()]
@@ -368,15 +431,16 @@ class GossipPlane:
                 m = msgpack.unpackb(await reader.readexactly(ln), raw=False)
                 t = m.get("t")
                 if t == "register":
-                    me = self._register(m, writer)
+                    me, refuse = self._register(m, writer)
                     if me is None:
-                        self._send(writer, {"t": "err",
-                                            "error": "plane full or name taken"})
+                        self._send(writer, {"t": "err", "error": refuse})
                         break
                 elif me is None:
                     continue
                 elif t == "hb":
                     me.last_hb = time.monotonic()
+                    if me.id >= 0:
+                        self._hb_at[me.id] = me.last_hb
                     if me.status == "failed":
                         # heartbeats resumed after a dead verdict: the
                         # node rejoins at a fresh incarnation (serf
@@ -418,8 +482,43 @@ class GossipPlane:
             except Exception:
                 pass
 
-    def _register(self, m: Dict[str, Any],
-                  writer: asyncio.StreamWriter) -> Optional[PlaneNode]:
+    def _verify_auth(self, m: Dict[str, Any]) -> bool:
+        """Registration proof check against every installed key (key
+        rotation: agents may still prove with a non-primary key).
+        Never raises — malformed auth fields are a refusal, not a
+        handler crash — and a (ts, nonce) pair is single-use within
+        the skew window (replay of a captured register frame fails)."""
+        try:
+            ts = int(m.get("auth_ts", 0) or 0)
+            nonce = bytes(m.get("auth_nonce", b"") or b"")
+            mac = bytes(m.get("auth", b"") or b"")
+            now = time.time()
+            if abs(now - ts) > self.config.auth_skew_s:
+                return False
+            seen = self._seen_nonces
+            for k in [k for k, exp in seen.items() if exp < now]:
+                del seen[k]
+            if (ts, nonce) in seen:
+                return False
+            for key in self.config.encrypt_keys:
+                try:
+                    want = registration_proof(
+                        key, m.get("name", ""), m.get("addr", ""),
+                        int(m.get("port", 0) or 0), ts, nonce,
+                        m.get("tags") or {})
+                except Exception:
+                    continue  # one bad key must not mask the others
+                if hmac.compare_digest(want, mac):
+                    seen[(ts, nonce)] = now + 2 * self.config.auth_skew_s
+                    return True
+        except Exception:
+            return False
+        return False
+
+    def _register(self, m: Dict[str, Any], writer: asyncio.StreamWriter
+                  ) -> tuple[Optional[PlaneNode], str]:
+        if self.config.encrypt_keys and not self._verify_auth(m):
+            return None, "authentication failed (keyring proof required)"
         name = m.get("name", "")
         node = self._nodes_by_name.get(name)
         if node is not None and node.status == "alive" \
@@ -428,11 +527,11 @@ class GossipPlane:
             # Name conflict with a LIVE registration: refuse, as
             # memberlist's name-conflict delegate does.  A dead/lapsed
             # holder is a restart and may re-register.
-            return None
+            return None, "name taken by a live node"
         if node is None or node.id < 0:
             nid = self._alloc_id()
             if nid is None:
-                return None
+                return None, "plane full"
             if node is None:
                 node = PlaneNode(id=nid, name=name)
                 self._nodes_by_name[name] = node
@@ -449,7 +548,7 @@ class GossipPlane:
             "hb_interval_s": self.config.hb_lapse_s / 3.0,
             "members": self.members_wire()})
         self._broadcast_member_event(EV_JOIN, node)
-        return node
+        return node, ""
 
     def _member_wire(self, node: PlaneNode) -> Dict[str, Any]:
         return {"name": node.name, "addr": node.addr, "port": node.port,

@@ -118,9 +118,12 @@ class TpuSerfPool:
                  on_event: Optional[Callable[[str, Any], None]] = None,
                  member_filter: Optional[Callable[[Node], bool]] = None,
                  plane_addr: str = "", use_native: bool = True) -> None:
-        # keyring: gossip encryption is plane-side policy (the bridge is
-        # a point-to-point agent<->plane link, not a gossip fabric);
-        # accepted for interface parity.
+        # keyring: the gossip key doubles as the plane admission secret
+        # (registration_proof) — an armed keyring means the plane
+        # refuses unauthenticated registrations, so gossip_backend=tpu
+        # keeps the encrypted-fabric security posture instead of
+        # silently downgrading to an open port.
+        self.keyring = keyring
         self.config = config
         self.on_event = on_event or (lambda kind, payload: None)
         self.member_filter = member_filter
@@ -196,11 +199,23 @@ class TpuSerfPool:
         self._register_error = ""
         self._bridge, self._native = bridge, native
         try:
-            bridge.send({
+            reg = {
                 "t": "register", "name": self.config.node_name,
                 "addr": self.config.advertise_addr or self.config.bind_addr,
                 "port": self.config.bind_port,
-                "tags": dict(self.config.tags)})
+                "tags": dict(self.config.tags)}
+            if self.keyring is not None and \
+                    getattr(self.keyring, "keys", None):
+                import os as _os
+
+                from consul_tpu.gossip.plane import registration_proof
+                ts, nonce = int(time.time()), _os.urandom(8)
+                reg.update({
+                    "auth_ts": ts, "auth_nonce": nonce,
+                    "auth": registration_proof(
+                        self.keyring.primary, reg["name"], reg["addr"],
+                        reg["port"], ts, nonce, reg["tags"])})
+            bridge.send(reg)
             self._poll_task = asyncio.get_event_loop().create_task(
                 self._poller())
             await asyncio.wait_for(self._registered.wait(), timeout=10.0)
@@ -244,11 +259,24 @@ class TpuSerfPool:
 
     def _schedule_redial(self, interval: float = 1.0) -> None:
         async def redial():
+            last_reason = ""
             while not self._closed and self._bridge is None:
                 await asyncio.sleep(interval)
                 try:
                     await self._connect(self.plane_addr)
-                except (ConnectionError, OSError, asyncio.TimeoutError):
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError) as e:
+                    # Surface each DISTINCT refusal once: an agent
+                    # stuck on "authentication failed" (keyring
+                    # mismatch) must not look like a plane that is
+                    # merely not up yet.
+                    reason = str(e)
+                    if reason and reason != last_reason:
+                        last_reason = reason
+                        import sys
+                        print(f"[gossip-tpu] plane join failing "
+                              f"({self.plane_addr}): {reason}; retrying",
+                              file=sys.stderr)
                     continue
         self._redial_task = asyncio.get_event_loop().create_task(redial())
 

@@ -107,12 +107,12 @@ def test_event_convergence_tracks_oracle():
     stock gossip.  The kernel floods over per-round circulant shifts;
     the oracle pushes to iid uniform targets (memberlist's actual
     behavior).  Gates: every flood completes, and rounds-to-50%/99%
-    stay within 25% of the oracle (measured: 0% at 1k, ~11% at 10k —
-    the exact-in-degree circulant graph runs one round AHEAD of
-    Poisson at the tail)."""
+    stay within 15% of the oracle — as tight as the detection-side
+    gates (measured: 0% at 1k, ~11% at 10k — the exact-in-degree
+    circulant graph runs one round AHEAD of Poisson at the tail)."""
     from consul_tpu.gossip.crossval import run_event_config
     out = run_event_config(n=1024, seeds=3)
     assert out["completed"]["kernel"] == 3, out
     assert out["completed"]["oracle"] == 3, out
-    assert out["rounds_to_50pct"]["relative_error"] <= 0.25, out
-    assert out["rounds_to_99pct"]["relative_error"] <= 0.25, out
+    assert out["rounds_to_50pct"]["relative_error"] <= 0.15, out
+    assert out["rounds_to_99pct"]["relative_error"] <= 0.15, out

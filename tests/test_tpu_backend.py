@@ -482,3 +482,124 @@ def test_tpu_force_leave_reaps_failed_node(loop):
         finally:
             await c.stop()
     loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_plane_keyring_auth(loop):
+    """An armed plane keyring is enforced at registration: the agents'
+    `encrypt` gossip key doubles as the plane admission secret
+    (registration_proof), so gossip_backend=tpu cannot silently
+    downgrade the encrypted-fabric posture to an open port."""
+    import base64
+
+    from consul_tpu.agent.keyring import Keyring
+
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    wrong = base64.b64encode(b"fedcba9876543210").decode()
+
+    async def body():
+        plane = GossipPlane(PlaneConfig(
+            bind_port=0, capacity=8, slots=8, gossip_interval_s=0.02,
+            suspicion_mult=1.0, hb_lapse_s=0.3, encrypt_keys=[key]))
+        await plane.start()
+        addr = "127.0.0.1:%d" % plane.local_addr[1]
+        try:
+            # no keyring -> refused with the auth error
+            bare = TpuSerfPool(_fast_cfg("bare"), plane_addr=addr,
+                               use_native=False)
+            with pytest.raises(ConnectionError, match="authentication"):
+                await bare._connect(addr)
+            # wrong key -> refused
+            liar = TpuSerfPool(_fast_cfg("liar"),
+                               keyring=Keyring(initial_key=wrong),
+                               plane_addr=addr, use_native=False)
+            with pytest.raises(ConnectionError, match="authentication"):
+                await liar._connect(addr)
+            assert not plane._nodes_by_name
+            # matching keyring -> admitted (native default transport)
+            ev = []
+            good = TpuSerfPool(_fast_cfg("good"),
+                               keyring=Keyring(initial_key=key),
+                               on_event=lambda k, p: ev.append((k, p)),
+                               plane_addr=addr)
+            try:
+                await good.start()
+                assert await _wait(lambda: any(
+                    k == EV_JOIN and n.name == "good" for k, n in ev))
+            finally:
+                await good.stop()
+            # rotation: proof with a non-primary installed key passes
+            ring2 = Keyring(initial_key=key)
+            ring2.install(wrong)
+            ring2.use(wrong)  # wrong becomes primary locally
+            plane.config.encrypt_keys = [key, wrong]
+            alt = TpuSerfPool(_fast_cfg("alt"), keyring=ring2,
+                              plane_addr=addr, use_native=False)
+            try:
+                await alt._connect(addr)
+                assert "alt" in plane._nodes_by_name
+            finally:
+                await alt.stop()
+        finally:
+            await plane.stop()
+    loop.run_until_complete(body())
+
+
+def test_plane_auth_replay_window():
+    """A stale or skewed registration proof is refused (bounded replay
+    window) and a valid-window proof verifies."""
+    import base64
+    import time as _time
+
+    from consul_tpu.gossip.plane import registration_proof
+
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    plane = GossipPlane(PlaneConfig(encrypt_keys=[key], auth_skew_s=30.0))
+
+    def reg(ts, nonce, tags=None):
+        return {"name": "n1", "addr": "127.0.0.1", "port": 7,
+                "tags": dict(tags or {}),
+                "auth_ts": ts, "auth_nonce": nonce,
+                "auth": registration_proof(key, "n1", "127.0.0.1", 7,
+                                           ts, nonce, tags)}
+
+    now = int(_time.time())
+    assert plane._verify_auth(reg(now, b"\x01" * 8))
+    # replay of the SAME captured frame is refused (nonce is single-use)
+    assert not plane._verify_auth(reg(now, b"\x01" * 8))
+    assert not plane._verify_auth(reg(now - 3600, b"\x02" * 8))
+    assert not plane._verify_auth(reg(now + 3600, b"\x03" * 8))
+    # tampered fields invalidate the proof — including tags, which the
+    # MAC covers (role/dc routing must not be forgeable)
+    m = reg(now, b"\x04" * 8)
+    m["port"] = 8
+    assert not plane._verify_auth(m)
+    m = reg(now, b"\x05" * 8, tags={"role": "node"})
+    m["tags"] = {"role": "consul"}
+    assert not plane._verify_auth(m)
+    # no keys on the wire at all
+    assert not plane._verify_auth({"name": "n1", "addr": "", "port": 0})
+    # malformed auth fields are a refusal, never a handler crash
+    assert not plane._verify_auth({"name": "n1", "auth_ts": "abc",
+                                   "auth": "str-not-bytes",
+                                   "auth_nonce": 3})
+
+
+def test_plane_left_tombstone_reap():
+    """Left names are reaped after the tombstone window — node-name
+    churn must not grow the member list without bound (serf reap)."""
+    plane = GossipPlane(PlaneConfig(capacity=4, tombstone_timeout_s=0.05))
+    import time as _time
+
+    from consul_tpu.gossip.plane import PlaneNode
+    now = _time.monotonic()
+    plane._nodes_by_name = {
+        "gone": PlaneNode(id=-1, name="gone", status="left",
+                          left_at=now - 1.0),
+        "fresh": PlaneNode(id=-1, name="fresh", status="left",
+                           left_at=now),
+        "live": PlaneNode(id=0, name="live", status="alive"),
+    }
+    plane._reap_tombstones()
+    assert set(plane._nodes_by_name) == {"fresh", "live"}
