@@ -1077,7 +1077,8 @@ class Agent:
             payload=await request.read(),
             node_filter=q.get("node", ""),
             service_filter=q.get("service", ""),
-            tag_filter=q.get("tag", ""))
+            tag_filter=q.get("tag", ""),
+            datacenter=q.get("dc", ""))
         try:
             eid = await self.events.fire(event)
         except ValueError as e:

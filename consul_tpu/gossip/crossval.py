@@ -87,12 +87,22 @@ def loss_sized_slots(n: int, loss: float, base: int = 64) -> int:
 
 
 def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
-               slots: int | None = None) -> dict:
-    """One matched kernel-vs-oracle config; returns the report row."""
+               slots: int | None = None, pushpull: bool = False,
+               oracle: bool = True) -> dict:
+    """One matched kernel-vs-oracle config; returns the report row.
+
+    ``pushpull`` arms anti-entropy in BOTH models (memberlist
+    PushPullInterval, 150 rounds = 30s LAN).  ``oracle=False`` skips
+    the discrete-event model and gates on the analytic Lifeguard
+    envelope only — the pure-Python oracle is tractable to a few
+    thousand nodes, so the 100k BASELINE row (whose published
+    criterion IS "p99 within Lifeguard bounds") runs kernel-only,
+    with the same config shape oracle-validated at 1k/10k."""
     from consul_tpu.gossip.params import SwimParams
     if slots is None:
         slots = loss_sized_slots(n, loss)
-    p = SwimParams(n=n, slots=slots, probe_every=5, loss_rate=loss)
+    p = SwimParams(n=n, slots=slots, probe_every=5, loss_rate=loss,
+                   pushpull_every=150 if pushpull else 0)
     first_fail = 30
     spacing = max(5, p.suspicion_min_rounds // 4)
     fail_at = {(n // (n_victims + 1)) * (i + 1): first_fail + i * spacing
@@ -111,7 +121,7 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
         k_drops += kd
     t_kernel = time.time() - t0
     t0 = time.time()
-    for s in range(seeds):
+    for s in range(seeds if oracle else 0):
         rl, rf, rr = refmodel_event_latencies(p, fail_at, steps,
                                               seed=1000 + s)
         r_lats += rl
@@ -135,9 +145,15 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
         "n": n,
         "loss_rate": loss,
         "slots": slots,
+        "pushpull_every": p.pushpull_every,
+        # A skipped oracle must never read as an oracle that detected
+        # nothing: its stats are None and the row says why.
+        "oracle": oracle if oracle else "skipped (pure-Python oracle "
+                  "intractable at this n; envelope gate only)",
         "victims_per_run": n_victims,
         "seeds": seeds,
-        "samples": {"kernel": len(k), "refmodel": len(r)},
+        "samples": {"kernel": len(k),
+                    "refmodel": len(r) if oracle else None},
         "expected_events": expected,
         # Detection completeness: fraction of injected failures whose
         # dead verdict was declared inside the window.  First-class
@@ -145,7 +161,8 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
         # percentiles over a starved sample set are meaningless.
         "completeness": {
             "kernel": round(len(k) / expected, 4) if expected else None,
-            "refmodel": round(len(r) / expected, 4) if expected else None,
+            "refmodel": (round(len(r) / expected, 4)
+                         if oracle and expected else None),
         },
         # Suspicion initiations lost to full slots (saturation alarm for
         # the S sizing above; structurally 0 in the refmodel).
@@ -166,6 +183,141 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
         "refutes": {"kernel": k_ref, "refmodel": r_ref},
         "lifeguard_envelope_rounds": [p.suspicion_min_rounds,
                                       p.suspicion_max_rounds],
+        "wall_s": {"kernel": round(t_kernel, 1), "refmodel": round(t_ref, 1)},
+    }
+
+
+# -- join churn (gossip.html.markdown:10-43: joins propagate as
+# gossiped alive messages; consumed by consul/leader.go:354-421) ------------
+
+
+def run_join_config(n: int, n_joiners: int, n_victims: int, seeds: int,
+                    loss: float = 0.0) -> dict:
+    """Concurrent joins + failures, kernel vs oracle.
+
+    Two statistics families, matched definitions in both models:
+      - detection: latency percentiles + completeness for the victims,
+        with join churn running concurrently (the same gates as the
+        static-membership configs);
+      - join propagation: rounds from a node's join until 95% of the
+        eventual membership holds its alive@inc announcement (kernel:
+        ``n_heard_alive`` on the JOIN slot; oracle: the incremental
+        join-knowers set).  The 95%-of-(n - victims) target is shared;
+        the small asymmetry (the oracle's knower set is monotone and
+        may count observers that later die; the kernel counts current
+        members only) biases both toward the same side well under the
+        gate."""
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.kernel import (NEVER, PHASE_DEAD, PHASE_JOIN,
+                                          init_state, run_rounds)
+    from consul_tpu.gossip.params import SwimParams
+    from consul_tpu.gossip.refmodel import RefModel
+
+    slots = max(64, loss_sized_slots(n, loss))
+    p = SwimParams(n=n, slots=slots, probe_every=5, loss_rate=loss)
+    spacing = max(5, p.suspicion_min_rounds // 4)
+    # Joiners are the top ids (they start outside the pool); victims are
+    # spread through the standing membership; the windows interleave.
+    joiners = [n - 1 - i for i in range(n_joiners)]
+    join_at = {j: 20 + i * spacing for i, j in enumerate(joiners)}
+    victims = [(n // (n_victims + 1)) * (i + 1) for i in range(n_victims)]
+    fail_at = {v: 30 + i * spacing for i, v in enumerate(victims)}
+    steps = (max(max(join_at.values()), max(fail_at.values()))
+             + p.slot_ttl_rounds + 8 * p.probe_every)
+    target = 0.95 * (n - n_victims)
+
+    fail = np.full(n, NEVER, np.int32)
+    for v, t in fail_at.items():
+        fail[v] = t
+    join = np.full(n, NEVER, np.int32)
+    for j, t in join_at.items():
+        join[j] = t
+
+    k_lats, r_lats, k_join, r_join = [], [], [], []
+    k_fp = r_fp = k_drops = 0
+    t0 = time.time()
+    for s in range(seeds):
+        st = init_state(p)._replace(member=jnp.asarray(join == NEVER))
+        st, trace = run_rounds(st, jax.random.key(s), jnp.asarray(fail), p,
+                               steps, trace=True,
+                               join_round=jnp.asarray(join))
+        slot_node = np.asarray(trace.slot_node)
+        slot_dead = np.asarray(trace.slot_dead_round)
+        slot_phase = np.asarray(trace.slot_phase)
+        heard_alive = np.asarray(trace.n_heard_alive)
+        for v, t_fail in fail_at.items():
+            mask = ((slot_node == v) & (slot_dead >= t_fail)
+                    & (slot_phase == PHASE_DEAD))
+            if mask.any():
+                k_lats.append(int(slot_dead[mask].min()) - t_fail)
+        for j, t_join in join_at.items():
+            jm = (slot_node == j) & (slot_phase == PHASE_JOIN)
+            curve = np.where(jm, heard_alive, 0).max(axis=1)
+            hit = np.nonzero(curve >= target)[0]
+            if hit.size:
+                k_join.append(int(hit[0]) + 1 - t_join)
+        k_fp += int(st.n_false_dead)
+        k_drops += int(st.drops)
+    t_kernel = time.time() - t0
+    t0 = time.time()
+    for s in range(seeds):
+        m = RefModel(p, dict(fail_at), seed=1000 + s,
+                     join_tick=dict(join_at))
+        m.run(steps)
+        r_lats += m.detection_latencies()
+        r_fp += m.n_false_dead
+        for j, t_join in join_at.items():
+            hits = [t for t, c in m.join_curve[j] if c >= target]
+            if hits:
+                r_join.append(hits[0] + 1 - t_join)
+    t_ref = time.time() - t0
+
+    k = np.asarray(k_lats, float)
+    r = np.asarray(r_lats, float)
+
+    def pct(a, q):
+        return float(np.percentile(a, q)) if len(a) else None
+
+    def rel(kv, rv):
+        if kv is None or rv is None or not rv:
+            return None
+        return round(abs(kv - rv) / rv, 4)
+
+    def m_(a):
+        return round(float(np.mean(a)), 2) if len(a) else None
+
+    expected = n_victims * seeds
+    expected_joins = n_joiners * seeds
+    return {
+        "n": n,
+        "loss_rate": loss,
+        "slots": slots,
+        "joiners_per_run": n_joiners,
+        "victims_per_run": n_victims,
+        "seeds": seeds,
+        "completeness": {
+            "kernel": round(len(k) / expected, 4) if expected else None,
+            "refmodel": round(len(r) / expected, 4) if expected else None,
+        },
+        "kernel_slot_drops": k_drops,
+        "detection_latency_rounds": {
+            "kernel": {"mean": m_(k), "p50": pct(k, 50), "p99": pct(k, 99)},
+            "refmodel": {"mean": m_(r), "p50": pct(r, 50), "p99": pct(r, 99)},
+        },
+        "relative_error": {
+            "mean": rel(m_(k), m_(r)),
+            "p50": rel(pct(k, 50), pct(r, 50)),
+            "p99": rel(pct(k, 99), pct(r, 99)),
+        },
+        "false_dead": {"kernel": k_fp, "refmodel": r_fp},
+        "join_spread_rounds_to_95pct": {
+            "kernel": m_(k_join), "refmodel": m_(r_join),
+            "relative_error": rel(m_(k_join), m_(r_join)),
+            "completed": {"kernel": len(k_join), "refmodel": len(r_join),
+                          "expected": expected_joins},
+        },
         "wall_s": {"kernel": round(t_kernel, 1), "refmodel": round(t_ref, 1)},
     }
 

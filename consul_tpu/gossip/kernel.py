@@ -73,12 +73,13 @@ from consul_tpu.gossip.params import SwimParams
 MSG_NONE = 0
 MSG_SUSPECT = 1
 MSG_DEAD = 2
-MSG_REFUTE = 3
+MSG_REFUTE = 3   # alive@inc: refutations AND join announcements
 
 PHASE_FREE = 0
 PHASE_SUSPECT = 1
 PHASE_DEAD = 2
 PHASE_REFUTED = 3
+PHASE_JOIN = 4   # alive@inc dissemination for a node joining the pool
 
 NEVER = np.int32(2**31 - 1)  # fail_round value for "never fails"
 
@@ -99,11 +100,12 @@ class SwimState(NamedTuple):
     heard: jnp.ndarray          # u8  [S, N] — per-(slot, observer) belief
     slot_node: jnp.ndarray      # i32 [S] — subject node id, -1 = free
     slot_phase: jnp.ndarray     # i32 [S] — PHASE_*
-    slot_inc: jnp.ndarray       # i32 [S] — incarnation under suspicion (diagnostic
-                                #   only for now: message ordering within an episode
-                                #   is positional — suspect < dead < refute — so the
-                                #   incarnation guard is implicit; joins/rejoins will
-                                #   consume this field when they land)
+    slot_inc: jnp.ndarray       # i32 [S] — incarnation the episode speaks at:
+                                #   suspicion slots record the inc under suspicion
+                                #   (ordering within an episode is positional —
+                                #   suspect < dead < refute — so the guard is
+                                #   implicit); JOIN slots record the alive@inc the
+                                #   join announces (bumped on every (re)join)
     slot_start: jnp.ndarray     # i32 [S] — round the episode began
     slot_nsusp: jnp.ndarray     # i32 [S] — independent suspicion initiators
     slot_dead_round: jnp.ndarray  # i32 [S] — round the episode's verdict was
@@ -157,6 +159,84 @@ def _age_tick(heard: jnp.ndarray) -> jnp.ndarray:
                         jnp.minimum(age + 1, jnp.uint8(_AGE_MASK - 1)))
     aged = (heard & ~jnp.uint8(_AGE_MASK)) | new_age.astype(jnp.uint8)
     return jnp.where(msg > 0, aged, heard)
+
+
+def _join_tick(p: SwimParams, rnd, carry, join_round):
+    """Activate this round's joins on-device (memberlist: a join is an
+    alive@inc message gossiped like any rumor — behavior contract
+    ``website/source/docs/internals/gossip.html.markdown:10-43``,
+    consumed by the leader's join path ``consul/leader.go:354-421``).
+
+    ``join_round[i] == rnd`` admits node ``i`` this round: membership
+    flips on-device, the incarnation bumps (alive@inc supersedes any
+    prior suspect/dead at the old inc — memberlist aliveNode), any
+    stale episode about the id is cleared, and a PHASE_JOIN slot is
+    allocated whose alive rumor (MSG_REFUTE — the same message class a
+    refutation floods) disseminates through the ordinary gossip path.
+
+    Approximation (counted, not silent): at most one join per
+    segmented-min segment gets a rumor slot per round; a joiner that
+    loses the race still BECOMES a member (the global flip is ground
+    truth) but its announcement flood is lost — surfaced in ``drops``
+    and recovered by push/pull anti-entropy, exactly like a rumor that
+    aged out under loss."""
+    (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
+     slot_dead_round, slot_of_node, incarnation, member, drops) = carry
+    N, S = p.n, p.slots
+
+    joining = (join_round == rnd) & ~member
+    incarnation = incarnation + joining.astype(jnp.int32)
+    member = member | joining
+
+    # Clear any stale episode about a rejoining id (e.g. a dead verdict
+    # whose slot has not yet been GC'd).
+    node_c0 = jnp.clip(slot_node, 0, N - 1)
+    stale = (slot_node >= 0) & joining[node_c0]
+    heard = jnp.where(stale[:, None], jnp.uint8(0), heard)
+    slot_of_node = slot_of_node.at[jnp.where(stale, node_c0, N)].set(
+        -1, mode="drop")
+    slot_node = jnp.where(stale, -1, slot_node)
+    slot_phase = jnp.where(stale, PHASE_FREE, slot_phase)
+    slot_dead_round = jnp.where(stale, -1, slot_dead_round)
+
+    # JOIN-slot allocation: segmented-min compaction, the probe tick's
+    # trick — O(N) work, no sort, no N-scatter.
+    masked = jnp.where(joining, jnp.arange(N, dtype=jnp.int32), N)
+    kk = min(S, N)
+    GB = -(-N // kk)
+    pad = kk * GB - N
+    masked_p = (jnp.concatenate([masked, jnp.full((pad,), N, jnp.int32)])
+                if pad else masked)
+    cand = jnp.min(masked_p.reshape(kk, GB), axis=1)
+    in_dom = cand < N
+    free = slot_node < 0
+    free_order = jnp.argsort(jnp.where(free, 0, 1),
+                             stable=True).astype(jnp.int32)
+    n_free = jnp.sum(free)
+    rank = jnp.cumsum(in_dom.astype(jnp.int32)) - 1
+    can_k = in_dom & (rank < n_free)
+    slot_k = free_order[jnp.clip(rank, 0, S - 1)]
+    sidx = jnp.where(can_k, slot_k, S)  # S = out of range -> dropped
+    cand_c = jnp.clip(cand, 0, N - 1)
+    slot_node = slot_node.at[sidx].set(cand_c, mode="drop")
+    slot_phase = slot_phase.at[sidx].set(PHASE_JOIN, mode="drop")
+    slot_inc = slot_inc.at[sidx].set(incarnation[cand_c], mode="drop")
+    slot_start = slot_start.at[sidx].set(rnd, mode="drop")
+    slot_nsusp = slot_nsusp.at[sidx].set(0, mode="drop")
+    # The join IS the episode's verdict: the slot lives only for the
+    # alive rumor's dissemination window (verdict-done GC).
+    slot_dead_round = slot_dead_round.at[sidx].set(rnd, mode="drop")
+    slot_of_node = slot_of_node.at[jnp.where(can_k, cand_c, N)].set(
+        slot_k, mode="drop")
+    # The joiner seeds its own announcement flood.
+    heard = heard.at[sidx, cand_c].set(
+        jnp.uint8(_enc(MSG_REFUTE, age=_AGE_FRESH)), mode="drop")
+
+    n_join = jnp.sum(joining.astype(jnp.int32))
+    served = jnp.sum(can_k.astype(jnp.int32))
+    drops = drops + (n_join - served)
+    return (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
+            slot_dead_round, slot_of_node, incarnation, member, drops)
 
 
 def _block_size(p: SwimParams) -> int:
@@ -274,9 +354,11 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
     slot_nsusp = jnp.where((slot_phase == PHASE_SUSPECT) & slot_want,
                            slot_nsusp + add_here, slot_nsusp)
 
-    # A refuted episode whose subject fails probes again re-arms at the
-    # bumped incarnation (memberlist: suspect at inc >= alive inc).
-    rearm = (slot_phase == PHASE_REFUTED) & slot_want
+    # A refuted (or freshly-joined) episode whose subject fails probes
+    # re-arms as a suspicion at the bumped incarnation (memberlist:
+    # suspect at inc >= alive inc supersedes the alive).
+    rearm = (((slot_phase == PHASE_REFUTED) | (slot_phase == PHASE_JOIN))
+             & slot_want)
     slot_phase = jnp.where(rearm, PHASE_SUSPECT, slot_phase)
     slot_inc = jnp.where(rearm, incarnation[node_c], slot_inc)
     slot_start = jnp.where(rearm, rnd, slot_start)
@@ -354,8 +436,14 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
 
 @functools.partial(jax.jit, static_argnames=("p",))
 def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
-               p: SwimParams) -> SwimState:
-    """Advance the pool by one gossip round."""
+               p: SwimParams,
+               join_round: jnp.ndarray | None = None) -> SwimState:
+    """Advance the pool by one gossip round.
+
+    ``join_round`` (optional, [N] i32, NEVER = present from start):
+    nodes whose entry equals the current round join the pool this round
+    — see ``_join_tick``.  ``None`` compiles the join machinery out
+    entirely (the bench regimes and static-membership sims pay zero)."""
     rnd = state.round
     key = jax.random.fold_in(base_key, rnd)
     k_probe = jax.random.split(jax.random.fold_in(key, 1), 4)
@@ -363,18 +451,29 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
 
     N, S = p.n, p.slots
     alive = fail_round > rnd
+
+    carry = (state.heard, state.slot_node, state.slot_phase, state.slot_inc,
+             state.slot_start, state.slot_nsusp, state.slot_dead_round,
+             state.slot_of_node, state.incarnation, state.member, state.drops)
+
+    # -- 0. join tick: admit this round's joiners (alive@inc rumors).
+    # One N-compare guards the cond; no joins due -> no work.
+    if join_round is not None:
+        any_join = jnp.any((join_round == rnd) & ~state.member)
+        carry = jax.lax.cond(
+            any_join, lambda c: _join_tick(p, rnd, c, join_round),
+            lambda c: c, carry)
+
+    member_now = carry[9]
     # Packed per-node status: member ? fail_round : -1.  One gather
     # answers both "is x a member" (>= 0) and "is x an alive member"
     # (> rnd) — the kernel's most common random reads.
-    mf = jnp.where(state.member, fail_round, -1)
+    mf = jnp.where(member_now, fail_round, -1)
 
     # -- 1. probe tick (staggered: block rnd % probe_every probes).  Runs
     # FIRST, on the un-aged matrix: its decisions read only msg/conf
     # bits, and its fresh marks carry the _AGE_FRESH sentinel that the
     # tail's age tick turns into age 0 --------------------------------
-    carry = (state.heard, state.slot_node, state.slot_phase, state.slot_inc,
-             state.slot_start, state.slot_nsusp, state.slot_dead_round,
-             state.slot_of_node, state.incarnation, state.member, state.drops)
     carry = _probe_tick(p, rnd, k_probe, mf, carry)
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
@@ -790,7 +889,10 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
     # episodes per round), and holding each for the full slot TTL
     # starved every slot — 87% of true failures went undetected in the
     # round-3 crossval loss config (CROSSVAL.json config 3: 2/16).
-    verdict_done = ((((sl_phase == PHASE_DEAD) | (sl_phase == PHASE_REFUTED))
+    # JOIN slots carry their verdict from birth (slot_dead_round = the
+    # join round): they recycle on the same dissemination window.
+    verdict_done = ((((sl_phase == PHASE_DEAD) | (sl_phase == PHASE_REFUTED)
+                      | (sl_phase == PHASE_JOIN))
                      & (sl_dead_round >= 0))
                     & (rnd - sl_dead_round > 2 * p.spread_budget_rounds + 8))
     expired = ((sl_phase > PHASE_FREE)
@@ -851,25 +953,30 @@ class RoundTrace(NamedTuple):
     slot_start: jnp.ndarray      # [T, S]
     slot_dead_round: jnp.ndarray  # [T, S]
     n_heard_dead: jnp.ndarray    # [T, S] — members that hold the dead verdict
+    n_heard_alive: jnp.ndarray   # [T, S] — members that hold the alive@inc
+                                 #   rumor (join announcements / refutes)
 
 
 @functools.partial(jax.jit, static_argnames=("p", "steps", "trace", "unroll"))
 def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                p: SwimParams, steps: int, trace: bool = False,
-               unroll: int = 4):
+               unroll: int = 4, join_round: jnp.ndarray | None = None):
     """Scan ``steps`` rounds.  With ``trace``, also return per-round slot
     snapshots for detection-curve analysis (adds one S×N reduction/round).
     ``unroll`` fuses that many rounds per scan iteration — amortizes
     per-iteration dispatch/sync on backends where that dominates."""
 
     def body(st, _):
-        st = swim_round(st, base_key, fail_round, p)
+        st = swim_round(st, base_key, fail_round, p, join_round=join_round)
         if trace:
-            n_heard_dead = jnp.sum(
-                (((st.heard >> _MSG_SHIFT) == MSG_DEAD) & st.member[None, :]),
-                axis=1, dtype=jnp.int32)
+            msg = st.heard >> _MSG_SHIFT
+            mem = st.member[None, :]
+            n_heard_dead = jnp.sum((msg == MSG_DEAD) & mem,
+                                   axis=1, dtype=jnp.int32)
+            n_heard_alive = jnp.sum((msg == MSG_REFUTE) & mem,
+                                    axis=1, dtype=jnp.int32)
             y = RoundTrace(st.slot_node, st.slot_phase, st.slot_start,
-                           st.slot_dead_round, n_heard_dead)
+                           st.slot_dead_round, n_heard_dead, n_heard_alive)
         else:
             y = None
         return st, y

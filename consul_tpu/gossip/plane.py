@@ -69,7 +69,7 @@ class PlaneConfig:
     bind_addr: str = "127.0.0.1"
     bind_port: int = 8310          # the plane's rendezvous port
     unix_path: str = ""            # serve on a unix socket instead
-    capacity: int = 256            # real-agent universe size (node ids)
+    capacity: int = 1024           # real-agent universe size (node ids)
     sim_nodes: int = 0             # extra simulated nodes sharing the arrays
     gossip_interval_s: float = 0.2  # kernel round length in wall time
     probe_every: int = 5
@@ -92,6 +92,11 @@ class PlaneConfig:
     # grows the member list and welcome snapshots without bound.
     # Matches serf's TombstoneTimeout default (24h).
     tombstone_timeout_s: float = 24 * 3600.0
+    # Concurrent user-event slots in the dissemination kernel
+    # (gossip/events.py): fired events flood the SAME gossip substrate
+    # as membership — real agents and the sim swarm share the flood —
+    # instead of a host-side TCP fanout.
+    event_slots: int = 64
 
 
 @dataclass
@@ -121,12 +126,13 @@ def registration_proof(key_b64: str, name: str, addr: str, port: int,
     ``gossip_backend=tpu`` replaces the encrypted serf fabric
     (reference: serf rejects plaintext when a keyring is armed).
     The MAC covers every register field — including tags, which carry
-    role/dc routing decisions — so no field is forgeable."""
-    tag_blob = b"&".join(
-        f"{k}={v}".encode() for k, v in sorted((tags or {}).items()))
-    msg = b"|".join((b"consul-tpu-plane-register", name.encode(),
-                     addr.encode(), str(int(port)).encode(),
-                     str(int(ts)).encode(), nonce, tag_blob))
+    role/dc routing decisions — so no field is forgeable.  The fields
+    are msgpack-canonicalized (length-prefixed), never joined with
+    in-band delimiters: two different registrations can never serialize
+    to the same MAC input."""
+    msg = msgpack.packb(
+        ["consul-tpu-plane-register", name, addr, int(port), int(ts),
+         nonce, sorted((tags or {}).items())], use_bin_type=True)
     return hmac.new(base64.b64decode(key_b64), msg,
                     hashlib.sha256).digest()
 
@@ -141,7 +147,6 @@ class GossipPlane:
         self._nodes_by_id: Dict[int, PlaneNode] = {}
         self._free_ids: List[int] = []
         self._declared_dead: Set[int] = set()
-        self._event_ltime = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()   # every live bridge connection's writer
         self._tick_task: Optional[asyncio.Task] = None
@@ -153,6 +158,12 @@ class GossipPlane:
         self._fail: Optional[np.ndarray] = None
         self._rounds_done = 0
         self._t0 = 0.0
+        # Events-kernel session: fires queue between dispatches; slot
+        # metadata (payloads never enter device arrays) + delivery
+        # bookkeeping live host-side, keyed by (slot, start_round).
+        self._ev_state = None
+        self._fire_queue: List[tuple] = []   # (origin_id, meta dict)
+        self._ev_meta: Dict[tuple, Dict[str, Any]] = {}
 
     # -- universe ----------------------------------------------------------
 
@@ -191,6 +202,12 @@ class GossipPlane:
                 member=self._state.member.at[c.capacity:].set(True))
         self._key = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "big"))
         self._fail = np.full((n,), int(NEVER), np.int32)
+        # Joins are kernel dynamics too: registration sets the id's
+        # join_round and the kernel admits it on-device (alive@inc
+        # rumor, kernel._join_tick); EV_JOIN broadcasts only once the
+        # kernel's membership flip is visible (_pending_join).
+        self._join = np.full((n,), int(NEVER), np.int32)
+        self._pending_join: Dict[int, PlaneNode] = {}
         self._free_ids = list(range(c.capacity - 1, -1, -1))
         # Vectorized lapse bookkeeping (O(capacity) numpy per tick, not
         # an O(capacity) Python loop): heartbeat times + lifecycle masks
@@ -204,10 +221,16 @@ class GossipPlane:
         # read as every agent lapsing at once).
         import jax.numpy as jnp
 
+        from consul_tpu.gossip.events import init_events, run_event_rounds
         from consul_tpu.gossip.kernel import run_rounds
+        self._ev_state = init_events(self._p, slots=c.event_slots)
         jax.block_until_ready(run_rounds(
             self._state, self._key, jnp.asarray(self._fail), self._p,
-            steps=STEPS_PER_TICK, trace=True)[0])
+            steps=STEPS_PER_TICK, trace=True,
+            join_round=jnp.asarray(self._join))[0])
+        jax.block_until_ready(run_event_rounds(
+            self._ev_state, self._key, self._state.member, self._p,
+            steps=STEPS_PER_TICK)[0])
         self._rounds_done = 0
         self._t0 = time.monotonic()
 
@@ -330,9 +353,24 @@ class GossipPlane:
 
         state, trace = run_rounds(
             self._state, self._key, jnp.asarray(self._fail), self._p,
-            steps=STEPS_PER_TICK, trace=True)
+            steps=STEPS_PER_TICK, trace=True,
+            join_round=jnp.asarray(self._join))
         self._state = state
         self._rounds_done += STEPS_PER_TICK
+
+        # Joins the kernel admitted this dispatch: the EV_JOIN the
+        # agents see is the kernel's membership flip, not host-side
+        # bookkeeping (robust to JOIN-slot overflow — the flip is the
+        # ground truth; the rumor slot only drives dissemination).
+        if self._pending_join:
+            mem = np.asarray(state.member)
+            for i, node in list(self._pending_join.items()):
+                if node.status != "joining":   # evicted while pending
+                    self._pending_join.pop(i, None)
+                elif mem[i]:
+                    self._pending_join.pop(i, None)
+                    node.status = "alive"
+                    self._broadcast_member_event(EV_JOIN, node)
 
         # Dead verdicts declared during this dispatch (trace carries the
         # per-round slot registers: subject + phase).
@@ -350,16 +388,114 @@ class GossipPlane:
             self._alive_mask[node.id] = False
             self._broadcast_member_event(EV_FAILED, node)
 
+        self._dispatch_events()
+
+    def _dispatch_events(self) -> None:
+        """User events ride the dissemination kernel: queued fires enter
+        the [E, N] flood — the lamport stamp, the flood dynamics, and
+        the convergence observable are kernel state (reference:
+        EventFire → serf UserEvent → gossip broadcast,
+        consul/internal_endpoint.go:87).
+
+        Registered agents are SEEDED into the flood and notified over
+        TCP with the kernel's ltime: every real agent "knows" the event
+        the moment it is stamped (host fanout is the low-latency
+        notification; serf's UDP delivery to a handful of live agents
+        is similarly instant at these scales).  The roll-based flood
+        then carries it across the hybrid universe — the sim swarm's
+        convergence is the kernel-measured statistic.  (Per-column
+        delivery to agents is NOT used: circulant shifts over a
+        sparsely-registered id space hit the few live member ids too
+        rarely before the spread budget closes — the dense-membership
+        approximation the rolls rely on, documented in
+        kernel.gossip_offsets, does not hold for the agent subset.)"""
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.events import _SEEN, fire_events, \
+            run_event_rounds
+
+        if not self._fire_queue and not self._ev_meta:
+            # No live event anywhere: skip the whole event dispatch
+            # (the kernel's event clock lags while idle — every TTL
+            # comparison is relative to it, so lagging is free, and a
+            # quiescent plane pays nothing for the events tier).
+            return
+        ev = self._ev_state
+        if self._fire_queue:
+            fires, self._fire_queue = self._fire_queue, []
+            before_used = np.asarray(ev.slot_used)
+            fire_round = int(ev.round)
+            ev = fire_events(ev, jnp.asarray([f[0] for f in fires],
+                                             jnp.int32))
+            # fire_events hands free slots out in ascending index order,
+            # one per fire — recover the mapping to attach host metadata
+            # (name/payload never enter device arrays).
+            free_list = [s for s in range(before_used.shape[0])
+                         if not before_used[s]]
+            ltimes = np.asarray(ev.ltime)
+            live = [n for n in self._nodes_by_id.values()
+                    if n.id >= 0 and n.status in ("alive", "joining")]
+            seed_ids = jnp.asarray([n.id for n in live] or [0], jnp.int32)
+            for k, (_oid, meta) in enumerate(fires):
+                if k >= len(free_list):
+                    # dropped, counted in ev.drops — overflow is never
+                    # silent (same posture as the membership slots)
+                    continue
+                s = free_list[k]
+                meta = dict(meta, ltime=int(ltimes[s]))
+                self._ev_meta[(s, fire_round)] = meta
+                if live:
+                    # Seeding = witnessing: the seeded nodes' lamport
+                    # clocks advance by the kernel's witness rule
+                    # (max(clock, event)+1) so a later fire from any
+                    # agent is stamped AFTER this event.
+                    nl = ev.node_ltime
+                    ev = ev._replace(
+                        has=ev.has.at[s, seed_ids].set(jnp.uint8(_SEEN)),
+                        n_seen=ev.n_seen.at[s].set(len(live)),
+                        node_ltime=nl.at[seed_ids].set(
+                            jnp.maximum(nl[seed_ids], ev.ltime[s]) + 1))
+                for node in live:
+                    if node.writer is not None:
+                        self._send(node.writer, {
+                            "t": "user", "name": meta["name"],
+                            "payload": meta["payload"],
+                            "ltime": meta["ltime"], "from": meta["from"],
+                            "coalesce": meta["coalesce"]})
+
+        ev, _cov = run_event_rounds(ev, self._key, self._state.member,
+                                    self._p, steps=STEPS_PER_TICK)
+        self._ev_state = ev
+        # GC host metadata for slots whose flood window closed.
+        if self._ev_meta:
+            used = np.asarray(ev.slot_used)
+            startr = np.asarray(ev.start_round)
+            for (s, sr) in list(self._ev_meta):
+                if not used[s] or int(startr[s]) != sr:
+                    self._ev_meta.pop((s, sr), None)
+
+    def event_coverage(self) -> Dict[int, float]:
+        """Live event slots -> fraction of members holding the event
+        (the convergence observable, incl. the sim swarm)."""
+        from consul_tpu.gossip.events import coverage
+        cov = np.asarray(coverage(self._ev_state, self._state.member))
+        used = np.asarray(self._ev_state.slot_used)
+        return {int(s): float(cov[s]) for s in np.nonzero(used)[0]}
+
     # -- registration / membership ops ------------------------------------
 
     def _admit(self, node: PlaneNode) -> None:
+        """(Re)admission is a kernel join: the host only releases the id
+        (clears membership + any stale episode — control-plane surgery
+        between dispatches) and stamps ``join_round``; the kernel's
+        join tick performs the membership flip, the incarnation bump,
+        and the alive@inc dissemination on-device, and EV_JOIN is
+        broadcast when that flip lands (_dispatch)."""
         from consul_tpu.gossip.kernel import NEVER
         i = node.id
         self._fail[i] = int(NEVER)
         st = self._state
-        # Host-side control-plane surgery between dispatches: (re)admit
-        # the id and clear any stale episode registers for it.
-        member = st.member.at[i].set(True)
+        member = st.member.at[i].set(False)
         slot = int(st.slot_of_node[i])
         if slot >= 0:
             st = st._replace(
@@ -370,17 +506,25 @@ class GossipPlane:
                 slot_of_node=st.slot_of_node.at[i].set(-1),
             )
         self._state = st._replace(member=member)
+        self._join[i] = self._rounds_done  # next dispatch's first round
         self._declared_dead.discard(i)
-        node.status = "alive"
+        node.status = "joining"
+        self._pending_join[i] = node
         node.last_hb = time.monotonic()
         self._hb_at[i] = node.last_hb
         self._eligible[i] = True
         self._alive_mask[i] = True
 
     def _evict(self, node: PlaneNode, status: str) -> None:
+        from consul_tpu.gossip.kernel import NEVER
         i = node.id
+        if i < 0:
+            return  # already evicted (duplicate leave frame): -1 would
+                    # otherwise index the HIGHEST id's lifecycle entries
         self._eligible[i] = False
         self._alive_mask[i] = False
+        self._join[i] = int(NEVER)
+        self._pending_join.pop(i, None)
         st = self._state
         st = st._replace(member=st.member.at[i].set(False))
         slot = int(st.slot_of_node[i])
@@ -444,9 +588,9 @@ class GossipPlane:
                     if me.status == "failed":
                         # heartbeats resumed after a dead verdict: the
                         # node rejoins at a fresh incarnation (serf
-                        # failed->rejoin choreography)
+                        # failed->rejoin choreography); EV_JOIN fires
+                        # when the kernel's membership flip lands
                         self._admit(me)
-                        self._broadcast_member_event(EV_JOIN, me)
                 elif t == "leave":
                     self._evict(me, "left")
                     self._broadcast_member_event(EV_LEAVE, me)
@@ -459,13 +603,15 @@ class GossipPlane:
                     me.tags = dict(m.get("tags") or {})
                     self._broadcast_member_event(EV_UPDATE, me)
                 elif t == "event":
-                    self._event_ltime += 1
-                    self._broadcast({"t": "user",
-                                     "name": m.get("name", ""),
-                                     "payload": m.get("payload", b""),
-                                     "ltime": self._event_ltime,
-                                     "from": me.name,
-                                     "coalesce": m.get("coalesce", True)})
+                    # Enters the dissemination kernel at the next
+                    # dispatch: lamport stamp, flood, and delivery
+                    # timing are kernel dynamics (_dispatch_events).
+                    if me.id >= 0:
+                        self._fire_queue.append((me.id, {
+                            "name": m.get("name", ""),
+                            "payload": m.get("payload", b""),
+                            "coalesce": m.get("coalesce", True),
+                            "from": me.name}))
                 elif t == "members":
                     self._send(writer, {"t": "members",
                                         "members": self.members_wire()})
@@ -547,14 +693,16 @@ class GossipPlane:
             "t": "welcome", "id": node.id, "round": self._rounds_done,
             "hb_interval_s": self.config.hb_lapse_s / 3.0,
             "members": self.members_wire()})
-        self._broadcast_member_event(EV_JOIN, node)
+        # EV_JOIN broadcasts from _dispatch once the kernel admits the id.
         return node, ""
 
     def _member_wire(self, node: PlaneNode) -> Dict[str, Any]:
+        # "joining" (registered, kernel flip pending <1 tick) reads as
+        # alive on the wire — serf members show a joiner immediately.
         return {"name": node.name, "addr": node.addr, "port": node.port,
                 "tags": node.tags,
-                "state": ("alive" if node.status == "alive" else
-                          "dead" if node.status == "failed" else "left")}
+                "state": ("dead" if node.status == "failed" else
+                          "left" if node.status == "left" else "alive")}
 
     def _broadcast_member_event(self, kind: str, node: PlaneNode) -> None:
         self._broadcast({"t": "ev", "kind": kind,

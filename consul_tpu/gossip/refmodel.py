@@ -51,11 +51,19 @@ class Belief:
 
 
 class Broadcast:
-    __slots__ = ("msg", "remaining")
+    __slots__ = ("msg", "remaining", "born")
 
-    def __init__(self, msg: Message, remaining: int):
+    def __init__(self, msg: Message, remaining: int, born: int = -1):
         self.msg = msg
         self.remaining = remaining
+        # Tick the broadcast was enqueued: it may not be FORWARDED
+        # within the same tick (one gossip hop per tick — the same
+        # synchronous-rounds convention the kernel and the event oracle
+        # use; without this, shuffled intra-tick processing lets a
+        # rumor chain multiple hops per tick and flood measurably
+        # faster than either other model).  Beliefs and timers still
+        # update at receipt — only re-forwarding waits.
+        self.born = born
 
 
 @dataclasses.dataclass
@@ -69,11 +77,17 @@ class DetectionEvent:
 class RefModel:
     """Per-node discrete-event SWIM simulation."""
 
-    def __init__(self, p: SwimParams, fail_tick: Dict[int, int], seed: int = 0):
+    def __init__(self, p: SwimParams, fail_tick: Dict[int, int], seed: int = 0,
+                 join_tick: Optional[Dict[int, int]] = None):
         self.p = p
         self.n = p.n
         self.rng = random.Random(seed)
         self.fail_tick = dict(fail_tick)
+        # Joins (memberlist: a join is a TCP state sync with one contact
+        # node followed by a gossiped alive@inc broadcast —
+        # gossip.html.markdown:10-43): nodes with a join_tick do not
+        # exist in anyone's view (or act) until that tick.
+        self.join_tick = dict(join_tick or {})
         self.tick = 0
         # Per-node protocol state (sparse: only deviations from alive@0).
         self.beliefs: List[Dict[int, Belief]] = [dict() for _ in range(self.n)]
@@ -90,6 +104,9 @@ class RefModel:
         self.probe_list: List[Optional[np.ndarray]] = [None] * self.n
         self.probe_pos = [0] * self.n
         self.probe_offset = [self.rng.randrange(p.probe_every) for _ in range(self.n)]
+        self.pushpull_offset = ([self.rng.randrange(p.pushpull_every)
+                                 for _ in range(self.n)]
+                                if p.pushpull_every else [])
         # Suspicion timers: (observer, subject) -> deadline handled lazily.
         self.first_suspect: Dict[int, int] = {}
         self.dead_declared: Dict[int, int] = {}
@@ -102,6 +119,13 @@ class RefModel:
         # per dead subject per tick, which dominated 10k-node oracle
         # runs in the cross-validation harness.
         self._dead_knowers: Dict[int, Set[int]] = defaultdict(set)
+        # Join-propagation bookkeeping: who has learned of each joiner.
+        self._join_knowers: Dict[int, Set[int]] = defaultdict(set)
+        self.join_curve: Dict[int, List[Tuple[int, int]]] = defaultdict(list)
+        for j in self.join_tick:
+            for i in range(self.n):
+                if i != j:
+                    self.not_member[i].add(j)
         # Same Lifeguard decay the kernel uses — one source of truth.
         self._timeouts = p.timeout_table()
 
@@ -154,7 +178,11 @@ class RefModel:
         return out
 
     def _alive_truth(self, i: int) -> bool:
-        return self.fail_tick.get(i, 1 << 60) > self.tick
+        return (self.fail_tick.get(i, 1 << 60) > self.tick
+                and self._joined(i))
+
+    def _joined(self, i: int) -> bool:
+        return self.join_tick.get(i, -(1 << 60)) <= self.tick
 
     def _lost(self) -> bool:
         return self.rng.random() < self.p.loss_rate
@@ -169,10 +197,15 @@ class RefModel:
     def _transmit_limit(self) -> int:
         return self.p.transmit_limit
 
-    def _enqueue(self, i: int, msg: Message) -> None:
+    def _enqueue(self, i: int, msg: Message, originated: bool = False) -> None:
+        """``originated``: the node CREATED this message during its own
+        probe/join phase — it rides the node's own gossip burst this
+        same tick (the kernel's fresh-mark behavior).  Messages enqueued
+        while HANDLING received gossip forward from the next tick."""
         # memberlist queue invalidates older broadcasts about the same subject
         self.queues[i] = [b for b in self.queues[i] if b.msg.subject != msg.subject]
-        self.queues[i].append(Broadcast(msg, self._transmit_limit()))
+        self.queues[i].append(Broadcast(msg, self._transmit_limit(),
+                                        born=-1 if originated else self.tick))
 
     def _suspicion_timeout(self, nconf: int) -> int:
         return int(self._timeouts[min(nconf, self.p.max_confirmations)])
@@ -220,8 +253,20 @@ class RefModel:
                 # aliveNode at a newer incarnation RE-ADMITS the subject to
                 # the membership view; the old dense-set code left a
                 # refuted node permanently excluded from members[i].
+                readmitted = subject in self.not_member[i]
                 self.not_member[i].discard(subject)
                 self._dead_knowers[subject].discard(i)
+                if subject in self.join_tick:
+                    first = i not in self._join_knowers[subject]
+                    self._join_knowers[subject].add(i)
+                    # memberlist aliveNode splices a NEW member into the
+                    # probe ring at a random offset immediately (it
+                    # would otherwise wait a full sweep for reshuffle).
+                    ring = self.probe_list[i]
+                    if first and readmitted and ring is not None:
+                        pos = self.rng.randrange(len(ring) + 1)
+                        self.probe_list[i] = np.insert(
+                            ring, pos, np.int32(subject))
                 self._enqueue(i, msg)
 
     def _declare_dead(self, i: int, subject: int, b: Belief) -> None:
@@ -277,19 +322,23 @@ class RefModel:
                 b.status, b.inc, b.heard_tick = SUSPECT, inc, self.tick
                 b.confirmers = {i}  # creator seed; not a confirmation
                 self.first_suspect.setdefault(t, self.tick)
-                self._enqueue(i, Message(SUSPECT, t, inc, i))
+                self._enqueue(i, Message(SUSPECT, t, inc, i),
+                              originated=True)
             elif b.status == SUSPECT:
                 # memberlist suspectNode on an existing suspicion: the local
                 # failed probe is an independent confirmation, re-gossiped.
                 if b.confirmers is not None and i not in b.confirmers:
                     b.confirmers.add(i)
-                    self._enqueue(i, Message(SUSPECT, t, b.inc, i))
+                    self._enqueue(i, Message(SUSPECT, t, b.inc, i),
+                                  originated=True)
 
     def _gossip(self, i: int) -> None:
         if not self.queues[i] or self._member_count(i) <= 0:
             return
         targets = self._sample_members(i, self.p.fanout)
         for b in list(self.queues[i]):
+            if b.born == self.tick:
+                continue  # one hop per tick: forwarded from next tick on
             for t in targets:
                 if b.remaining <= 0:
                     break
@@ -297,6 +346,26 @@ class RefModel:
                 if self._alive_truth(t) and not self._lost():
                     self._handle(t, b.msg)
         self.queues[i] = [b for b in self.queues[i] if b.remaining > 0]
+
+    def _pushpull(self, i: int) -> None:
+        """memberlist PushPullInterval: full bidirectional state sync
+        with one random member over TCP (pushPullNode →
+        mergeRemoteState).  Each deviating belief merges through the
+        ordinary message semantics — this is what recovers rumors whose
+        retransmit budget expired before reaching everyone."""
+        partners = self._sample_members(i, 1)
+        if not partners:
+            return
+        j = partners[0]
+        if not self._alive_truth(j):
+            return  # TCP dial to a dead node fails
+        kind_of = {SUSPECT: SUSPECT, DEAD: DEAD, ALIVE: REFUTE}
+        for a, b in ((i, j), (j, i)):
+            for subject, bel in list(self.beliefs[b].items()):
+                if bel.status == ALIVE and bel.inc == 0:
+                    continue  # no information beyond the default
+                self._handle(a, Message(kind_of[bel.status], subject,
+                                        bel.inc, b))
 
     def _timers(self, i: int) -> None:
         for subject, b in list(self.beliefs[i].items()):
@@ -308,13 +377,39 @@ class RefModel:
             if self.tick - b.heard_tick >= self._suspicion_timeout(nconf):
                 self._declare_dead(i, subject, b)
 
+    def _do_join(self, j: int) -> None:
+        """Node ``j`` joins: state sync with one live contact (the TCP
+        push/pull leg of memberlist Join), then an alive@inc broadcast
+        floods through gossip (the same REFUTE message class)."""
+        self.incarnation[j] = max(1, self.incarnation[j] + 1)
+        contacts = [x for x in range(self.n)
+                    if x != j and self._alive_truth(x)]
+        if contacts:
+            c = self.rng.choice(contacts)
+            # joiner adopts the contact's membership view...
+            self.not_member[j] = set(self.not_member[c]) - {j}
+            # ...and appears in the contact's view over the same sync
+            self.not_member[c].discard(j)
+            self._join_knowers[j].add(c)
+        self.probe_list[j] = None  # fresh ring over the synced view
+        self.probe_pos[j] = 0
+        self._join_knowers[j].add(j)
+        self._enqueue(j, Message(REFUTE, j, self.incarnation[j], j),
+                      originated=True)
+
     def step(self) -> None:
         t = self.tick
+        for j, jt in self.join_tick.items():
+            if jt == t and self.fail_tick.get(j, 1 << 60) > t:
+                self._do_join(j)
         for i in range(self.n):
             if not self._alive_truth(i):
                 continue
             if (t + self.probe_offset[i]) % self.p.probe_every == 0:
                 self._probe(i)
+            if self.p.pushpull_every and \
+                    (t + self.pushpull_offset[i]) % self.p.pushpull_every == 0:
+                self._pushpull(i)
         order = list(range(self.n))
         self.rng.shuffle(order)
         for i in order:
@@ -329,6 +424,9 @@ class RefModel:
         for subject in self.dead_declared:
             self.dissemination[subject].append(
                 (t, len(self._dead_knowers[subject])))
+        for j, jt in self.join_tick.items():
+            if jt <= t:
+                self.join_curve[j].append((t, len(self._join_knowers[j])))
         self.tick += 1
 
     def run(self, ticks: int) -> None:

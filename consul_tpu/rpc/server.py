@@ -357,7 +357,10 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
         meta, out = await srv.internal.node_dump(_opts(body))
         return {"meta": _meta_wire(meta), "data": _w(out)}
 
-    @reg("Internal.EventFire", LOCAL)
+    # READ, not LOCAL: the forward() prologue routes a fire naming
+    # another datacenter over the WAN (internal_endpoint.go EventFire
+    # calls srv.forward first).
+    @reg("Internal.EventFire", READ)
     async def internal_event_fire(srv, body):
         await srv.fire_user_event(UserEvent.from_wire(body))
         return True

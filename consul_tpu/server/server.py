@@ -367,9 +367,15 @@ class Server:
 
     async def fire_user_event(self, event) -> None:
         """Broadcast a user event (consul/internal_endpoint.go EventFire →
-        serf.UserEvent).  With a gossip pool armed, the broadcaster floods
-        the cluster and local delivery arrives via the pool's own event
-        loopback; without one, deliver straight to the local sinks."""
+        serf.UserEvent).  A fire naming another datacenter forwards over
+        the WAN and floods there (EventFireRequest.Datacenter).  With a
+        gossip pool armed, the broadcaster floods the cluster and local
+        delivery arrives via the pool's own event loopback; without one,
+        deliver straight to the local sinks."""
+        dc = getattr(event, "datacenter", "")
+        if dc and dc != self.config.datacenter:
+            await self.forward_dc(dc, "Internal.EventFire", event.to_wire())
+            return
         if self.user_event_broadcaster is not None:
             self.user_event_broadcaster(event)
             return

@@ -468,6 +468,10 @@ class UserEvent(Struct):
     tag_filter: str = ""
     version: int = 1
     ltime: int = 0
+    # Target DC (EventFireRequest.Datacenter, event_endpoint.go:33-40):
+    # a fire naming another datacenter forwards over the WAN and floods
+    # THERE; empty = local DC.
+    datacenter: str = ""
 
 
 # ---------------------------------------------------------------------------

@@ -116,3 +116,25 @@ def test_event_convergence_tracks_oracle():
     assert out["completed"]["oracle"] == 3, out
     assert out["rounds_to_50pct"]["relative_error"] <= 0.15, out
     assert out["rounds_to_99pct"]["relative_error"] <= 0.15, out
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_join_churn_tracks_oracle():
+    """Concurrent joins + failures (gossip.html.markdown:10-43: joins
+    propagate as gossiped alive messages).  Gates: the SAME detection
+    gates as the static configs (p99 err <= 15%, completeness >= 0.95,
+    no false deads) with join churn running concurrently, plus the
+    join announcement's propagation latency within 15% of the oracle
+    and every join covered in both models."""
+    from consul_tpu.gossip.crossval import run_join_config
+    out = run_join_config(n=1000, n_joiners=8, n_victims=8, seeds=2)
+    assert out["completeness"]["kernel"] >= 0.95, out
+    assert out["completeness"]["refmodel"] >= 0.95, out
+    assert out["relative_error"]["p99"] is not None
+    assert out["relative_error"]["p99"] <= 0.15, out["relative_error"]
+    assert out["false_dead"]["kernel"] == 0, out
+    js = out["join_spread_rounds_to_95pct"]
+    assert js["completed"]["kernel"] == js["completed"]["expected"], js
+    assert js["completed"]["refmodel"] == js["completed"]["expected"], js
+    assert js["relative_error"] <= 0.15, js

@@ -186,3 +186,108 @@ def test_dissemination_strategies_bit_identical():
         np.testing.assert_array_equal(
             np.asarray(getattr(outs[0], name)),
             np.asarray(getattr(outs[1], name)), err_msg=name)
+
+
+def run_with_joins(p, fail_round, join_round, steps, seed=0, trace=False):
+    st = init_state(p)
+    # Unjoined ids start outside the membership.
+    st = st._replace(member=jnp.asarray(join_round == NEVER) | jnp.asarray(
+        np.zeros(p.n, bool)))
+    fr = jnp.asarray(fail_round, jnp.int32)
+    jr = jnp.asarray(join_round, jnp.int32)
+    return run_rounds(st, jax.random.key(seed), fr, p, steps, trace=trace,
+                      join_round=jr)
+
+
+def test_join_disseminates_alive_rumor():
+    """A joining node's alive@inc floods the pool on-device (gossip.html
+    behavior contract: joins propagate as gossiped alive messages)."""
+    from consul_tpu.gossip.kernel import PHASE_JOIN
+    p = small_params(n=64)
+    fail = np.full(p.n, NEVER, np.int32)
+    join = np.full(p.n, NEVER, np.int32)
+    join[13] = 10  # id 13 joins at round 10
+    st, tr = run_with_joins(p, fail, join, 60, trace=True)
+    # it became a member on-device, with a bumped incarnation
+    assert bool(st.member[13])
+    assert int(st.incarnation[13]) == 1
+    assert int(st.n_false_dead) == 0
+    # a JOIN slot carried the announcement and reached (nearly) everyone
+    phases = np.asarray(tr.slot_phase)
+    nodes = np.asarray(tr.slot_node)
+    jmask = (phases == PHASE_JOIN) & (nodes == 13)
+    assert jmask.any(), "no JOIN slot was allocated"
+    alive_counts = np.asarray(tr.n_heard_alive)
+    assert alive_counts[jmask].max() >= 0.95 * 64
+    # the slot recycled after its dissemination window
+    assert int(jnp.sum((st.slot_phase == PHASE_JOIN).astype(jnp.int32))) == 0
+
+
+def test_join_then_fail_detected():
+    """A joiner that later dies is detected like any member: the JOIN
+    slot re-arms into a suspicion episode on probe failure."""
+    p = small_params(n=64)
+    fail = np.full(p.n, NEVER, np.int32)
+    join = np.full(p.n, NEVER, np.int32)
+    join[20] = 5
+    fail[20] = 12  # dies shortly after joining
+    steps = 12 + p.slot_ttl_rounds + 40
+    st, _ = run_with_joins(p, fail, join, steps)
+    assert int(st.n_detected) == 1
+    assert int(st.n_false_dead) == 0
+    assert not bool(st.member[20])
+
+
+def test_rejoin_after_dead_verdict():
+    """Failed -> detected -> rejoins at a fresh incarnation: the stale
+    episode clears and the node is a member again (serf failed->rejoin
+    choreography, driven entirely by the join_round input)."""
+    p = small_params(n=64)
+    fail = np.full(p.n, NEVER, np.int32)
+    join = np.full(p.n, NEVER, np.int32)
+    fail[9] = 8
+    rejoin_at = 8 + p.slot_ttl_rounds + 30
+    join[9] = rejoin_at
+    # Two phases: after the restart the node answers probes again, so
+    # fail_round moves to NEVER for the rejoin window.
+    st = init_state(p)
+    fr = jnp.asarray(fail, jnp.int32)
+    jr = jnp.asarray(join, jnp.int32)
+    st, _ = run_rounds(st, jax.random.key(0), fr, p, rejoin_at, join_round=jr)
+    assert not bool(st.member[9])  # dead verdict landed
+    n_det = int(st.n_detected)
+    assert n_det == 1
+    # process restarts: answers probes again, join fires at rejoin_at
+    fail[9] = NEVER
+    st, _ = run_rounds(st, jax.random.key(0), jnp.asarray(fail), p, 60,
+                       join_round=jr)
+    assert bool(st.member[9])
+    assert int(st.incarnation[9]) >= 1
+    assert int(st.n_false_dead) == 0
+
+
+def test_join_burst_overflow_counted():
+    """More simultaneous joiners than slots: everyone still becomes a
+    member (the global flip is ground truth); lost announcement floods
+    are counted in drops, never silent."""
+    p = small_params(n=64, slots=4)
+    fail = np.full(p.n, NEVER, np.int32)
+    join = np.full(p.n, NEVER, np.int32)
+    join[10:30] = 5  # 20 joiners, 4 slots
+    st, _ = run_with_joins(p, fail, join, 40)
+    assert bool(jnp.all(st.member))
+    assert int(st.drops) > 0
+
+
+def test_no_joins_bit_identical_to_baseline():
+    """join_round=None and join_round=all-NEVER produce byte-identical
+    state to each other and to the no-join API (the join machinery is
+    free when unused)."""
+    p = small_params(n=128)
+    fail = np.full(p.n, NEVER, np.int32)
+    fail[3] = 7
+    st_none, _ = run(p, fail, 80)
+    join = np.full(p.n, NEVER, np.int32)
+    st_never, _ = run_with_joins(p, fail, join, 80)
+    for a, b, name in zip(st_none, st_never, st_none._fields):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
