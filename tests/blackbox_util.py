@@ -43,6 +43,36 @@ def _port_block() -> Dict[str, int]:
             "serf_lan": base + 3, "serf_wan": base + 4, "server": base + 5}
 
 
+class _Drain:
+    """Continuously drain a child's stdout pipe into a buffer.
+
+    A child whose pipe is never read BLOCKS once the 64 KB pipe buffer
+    fills — XLA's C++ logging alone can do that (its AOT cache-feature-
+    mismatch warnings are ~4 KB EACH), freezing the child's event loop
+    mid-write.  This bit as a gossipd daemon that compiled fine, served
+    its first probes, then wedged before sending a welcome frame."""
+
+    def __init__(self, pipe) -> None:
+        import threading
+        self._buf = bytearray()
+        self._lock = threading.Lock()
+
+        def pump():
+            try:
+                for chunk in iter(lambda: pipe.read(65536), b""):
+                    with self._lock:
+                        self._buf += chunk
+            except Exception:
+                pass
+
+        self._t = threading.Thread(target=pump, daemon=True)
+        self._t.start()
+
+    def text(self) -> str:
+        with self._lock:
+            return self._buf.decode(errors="replace")
+
+
 class TestServer:
     """One forked agent.  Not a pytest class (helper)."""
 
@@ -82,10 +112,12 @@ class TestServer:
         env.pop("PALLAS_AXON_POOL_IPS", None)  # host plane must not dial TPU
         env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))
+        env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")  # XLA C++ log spew
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "consul_tpu.cli.main", "agent",
              "-config-file", self.config_path],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        self._drain = _Drain(self.proc.stdout)
         return self
 
     def stop(self) -> None:
@@ -101,17 +133,9 @@ class TestServer:
         self.tmp.cleanup()
 
     def output(self) -> str:
-        """Diagnostic dump: kills the agent if still running (reading a
-        live process's pipe to EOF would block forever)."""
-        if self.proc is None or self.proc.stdout is None:
-            return ""
-        if self.proc.poll() is None:
-            self.proc.kill()
-        try:
-            out, _ = self.proc.communicate(timeout=5)
-            return out.decode(errors="replace")
-        except Exception:
-            return ""
+        """Diagnostic dump (the drain thread owns the pipe; safe on a
+        live process)."""
+        return self._drain.text() if self.proc is not None else ""
 
     # -- readiness (testutil/wait.go WaitForResult/WaitForLeader) ------------
 
@@ -227,9 +251,11 @@ class TestPlane:
         env["JAX_PLATFORMS"] = "cpu"   # forked plane runs the CPU kernel
         env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))
+        env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")  # XLA C++ log spew
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "consul_tpu.cli.main", *self.args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        self._drain = _Drain(self.proc.stdout)
         return self
 
     def wait_ready(self, timeout: float = 240.0) -> None:
@@ -264,12 +290,4 @@ class TestPlane:
                 self.proc.wait(5)
 
     def output(self) -> str:
-        if self.proc is None or self.proc.stdout is None:
-            return ""
-        if self.proc.poll() is None:
-            self.proc.kill()
-        try:
-            out, _ = self.proc.communicate(timeout=5)
-            return out.decode(errors="replace")
-        except Exception:
-            return ""
+        return self._drain.text() if self.proc is not None else ""
