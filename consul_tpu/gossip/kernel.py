@@ -161,47 +161,35 @@ def _age_tick(heard: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(msg > 0, aged, heard)
 
 
-def _join_tick(p: SwimParams, rnd, carry, join_round):
-    """Activate this round's joins on-device (memberlist: a join is an
+def _join_tick(p: SwimParams, rnd, carry, join_round, fail_round):
+    """Activate pending joins on-device (memberlist: a join IS an
     alive@inc message gossiped like any rumor — behavior contract
     ``website/source/docs/internals/gossip.html.markdown:10-43``,
     consumed by the leader's join path ``consul/leader.go:354-421``).
 
-    ``join_round[i] == rnd`` admits node ``i`` this round: membership
-    flips on-device, the incarnation bumps (alive@inc supersedes any
-    prior suspect/dead at the old inc — memberlist aliveNode), any
-    stale episode about the id is cleared, and a PHASE_JOIN slot is
-    allocated whose alive rumor (MSG_REFUTE — the same message class a
-    refutation floods) disseminates through the ordinary gossip path.
+    A node with ``join_round[i] <= rnd`` that is not yet a member (and
+    is not already dead by ground truth) is PENDING: when it wins a
+    rumor slot, membership flips, the incarnation bumps (alive@inc
+    supersedes any prior suspect/dead at the old inc — memberlist
+    aliveNode), any stale episode about the id is cleared, and the
+    PHASE_JOIN slot's alive rumor (MSG_REFUTE — the same message class
+    a refutation floods) disseminates through the ordinary gossip path.
 
-    Approximation (counted, not silent): at most one join per
-    segmented-min segment gets a rumor slot per round; a joiner that
-    loses the race still BECOMES a member (the global flip is ground
-    truth) but its announcement flood is lost — surfaced in ``drops``
-    and recovered by push/pull anti-entropy, exactly like a rumor that
-    aged out under loss."""
+    Join bursts are a retry queue, not a loss: at most one join per
+    segmented-min segment wins a slot per round; the rest stay pending
+    and retry next round (a join without its announcement would be a
+    member nobody can learn about — memberlist never loses the alive
+    message, it queues it).  The deferral is observable in the trace
+    as slot_start - join_round lag."""
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
     N, S = p.n, p.slots
 
-    joining = (join_round == rnd) & ~member
-    incarnation = incarnation + joining.astype(jnp.int32)
-    member = member | joining
-
-    # Clear any stale episode about a rejoining id (e.g. a dead verdict
-    # whose slot has not yet been GC'd).
-    node_c0 = jnp.clip(slot_node, 0, N - 1)
-    stale = (slot_node >= 0) & joining[node_c0]
-    heard = jnp.where(stale[:, None], jnp.uint8(0), heard)
-    slot_of_node = slot_of_node.at[jnp.where(stale, node_c0, N)].set(
-        -1, mode="drop")
-    slot_node = jnp.where(stale, -1, slot_node)
-    slot_phase = jnp.where(stale, PHASE_FREE, slot_phase)
-    slot_dead_round = jnp.where(stale, -1, slot_dead_round)
+    pending = (join_round <= rnd) & ~member & (fail_round > rnd)
 
     # JOIN-slot allocation: segmented-min compaction, the probe tick's
     # trick — O(N) work, no sort, no N-scatter.
-    masked = jnp.where(joining, jnp.arange(N, dtype=jnp.int32), N)
+    masked = jnp.where(pending, jnp.arange(N, dtype=jnp.int32), N)
     kk = min(S, N)
     GB = -(-N // kk)
     pad = kk * GB - N
@@ -216,8 +204,26 @@ def _join_tick(p: SwimParams, rnd, carry, join_round):
     rank = jnp.cumsum(in_dom.astype(jnp.int32)) - 1
     can_k = in_dom & (rank < n_free)
     slot_k = free_order[jnp.clip(rank, 0, S - 1)]
-    sidx = jnp.where(can_k, slot_k, S)  # S = out of range -> dropped
+    sidx = jnp.where(can_k, slot_k, S)  # S = out of range -> deferred
     cand_c = jnp.clip(cand, 0, N - 1)
+
+    # Winners in N-space: these ids join THIS round.
+    joining = jnp.zeros((N,), bool).at[
+        jnp.where(can_k, cand_c, N)].set(True, mode="drop")
+    incarnation = incarnation + joining.astype(jnp.int32)
+    member = member | joining
+
+    # Clear any stale episode about a rejoining winner (e.g. a dead
+    # verdict whose slot has not yet been GC'd).
+    node_c0 = jnp.clip(slot_node, 0, N - 1)
+    stale = (slot_node >= 0) & joining[node_c0]
+    heard = jnp.where(stale[:, None], jnp.uint8(0), heard)
+    slot_of_node = slot_of_node.at[jnp.where(stale, node_c0, N)].set(
+        -1, mode="drop")
+    slot_node = jnp.where(stale, -1, slot_node)
+    slot_phase = jnp.where(stale, PHASE_FREE, slot_phase)
+    slot_dead_round = jnp.where(stale, -1, slot_dead_round)
+
     slot_node = slot_node.at[sidx].set(cand_c, mode="drop")
     slot_phase = slot_phase.at[sidx].set(PHASE_JOIN, mode="drop")
     slot_inc = slot_inc.at[sidx].set(incarnation[cand_c], mode="drop")
@@ -232,9 +238,6 @@ def _join_tick(p: SwimParams, rnd, carry, join_round):
     heard = heard.at[sidx, cand_c].set(
         jnp.uint8(_enc(MSG_REFUTE, age=_AGE_FRESH)), mode="drop")
 
-    n_join = jnp.sum(joining.astype(jnp.int32))
-    served = jnp.sum(can_k.astype(jnp.int32))
-    drops = drops + (n_join - served)
     return (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
             slot_dead_round, slot_of_node, incarnation, member, drops)
 
@@ -456,12 +459,14 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
              state.slot_start, state.slot_nsusp, state.slot_dead_round,
              state.slot_of_node, state.incarnation, state.member, state.drops)
 
-    # -- 0. join tick: admit this round's joiners (alive@inc rumors).
-    # One N-compare guards the cond; no joins due -> no work.
+    # -- 0. join tick: admit pending joiners (alive@inc rumors).
+    # One N-compare guards the cond; no joins pending -> no work.
     if join_round is not None:
-        any_join = jnp.any((join_round == rnd) & ~state.member)
+        any_join = jnp.any((join_round <= rnd) & ~state.member
+                           & (fail_round > rnd))
         carry = jax.lax.cond(
-            any_join, lambda c: _join_tick(p, rnd, c, join_round),
+            any_join,
+            lambda c: _join_tick(p, rnd, c, join_round, fail_round),
             lambda c: c, carry)
 
     member_now = carry[9]
