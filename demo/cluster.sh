@@ -43,6 +43,16 @@ EOF
 up() {
   local gossip_extra=""
   rm -rf "$ROOT"; mkdir -p "$ROOT"
+  # GOSSIP_KEY=<base64 16-byte key> arms gossip encryption: agents get
+  # the serf keyring AND (with up-tpu) the plane requires keyring HMAC
+  # registration proofs — the encrypted-fabric posture on both
+  # substrates.  e.g. GOSSIP_KEY=$(head -c16 /dev/urandom | base64)
+  local encrypt_extra="" plane_encrypt=()
+  if [ -n "${GOSSIP_KEY:-}" ]; then
+    encrypt_extra='
+  "encrypt": "'$GOSSIP_KEY'",'
+    plane_encrypt=(-encrypt "$GOSSIP_KEY")
+  fi
   if [ "${1:-}" = tpu ]; then
     # Membership substrate = the SWIM kernel in the gossipd daemon:
     # suspicion/Lifeguard/refutation/dead verdicts run on-device, and
@@ -54,6 +64,7 @@ up() {
     # plane on the real chip; the demo defaults to the CPU kernel.
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS="${GOSSIPD_JAX_PLATFORMS:-cpu}" \
       python -m consul_tpu.cli.main gossipd -port $PLANE_PORT \
+      "${plane_encrypt[@]}" \
       > "$ROOT/gossipd.log" 2>&1 &
     echo $! > "$ROOT/gossipd.pid"
     echo "started gossipd (pid $(cat "$ROOT/gossipd.pid"), port $PLANE_PORT)"
@@ -67,8 +78,10 @@ up() {
     (echo > /dev/tcp/127.0.0.1/$PLANE_PORT) 2>/dev/null || {
       echo "gossip plane never came up:"; tail -5 "$ROOT/gossipd.log"; exit 1; }
   fi
-  cfg s1 0 true 3 "$gossip_extra"; cfg s2 1 true 3 "$gossip_extra"
-  cfg s3 2 true 3 "$gossip_extra"; cfg c1 3 false 0 "$gossip_extra"
+  cfg s1 0 true 3 "$gossip_extra$encrypt_extra"
+  cfg s2 1 true 3 "$gossip_extra$encrypt_extra"
+  cfg s3 2 true 3 "$gossip_extra$encrypt_extra"
+  cfg c1 3 false 0 "$gossip_extra$encrypt_extra"
   for n in s1 s2 s3 c1; do
     env -u PALLAS_AXON_POOL_IPS python -m consul_tpu.cli.main agent \
       -config-file "$ROOT/$n/config.json" > "$ROOT/$n/log" 2>&1 &

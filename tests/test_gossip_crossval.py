@@ -159,3 +159,20 @@ def test_pushpull_loss_regime_tracks_oracle():
     assert out["relative_error"]["p99"] is not None
     assert out["relative_error"]["p99"] <= 0.15, out["relative_error"]
     assert out["false_dead"]["kernel"] == 0, out["false_dead"]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_lifeguard_envelope_at_scale_with_pushpull():
+    """BASELINE table row 4 (CI-sized): Lifeguard + push/pull at scale,
+    kernel-only, gated on the row's own published criterion — detection
+    p99 inside the Lifeguard envelope, full completeness, no false
+    deads.  The artifact runs the full 100k config
+    (tools/crossval_report.py); 20k keeps this under a minute."""
+    out = run_config(20_000, 8, 1, pushpull=True, oracle=False)
+    assert out["completeness"]["kernel"] == 1.0, out["completeness"]
+    lo, hi = out["lifeguard_envelope_rounds"]
+    p99 = out["detection_latency_rounds"]["kernel"]["p99"]
+    assert lo * 0.8 <= p99 <= hi, (p99, lo, hi)
+    assert out["false_dead"]["kernel"] == 0
+    assert out["kernel_slot_drops"] == 0

@@ -109,6 +109,25 @@ def main():
     results["round_amortized_64_planes"] = timed(
         f_scan_pl, state, iters=2, warmup=1) / 64
 
+    # -- join-tick overhead: the same 64-round scan with the join input
+    # armed but quiescent (all NEVER — one N-compare + cond per round)
+    # and with active join churn (64 joins spread over the scan).  The
+    # delta over round_amortized_64 prices gossip_backend=tpu's
+    # always-on join path and the sim's join-churn regime.
+    join_quiet = jnp.full((n,), NEVER, jnp.int32)
+    f_jq = make_timed(lambda st: run_rounds(
+        st, key, fail, p, steps=64, join_round=join_quiet)[0])
+    results["round_amortized_64_joinquiet"] = timed(
+        f_jq, state, iters=2, warmup=1) / 64
+    rnd0 = int(state.round)
+    join_act = (jnp.full((n,), NEVER, jnp.int32)
+                .at[n - 64:].set(rnd0 + jnp.arange(64, dtype=jnp.int32)))
+    state_j = state._replace(member=state.member.at[n - 64:].set(False))
+    f_ja = make_timed(lambda st: run_rounds(
+        st, key, fail, p, steps=64, join_round=join_act)[0])
+    results["round_amortized_64_joinchurn"] = timed(
+        f_ja, state_j, iters=2, warmup=1) / 64
+
     # -- realistic-churn regime: 1-2 live episodes (vs the bench's 64
     # saturated slots), full tail vs the hot tier's sliced-row subset
     # pipeline.  This is the measurement VERDICT r3 asked for before
