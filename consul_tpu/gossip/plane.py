@@ -552,6 +552,26 @@ class GossipPlane:
     def members_wire(self) -> List[Dict[str, Any]]:
         return [self._member_wire(n) for n in self._nodes_by_name.values()]
 
+    def _stats_wire(self) -> Dict[str, Any]:
+        by = {"alive": 0, "failed": 0, "left": 0, "joining": 0}
+        for node in self._nodes_by_name.values():
+            by[node.status] = by.get(node.status, 0) + 1
+        st = self._state
+        return {
+            "t": "stats", "round": self._rounds_done,
+            "capacity": self.config.capacity,
+            "sim_nodes": self.config.sim_nodes,
+            "members": by,
+            "pending_joins": len(self._pending_join),
+            "event_slots_live": len(self._ev_meta),
+            # on-demand device sync: these force a fetch, which is fine
+            # for an operator query
+            "kernel": {"drops": int(st.drops),
+                       "n_detected": int(st.n_detected),
+                       "n_false_dead": int(st.n_false_dead),
+                       "n_refuted": int(st.n_refuted)},
+        }
+
     # -- bridge server -----------------------------------------------------
 
     async def _serve(self, reader: asyncio.StreamReader,
@@ -615,6 +635,11 @@ class GossipPlane:
                 elif t == "members":
                     self._send(writer, {"t": "members",
                                         "members": self.members_wire()})
+                elif t == "stats":
+                    # serf.Stats() role for the plane: kernel session
+                    # counters on demand (registered connections only —
+                    # an armed keyring must gate observability too).
+                    self._send(writer, self._stats_wire())
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:

@@ -322,6 +322,10 @@ class TpuSerfPool:
             else:
                 self._nodes[node.name] = node
             self.on_event(kind, node)
+        elif t == "stats":
+            fut = getattr(self, "_stats_future", None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
         elif t == "user":
             ltime = int(m.get("ltime", 0))
             self.event_ltime = max(self.event_ltime, ltime)
@@ -393,6 +397,19 @@ class TpuSerfPool:
                 self._bridge.send({"t": "tags", "tags": dict(tags)})
             except Exception:
                 pass
+
+    async def plane_stats(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Kernel-session counters from the plane (serf Stats() role):
+        round count, member states, pending joins, live event slots,
+        detection/refute/drop totals."""
+        if self._bridge is None:
+            return {}
+        self._stats_future = asyncio.get_event_loop().create_future()
+        self._bridge.send({"t": "stats"})
+        try:
+            return await asyncio.wait_for(self._stats_future, timeout)
+        except asyncio.TimeoutError:
+            return {}
 
     def user_event(self, name: str, payload: bytes,
                    coalesce: bool = True) -> None:

@@ -877,3 +877,33 @@ def test_duplicate_leave_is_harmless(loop):
             await b.stop()
             await plane.stop()
     loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_plane_stats_op(loop):
+    """The plane's serf.Stats() role: a registered agent can query the
+    kernel session's counters (unregistered connections get nothing —
+    an armed keyring gates observability too)."""
+    async def body():
+        c = Cluster("tpu")
+        try:
+            await c.start(["a", "b"])
+            assert await _wait(
+                lambda: len(c.pools["a"].alive_members()) == 2)
+            st = await c.pools["a"].plane_stats()
+            assert st.get("round", -1) >= 0
+            assert st["members"]["alive"] + st["members"]["joining"] == 2
+            assert st["capacity"] == 32
+            assert st["kernel"]["n_false_dead"] == 0
+            # kill b; after the verdict the stats reflect it
+            await c.kill("b")
+            assert await _wait(lambda: any(
+                k == EV_FAILED and n.name == "b"
+                for k, n in c.events["a"]), timeout=30.0)
+            st = await c.pools["a"].plane_stats()
+            assert st["members"]["failed"] == 1
+            assert st["kernel"]["n_detected"] >= 1
+        finally:
+            await c.stop()
+    loop.run_until_complete(body())
