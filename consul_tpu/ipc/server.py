@@ -140,6 +140,29 @@ class _Conn:
     async def _cmd_stats(self, seq: int) -> None:
         stats = dict(self.agent.server.stats())
         stats.update(self.agent.gossip_stats())
+        # gossip_backend=tpu: surface the plane's kernel-session
+        # counters as their own `consul info` section (the serf.Stats()
+        # role for the on-device substrate).
+        pool = getattr(self.agent, "lan_pool", None)
+        if hasattr(pool, "plane_stats"):
+            ps = await pool.plane_stats(timeout=2.0)
+            if ps:
+                k = ps.get("kernel", {})
+                m = ps.get("members", {})
+                stats["gossip_plane"] = {
+                    "round": str(ps.get("round", 0)),
+                    "capacity": str(ps.get("capacity", 0)),
+                    "sim_nodes": str(ps.get("sim_nodes", 0)),
+                    "alive": str(m.get("alive", 0)),
+                    "failed": str(m.get("failed", 0)),
+                    "left": str(m.get("left", 0)),
+                    "pending_joins": str(ps.get("pending_joins", 0)),
+                    "event_slots_live": str(ps.get("event_slots_live", 0)),
+                    "detected": str(k.get("n_detected", 0)),
+                    "refuted": str(k.get("n_refuted", 0)),
+                    "false_dead": str(k.get("n_false_dead", 0)),
+                    "slot_drops": str(k.get("drops", 0)),
+                }
         self._send({"Seq": seq, "Error": ""}, stats)
 
     async def _cmd_leave(self, seq: int) -> None:

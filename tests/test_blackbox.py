@@ -315,6 +315,10 @@ class TestTpuBackendBlackBox:
                 time.sleep(0.3)
             assert all(n in out.stdout for n in names), out.stdout
             assert "alive" in out.stdout, out.stdout
+            # `consul info` surfaces the plane's kernel counters
+            info = servers[0].cli("info")
+            assert "gossip_plane" in info.stdout, info.stdout
+            assert "backend = tpu" in info.stdout, info.stdout
             # the catalog converged through reconcile: all 3 nodes
             nodes = servers[0].http_get("/v1/catalog/nodes")
             got = {n["Node"] for n in nodes}
