@@ -337,12 +337,32 @@ class GossipPlane:
     def _reap_tombstones(self) -> None:
         """Drop "left" names whose tombstone window expired (serf's
         tombstone reap): without this, node-name churn grows the member
-        list and every welcome snapshot without bound."""
-        cutoff = time.monotonic() - self.config.tombstone_timeout_s
+        list and every welcome snapshot without bound.  Also release
+        registrations that died MID-JOIN: a node whose heartbeats
+        lapsed before the kernel ever admitted it was never announced
+        to anyone — it simply ceases (otherwise its id leaks and
+        welcome snapshots list a ghost forever)."""
+        now = time.monotonic()
+        cutoff = now - self.config.tombstone_timeout_s
         for name in [n for n, node in self._nodes_by_name.items()
                      if node.status == "left" and node.id < 0
                      and node.left_at < cutoff]:
             del self._nodes_by_name[name]
+        from consul_tpu.gossip.kernel import NEVER
+        ghost_cutoff = now - max(10 * self.config.hb_lapse_s, 5.0)
+        for node in [n for n in self._nodes_by_id.values()
+                     if n.status == "joining"
+                     and self._hb_at[n.id] < ghost_cutoff]:
+            i = node.id
+            self._eligible[i] = False
+            self._alive_mask[i] = False
+            self._join[i] = int(NEVER)
+            self._fail[i] = int(NEVER)
+            self._pending_join.pop(i, None)
+            self._nodes_by_id.pop(i, None)
+            self._nodes_by_name.pop(node.name, None)
+            self._free_ids.append(i)
+            node.id = -1
 
     def _dispatch(self) -> None:
         """Advance the kernel by STEPS_PER_TICK rounds and fan out the

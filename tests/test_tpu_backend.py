@@ -907,3 +907,44 @@ def test_plane_stats_op(loop):
         finally:
             await c.stop()
     loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_ghost_registration_reaped(loop):
+    """A node that dies mid-join (registered, heartbeats lapsed before
+    the kernel ever admitted it) was never announced to anyone — it
+    must cease entirely: id released, no ghost in welcome snapshots."""
+    import time as _time
+
+    async def body():
+        plane = GossipPlane(PlaneConfig(
+            bind_port=0, capacity=8, slots=8, gossip_interval_s=0.02,
+            suspicion_mult=1.0, hb_lapse_s=0.2))
+        await plane.start()
+        try:
+            class _W:
+                def write(self, b):
+                    pass
+
+                def close(self):
+                    pass
+
+            node, err = plane._register(
+                {"name": "ghost", "addr": "", "port": 0, "tags": {}}, _W())
+            assert node is not None, err
+            gid = node.id
+            free_before = len(plane._free_ids)
+            # died instantly: failing since round 0, last hb long ago
+            plane._fail[gid] = 0
+            plane._hb_at[gid] = _time.monotonic() - 100
+            # ghost window is max(10*hb_lapse, 5s)
+            assert await _wait(
+                lambda: "ghost" not in plane._nodes_by_name, timeout=12.0)
+            assert gid not in plane._nodes_by_id
+            assert len(plane._free_ids) == free_before + 1
+            assert not any(m["name"] == "ghost"
+                           for m in plane.members_wire())
+        finally:
+            await plane.stop()
+    loop.run_until_complete(body())
