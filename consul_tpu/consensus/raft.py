@@ -321,10 +321,16 @@ class RaftNode:
         (raft.Apply / raftApply, consul/rpc.go:280-297)."""
         return await self._submit(LOG_COMMAND, data, timeout)
 
-    async def barrier(self, timeout: float = 30.0) -> None:
+    async def barrier(self, timeout: float = 30.0) -> int:
         """Commit round-trip proving current leadership (raft.Barrier /
-        VerifyLeader, consul/rpc.go:413-417)."""
-        await self._submit(LOG_BARRIER, b"", timeout)
+        VerifyLeader, consul/rpc.go:413-417).  Returns the barrier
+        entry's log index: once it commits, every entry below it is
+        committed under the CURRENT term — the Raft §6.4 precondition
+        for serving ReadIndex (a fresh leader's commit_index may lag
+        entries its predecessor acked until its first own-term commit)."""
+        _, index = await self._submit(LOG_BARRIER, b"", timeout,
+                                      with_index=True)
+        return index
 
     async def wait_applied(self, index: int, timeout: float = 30.0) -> None:
         """Block until the local FSM has applied up through ``index`` —
@@ -353,7 +359,8 @@ class RaftNode:
         await self._submit(LOG_CONFIGURATION,
                            msgpack.packb(new, use_bin_type=True), timeout)
 
-    async def _submit(self, type_: int, data: bytes, timeout: float) -> Any:
+    async def _submit(self, type_: int, data: bytes, timeout: float,
+                      with_index: bool = False) -> Any:
         """Group commit (hashicorp/raft's applyBatch): entries submitted
         in the same event-loop tick are buffered and land in ONE
         log.append — one fsync for the whole batch — before replication
@@ -376,7 +383,8 @@ class RaftNode:
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_event_loop().call_soon(self._flush_appends)
-        return await asyncio.wait_for(fut, timeout)
+        result = await asyncio.wait_for(fut, timeout)
+        return (result, entry.index) if with_index else result
 
     def _flush_appends(self) -> None:
         self._flush_scheduled = False

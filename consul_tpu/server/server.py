@@ -145,6 +145,10 @@ class Server:
         self.lan_members_fn: Optional[Any] = None
         self.user_event_broadcaster: Optional[Any] = None
         self._barrier_inflight: Optional[asyncio.Future] = None
+        # ReadIndex batching (follower consistent reads): the unfired
+        # batch new reads may join + the previously-running batch.
+        self._ri_batch: Optional[dict] = None
+        self._ri_prev: Optional[asyncio.Future] = None
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
@@ -251,52 +255,94 @@ class Server:
         Where the reference ships every ?consistent request to the
         leader in full, this costs the leader one index round-trip and
         keeps the read (and its blocking-query machinery) on the node
-        that received it.
-
-        Concurrent consistent reads coalesce onto one in-flight
-        confirmation: any confirmation that completes after a read
-        arrived proves what that read needs (leadership held / local
-        state caught up to a post-arrival leader index), so sharing is
-        safe and turns a round-trip-per-read into one per batch."""
-        fut = self._barrier_inflight
-        if fut is None or fut.done():
-            fut = asyncio.ensure_future(self._leadership_confirmation())
-            self._barrier_inflight = fut
+        that received it."""
         try:
-            await asyncio.shield(fut)
+            if self.raft.is_leader() or self.pool is None:
+                await self._leader_confirm()
+            else:
+                await self._follower_confirm()
         except RaftNotLeaderError as e:
             raise NotLeaderError(str(e)) from e
 
-    async def _leadership_confirmation(self) -> None:
-        if self.raft.is_leader() or self.pool is None:
-            # Leader (or no mesh to forward over — single node): the
-            # classic barrier; a stale self-belief surfaces as
-            # NotLeaderError exactly as before.
-            await self.raft.barrier(timeout=ENQUEUE_LIMIT)
-        else:
+    async def _leader_confirm(self) -> int:
+        """Coalesced leader barrier; returns the read-safe index
+        (everything below the barrier entry is committed under the
+        CURRENT term once it lands — Raft §6.4's precondition: a fresh
+        leader's commit_index may lag entries its predecessor acked
+        until its first own-term commit).  Sharing an IN-FLIGHT barrier
+        is safe here: the proof each leader-local read needs is only
+        "leadership held at some moment after the read arrived", which
+        any post-arrival completion supplies."""
+        fut = self._barrier_inflight
+        if fut is None or fut.done():
+            async def _run():
+                return await self.raft.barrier(timeout=ENQUEUE_LIMIT) - 1
+            fut = asyncio.ensure_future(_run())
+            self._barrier_inflight = fut
+        return await asyncio.shield(fut)
+
+    async def _follower_confirm(self) -> None:
+        """ReadIndex with BATCHED-not-shared in-flight handling: a read
+        may only ride a confirmation whose index sample happens after
+        the read arrived — joining one already in flight could reuse an
+        index recorded before a write this read must observe was acked.
+        Reads therefore join the batch that has not FIRED yet; one
+        batch runs at a time, so a 64-way burst still costs one index
+        round-trip per batch."""
+        b = self._ri_batch
+        if b is None or b["fired"]:
+            b = self._ri_batch = {
+                "fut": asyncio.get_event_loop().create_future(),
+                "fired": False}
+            asyncio.get_event_loop().create_task(self._run_ri_batch(b))
+        await b["fut"]
+
+    async def _run_ri_batch(self, b: dict) -> None:
+        from consul_tpu.rpc.pool import RPCError
+        try:
+            prev = self._ri_prev
+            if prev is not None and not prev.done():
+                try:
+                    await prev  # serialize batches; its failure is its own
+                except Exception:
+                    pass
+            b["fired"] = True   # new arrivals form the next batch
+            self._ri_prev = b["fut"]
             out = await self.forward_leader("Server.ReadIndex", {})
             await self.raft.wait_applied(int(out["index"]),
                                          timeout=ENQUEUE_LIMIT)
+            if not b["fut"].done():
+                b["fut"].set_result(None)
+        except Exception as e:
+            # Keep the exported exception contract: a remote not-leader
+            # rejection (stringified over the wire) is a NotLeaderError
+            # to callers, exactly as the local barrier path raises.
+            if isinstance(e, (RPCError, RaftNotLeaderError)) and \
+                    "leader" in str(e).lower():
+                e = NotLeaderError(str(e))
+            if not b["fut"].done():
+                b["fut"].set_exception(e)
 
     async def leader_read_index(self) -> int:
-        """Server.ReadIndex target: leadership-verified commit index.
-        Leader-only by construction — a stale route must fail the one
-        hop loudly, never bounce between nodes that each think the
-        other leads.
+        """Server.ReadIndex target: leadership-verified read-safe index.
+        Leader-only by construction — it goes straight to the local
+        barrier (never the follower path), so a deposed node fails its
+        one hop loudly instead of forwarding onward and returning a
+        stale index, and routes never bounce between nodes that each
+        think the other leads.
 
-        Per the protocol the index is RECORDED BEFORE the leadership
-        confirmation: it covers every write acked before the caller's
-        read arrived (sufficient for linearizability), and crucially it
-        does NOT include the barrier entry itself — a follower waiting
-        for the barrier to replicate would stall a heartbeat interval
-        per batch (measured: consistent reads at 228/s, p50 279 ms;
-        with the pre-barrier index the catch-up is usually already
-        satisfied)."""
+        The returned index excludes the barrier entry itself: the
+        entries below it cover every previously-acked write (the
+        barrier's own replication round also teaches followers that
+        commit level), while making followers wait for the barrier
+        ENTRY to apply stalled a heartbeat interval per batch
+        (measured: 228/s at p50 279 ms vs 3741/s after)."""
         if not self.raft.is_leader():
             raise NotLeaderError("not the leader")
-        idx = int(self.raft.commit_index)
-        await self.consistent_read_barrier()  # coalesced leader barrier
-        return idx
+        try:
+            return await self._leader_confirm()
+        except RaftNotLeaderError as e:
+            raise NotLeaderError(str(e)) from e
 
     def endpoint(self, name: str):
         return self._endpoints[name]

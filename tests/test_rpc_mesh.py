@@ -369,3 +369,37 @@ class TestFollowerConsistentReads:
             await _shutdown(servers)
 
         loop.run_until_complete(body())
+
+    def test_ri_batching_never_joins_fired_confirmation(self, loop):
+        """A read may only ride a ReadIndex confirmation whose index
+        sample postdates its arrival: reads arriving while a batch's
+        RPC is in flight form a NEW batch (two RPCs), while reads
+        arriving before the batch fires share it (one RPC)."""
+        async def body():
+            servers = await _mk_cluster(3)
+            follower = next(srv for srv, _ in servers
+                            if not srv.is_leader())
+            calls = []
+            orig = follower.forward_leader
+
+            async def slow(method, body):
+                calls.append(method)
+                await asyncio.sleep(0.15)
+                return await orig(method, body)
+
+            follower.forward_leader = slow
+            # same-burst reads share one confirmation
+            t1 = asyncio.ensure_future(follower.consistent_read_barrier())
+            t2 = asyncio.ensure_future(follower.consistent_read_barrier())
+            await asyncio.gather(t1, t2)
+            assert len(calls) == 1, calls
+            # a read arriving mid-flight gets its own (post-arrival) one
+            calls.clear()
+            t1 = asyncio.ensure_future(follower.consistent_read_barrier())
+            await asyncio.sleep(0.05)   # batch 1 fired, RPC in flight
+            t2 = asyncio.ensure_future(follower.consistent_read_barrier())
+            await asyncio.gather(t1, t2)
+            assert len(calls) == 2, calls
+            await _shutdown(servers)
+
+        loop.run_until_complete(body())
