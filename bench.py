@@ -14,17 +14,22 @@ and the dense-regime roofline estimate:
 
     {"metric": ..., "value": N, "unit": "rounds/s", "vs_baseline": N,
      "regimes": {"healthy": {...}, "churn1000ppm": {...},
-                 "churn1000ppm_planes": {...}, "multidc": {...}},
+                 "churn1000ppm_planes": {...},
+                 "realistic_churn10ppm": {...},
+                 "realistic_churn10ppm_hot8": {...}, "multidc": {...}},
      "roofline_rounds_per_sec": N, ...}
 
-(churn1000ppm vs churn1000ppm_planes is the dissemination-strategy A/B
-— params.dissem_swar — so the better lowering is picked from captured
-evidence.)
+Two A/Bs ride the table so pending lowering decisions are settled by
+whatever capture next reaches a chip: churn1000ppm vs _planes is the
+dissemination-strategy A/B (params.dissem_swar), and
+realistic_churn10ppm vs _hot8 is the hot-tier decision
+(params.hot_slots) in the 1-2-live-episode regime the tier exists for.
 
-The headline metric/value is the healthy-cluster regime (the operating
-point for BASELINE's scale posture — see BENCH_NOTES.md §1c for the
-churn-rate calibration); the churn row is the stress bound.  Flags
-(--multidc / --churn-ppm / --n) still run a single regime for manual
+The headline metric/value is the historical churn1000ppm stress regime
+(cross-round comparability); the healthy row is the operating point
+for BASELINE's scale posture — see BENCH_NOTES.md §1c for the
+churn-rate calibration.  Flags (--multidc / --churn-ppm / --n /
+--hot-slots / --dissem) still run a single regime for manual
 profiling sessions.
 
 All progress/diagnostics go to stderr.  Resilience (round-1 failure was
@@ -159,13 +164,15 @@ def _sync(jax, state) -> None:
 
 
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
-               churn_ppm: int = 1000, dissem_swar: bool = True) -> dict:
+               churn_ppm: int = 1000, dissem_swar: bool = True,
+               hot_slots: int = 0) -> dict:
     import jax.numpy as jnp
 
     from consul_tpu.gossip.kernel import init_state, run_rounds
     from consul_tpu.gossip.params import lan_profile
 
-    p = lan_profile(n, slots=slots, dissem_swar=dissem_swar)
+    p = lan_profile(n, slots=slots, dissem_swar=dissem_swar,
+                    hot_slots=hot_slots)
     state = init_state(p)
     key = jax.random.PRNGKey(42)
     # Steady-state failure churn (default 0.1% of nodes, staggered over
@@ -205,6 +212,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
     return {
         "metric": (f"swim_gossip_rounds_per_sec_{n}_nodes"
                    + ("" if churn_ppm == 1000 else f"_churn{churn_ppm}ppm")
+                   + (f"_hot{hot_slots}" if hot_slots else "")
                    + ("" if dissem_swar else "_planes")),
         "value": round(rps, 1),
         "unit": "rounds/s",
@@ -212,6 +220,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         "compile_s": round(compile_s, 1),
         "n_nodes": n,
         "dissem": "swar" if dissem_swar else "planes",
+        "hot_slots": hot_slots,
     }
 
 
@@ -277,18 +286,18 @@ _LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # [+ "_planes" for the fallback dissemination strategy].
 _METRIC_RE = re.compile(
     r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
-    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(_planes)?$")
+    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?(_planes)?$")
 
 
 def _regime_key(multidc: bool, churn_ppm: int,
-                planes: bool = False) -> tuple:
+                planes: bool = False, hot: int = 0) -> tuple:
     """Cache-matching key: bench variant + churn regime + dissemination
     strategy, size-agnostic.  The default LAN run (churn 1000 ppm) has
     NO suffix historically, so the regime must be recovered from the
     parsed name, not a string prefix — a churn-0 quiescent entry is
     ~10x the churned number and must never stand in for it."""
     return ("multidc" if multidc else "gossip",
-            None if multidc else churn_ppm, planes)
+            None if multidc else churn_ppm, planes, hot)
 
 
 def _parse_metric_regime(name: str) -> tuple | None:
@@ -299,7 +308,8 @@ def _parse_metric_regime(name: str) -> tuple | None:
     variant = m.group(1)
     churn = int(m.group(3)) if m.group(3) is not None else 1000
     return (variant, None if variant == "multidc" else churn,
-            m.group(5) is not None)
+            m.group(6) is not None,
+            int(m.group(5)) if m.group(5) is not None else 0)
 
 
 def _read_cache() -> dict:
@@ -324,13 +334,14 @@ def _same_platform_class(a: str, b: str) -> bool:
 
 
 def _read_last_good(multidc: bool, churn_ppm: int, planes: bool = False,
+                    hot: int = 0,
                     platform: str | None = None) -> dict | None:
     """Last cached measurement of this exact regime (variant + churn +
     strategy) ON THIS BACKEND PLATFORM CLASS, preferring the largest n.
     A CPU smoke run must never stand in for a chip measurement (or vice
     versa); "axon"/"tpu"/untagged are all the chip class.  A corrupt
     cache must never take down the metric emit."""
-    want = _regime_key(multidc, churn_ppm, planes)
+    want = _regime_key(multidc, churn_ppm, planes, hot)
     plat = platform if platform is not None else _PLATFORM
     candidates = [
         v for k, v in _read_cache().items()
@@ -357,7 +368,7 @@ def _store_result(result: dict) -> None:
 
 
 def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
-                dissem_swar: bool = True) -> dict:
+                dissem_swar: bool = True, hot_slots: int = 0) -> dict:
     """One regime with reduced-N fallback.  Returns a result dict; on
     total failure returns an error dict carrying the regime-matched
     last-known-good."""
@@ -373,7 +384,8 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
             else:
                 result = _bench_lan(jax, n, args.slots, args.steps,
                                     args.repeats, churn_ppm=churn_ppm,
-                                    dissem_swar=dissem_swar)
+                                    dissem_swar=dissem_swar,
+                                    hot_slots=hot_slots)
             if n != args.n:
                 result["reduced_from_n"] = args.n
             _store_result(result)
@@ -390,7 +402,7 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                "vs_baseline": 0.0,
                "error": f"all sizes failed; last: "
                         f"{type(last_err).__name__}: {last_err}"}
-    last = _read_last_good(multidc, churn_ppm, not dissem_swar)
+    last = _read_last_good(multidc, churn_ppm, not dissem_swar, hot_slots)
     if last is not None:
         payload["last_known_good"] = last
     return payload
@@ -419,6 +431,9 @@ def main() -> None:
     ap.add_argument("--dissem", choices=("swar", "planes"), default="swar",
                     help="dissemination strategy for single-regime runs "
                          "(the table always measures both)")
+    ap.add_argument("--hot-slots", dest="hot_slots", type=int, default=0,
+                    help="hot-tier width for single-regime runs "
+                         "(the table A/Bs full vs hot8 at realistic churn)")
     args = ap.parse_args()
 
     single_regime = args.multidc or args.churn_ppm is not None
@@ -450,6 +465,10 @@ def main() -> None:
                 "churn1000ppm": _read_last_good(False, 1000, platform=plat),
                 "churn1000ppm_planes": _read_last_good(
                     False, 1000, planes=True, platform=plat),
+                "realistic_churn10ppm": _read_last_good(
+                    False, 10, platform=plat),
+                "realistic_churn10ppm_hot8": _read_last_good(
+                    False, 10, hot=8, platform=plat),
                 "multidc": _read_last_good(True, 0, platform=plat),
             }
             payload["regimes_last_known_good"] = {
@@ -462,7 +481,8 @@ def main() -> None:
     if single_regime:
         churn = args.churn_ppm if args.churn_ppm is not None else 1000
         _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn,
-                          dissem_swar=args.dissem == "swar"))
+                          dissem_swar=args.dissem == "swar",
+                          hot_slots=args.hot_slots))
         return
 
     # -- default: the full regime table, one JSON line -------------------
@@ -475,6 +495,14 @@ def main() -> None:
     # (params.dissem_swar), not hope.
     regimes["churn1000ppm_planes"] = _run_regime(
         jax, args, multidc=False, churn_ppm=1000, dissem_swar=False)
+    # Hot-tier A/B at realistic churn (1-2 live episodes — the regime
+    # the tier exists for; bench churn is ~100x real failure rates):
+    # the captured pair IS the hot_slots default decision the last two
+    # rounds could not make without chip access.
+    regimes["realistic_churn10ppm"] = _run_regime(
+        jax, args, multidc=False, churn_ppm=10)
+    regimes["realistic_churn10ppm_hot8"] = _run_regime(
+        jax, args, multidc=False, churn_ppm=10, hot_slots=8)
     regimes["multidc"] = _run_regime(jax, args, multidc=True, churn_ppm=0)
 
     # The historical churn regime stays the headline so cross-round
