@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
-from consul_tpu.gossip.kernel import gossip_offsets
+from consul_tpu.gossip.kernel import alloc_free_slots, gossip_offsets
 
 _SEEN = 0x80
 _AGE_MASK = 0x0F
@@ -78,13 +78,7 @@ def fire_events(state: EventState, nodes: jnp.ndarray) -> EventState:
     UserEvent stamps the next time)."""
     E = state.has.shape[0]
     want = nodes >= 0
-    free = ~state.slot_used
-    free_order = jnp.argsort(jnp.where(free, 0, 1), stable=True).astype(jnp.int32)
-    n_free = jnp.sum(free)
-    rank = jnp.cumsum(want.astype(jnp.int32)) - 1
-    can = want & (rank < n_free)
-    slot_for = free_order[jnp.clip(rank, 0, E - 1)]
-    sidx = jnp.where(can, slot_for, E)
+    can, _slot_for, sidx = alloc_free_slots(~state.slot_used, want)
     node_c = jnp.clip(nodes, 0, state.node_ltime.shape[0] - 1)
 
     fire_lt = state.node_ltime[node_c] + 1

@@ -161,6 +161,25 @@ def _age_tick(heard: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(msg > 0, aged, heard)
 
 
+def alloc_free_slots(free: jnp.ndarray, want: jnp.ndarray):
+    """Rank the True entries of ``want`` onto the free slots of ``free``
+    in ascending slot order — the shared compaction behind suspicion
+    slots (probe tick), JOIN slots, and event slots (events.fire_events).
+    Returns ``(can, slot_ids, sidx)``: ``can`` marks served entries,
+    ``slot_ids`` their slots, and ``sidx`` equals the slot id for served
+    entries and ``len(free)`` (out-of-range, for ``mode='drop'``
+    scatters) otherwise."""
+    S = free.shape[0]
+    free_order = jnp.argsort(jnp.where(free, 0, 1),
+                             stable=True).astype(jnp.int32)
+    n_free = jnp.sum(free)
+    rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+    can = want & (rank < n_free)
+    slot_ids = free_order[jnp.clip(rank, 0, S - 1)]
+    sidx = jnp.where(can, slot_ids, S)
+    return can, slot_ids, sidx
+
+
 def _join_tick(p: SwimParams, rnd, carry, join_round, fail_round):
     """Activate pending joins on-device (memberlist: a join IS an
     alive@inc message gossiped like any rumor — behavior contract
@@ -197,14 +216,7 @@ def _join_tick(p: SwimParams, rnd, carry, join_round, fail_round):
                 if pad else masked)
     cand = jnp.min(masked_p.reshape(kk, GB), axis=1)
     in_dom = cand < N
-    free = slot_node < 0
-    free_order = jnp.argsort(jnp.where(free, 0, 1),
-                             stable=True).astype(jnp.int32)
-    n_free = jnp.sum(free)
-    rank = jnp.cumsum(in_dom.astype(jnp.int32)) - 1
-    can_k = in_dom & (rank < n_free)
-    slot_k = free_order[jnp.clip(rank, 0, S - 1)]
-    sidx = jnp.where(can_k, slot_k, S)  # S = out of range -> deferred
+    can_k, slot_k, sidx = alloc_free_slots(slot_node < 0, in_dom)
     cand_c = jnp.clip(cand, 0, N - 1)
 
     # Winners in N-space: these ids join THIS round.
@@ -384,14 +396,7 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
                 if pad_b else masked)
     cand = jnp.min(masked_p.reshape(kk, GB), axis=1)
     in_dom = cand < N
-
-    free = ~valid
-    free_order = jnp.argsort(jnp.where(free, 0, 1), stable=True).astype(jnp.int32)
-    n_free = jnp.sum(free)
-    rank = jnp.cumsum(in_dom.astype(jnp.int32)) - 1
-    can_k = in_dom & (rank < n_free)
-    slot_k = free_order[jnp.clip(rank, 0, S - 1)]
-    sidx = jnp.where(can_k, slot_k, S)  # S = out of range -> dropped
+    can_k, slot_k, sidx = alloc_free_slots(~valid, in_dom)
     cand_c = jnp.clip(cand, 0, N - 1)
     slot_node = slot_node.at[sidx].set(cand_c, mode="drop")
     slot_phase = slot_phase.at[sidx].set(PHASE_SUSPECT, mode="drop")

@@ -363,6 +363,18 @@ class GossipPlane:
             self._nodes_by_name.pop(node.name, None)
             self._free_ids.append(i)
             node.id = -1
+            # Kill any still-open session: a revenant whose heartbeats
+            # resume AFTER the reap must re-register through the redial
+            # path (fresh id, fresh welcome) — the hb handler cannot
+            # re-admit an id-less node, and a zombie that believes it
+            # is a member while the plane no longer lists it is worse
+            # than a reconnect.
+            if node.writer is not None:
+                try:
+                    node.writer.close()
+                except Exception:
+                    pass
+                node.writer = None
 
     def _dispatch(self) -> None:
         """Advance the kernel by STEPS_PER_TICK rounds and fan out the

@@ -401,13 +401,19 @@ class TpuSerfPool:
     async def plane_stats(self, timeout: float = 5.0) -> Dict[str, Any]:
         """Kernel-session counters from the plane (serf Stats() role):
         round count, member states, pending joins, live event slots,
-        detection/refute/drop totals."""
+        detection/refute/drop totals.  Concurrent callers share one
+        in-flight request — stats are idempotent, and overwriting a
+        pending future would orphan the earlier caller into its full
+        timeout."""
         if self._bridge is None:
             return {}
-        self._stats_future = asyncio.get_event_loop().create_future()
-        self._bridge.send({"t": "stats"})
+        fut = getattr(self, "_stats_future", None)
+        if fut is None or fut.done():
+            fut = self._stats_future = \
+                asyncio.get_event_loop().create_future()
+            self._bridge.send({"t": "stats"})
         try:
-            return await asyncio.wait_for(self._stats_future, timeout)
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
         except asyncio.TimeoutError:
             return {}
 

@@ -145,10 +145,11 @@ class Server:
         self.lan_members_fn: Optional[Any] = None
         self.user_event_broadcaster: Optional[Any] = None
         self._barrier_inflight: Optional[asyncio.Future] = None
-        # ReadIndex batching (follower consistent reads): the unfired
-        # batch new reads may join + the previously-running batch.
-        self._ri_batch: Optional[dict] = None
-        self._ri_prev: Optional[asyncio.Future] = None
+        # ReadIndex batching: per-key unfired batch new confirmations
+        # may join + the previously-running batch (keys: follower_ri,
+        # leader_ri).
+        self._confirm_batches: Dict[str, dict] = {}
+        self._confirm_prev: Dict[str, asyncio.Future] = {}
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
@@ -289,30 +290,45 @@ class Server:
         Reads therefore join the batch that has not FIRED yet; one
         batch runs at a time, so a 64-way burst still costs one index
         round-trip per batch."""
-        b = self._ri_batch
+        await self._confirm_batched("follower_ri", self._ri_follower_runner)
+
+    async def _ri_follower_runner(self):
+        out = await self.forward_leader("Server.ReadIndex", {})
+        await self.raft.wait_applied(int(out["index"]),
+                                     timeout=ENQUEUE_LIMIT)
+
+    async def _ri_leader_runner(self):
+        return await self.raft.barrier(timeout=ENQUEUE_LIMIT) - 1
+
+    async def _confirm_batched(self, key: str, runner):
+        """Join the unfired confirmation batch for ``key`` (create one
+        if none is forming); batches run serially.  The fired flag is
+        the linearizability hinge: work for a batch (index sample /
+        barrier append) only starts after the batch stops accepting
+        joiners, so every joiner's arrival precedes it."""
+        b = self._confirm_batches.get(key)
         if b is None or b["fired"]:
-            b = self._ri_batch = {
+            b = self._confirm_batches[key] = {
                 "fut": asyncio.get_event_loop().create_future(),
                 "fired": False}
-            asyncio.get_event_loop().create_task(self._run_ri_batch(b))
-        await b["fut"]
+            asyncio.get_event_loop().create_task(
+                self._run_confirm_batch(key, b, runner))
+        return await b["fut"]
 
-    async def _run_ri_batch(self, b: dict) -> None:
+    async def _run_confirm_batch(self, key: str, b: dict, runner) -> None:
         from consul_tpu.rpc.pool import RPCError
         try:
-            prev = self._ri_prev
+            prev = self._confirm_prev.get(key)
             if prev is not None and not prev.done():
                 try:
                     await prev  # serialize batches; its failure is its own
                 except Exception:
                     pass
             b["fired"] = True   # new arrivals form the next batch
-            self._ri_prev = b["fut"]
-            out = await self.forward_leader("Server.ReadIndex", {})
-            await self.raft.wait_applied(int(out["index"]),
-                                         timeout=ENQUEUE_LIMIT)
+            self._confirm_prev[key] = b["fut"]
+            result = await runner()
             if not b["fut"].done():
-                b["fut"].set_result(None)
+                b["fut"].set_result(result)
         except Exception as e:
             # Keep the exported exception contract: a remote not-leader
             # rejection (stringified over the wire) is a NotLeaderError
@@ -331,16 +347,21 @@ class Server:
         stale index, and routes never bounce between nodes that each
         think the other leads.
 
-        The returned index excludes the barrier entry itself: the
-        entries below it cover every previously-acked write (the
-        barrier's own replication round also teaches followers that
-        commit level), while making followers wait for the barrier
-        ENTRY to apply stalled a heartbeat interval per batch
-        (measured: 228/s at p50 279 ms vs 3741/s after)."""
+        BATCHED, not shared: joining a barrier already in flight when
+        this RPC arrived could return an index sampled before a write
+        the calling follower's read must observe (the share-in-flight
+        argument only covers leader-LOCAL reads, where the ack implies
+        the leader has applied the write).  The returned index excludes
+        the barrier entry itself: the entries below it cover every
+        previously-acked write (the barrier's own replication round
+        also teaches followers that commit level), while making
+        followers wait for the barrier ENTRY to apply stalled a
+        heartbeat interval per batch (228/s at p50 279 ms vs 3741/s)."""
         if not self.raft.is_leader():
             raise NotLeaderError("not the leader")
         try:
-            return await self._leader_confirm()
+            return await self._confirm_batched("leader_ri",
+                                               self._ri_leader_runner)
         except RaftNotLeaderError as e:
             raise NotLeaderError(str(e)) from e
 
