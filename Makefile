@@ -33,10 +33,12 @@ test: vet
 integ:
 	$(PYTEST) tests/test_blackbox.py tests/test_linearizability.py
 
-# Static checks: byte-compile every source file (the cheap `go vet`
+# Static checks: byte-compile every source file, then the AST pass
+# (tools/pyvet.py: undefined names + unused imports — the `go vet`
 # role in an image without a Python linter).
 vet:
 	$(PYTHON) -m compileall -q consul_tpu tests tools bench.py __graft_entry__.py
+	$(PYTHON) tools/pyvet.py consul_tpu tests
 
 # North-star benchmark (needs the real chip; emits one JSON line).
 bench:

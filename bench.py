@@ -165,15 +165,21 @@ def _sync(jax, state) -> None:
 
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
                churn_ppm: int = 1000, dissem_swar: bool = True,
-               hot_slots: int = 0) -> dict:
+               hot_slots: int = 0, flight: bool = False) -> dict:
     import jax.numpy as jnp
 
-    from consul_tpu.gossip.kernel import init_state, run_rounds
+    from consul_tpu.gossip.kernel import init_flight, init_state, run_rounds
     from consul_tpu.gossip.params import lan_profile
 
     p = lan_profile(n, slots=slots, dissem_swar=dissem_swar,
                     hot_slots=hot_slots)
     state = init_state(p)
+    # Flight-recorder overhead regime: the on-device ring rides the
+    # scan carry exactly as the gossip plane runs it; the ring is NOT
+    # drained inside timed blocks (the plane amortizes drains over
+    # >= 64 rounds, off the hot path), so the measured delta is the
+    # pure in-kernel recording cost.
+    fl = init_flight() if flight else None
     key = jax.random.PRNGKey(42)
     # Steady-state failure churn (default 0.1% of nodes, staggered over
     # warmup AND every timed block, so probe/suspect/dead/GC paths stay
@@ -194,7 +200,11 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
 
     _log(f"lan n={n} slots={slots}: compiling + warmup ({steps} rounds)")
     t0 = time.perf_counter()
-    state, _ = run_rounds(state, key, fail_round, p, steps=steps)
+    if flight:
+        (state, fl), _ = run_rounds(state, key, fail_round, p, steps=steps,
+                                    flight=fl)
+    else:
+        state, _ = run_rounds(state, key, fail_round, p, steps=steps)
     _sync(jax, state)
     compile_s = time.perf_counter() - t0
     _log(f"compile+warmup done in {compile_s:.1f}s")
@@ -202,18 +212,23 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
     best = float("inf")
     for r in range(repeats):
         t0 = time.perf_counter()
-        state, _ = run_rounds(state, key, fail_round, p, steps=steps)
+        if flight:
+            (state, fl), _ = run_rounds(state, key, fail_round, p,
+                                        steps=steps, flight=fl)
+        else:
+            state, _ = run_rounds(state, key, fail_round, p, steps=steps)
         _sync(jax, state)
         dt = time.perf_counter() - t0
         best = min(best, dt)
         _log(f"block {r + 1}/{repeats}: {steps / dt:.1f} rounds/s")
 
     rps = steps / best
-    return {
+    result = {
         "metric": (f"swim_gossip_rounds_per_sec_{n}_nodes"
                    + ("" if churn_ppm == 1000 else f"_churn{churn_ppm}ppm")
                    + (f"_hot{hot_slots}" if hot_slots else "")
-                   + ("" if dissem_swar else "_planes")),
+                   + ("" if dissem_swar else "_planes")
+                   + ("_flight" if flight else "")),
         "value": round(rps, 1),
         "unit": "rounds/s",
         "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 3),
@@ -222,6 +237,11 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         "dissem": "swar" if dissem_swar else "planes",
         "hot_slots": hot_slots,
     }
+    if flight:
+        # One drain AFTER timing: proves rows were recorded without a
+        # host transfer inside the measured blocks.
+        result["flight_rows_recorded"] = int(fl.cursor)
+    return result
 
 
 def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
@@ -283,21 +303,23 @@ _LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 # Metric-name shape: swim_{gossip|multidc}_rounds_per_sec_{n}_nodes
 # [+ "_churn{ppm}ppm" for non-default churn | "_{d}dc" for multidc]
-# [+ "_planes" for the fallback dissemination strategy].
+# [+ "_planes" for the fallback dissemination strategy]
+# [+ "_flight" with the kernel flight recorder enabled].
 _METRIC_RE = re.compile(
     r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
-    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?(_planes)?$")
+    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?(_planes)?(_flight)?$")
 
 
 def _regime_key(multidc: bool, churn_ppm: int,
-                planes: bool = False, hot: int = 0) -> tuple:
+                planes: bool = False, hot: int = 0,
+                flight: bool = False) -> tuple:
     """Cache-matching key: bench variant + churn regime + dissemination
     strategy, size-agnostic.  The default LAN run (churn 1000 ppm) has
     NO suffix historically, so the regime must be recovered from the
     parsed name, not a string prefix — a churn-0 quiescent entry is
     ~10x the churned number and must never stand in for it."""
     return ("multidc" if multidc else "gossip",
-            None if multidc else churn_ppm, planes, hot)
+            None if multidc else churn_ppm, planes, hot, flight)
 
 
 def _parse_metric_regime(name: str) -> tuple | None:
@@ -309,7 +331,8 @@ def _parse_metric_regime(name: str) -> tuple | None:
     churn = int(m.group(3)) if m.group(3) is not None else 1000
     return (variant, None if variant == "multidc" else churn,
             m.group(6) is not None,
-            int(m.group(5)) if m.group(5) is not None else 0)
+            int(m.group(5)) if m.group(5) is not None else 0,
+            m.group(7) is not None)
 
 
 def _read_cache() -> dict:
@@ -334,14 +357,14 @@ def _same_platform_class(a: str, b: str) -> bool:
 
 
 def _read_last_good(multidc: bool, churn_ppm: int, planes: bool = False,
-                    hot: int = 0,
+                    hot: int = 0, flight: bool = False,
                     platform: str | None = None) -> dict | None:
     """Last cached measurement of this exact regime (variant + churn +
     strategy) ON THIS BACKEND PLATFORM CLASS, preferring the largest n.
     A CPU smoke run must never stand in for a chip measurement (or vice
     versa); "axon"/"tpu"/untagged are all the chip class.  A corrupt
     cache must never take down the metric emit."""
-    want = _regime_key(multidc, churn_ppm, planes, hot)
+    want = _regime_key(multidc, churn_ppm, planes, hot, flight)
     plat = platform if platform is not None else _PLATFORM
     candidates = [
         v for k, v in _read_cache().items()
@@ -368,7 +391,8 @@ def _store_result(result: dict) -> None:
 
 
 def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
-                dissem_swar: bool = True, hot_slots: int = 0) -> dict:
+                dissem_swar: bool = True, hot_slots: int = 0,
+                flight: bool = False) -> dict:
     """One regime with reduced-N fallback.  Returns a result dict; on
     total failure returns an error dict carrying the regime-matched
     last-known-good."""
@@ -385,7 +409,7 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                 result = _bench_lan(jax, n, args.slots, args.steps,
                                     args.repeats, churn_ppm=churn_ppm,
                                     dissem_swar=dissem_swar,
-                                    hot_slots=hot_slots)
+                                    hot_slots=hot_slots, flight=flight)
             if n != args.n:
                 result["reduced_from_n"] = args.n
             _store_result(result)
@@ -402,7 +426,8 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                "vs_baseline": 0.0,
                "error": f"all sizes failed; last: "
                         f"{type(last_err).__name__}: {last_err}"}
-    last = _read_last_good(multidc, churn_ppm, not dissem_swar, hot_slots)
+    last = _read_last_good(multidc, churn_ppm, not dissem_swar, hot_slots,
+                           flight)
     if last is not None:
         payload["last_known_good"] = last
     return payload
@@ -434,6 +459,10 @@ def main() -> None:
     ap.add_argument("--hot-slots", dest="hot_slots", type=int, default=0,
                     help="hot-tier width for single-regime runs "
                          "(the table A/Bs full vs hot8 at realistic churn)")
+    ap.add_argument("--flight", action="store_true",
+                    help="enable the kernel flight recorder for "
+                         "single-regime runs (the table A/Bs the healthy "
+                         "regime with and without it)")
     args = ap.parse_args()
 
     single_regime = args.multidc or args.churn_ppm is not None
@@ -462,6 +491,8 @@ def main() -> None:
         else:
             lkg = {
                 "healthy": _read_last_good(False, 0, platform=plat),
+                "healthy_flight": _read_last_good(False, 0, flight=True,
+                                                  platform=plat),
                 "churn1000ppm": _read_last_good(False, 1000, platform=plat),
                 "churn1000ppm_planes": _read_last_good(
                     False, 1000, planes=True, platform=plat),
@@ -482,12 +513,16 @@ def main() -> None:
         churn = args.churn_ppm if args.churn_ppm is not None else 1000
         _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn,
                           dissem_swar=args.dissem == "swar",
-                          hot_slots=args.hot_slots))
+                          hot_slots=args.hot_slots, flight=args.flight))
         return
 
     # -- default: the full regime table, one JSON line -------------------
     regimes: dict[str, dict] = {}
     regimes["healthy"] = _run_regime(jax, args, multidc=False, churn_ppm=0)
+    # Flight-recorder overhead A/B at the healthy operating point: the
+    # acceptance bar is <5% regression with the recorder enabled.
+    regimes["healthy_flight"] = _run_regime(jax, args, multidc=False,
+                                            churn_ppm=0, flight=True)
     regimes["churn1000ppm"] = _run_regime(jax, args, multidc=False,
                                           churn_ppm=1000)
     # Dissemination-strategy A/B in the stress regime: the table

@@ -919,13 +919,60 @@ class Agent:
         router.add_put("/v1/event/fire/{name}", h(self._event_fire))
         router.add_get("/v1/event/list", h(self._event_list))
         router.add_get("/v1/agent/metrics", h(self._metrics))
+        # Observability surfaces, gated like /debug/pprof/* (http.go
+        # EnableDebug): finished traces and the kernel flight recorder.
+        if self.config.enable_debug:
+            router.add_get("/v1/agent/traces", h(self._traces))
+            router.add_get("/v1/agent/flight", h(self._flight))
 
     async def _metrics(self, request):
         """Telemetry snapshot: the inmem sink's interval ring (the
-        go-metrics dump the reference wires to SIGUSR1, served as
-        JSON)."""
+        go-metrics dump the reference wires to SIGUSR1), served as JSON
+        or — with ``?format=prometheus`` — in the Prometheus text
+        exposition format (obs/prom.py)."""
         from consul_tpu.utils.telemetry import metrics
+        if request.query.get("format") == "prometheus":
+            from aiohttp import web
+
+            from consul_tpu.obs.prom import render_prometheus
+            # Scrape-time collection of the kernel flight recorder: it
+            # lives in the plane process, so pull its summary over the
+            # bridge and mirror it here as consul.flight.* gauges.
+            getter = getattr(self.lan_pool, "plane_flight", None)
+            if getter is not None:
+                from consul_tpu.obs.flight import fold_summary
+                fr = await getter(timeout=2.0)
+                fold_summary(metrics, fr.get("summary") or {})
+            return web.Response(text=render_prometheus(metrics.snapshot()),
+                                content_type="text/plain")
         return metrics.snapshot()
+
+    async def _traces(self, request):
+        """Recent finished traces (obs/trace.py ring), newest first."""
+        from consul_tpu.obs.trace import tracer
+        try:
+            limit = int(request.query.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        return tracer.traces(limit)
+
+    async def _flight(self, request):
+        """Kernel flight-recorder timeline: per-round SWIM counters
+        drained from the gossip plane's HBM ring.  Served from the
+        plane over the bridge for the TPU backend; empty for backends
+        without a kernel."""
+        pool = self.lan_pool
+        getter = getattr(pool, "plane_flight", None)
+        if getter is None:
+            return {"backend": self.config.gossip_backend,
+                    "cols": [], "rows": [], "summary": {}}
+        out = dict(await getter())
+        out.pop("t", None)  # bridge frame tag, not API surface
+        out.setdefault("backend", self.config.gossip_backend)
+        out.setdefault("cols", [])
+        out.setdefault("rows", [])
+        out.setdefault("summary", {})
+        return out
 
     async def _self(self, request):
         """/v1/agent/self (agent_endpoint.go:24-34): config + stats."""

@@ -28,6 +28,7 @@ from typing import List, Optional, Tuple
 
 from time import monotonic as _monotonic
 
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.structs.structs import HEALTH_CRITICAL, QueryOptions
 from consul_tpu.utils.telemetry import metrics
 
@@ -239,9 +240,11 @@ class DNSServer:
         name = q.name.lower()
         t0 = _monotonic()
         if name.endswith(".in-addr.arpa."):
+            span = obs_trace.root_span("dns:ptr_query", tags={"name": name})
             try:
                 return await self._ptr_lookup(query, q, name)
             finally:
+                span.finish()
                 metrics.measure_since(("consul", "dns", "ptr_query"), t0)
         if not name.endswith(self.domain):
             # Out-of-domain: forward to recursors when configured
@@ -251,9 +254,11 @@ class DNSServer:
                 if resp is not None:
                     return resp
             return build_response(query, RCODE_REFUSED, [], authoritative=False)
+        span = obs_trace.root_span("dns:domain_query", tags={"name": name})
         try:
             return await self._dispatch(query, q, name, udp)
         finally:
+            span.finish()
             metrics.measure_since(("consul", "dns", "domain_query"), t0)
 
     async def _recurse(self, buf: bytes) -> Optional[bytes]:

@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import re
 import uuid
-from typing import List, Optional
+from typing import List
 
 from consul_tpu.structs.structs import UserEvent
 

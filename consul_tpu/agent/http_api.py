@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import base64
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from aiohttp import web
 
@@ -204,14 +204,24 @@ class HTTPServer:
         self.agent.register_http_routes(r, h)
 
     def _handler(self, fn):
-        """wrap() (http.go:282-346): invoke, time, map errors, JSON-encode."""
+        """wrap() (http.go:282-346): invoke, time, map errors, JSON-encode.
+
+        Each request is also the ROOT of a distributed trace: every
+        RPC the handler forwards carries this span's context over the
+        wire, and the backhauled remote spans land in this node's
+        trace ring (obs/trace.py)."""
         import time as _time
 
+        from consul_tpu.obs import trace as obs_trace
         from consul_tpu.utils.telemetry import metrics
-        mkey = ("consul", "http", fn.__name__.lstrip("_"))
+        name = fn.__name__.lstrip("_")
+        mkey = ("consul", "http", name)
 
         async def handle(request: web.Request) -> web.Response:
             t0 = _time.monotonic()
+            span = obs_trace.root_span(
+                f"http:{name}",
+                tags={"method": request.method, "path": request.path})
             try:
                 resp = await fn(request)
                 if isinstance(resp, web.StreamResponse):
@@ -220,14 +230,19 @@ class HTTPServer:
             except web.HTTPException:
                 raise  # redirects/aiohttp statuses pass through untouched
             except EndpointError as e:
+                span.set_error(e)
                 return web.Response(status=400, text=str(e))
             except PermissionError as e:
+                span.set_error(e)
                 return web.Response(status=403, text=str(e) or "Permission denied")
             except NotFound as e:
+                span.set_error(e)
                 return web.Response(status=404, text=str(e))
             except Exception as e:  # 500 + message, as the reference wrap()
+                span.set_error(e)
                 return web.Response(status=500, text=f"{type(e).__name__}: {e}")
             finally:
+                span.finish()
                 metrics.measure_since(mkey, t0)
 
         return handle

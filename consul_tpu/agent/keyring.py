@@ -16,7 +16,7 @@ from __future__ import annotations
 import base64
 import json
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 KEY_LEN = 16
 

@@ -16,7 +16,7 @@ import json
 from typing import Optional
 
 from consul_tpu.structs.structs import (
-    DirEntry, KVSOp, KVSRequest, KeyRequest, MessageType, UserEvent)
+    DirEntry, KVSOp, KVSRequest, KeyRequest, UserEvent)
 
 CHUNK_SIZE = 4 * 1024        # remoteExecOutputSize
 FLUSH_INTERVAL = 0.5         # remoteExecOutputDeadline

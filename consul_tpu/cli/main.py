@@ -123,6 +123,10 @@ def cmd_agent(args) -> int:
                       statsite_addr=cfg.telemetry.statsite_addr,
                       hostname=acfg.node_name,
                       disable_hostname=cfg.telemetry.disable_hostname)
+    # Stamp spans with this node's name so cross-process traces show
+    # which hop ran where (obs/trace.py).
+    from consul_tpu.obs.trace import tracer
+    tracer.node_name = acfg.node_name
 
     async def serve() -> None:
         await agent.start()

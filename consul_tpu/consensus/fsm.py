@@ -40,6 +40,7 @@ from consul_tpu.structs.structs import (
 
 from time import monotonic as _monotonic
 
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.utils.telemetry import metrics
 
 IGNORE_UNKNOWN_FLAG = 0x80  # high bit: safe-to-skip for old versions (fsm.go:25-30)
@@ -47,6 +48,9 @@ IGNORE_UNKNOWN_FLAG = 0x80  # high bit: safe-to-skip for old versions (fsm.go:25
 # Pre-built metric keys — apply() is the consistency hot loop.
 _FSM_METRIC_KEYS = {int(t): ("consul", "fsm", t.name.lower())
                     for t in MessageType}
+# Pre-built span names (spans are observational only — trace context is
+# node-local and never enters replicated state).
+_FSM_SPAN_NAMES = {int(t): f"fsm:{t.name.lower()}" for t in MessageType}
 
 # Snapshot record kinds (one byte each, mirroring fsm.go's persist order).
 SNAP_HEADER = "header"
@@ -97,9 +101,13 @@ class ConsulFSM:
             raise ValueError(f"failed to apply request: unknown type {msg_type}")
         # MeasureSince per message type (fsm.go:121 et al.)
         t0 = _monotonic()
+        span = obs_trace.child_span(
+            _FSM_SPAN_NAMES[msg_type & ~IGNORE_UNKNOWN_FLAG],
+            tags={"index": index})
         try:
             return handler(index, buf[1:])
         finally:
+            obs_trace.finish_span(span)
             metrics.measure_since(_FSM_METRIC_KEYS[msg_type & ~IGNORE_UNKNOWN_FLAG], t0)
 
     def _apply_register(self, index: int, payload: bytes) -> Any:

@@ -21,13 +21,14 @@ from __future__ import annotations
 import asyncio
 import random
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from consul_tpu.consensus.log import (
     LOG_BARRIER, LOG_COMMAND, LOG_CONFIGURATION, LOG_NOOP, LogEntry,
     MemoryLogStore)
 from consul_tpu.consensus.snapshot import MemorySnapshotStore
+from consul_tpu.obs import trace as obs_trace
 
 import msgpack
 
@@ -179,6 +180,11 @@ class RaftNode:
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
         self._pending: Dict[int, asyncio.Future] = {}
+        # Trace contexts of pending commands, by log index: the
+        # durability pump applies committed entries OUTSIDE any request
+        # task, so the submitting request's span context is stashed
+        # here and re-activated around fsm.apply (obs/trace.py).
+        self._trace_ctx: Dict[int, Any] = {}
         # Group-commit buffer (see _submit/_flush_appends).
         self._append_buf: List[LogEntry] = []
         self._buf_tail = 0
@@ -319,7 +325,11 @@ class RaftNode:
     async def apply(self, data: bytes, timeout: float = 30.0) -> Any:
         """Append a command; resolves with the FSM's return once committed
         (raft.Apply / raftApply, consul/rpc.go:280-297)."""
-        return await self._submit(LOG_COMMAND, data, timeout)
+        span = obs_trace.child_span("raft-commit")
+        try:
+            return await self._submit(LOG_COMMAND, data, timeout)
+        finally:
+            obs_trace.finish_span(span)
 
     async def barrier(self, timeout: float = 30.0) -> int:
         """Commit round-trip proving current leadership (raft.Barrier /
@@ -375,6 +385,10 @@ class RaftNode:
                          type=type_, data=data)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[entry.index] = fut
+        if type_ == LOG_COMMAND:
+            ctx = obs_trace.current_context()
+            if ctx is not None:
+                self._trace_ctx[entry.index] = ctx
         self._append_buf.append(entry)
         if type_ == LOG_CONFIGURATION:
             # Apply eagerly, not at flush: a second membership change in
@@ -393,6 +407,7 @@ class RaftNode:
         if not batch or self.role != LEADER:
             for e in batch:
                 fut = self._pending.pop(e.index, None)
+                self._trace_ctx.pop(e.index, None)
                 if fut is not None and not fut.done():
                     fut.set_exception(NotLeaderError(self.leader_id))
             return
@@ -510,6 +525,7 @@ class RaftNode:
             if not fut.done():
                 fut.set_exception(err)
         self._pending.clear()
+        self._trace_ctx.clear()
 
     # -- replication (leader side) ----------------------------------------
 
@@ -628,10 +644,19 @@ class RaftNode:
                 continue
             result: Any = None
             if e.type == LOG_COMMAND:
+                # Re-activate the submitting request's trace context (if
+                # any) so fsm.apply's span lands in the right trace even
+                # though we're running in the durability-pump task.
+                ctx = self._trace_ctx.pop(i, None)
+                token = obs_trace.set_context(ctx) if ctx is not None \
+                    else None
                 try:
                     result = self.fsm.apply(e.index, e.data)
                 except Exception as exc:  # FSM errors surface to the caller
                     result = exc
+                finally:
+                    if token is not None:
+                        obs_trace.reset_context(token)
             self.last_applied = i
             fut = self._pending.pop(i, None)
             if fut is not None and not fut.done():
@@ -743,6 +768,7 @@ class RaftNode:
                 for i in list(self._pending):
                     if i >= e.index:
                         fut = self._pending.pop(i)
+                        self._trace_ctx.pop(i, None)
                         if not fut.done():
                             fut.set_exception(NotLeaderError(req.leader))
                 local = None

@@ -33,7 +33,6 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
 from consul_tpu.gossip.kernel import alloc_free_slots, gossip_offsets

@@ -69,6 +69,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
+from consul_tpu.obs.flight import N_COLS as _FLIGHT_COLS
 
 MSG_NONE = 0
 MSG_SUSPECT = 1
@@ -141,6 +142,21 @@ def init_state(p: SwimParams) -> SwimState:
         n_false_dead=jnp.int32(0),
         n_refuted=jnp.int32(0),
     )
+
+
+class FlightRing(NamedTuple):
+    """On-device flight-recorder ring: one i32 row of per-round counters
+    (column layout = ``obs.flight.FLIGHT_COLS``) written per round at
+    ``cursor % R`` INSIDE the scan body — the host drains it in
+    amortized batches (gossip/plane.py), never per round."""
+
+    rows: jnp.ndarray    # i32 [R, N_COLS]
+    cursor: jnp.ndarray  # i32 scalar — total rows ever written
+
+
+def init_flight(ring_rounds: int = 256) -> FlightRing:
+    return FlightRing(rows=jnp.zeros((ring_rounds, _FLIGHT_COLS), jnp.int32),
+                      cursor=jnp.int32(0))
 
 
 _AGE_FRESH = 0xF  # sentinel: written by this round's probe marks, pre-aging
@@ -438,8 +454,19 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
         heard = heard.at[jnp.where(mark_ok, s_t2, S), pid_c].set(
             fresh, mode="drop")
 
+    # Flight-recorder observables (all B-space reductions, bytes each;
+    # XLA dead-code-eliminates them when the caller drops the tuple —
+    # collect=False rounds pay nothing).
+    probe_stats = (
+        jnp.sum(prober_ok.astype(jnp.int32)),                 # probes fired
+        jnp.sum((prober_ok & direct_fail).astype(jnp.int32)),  # acks missed
+        jnp.sum((prober_ok & direct_fail                       # indirect
+                 & tgt_member).astype(jnp.int32)),             #   escalations
+        jnp.sum(init.astype(jnp.int32)),                       # suspicions
+    )
     return (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
-            slot_dead_round, slot_of_node, incarnation, member, drops)
+            slot_dead_round, slot_of_node, incarnation, member,
+            drops), probe_stats
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
@@ -452,6 +479,23 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     nodes whose entry equals the current round join the pool this round
     — see ``_join_tick``.  ``None`` compiles the join machinery out
     entirely (the bench regimes and static-membership sims pay zero)."""
+    return _swim_round_impl(state, base_key, fail_round, p, join_round,
+                            collect=False)[0]
+
+
+def _swim_round_impl(state: SwimState, base_key: jax.Array,
+                     fail_round: jnp.ndarray, p: SwimParams,
+                     join_round: jnp.ndarray | None, collect: bool):
+    """One round + (optionally) its flight-recorder row.
+
+    ``collect`` is a PYTHON-level static: False compiles exactly the
+    old round (the stats tuple is dropped and DCE'd — bit-identical
+    states, zero cost); True additionally returns one i32[N_COLS] row
+    of per-round counters (column layout = obs.flight.FLIGHT_COLS).
+    The only S×N-sized extra work is the dissemination-bytes
+    reduction, and it sits behind the same ``n_active > 0`` cond as
+    the round tail — a quiescent (healthy) round never touches the
+    belief matrix for it."""
     rnd = state.round
     key = jax.random.fold_in(base_key, rnd)
     k_probe = jax.random.split(jax.random.fold_in(key, 1), 4)
@@ -484,7 +528,7 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     # FIRST, on the un-aged matrix: its decisions read only msg/conf
     # bits, and its fresh marks carry the _AGE_FRESH sentinel that the
     # tail's age tick turns into age 0 --------------------------------
-    carry = _probe_tick(p, rnd, k_probe, mf, carry)
+    carry, probe_stats = _probe_tick(p, rnd, k_probe, mf, carry)
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
 
@@ -582,7 +626,41 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                                 _full_tail, heard)
         return _full_tail(heard)
 
-    return jax.lax.cond(n_active > 0, _nonquiescent, _quiescent_tail, heard)
+    new_state = jax.lax.cond(n_active > 0, _nonquiescent, _quiescent_tail,
+                             heard)
+    if not collect:
+        return new_state, None
+
+    # -- flight row (obs.flight.FLIGHT_COLS order) ------------------------
+    # Dissemination bytes: every in-budget rumor entry is pushed to
+    # ``fanout`` peers at one belief byte each.  Behind the quiescence
+    # cond so the healthy fast path never reads the matrix for it.
+    def _tx_bytes(h):
+        live = ((h >> _MSG_SHIFT) > 0) & \
+            ((h & _AGE_MASK) < p.spread_budget_rounds)
+        return p.fanout * jnp.sum(live.astype(jnp.int32))
+
+    tx = jax.lax.cond(n_active > 0, _tx_bytes,
+                      lambda h: jnp.int32(0), new_state.heard)
+    dead_before = state.n_detected + state.n_false_dead
+    dead_after = new_state.n_detected + new_state.n_false_dead
+    row = jnp.stack([
+        rnd,
+        probe_stats[0],                                    # probes
+        probe_stats[1],                                    # acks_missed
+        probe_stats[2],                                    # indirect_probes
+        probe_stats[3],                                    # suspect_new
+        new_state.n_refuted - state.n_refuted,             # alive_events
+        dead_after - dead_before,                          # dead_events
+        jnp.sum((new_state.slot_phase == PHASE_JOIN)
+                .astype(jnp.int32)),                       # join_rumors
+        jnp.sum((new_state.slot_node >= 0)
+                .astype(jnp.int32)),                       # queue_occupancy
+        tx,                                                # dissem_bytes
+        new_state.drops - state.drops,                     # drops
+        jnp.sum(new_state.member.astype(jnp.int32)),       # members
+    ]).astype(jnp.int32)
+    return new_state, row
 
 
 def gossip_offsets(key: jax.Array, n: int, fanout: int) -> jnp.ndarray:
@@ -970,14 +1048,33 @@ class RoundTrace(NamedTuple):
 @functools.partial(jax.jit, static_argnames=("p", "steps", "trace", "unroll"))
 def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                p: SwimParams, steps: int, trace: bool = False,
-               unroll: int = 4, join_round: jnp.ndarray | None = None):
+               unroll: int = 4, join_round: jnp.ndarray | None = None,
+               flight: FlightRing | None = None):
     """Scan ``steps`` rounds.  With ``trace``, also return per-round slot
     snapshots for detection-curve analysis (adds one S×N reduction/round).
     ``unroll`` fuses that many rounds per scan iteration — amortizes
-    per-iteration dispatch/sync on backends where that dominates."""
+    per-iteration dispatch/sync on backends where that dominates.
 
-    def body(st, _):
-        st = swim_round(st, base_key, fail_round, p, join_round=join_round)
+    ``flight`` (optional FlightRing): record one flight-recorder row
+    per round into the on-device ring at ``cursor % R`` — no host
+    transfer here; the caller drains the ring whenever it likes
+    (gossip/plane.py amortizes over >= 64 rounds).  When passed, the
+    scan carry is ``(state, flight)`` and the first return value is
+    that pair; ``None`` compiles the recorder out entirely."""
+
+    def body(carry, _):
+        if flight is not None:
+            st, fl = carry
+        else:
+            st = carry
+        st, row = _swim_round_impl(st, base_key, fail_round, p, join_round,
+                                   collect=flight is not None)
+        if flight is not None:
+            R = fl.rows.shape[0]
+            fl = FlightRing(
+                rows=jax.lax.dynamic_update_slice(
+                    fl.rows, row[None, :], (fl.cursor % R, jnp.int32(0))),
+                cursor=fl.cursor + 1)
         if trace:
             msg = st.heard >> _MSG_SHIFT
             mem = st.member[None, :]
@@ -989,7 +1086,8 @@ def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                            st.slot_dead_round, n_heard_dead, n_heard_alive)
         else:
             y = None
-        return st, y
+        return (st, fl) if flight is not None else st, y
 
-    return jax.lax.scan(body, state, None, length=steps,
+    init = (state, flight) if flight is not None else state
+    return jax.lax.scan(body, init, None, length=steps,
                         unroll=min(unroll, max(steps, 1)))

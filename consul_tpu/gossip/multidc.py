@@ -28,7 +28,6 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from consul_tpu.gossip.events import (
     EventState, _SEEN, event_round, init_events)

@@ -36,6 +36,27 @@ events, pushed user events.
 One plane serves one LAN pool (one DC).  The WAN pool — tiny,
 servers-only — stays on the asyncio backend; cross-DC remains the
 reference's two-pool topology.
+
+Security posture — TRUSTED NETWORK ASSUMED for non-loopback binds.
+The bridge protocol is plaintext msgpack: an armed keyring
+(``encrypt_keys``) gates *admission* (registration requires an HMAC
+proof, see :func:`registration_proof`) but does NOT encrypt the
+stream — membership events, user-event payloads, and stats frames are
+readable, and frames after registration are not individually
+authenticated, by any on-path observer.  Binding to anything other
+than 127.0.0.1 / a mode-0600 unix socket therefore assumes the
+network segment is trusted (the same posture as memberlist with
+gossip verification but no transport encryption).  Deployments that
+cannot assume this must front the plane port with their own transport
+security (e.g. a local sidecar or an ipsec/wireguard segment).
+
+The registration replay cache (``_seen_nonces``) is IN-MEMORY ONLY:
+a plane restart forgets seen (ts, nonce) pairs, so a captured
+register frame can be replayed against the restarted plane for up to
+``auth_skew_s`` after its original timestamp.  The window is small
+(default 30s) and the frame only re-registers the same node identity,
+but operators rotating keys after a suspected capture should restart
+the plane LAST, after the old key is removed everywhere.
 """
 
 from __future__ import annotations
@@ -62,6 +83,13 @@ EV_USER = "user"
 # Fixed rounds per kernel dispatch: one compiled variant, wall-clock
 # catch-up runs several dispatches.
 STEPS_PER_TICK = 4
+
+# Drain the on-device flight ring every this many dispatches.  At
+# STEPS_PER_TICK=4 this is 64 kernel rounds per host transfer — the
+# recorder adds ZERO per-round (and zero per-dispatch) host syncs.
+# Must stay <= the ring length / STEPS_PER_TICK or rows overflow
+# (overflow is counted, not silent — obs.flight tracks it).
+FLIGHT_DRAIN_EVERY = 16
 
 
 @dataclass
@@ -164,6 +192,11 @@ class GossipPlane:
         self._ev_state = None
         self._fire_queue: List[tuple] = []   # (origin_id, meta dict)
         self._ev_meta: Dict[tuple, Dict[str, Any]] = {}
+        # Kernel flight recorder: on-device ring written inside the jit
+        # step, drained host-side every FLIGHT_DRAIN_EVERY dispatches.
+        self._flight = None                  # FlightRing (device)
+        self._flight_recorder = None         # obs.flight.FlightRecorder
+        self._dispatches_since_drain = 0
 
     # -- universe ----------------------------------------------------------
 
@@ -222,12 +255,21 @@ class GossipPlane:
         import jax.numpy as jnp
 
         from consul_tpu.gossip.events import init_events, run_event_rounds
-        from consul_tpu.gossip.kernel import run_rounds
+        from consul_tpu.gossip.kernel import init_flight, run_rounds
+        from consul_tpu.obs.flight import FlightRecorder
         self._ev_state = init_events(self._p, slots=c.event_slots)
+        # Flight ring sized so a full drain interval fits with headroom
+        # (bounded-burst catch-up can run up to max_burst extra
+        # dispatches before the drain counter trips).
+        self._flight = init_flight(
+            ring_rounds=4 * FLIGHT_DRAIN_EVERY * STEPS_PER_TICK)
+        self._flight_recorder = FlightRecorder()
+        self._dispatches_since_drain = 0
         jax.block_until_ready(run_rounds(
             self._state, self._key, jnp.asarray(self._fail), self._p,
             steps=STEPS_PER_TICK, trace=True,
-            join_round=jnp.asarray(self._join))[0])
+            join_round=jnp.asarray(self._join),
+            flight=self._flight)[0])
         jax.block_until_ready(run_event_rounds(
             self._ev_state, self._key, self._state.member, self._p,
             steps=STEPS_PER_TICK)[0])
@@ -383,12 +425,18 @@ class GossipPlane:
 
         from consul_tpu.gossip.kernel import PHASE_DEAD, run_rounds
 
-        state, trace = run_rounds(
+        (state, self._flight), trace = run_rounds(
             self._state, self._key, jnp.asarray(self._fail), self._p,
             steps=STEPS_PER_TICK, trace=True,
-            join_round=jnp.asarray(self._join))
+            join_round=jnp.asarray(self._join),
+            flight=self._flight)
         self._state = state
         self._rounds_done += STEPS_PER_TICK
+        # Amortized drain: one host transfer per FLIGHT_DRAIN_EVERY
+        # dispatches (>= 64 rounds), never per round.
+        self._dispatches_since_drain += 1
+        if self._dispatches_since_drain >= FLIGHT_DRAIN_EVERY:
+            self._drain_flight()
 
         # Joins the kernel admitted this dispatch: the EV_JOIN the
         # agents see is the kernel's membership flip, not host-side
@@ -505,6 +553,20 @@ class GossipPlane:
             for (s, sr) in list(self._ev_meta):
                 if not used[s] or int(startr[s]) != sr:
                     self._ev_meta.pop((s, sr), None)
+
+    def _drain_flight(self) -> None:
+        """Pull the on-device flight ring to the host recorder.  One
+        device->host transfer for the whole batch; called every
+        FLIGHT_DRAIN_EVERY dispatches and on-demand for a ``flight``
+        bridge query."""
+        if self._flight is None or self._flight_recorder is None:
+            return
+        self._dispatches_since_drain = 0
+        cursor = int(self._flight.cursor)
+        if cursor == self._flight_recorder.last_cursor:
+            return  # nothing new since the last drain
+        self._flight_recorder.ingest(
+            np.asarray(self._flight.rows), cursor)
 
     def event_coverage(self) -> Dict[int, float]:
         """Live event slots -> fraction of members holding the event
@@ -672,6 +734,17 @@ class GossipPlane:
                     # counters on demand (registered connections only —
                     # an armed keyring must gate observability too).
                     self._send(writer, self._stats_wire())
+                elif t == "flight":
+                    # Flight-recorder query: drain whatever the kernel
+                    # has written since the last amortized drain, then
+                    # serve the host-side timeline (same keyring gate
+                    # as stats).
+                    self._drain_flight()
+                    payload = {"t": "flight"}
+                    if self._flight_recorder is not None:
+                        payload.update(self._flight_recorder.wire(
+                            limit=int(m.get("limit", 256) or 256)))
+                    self._send(writer, payload)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
@@ -690,7 +763,9 @@ class GossipPlane:
         rotation: agents may still prove with a non-primary key).
         Never raises — malformed auth fields are a refusal, not a
         handler crash — and a (ts, nonce) pair is single-use within
-        the skew window (replay of a captured register frame fails)."""
+        the skew window (replay of a captured register frame fails).
+        The nonce cache is in-memory only: a plane restart reopens a
+        replay window of up to ``auth_skew_s`` (module docstring)."""
         try:
             ts = int(m.get("auth_ts", 0) or 0)
             nonce = bytes(m.get("auth_nonce", b"") or b"")

@@ -18,14 +18,12 @@ Adds on top of :class:`~consul_tpu.membership.swim.Memberlist`:
 
 from __future__ import annotations
 
-import asyncio
 import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from consul_tpu.membership.swim import (
-    EV_FAILED, EV_JOIN, EV_LEAVE, EV_UPDATE, MemberConfig, Memberlist, Node,
-    STATE_ALIVE)
+    EV_FAILED, EV_JOIN, EV_LEAVE, MemberConfig, Memberlist, Node)
 
 EV_USER = "user"
 

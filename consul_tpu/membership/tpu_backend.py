@@ -32,9 +32,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import msgpack
 
 from consul_tpu.membership.serf import SerfConfig
-from consul_tpu.membership.swim import (EV_FAILED, EV_JOIN, EV_LEAVE,
-                                        EV_UPDATE, Node, STATE_ALIVE,
-                                        STATE_DEAD, STATE_LEFT)
+from consul_tpu.membership.swim import (
+    EV_FAILED, EV_JOIN, EV_LEAVE, Node, STATE_ALIVE, STATE_DEAD, STATE_LEFT)
 
 EV_USER = "user"
 
@@ -326,6 +325,10 @@ class TpuSerfPool:
             fut = getattr(self, "_stats_future", None)
             if fut is not None and not fut.done():
                 fut.set_result(m)
+        elif t == "flight":
+            fut = getattr(self, "_flight_future", None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
         elif t == "user":
             ltime = int(m.get("ltime", 0))
             self.event_ltime = max(self.event_ltime, ltime)
@@ -412,6 +415,23 @@ class TpuSerfPool:
             fut = self._stats_future = \
                 asyncio.get_event_loop().create_future()
             self._bridge.send({"t": "stats"})
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            return {}
+
+    async def plane_flight(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Kernel flight-recorder timeline from the plane (the agent
+        side of /v1/agent/flight).  Same shared-future discipline as
+        plane_stats: the query is idempotent and concurrent callers
+        ride one in-flight request."""
+        if self._bridge is None:
+            return {}
+        fut = getattr(self, "_flight_future", None)
+        if fut is None or fut.done():
+            fut = self._flight_future = \
+                asyncio.get_event_loop().create_future()
+            self._bridge.send({"t": "flight"})
         try:
             return await asyncio.wait_for(asyncio.shield(fut), timeout)
         except asyncio.TimeoutError:

@@ -1,0 +1,15 @@
+"""Observability: distributed tracing, kernel flight recorder, and
+Prometheus exposition.
+
+Three surfaces, one subsystem:
+
+- ``obs.trace``  — request-scoped spans propagated through the msgpack
+  RPC envelope (agent -> server -> leader -> raft -> FSM), collected in
+  a bounded in-memory ring served at ``/v1/agent/traces``.
+- ``obs.flight`` — per-round SWIM kernel counters accumulated inside
+  the jit step into an HBM ring and drained by the gossip plane in
+  amortized batches; exposed via the metrics registry and
+  ``/v1/agent/flight``.
+- ``obs.prom``   — text-format rendering of the ``utils.telemetry``
+  registry at ``/v1/agent/metrics?format=prometheus``.
+"""

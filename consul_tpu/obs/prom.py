@@ -1,0 +1,80 @@
+"""Prometheus text-format exposition of the telemetry registry.
+
+Renders the ``utils.telemetry`` inmem snapshot (the same data
+``/v1/agent/metrics`` serves as JSON) in the Prometheus text format
+(version 0.0.4): counters summed across retained intervals, gauges
+last-write-wins, timer samples as a summary pair (``_count``/``_sum``
+in seconds) plus ``_min``/``_max`` gauges.  Served by the agent at
+``/v1/agent/metrics?format=prometheus``.
+
+Flight-recorder series ride along automatically: the FlightRecorder
+folds drained kernel rows into the registry as ``consul.flight.*``,
+which render here as ``consul_flight_*``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Metric name -> valid Prometheus identifier (dots and other
+    separators become underscores; leading digit gets a prefix)."""
+    out = _BAD_CHARS.sub("_", name)
+    if not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(snapshot: List[Dict[str, Any]]) -> str:
+    """Telemetry snapshot (list of interval dicts, oldest first) ->
+    Prometheus text format, one block per family with a TYPE line."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    samples: Dict[str, Dict[str, float]] = {}
+    for iv in snapshot:
+        for k, c in iv.get("Counters", {}).items():
+            counters[k] = counters.get(k, 0.0) + float(c["sum"])
+        for k, g in iv.get("Gauges", {}).items():
+            gauges[k] = float(g)
+        for k, s in iv.get("Samples", {}).items():
+            agg = samples.setdefault(
+                k, {"count": 0.0, "sum": 0.0,
+                    "min": float("inf"), "max": float("-inf")})
+            agg["count"] += float(s["count"])
+            agg["sum"] += float(s["sum"])
+            agg["min"] = min(agg["min"], float(s["min"]))
+            agg["max"] = max(agg["max"], float(s["max"]))
+    lines: List[str] = []
+    for k in sorted(counters):
+        n = sanitize(k)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(counters[k])}")
+    for k in sorted(gauges):
+        n = sanitize(k)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(gauges[k])}")
+    for k in sorted(samples):
+        agg = samples[k]
+        n = sanitize(k)
+        # Timer samples are milliseconds in the registry; expose
+        # base-unit seconds per Prometheus convention.
+        lines.append(f"# TYPE {n}_seconds summary")
+        lines.append(f"{n}_seconds_count {_fmt(agg['count'])}")
+        lines.append(f"{n}_seconds_sum {repr(agg['sum'] / 1000.0)}")
+        lines.append(f"# TYPE {n}_seconds_min gauge")
+        lines.append(f"{n}_seconds_min {repr(agg['min'] / 1000.0)}")
+        lines.append(f"# TYPE {n}_seconds_max gauge")
+        lines.append(f"{n}_seconds_max {repr(agg['max'] / 1000.0)}")
+    return "\n".join(lines) + "\n" if lines else ""

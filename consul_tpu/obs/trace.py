@@ -1,0 +1,259 @@
+"""Lightweight distributed tracing for the RPC mesh.
+
+A *span* is one timed operation on one node; a *trace* is the tree of
+spans sharing a trace id, stitched across processes by (a) propagating
+the (trace_id, span_id) pair in an optional ``"Trace"`` field of the
+msgpack request envelope (rpc/wire.py helpers, sent by rpc/pool.py,
+honored by rpc/server.py) and (b) *backhauling* the spans a remote
+server finished while handling a forwarded request in an optional
+``"Spans"`` field of the response envelope.  The backhaul means the
+originating agent's ring holds the COMPLETE trace — http root, the
+forward hop, the leader-side raft apply and FSM dispatch — without any
+out-of-band collector.
+
+Context propagation is a ``contextvars.ContextVar``: task-local, and
+``asyncio.create_task`` snapshots the creating task's context, so a
+span opened around an ``await`` is visible to everything the awaited
+code spawns.  The raft durability pump runs outside any request
+context, so consensus/raft.py stashes the submitting request's context
+by log index and re-activates it around ``fsm.apply`` (see
+``Raft._apply_committed``).
+
+Overhead when idle: one ContextVar read per potential child span
+(~100ns); no locks taken until a span actually finishes.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+# Trace context of the current task: None outside any traced request.
+_current: contextvars.ContextVar[Optional["SpanContext"]] = \
+    contextvars.ContextVar("consul_trace", default=None)
+
+# Ring/buffer bounds (see Tracer): small enough that a debug-enabled
+# agent under heavy traffic stays O(MB), large enough for a test or an
+# operator paging through recent requests.
+MAX_OPEN_TRACES = 512     # distinct trace ids with unfinished spans
+MAX_SPANS_PER_TRACE = 64  # runaway-recursion guard
+RING_TRACES = 256         # finished traces kept for /v1/agent/traces
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+class SpanContext:
+    """Immutable (trace_id, span_id) pair — what crosses the wire."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"SpanContext({self.trace_id}/{self.span_id})"
+
+
+class Span:
+    """One in-flight operation.  Created via the module helpers
+    (``root_span``/``child_span``/``server_span``), finished exactly
+    once via ``finish()`` (idempotent).  While open it is installed as
+    the current context so children nest under it."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start",
+                 "_t0", "duration_ms", "tags", "error", "_token",
+                 "_tracer", "_is_root")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional[SpanContext],
+                 tags: Optional[Dict[str, Any]] = None,
+                 is_root: bool = False) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = parent.trace_id if parent else _new_id()
+        self.span_id = _new_id()
+        self.parent_id = parent.span_id if parent else None
+        self.start = time.time()
+        self._t0 = time.monotonic()
+        self.duration_ms: Optional[float] = None
+        self.tags: Dict[str, Any] = dict(tags) if tags else {}
+        self.error: Optional[str] = None
+        self._is_root = is_root
+        self._token = _current.set(SpanContext(self.trace_id, self.span_id))
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def set_error(self, exc: BaseException) -> None:
+        self.error = f"{type(exc).__name__}: {exc}"
+
+    def finish(self) -> None:
+        if self.duration_ms is not None:
+            return  # already finished
+        self.duration_ms = (time.monotonic() - self._t0) * 1000.0
+        try:
+            _current.reset(self._token)
+        except ValueError:
+            # Finished from a different context than it was opened in
+            # (e.g. a callback); restoring the parent is best-effort.
+            _current.set(None)
+        self._tracer._record(self)
+
+    def to_wire(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "TraceID": self.trace_id, "SpanID": self.span_id,
+            "ParentID": self.parent_id, "Name": self.name,
+            "Node": self._tracer.node_name,
+            "Start": self.start, "DurationMs": self.duration_ms,
+        }
+        if self.tags:
+            d["Tags"] = self.tags
+        if self.error:
+            d["Error"] = self.error
+        return d
+
+
+class Tracer:
+    """Process-global span collector.
+
+    Finished spans buffer per trace id until the trace's ROOT span (a
+    span opened with no parent on this node) finishes, at which point
+    the whole trace moves to a bounded deque served by
+    ``/v1/agent/traces``.  Spans belonging to a *remote* root (opened
+    here with a wire parent) never promote to the ring locally; the RPC
+    server layer calls ``take()`` to pull them into the response
+    envelope, and the caller's tracer ``ingest()``s them.
+    """
+
+    def __init__(self) -> None:
+        self.node_name: str = ""
+        self.enabled: bool = True
+        self._lock = threading.Lock()
+        self._bufs: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=RING_TRACES)
+
+    # -- collection (called from Span.finish) ------------------------------
+
+    def _record(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        wire = span.to_wire()
+        with self._lock:
+            buf = self._bufs.get(span.trace_id)
+            if buf is None:
+                if len(self._bufs) >= MAX_OPEN_TRACES:
+                    self._bufs.popitem(last=False)  # evict oldest open
+                buf = self._bufs[span.trace_id] = []
+            if len(buf) < MAX_SPANS_PER_TRACE:
+                buf.append(wire)
+            if span._is_root:
+                self._bufs.pop(span.trace_id, None)
+                self._ring.append({"TraceID": span.trace_id, "Spans": buf})
+
+    # -- cross-process stitching -------------------------------------------
+
+    def take(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Pop the buffered spans for a trace (server side of the span
+        backhaul: they ride home in the response envelope)."""
+        with self._lock:
+            return self._bufs.pop(trace_id, [])
+
+    def ingest(self, spans: List[Dict[str, Any]]) -> None:
+        """Re-home spans backhauled from a remote server into the local
+        buffers, so the eventual root finish captures them."""
+        if not self.enabled or not spans:
+            return
+        with self._lock:
+            for wire in spans:
+                tid = wire.get("TraceID")
+                if not tid:
+                    continue
+                buf = self._bufs.get(tid)
+                if buf is None:
+                    if len(self._bufs) >= MAX_OPEN_TRACES:
+                        self._bufs.popitem(last=False)
+                    buf = self._bufs[tid] = []
+                if len(buf) < MAX_SPANS_PER_TRACE:
+                    buf.append(wire)
+
+    # -- read side ----------------------------------------------------------
+
+    def traces(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Most-recent finished traces, newest first."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:max(0, int(limit))]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._bufs.clear()
+            self._ring.clear()
+
+
+tracer = Tracer()
+
+
+# -- context helpers ---------------------------------------------------------
+
+def current_context() -> Optional[SpanContext]:
+    return _current.get()
+
+
+def set_context(ctx: Optional[SpanContext]) -> "contextvars.Token":
+    """Install a context directly (raft apply path); pair with
+    ``reset_context``."""
+    return _current.set(ctx)
+
+
+def reset_context(token: "contextvars.Token") -> None:
+    try:
+        _current.reset(token)
+    except ValueError:
+        _current.set(None)
+
+
+# -- span constructors -------------------------------------------------------
+
+def root_span(name: str, tags: Optional[Dict[str, Any]] = None) -> Span:
+    """Start a new trace (HTTP/DNS edge).  Always returns a span."""
+    return Span(tracer, name, parent=None, tags=tags, is_root=True)
+
+
+def child_span(name: str,
+               tags: Optional[Dict[str, Any]] = None) -> Optional[Span]:
+    """Child of the current context, or None when nothing is being
+    traced — callers guard with ``if span is not None`` (or just
+    ``finish_span(span)``)."""
+    ctx = _current.get()
+    if ctx is None:
+        return None
+    return Span(tracer, name, parent=ctx, tags=tags)
+
+
+def server_span(name: str, remote: SpanContext,
+                tags: Optional[Dict[str, Any]] = None) -> Span:
+    """Server side of a forwarded RPC: child of a WIRE parent.  Never a
+    root — its spans are backhauled via ``Tracer.take``."""
+    return Span(tracer, name, parent=remote, tags=tags)
+
+
+def finish_span(span: Optional[Span],
+                exc: Optional[BaseException] = None) -> None:
+    """None-tolerant finish, with optional error capture."""
+    if span is None:
+        return
+    if exc is not None:
+        span.set_error(exc)
+    span.finish()

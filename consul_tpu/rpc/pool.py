@@ -17,12 +17,14 @@ from __future__ import annotations
 
 import asyncio
 import ssl
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import msgpack
 
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.rpc.mux import MuxError, MuxSession
-from consul_tpu.rpc.wire import raft_msg_to_wire, raft_resp_from_wire
+from consul_tpu.rpc.wire import (
+    raft_msg_to_wire, raft_resp_from_wire, trace_to_wire)
 
 # Protocol selector bytes (consul/rpc.go:19-27).
 RPC_CONSUL = 0x01
@@ -98,34 +100,54 @@ class ConnPool:
         Default timeout covers plain RPCs; callers forwarding blocking
         queries pass an explicit budget (max_query_time + margin) —
         see Server.forward_leader / forward_dc."""
-        for attempt in (0, 1):
-            sess = await self._session(addr, dc)
-            try:
-                stream = await sess.open_stream()
+        # Only requests already inside a trace carry context (keeps the
+        # raft replication background chatter untraced); the response's
+        # backhauled spans are re-homed into the local tracer so the
+        # originating node's ring holds the whole cross-process trace.
+        span = obs_trace.child_span(f"rpc-forward:{method}",
+                                    tags={"addr": addr})
+        env: Dict[str, Any] = {"Method": method, "Body": body}
+        if span is not None:
+            env["Trace"] = trace_to_wire(span.context)
+        try:
+            for attempt in (0, 1):
+                sess = await self._session(addr, dc)
                 try:
-                    await stream.send(msgpack.packb(
-                        {"Method": method, "Body": body}, use_bin_type=True))
-                    raw = await asyncio.wait_for(stream.recv(), timeout)
-                finally:
-                    await stream.close()
-                resp = msgpack.unpackb(raw, raw=False, strict_map_key=False)
-                if resp.get("Error"):
-                    raise RPCError(resp["Error"])
-                return resp.get("Body")
-            except asyncio.TimeoutError:
-                # Surface a timed-out exchange immediately (re-waiting
-                # the full budget would double the stall) — and close
-                # the evicted session, or its socket + pump task leak.
-                evicted = self._sessions.pop(addr, None)
-                if evicted is not None:
-                    await evicted.close()
-                raise
-            except (MuxError, ConnectionError,
-                    asyncio.IncompleteReadError):
-                self._sessions.pop(addr, None)
-                if attempt:
+                    stream = await sess.open_stream()
+                    try:
+                        await stream.send(msgpack.packb(
+                            env, use_bin_type=True))
+                        raw = await asyncio.wait_for(stream.recv(), timeout)
+                    finally:
+                        await stream.close()
+                    resp = msgpack.unpackb(raw, raw=False,
+                                           strict_map_key=False)
+                    if span is not None and resp.get("Spans"):
+                        obs_trace.tracer.ingest(resp["Spans"])
+                    if resp.get("Error"):
+                        raise RPCError(resp["Error"])
+                    return resp.get("Body")
+                except asyncio.TimeoutError:
+                    # Surface a timed-out exchange immediately
+                    # (re-waiting the full budget would double the
+                    # stall) — and close the evicted session, or its
+                    # socket + pump task leak.
+                    evicted = self._sessions.pop(addr, None)
+                    if evicted is not None:
+                        await evicted.close()
                     raise
-        raise RPCError("unreachable")  # pragma: no cover
+                except (MuxError, ConnectionError,
+                        asyncio.IncompleteReadError):
+                    self._sessions.pop(addr, None)
+                    if attempt:
+                        raise
+            raise RPCError("unreachable")  # pragma: no cover
+        except BaseException as e:
+            if span is not None:
+                span.set_error(e)
+            raise
+        finally:
+            obs_trace.finish_span(span)
 
     async def close(self) -> None:
         for sess in list(self._sessions.values()):

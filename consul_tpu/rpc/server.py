@@ -17,20 +17,19 @@ served wherever they land.
 from __future__ import annotations
 
 import asyncio
-import random
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import msgpack
 
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.rpc.mux import MuxError, MuxSession
-from consul_tpu.rpc.pool import (
-    RPC_CONSUL, RPC_MULTIPLEX, RPC_RAFT, RPC_TLS, RPCError)
+from consul_tpu.rpc.pool import RPC_CONSUL, RPC_MULTIPLEX, RPC_RAFT, RPC_TLS
 from consul_tpu.rpc.wire import (
-    raft_msg_to_wire, raft_req_from_wire)
+    raft_msg_to_wire, raft_req_from_wire, trace_from_wire)
 from consul_tpu.structs.structs import (
     ACLPolicyRequest, ACLRequest, DeregisterRequest, KeyListRequest,
-    KeyRequest, KVSRequest, MessageType, QueryOptions, RegisterRequest,
-    SessionRequest, UserEvent)
+    KeyRequest, KVSRequest, QueryOptions, RegisterRequest, SessionRequest,
+    UserEvent)
 
 # handler kinds drive the forward() prologue
 LOCAL = "local"   # never forwarded (Status.*, raft internals)
@@ -143,6 +142,29 @@ class RPCServer:
     # -- dispatch + forward prologue ---------------------------------------
 
     async def _dispatch(self, req: Dict) -> Dict:
+        """Trace-aware dispatch shell: when the envelope carries a
+        ``"Trace"`` context, handle the request under a server span and
+        backhaul every span this node finished for that trace in the
+        response's ``"Spans"`` field (the caller's tracer re-homes
+        them, stitching the cross-process tree — see obs/trace.py)."""
+        remote = trace_from_wire(req.get("Trace"))
+        if remote is None:
+            return await self._dispatch_inner(req)
+        span = obs_trace.server_span(f"rpc:{req.get('Method', '')}", remote)
+        try:
+            resp = await self._dispatch_inner(req)
+        except BaseException as e:
+            span.set_error(e)
+            span.finish()
+            obs_trace.tracer.take(span.trace_id)  # drop orphaned spans
+            raise
+        span.finish()
+        spans = obs_trace.tracer.take(span.trace_id)
+        if spans:
+            resp["Spans"] = spans
+        return resp
+
+    async def _dispatch_inner(self, req: Dict) -> Dict:
         method = req.get("Method", "")
         body = req.get("Body")
         entry = self._handlers.get(method)

@@ -52,6 +52,23 @@ _RESP_FROM_WIRE = {
 }
 
 
+def trace_to_wire(ctx: Any) -> Dict:
+    """SpanContext -> the optional ``"Trace"`` envelope field."""
+    return {"tid": ctx.trace_id, "sid": ctx.span_id}
+
+
+def trace_from_wire(d: Any) -> Any:
+    """Envelope ``"Trace"`` field -> SpanContext (None when absent or
+    malformed — tracing is best-effort, never a protocol error)."""
+    if not isinstance(d, dict):
+        return None
+    tid, sid = d.get("tid"), d.get("sid")
+    if not (isinstance(tid, str) and isinstance(sid, str)):
+        return None
+    from consul_tpu.obs.trace import SpanContext
+    return SpanContext(tid, sid)
+
+
 def raft_msg_to_wire(msg: Any) -> Dict:
     return _TO_WIRE[type(msg)](msg)
 

@@ -14,7 +14,6 @@ Resolution path (consul/acl.go:70-148):
 
 from __future__ import annotations
 
-import time
 from typing import List, Optional
 
 from consul_tpu.acl.acl import ACLEval, manage_all, root_acl

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import random
-from typing import Awaitable, Callable, Iterable, Optional, Tuple
+from typing import Awaitable, Callable, Iterable, Optional
 
 from consul_tpu.state.store import StateStore
 from consul_tpu.structs.structs import QueryMeta, QueryOptions

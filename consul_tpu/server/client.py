@@ -18,7 +18,6 @@ hold either one without branching at every call site.
 
 from __future__ import annotations
 
-import asyncio
 import random
 import time
 from dataclasses import dataclass, field

@@ -25,6 +25,7 @@ from consul_tpu.consensus.log import FileLogStore, MemoryLogStore
 from consul_tpu.consensus.raft import (
     MemoryTransport, NotLeaderError as RaftNotLeaderError, RaftConfig, RaftNode)
 from consul_tpu.consensus.snapshot import FileSnapshotStore, MemorySnapshotStore
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.server.leader import LeaderDuties
 from consul_tpu.state.tombstone_gc import TombstoneGC
 from consul_tpu.structs import codec
@@ -228,6 +229,8 @@ class Server:
         if len(buf) > MAX_RAFT_ENTRY_WARN:
             # Reference warns and proceeds (rpc.go:42-44).
             pass
+        span = obs_trace.child_span("raft-apply",
+                                    tags={"type": msg_type.name.lower()})
         try:
             return await self.raft.apply(buf, timeout=ENQUEUE_LIMIT)
         except RaftNotLeaderError as e:
@@ -237,6 +240,8 @@ class Server:
                     return await self.pool.rpc(leader_addr, "Server.Apply",
                                                {"buf": buf})
             raise NotLeaderError(str(e)) from e
+        finally:
+            obs_trace.finish_span(span)
 
     async def raft_apply_raw(self, buf: bytes) -> Any:
         """Leader-side target of the Server.Apply forward."""
@@ -257,6 +262,9 @@ class Server:
         leader in full, this costs the leader one index round-trip and
         keeps the read (and its blocking-query machinery) on the node
         that received it."""
+        span = obs_trace.child_span(
+            "read-barrier",
+            tags={"role": "leader" if self.raft.is_leader() else "follower"})
         try:
             if self.raft.is_leader() or self.pool is None:
                 await self._leader_confirm()
@@ -264,6 +272,8 @@ class Server:
                 await self._follower_confirm()
         except RaftNotLeaderError as e:
             raise NotLeaderError(str(e)) from e
+        finally:
+            obs_trace.finish_span(span)
 
     async def _leader_confirm(self) -> int:
         """Coalesced leader barrier; returns the read-safe index
@@ -305,7 +315,12 @@ class Server:
         if none is forming); batches run serially.  The fired flag is
         the linearizability hinge: work for a batch (index sample /
         barrier append) only starts after the batch stops accepting
-        joiners, so every joiner's arrival precedes it."""
+        joiners, so every joiner's arrival precedes it.
+
+        The shield matters: ``b["fut"]`` is SHARED by every joiner, so
+        a cancelled reader awaiting it bare would cancel the batch
+        future itself and poison its batchmates (matching
+        ``_leader_confirm``'s shield)."""
         b = self._confirm_batches.get(key)
         if b is None or b["fired"]:
             b = self._confirm_batches[key] = {
@@ -313,7 +328,7 @@ class Server:
                 "fired": False}
             asyncio.get_event_loop().create_task(
                 self._run_confirm_batch(key, b, runner))
-        return await b["fut"]
+        return await asyncio.shield(b["fut"])
 
     async def _run_confirm_batch(self, key: str, b: dict, runner) -> None:
         from consul_tpu.rpc.pool import RPCError
@@ -321,15 +336,21 @@ class Server:
             prev = self._confirm_prev.get(key)
             if prev is not None and not prev.done():
                 try:
-                    await prev  # serialize batches; its failure is its own
-                except Exception:
+                    # Serialize batches; the previous batch's failure —
+                    # including cancellation — is its own.  Catching
+                    # BaseException here is load-bearing: a cancelled
+                    # prev would otherwise unwind THIS runner before it
+                    # fires, stranding an unfired batch whose joiners
+                    # wait forever.
+                    await prev
+                except BaseException:
                     pass
             b["fired"] = True   # new arrivals form the next batch
             self._confirm_prev[key] = b["fut"]
             result = await runner()
             if not b["fut"].done():
                 b["fut"].set_result(result)
-        except Exception as e:
+        except BaseException as e:
             # Keep the exported exception contract: a remote not-leader
             # rejection (stringified over the wire) is a NotLeaderError
             # to callers, exactly as the local barrier path raises.
@@ -338,6 +359,8 @@ class Server:
                 e = NotLeaderError(str(e))
             if not b["fut"].done():
                 b["fut"].set_exception(e)
+            if isinstance(e, asyncio.CancelledError):
+                raise  # don't swallow task cancellation
 
     async def leader_read_index(self) -> int:
         """Server.ReadIndex target: leadership-verified read-safe index.
@@ -359,11 +382,14 @@ class Server:
         heartbeat interval per batch (228/s at p50 279 ms vs 3741/s)."""
         if not self.raft.is_leader():
             raise NotLeaderError("not the leader")
+        span = obs_trace.child_span("read-index")
         try:
             return await self._confirm_batched("leader_ri",
                                                self._ri_leader_runner)
         except RaftNotLeaderError as e:
             raise NotLeaderError(str(e)) from e
+        finally:
+            obs_trace.finish_span(span)
 
     def endpoint(self, name: str):
         return self._endpoints[name]

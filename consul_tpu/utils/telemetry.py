@@ -23,7 +23,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 DEFAULT_INTERVAL_S = 10.0
 DEFAULT_RETAIN = 6  # 6 x 10s = one minute of history (go-metrics default)

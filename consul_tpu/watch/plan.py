@@ -10,7 +10,6 @@ checks (by service or state), event.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from consul_tpu.api.client import Client, Config, QueryOptions

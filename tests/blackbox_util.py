@@ -59,7 +59,11 @@ class _Drain:
 
         def pump():
             try:
-                for chunk in iter(lambda: pipe.read(65536), b""):
+                # read1, not read: read(n) on a BufferedReader blocks
+                # until n bytes OR EOF, so nothing would surface until
+                # the child exits — output() must see a LIVE process's
+                # writes (e.g. the SIGUSR1 telemetry dump).
+                for chunk in iter(lambda: pipe.read1(65536), b""):
                     with self._lock:
                         self._buf += chunk
             except Exception:

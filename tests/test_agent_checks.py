@@ -3,7 +3,6 @@ persistence (reference tier: command/agent/check_test.go,
 local_test.go, agent_test.go)."""
 
 import asyncio
-import json
 import time
 
 import pytest

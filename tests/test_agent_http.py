@@ -4,7 +4,6 @@ server the same way, command/agent/*_test.go)."""
 
 import asyncio
 import base64
-import json
 import socket
 import struct
 import threading
@@ -450,7 +449,7 @@ class TestDNSRecursor:
         def serve_one():
             buf, addr = upstream.recvfrom(4096)
             msg = parse_message(buf)
-            from consul_tpu.agent.dns import Record, a_record
+            from consul_tpu.agent.dns import a_record
             rec = a_record(msg.questions[0].name, "93.184.216.34", 60)
             upstream.sendto(
                 build_response(msg, RCODE_OK, [rec], authoritative=False),

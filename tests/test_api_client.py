@@ -8,8 +8,7 @@ import time
 import pytest
 
 from consul_tpu.api import (
-    APIError, Client, Config, KVPair, Lock, LockError, QueryOptions,
-    Semaphore)
+    Client, Config, KVPair, Lock, LockError, QueryOptions, Semaphore)
 from tests.test_agent_http import AgentHarness
 
 

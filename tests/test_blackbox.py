@@ -366,3 +366,96 @@ class TestTpuBackendBlackBox:
             for s in servers:
                 s.stop()
             plane.stop()
+
+
+class TestObservabilityBlackBox:
+    def test_trace_propagates_across_cluster(self):
+        """PR-1 acceptance: one KV write through a 3-node cluster yields
+        a single trace with the full hop chain (http root -> rpc
+        forward -> raft apply -> fsm) retrievable from
+        /v1/agent/traces, with the trace id carried over the wire
+        between real processes and the leader's spans backhauled to the
+        originating agent."""
+        import os
+        dbg = {"enable_debug": True}
+        s1 = TestServer("bb-o1", bootstrap=False, bootstrap_expect=3,
+                        config_extra=dbg).start()
+        servers = [s1]
+        try:
+            s1.wait_for_api()
+            for name in ("bb-o2", "bb-o3"):
+                s = TestServer(name, bootstrap=False, bootstrap_expect=3,
+                               retry_join=[s1.lan_addr],
+                               config_extra=dbg).start()
+                servers.append(s)
+                s.wait_for_api()
+            for s in servers:
+                s.wait_for_leader(60)
+            leader = servers[0].http_get("/v1/status/leader")
+            follower = next(s for s in servers if s.name != leader)
+            assert follower.http_put("/v1/kv/obs/trace-probe", b"x") is True
+            # poll the FOLLOWER's ring: the write entered there, so the
+            # whole stitched trace must come back from that agent
+            deadline = time.monotonic() + 20
+            trace = None
+            while time.monotonic() < deadline:
+                for t in follower.http_get("/v1/agent/traces?limit=50"):
+                    names = {sp["Name"] for sp in t["Spans"]}
+                    if "http:kvs" in names and "fsm:kvs" in names:
+                        trace = t
+                        break
+                if trace:
+                    break
+                time.sleep(0.3)
+            assert trace is not None, \
+                follower.http_get("/v1/agent/traces?limit=50")
+            spans = trace["Spans"]
+            assert len(spans) >= 4
+            # one trace id across every span, including the remote ones
+            assert {sp["TraceID"] for sp in spans} == {trace["TraceID"]}
+            names = {sp["Name"] for sp in spans}
+            assert {"http:kvs", "rpc-forward:Server.Apply",
+                    "rpc:Server.Apply", "raft-apply", "fsm:kvs"} <= names
+            # spans recorded by ANOTHER process prove wire propagation
+            nodes = {sp["Node"] for sp in spans}
+            assert leader in nodes and follower.name in nodes
+            # parentage: the remote server span hangs off the forward
+            by_name = {sp["Name"]: sp for sp in spans}
+            fwd = by_name["rpc-forward:Server.Apply"]
+            assert by_name["rpc:Server.Apply"]["ParentID"] == fwd["SpanID"]
+            assert by_name["http:kvs"]["ParentID"] is None
+        except Exception:
+            for s in servers:
+                print(f"--- {s.name} ---")
+                print(s.output()[-2000:])
+            raise
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_sigusr1_dumps_metrics(self):
+        """SIGUSR1 -> telemetry dump on stderr (agent.go:623-631 role),
+        against a real forked process."""
+        import os
+        import signal as _signal
+        s = TestServer("bb-usr1").start()
+        try:
+            s.wait_for_api()
+            s.wait_for_leader()
+            s.http_put("/v1/kv/usr1/x", b"1")  # generate some telemetry
+            os.kill(s.proc.pid, _signal.SIGUSR1)
+            deadline = time.monotonic() + 15
+            out = ""
+            while time.monotonic() < deadline:
+                out = s.output()
+                if "[C]" in out and "[S]" in out:
+                    break
+                time.sleep(0.3)
+            assert "[C]" in out, out[-2000:]   # counters (raft.apply)
+            assert "[S]" in out, out[-2000:]   # samples (http timing)
+            assert "raft.apply" in out, out[-2000:]
+        except Exception:
+            print(s.output()[-2000:])
+            raise
+        finally:
+            s.stop()

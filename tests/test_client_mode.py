@@ -16,8 +16,7 @@ from consul_tpu.agent.agent import Agent, AgentConfig
 from consul_tpu.consensus.raft import RaftConfig
 from consul_tpu.server.client import ConsulClient, NoServersError
 from consul_tpu.structs.structs import (
-    DirEntry, HEALTH_PASSING, KVSOp, KVSRequest, KeyRequest, QueryOptions,
-    SERF_CHECK_ID)
+    DirEntry, HEALTH_PASSING, KVSOp, KVSRequest, KeyRequest, SERF_CHECK_ID)
 
 FAST_RAFT = RaftConfig(heartbeat_interval=0.03, election_timeout_min=0.06,
                        election_timeout_max=0.12, rpc_timeout=0.5)
@@ -185,8 +184,7 @@ class TestClientCatalog:
 
     def test_client_dns_resolves_over_mesh(self, loop):
         async def body():
-            from consul_tpu.agent.dns import (
-                QTYPE_SRV, Message, Question, build_response, parse_message)
+            from consul_tpu.agent.dns import QTYPE_SRV
             from consul_tpu.structs.structs import NodeService
             import struct
 

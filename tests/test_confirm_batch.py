@@ -1,0 +1,167 @@
+"""ReadIndex confirmation batching under cancellation.
+
+Regression tier for the ADVICE r5 high finding: ``b["fut"]`` is SHARED
+by every reader that joined a confirmation batch, so a reader cancelled
+mid-batch (client disconnect, request timeout) must not cancel the
+batch future out from under its batchmates, and a cancelled/failed
+PREVIOUS batch must not unwind the next batch's runner before it fires
+(stranding joiners that will never be woken).
+"""
+
+import asyncio
+
+import pytest
+
+from consul_tpu.server.server import NotLeaderError, Server
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def _bare_server() -> Server:
+    """Just the batching state — no raft, no pool, no store."""
+    srv = object.__new__(Server)
+    srv._confirm_batches = {}
+    srv._confirm_prev = {}
+    return srv
+
+
+class TestConfirmBatch:
+    def test_cancelled_waiter_does_not_poison_batchmates(self, loop):
+        async def body():
+            srv = _bare_server()
+            release = asyncio.Event()
+            runs = 0
+
+            async def runner():
+                nonlocal runs
+                runs += 1
+                await release.wait()
+                return 42
+
+            waiters = [asyncio.ensure_future(
+                srv._confirm_batched("follower_ri", runner))
+                for _ in range(3)]
+            await asyncio.sleep(0.01)  # all three join the same batch
+            waiters[1].cancel()
+            await asyncio.sleep(0.01)
+            release.set()
+            r0 = await waiters[0]
+            r2 = await waiters[2]
+            assert (r0, r2) == (42, 42)
+            with pytest.raises(asyncio.CancelledError):
+                await waiters[1]
+            assert runs == 1  # one runner for the whole batch
+
+        loop.run_until_complete(body())
+
+    def test_all_waiters_cancelled_still_resolves_future(self, loop):
+        """Even with every joiner gone, the batch future must complete
+        (the NEXT batch serializes on it via _confirm_prev)."""
+        async def body():
+            srv = _bare_server()
+
+            async def runner():
+                await asyncio.sleep(0.02)
+                return 7
+
+            w = asyncio.ensure_future(
+                srv._confirm_batched("leader_ri", runner))
+            await asyncio.sleep(0.005)
+            w.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await w
+            b = srv._confirm_batches["leader_ri"]
+            await asyncio.wait_for(asyncio.shield(b["fut"]), 2.0)
+            assert b["fut"].result() == 7
+
+        loop.run_until_complete(body())
+
+    def test_cancelled_prev_batch_does_not_strand_next(self, loop):
+        """A cancelled previous batch future must not unwind the next
+        runner before it fires — its joiners would wait forever."""
+        async def body():
+            srv = _bare_server()
+            cancelled_prev = asyncio.get_event_loop().create_future()
+            cancelled_prev.cancel()
+            srv._confirm_prev["follower_ri"] = cancelled_prev
+
+            async def runner():
+                return 11
+
+            result = await asyncio.wait_for(
+                srv._confirm_batched("follower_ri", runner), 2.0)
+            assert result == 11
+
+        loop.run_until_complete(body())
+
+    def test_failed_prev_batch_does_not_strand_next(self, loop):
+        async def body():
+            srv = _bare_server()
+            failed_prev = asyncio.get_event_loop().create_future()
+            failed_prev.set_exception(RuntimeError("prior batch died"))
+            srv._confirm_prev["leader_ri"] = failed_prev
+
+            async def runner():
+                return 13
+
+            assert await asyncio.wait_for(
+                srv._confirm_batched("leader_ri", runner), 2.0) == 13
+
+        loop.run_until_complete(body())
+
+    def test_not_leader_mapping_preserved(self, loop):
+        """The wire contract survives the BaseException hardening: a
+        stringified remote not-leader rejection still surfaces as
+        NotLeaderError to every joiner."""
+        from consul_tpu.rpc.pool import RPCError
+
+        async def body():
+            srv = _bare_server()
+
+            async def runner():
+                raise RPCError("rpc error: not the leader")
+
+            with pytest.raises(NotLeaderError):
+                await asyncio.wait_for(
+                    srv._confirm_batched("follower_ri", runner), 2.0)
+
+        loop.run_until_complete(body())
+
+    def test_second_batch_forms_after_fire(self, loop):
+        """Joiners arriving after the batch fired get a FRESH batch
+        (the linearizability hinge), serialized behind the first."""
+        async def body():
+            srv = _bare_server()
+            order = []
+            gate1 = asyncio.Event()
+
+            async def runner1():
+                order.append("r1-start")
+                await gate1.wait()
+                order.append("r1-done")
+                return 1
+
+            async def runner2():
+                order.append("r2-start")
+                return 2
+
+            w1 = asyncio.ensure_future(
+                srv._confirm_batched("follower_ri", runner1))
+            await asyncio.sleep(0.01)  # batch 1 fired (runner started)
+            w2 = asyncio.ensure_future(
+                srv._confirm_batched("follower_ri", runner2))
+            await asyncio.sleep(0.01)
+            # batch 2 must wait for batch 1 to complete
+            assert order == ["r1-start"]
+            gate1.set()
+            assert await w1 == 1
+            assert await w2 == 2
+            assert order == ["r1-start", "r1-done", "r2-start"]
+
+        loop.run_until_complete(body())

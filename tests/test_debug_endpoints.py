@@ -21,8 +21,10 @@ def debug_harness():
 def test_debug_routes_absent_without_flag():
     h = AgentHarness().start()  # enable_debug defaults to False
     try:
-        r = httpx.get(h.http_addr + "/debug/pprof/goroutine", timeout=5)
-        assert r.status_code == 404
+        for path in ("/debug/pprof/goroutine", "/v1/agent/traces",
+                     "/v1/agent/flight"):
+            r = httpx.get(h.http_addr + path, timeout=5)
+            assert r.status_code == 404, path
     finally:
         h.stop()
 
@@ -57,3 +59,49 @@ def test_seconds_clamped(debug_harness):
     r = httpx.get(debug_harness.http_addr
                   + "/debug/pprof/profile?seconds=bogus", timeout=10)
     assert r.status_code == 200
+
+
+def test_traces_endpoint_serves_request_trace(debug_harness):
+    """Any traced HTTP request through the agent lands in the ring and
+    comes back from /v1/agent/traces with its span tree."""
+    from consul_tpu.obs.trace import tracer
+    tracer.clear()
+    r = httpx.put(debug_harness.http_addr + "/v1/kv/obs/probe",
+                  content=b"x", timeout=10)
+    assert r.status_code == 200
+    r = httpx.get(debug_harness.http_addr + "/v1/agent/traces?limit=10",
+                  timeout=5)
+    assert r.status_code == 200
+    traces = r.json()
+    kv_traces = [t for t in traces
+                 if any(s["Name"] == "http:kvs" for s in t["Spans"])]
+    assert kv_traces, [t["Spans"][0]["Name"] for t in traces]
+    spans = kv_traces[0]["Spans"]
+    assert {s["TraceID"] for s in spans} == {kv_traces[0]["TraceID"]}
+    names = {s["Name"] for s in spans}
+    # single in-process server: http root + raft apply/commit + fsm
+    assert {"http:kvs", "raft-apply", "raft-commit", "fsm:kvs"} <= names
+    root = [s for s in spans if s["ParentID"] is None]
+    assert len(root) == 1 and root[0]["Name"] == "http:kvs"
+
+
+def test_flight_endpoint_degrades_without_kernel(debug_harness):
+    """Asyncio gossip backend: the endpoint answers with an empty
+    timeline instead of 500 (the recorder lives in the TPU plane)."""
+    r = httpx.get(debug_harness.http_addr + "/v1/agent/flight", timeout=5)
+    assert r.status_code == 200
+    body = r.json()
+    assert body["rows"] == [] and body["cols"] == []
+    assert "backend" in body
+
+
+def test_metrics_prometheus_format(debug_harness):
+    """?format=prometheus returns the text exposition; default stays
+    JSON.  (Not debug-gated — but the harness has traffic to render.)"""
+    r = httpx.get(debug_harness.http_addr
+                  + "/v1/agent/metrics?format=prometheus", timeout=5)
+    assert r.status_code == 200
+    assert r.headers["content-type"].startswith("text/plain")
+    assert "# TYPE" in r.text
+    r2 = httpx.get(debug_harness.http_addr + "/v1/agent/metrics", timeout=5)
+    assert isinstance(r2.json(), list)

@@ -1,7 +1,6 @@
 """User events + remote exec tests (reference tier:
 command/agent/user_event_test.go, remote_exec_test.go, exec e2e)."""
 
-import json
 import threading
 import time
 

@@ -8,12 +8,10 @@ import numpy as np
 import pytest
 
 from consul_tpu.gossip.events import (
-    _SEEN, EventState, coverage, event_round, fire_events, init_events,
-    run_event_rounds)
+    _SEEN, fire_events, init_events, run_event_rounds)
 from consul_tpu.gossip.kernel import NEVER, init_state, run_rounds
 from consul_tpu.gossip.multidc import (
-    event_coverage, fire_in_dc, init_multidc, make_params,
-    run_multidc_rounds)
+    fire_in_dc, init_multidc, make_params, run_multidc_rounds)
 from consul_tpu.gossip.params import SwimParams, lan_profile
 
 

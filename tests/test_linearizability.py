@@ -16,10 +16,8 @@ import asyncio
 import math
 import random
 
-import pytest
 
-from consul_tpu.structs.structs import (
-    DirEntry, KVSOp, KVSRequest, KeyRequest, MessageType, QueryOptions)
+from consul_tpu.structs.structs import DirEntry, KVSOp, KVSRequest, KeyRequest
 
 from linearize import check_linearizable
 from test_server_cluster import make_servers, start_and_elect, stop_all

@@ -12,7 +12,7 @@ from consul_tpu.membership import SerfConfig, SerfPool
 from consul_tpu.membership.serf import (
     EV_USER, client_tags, parse_server, server_tags)
 from consul_tpu.membership.swim import (
-    EV_FAILED, EV_JOIN, EV_LEAVE, STATE_ALIVE, STATE_DEAD, STATE_LEFT)
+    EV_FAILED, EV_LEAVE, STATE_ALIVE, STATE_DEAD, STATE_LEFT)
 
 
 @pytest.fixture()

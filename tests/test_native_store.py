@@ -2,7 +2,6 @@
 SURVEY §2.1).  Skipped wholesale if the toolchain can't build it."""
 
 import os
-import struct
 import threading
 
 import pytest

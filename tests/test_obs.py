@@ -1,0 +1,302 @@
+"""Observability surfaces: tracer, flight recorder, Prometheus render.
+
+Covers the PR-1 tentpole units in-process:
+
+- span nesting / ring promotion / cross-process take+ingest stitching
+  (obs/trace.py),
+- FlightRecorder drain semantics: cursor deltas, ring wraparound,
+  overflow accounting, registry folding (obs/flight.py),
+- the kernel-side flight ring: enabling it must NOT change the SWIM
+  round dynamics (bit-identical state) and must record sensible
+  per-round rows with zero host transfers inside the scan
+  (gossip/kernel.py),
+- Prometheus text exposition over a registry carrying telemetry AND
+  flight series, parsed by a strict line validator (obs/prom.py).
+"""
+
+import re
+
+import pytest
+
+from consul_tpu.obs import trace as obs_trace
+from consul_tpu.obs.flight import (
+    FLIGHT_COLS, N_COLS, FlightRecorder)
+from consul_tpu.obs.prom import render_prometheus, sanitize
+from consul_tpu.obs.trace import (
+    RING_TRACES, SpanContext, Tracer, child_span, current_context,
+    finish_span, root_span, server_span)
+from consul_tpu.utils.telemetry import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    obs_trace.tracer.clear()
+    yield
+    obs_trace.tracer.clear()
+
+
+class TestTracer:
+    def test_root_child_nesting(self):
+        root = root_span("http:kv", tags={"path": "/v1/kv/a"})
+        assert current_context().span_id == root.span_id
+        child = child_span("raft-apply")
+        assert child is not None
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        grand = child_span("fsm:kvs")
+        assert grand.parent_id == child.span_id
+        finish_span(grand)
+        finish_span(child)
+        # children finished, root still open: nothing promoted yet
+        assert obs_trace.tracer.traces() == []
+        finish_span(root)
+        assert current_context() is None
+        traces = obs_trace.tracer.traces()
+        assert len(traces) == 1
+        t = traces[0]
+        assert t["TraceID"] == root.trace_id
+        names = [s["Name"] for s in t["Spans"]]
+        assert names == ["fsm:kvs", "raft-apply", "http:kv"]
+        by_id = {s["SpanID"]: s for s in t["Spans"]}
+        assert by_id[grand.span_id]["ParentID"] == child.span_id
+        assert by_id[root.span_id]["ParentID"] is None
+        assert all(s["DurationMs"] >= 0 for s in t["Spans"])
+
+    def test_child_without_context_is_none(self):
+        assert current_context() is None
+        assert child_span("orphan") is None
+        finish_span(None)  # tolerated
+
+    def test_error_capture(self):
+        root = root_span("http:kv")
+        try:
+            raise ValueError("boom")
+        except ValueError as e:
+            finish_span(root, exc=e)
+        t = obs_trace.tracer.traces()[0]
+        assert t["Spans"][0]["Error"] == "ValueError: boom"
+
+    def test_take_and_ingest_stitch_remote_spans(self):
+        """The backhaul round-trip: a server-side tracer's spans for a
+        wire parent move (take) into the caller's tracer (ingest) and
+        land in the caller's promoted trace."""
+        remote = Tracer()
+        remote.node_name = "srv1"
+        caller_root = root_span("http:kv")
+        wire_ctx = SpanContext(caller_root.trace_id, caller_root.span_id)
+        # remote side: a server span under the wire parent, recorded
+        # into the remote process's tracer
+        srv = obs_trace.Span(remote, "rpc:Server.Apply", parent=wire_ctx)
+        srv.finish()
+        # server spans never promote on the remote node
+        assert remote.traces() == []
+        backhauled = remote.take(caller_root.trace_id)
+        assert len(backhauled) == 1
+        obs_trace.tracer.ingest(backhauled)
+        finish_span(caller_root)
+        t = obs_trace.tracer.traces()[0]
+        names = {s["Name"] for s in t["Spans"]}
+        assert names == {"rpc:Server.Apply", "http:kv"}
+
+    def test_ring_bounded(self):
+        for i in range(RING_TRACES + 10):
+            finish_span(root_span(f"r{i}"))
+        traces = obs_trace.tracer.traces(limit=10_000)
+        assert len(traces) == RING_TRACES
+        # newest first
+        assert traces[0]["Spans"][0]["Name"] == f"r{RING_TRACES + 9}"
+
+    def test_server_span_finish_restores_context(self):
+        ctx = SpanContext("t" * 16, "s" * 16)
+        span = server_span("rpc:X", ctx)
+        assert current_context().span_id == span.span_id
+        span.finish()
+        # restored to the pre-span context (None here)
+        assert current_context() is None
+
+
+def _ring(rows):
+    """list-of-lists stand-in for the drained device array."""
+    return [list(r) for r in rows]
+
+
+class TestFlightRecorder:
+    def _row(self, rnd, **kw):
+        base = {c: 0 for c in FLIGHT_COLS}
+        base["round"] = rnd
+        base.update(kw)
+        return [base[c] for c in FLIGHT_COLS]
+
+    def test_ingest_extracts_new_rows_in_order(self):
+        m = Metrics()
+        rec = FlightRecorder(metrics=m)
+        ring = [self._row(i, probes=i + 1) for i in range(4)]
+        assert rec.ingest(_ring(ring), 4) == 4
+        tl = rec.timeline()
+        assert [r["round"] for r in tl] == [0, 1, 2, 3]
+        assert rec.summary()["probes"] == 1 + 2 + 3 + 4
+        # re-drain with no progress: nothing new
+        assert rec.ingest(_ring(ring), 4) == 0
+
+    def test_wraparound_order(self):
+        m = Metrics()
+        rec = FlightRecorder(metrics=m)
+        # ring of 4, cursor at 6: rows 2..5 live at slots 2,3,0,1
+        ring = [self._row(4), self._row(5), self._row(2), self._row(3)]
+        assert rec.ingest(_ring(ring), 6) == 4
+        assert [r["round"] for r in rec.timeline()] == [2, 3, 4, 5]
+
+    def test_overflow_accounted(self):
+        m = Metrics()
+        rec = FlightRecorder(metrics=m)
+        rec.ingest(_ring([self._row(0)]), 1)
+        # 9 new rounds through a 1-row ring: 8 lost
+        rec.ingest(_ring([self._row(9)]), 10)
+        s = rec.summary()
+        assert s["rows_overflowed"] == 8
+        assert s["rows_recorded"] == 10
+        assert rec.last_cursor == 10
+
+    def test_registry_folding(self):
+        m = Metrics()
+        rec = FlightRecorder(metrics=m)
+        rec.ingest(_ring([self._row(0, probes=3, members=7),
+                          self._row(1, probes=2, members=8)]), 2)
+        snap = m.snapshot()
+        counters = {}
+        gauges = {}
+        for iv in snap:
+            for k, v in iv["Counters"].items():
+                counters[k] = counters.get(k, 0) + v["sum"]
+            gauges.update(iv["Gauges"])
+        assert counters["consul.flight.probes"] == 5
+        assert gauges["consul.flight.members"] == 8
+        assert gauges["consul.flight.round"] == 1
+
+
+class TestKernelFlight:
+    """CPU execution of the jitted round with the recorder enabled."""
+
+    def _setup(self, steps):
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.kernel import NEVER, init_state
+        from consul_tpu.gossip.params import SwimParams
+
+        p = SwimParams(n=64, slots=16)
+        state = init_state(p)
+        key = jax.random.PRNGKey(0)
+        fail = jnp.full((p.n,), int(NEVER), jnp.int32).at[7].set(3)
+        return p, state, key, fail
+
+    def test_flight_does_not_change_dynamics(self):
+        """Bit-identical SwimState with and without the recorder: the
+        collect branch must be observation only."""
+        import numpy as np
+
+        from consul_tpu.gossip.kernel import init_flight, run_rounds
+
+        steps = 50
+        p, state, key, fail = self._setup(steps)
+        base, _ = run_rounds(state, key, fail, p, steps=steps)
+        (with_fl, fl), _ = run_rounds(state, key, fail, p, steps=steps,
+                                      flight=init_flight(64))
+        for name in base._fields:
+            a, b = getattr(base, name), getattr(with_fl, name)
+            assert np.array_equal(np.asarray(a), np.asarray(b)), name
+        assert int(fl.cursor) == steps
+
+    def test_flight_rows_content(self):
+        import numpy as np
+
+        from consul_tpu.gossip.kernel import init_flight, run_rounds
+
+        # 100 rounds: enough for the round-3 failure's suspicion window
+        # to expire and the dead verdict to disseminate.
+        steps = 100
+        p, state, key, fail = self._setup(steps)
+        R = 128
+        (state, fl), _ = run_rounds(state, key, fail, p, steps=steps,
+                                    flight=init_flight(R))
+        m = Metrics()
+        rec = FlightRecorder(metrics=m)
+        assert rec.ingest(np.asarray(fl.rows), int(fl.cursor)) == steps
+        tl = rec.timeline()
+        assert [r["round"] for r in tl] == list(range(steps))
+        s = rec.summary()
+        assert s["probes"] > 0                      # probing happened
+        assert s["dead_events"] >= 1                # node 7 died
+        assert tl[-1]["members"] == 63              # and left the cluster
+        assert tl[0]["members"] == 64
+        assert all(len(r) == N_COLS for r in np.asarray(fl.rows))
+
+
+# One sample line of the text exposition format (0.0.4): name, optional
+# labels (unused here), a float value, optional timestamp (unused).
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})?"
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]?Inf|NaN)( \d+)?$")
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|summary|histogram|untyped)$")
+
+
+def _validate_prom(text):
+    """Strict-enough text-format validator: every line is a TYPE/HELP
+    comment, a sample, or blank; every sample's metric name was
+    declared by a preceding TYPE line (summaries declare their _count
+    / _sum children)."""
+    declared = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            assert _TYPE_RE.match(line), f"bad TYPE line: {line!r}"
+            name, kind = line.split()[2], line.split()[3]
+            declared.add(name)
+            if kind == "summary":
+                declared.update({name + "_count", name + "_sum"})
+            continue
+        if line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        mname = line.split("{")[0].split(" ")[0]
+        assert mname in declared, f"undeclared metric: {mname}"
+    assert text.endswith("\n")
+    return True
+
+
+class TestPrometheus:
+    def test_sanitize(self):
+        assert sanitize("consul.rpc.query") == "consul_rpc_query"
+        assert sanitize("1weird-name") == "_1weird_name"
+
+    def test_render_parses_with_flight_series(self):
+        m = Metrics()
+        m.incr_counter(("consul", "rpc", "query"), 2)
+        m.incr_counter(("consul", "rpc", "query"), 3)
+        m.set_gauge(("consul", "sessions"), 4.5)
+        m.add_sample(("consul", "fsm", "kvs"), 1.25)
+        m.add_sample(("consul", "fsm", "kvs"), 0.75)
+        rec = FlightRecorder(metrics=m)
+        row = {c: 0 for c in FLIGHT_COLS}
+        row.update(round=5, probes=9, members=64)
+        rec.ingest([[row[c] for c in FLIGHT_COLS]], 1)
+
+        text = render_prometheus(m.snapshot())
+        assert _validate_prom(text)
+        assert "# TYPE consul_rpc_query counter" in text
+        assert "consul_rpc_query 5" in text
+        assert "consul_sessions 4.5" in text
+        # samples render as a time summary in seconds
+        assert "# TYPE consul_fsm_kvs_seconds summary" in text
+        assert "consul_fsm_kvs_seconds_count 2" in text
+        assert "consul_fsm_kvs_seconds_sum 0.002" in text
+        # flight series present alongside the telemetry ones
+        assert "# TYPE consul_flight_probes counter" in text
+        assert "consul_flight_probes 9" in text
+        assert "consul_flight_members 64" in text
+
+    def test_render_empty_snapshot(self):
+        # an empty exposition body is valid (no families, no samples)
+        assert render_prometheus([]) == ""

@@ -13,10 +13,10 @@ import asyncio
 import msgpack
 import pytest
 
-from consul_tpu.consensus.log import FileLogStore, MemoryLogStore
+from consul_tpu.consensus.log import FileLogStore
 from consul_tpu.consensus.raft import (
     MemoryTransport, NotLeaderError, RaftConfig, RaftNode)
-from consul_tpu.consensus.snapshot import FileSnapshotStore, MemorySnapshotStore
+from consul_tpu.consensus.snapshot import FileSnapshotStore
 
 
 def fast_config(**kw) -> RaftConfig:

@@ -13,8 +13,7 @@ import pytest
 from consul_tpu.consensus.raft import RaftConfig
 from consul_tpu.server.server import Server, ServerConfig
 from consul_tpu.structs.structs import (
-    DirEntry, KVSOp, KVSRequest, KeyRequest, NodeService, QueryOptions,
-    RegisterRequest)
+    DirEntry, KVSOp, KVSRequest, KeyRequest, NodeService, RegisterRequest)
 
 FAST = RaftConfig(heartbeat_interval=0.03, election_timeout_min=0.06,
                   election_timeout_max=0.12, rpc_timeout=0.5)

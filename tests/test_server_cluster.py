@@ -16,8 +16,8 @@ import pytest
 from consul_tpu.consensus.raft import MemoryTransport, RaftConfig
 from consul_tpu.server.server import NotLeaderError, Server, ServerConfig
 from consul_tpu.structs.structs import (
-    DirEntry, KVSOp, KVSRequest, KeyRequest, MessageType, QueryOptions,
-    RegisterRequest, Session, SessionOp, SessionRequest)
+    DirEntry, KVSOp, KVSRequest, KeyRequest, RegisterRequest, Session,
+    SessionOp, SessionRequest)
 
 
 def fast_raft() -> RaftConfig:

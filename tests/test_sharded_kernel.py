@@ -14,7 +14,6 @@ per-node arrays sharded on the node axis, slot registers + WAN pool
 replicated.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -8,17 +8,8 @@ import pytest
 from consul_tpu.state import StateStore, StateStoreError
 from consul_tpu.state.tombstone_gc import TombstoneGC
 from consul_tpu.structs.structs import (
-    ACL,
-    DirEntry,
-    HEALTH_CRITICAL,
-    HEALTH_PASSING,
-    HealthCheck,
-    Node,
-    NodeService,
-    RegisterRequest,
-    SESSION_BEHAVIOR_DELETE,
-    Session,
-)
+    ACL, DirEntry, HEALTH_CRITICAL, HEALTH_PASSING, HealthCheck, NodeService,
+    RegisterRequest, SESSION_BEHAVIOR_DELETE, Session)
 
 
 def reg(store, index, node="node1", addr="10.0.0.1", service=None, check=None):

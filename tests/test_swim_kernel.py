@@ -7,12 +7,8 @@ seeded PRNG, compressed timers, assertions on protocol invariants.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
-from consul_tpu.gossip.kernel import (
-    MSG_DEAD, NEVER, PHASE_DEAD, PHASE_FREE, PHASE_REFUTED,
-    init_state, run_rounds, swim_round,
-)
+from consul_tpu.gossip.kernel import NEVER, PHASE_FREE, init_state, run_rounds
 from consul_tpu.gossip.params import SwimParams
 
 

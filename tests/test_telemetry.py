@@ -7,7 +7,9 @@ points (consul/fsm.go:121, consul/rpc.go:386)."""
 import socket
 import time
 
-from consul_tpu.utils.telemetry import InmemSink, Metrics, metrics
+import pytest
+
+from consul_tpu.utils.telemetry import InmemSink, Metrics
 
 
 class TestInmemSink:
@@ -96,12 +98,42 @@ class TestMetricsRegistry:
         assert "consul.sessions:4.5|g" in lines
         assert "consul.fsm.kvs:1.25|ms" in lines
 
+    def test_reconfigure_closes_old_sink_and_swaps(self):
+        """A reload (SIGHUP path) re-runs configure(): the previous UDP
+        socket must be closed — not leaked — and datagrams flow to the
+        NEW address only."""
+        rx_old = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx_old.bind(("127.0.0.1", 0))
+        rx_old.settimeout(0.5)
+        rx_new = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx_new.bind(("127.0.0.1", 0))
+        rx_new.settimeout(5)
+        m = Metrics()
+        m.configure(statsd_addr=f"127.0.0.1:{rx_old.getsockname()[1]}")
+        old_sink = m._sinks[1]
+        m.configure(statsd_addr=f"127.0.0.1:{rx_new.getsockname()[1]}")
+        assert old_sink._sock.fileno() == -1  # closed, not leaked
+        m.incr_counter(("consul", "rpc", "query"))
+        assert rx_new.recvfrom(1024)[0] == b"consul.rpc.query:1|c"
+        with pytest.raises(socket.timeout):
+            rx_old.recvfrom(1024)
+        rx_old.close()
+        rx_new.close()
+
+    def test_statsd_malformed_addr_does_not_raise(self):
+        """Bad telemetry config must never take the agent down: a
+        malformed port falls back to the statsd default (8125) and
+        sends stay fire-and-forget."""
+        m = Metrics()
+        m.configure(statsd_addr="127.0.0.1:not-a-port")
+        assert m._sinks[1]._addr == ("127.0.0.1", 8125)
+        m.incr_counter(("consul", "rpc", "query"))  # no exception
+
 
 class TestAgentIntegration:
     def test_hot_paths_emit_and_http_serves_snapshot(self):
         """Drive KV writes + a DNS query through a live agent, then read
         /v1/agent/metrics and see fsm/raft/http/dns series populated."""
-        import struct
 
         import httpx
 

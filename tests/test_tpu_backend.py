@@ -736,7 +736,6 @@ def test_plane_soak_many_agents_large_sim():
             await asyncio.sleep(interval * 4 * 4)
             assert plane._rounds_done > r0
             # the sim swarm stayed healthy: no mass false verdicts
-            import jax.numpy as jnp
             assert int(plane._state.n_false_dead) == 0
         finally:
             for pool in pools.values():

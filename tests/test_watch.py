@@ -7,7 +7,7 @@ import time
 import pytest
 
 from consul_tpu.api import Client, Config, KVPair
-from consul_tpu.watch import WatchPlan, parse
+from consul_tpu.watch import parse
 from consul_tpu.watch.plan import WatchError
 from tests.test_agent_http import AgentHarness
 
