@@ -33,12 +33,16 @@ test: vet
 integ:
 	$(PYTEST) tests/test_blackbox.py tests/test_linearizability.py
 
-# Static checks: byte-compile every source file, then the AST pass
-# (tools/pyvet.py: undefined names + unused imports — the `go vet`
-# role in an image without a Python linter).
+# Static checks: byte-compile every source file, then the six-pass
+# analyzer (tools/vet/: names, async-safety, JAX tracer-purity,
+# wire-schema drift, exception hygiene — the `go vet` role in an image
+# without a Python linter).  Exit codes: 0 clean, 1 findings, 2 parse
+# error.  Suppress per line with `# noqa: CODE` or per finding in
+# tools/vet/baseline.txt.
+VET_PATHS = consul_tpu tests tools demo bench.py __graft_entry__.py
 vet:
-	$(PYTHON) -m compileall -q consul_tpu tests tools bench.py __graft_entry__.py
-	$(PYTHON) tools/pyvet.py consul_tpu tests
+	$(PYTHON) -m compileall -q $(VET_PATHS)
+	$(PYTHON) -m tools.vet $(VET_PATHS)
 
 # North-star benchmark (needs the real chip; emits one JSON line).
 bench:

@@ -14,7 +14,7 @@ import asyncio
 import json
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set
 
 from consul_tpu.agent.checks import CheckRunnerSet, CheckType
 from consul_tpu.agent.dns import DNSServer
@@ -189,6 +189,16 @@ class Agent:
         self._wan_servers: Dict[str, Dict[str, str]] = {}  # dc -> name -> addr
         self._retry_join_task: Optional[asyncio.Task] = None
         self._check_state_dir_made = False
+        # Fire-and-forget task anchor: the loop keeps only weak refs, so
+        # an unanchored create_task() can be GC-cancelled mid-run.
+        self._bg_tasks: Set[asyncio.Task] = set()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        """create_task with a strong reference until completion."""
+        task = asyncio.get_event_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     @property
     def node_name(self) -> str:
@@ -860,7 +870,6 @@ class Agent:
         (agent.go:1109-1127)."""
         if not self.config.data_dir:
             return
-        loop = asyncio.get_event_loop()
         d = os.path.join(self.config.data_dir, "services")
         if os.path.isdir(d):
             for fn in sorted(os.listdir(d)):
@@ -869,7 +878,7 @@ class Agent:
                         payload = json.load(f)
                     svc = _service_from_api(payload["service"])
                     cts = [CheckType(**ct) for ct in payload.get("check_types", [])]
-                    loop.create_task(self.add_service(
+                    self._spawn(self.add_service(
                         svc, cts, payload.get("token", ""), persist=False))
                 except (json.JSONDecodeError, KeyError, TypeError):
                     continue
@@ -891,7 +900,7 @@ class Agent:
                     ct = (CheckType(**payload["check_type"])
                           if payload.get("check_type") else None)
                     # (TTL saved-state restore happens inside add_check)
-                    loop.create_task(self.add_check(
+                    self._spawn(self.add_check(
                         check, ct, payload.get("token", ""), persist=False))
                 except (json.JSONDecodeError, KeyError, TypeError):
                     continue

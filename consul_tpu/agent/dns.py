@@ -24,7 +24,7 @@ import asyncio
 import random
 import struct
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import List, Optional, Set, Tuple
 
 from time import monotonic as _monotonic
 
@@ -430,12 +430,17 @@ class _UDPProtocol(asyncio.DatagramProtocol):
     def __init__(self, server: DNSServer) -> None:
         self.server = server
         self.transport: Optional[asyncio.DatagramTransport] = None
+        # anchor per-query tasks: the loop keeps only weak refs, and a
+        # GC'd task silently drops the DNS response
+        self._tasks: Set[asyncio.Task] = set()
 
     def connection_made(self, transport) -> None:
         self.transport = transport
 
     def datagram_received(self, data: bytes, addr) -> None:
-        asyncio.ensure_future(self._respond(data, addr))
+        task = asyncio.ensure_future(self._respond(data, addr))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def _respond(self, data: bytes, addr) -> None:
         resp = await self.server.handle(data, udp=True)

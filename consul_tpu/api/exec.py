@@ -63,8 +63,8 @@ class ExecJob:
             stop_renew.set()
             try:
                 self.c.session.destroy(session)
-            except Exception:
-                pass
+            except Exception:  # noqa: E02 — best-effort cleanup
+                pass  # session TTLs out on its own anyway
 
     def _run(self, session: str, on_output, on_exit) -> ExecResult:
         prefix = f"{REXEC_PREFIX}/{session}"

@@ -180,12 +180,15 @@ def cmd_agent(args) -> int:
                 watch_plans.append(plan)
         loop = asyncio.get_event_loop()
         stop = asyncio.Event()
+        reload_tasks: set = set()  # anchor against mid-reload GC
 
         def on_term() -> None:
             stop.set()
 
         def on_hup() -> None:
-            loop.create_task(agent.reload())
+            task = loop.create_task(agent.reload())
+            reload_tasks.add(task)
+            task.add_done_callback(reload_tasks.discard)
 
         def on_usr1() -> None:
             print(metrics.dump(), file=sys.stderr, flush=True)
@@ -225,7 +228,7 @@ def cmd_gossipd(args) -> int:
         try:
             import jax
             jax.config.update("jax_platforms", "cpu")
-        except Exception:
+        except Exception:  # noqa: E02 — jax absent or too old to force cpu
             pass
 
     # Persistent compile cache: a restarted plane must not pay the
@@ -238,7 +241,7 @@ def cmd_gossipd(args) -> int:
                 _os.path.abspath(__file__)))), ".jax_cache"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
+    except Exception:  # noqa: E02 — cache knobs are version-dependent
         pass
 
     from consul_tpu.gossip.plane import GossipPlane, PlaneConfig

@@ -298,7 +298,9 @@ class RaftNode:
         for t in self._repl_tasks + self._tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
+                pass  # we just cancelled it
+            except Exception:  # noqa: E02 — task's own failure; shutting down
                 pass
         self._fail_pending(NotLeaderError(None))
         self.log.close() if hasattr(self.log, "close") else None

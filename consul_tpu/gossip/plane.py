@@ -300,7 +300,9 @@ class GossipPlane:
             self._tick_task.cancel()
             try:
                 await self._tick_task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
+                pass  # we just cancelled it
+            except Exception:  # noqa: E02 — tick's own failure; shutting down
                 pass
         # Close every live connection BEFORE wait_closed(): since
         # Python 3.12.1 Server.wait_closed() waits for active handlers,
@@ -309,7 +311,7 @@ class GossipPlane:
         for writer in list(self._conns):
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # noqa: E02 — best-effort close at teardown
                 pass
         if self._server is not None:
             self._server.close()
@@ -414,7 +416,7 @@ class GossipPlane:
             if node.writer is not None:
                 try:
                     node.writer.close()
-                except Exception:
+                except Exception:  # noqa: E02 — best-effort close
                     pass
                 node.writer = None
 
@@ -676,7 +678,7 @@ class GossipPlane:
             # before this task ran — bail so wait_closed() can finish.
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # noqa: E02 — best-effort close
                 pass
             return
         self._conns.add(writer)
@@ -755,7 +757,7 @@ class GossipPlane:
                 me.writer = None
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # noqa: E02 — best-effort close
                 pass
 
     def _verify_auth(self, m: Dict[str, Any]) -> bool:
@@ -850,7 +852,7 @@ class GossipPlane:
         try:
             raw = msgpack.packb(payload, use_bin_type=True)
             writer.write(struct.pack(">I", len(raw)) + raw)
-        except Exception:
+        except Exception:  # noqa: E02 — dying peer socket; reaper collects it
             pass
 
 

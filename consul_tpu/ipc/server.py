@@ -77,6 +77,7 @@ class _Conn:
         self.unpacker = msgpack.Unpacker(raw=False)
         self.did_handshake = False
         self._monitors: Dict[int, Any] = {}  # monitor seq -> log sink
+        self._drains: set = set()  # anchor drain tasks against GC
 
     async def _next_obj(self) -> Any:
         while True:
@@ -187,8 +188,10 @@ class _Conn:
             try:
                 self._send({"Seq": seq, "Error": ""}, {"Log": line})
                 loop = asyncio.get_event_loop()
-                loop.create_task(_drain(self.writer))
-            except Exception:
+                task = loop.create_task(_drain(self.writer))
+                self._drains.add(task)
+                task.add_done_callback(self._drains.discard)
+            except Exception:  # noqa: E02 — monitor client died mid-stream
                 pass
 
         # Ack FIRST: the client reads one header as the command response;

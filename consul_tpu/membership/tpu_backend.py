@@ -66,9 +66,11 @@ class _AsyncioTransport:
                 (ln,) = struct.unpack(">I", hdr)
                 raw = await self._reader.readexactly(ln)
                 self._inbox.put_nowait(msgpack.unpackb(raw, raw=False))
-        except (asyncio.IncompleteReadError, ConnectionError,
-                asyncio.CancelledError):
+        except (asyncio.IncompleteReadError, ConnectionError):
             self._inbox.put_nowait(None)  # closed sentinel
+        except asyncio.CancelledError:
+            self._inbox.put_nowait(None)  # cancelled at close: same sentinel
+            raise
 
     def send(self, payload: Dict[str, Any]) -> None:
         raw = msgpack.packb(payload, use_bin_type=True)
@@ -106,7 +108,7 @@ class _AsyncioTransport:
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:
+            except Exception:  # noqa: E02 — best-effort close at teardown
                 pass
 
 
@@ -159,7 +161,9 @@ class TpuSerfPool:
             self._poll_task.cancel()
             try:
                 await self._poll_task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
+                pass  # we just cancelled it
+            except Exception:  # noqa: E02 — poll's own failure; shutting down
                 pass
         if self._bridge is not None:
             self._bridge.close()
@@ -368,7 +372,7 @@ class TpuSerfPool:
                 self._bridge.send({"t": "leave",
                                    "name": self.config.node_name})
                 await asyncio.sleep(0.05)  # let the frame flush
-            except Exception:
+            except Exception:  # noqa: E02 — best-effort leave notice
                 pass
 
     def force_leave(self, name: str) -> bool:
@@ -398,7 +402,7 @@ class TpuSerfPool:
         if self._bridge is not None:
             try:
                 self._bridge.send({"t": "tags", "tags": dict(tags)})
-            except Exception:
+            except Exception:  # noqa: E02 — plane gone; redial re-pushes tags
                 pass
 
     async def plane_stats(self, timeout: float = 5.0) -> Dict[str, Any]:
@@ -444,7 +448,7 @@ class TpuSerfPool:
         try:
             self._bridge.send({"t": "event", "name": name,
                                "payload": payload, "coalesce": coalesce})
-        except Exception:
+        except Exception:  # noqa: E02 — plane gone; events are best-effort
             pass
 
     # interface parity with SerfPool

@@ -122,9 +122,10 @@ class MuxSession:
                     st = self._streams.get(sid)
                     if st is not None:
                         st._push(payload)
-        except (asyncio.IncompleteReadError, ConnectionError, MuxError,
-                asyncio.CancelledError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError, MuxError):
+            pass  # peer closed; the finally block tears down the streams
+        except asyncio.CancelledError:
+            pass  # cancelled by close(); same teardown path, don't escape
         finally:
             self.closed = True
             for st in self._streams.values():

@@ -37,8 +37,8 @@ READ = "read"     # forwarded to leader unless allow_stale
 WRITE = "write"   # always to the leader
 
 
-def _opts(d: Dict) -> QueryOptions:
-    o = d.get("opts") or {}
+def _opts_from_wire(o: Optional[Dict]) -> QueryOptions:
+    o = o or {}
     return QueryOptions(
         token=o.get("token", ""), datacenter=o.get("datacenter", ""),
         min_query_index=o.get("min_query_index", 0),
@@ -47,7 +47,7 @@ def _opts(d: Dict) -> QueryOptions:
         require_consistent=o.get("require_consistent", False))
 
 
-def _meta_wire(meta) -> Dict:
+def _meta_to_wire(meta) -> Dict:
     return {"index": meta.index, "known_leader": meta.known_leader,
             "last_contact": meta.last_contact}
 
@@ -70,6 +70,7 @@ class RPCServer:
         self.addr: Optional[Tuple[str, int]] = None
         self._handlers = _build_handlers()
         self._conns: set = set()  # live connection writers, closed on stop
+        self._stream_tasks: set = set()  # anchor mux stream servers
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._listener = await asyncio.start_server(self._serve, host, port)
@@ -118,8 +119,10 @@ class RPCServer:
             sess = MuxSession(reader, writer, client=False)
             while True:
                 stream = await sess.accept_stream()
-                asyncio.get_event_loop().create_task(
+                task = asyncio.get_event_loop().create_task(
                     self._serve_stream(stream))
+                self._stream_tasks.add(task)
+                task.add_done_callback(self._stream_tasks.discard)
         elif selector in (RPC_CONSUL, RPC_RAFT):
             # single-exchange loop on the raw connection
             unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
@@ -258,50 +261,57 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
 
     @reg("Catalog.ListNodes", READ)
     async def catalog_nodes(srv, body):
-        meta, out = await srv.catalog.list_nodes(_opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.catalog.list_nodes(opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Catalog.ListServices", READ)
     async def catalog_services(srv, body):
-        meta, out = await srv.catalog.list_services(_opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.catalog.list_services(opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Catalog.ServiceNodes", READ)
     async def catalog_service_nodes(srv, body):
+        opts = _opts_from_wire(body.get("opts"))
         meta, out = await srv.catalog.service_nodes(
-            body.get("service", ""), _opts(body), body.get("tag", ""))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+            body.get("service", ""), opts, body.get("tag", ""))
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Catalog.NodeServices", READ)
     async def catalog_node_services(srv, body):
+        opts = _opts_from_wire(body.get("opts"))
         meta, out = await srv.catalog.node_services(
-            body.get("node", ""), _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+            body.get("node", ""), opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Health.ChecksInState", READ)
     async def health_state(srv, body):
+        opts = _opts_from_wire(body.get("opts"))
         meta, out = await srv.health.checks_in_state(
-            body.get("state", "any"), _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+            body.get("state", "any"), opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Health.NodeChecks", READ)
     async def health_node(srv, body):
-        meta, out = await srv.health.node_checks(body.get("node", ""),
-                                                 _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.health.node_checks(body.get("node", ""), opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Health.ServiceChecks", READ)
     async def health_checks(srv, body):
+        opts = _opts_from_wire(body.get("opts"))
         meta, out = await srv.health.service_checks(body.get("service", ""),
-                                                    _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+                                                    opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Health.ServiceNodes", READ)
     async def health_service(srv, body):
+        opts = _opts_from_wire(body.get("opts"))
         meta, out = await srv.health.service_nodes(
-            body.get("service", ""), _opts(body), body.get("tag", ""),
+            body.get("service", ""), opts, body.get("tag", ""),
             body.get("passing", False))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("KVS.Apply", WRITE)
     async def kvs_apply(srv, body):
@@ -310,17 +320,17 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
     @reg("KVS.Get", READ)
     async def kvs_get(srv, body):
         meta, out = await srv.kvs.get(KeyRequest.from_wire(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("KVS.List", READ)
     async def kvs_list(srv, body):
         meta, out = await srv.kvs.list(KeyListRequest.from_wire(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("KVS.ListKeys", READ)
     async def kvs_list_keys(srv, body):
         meta, out = await srv.kvs.list_keys(KeyListRequest.from_wire(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Session.Apply", WRITE)
     async def session_apply(srv, body):
@@ -328,19 +338,21 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
 
     @reg("Session.Get", READ)
     async def session_get(srv, body):
-        meta, out = await srv.session.get(body.get("id", ""), _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.session.get(body.get("id", ""), opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Session.List", READ)
     async def session_list(srv, body):
-        meta, out = await srv.session.list(_opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.session.list(opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Session.NodeSessions", READ)
     async def session_node(srv, body):
-        meta, out = await srv.session.node_sessions(body.get("node", ""),
-                                                    _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.session.node_sessions(body.get("node", ""), opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Session.Renew", WRITE)
     async def session_renew(srv, body):
@@ -355,8 +367,9 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
 
     @reg("ACL.Get", READ)
     async def acl_get(srv, body):
-        meta, out = await srv.acl.get(body.get("id", ""), _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.acl.get(body.get("id", ""), opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("ACL.GetPolicy", LOCAL)
     async def acl_get_policy(srv, body):
@@ -365,19 +378,21 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
 
     @reg("ACL.List", READ)
     async def acl_list(srv, body):
-        meta, out = await srv.acl.list(_opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.acl.list(opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Internal.NodeInfo", READ)
     async def internal_node_info(srv, body):
-        meta, out = await srv.internal.node_info(body.get("node", ""),
-                                                 _opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.internal.node_info(body.get("node", ""), opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     @reg("Internal.NodeDump", READ)
     async def internal_node_dump(srv, body):
-        meta, out = await srv.internal.node_dump(_opts(body))
-        return {"meta": _meta_wire(meta), "data": _w(out)}
+        opts = _opts_from_wire(body.get("opts"))
+        meta, out = await srv.internal.node_dump(opts)
+        return {"meta": _meta_to_wire(meta), "data": _w(out)}
 
     # READ, not LOCAL: the forward() prologue routes a fire naming
     # another datacenter over the WAN (internal_endpoint.go EventFire

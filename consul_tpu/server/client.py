@@ -40,14 +40,14 @@ class NoServersError(Exception):
     """No known consul servers (client.go "No known Consul servers")."""
 
 
-def _meta(d: Optional[Dict]) -> QueryMeta:
+def _meta_from_wire(d: Optional[Dict]) -> QueryMeta:
     d = d or {}
     return QueryMeta(index=d.get("index", 0),
                      known_leader=d.get("known_leader", True),
                      last_contact=d.get("last_contact", 0.0))
 
 
-def _opts_wire(opts: QueryOptions) -> Dict:
+def _opts_to_wire(opts: QueryOptions) -> Dict:
     return {"token": opts.token, "datacenter": opts.datacenter,
             "min_query_index": opts.min_query_index,
             "max_query_time": opts.max_query_time,
@@ -245,50 +245,51 @@ class _RemoteCatalog(_Remote):
         return await self.c.rpc("Catalog.ListDatacenters", {})
 
     async def list_nodes(self, opts: QueryOptions) -> tuple:
-        r = await self.c.rpc("Catalog.ListNodes", {"opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [Node.from_wire(n)
+        r = await self.c.rpc("Catalog.ListNodes",
+                             {"opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [Node.from_wire(n)
                                       for n in r.get("data") or []]
 
     async def list_services(self, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Catalog.ListServices",
-                             {"opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), dict(r.get("data") or {})
+                             {"opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), dict(r.get("data") or {})
 
     async def service_nodes(self, service: str, opts: QueryOptions,
                             tag: str = "") -> tuple:
         r = await self.c.rpc("Catalog.ServiceNodes",
                              {"service": service, "tag": tag,
-                              "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [ServiceNode.from_wire(n)
+                              "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [ServiceNode.from_wire(n)
                                       for n in r.get("data") or []]
 
     async def node_services(self, node: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Catalog.NodeServices",
-                             {"node": node, "opts": _opts_wire(opts)})
+                             {"node": node, "opts": _opts_to_wire(opts)})
         data = r.get("data")
         if data is None:
-            return _meta(r.get("meta")), None
-        return _meta(r.get("meta")), {
+            return _meta_from_wire(r.get("meta")), None
+        return _meta_from_wire(r.get("meta")), {
             sid: NodeService.from_wire(s) for sid, s in data.items()}
 
 
 class _RemoteHealth(_Remote):
     async def checks_in_state(self, state: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Health.ChecksInState",
-                             {"state": state, "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [HealthCheck.from_wire(x)
+                             {"state": state, "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [HealthCheck.from_wire(x)
                                       for x in r.get("data") or []]
 
     async def node_checks(self, node: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Health.NodeChecks",
-                             {"node": node, "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [HealthCheck.from_wire(x)
+                             {"node": node, "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [HealthCheck.from_wire(x)
                                       for x in r.get("data") or []]
 
     async def service_checks(self, service: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Health.ServiceChecks",
-                             {"service": service, "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [HealthCheck.from_wire(x)
+                             {"service": service, "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [HealthCheck.from_wire(x)
                                       for x in r.get("data") or []]
 
     async def service_nodes(self, service: str, opts: QueryOptions,
@@ -297,8 +298,8 @@ class _RemoteHealth(_Remote):
         r = await self.c.rpc("Health.ServiceNodes",
                              {"service": service, "tag": tag,
                               "passing": passing_only,
-                              "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [CheckServiceNode.from_wire(x)
+                              "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [CheckServiceNode.from_wire(x)
                                       for x in r.get("data") or []]
 
 
@@ -308,17 +309,17 @@ class _RemoteKVS(_Remote):
 
     async def get(self, args) -> tuple:
         r = await self.c.rpc("KVS.Get", args.to_wire())
-        return _meta(r.get("meta")), [DirEntry.from_wire(e)
+        return _meta_from_wire(r.get("meta")), [DirEntry.from_wire(e)
                                       for e in r.get("data") or []]
 
     async def list(self, args) -> tuple:
         r = await self.c.rpc("KVS.List", args.to_wire())
-        return _meta(r.get("meta")), [DirEntry.from_wire(e)
+        return _meta_from_wire(r.get("meta")), [DirEntry.from_wire(e)
                                       for e in r.get("data") or []]
 
     async def list_keys(self, args) -> tuple:
         r = await self.c.rpc("KVS.ListKeys", args.to_wire())
-        return _meta(r.get("meta")), list(r.get("data") or [])
+        return _meta_from_wire(r.get("meta")), list(r.get("data") or [])
 
 
 class _RemoteSession(_Remote):
@@ -327,20 +328,20 @@ class _RemoteSession(_Remote):
 
     async def get(self, sid: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Session.Get",
-                             {"id": sid, "opts": _opts_wire(opts)})
+                             {"id": sid, "opts": _opts_to_wire(opts)})
         data = r.get("data")
-        return _meta(r.get("meta")), (Session.from_wire(data)
+        return _meta_from_wire(r.get("meta")), (Session.from_wire(data)
                                       if data is not None else None)
 
     async def list(self, opts: QueryOptions) -> tuple:
-        r = await self.c.rpc("Session.List", {"opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [Session.from_wire(s)
+        r = await self.c.rpc("Session.List", {"opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [Session.from_wire(s)
                                       for s in r.get("data") or []]
 
     async def node_sessions(self, node: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Session.NodeSessions",
-                             {"node": node, "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [Session.from_wire(s)
+                             {"node": node, "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [Session.from_wire(s)
                                       for s in r.get("data") or []]
 
     async def renew(self, sid: str) -> Optional[Session]:
@@ -354,13 +355,13 @@ class _RemoteACL(_Remote):
 
     async def get(self, acl_id: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("ACL.Get",
-                             {"id": acl_id, "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [ACL.from_wire(a)
+                             {"id": acl_id, "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [ACL.from_wire(a)
                                       for a in r.get("data") or []]
 
     async def list(self, opts: QueryOptions) -> tuple:
-        r = await self.c.rpc("ACL.List", {"opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [ACL.from_wire(a)
+        r = await self.c.rpc("ACL.List", {"opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [ACL.from_wire(a)
                                       for a in r.get("data") or []]
 
 
@@ -380,11 +381,12 @@ def _dump_row(d: Dict) -> Dict:
 class _RemoteInternal(_Remote):
     async def node_info(self, node: str, opts: QueryOptions) -> tuple:
         r = await self.c.rpc("Internal.NodeInfo",
-                             {"node": node, "opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [_dump_row(d)
+                             {"node": node, "opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [_dump_row(d)
                                       for d in r.get("data") or []]
 
     async def node_dump(self, opts: QueryOptions) -> tuple:
-        r = await self.c.rpc("Internal.NodeDump", {"opts": _opts_wire(opts)})
-        return _meta(r.get("meta")), [_dump_row(d)
+        r = await self.c.rpc("Internal.NodeDump",
+                             {"opts": _opts_to_wire(opts)})
+        return _meta_from_wire(r.get("meta")), [_dump_row(d)
                                       for d in r.get("data") or []]

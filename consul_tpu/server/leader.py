@@ -158,8 +158,8 @@ class LeaderDuties:
                             session=Session(id=sid))
         try:
             await self.srv.raft_apply(MessageType.SESSION, req)
-        except Exception:
-            pass  # lost leadership mid-destroy; next leader re-arms
+        except Exception:  # noqa: E02 — lost leadership mid-destroy
+            pass  # next leader re-arms the timer
 
     def session_timer_count(self) -> int:
         return len(self._session_timers)
@@ -185,8 +185,8 @@ class LeaderDuties:
                     continue
                 try:
                     await self._reconcile_member(member)
-                except Exception:
-                    pass  # lost leadership mid-apply; next leader repairs
+                except Exception:  # noqa: E02 — lost leadership mid-apply
+                    pass  # next leader repairs
         except asyncio.CancelledError:
             pass
 

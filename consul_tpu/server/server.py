@@ -151,6 +151,7 @@ class Server:
         # leader_ri).
         self._confirm_batches: Dict[str, dict] = {}
         self._confirm_prev: Dict[str, asyncio.Future] = {}
+        self._confirm_tasks: set = set()  # anchor batch runners vs GC
 
         # Endpoint registry (server.go:414-431 registers the 7 services).
         from consul_tpu.server.endpoints import (
@@ -326,8 +327,10 @@ class Server:
             b = self._confirm_batches[key] = {
                 "fut": asyncio.get_event_loop().create_future(),
                 "fired": False}
-            asyncio.get_event_loop().create_task(
+            task = asyncio.get_event_loop().create_task(
                 self._run_confirm_batch(key, b, runner))
+            self._confirm_tasks.add(task)
+            task.add_done_callback(self._confirm_tasks.discard)
         return await asyncio.shield(b["fut"])
 
     async def _run_confirm_batch(self, key: str, b: dict, runner) -> None:
@@ -343,7 +346,7 @@ class Server:
                     # fires, stranding an unfired batch whose joiners
                     # wait forever.
                     await prev
-                except BaseException:
+                except BaseException:  # noqa: E02,E03 — see comment above
                     pass
             b["fired"] = True   # new arrivals form the next batch
             self._confirm_prev[key] = b["fut"]

@@ -28,6 +28,7 @@ def _bare_server() -> Server:
     srv = object.__new__(Server)
     srv._confirm_batches = {}
     srv._confirm_prev = {}
+    srv._confirm_tasks = set()
     return srv
 
 

@@ -1,0 +1,579 @@
+"""tools/vet — the six-pass static analyzer.
+
+Each pass gets one known-bad snippet (the planted defect it must
+catch) and one clean snippet (the idiomatic fix it must NOT flag),
+plus the suppression machinery (``# noqa: CODE``, blanket ``# noqa``,
+baseline) and the exit-code contract.  The meta-test at the bottom
+holds the analyzer to its own standard.
+"""
+
+import textwrap
+from pathlib import Path
+
+from tools.vet import async_safety, exceptions, names, tracer_purity
+from tools.vet import wire_schema
+from tools.vet.core import FileCtx, parse_noqa
+from tools.vet.driver import main as vet_main
+from tools.vet.driver import run_vet
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _ctx(tmp_path, name, src):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return FileCtx.load(p, p.as_posix())
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# -- names (the legacy pyvet passes on the new walker) -----------------------
+
+
+class TestNames:
+    def test_undefined_name(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            def f():
+                return not_defined_anywhere
+            """)
+        assert "N01" in _codes(names.check(ctx))
+
+    def test_unused_import(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import os
+            import sys
+
+            print(sys.argv)
+            """)
+        found = names.check(ctx)
+        assert _codes(found) == ["N02"]
+        assert "os" in found[0].message
+
+    def test_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import os
+
+            def f():
+                return os.getpid()
+            """)
+        assert names.check(ctx) == []
+
+
+# -- async-safety ------------------------------------------------------------
+
+
+class TestAsyncSafety:
+    def test_a01_unawaited_coroutine(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            async def work():
+                pass
+
+            async def caller():
+                work()
+            """)
+        assert "A01" in _codes(async_safety.check(ctx))
+
+    def test_a01_self_method(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class A:
+                async def start(self):
+                    pass
+
+                def boot(self):
+                    self.start()
+            """)
+        assert "A01" in _codes(async_safety.check(ctx))
+
+    def test_a01_other_object_not_flagged(self, tmp_path):
+        # self.local.start() must NOT match A.start — the sync method
+        # of another object merely shares the name.
+        ctx = _ctx(tmp_path, "m.py", """\
+            class A:
+                async def start(self):
+                    pass
+
+                def boot(self):
+                    self.local.start()
+            """)
+        assert async_safety.check(ctx) == []
+
+    def test_a02_dropped_task(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            async def main():
+                asyncio.create_task(asyncio.sleep(1))
+            """)
+        assert "A02" in _codes(async_safety.check(ctx))
+
+    def test_a02_task_set_pattern_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            tasks = set()
+
+            async def main():
+                t = asyncio.create_task(asyncio.sleep(1))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+            """)
+        assert async_safety.check(ctx) == []
+
+    def test_a03_blocking_call(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import time
+
+            async def f():
+                time.sleep(1)
+            """)
+        assert "A03" in _codes(async_safety.check(ctx))
+
+    def test_a03_through_from_import(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            from time import sleep
+
+            async def f():
+                sleep(1)
+            """)
+        assert "A03" in _codes(async_safety.check(ctx))
+
+    def test_a03_nested_sync_def_clean(self, tmp_path):
+        # a plain def nested in a coroutine may run in an executor
+        ctx = _ctx(tmp_path, "m.py", """\
+            import time
+
+            async def f():
+                def worker():
+                    time.sleep(1)
+                return worker
+            """)
+        assert async_safety.check(ctx) == []
+
+    def test_a04_threading_lock(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import threading
+
+            lock = threading.Lock()
+
+            async def f():
+                with lock:
+                    pass
+            """)
+        assert "A04" in _codes(async_safety.check(ctx))
+
+    def test_a04_asyncio_lock_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            lock = asyncio.Lock()
+
+            async def f():
+                async with lock:
+                    pass
+            """)
+        assert async_safety.check(ctx) == []
+
+
+# -- tracer-purity -----------------------------------------------------------
+
+
+class TestTracerPurity:
+    def test_j01_float_on_traced(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) + 1.0
+            """)
+        assert "J01" in _codes(tracer_purity.check(ctx))
+
+    def test_j01_item(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+            """)
+        assert "J01" in _codes(tracer_purity.check(ctx))
+
+    def test_j01_static_argname_exempt(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                return x * int(n)
+            """)
+        assert tracer_purity.check(ctx) == []
+
+    def test_j02_numpy_in_trace(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.cumsum(x)
+            """)
+        assert "J02" in _codes(tracer_purity.check(ctx))
+
+    def test_j02_dtype_constructor_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return jnp.cumsum(x.astype(np.int32))
+            """)
+        assert tracer_purity.check(ctx) == []
+
+    def test_j03_time_read(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import time
+
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x + time.monotonic()
+            """)
+        assert "J03" in _codes(tracer_purity.check(ctx))
+
+    def test_j03_reaches_helpers(self, tmp_path):
+        # the call graph extends the root set to module helpers
+        ctx = _ctx(tmp_path, "m.py", """\
+            import random
+
+            import jax
+
+            def helper(x):
+                return x * random.random()
+
+            @jax.jit
+            def f(x):
+                return helper(x)
+            """)
+        assert "J03" in _codes(tracer_purity.check(ctx))
+
+    def test_j04_scan_body_mutation(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            from jax import lax
+
+            seen = []
+
+            def body(carry, x):
+                seen.append(x)
+                return carry + x, x
+
+            def run(xs):
+                return lax.scan(body, 0, xs)
+            """)
+        assert "J04" in _codes(tracer_purity.check(ctx))
+
+    def test_j04_carry_threading_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            from jax import lax
+
+            def body(carry, x):
+                acc = carry + x
+                return acc, acc
+
+            def run(xs):
+                return lax.scan(body, 0, xs)
+            """)
+        assert tracer_purity.check(ctx) == []
+
+    def test_non_jax_module_skipped(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import time
+
+            def f(x):
+                return float(x) + time.time()
+            """)
+        assert tracer_purity.check(ctx) == []
+
+
+# -- wire-schema -------------------------------------------------------------
+
+
+class TestWireSchema:
+    def test_w01_w02_function_pair(self, tmp_path):
+        ctx = _ctx(tmp_path, "codec.py", """\
+            def ping_to_wire(m):
+                return {"a": m.a, "b": m.b}
+
+            def ping_from_wire(d):
+                return (d["a"], d.get("c"))
+            """)
+        found = wire_schema.check_project(
+            [ctx], modules=("codec.py",), envelope_groups=())
+        assert _codes(found) == ["W01", "W02"]
+        assert "'b'" in found[0].message   # written, never read
+        assert "'c'" in found[1].message   # read, never written
+
+    def test_class_pair_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "codec.py", """\
+            class Ping:
+                def to_wire(self):
+                    return {"a": self.a}
+
+                @classmethod
+                def from_wire(cls, d):
+                    return cls(d.get("a"))
+            """)
+        assert wire_schema.check_project(
+            [ctx], modules=("codec.py",), envelope_groups=()) == []
+
+    def test_one_sided_unit_skipped(self, tmp_path):
+        # the peer lives outside the scanned surface — no findings
+        ctx = _ctx(tmp_path, "codec.py", """\
+            def ping_to_wire(m):
+                return {"a": m.a}
+            """)
+        assert wire_schema.check_project(
+            [ctx], modules=("codec.py",), envelope_groups=()) == []
+
+    def test_envelope_group_cross_file(self, tmp_path):
+        srv = _ctx(tmp_path, "srv.py", """\
+            def reply(w, body):
+                w.send({"Seq": 1, "Error": "", "Extra": body})
+            """)
+        cli = _ctx(tmp_path, "cli.py", """\
+            def read(d):
+                return d["Seq"], d.get("Error"), d.get("Missing")
+            """)
+        found = wire_schema.check_project(
+            [srv, cli], modules=("srv.py", "cli.py"),
+            envelope_groups=(("env", ("srv.py", "cli.py")),))
+        assert _codes(found) == ["W02", "W01"]  # sorted by path
+        assert "'Missing'" in found[0].message
+        assert "'Extra'" in found[1].message
+
+    def test_repo_wire_surface_clean(self):
+        roots = [str(REPO / m) for m in wire_schema.WIRE_MODULES]
+        result = run_vet(roots, passes=["wire-schema"], baseline_path=None)
+        assert result.findings == []
+
+
+# -- exception-hygiene -------------------------------------------------------
+
+
+class TestExceptionHygiene:
+    def test_e01_bare_except(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            def f():
+                try:
+                    return 1
+                except:
+                    return 0
+            """)
+        assert "E01" in _codes(exceptions.check(ctx))
+
+    def test_e02_silent_broad(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    pass
+            """)
+        assert "E02" in _codes(exceptions.check(ctx))
+
+    def test_e02_handled_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import logging
+
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    logging.exception("f failed")
+            """)
+        assert exceptions.check(ctx) == []
+
+    def test_e03_tuple_with_cancelled(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            async def f(task):
+                try:
+                    await task
+                except (asyncio.CancelledError, ValueError):
+                    pass
+            """)
+        assert "E03" in _codes(exceptions.check(ctx))
+
+    def test_e03_cancel_only_exempt(self, tmp_path):
+        # the deliberate cancel-then-await idiom
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            async def f(task):
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            """)
+        assert exceptions.check(ctx) == []
+
+    def test_e03_reraise_exempt(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            async def f(task):
+                try:
+                    await task
+                except BaseException:
+                    task = None
+                    raise
+            """)
+        assert _codes(exceptions.check(ctx)) == []
+
+    def test_e03_sync_function_exempt(self, tmp_path):
+        # no coroutine, no cancellation to swallow (still E02 though)
+        ctx = _ctx(tmp_path, "m.py", """\
+            def f():
+                try:
+                    return 1
+                except BaseException:
+                    pass
+            """)
+        assert _codes(exceptions.check(ctx)) == ["E02"]
+
+
+# -- suppression: noqa + baseline --------------------------------------------
+
+
+class TestSuppression:
+    def test_parse_noqa_forms(self):
+        noqa = parse_noqa("x = 1  # noqa\ny = 2  # noqa: A02, e03\nz = 3\n")
+        assert noqa[1] is None            # blanket
+        assert noqa[2] == {"A02", "E03"}  # codes, case-folded
+        assert 3 not in noqa
+
+    def test_noqa_code_suppresses(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent("""\
+            import asyncio
+
+            async def main():
+                asyncio.create_task(asyncio.sleep(1))  # noqa: A02
+            """))
+        result = run_vet([str(p)], baseline_path=None)
+        assert result.findings == []
+
+    def test_noqa_wrong_code_does_not_suppress(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent("""\
+            import asyncio
+
+            async def main():
+                asyncio.create_task(asyncio.sleep(1))  # noqa: E02
+            """))
+        result = run_vet([str(p)], baseline_path=None)
+        assert _codes(result.findings) == ["A02"]
+
+    def test_blanket_noqa_suppresses_everything(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent("""\
+            import asyncio
+
+            async def main():
+                asyncio.create_task(asyncio.sleep(1))  # noqa
+            """))
+        result = run_vet([str(p)], baseline_path=None)
+        assert result.findings == []
+
+    def test_baseline_suppresses_and_counts(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("def f():\n    try:\n        return 1\n"
+                     "    except Exception:\n        pass\n")
+        unsuppressed = run_vet([str(p)], baseline_path=None)
+        assert _codes(unsuppressed.findings) == ["E02"]
+        base = tmp_path / "baseline.txt"
+        base.write_text("# justified: fixture\n"
+                        + unsuppressed.findings[0].baseline_key() + "\n")
+        result = run_vet([str(p)], baseline_path=base)
+        assert result.findings == []
+        assert result.baselined == 1
+        assert result.rc == 0
+
+    def test_stale_baseline_entry_reported(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        base = tmp_path / "baseline.txt"
+        base.write_text("gone.py|E02|no longer found\n")
+        result = run_vet([str(p)], baseline_path=base)
+        assert result.stale_baseline == ["gone.py|E02|no longer found"]
+
+    def test_write_baseline_roundtrip(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("def f():\n    try:\n        return 1\n"
+                     "    except Exception:\n        pass\n")
+        base = tmp_path / "baseline.txt"
+        first = run_vet([str(p)], baseline_path=base, update_baseline=True)
+        assert first.findings == [] and first.baselined == 1
+        again = run_vet([str(p)], baseline_path=base)
+        assert again.rc == 0 and again.stale_baseline == []
+
+
+# -- exit codes (the `make vet` contract) ------------------------------------
+
+
+class TestExitCodes:
+    def test_rc0_clean(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        assert vet_main([str(p), "--no-baseline"]) == 0
+
+    def test_rc1_findings(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("def f():\n    try:\n        return 1\n"
+                     "    except:\n        pass\n")
+        assert vet_main([str(p), "--no-baseline"]) == 1
+
+    def test_rc2_parse_error(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("def f(:\n")
+        assert vet_main([str(p), "--no-baseline"]) == 2
+
+    def test_rc2_unknown_pass(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        assert vet_main([str(p), "--passes", "nope"]) == 2
+
+    def test_pass_subset_runs_only_that_pass(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("import os\n\n\ndef f():\n    try:\n        return 1\n"
+                     "    except:\n        pass\n")
+        result = run_vet([str(p)], passes=["names"], baseline_path=None)
+        assert _codes(result.findings) == ["N02"]  # E01 pass not selected
+
+    def test_legacy_pyvet_cli_still_names_only(self, tmp_path):
+        from tools import pyvet
+        p = tmp_path / "m.py"
+        p.write_text("def f():\n    try:\n        return 1\n"
+                     "    except:\n        pass\n")
+        assert pyvet.main([str(p)]) == 0  # E01 is not a legacy pass
+
+
+# -- meta: the analyzer meets its own standard -------------------------------
+
+
+class TestSelfAnalysis:
+    def test_tools_vet_is_clean_under_itself(self):
+        result = run_vet([str(REPO / "tools" / "vet")], baseline_path=None)
+        assert result.parse_errors == []
+        assert result.findings == []

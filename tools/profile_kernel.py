@@ -25,7 +25,6 @@ sys.path.insert(0, REPO)
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def _checksum(out):

@@ -1,322 +1,35 @@
-"""pyflakes-lite static pass for `make vet`: undefined names + unused
-imports, no third-party dependencies (the image has no linter; byte-
-compilation alone misses exactly these two classes of rot).
+"""Back-compat shim: the two original pyvet passes (undefined names +
+unused imports) now live in ``tools/vet/names.py`` on the shared
+single-parse walker, honoring the package's ``# noqa: CODE``
+convention (blanket ``# noqa`` still suppresses everything on a line).
 
-Scope is deliberately narrow and low-false-positive:
-
-- **Unused imports**: a module-level or function-level import whose
-  bound name is never read anywhere in the module.  Names re-exported
-  via ``__all__`` strings count as used; ``__init__.py`` files are
-  exempt entirely (re-export surface); ``from __future__`` and
-  ``import x as _`` (underscore convention) are exempt; a trailing
-  ``# noqa`` on the import line suppresses.
-- **Undefined names**: a Name load with no binding in any enclosing
-  scope, module global, builtin, or wildcard-import escape hatch.  A
-  module containing ``from x import *`` skips undefined-name analysis
-  (the star can bind anything); class bodies and comprehension scopes
-  follow Python's actual scoping (class-body names are invisible to
-  nested functions).
-
-Exit status 0 = clean, 1 = findings (printed one per line as
-``path:line: message``), 2 = a file failed to parse (syntax errors are
-compileall's job, but we must not crash past them silently).
+``python tools/pyvet.py <paths>`` runs ONLY those two passes — the
+historical contract.  The full six-pass analyzer (async-safety,
+tracer-purity, wire-schema, exception-hygiene) is what ``make vet``
+runs:  ``python -m tools.vet <paths>``.
 """
 
 from __future__ import annotations
 
-import ast
-import builtins
 import sys
 from pathlib import Path
-from typing import Dict, List, Set, Tuple
+from typing import List, Optional, Sequence
 
-BUILTINS = set(dir(builtins)) | {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
-    # typing/runtime dunders commonly read without a binding
-    "__annotations__", "__dict__", "__all__",
-    "WindowsError",  # guarded platform reads
-}
+# runnable as a script: tools/pyvet.py puts tools/ first on sys.path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.vet.driver import LEGACY_PASSES, run_vet  # noqa: E402
 
 
-def _noqa_lines(src: str) -> Set[int]:
-    return {i for i, line in enumerate(src.splitlines(), 1)
-            if "# noqa" in line or "#noqa" in line}
-
-
-class _Scope:
-    __slots__ = ("node", "bound", "is_class")
-
-    def __init__(self, node: ast.AST, is_class: bool = False) -> None:
-        self.node = node
-        self.bound: Set[str] = set()
-        self.is_class = is_class
-
-
-def _binds(node: ast.AST, into: Set[str]) -> None:
-    """Collect the names a statement binds in its own scope (no
-    recursion into nested function/class bodies)."""
-    if isinstance(node, (ast.Import, ast.ImportFrom)):
-        for a in node.names:
-            if a.name == "*":
-                continue
-            into.add((a.asname or a.name).split(".")[0])
-    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                           ast.ClassDef)):
-        into.add(node.name)
-    elif isinstance(node, ast.Name) and isinstance(node.ctx,
-                                                   (ast.Store, ast.Del)):
-        into.add(node.id)
-    elif isinstance(node, (ast.Global, ast.Nonlocal)):
-        into.update(node.names)
-    elif isinstance(node, ast.ExceptHandler) and node.name:
-        into.add(node.name)
-    elif isinstance(node, (ast.MatchAs, ast.MatchStar)) \
-            and getattr(node, "name", None):
-        into.add(node.name)
-    elif isinstance(node, ast.MatchMapping) and node.rest:
-        into.add(node.rest)
-
-
-def _args_of(fn) -> Set[str]:
-    a = fn.args
-    names = {x.arg for x in
-             a.posonlyargs + a.args + a.kwonlyargs}
-    if a.vararg:
-        names.add(a.vararg.arg)
-    if a.kwarg:
-        names.add(a.kwarg.arg)
-    return names
-
-
-class _Checker(ast.NodeVisitor):
-    """Two-pass per scope: pre-bind every name the scope assigns
-    anywhere (Python scoping is whole-scope, not top-down), then walk
-    loads."""
-
-    def __init__(self, path: str, src: str, tree: ast.Module) -> None:
-        self.path = path
-        self.noqa = _noqa_lines(src)
-        self.findings: List[Tuple[int, str]] = []
-        self.has_star = any(
-            isinstance(n, ast.ImportFrom) and any(a.name == "*"
-                                                  for a in n.names)
-            for n in ast.walk(tree))
-        # import bookkeeping: name -> (lineno, shown-as)
-        self.imports: Dict[str, Tuple[int, str]] = {}
-        self.used: Set[str] = set()
-        self.scopes: List[_Scope] = []
-        self.tree = tree
-
-    # -- scope machinery ----------------------------------------------------
-
-    def _prebind(self, scope: _Scope, body: List[ast.stmt]) -> None:
-        todo = list(body)
-        while todo:
-            node = todo.pop()
-            _binds(node, scope.bound)
-            for child in ast.iter_child_nodes(node):
-                # stop at nested scopes — their bindings are their own
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef, ast.ClassDef,
-                                      ast.Lambda)):
-                    _binds(child, scope.bound)
-                    continue
-                if isinstance(child, (ast.ListComp, ast.SetComp,
-                                      ast.DictComp, ast.GeneratorExp)):
-                    continue  # comprehensions have their own scope
-                todo.append(child)
-
-    def _visible(self, name: str) -> bool:
-        if name in BUILTINS:
-            return True
-        for i, scope in enumerate(reversed(self.scopes)):
-            # class-body bindings are invisible to nested scopes
-            # (only the innermost scope may BE the class body)
-            if scope.is_class and i != 0:
-                continue
-            if name in scope.bound:
-                return True
-        return False
-
-    # -- visitors -----------------------------------------------------------
-
-    def check(self) -> None:
-        root = _Scope(self.tree)
-        self.scopes.append(root)
-        self._prebind(root, self.tree.body)
-        for node in self.tree.body:
-            self.visit(node)
-        self.scopes.pop()
-        # __all__ strings count as uses of the re-exported names
-        for node in self.tree.body:
-            if isinstance(node, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == "__all__"
-                    for t in node.targets):
-                for el in ast.walk(node.value):
-                    if isinstance(el, ast.Constant) \
-                            and isinstance(el.value, str):
-                        self.used.add(el.value)
-        for name, (line, shown) in sorted(self.imports.items(),
-                                          key=lambda kv: kv[1][0]):
-            if name not in self.used and not name.startswith("_") \
-                    and line not in self.noqa:
-                self.findings.append(
-                    (line, f"unused import '{shown}'"))
-
-    def _enter(self, node, bound: Set[str], is_class: bool = False):
-        scope = _Scope(node, is_class)
-        scope.bound |= bound
-        self.scopes.append(scope)
-        body = node.body if isinstance(node.body, list) else [node.body]
-        self._prebind(scope, [b for b in body
-                              if isinstance(b, ast.stmt)] or [])
-        return scope
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imports.setdefault(name, (node.lineno,
-                                           a.asname or a.name))
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            if a.name == "*":
-                continue
-            name = a.asname or a.name
-            self.imports.setdefault(name, (node.lineno, name))
-
-    def _visit_function(self, node) -> None:
-        for dec in node.decorator_list:
-            self.visit(dec)
-        for default in (node.args.defaults + node.args.kw_defaults):
-            if default is not None:
-                self.visit(default)
-        for x in (node.args.posonlyargs + node.args.args
-                  + node.args.kwonlyargs):
-            if x.annotation:
-                self.visit(x.annotation)
-        if node.returns:
-            self.visit(node.returns)
-        scope = self._enter(node, _args_of(node))
-        for stmt in node.body:
-            self.visit(stmt)
-        self.scopes.pop()
-        del scope
-
-    visit_FunctionDef = _visit_function
-    visit_AsyncFunctionDef = _visit_function
-
-    def visit_Lambda(self, node: ast.Lambda) -> None:
-        for default in (node.args.defaults + node.args.kw_defaults):
-            if default is not None:
-                self.visit(default)
-        scope = _Scope(node)
-        scope.bound |= _args_of(node)
-        self.scopes.append(scope)
-        self.visit(node.body)
-        self.scopes.pop()
-
-    def visit_ClassDef(self, node: ast.ClassDef) -> None:
-        for dec in node.decorator_list:
-            self.visit(dec)
-        for base in node.bases + [k.value for k in node.keywords]:
-            self.visit(base)
-        scope = self._enter(node, set(), is_class=True)
-        for stmt in node.body:
-            self.visit(stmt)
-        self.scopes.pop()
-        del scope
-
-    def _visit_comp(self, node) -> None:
-        scope = _Scope(node)
-        self.scopes.append(scope)
-        for gen in node.generators:
-            # the first iterable evaluates in the ENCLOSING scope, but
-            # treating it as inner only risks false-negatives, not
-            # false-positives — acceptable for a lite pass
-            for n in ast.walk(gen.target):
-                _binds(n, scope.bound)
-        for gen in node.generators:
-            self.visit(gen.iter)
-            for cond in gen.ifs:
-                self.visit(cond)
-        if isinstance(node, ast.DictComp):
-            self.visit(node.key)
-            self.visit(node.value)
-        else:
-            self.visit(node.elt)
-        self.scopes.pop()
-
-    visit_ListComp = _visit_comp
-    visit_SetComp = _visit_comp
-    visit_DictComp = _visit_comp
-    visit_GeneratorExp = _visit_comp
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-            if not self.has_star and not self._visible(node.id) \
-                    and node.lineno not in self.noqa:
-                self.findings.append(
-                    (node.lineno, f"undefined name '{node.id}'"))
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        # `import a.b; a.b.c` — the root name is the use
-        self.generic_visit(node)
-
-    def visit_Constant(self, node: ast.Constant) -> None:
-        # string annotations under `from __future__ import annotations`
-        # may reference imported names — count words as uses (cheap,
-        # suppresses typing-only "unused import" false positives)
-        if isinstance(node.value, str) and len(node.value) < 200:
-            for tok in node.value.replace("[", " ").replace("]", " ") \
-                    .replace(",", " ").replace(".", " ").split():
-                if tok.isidentifier():
-                    self.used.add(tok)
-
-
-def check_file(path: Path) -> List[str]:
-    src = path.read_text(encoding="utf-8", errors="replace")
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    checker = _Checker(str(path), src, tree)
-    checker.check()
-    if path.name == "__init__.py":
-        # re-export surface: unused-import findings don't apply, but
-        # undefined names still do
-        checker.findings = [f for f in checker.findings
-                            if "unused import" not in f[1]]
-    return [f"{path}:{line}: {msg}"
-            for line, msg in sorted(checker.findings)]
-
-
-def main(argv: List[str]) -> int:
-    roots = [Path(a) for a in argv] or [Path("consul_tpu"), Path("tests")]
-    files: List[Path] = []
-    for root in roots:
-        if root.is_file():
-            files.append(root)
-        else:
-            files.extend(sorted(root.rglob("*.py")))
-    findings: List[str] = []
-    rc = 0
-    for f in files:
-        out = check_file(f)
-        if any("syntax error" in line for line in out):
-            rc = 2
-        findings.extend(out)
-    for line in findings:
-        print(line)
-    if findings and rc == 0:
-        rc = 1
-    if not findings:
-        print(f"pyvet: {len(files)} files clean", file=sys.stderr)
-    return rc
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    roots: List[str] = list(argv) if argv else ["consul_tpu", "tests"]
+    result = run_vet(roots, passes=list(LEGACY_PASSES),
+                     baseline_path=None)
+    for f in result.parse_errors + result.findings:
+        print(f.render())
+    if result.rc == 0:
+        print(f"pyvet: {result.files} files clean", file=sys.stderr)
+    return result.rc
 
 
 if __name__ == "__main__":

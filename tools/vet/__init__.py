@@ -1,0 +1,21 @@
+"""`make vet`'s analyzer: six passes over one shared parse.
+
+The ``go vet`` role for a tree with no third-party linter.  Passes
+(each module documents its codes and heuristics):
+
+- ``names``            N01 undefined name, N02 unused import
+- ``async-safety``     A01 unawaited coroutine, A02 dropped task,
+                       A03 blocking call in coroutine, A04 threading
+                       lock in coroutine
+- ``tracer-purity``    J01 host round-trip, J02 numpy-in-trace,
+                       J03 impure read, J04 scan-body mutation
+- ``wire-schema``      W01 written-never-read, W02 read-never-written
+- ``exception-hygiene``  E01 bare except, E02 silent broad handler,
+                       E03 swallowed CancelledError
+
+Suppression: ``# noqa: CODE[,CODE…]`` per line (blanket ``# noqa``
+still works), or an entry in ``tools/vet/baseline.txt`` for accepted
+legacy findings.  Run: ``python -m tools.vet <paths>``.
+"""
+
+from tools.vet.core import FileCtx, Finding, Pass  # noqa: F401 (re-export)

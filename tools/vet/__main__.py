@@ -1,0 +1,5 @@
+import sys
+
+from tools.vet.driver import main
+
+sys.exit(main())

@@ -1,0 +1,249 @@
+"""Async-safety pass: the event-loop bug classes pytest-on-CPU cannot
+see because they only bite under load or GC pressure.
+
+- **A01 unawaited coroutine**: an expression-statement call to a
+  function the module defines with ``async def``.  The coroutine is
+  created and dropped — the body never runs (asyncio warns at GC time,
+  long after the damage).  Matching is scope-aware to stay
+  near-zero-false-positive: a bare ``name()`` matches module-level /
+  nested ``async def name``, and ``self.name()`` matches an ``async
+  def name`` on the *enclosing* class only — ``self.local.start()``
+  never matches ``Agent.start``.
+- **A02 dropped task**: ``asyncio.create_task(...)`` /
+  ``loop.create_task(...)`` / ``ensure_future(...)`` whose return
+  value is discarded.  The event loop holds only a weak reference to
+  tasks; a dropped handle can be garbage-collected mid-run, silently
+  cancelling the work (the gossip plane's failure mode).  Keep a
+  strong reference — the task-set pattern:
+  ``self._tasks.add(t); t.add_done_callback(self._tasks.discard)``.
+- **A03 blocking call in coroutine**: ``time.sleep``, sync
+  ``subprocess`` helpers, sync socket/DNS ops, ``os.system`` … lexically
+  inside an ``async def`` — each one stalls the whole event loop (the
+  gossip plane misses heartbeats for every peer, not just the caller).
+  Calls inside a nested plain ``def`` are NOT flagged (that function
+  may legitimately run in an executor or thread).
+- **A04 threading lock in coroutine**: ``with lock:`` /
+  ``lock.acquire()`` on a name assigned from ``threading.Lock()`` (or
+  RLock/Condition/Semaphore), used inside an ``async def``.  A
+  contended threading lock blocks the loop; use ``asyncio.Lock`` or
+  keep the critical section out of coroutines.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.vet.core import FileCtx, Finding, dotted_name
+
+UNAWAITED = "A01"
+DROPPED_TASK = "A02"
+BLOCKING = "A03"
+THREAD_LOCK = "A04"
+
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+# dotted stdlib calls that block the loop (module-rooted chains only)
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname", "socket.gethostbyaddr",
+    "os.system", "os.wait", "os.waitpid",
+    "urllib.request.urlopen",
+    "select.select",
+}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+def _module_imports(tree: ast.Module) -> Dict[str, str]:
+    """local name -> dotted origin, for ``import x`` / ``from x import y``."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[(a.asname or a.name).split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _lock_names(tree: ast.Module, imports: Dict[str, str]) -> Set[str]:
+    """Simple names (or attribute tails, for ``self._lock``) assigned
+    from a threading lock factory anywhere in the module."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        dn = dotted_name(value.func)
+        if dn is None:
+            continue
+        parts = dn.split(".")
+        is_lock = (len(parts) == 2 and imports.get(parts[0]) == "threading"
+                   and parts[1] in _LOCK_FACTORIES) or \
+                  (len(parts) == 1 and parts[0] in _LOCK_FACTORIES
+                   and imports.get(parts[0], "").startswith("threading."))
+        if not is_lock:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                names.add(t.attr)
+    return names
+
+
+def _target_name(node: ast.AST) -> Optional[str]:
+    """Name id, or attribute tail for ``self.x`` / ``obj.x`` chains."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, ctx: FileCtx, bare_async: Set[str],
+                 imports: Dict[str, str], locks: Set[str]) -> None:
+        self.ctx = ctx
+        self.bare_async = bare_async  # async defs NOT on a class
+        self.imports = imports
+        self.locks = locks
+        self.findings: List[Finding] = []
+        self._async_depth = 0
+        # async method names of each lexically-enclosing class
+        self._class_async: List[Set[str]] = []
+
+    def _emit(self, node: ast.AST, code: str, msg: str) -> None:
+        self.findings.append(Finding(self.ctx.path, node.lineno, code, msg))
+
+    # -- scope tracking -----------------------------------------------------
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_async.append({
+            n.name for n in node.body
+            if isinstance(n, ast.AsyncFunctionDef)})
+        self.generic_visit(node)
+        self._class_async.pop()
+
+    def _is_unawaited_async(self, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id in self.bare_async:
+            return fn.id
+        if isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("self", "cls") \
+                and self._class_async \
+                and fn.attr in self._class_async[-1]:
+            return fn.attr
+        return None
+
+    # -- checks -------------------------------------------------------------
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            name = _target_name(call.func)
+            unawaited = self._is_unawaited_async(call)
+            if name in _TASK_SPAWNERS:
+                self._emit(
+                    node, DROPPED_TASK,
+                    f"return value of {name}() is discarded — the loop "
+                    "keeps only a weak reference, so the task can be "
+                    "garbage-collected mid-run; keep a strong reference "
+                    "(task-set pattern)")
+            elif unawaited is not None:
+                self._emit(
+                    node, UNAWAITED,
+                    f"call to async function '{unawaited}' is never "
+                    "awaited (the coroutine object is created and "
+                    "dropped)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._async_depth:
+            dn = dotted_name(node.func)
+            if dn is not None:
+                resolved = self._resolve(dn)
+                if resolved in _BLOCKING_CALLS:
+                    self._emit(
+                        node, BLOCKING,
+                        f"blocking call {resolved}() inside 'async def' "
+                        "stalls the event loop; use the asyncio "
+                        "equivalent or an executor")
+            name = _target_name(node.func)
+            if name == "acquire" and isinstance(node.func, ast.Attribute):
+                tail = _target_name(node.func.value)
+                if tail in self.locks:
+                    self._emit(
+                        node, THREAD_LOCK,
+                        f"threading lock '{tail}' acquired inside "
+                        "'async def' — a contended acquire blocks the "
+                        "event loop; use asyncio.Lock")
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        if self._async_depth:
+            for item in node.items:
+                tail = _target_name(item.context_expr)
+                if tail in self.locks:
+                    self._emit(
+                        node, THREAD_LOCK,
+                        f"threading lock '{tail}' held inside 'async def' "
+                        "— a contended acquire blocks the event loop; "
+                        "use asyncio.Lock")
+        self.generic_visit(node)
+
+    def _resolve(self, dn: str) -> str:
+        """Rewrite the chain root through the module's imports so
+        ``from time import sleep; sleep()`` still resolves to
+        ``time.sleep``."""
+        root, _, rest = dn.partition(".")
+        origin = self.imports.get(root)
+        if origin is None:
+            return dn
+        return f"{origin}.{rest}" if rest else origin
+
+
+def _bare_async_defs(tree: ast.Module) -> Set[str]:
+    """Async defs whose immediate parent is NOT a class body (callable
+    by bare name: module level, or nested closures)."""
+    method_ids: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                if isinstance(child, ast.AsyncFunctionDef):
+                    method_ids.add(id(child))
+    return {n.name for n in ast.walk(tree)
+            if isinstance(n, ast.AsyncFunctionDef)
+            and id(n) not in method_ids}
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    imports = _module_imports(ctx.tree)
+    locks = _lock_names(ctx.tree, imports)
+    w = _Walker(ctx, _bare_async_defs(ctx.tree), imports, locks)
+    w.visit(ctx.tree)
+    return sorted(w.findings, key=lambda f: (f.line, f.code))

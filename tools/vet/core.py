@@ -1,0 +1,183 @@
+"""Shared infrastructure for the vet passes: one parse per file, one
+finding type, one suppression model.
+
+Every pass consumes a :class:`FileCtx` (path + source + AST + noqa
+map) and emits :class:`Finding` objects.  The driver owns suppression:
+
+- ``# noqa`` on a line suppresses every finding on that line (legacy
+  blanket form, kept for compatibility);
+- ``# noqa: A02`` / ``# noqa: A02, E03`` suppresses only the listed
+  codes — the preferred form, because it keeps the other passes honest
+  on that line;
+- ``tools/vet/baseline.txt`` holds accepted legacy findings keyed by
+  ``path|CODE|message`` (no line numbers, so the baseline survives
+  unrelated edits).  ``--write-baseline`` regenerates it.
+
+Exit status contract (same as the old pyvet): 0 clean, 1 findings,
+2 parse failure.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+_NOQA_RE = re.compile(r"#\s*noqa(?:\s*:\s*(?P<codes>[A-Za-z0-9_, ]+))?",
+                      re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.path}|{self.code}|{self.message}"
+
+
+@dataclass
+class FileCtx:
+    """One parsed source file, shared by every pass (single parse)."""
+
+    path: str
+    src: str
+    tree: ast.Module
+    # line -> None (blanket noqa) or the set of suppressed codes
+    noqa: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: Path, display: str) -> "FileCtx":
+        src = path.read_text(encoding="utf-8", errors="replace")
+        tree = ast.parse(src, filename=display)  # may raise SyntaxError
+        return cls(display, src, tree, parse_noqa(src))
+
+    def suppressed(self, line: int, code: str) -> bool:
+        if line not in self.noqa:
+            return False
+        codes = self.noqa[line]
+        return codes is None or code in codes
+
+
+def parse_noqa(src: str) -> Dict[int, Optional[Set[str]]]:
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, text in enumerate(src.splitlines(), 1):
+        m = _NOQA_RE.search(text)
+        if not m:
+            continue
+        raw = m.group("codes")
+        if raw is None:
+            out[i] = None  # blanket
+        else:
+            codes = {c.strip().upper() for c in raw.split(",") if c.strip()}
+            out[i] = codes or None
+    return out
+
+
+@dataclass
+class Pass:
+    """A named analysis: either per-file (``check``) or whole-project
+    (``check_project`` — for cross-file passes like wire-schema)."""
+
+    name: str
+    codes: Sequence[str]
+    check: Optional[Callable[[FileCtx], List[Finding]]] = None
+    check_project: Optional[
+        Callable[[List[FileCtx]], List[Finding]]] = None
+
+    def run(self, ctxs: List[FileCtx]) -> List[Finding]:
+        if self.check_project is not None:
+            return list(self.check_project(ctxs))
+        assert self.check is not None
+        out: List[Finding] = []
+        for ctx in ctxs:
+            out.extend(self.check(ctx))
+        return out
+
+
+# -- file collection ---------------------------------------------------------
+
+
+def collect_files(roots: Iterable[str]) -> List[Path]:
+    files: List[Path] = []
+    for root in roots:
+        p = Path(root)
+        if p.is_file():
+            files.append(p)
+        elif p.is_dir():
+            files.extend(f for f in sorted(p.rglob("*.py"))
+                         if "__pycache__" not in f.parts)
+    return files
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> List[str]:
+    """Baseline entries, one ``path|CODE|message`` key per line;
+    ``#``-prefixed lines are justification comments."""
+    if not path.is_file():
+        return []
+    out: List[str] = []
+    for line in path.read_text(encoding="utf-8").splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            out.append(line)
+    return out
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    lines = [
+        "# vet baseline — accepted legacy findings, one per line as",
+        "# path|CODE|message (line numbers omitted so entries survive",
+        "# unrelated edits).  Regenerate with:  python -m tools.vet",
+        "#   <paths> --write-baseline.  New code must come in clean;",
+        "# prefer a targeted `# noqa: CODE` with a justification",
+        "# comment over growing this file.",
+        "",
+    ]
+    seen: Set[str] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.code, f.message)):
+        key = f.baseline_key()
+        if key not in seen:
+            seen.add(key)
+            lines.append(key)
+    path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+
+
+# -- AST helpers shared by several passes ------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain rooted at a Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def func_scopes(tree: ast.Module):
+    """Yield ``(node, async_stack)`` for every statement-bearing node,
+    where ``async_stack`` is True when the nearest enclosing function
+    is an ``async def`` (lambdas are transparent)."""
+    def walk(node: ast.AST, in_async: bool):
+        for child in ast.iter_child_nodes(node):
+            child_async = in_async
+            if isinstance(child, ast.AsyncFunctionDef):
+                child_async = True
+            elif isinstance(child, ast.FunctionDef):
+                child_async = False
+            yield child, child_async
+            yield from walk(child, child_async)
+    yield from walk(tree, False)

@@ -1,0 +1,157 @@
+"""Driver: collect files, parse once, run the passes, apply noqa +
+baseline suppression, print findings and the per-pass summary.
+
+Exit codes (the ``make vet`` contract): 0 clean, 1 findings, 2 a file
+failed to parse (syntax errors are compileall's job, but we must not
+crash past them silently).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from tools.vet import (async_safety, exceptions, names, tracer_purity,
+                       wire_schema)
+from tools.vet.core import (FileCtx, Finding, Pass, collect_files,
+                            load_baseline, write_baseline)
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.txt"
+
+PASSES: List[Pass] = [
+    Pass("names", codes=("N01", "N02"), check=names.check),
+    Pass("async-safety", codes=("A01", "A02", "A03", "A04"),
+         check=async_safety.check),
+    Pass("tracer-purity", codes=("J01", "J02", "J03", "J04"),
+         check=tracer_purity.check),
+    Pass("wire-schema", codes=("W01", "W02"),
+         check_project=wire_schema.check_project),
+    Pass("exception-hygiene", codes=("E01", "E02", "E03"),
+         check=exceptions.check),
+]
+
+# pyvet backwards-compat: the two legacy passes ride in "names"
+LEGACY_PASSES = ("names",)
+
+
+@dataclass
+class VetResult:
+    findings: List[Finding] = field(default_factory=list)   # reported
+    baselined: int = 0
+    stale_baseline: List[str] = field(default_factory=list)
+    parse_errors: List[Finding] = field(default_factory=list)
+    per_pass: Dict[str, int] = field(default_factory=dict)
+    files: int = 0
+
+    @property
+    def rc(self) -> int:
+        if self.parse_errors:
+            return 2
+        return 1 if self.findings else 0
+
+
+def run_vet(roots: Sequence[str],
+            passes: Optional[Sequence[str]] = None,
+            baseline_path: Optional[Path] = DEFAULT_BASELINE,
+            update_baseline: bool = False) -> VetResult:
+    result = VetResult()
+    selected = [p for p in PASSES if passes is None or p.name in passes]
+    ctxs: List[FileCtx] = []
+    for path in collect_files(roots):
+        display = path.as_posix()
+        try:
+            ctxs.append(FileCtx.load(path, display))
+        except SyntaxError as e:
+            result.parse_errors.append(Finding(
+                display, e.lineno or 0, "P00", f"syntax error: {e.msg}"))
+    result.files = len(ctxs)
+    by_path = {c.path: c for c in ctxs}
+
+    raw: List[Finding] = []
+    for p in selected:
+        found = p.run(ctxs)
+        kept = [f for f in found
+                if not by_path[f.path].suppressed(f.line, f.code)]
+        result.per_pass[p.name] = len(kept)
+        raw.extend(kept)
+
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    if update_baseline and baseline_path is not None:
+        write_baseline(baseline_path, raw)
+        baseline = load_baseline(baseline_path)
+    matched: set = set()
+    for f in raw:
+        key = f.baseline_key()
+        if key in baseline:
+            matched.add(key)
+            result.baselined += 1
+            # summary counts report what the pass FOUND; subtract the
+            # baselined share so `per_pass` mirrors the printed list
+            for p in selected:
+                if f.code in p.codes:
+                    result.per_pass[p.name] -= 1
+                    break
+        else:
+            result.findings.append(f)
+    result.stale_baseline = [k for k in baseline if k not in matched]
+    result.findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return result
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.vet",
+        description="multi-pass static analyzer (see tools/vet/*.py)")
+    ap.add_argument("paths", nargs="*",
+                    default=["consul_tpu", "tests"],
+                    help="files or directories to analyze")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of: "
+                         + ",".join(p.name for p in PASSES))
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file (default tools/vet/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    args = ap.parse_args(argv)
+
+    passes = None
+    if args.passes:
+        passes = [s.strip() for s in args.passes.split(",") if s.strip()]
+        known = {p.name for p in PASSES}
+        unknown = [s for s in passes if s not in known]
+        if unknown:
+            print(f"vet: unknown pass(es): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    result = run_vet(
+        args.paths, passes=passes,
+        baseline_path=None if args.no_baseline else Path(args.baseline),
+        update_baseline=args.write_baseline)
+
+    for f in result.parse_errors + result.findings:
+        print(f.render())
+    for name, count in result.per_pass.items():
+        print(f"vet: {name}: {count} finding(s)", file=sys.stderr)
+    extras = []
+    if result.baselined:
+        extras.append(f"{result.baselined} baselined")
+    if result.stale_baseline:
+        extras.append(f"{len(result.stale_baseline)} stale baseline "
+                      "entr(y/ies) — prune tools/vet/baseline.txt")
+    tail = f" ({'; '.join(extras)})" if extras else ""
+    status = "clean" if result.rc == 0 else \
+        f"{len(result.findings) + len(result.parse_errors)} finding(s)"
+    print(f"vet: {result.files} files, {status}{tail}", file=sys.stderr)
+    return result.rc
+
+
+__all__ = ["run_vet", "main", "VetResult", "PASSES", "LEGACY_PASSES"]
+
+if __name__ == "__main__":
+    sys.exit(main())

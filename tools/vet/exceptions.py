@@ -1,0 +1,102 @@
+"""Exception-hygiene pass.
+
+- **E01 bare except**: ``except:`` catches ``SystemExit``,
+  ``KeyboardInterrupt`` and ``asyncio.CancelledError`` alongside real
+  errors — name what you mean.
+- **E02 silent broad handler**: ``except Exception: pass`` (or
+  ``BaseException``, or a tuple containing either) — errors vanish
+  without a trace.  Either handle, log, or narrow; a deliberate
+  swallow earns a ``# noqa: E02`` with a justification comment.
+- **E03 swallowed cancellation**: a handler *inside a coroutine* whose
+  caught set includes ``asyncio.CancelledError`` — explicitly in a
+  tuple with other types, via ``BaseException``, or via a bare
+  ``except`` — and whose body never re-raises.  Since Python 3.8
+  ``CancelledError`` derives from ``BaseException`` precisely so broad
+  ``except Exception`` handlers DON'T eat it; a handler that opts back
+  in makes the task uncancellable: ``await task`` after ``cancel()``
+  hangs, and shutdown deadlocks.  A handler catching **only**
+  ``CancelledError`` is exempt — that is the deliberate
+  cancel-then-await idiom, visible and greppable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.vet.core import FileCtx, Finding, dotted_name, func_scopes
+
+BARE_EXCEPT = "E01"
+SILENT_BROAD = "E02"
+SWALLOWED_CANCEL = "E03"
+
+
+def _caught_names(handler: ast.ExceptHandler) -> Optional[Set[str]]:
+    """Simple names of the caught exception types (dotted chains keep
+    only the tail: ``asyncio.CancelledError`` -> ``CancelledError``).
+    None for a bare ``except:``."""
+    t = handler.type
+    if t is None:
+        return None
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    out: Set[str] = set()
+    for n in nodes:
+        dn = dotted_name(n)
+        if dn:
+            out.add(dn.rsplit(".", 1)[-1])
+    return out
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True when any path in the handler body re-raises (bare ``raise``
+    or an explicit raise of the caught name), stopping at nested
+    function boundaries."""
+    todo = list(handler.body)
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Raise):
+            return True
+        todo.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _is_pass_only(handler: ast.ExceptHandler) -> bool:
+    return len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass)
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    findings: List[Finding] = []
+    for node, in_async in func_scopes(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught = _caught_names(node)
+        if caught is None:
+            findings.append(Finding(
+                ctx.path, node.lineno, BARE_EXCEPT,
+                "bare 'except:' catches SystemExit/KeyboardInterrupt/"
+                "CancelledError — name the exceptions you mean"))
+        broad = caught is None or bool(
+            caught & {"Exception", "BaseException"})
+        if broad and _is_pass_only(node):
+            findings.append(Finding(
+                ctx.path, node.lineno, SILENT_BROAD,
+                "broad handler silently swallows exceptions "
+                "('except {}: pass') — handle, log, or narrow".format(
+                    "/".join(sorted(caught)) if caught else ":")))
+        if in_async and not _reraises(node):
+            catches_cancel = caught is None \
+                or "BaseException" in caught \
+                or "CancelledError" in caught
+            only_cancel = caught is not None and caught == {
+                "CancelledError"}
+            if catches_cancel and not only_cancel:
+                findings.append(Finding(
+                    ctx.path, node.lineno, SWALLOWED_CANCEL,
+                    "handler swallows asyncio.CancelledError inside a "
+                    "coroutine — the task becomes uncancellable and "
+                    "shutdown can deadlock; re-raise it or split the "
+                    "handler"))
+    return sorted(findings, key=lambda f: (f.line, f.code))

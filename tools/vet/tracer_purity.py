@@ -1,0 +1,335 @@
+"""JAX tracer-purity pass: flag host-Python escapes inside code that
+runs under a tracer.
+
+An impure ``lax.scan`` body breaks the kernel's replayability (the
+Lifeguard cross-validation gates compare kernel runs bit-for-bit), and
+host round-trips inside jit silently insert device syncs — both
+invisible to pytest because tracing "works" and merely produces wrong
+or slow programs.
+
+Roots are functions reachable from a tracing entry point:
+
+- decorated with ``@jax.jit`` / ``@jit`` /
+  ``@functools.partial(jax.jit, static_argnames=(...))`` (static args
+  are exempt from traced-value checks);
+- passed callable-first to ``jax.jit`` / ``lax.scan`` / ``shard_map``
+  / ``jax.vmap`` / ``jax.pmap`` call sites (scan marks the function as
+  a *scan body* for J04).
+
+A module-level call graph (simple-name edges) extends the root set to
+helpers the kernel calls.  Within traced code:
+
+- **J01 host round-trip**: ``.item()`` / ``.tolist()`` anywhere, and
+  ``float()`` / ``int()`` / ``bool()`` applied to a value derived from
+  a traced (non-static) parameter.  Each forces a device sync and
+  fails under abstract tracers.
+- **J02 numpy-in-trace**: ``np.*`` compute calls on the traced path —
+  they escape the tracer and freeze the value at trace time (dtype
+  constructors like ``np.int32``/``np.iinfo`` are fine and exempt).
+- **J03 impure read**: stdlib ``random.*`` / ``time.*`` /
+  ``datetime.*`` reads — trace-time constants that make compiled runs
+  non-replayable (``jax.random`` is of course exempt; its chain roots
+  at ``jax``).
+- **J04 scan-body mutation**: assignment through ``nonlocal`` /
+  ``global``, stores to attributes/subscripts of names free in the
+  scan body (e.g. ``self.x = …``), or mutating method calls
+  (``.append`` …) on free names.  The scan body runs ONCE at trace
+  time — the mutation happens once, not per step, and the
+  cross-validation guarantees are void.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from tools.vet.core import FileCtx, Finding, dotted_name
+
+HOST_ROUNDTRIP = "J01"
+NUMPY_IN_TRACE = "J02"
+IMPURE_READ = "J03"
+SCAN_MUTATION = "J04"
+
+_TRACING_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "checkpoint",
+                     "remat"}
+_SCAN_NAMES = {"scan", "fori_loop", "while_loop", "associative_scan"}
+
+_NP_DTYPE_OK = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bool_", "dtype",
+    "iinfo", "finfo",
+}
+
+_TIME_READS = {"time", "monotonic", "perf_counter", "time_ns",
+               "monotonic_ns", "perf_counter_ns"}
+
+_MUTATORS = {"append", "extend", "add", "update", "pop", "remove",
+             "clear", "setdefault", "insert", "discard"}
+
+
+@dataclass
+class _DefInfo:
+    node: ast.AST                       # FunctionDef | AsyncFunctionDef
+    name: str
+    static: Set[str] = field(default_factory=set)
+    is_root: bool = False
+    is_scan_body: bool = False
+    calls: Set[str] = field(default_factory=set)
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    dn = dotted_name(node)
+    return dn.rsplit(".", 1)[-1] if dn else None
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            out: Set[str] = set()
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+            return out
+    return set()
+
+
+def _params(fn) -> Set[str]:
+    a = fn.args
+    names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _collect_defs(tree: ast.Module) -> Dict[str, List[_DefInfo]]:
+    defs: Dict[str, List[_DefInfo]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = _DefInfo(node, node.name)
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call):
+                    t = _tail(inner.func)
+                    if t:
+                        info.calls.add(t)
+            defs.setdefault(node.name, []).append(info)
+    return defs
+
+
+def _mark_roots(tree: ast.Module, defs: Dict[str, List[_DefInfo]]) -> None:
+    # decorator form
+    for infos in defs.values():
+        for info in infos:
+            for dec in info.node.decorator_list:
+                t = _tail(dec if not isinstance(dec, ast.Call) else dec.func)
+                if t in _TRACING_WRAPPERS:
+                    info.is_root = True
+                elif t == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args \
+                        and _tail(dec.args[0]) in _TRACING_WRAPPERS:
+                    info.is_root = True
+                    info.static |= _static_argnames(dec)
+    # call-site form: jit(f), lax.scan(f, ...), shard_map(f, ...)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        t = _tail(node.func)
+        if t not in _TRACING_WRAPPERS and t not in _SCAN_NAMES:
+            continue
+        fn_name = _tail(node.args[0])
+        if fn_name is None or fn_name not in defs:
+            continue
+        for info in defs[fn_name]:
+            info.is_root = True
+            if t in _SCAN_NAMES:
+                info.is_scan_body = True
+            if t in _TRACING_WRAPPERS:
+                info.static |= _static_argnames(node)
+
+
+def _reachable(defs: Dict[str, List[_DefInfo]]) -> List[_DefInfo]:
+    """Roots plus everything transitively called from them, by simple
+    name.  Statics do not propagate: a helper may be called with traced
+    values from one site and static from another, so only the
+    decorated root's own params are exempted."""
+    out: List[_DefInfo] = []
+    seen: Set[int] = set()
+    todo = [i for infos in defs.values() for i in infos if i.is_root]
+    while todo:
+        info = todo.pop()
+        if id(info) in seen:
+            continue
+        seen.add(id(info))
+        out.append(info)
+        for callee in info.calls:
+            todo.extend(defs.get(callee, []))
+    return out
+
+
+class _TracedWalker(ast.NodeVisitor):
+    """Flag walk over ONE traced def, tracking the set of names known
+    to derive from traced params (params minus statics, plus a small
+    assignment fixpoint computed by the caller)."""
+
+    def __init__(self, ctx: FileCtx, imports: Dict[str, str],
+                 traced_names: Set[str], fn_name: str) -> None:
+        self.ctx = ctx
+        self.imports = imports
+        self.traced = traced_names
+        self.fn_name = fn_name
+        self.findings: List[Finding] = []
+
+    def _emit(self, node: ast.AST, code: str, msg: str) -> None:
+        self.findings.append(Finding(self.ctx.path, node.lineno, code, msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        t = _tail(node.func)
+        dn = dotted_name(node.func) or ""
+        root = dn.split(".")[0] if dn else ""
+        origin = self.imports.get(root, root)
+        # J01: device -> host escapes (.attr directly: the chain may
+        # root at a call, e.g. x.sum().item(), where dotted_name is None)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist"):
+            self._emit(node, HOST_ROUNDTRIP,
+                       f".{node.func.attr}() inside traced function "
+                       f"'{self.fn_name}' forces a device sync and fails "
+                       "under jit")
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") and node.args:
+            refs = {n.id for n in ast.walk(node.args[0])
+                    if isinstance(n, ast.Name)}
+            if refs & self.traced:
+                self._emit(
+                    node, HOST_ROUNDTRIP,
+                    f"{node.func.id}() on traced value in "
+                    f"'{self.fn_name}' — concretizes a tracer (use jnp "
+                    "ops, or mark the argument static)")
+        # J02: numpy compute on the traced path
+        if origin == "numpy" and dn.count(".") == 1 \
+                and t not in _NP_DTYPE_OK:
+            self._emit(node, NUMPY_IN_TRACE,
+                       f"{dn}() inside traced function '{self.fn_name}' "
+                       "escapes the tracer (freezes at trace time); "
+                       "use the jnp equivalent")
+        # J03: impure host reads baked in at trace time
+        if origin == "random" and dn.startswith("random."):
+            self._emit(node, IMPURE_READ,
+                       f"stdlib {dn}() inside traced function "
+                       f"'{self.fn_name}' is a trace-time constant; "
+                       "use jax.random with a threaded key")
+        elif origin == "time" and t in _TIME_READS:
+            self._emit(node, IMPURE_READ,
+                       f"{dn}() inside traced function '{self.fn_name}' "
+                       "is read once at trace time, not per call")
+        elif origin == "datetime":
+            self._emit(node, IMPURE_READ,
+                       f"{dn}() inside traced function '{self.fn_name}' "
+                       "is read once at trace time, not per call")
+        self.generic_visit(node)
+
+
+def _scan_locals(fn) -> Set[str]:
+    """Params + every Name the body stores + nested def names, stopping
+    at nested function boundaries (their locals are their own)."""
+    names = _params(fn)
+    todo = list(fn.body)
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        todo.extend(ast.iter_child_nodes(node))
+    return names
+
+
+def _check_scan_mutations(ctx: FileCtx, info: _DefInfo,
+                          out: List[Finding]) -> None:
+    fn = info.node
+    local = _scan_locals(fn)
+
+    def root_name(node: ast.AST) -> Optional[str]:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Nonlocal, ast.Global)):
+            out.append(Finding(
+                ctx.path, node.lineno, SCAN_MUTATION,
+                f"'{type(node).__name__.lower()}' mutation inside scan "
+                f"body '{info.name}' runs once at trace time, not per "
+                "step — thread the value through the carry"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    rn = root_name(t)
+                    if rn is not None and rn not in local:
+                        out.append(Finding(
+                            ctx.path, t.lineno, SCAN_MUTATION,
+                            f"store to nonlocal '{rn}' inside scan body "
+                            f"'{info.name}' happens at trace time only — "
+                            "thread it through the carry"))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            rn = root_name(node.func.value)
+            if rn is not None and rn not in local:
+                out.append(Finding(
+                    ctx.path, node.lineno, SCAN_MUTATION,
+                    f"mutating call .{node.func.attr}() on nonlocal "
+                    f"'{rn}' inside scan body '{info.name}' happens at "
+                    "trace time only — thread it through the carry"))
+
+
+def _traced_name_fixpoint(fn, traced: Set[str]) -> Set[str]:
+    """Seed with non-static params; absorb simple ``y = f(x)`` chains
+    whose right side references a traced name (two rounds suffice for
+    the straight-line kernel style)."""
+    assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)]
+    for _ in range(2):
+        changed = False
+        for node in assigns:
+            refs = {n.id for n in ast.walk(node.value)
+                    if isinstance(n, ast.Name)}
+            if not (refs & traced):
+                continue
+            for t in node.targets:
+                for el in ast.walk(t):
+                    if isinstance(el, ast.Name) and el.id not in traced:
+                        traced.add(el.id)
+                        changed = True
+        if not changed:
+            break
+    return traced
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    src_has_jax = "jax" in ctx.src
+    if not src_has_jax:
+        return []
+    from tools.vet.async_safety import _module_imports
+    imports = _module_imports(ctx.tree)
+    if imports.get("jax") != "jax" and not any(
+            v == "jax" or v.startswith("jax.") for v in imports.values()):
+        return []
+    defs = _collect_defs(ctx.tree)
+    _mark_roots(ctx.tree, defs)
+    findings: List[Finding] = []
+    for info in _reachable(defs):
+        traced = _traced_name_fixpoint(
+            info.node, _params(info.node) - info.static)
+        walker = _TracedWalker(ctx, imports, traced, info.name)
+        for stmt in info.node.body:
+            walker.visit(stmt)
+        findings.extend(walker.findings)
+        if info.is_scan_body:
+            _check_scan_mutations(ctx, info, findings)
+    # a helper reachable from two roots would double-report
+    return sorted(set(findings), key=lambda f: (f.line, f.code, f.message))

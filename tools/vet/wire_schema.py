@@ -1,0 +1,249 @@
+"""Wire-schema drift pass: the encoder and decoder of a hand-rolled
+msgpack schema are two separate piles of string literals; nothing but
+convention keeps them aligned.  A key written but never read (or read
+but never written) is silent cross-node corruption — the field
+vanishes or arrives as the decoder's default.
+
+The pass collects field-name string literals per **schema unit** and
+reports the set difference in both directions:
+
+- **W01 written-never-read**: a key the encode side emits that no
+  decoder of the unit ever looks at.
+- **W02 read-never-written**: a key the decode side expects that no
+  encoder of the unit ever produces.
+
+Units are discovered generically:
+
+- a function (or method) named ``X_to_wire`` / ``encode_X`` is the
+  encoder of unit ``X``; ``X_from_wire`` / ``decode_X`` the decoder
+  (for methods the unit is the enclosing class, pairing each class's
+  ``to_wire`` with its ``from_wire``);
+- lambda tables — ``_TO_WIRE = {SomeClass: lambda m: {...}}`` keyed by
+  class pair with ``*_FROM_WIRE = {"method": lambda d: ...}`` entries
+  through the :data:`PAIRS` alias map (this repo's raft RPC tables);
+- **envelope** units: within each :data:`ENVELOPE_GROUPS` module set,
+  every Capitalized dict key is an encode and every Capitalized
+  ``d["K"]`` / ``d.get("K")`` a decode — the RPC and IPC envelope key
+  namespaces (``Method``/``Body``/``Trace``/…, ``Seq``/``Command``/…)
+  are capitalized precisely so this pass can see each whole, writer
+  side and reader side together.
+
+Encode keys are dict-literal string keys plus string-subscript stores;
+decode keys are string-subscript loads plus ``.get("k")`` calls.  A
+unit with contexts on only one side is skipped (its peer lives outside
+the scanned set — e.g. ``_meta_wire`` whose reader is the HTTP layer).
+Only findings, not pairings, consult the source, so the pass stays a
+single AST walk per file.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.vet.core import FileCtx, Finding
+
+WRITTEN_NEVER_READ = "W01"
+READ_NEVER_WRITTEN = "W02"
+
+# Modules forming the wire surface (matched by path suffix).
+WIRE_MODULES = (
+    "consul_tpu/structs/codec.py",
+    "consul_tpu/rpc/wire.py",
+    "consul_tpu/rpc/server.py",
+    "consul_tpu/rpc/pool.py",
+    "consul_tpu/server/client.py",
+    "consul_tpu/ipc/server.py",
+    "consul_tpu/ipc/client.py",
+)
+
+# (unit name, module suffixes) whose Capitalized keys form one shared
+# envelope namespace: writer and reader live in different files, so
+# each group is compared as a whole.
+ENVELOPE_GROUPS = (
+    ("rpc-envelope", ("consul_tpu/rpc/server.py",
+                      "consul_tpu/rpc/pool.py")),
+    ("ipc-envelope", ("consul_tpu/ipc/server.py",
+                      "consul_tpu/ipc/client.py")),
+)
+
+# decode-table entries -> the encode unit they must mirror
+# (table variable name, entry key) : unit
+PAIRS: Dict[Tuple[str, str], str] = {
+    ("_REQ_FROM_WIRE", "request_vote"): "VoteReq",
+    ("_REQ_FROM_WIRE", "append_entries"): "AppendReq",
+    ("_REQ_FROM_WIRE", "install_snapshot"): "SnapReq",
+    ("_RESP_FROM_WIRE", "request_vote"): "VoteResp",
+    ("_RESP_FROM_WIRE", "append_entries"): "AppendResp",
+    ("_RESP_FROM_WIRE", "install_snapshot"): "SnapResp",
+}
+
+_ENC_NAME = re.compile(r"^(?:_?(?P<stem>\w+?)_to_wire|encode_(?P<stem2>\w+)"
+                       r"|to_wire)$")
+_DEC_NAME = re.compile(r"^(?:_?(?P<stem>\w+?)_from_wire"
+                       r"|decode_(?P<stem2>\w+)|from_wire)$")
+_CAP_KEY = re.compile(r"^[A-Z][A-Za-z]*$")
+
+
+@dataclass
+class _Unit:
+    enc_keys: Dict[str, int] = field(default_factory=dict)  # key -> line
+    dec_keys: Dict[str, int] = field(default_factory=dict)
+    enc_paths: Set[str] = field(default_factory=set)
+    dec_paths: Set[str] = field(default_factory=set)
+    has_encoder: bool = False
+    has_decoder: bool = False
+
+
+def _collect_keys(node: ast.AST) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(encode keys, decode keys) within one context body."""
+    enc: Dict[str, int] = {}
+    dec: Dict[str, int] = {}
+    for n in ast.walk(node):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    enc.setdefault(k.value, k.lineno)
+        elif isinstance(n, ast.Subscript):
+            sl = n.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                if isinstance(n.ctx, ast.Store):
+                    enc.setdefault(sl.value, n.lineno)
+                elif isinstance(n.ctx, ast.Load):
+                    dec.setdefault(sl.value, n.lineno)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "get" and n.args \
+                and isinstance(n.args[0], ast.Constant) \
+                and isinstance(n.args[0].value, str):
+            dec.setdefault(n.args[0].value, n.lineno)
+    return enc, dec
+
+
+def _unit_of_name(name: str, cls: Optional[str],
+                  pattern: re.Pattern) -> Optional[str]:
+    m = pattern.match(name)
+    if not m:
+        return None
+    if name in ("to_wire", "from_wire") or name.startswith(("encode",
+                                                            "decode")):
+        if cls is not None:
+            return cls
+    stem = m.groupdict().get("stem") or m.groupdict().get("stem2")
+    return stem or cls
+
+
+def _scan_module(ctx: FileCtx, units: Dict[str, _Unit]) -> None:
+    class_stack: List[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.ClassDef):
+            class_stack.append(node.name)
+            for c in node.body:
+                visit(c)
+            class_stack.pop()
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = class_stack[-1] if class_stack else None
+            enc_unit = _unit_of_name(node.name, cls, _ENC_NAME)
+            dec_unit = _unit_of_name(node.name, cls, _DEC_NAME)
+            if enc_unit:
+                _absorb(units, enc_unit, ctx, node, encode=True)
+            elif dec_unit:
+                _absorb(units, dec_unit, ctx, node, encode=False)
+            return  # no nested schema contexts
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    _scan_table(ctx, target.id, node.value, units)
+        for c in ast.iter_child_nodes(node):
+            visit(c)
+
+    visit(ctx.tree)
+
+
+def _scan_table(ctx: FileCtx, var: str, table: ast.Dict,
+                units: Dict[str, _Unit]) -> None:
+    is_enc = var.endswith("_TO_WIRE")
+    is_dec = var.endswith("_FROM_WIRE")
+    if not (is_enc or is_dec):
+        return
+    for k, v in zip(table.keys, table.values):
+        if v is None or k is None:
+            continue
+        if is_enc and isinstance(k, ast.Name):
+            unit = k.id
+        elif is_dec and isinstance(k, ast.Constant) \
+                and isinstance(k.value, str):
+            unit = PAIRS.get((var, k.value), k.value)
+        else:
+            continue
+        _absorb(units, unit, ctx, v, encode=is_enc)
+
+
+def _absorb(units: Dict[str, _Unit], unit_name: str, ctx: FileCtx,
+            body: ast.AST, encode: bool) -> None:
+    unit = units.setdefault(unit_name, _Unit())
+    enc, dec = _collect_keys(body)
+    if encode:
+        unit.has_encoder = True
+        unit.enc_paths.add(ctx.path)
+        for k, line in enc.items():
+            unit.enc_keys.setdefault(k, line)
+    else:
+        unit.has_decoder = True
+        unit.dec_paths.add(ctx.path)
+        for k, line in dec.items():
+            unit.dec_keys.setdefault(k, line)
+
+
+def _scan_envelopes(ctxs: List[FileCtx], units: Dict[str, _Unit],
+                    groups) -> None:
+    for name, suffixes in groups:
+        for ctx in ctxs:
+            if not ctx.path.endswith(tuple(suffixes)):
+                continue
+            unit = units.setdefault(name, _Unit())
+            enc, dec = _collect_keys(ctx.tree)
+            for k, line in enc.items():
+                if _CAP_KEY.match(k):
+                    unit.has_encoder = True
+                    unit.enc_paths.add(ctx.path)
+                    unit.enc_keys.setdefault(k, line)
+            for k, line in dec.items():
+                if _CAP_KEY.match(k):
+                    unit.has_decoder = True
+                    unit.dec_paths.add(ctx.path)
+                    unit.dec_keys.setdefault(k, line)
+
+
+def check_project(ctxs: List[FileCtx],
+                  modules: Tuple[str, ...] = WIRE_MODULES,
+                  envelope_groups=ENVELOPE_GROUPS) -> List[Finding]:
+    wire_ctxs = [c for c in ctxs if c.path.endswith(tuple(modules))]
+    if not wire_ctxs:
+        return []
+    units: Dict[str, _Unit] = {}
+    for ctx in wire_ctxs:
+        _scan_module(ctx, units)
+    _scan_envelopes(wire_ctxs, units, envelope_groups)
+    findings: List[Finding] = []
+    for name, unit in sorted(units.items()):
+        if not (unit.has_encoder and unit.has_decoder):
+            continue  # peer lives outside the scanned surface
+        enc_path = min(unit.enc_paths) if unit.enc_paths else "?"
+        dec_path = min(unit.dec_paths) if unit.dec_paths else "?"
+        for key in sorted(set(unit.enc_keys) - set(unit.dec_keys)):
+            findings.append(Finding(
+                enc_path, unit.enc_keys[key], WRITTEN_NEVER_READ,
+                f"wire key '{key}' of unit '{name}' is written but never "
+                f"read by its decoder ({dec_path}) — dead field or "
+                "decoder drift"))
+        for key in sorted(set(unit.dec_keys) - set(unit.enc_keys)):
+            findings.append(Finding(
+                dec_path, unit.dec_keys[key], READ_NEVER_WRITTEN,
+                f"wire key '{key}' of unit '{name}' is read but never "
+                f"written by its encoder ({enc_path}) — arrives as the "
+                "decoder default on every message"))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.message))
