@@ -165,15 +165,30 @@ def _sync(jax, state) -> None:
 
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
                churn_ppm: int = 1000, dissem_swar: bool = True,
-               hot_slots: int = 0, flight: bool = False) -> dict:
+               hot_slots: int = 0, flight: bool = False,
+               shard_devices: int = 0) -> dict:
+    import functools
+
     import jax.numpy as jnp
 
-    from consul_tpu.gossip.kernel import init_flight, init_state, run_rounds
+    from consul_tpu.gossip.kernel import (
+        init_flight, init_state, run_rounds, run_rounds_sharded, shard_state)
     from consul_tpu.gossip.params import lan_profile
 
     p = lan_profile(n, slots=slots, dissem_swar=dissem_swar,
                     hot_slots=hot_slots)
     state = init_state(p)
+    # shard_devices > 0: the shard_map'd kernel over that many local
+    # devices (kernel.py "ICI sharding"; raises unless n is divisible
+    # by shard_devices and probe_every).  1 measures the shard_map
+    # wrapping overhead itself; the scaling curve is the regime table's
+    # _shard{d} entries.
+    if shard_devices:
+        state = shard_state(state, shard_devices)
+        run = functools.partial(run_rounds_sharded, p=p,
+                                ndev=shard_devices)
+    else:
+        run = functools.partial(run_rounds, p=p)
     # Flight-recorder overhead regime: the on-device ring rides the
     # scan carry exactly as the gossip plane runs it; the ring is NOT
     # drained inside timed blocks (the plane amortizes drains over
@@ -201,10 +216,9 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
     _log(f"lan n={n} slots={slots}: compiling + warmup ({steps} rounds)")
     t0 = time.perf_counter()
     if flight:
-        (state, fl), _ = run_rounds(state, key, fail_round, p, steps=steps,
-                                    flight=fl)
+        (state, fl), _ = run(state, key, fail_round, steps=steps, flight=fl)
     else:
-        state, _ = run_rounds(state, key, fail_round, p, steps=steps)
+        state, _ = run(state, key, fail_round, steps=steps)
     _sync(jax, state)
     compile_s = time.perf_counter() - t0
     _log(f"compile+warmup done in {compile_s:.1f}s")
@@ -213,10 +227,10 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
     for r in range(repeats):
         t0 = time.perf_counter()
         if flight:
-            (state, fl), _ = run_rounds(state, key, fail_round, p,
-                                        steps=steps, flight=fl)
+            (state, fl), _ = run(state, key, fail_round, steps=steps,
+                                 flight=fl)
         else:
-            state, _ = run_rounds(state, key, fail_round, p, steps=steps)
+            state, _ = run(state, key, fail_round, steps=steps)
         _sync(jax, state)
         dt = time.perf_counter() - t0
         best = min(best, dt)
@@ -228,7 +242,8 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
                    + ("" if churn_ppm == 1000 else f"_churn{churn_ppm}ppm")
                    + (f"_hot{hot_slots}" if hot_slots else "")
                    + ("" if dissem_swar else "_planes")
-                   + ("_flight" if flight else "")),
+                   + ("_flight" if flight else "")
+                   + (f"_shard{shard_devices}" if shard_devices else "")),
         "value": round(rps, 1),
         "unit": "rounds/s",
         "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 3),
@@ -236,6 +251,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         "n_nodes": n,
         "dissem": "swar" if dissem_swar else "planes",
         "hot_slots": hot_slots,
+        "shard_devices": shard_devices,
     }
     if flight:
         # One drain AFTER timing: proves rows were recorded without a
@@ -304,22 +320,25 @@ _LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # Metric-name shape: swim_{gossip|multidc}_rounds_per_sec_{n}_nodes
 # [+ "_churn{ppm}ppm" for non-default churn | "_{d}dc" for multidc]
 # [+ "_planes" for the fallback dissemination strategy]
-# [+ "_flight" with the kernel flight recorder enabled].
+# [+ "_flight" with the kernel flight recorder enabled]
+# [+ "_shard{d}" for the shard_map'd kernel over d devices].
 _METRIC_RE = re.compile(
     r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
-    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?(_planes)?(_flight)?$")
+    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?(_planes)?(_flight)?"
+    r"(?:_shard(\d+))?$")
 
 
 def _regime_key(multidc: bool, churn_ppm: int,
                 planes: bool = False, hot: int = 0,
-                flight: bool = False) -> tuple:
+                flight: bool = False, shard: int = 0) -> tuple:
     """Cache-matching key: bench variant + churn regime + dissemination
-    strategy, size-agnostic.  The default LAN run (churn 1000 ppm) has
-    NO suffix historically, so the regime must be recovered from the
-    parsed name, not a string prefix — a churn-0 quiescent entry is
-    ~10x the churned number and must never stand in for it."""
+    strategy + device count, size-agnostic.  The default LAN run (churn
+    1000 ppm) has NO suffix historically, so the regime must be
+    recovered from the parsed name, not a string prefix — a churn-0
+    quiescent entry is ~10x the churned number and must never stand in
+    for it."""
     return ("multidc" if multidc else "gossip",
-            None if multidc else churn_ppm, planes, hot, flight)
+            None if multidc else churn_ppm, planes, hot, flight, shard)
 
 
 def _parse_metric_regime(name: str) -> tuple | None:
@@ -332,7 +351,8 @@ def _parse_metric_regime(name: str) -> tuple | None:
     return (variant, None if variant == "multidc" else churn,
             m.group(6) is not None,
             int(m.group(5)) if m.group(5) is not None else 0,
-            m.group(7) is not None)
+            m.group(7) is not None,
+            int(m.group(8)) if m.group(8) is not None else 0)
 
 
 def _read_cache() -> dict:
@@ -357,14 +377,14 @@ def _same_platform_class(a: str, b: str) -> bool:
 
 
 def _read_last_good(multidc: bool, churn_ppm: int, planes: bool = False,
-                    hot: int = 0, flight: bool = False,
+                    hot: int = 0, flight: bool = False, shard: int = 0,
                     platform: str | None = None) -> dict | None:
     """Last cached measurement of this exact regime (variant + churn +
     strategy) ON THIS BACKEND PLATFORM CLASS, preferring the largest n.
     A CPU smoke run must never stand in for a chip measurement (or vice
     versa); "axon"/"tpu"/untagged are all the chip class.  A corrupt
     cache must never take down the metric emit."""
-    want = _regime_key(multidc, churn_ppm, planes, hot, flight)
+    want = _regime_key(multidc, churn_ppm, planes, hot, flight, shard)
     plat = platform if platform is not None else _PLATFORM
     candidates = [
         v for k, v in _read_cache().items()
@@ -392,7 +412,7 @@ def _store_result(result: dict) -> None:
 
 def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                 dissem_swar: bool = True, hot_slots: int = 0,
-                flight: bool = False) -> dict:
+                flight: bool = False, shard_devices: int = 0) -> dict:
     """One regime with reduced-N fallback.  Returns a result dict; on
     total failure returns an error dict carrying the regime-matched
     last-known-good."""
@@ -401,6 +421,11 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
     first = True
     while first or n >= MIN_FALLBACK_N:
         first = False
+        if shard_devices:
+            # Keep the sharded alignment (n divisible by device count
+            # and lan_profile's probe_every=5) through the reduced-N
+            # fallback ladder.
+            n -= n % (shard_devices * 5)
         try:
             if multidc:
                 result = _bench_multidc(jax, n, args.dcs, args.slots,
@@ -409,7 +434,8 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                 result = _bench_lan(jax, n, args.slots, args.steps,
                                     args.repeats, churn_ppm=churn_ppm,
                                     dissem_swar=dissem_swar,
-                                    hot_slots=hot_slots, flight=flight)
+                                    hot_slots=hot_slots, flight=flight,
+                                    shard_devices=shard_devices)
             if n != args.n:
                 result["reduced_from_n"] = args.n
             _store_result(result)
@@ -427,7 +453,7 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                "error": f"all sizes failed; last: "
                         f"{type(last_err).__name__}: {last_err}"}
     last = _read_last_good(multidc, churn_ppm, not dissem_swar, hot_slots,
-                           flight)
+                           flight, shard_devices)
     if last is not None:
         payload["last_known_good"] = last
     return payload
@@ -463,6 +489,11 @@ def main() -> None:
                     help="enable the kernel flight recorder for "
                          "single-regime runs (the table A/Bs the healthy "
                          "regime with and without it)")
+    ap.add_argument("--shard-devices", dest="shard_devices", type=int,
+                    default=0,
+                    help="run the shard_map'd kernel over this many local "
+                         "devices for single-regime runs (0 = unsharded; "
+                         "the table sweeps 1..all local devices)")
     args = ap.parse_args()
 
     single_regime = args.multidc or args.churn_ppm is not None
@@ -513,7 +544,8 @@ def main() -> None:
         churn = args.churn_ppm if args.churn_ppm is not None else 1000
         _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn,
                           dissem_swar=args.dissem == "swar",
-                          hot_slots=args.hot_slots, flight=args.flight))
+                          hot_slots=args.hot_slots, flight=args.flight,
+                          shard_devices=args.shard_devices))
         return
 
     # -- default: the full regime table, one JSON line -------------------
@@ -539,6 +571,16 @@ def main() -> None:
     regimes["realistic_churn10ppm_hot8"] = _run_regime(
         jax, args, multidc=False, churn_ppm=10, hot_slots=8)
     regimes["multidc"] = _run_regime(jax, args, multidc=True, churn_ppm=0)
+    # ICI-sharding scaling curve (BENCH_NOTES §sharding): the
+    # shard_map'd kernel at the headline churn regime, one entry per
+    # power-of-two local device count.  shard1 isolates the shard_map
+    # wrapping + collective-schedule overhead against the plain kernel;
+    # the top entry is the paper posture (all chips on the ring).
+    d = 1
+    while d <= len(jax.devices()):
+        regimes[f"churn1000ppm_shard{d}"] = _run_regime(
+            jax, args, multidc=False, churn_ppm=1000, shard_devices=d)
+        d *= 2
 
     # The historical churn regime stays the headline so cross-round
     # comparisons (and vs_baseline against the 10k target) remain

@@ -20,25 +20,37 @@ import time
 import numpy as np
 
 
-def kernel_event_latencies(p, fail_at: dict, steps: int, seed: int):
+def kernel_event_latencies(p, fail_at: dict, steps: int, seed: int,
+                           ndev: int = 0):
     """Per-event detection latencies from the kernel's round trace.
 
     A victim's episode slot records its verdict round in
     ``slot_dead_round``; latency = dead_round - fail_round (the same
     definition ``RefModel.detection_latencies`` uses).  Returns
     ``(latencies, n_false_dead, n_refuted, drops)``.
+
+    ``ndev > 1`` runs the ICI-sharded kernel instead — bit-identical
+    dynamics (tests/test_shard_map_parity.py), so the oracle gates
+    apply to the sharded lowering unchanged; it lets the crossval tier
+    exercise the production multi-device path end-to-end.
     """
     import jax
     import jax.numpy as jnp
 
     from consul_tpu.gossip.kernel import (NEVER, PHASE_DEAD, init_state,
-                                          run_rounds)
+                                          run_rounds, run_rounds_sharded,
+                                          shard_state)
 
     fail = np.full(p.n, NEVER, np.int32)
     for v, t in fail_at.items():
         fail[v] = t
-    st, trace = run_rounds(init_state(p), jax.random.key(seed),
-                           jnp.asarray(fail), p, steps, trace=True)
+    if ndev > 1:
+        st, trace = run_rounds_sharded(
+            shard_state(init_state(p), ndev), jax.random.key(seed),
+            jnp.asarray(fail), p, steps, trace=True, ndev=ndev)
+    else:
+        st, trace = run_rounds(init_state(p), jax.random.key(seed),
+                               jnp.asarray(fail), p, steps, trace=True)
     slot_node = np.asarray(trace.slot_node)        # [T, S]
     slot_dead = np.asarray(trace.slot_dead_round)  # [T, S]
     slot_phase = np.asarray(trace.slot_phase)      # [T, S]
@@ -88,7 +100,7 @@ def loss_sized_slots(n: int, loss: float, base: int = 64) -> int:
 
 def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
                slots: int | None = None, pushpull: bool = False,
-               oracle: bool = True) -> dict:
+               oracle: bool = True, ndev: int = 0) -> dict:
     """One matched kernel-vs-oracle config; returns the report row.
 
     ``pushpull`` arms anti-entropy in BOTH models (memberlist
@@ -114,7 +126,8 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
     k_fp = r_fp = k_ref = r_ref = k_drops = 0
     t0 = time.time()
     for s in range(seeds):
-        kl, kf, kr, kd = kernel_event_latencies(p, fail_at, steps, seed=s)
+        kl, kf, kr, kd = kernel_event_latencies(p, fail_at, steps, seed=s,
+                                                ndev=ndev)
         k_lats += kl
         k_fp += kf
         k_ref += kr

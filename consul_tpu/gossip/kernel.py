@@ -57,6 +57,15 @@ propagation against each observer's local timer — biases false-positive
 counts low vs event-driven memberlist).
 Each is quantified against the discrete-event reference model
 (gossip/refmodel.py) by the cross-validation test tier.
+
+**ICI sharding.**  ``run_rounds_sharded``/``swim_round_sharded`` run the
+same round ``shard_map``-partitioned along the observer axis N: only the
+``heard [S, N]`` belief matrix is sharded; every other register is
+replicated and the few heard-derived quantities are ``psum``-merged (the
+per-column contributions are disjoint, so the merge is exact and the
+sharded kernel is bit-identical to the single-device one — the parity
+tier asserts it).  See the "ICI sharding" section below for the layout
+and the halo-exchange roll.
 """
 
 from __future__ import annotations
@@ -196,7 +205,118 @@ def alloc_free_slots(free: jnp.ndarray, want: jnp.ndarray):
     return can, slot_ids, sidx
 
 
-def _join_tick(p: SwimParams, rnd, carry, join_round, fail_round):
+# ---------------------------------------------------------------------------
+# ICI sharding (shard_map along the observer axis N)
+#
+# Layout: ONLY the [S, N] belief matrix is sharded (P devices, L = N/P
+# contiguous observer columns per shard).  Everything else — the S-space
+# slot registers, the [N] per-node registers (slot_of_node, incarnation,
+# member), mf, the PRNG key, and the scalar counters — is REPLICATED:
+# every write to those derives from replicated inputs plus the few
+# heard-derived quantities below, which are psum-combined.  Each observer
+# column is owned by exactly one shard, so the psum contributions are
+# disjoint integers — the merge is exact and the sharded round is
+# bit-identical to the single-device one (tests/test_shard_map_parity.py).
+#
+# Communication per round: each circulant delivery ``roll(packed, o)``
+# becomes a shard-local roll plus a log2(P)-hop ppermute halo exchange
+# (_roll_sharded); the probe tick's contiguous prober-block window is
+# read with zero-padded local slices + one psum (_win_read) and written
+# back shard-locally (_win_write); _finish_round psums the subjects'
+# own-belief bytes and the per-slot timer-fired bits.  All lax.cond
+# predicates (any_join, n_active, push/pull cadence) are replicated, so
+# every shard takes the same branch and the collective schedules line up
+# (check_rep=False — replication is by construction, not inferred).
+# ---------------------------------------------------------------------------
+
+_SHARD_AXIS = "ici"
+
+
+class _ShardCtx(NamedTuple):
+    """Static sharding context threaded through the round phases.
+    ``None`` everywhere means the unchanged single-device lowering."""
+
+    ndev: int   # devices along the observer axis
+    L: int      # observer columns per shard (N // ndev)
+
+
+def _sc_base(sc: _ShardCtx) -> jnp.ndarray:
+    """This shard's first global observer column (traced)."""
+    return jax.lax.axis_index(_SHARD_AXIS).astype(jnp.int32) * sc.L
+
+
+def _sloc(sc: _ShardCtx, v: jnp.ndarray) -> jnp.ndarray:
+    """Local [L] slice of a replicated [N] per-node vector."""
+    return jax.lax.dynamic_slice(v, (_sc_base(sc),), (sc.L,))
+
+
+def _sloc_roll(sc: _ShardCtx, v: jnp.ndarray, o) -> jnp.ndarray:
+    """Local [L] slice of ``jnp.roll(v, o)`` for a replicated [N]
+    vector — a dynamic slice of the doubled vector, never a gather."""
+    n = v.shape[0]
+    v2 = jnp.concatenate([v, v])
+    return jax.lax.dynamic_slice(v2, ((_sc_base(sc) - o) % n,), (sc.L,))
+
+
+def _roll_sharded(sc: _ShardCtx, x: jnp.ndarray, o) -> jnp.ndarray:
+    """Global ``jnp.roll(x, o, axis=-1)`` of an observer-sharded array.
+
+    The traced global shift decomposes into a shard-local roll by
+    ``o mod L`` plus a whole-shard rotation by ``o // L`` — done as a
+    binary-decomposed chain of log2(P) *conditional* ppermutes (the
+    condition selects results, never collectives, so the ppermute
+    schedule is static and identical on every shard) — plus one
+    neighbor exchange supplying the ``o mod L`` halo columns that
+    crossed the shard boundary."""
+    L, ndev = sc.L, sc.ndev
+    o = o % (L * ndev)
+    q, r = o // L, o % L
+    y = jnp.roll(x, r, axis=-1)
+    step = 1
+    while step < ndev:
+        perm = [(i, (i + step) % ndev) for i in range(ndev)]
+        shifted = jax.lax.ppermute(y, _SHARD_AXIS, perm)
+        y = jnp.where((q // step) % 2 == 1, shifted, y)
+        step *= 2
+    nxt = jax.lax.ppermute(y, _SHARD_AXIS,
+                           [(i, (i + 1) % ndev) for i in range(ndev)])
+    return jnp.where(jnp.arange(L) < r, nxt, y)
+
+
+def _win_read(sc: _ShardCtx, h: jnp.ndarray, blk, B: int) -> jnp.ndarray:
+    """Replicated [S, B] window ``heard[:, blk:blk+B]`` of the sharded
+    matrix (the window never wraps: blk = (rnd % probe_every) * B with
+    N = B * probe_every, enforced by _check_shardable).  Each shard
+    slices its overlap out of a zero-padded copy and the psum merges
+    the disjoint contributions exactly.  The explicit clip is
+    load-bearing: dynamic_slice normalizes NEGATIVE starts numpy-style
+    (adding the dim size) *before* clamping, which would alias an
+    empty-overlap shard's slice back onto real data."""
+    S = h.shape[0]
+    z = jnp.zeros((S, B), h.dtype)
+    Z = jnp.concatenate([z, h, z], axis=1)
+    start = jnp.clip(B + blk - _sc_base(sc), 0, B + sc.L)
+    part = jax.lax.dynamic_slice(Z, (jnp.int32(0), start), (S, B))
+    return jax.lax.psum(part.astype(jnp.int32), _SHARD_AXIS).astype(h.dtype)
+
+
+def _win_write(sc: _ShardCtx, h: jnp.ndarray, win: jnp.ndarray, blk,
+               B: int) -> jnp.ndarray:
+    """Write a replicated [S, B] window into cols [blk, blk+B) of the
+    sharded matrix: each shard overwrites exactly the columns it owns.
+    No collective; same clip caveat as _win_read."""
+    S = h.shape[0]
+    zl = jnp.zeros((S, sc.L), win.dtype)
+    Zw = jnp.concatenate([zl, win, zl], axis=1)
+    base = _sc_base(sc)
+    start = jnp.clip(sc.L + base - blk, 0, B + sc.L)
+    part = jax.lax.dynamic_slice(Zw, (jnp.int32(0), start), (S, sc.L))
+    g = base + jnp.arange(sc.L, dtype=jnp.int32)
+    inw = (g >= blk) & (g < blk + B)
+    return jnp.where(inw[None, :], part, h)
+
+
+def _join_tick(p: SwimParams, rnd, carry, join_round, fail_round, sc=None):
     """Activate pending joins on-device (memberlist: a join IS an
     alive@inc message gossiped like any rumor — behavior contract
     ``website/source/docs/internals/gossip.html.markdown:10-43``,
@@ -262,9 +382,17 @@ def _join_tick(p: SwimParams, rnd, carry, join_round, fail_round):
     slot_dead_round = slot_dead_round.at[sidx].set(rnd, mode="drop")
     slot_of_node = slot_of_node.at[jnp.where(can_k, cand_c, N)].set(
         slot_k, mode="drop")
-    # The joiner seeds its own announcement flood.
-    heard = heard.at[sidx, cand_c].set(
-        jnp.uint8(_enc(MSG_REFUTE, age=_AGE_FRESH)), mode="drop")
+    # The joiner seeds its own announcement flood.  Sharded: the seed
+    # column belongs to exactly one shard — the others drop the write.
+    if sc is None:
+        heard = heard.at[sidx, cand_c].set(
+            jnp.uint8(_enc(MSG_REFUTE, age=_AGE_FRESH)), mode="drop")
+    else:
+        base = _sc_base(sc)
+        owned = (cand_c >= base) & (cand_c < base + sc.L)
+        heard = heard.at[jnp.where(owned, sidx, S),
+                         jnp.clip(cand_c - base, 0, sc.L - 1)].set(
+            jnp.uint8(_enc(MSG_REFUTE, age=_AGE_FRESH)), mode="drop")
 
     return (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
             slot_dead_round, slot_of_node, incarnation, member, drops)
@@ -278,7 +406,7 @@ def _block_size(p: SwimParams) -> int:
     return max(1, -(-p.n // p.probe_every))
 
 
-def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
+def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple, sc=None):
     """One round's probe slice: direct probe -> k indirect probes ->
     suspicion initiation for this round's prober block (reference
     per-node behavior: memberlist probe cycle as configured at
@@ -355,7 +483,14 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
 
     s2 = jnp.concatenate([slot_of_node, slot_of_node])
     s_t = jax.lax.dynamic_slice(s2, ((blk + offs[0]) % N,), (B,))
-    if aligned:
+    if sc is not None:
+        # Sharded (requires aligned — _check_shardable): one psum
+        # replicates the window; it is reused below for the post-rearm
+        # read (only the rearm clear touches heard in between, and
+        # rearm is replicated — the local recompute is exact).
+        hblk_pre = _win_read(sc, heard, blk, B)
+        cur = _row_pick(hblk_pre, jnp.clip(s_t, 0, S - 1))
+    elif aligned:
         cur = _row_pick(jax.lax.dynamic_slice(heard, (0, blk), (S, B)),
                         jnp.clip(s_t, 0, S - 1))
     else:
@@ -437,7 +572,18 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
     s2b = jnp.concatenate([slot_of_node, slot_of_node])
     s_t2 = jax.lax.dynamic_slice(s2b, ((blk + offs[0]) % N,), (B,))
     rows2 = jnp.clip(s_t2, 0, S - 1)
-    if aligned:
+    if sc is not None:
+        # Post-rearm window, recomputed from the pre-rearm psum (saves
+        # a collective; exact — see above).  Write-back is shard-local.
+        hblk = jnp.where(rearm[:, None], jnp.uint8(0), hblk_pre)
+        cur2 = _row_pick(hblk, rows2)
+        mark_ok = init & (s_t2 >= 0) & ((cur2 >> _MSG_SHIFT) <= MSG_SUSPECT)
+        fresh = (jnp.uint8(_enc(MSG_SUSPECT, age=_AGE_FRESH))
+                 | (cur2 & jnp.uint8(_CONF_MASK << _CONF_SHIFT)))
+        sel = (srow[:, None] == rows2[None, :]) & mark_ok[None, :]
+        heard = _win_write(sc, heard, jnp.where(sel, fresh[None, :], hblk),
+                           blk, B)
+    elif aligned:
         hblk = jax.lax.dynamic_slice(heard, (0, blk), (S, B))
         cur2 = _row_pick(hblk, rows2)
         mark_ok = init & (s_t2 >= 0) & ((cur2 >> _MSG_SHIFT) <= MSG_SUSPECT)
@@ -469,11 +615,16 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple):
             drops), probe_stats
 
 
-@functools.partial(jax.jit, static_argnames=("p",))
+@functools.partial(jax.jit, static_argnames=("p",),
+                   donate_argnames=("state",))
 def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                p: SwimParams,
                join_round: jnp.ndarray | None = None) -> SwimState:
     """Advance the pool by one gossip round.
+
+    ``state`` is DONATED: the 64 MB-at-1M ``heard`` matrix is updated
+    in place instead of copied per dispatch.  Callers must rebind
+    (``state = swim_round(state, ...)``) and never reuse the argument.
 
     ``join_round`` (optional, [N] i32, NEVER = present from start):
     nodes whose entry equals the current round join the pool this round
@@ -485,7 +636,8 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
 
 def _swim_round_impl(state: SwimState, base_key: jax.Array,
                      fail_round: jnp.ndarray, p: SwimParams,
-                     join_round: jnp.ndarray | None, collect: bool):
+                     join_round: jnp.ndarray | None, collect: bool,
+                     sc: _ShardCtx | None = None):
     """One round + (optionally) its flight-recorder row.
 
     ``collect`` is a PYTHON-level static: False compiles exactly the
@@ -515,7 +667,7 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
                            & (fail_round > rnd))
         carry = jax.lax.cond(
             any_join,
-            lambda c: _join_tick(p, rnd, c, join_round, fail_round),
+            lambda c: _join_tick(p, rnd, c, join_round, fail_round, sc),
             lambda c: c, carry)
 
     member_now = carry[9]
@@ -528,7 +680,7 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
     # FIRST, on the un-aged matrix: its decisions read only msg/conf
     # bits, and its fresh marks carry the _AGE_FRESH sentinel that the
     # tail's age tick turns into age 0 --------------------------------
-    carry, probe_stats = _probe_tick(p, rnd, k_probe, mf, carry)
+    carry, probe_stats = _probe_tick(p, rnd, k_probe, mf, carry, sc)
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
 
@@ -554,9 +706,13 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
             # directions (+o and -o rolls) makes each pair's exchange
             # symmetric, as memberlist's push/pull TCP sync is.
             o = jax.random.randint(kpp, (), 1, N, dtype=jnp.int32)
+            rxl = sub_rx_ok if sc is None else _sloc(sc, sub_rx_ok)
             for shift in (o, -o):
-                ok = sub_rx_ok & (jnp.roll(mf, shift) > rnd)
-                hin = jnp.roll(h, shift, axis=1)
+                mfl = (jnp.roll(mf, shift) if sc is None
+                       else _sloc_roll(sc, mf, shift))
+                ok = rxl & (mfl > rnd)
+                hin = (jnp.roll(h, shift, axis=1) if sc is None
+                       else _roll_sharded(sc, h, shift))
                 upgraded = (((hin >> _MSG_SHIFT) > (h >> _MSG_SHIFT))
                             & ok[None, :])
                 h = jnp.where(upgraded, hin, h)
@@ -568,13 +724,13 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
     def _full_tail(heard):
         # -- 2+3. age (fused into the dissemination pack) + gossip push
         # via circulant rolls ---------------------------------------------
-        heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap)
+        heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap, sc)
         heard = _maybe_pushpull(heard, rx_ok)
         return _finish_round(p, state, rnd, fail_round, alive, member, heard,
                              None, jnp.arange(S, dtype=jnp.int32), slot_node,
                              slot_phase, slot_inc, slot_start, slot_nsusp,
                              slot_dead_round, slot_of_node, incarnation,
-                             drops, conf_cap, rx_ok)
+                             drops, conf_cap, rx_ok, sc)
 
     def _hot_tail(heard):
         # A handful of live episodes: slice just their belief rows, run
@@ -596,13 +752,14 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
         sub = jnp.concatenate([
             jax.lax.dynamic_slice_in_dim(heard, idx[j], 1, axis=0)
             for j in range(p.hot_slots)], axis=0)
-        sub = _disseminate(p, rnd, k_gossip, sub, mf, rx_ok, conf_cap[idx])
+        sub = _disseminate(p, rnd, k_gossip, sub, mf, rx_ok, conf_cap[idx],
+                           sc)
         sub = _maybe_pushpull(sub, rx_ok)
         return _finish_round(p, state, rnd, fail_round, alive, member, sub,
                              heard, idx, slot_node, slot_phase, slot_inc,
                              slot_start, slot_nsusp, slot_dead_round,
                              slot_of_node, incarnation, drops, conf_cap,
-                             rx_ok)
+                             rx_ok, sc)
 
     def _quiescent_tail(heard):
         # No active episode anywhere: the belief matrix is all-zero and
@@ -638,7 +795,8 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
     def _tx_bytes(h):
         live = ((h >> _MSG_SHIFT) > 0) & \
             ((h & _AGE_MASK) < p.spread_budget_rounds)
-        return p.fanout * jnp.sum(live.astype(jnp.int32))
+        t = p.fanout * jnp.sum(live.astype(jnp.int32))
+        return t if sc is None else jax.lax.psum(t, _SHARD_AXIS)
 
     tx = jax.lax.cond(n_active > 0, _tx_bytes,
                       lambda h: jnp.int32(0), new_state.heard)
@@ -723,7 +881,7 @@ def _byte_sel(mask, a, b):
 
 
 def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
-                 conf_cap) -> jnp.ndarray:
+                 conf_cap, sc=None) -> jnp.ndarray:
     """One round of rumor push: ``fanout`` circulant-shift deliveries,
     merged per destination with message-priority + Lifeguard
     confirmation counting.  Dispatches on ``p.dissem_swar`` (static):
@@ -731,12 +889,13 @@ def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
     an on-chip A/B and a one-line fallback."""
     if p.dissem_swar:
         return _disseminate_swar(p, rnd, k_gossip, heard, mf, rx_ok,
-                                 conf_cap)
-    return _disseminate_planes(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap)
+                                 conf_cap, sc)
+    return _disseminate_planes(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap,
+                               sc)
 
 
 def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
-                      conf_cap) -> jnp.ndarray:
+                      conf_cap, sc=None) -> jnp.ndarray:
     """The belief matrix moves as u32 words holding FOUR slot-rows per
     element; the whole merge is SWAR on those words — one fused
     elementwise pass that reads the current matrix and the ``fanout``
@@ -768,18 +927,25 @@ def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
     packed = _byte_sel(has_msg,
                        (packed & ~jnp.uint32(_AGE4)) | aged, packed)
 
-    offs = gossip_offsets(k_gossip, N, p.fanout)
+    # Offsets are drawn over the GLOBAL observer count: under sharding
+    # the local width is N/ndev but the circulant graph spans the pool.
+    offs = gossip_offsets(k_gossip, p.n, p.fanout)
     budget_b = jnp.uint32(p.spread_budget_rounds * _LSB)
-    rx = jnp.where(rx_ok, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[None, :]
+    rx_l = rx_ok if sc is None else _sloc(sc, rx_ok)
+    rx = jnp.where(rx_l, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[None, :]
 
     in_msg = jnp.zeros((S4, N), jnp.uint32)
     n_sus = jnp.zeros((S4, N), jnp.uint32)
     for f in range(p.fanout):
-        # Sender into d is d - o_f: delivery = roll by +o_f (contiguous).
+        # Sender into d is d - o_f: delivery = roll by +o_f (contiguous;
+        # sharded: local roll + ppermute halo exchange, and the rolled
+        # replicated mf is a local slice of its doubled copy).
         o = offs[f]
-        src = jnp.where(jnp.roll(mf, o) > rnd,
+        mf_r = jnp.roll(mf, o) if sc is None else _sloc_roll(sc, mf, o)
+        src = jnp.where(mf_r > rnd,
                         jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[None, :]
-        pin = jnp.roll(packed, o, axis=1)
+        pin = (jnp.roll(packed, o, axis=1) if sc is None
+               else _roll_sharded(sc, packed, o))
         live = ~_byte_ge(pin & jnp.uint32(_AGE4), budget_b) & src
         m = (pin >> _MSG_SHIFT) & jnp.uint32(_MSG4) & live
         in_msg = _byte_sel(_byte_ge(m, in_msg), m, in_msg)
@@ -822,7 +988,7 @@ def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
 
 
 def _disseminate_planes(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
-                        conf_cap) -> jnp.ndarray:
+                        conf_cap, sc=None) -> jnp.ndarray:
     """The round-3 strategy (kept for A/B + fallback, see
     ``_disseminate``): merge logic runs per byte-plane on native
     u32 lanes, producing four [S4, N] plane outputs.  Measured
@@ -846,14 +1012,18 @@ def _disseminate_planes(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
     packed = (planes[:, 0] | (planes[:, 1] << 8)
               | (planes[:, 2] << 16) | (planes[:, 3] << 24))
 
-    offs = gossip_offsets(k_gossip, N, p.fanout)
+    # Offsets over the GLOBAL observer count (see _disseminate_swar).
+    offs = gossip_offsets(k_gossip, p.n, p.fanout)
     budget = jnp.uint32(p.spread_budget_rounds)
+    rx_l = rx_ok if sc is None else _sloc(sc, rx_ok)
     pins = []
     for f in range(p.fanout):
         # Sender into d is d - o_f: delivery = roll by +o_f (contiguous).
         o = offs[f]
-        src_ok = jnp.roll(mf, o) > rnd
-        pins.append((jnp.roll(packed, o, axis=1), src_ok))
+        src_ok = (jnp.roll(mf, o) if sc is None
+                  else _sloc_roll(sc, mf, o)) > rnd
+        pins.append(((jnp.roll(packed, o, axis=1) if sc is None
+                      else _roll_sharded(sc, packed, o)), src_ok))
 
     cap4 = (jnp.concatenate([conf_cap, jnp.zeros((pad,), jnp.int32)])
             if pad else conf_cap).reshape(S4, 4).astype(jnp.uint32)
@@ -873,9 +1043,9 @@ def _disseminate_planes(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
         cur_msg = cur >> _MSG_SHIFT
         age = cur & _AGE_MASK
         conf = (cur >> _CONF_SHIFT) & _CONF_MASK
-        upgraded = (in_msg > cur_msg) & rx_ok[None, :]
+        upgraded = (in_msg > cur_msg) & rx_l[None, :]
         bump = ((cur_msg == MSG_SUSPECT) & (in_msg == MSG_SUSPECT)
-                & rx_ok[None, :])
+                & rx_l[None, :])
         conf_new = jnp.where(bump,
                              jnp.minimum(conf + n_sus_in, cap4[:, k][:, None]),
                              conf)
@@ -901,7 +1071,7 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
                   member, heard_sub, full_heard, idx, slot_node, slot_phase,
                   slot_inc, slot_start, slot_nsusp, slot_dead_round,
                   slot_of_node, incarnation, drops, conf_cap,
-                  rx_ok) -> SwimState:
+                  rx_ok, sc=None) -> SwimState:
     """Refutation, suspicion-timer firing, episode GC, stats.
 
     Operates on ``heard_sub`` — the belief rows of the slots listed in
@@ -927,7 +1097,17 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
     node_c = jnp.clip(sl_node, 0, N - 1)
     n_refuted = state.n_refuted
     if p.refute:
-        own_msg = heard_sub[hrows, node_c] >> _MSG_SHIFT
+        if sc is None:
+            own_msg = heard_sub[hrows, node_c] >> _MSG_SHIFT
+        else:
+            # Each subject's own-belief byte lives on exactly one shard:
+            # mask local ownership, psum the disjoint contributions.
+            base = _sc_base(sc)
+            owned = (node_c >= base) & (node_c < base + sc.L)
+            loc = jnp.clip(node_c - base, 0, sc.L - 1)
+            own_msg = jax.lax.psum(
+                jnp.where(owned, heard_sub[hrows, loc].astype(jnp.int32), 0),
+                _SHARD_AXIS) >> _MSG_SHIFT
         refutable = (sl_phase == PHASE_SUSPECT) | (sl_phase == PHASE_DEAD)
         refute_now = (refutable & (sl_node >= 0) & alive[node_c]
                       & member[node_c]
@@ -939,8 +1119,13 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
         # dead-then-refuted slot's dead round is superseded — the refute
         # is the message that still needs spreading).
         sl_dead_round = jnp.where(refute_now, rnd, sl_dead_round)
-        heard_sub = heard_sub.at[hrows, node_c].max(
-            jnp.where(refute_now, jnp.uint8(_enc(MSG_REFUTE)), jnp.uint8(0)))
+        refute_val = jnp.where(refute_now, jnp.uint8(_enc(MSG_REFUTE)),
+                               jnp.uint8(0))
+        if sc is None:
+            heard_sub = heard_sub.at[hrows, node_c].max(refute_val)
+        else:
+            heard_sub = heard_sub.at[hrows, jnp.where(owned, loc, sc.L)].max(
+                refute_val, mode="drop")
         n_refuted = n_refuted + jnp.sum(refute_now.astype(jnp.int32))
 
     # -- 5. suspicion timers fire -> dead declared ------------------------
@@ -948,11 +1133,16 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
     c_eff = jnp.minimum(((heard_sub >> _CONF_SHIFT) & _CONF_MASK).astype(jnp.int32),
                         cc[:, None])
     elapsed = rnd - sl_start
+    rx_l = rx_ok if sc is None else _sloc(sc, rx_ok)
     fire = ((sl_phase == PHASE_SUSPECT)[:, None]
             & ((heard_sub >> _MSG_SHIFT) == MSG_SUSPECT)
-            & rx_ok[None, :]
+            & rx_l[None, :]
             & (elapsed[:, None] >= tbl[c_eff]))
     slot_fired = jnp.any(fire, axis=1)
+    if sc is not None:
+        # Any observer on any shard fires the slot's timer.
+        slot_fired = jax.lax.psum(slot_fired.astype(jnp.int32),
+                                  _SHARD_AXIS) > 0
     new_dead = slot_fired & (sl_dead_round < 0)
     sl_phase = jnp.where(slot_fired, PHASE_DEAD, sl_phase)
     sl_dead_round = jnp.where(new_dead, rnd, sl_dead_round)
@@ -1045,7 +1235,8 @@ class RoundTrace(NamedTuple):
                                  #   rumor (join announcements / refutes)
 
 
-@functools.partial(jax.jit, static_argnames=("p", "steps", "trace", "unroll"))
+@functools.partial(jax.jit, static_argnames=("p", "steps", "trace", "unroll"),
+                   donate_argnames=("state", "flight"))
 def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                p: SwimParams, steps: int, trace: bool = False,
                unroll: int = 4, join_round: jnp.ndarray | None = None,
@@ -1055,12 +1246,23 @@ def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     ``unroll`` fuses that many rounds per scan iteration — amortizes
     per-iteration dispatch/sync on backends where that dominates.
 
+    ``state`` and ``flight`` are DONATED: the belief matrix and the
+    ring are updated in place instead of copied per dispatch (64 MB
+    per copy at 1M nodes).  Callers must rebind both and never reuse
+    the passed-in arrays afterwards.
+
     ``flight`` (optional FlightRing): record one flight-recorder row
     per round into the on-device ring at ``cursor % R`` — no host
     transfer here; the caller drains the ring whenever it likes
     (gossip/plane.py amortizes over >= 64 rounds).  When passed, the
     scan carry is ``(state, flight)`` and the first return value is
     that pair; ``None`` compiles the recorder out entirely."""
+    return _run_rounds_impl(state, base_key, fail_round, p, steps, trace,
+                            unroll, join_round, flight, None)
+
+
+def _run_rounds_impl(state, base_key, fail_round, p, steps, trace, unroll,
+                     join_round, flight, sc):
 
     def body(carry, _):
         if flight is not None:
@@ -1068,7 +1270,7 @@ def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
         else:
             st = carry
         st, row = _swim_round_impl(st, base_key, fail_round, p, join_round,
-                                   collect=flight is not None)
+                                   collect=flight is not None, sc=sc)
         if flight is not None:
             R = fl.rows.shape[0]
             fl = FlightRing(
@@ -1077,11 +1279,14 @@ def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                 cursor=fl.cursor + 1)
         if trace:
             msg = st.heard >> _MSG_SHIFT
-            mem = st.member[None, :]
+            mem = (st.member if sc is None else _sloc(sc, st.member))[None, :]
             n_heard_dead = jnp.sum((msg == MSG_DEAD) & mem,
                                    axis=1, dtype=jnp.int32)
             n_heard_alive = jnp.sum((msg == MSG_REFUTE) & mem,
                                     axis=1, dtype=jnp.int32)
+            if sc is not None:
+                n_heard_dead = jax.lax.psum(n_heard_dead, _SHARD_AXIS)
+                n_heard_alive = jax.lax.psum(n_heard_alive, _SHARD_AXIS)
             y = RoundTrace(st.slot_node, st.slot_phase, st.slot_start,
                            st.slot_dead_round, n_heard_dead, n_heard_alive)
         else:
@@ -1091,3 +1296,158 @@ def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
     init = (state, flight) if flight is not None else state
     return jax.lax.scan(body, init, None, length=steps,
                         unroll=min(unroll, max(steps, 1)))
+
+
+# ---------------------------------------------------------------------------
+# Public sharded entry points (see the "ICI sharding" section above for
+# the layout).  Factories are lru_cached per (params, topology) exactly
+# like jit caches per static args.
+# ---------------------------------------------------------------------------
+
+def _check_shardable(p: SwimParams, ndev: int) -> None:
+    """Static alignment constraints of the sharded lowering.
+
+    ``n`` must split evenly over the devices (contiguous observer
+    columns per shard) and over ``probe_every`` (the probe tick's
+    prober block must be the aligned contiguous-window case — the
+    unaligned gather fallback has no sharded lowering).  In short:
+    n divisible by device_count and by probe_every."""
+    if ndev < 1:
+        raise ValueError(f"ndev must be >= 1, got {ndev}")
+    if p.n % ndev:
+        raise ValueError(
+            f"sharded kernel needs n % ndev == 0 (n={p.n}, ndev={ndev})")
+    if p.n % p.probe_every:
+        raise ValueError(
+            f"sharded kernel needs n % probe_every == 0 (aligned prober "
+            f"blocks; n={p.n}, probe_every={p.probe_every})")
+
+
+def _default_ndev() -> int:
+    return len(jax.devices())
+
+
+@functools.lru_cache(maxsize=None)
+def _shard_mesh(ndev: int):
+    return jax.sharding.Mesh(np.array(jax.devices()[:ndev]), (_SHARD_AXIS,))
+
+
+def _state_spec():
+    Ps = jax.sharding.PartitionSpec
+    return SwimState(**{f: (Ps(None, _SHARD_AXIS) if f == "heard" else Ps())
+                        for f in SwimState._fields})
+
+
+def shard_state(state: SwimState, ndev: int | None = None) -> SwimState:
+    """Place a SwimState on the device mesh: ``heard`` column-sharded
+    along the observer axis, every other register replicated.  Call
+    once before a sharded run loop so dispatches don't re-lay-out the
+    belief matrix every call."""
+    ndev = ndev or _default_ndev()
+    mesh = _shard_mesh(ndev)
+    sh = jax.tree.map(lambda spec: jax.sharding.NamedSharding(mesh, spec),
+                      _state_spec())
+    return jax.device_put(state, sh)
+
+
+@functools.lru_cache(maxsize=None)
+def sharded_round_callable(p: SwimParams, ndev: int, has_join: bool = False):
+    """The shard_map-wrapped single round, NOT jitted: composes inside
+    outer jits (multidc_round's per-DC loop) or under the donating jit
+    of ``swim_round_sharded``.  Signature: (state, base_key, fail_round
+    [, join_round]) -> state."""
+    from jax.experimental.shard_map import shard_map
+    _check_shardable(p, ndev)
+    mesh = _shard_mesh(ndev)
+    sc = _ShardCtx(ndev, p.n // ndev)
+    Ps = jax.sharding.PartitionSpec
+    st = _state_spec()
+    in_specs = (st, Ps(), Ps()) + ((Ps(),) if has_join else ())
+
+    def _round(state, base_key, fail_round, *rest):
+        join_round = rest[0] if has_join else None
+        return _swim_round_impl(state, base_key, fail_round, p, join_round,
+                                collect=False, sc=sc)[0]
+
+    return shard_map(_round, mesh=mesh, in_specs=in_specs, out_specs=st,
+                     check_rep=False)
+
+
+@functools.lru_cache(maxsize=None)
+def _swim_round_sharded_jit(p: SwimParams, ndev: int, has_join: bool):
+    return jax.jit(sharded_round_callable(p, ndev, has_join),
+                   donate_argnums=(0,))
+
+
+def swim_round_sharded(state: SwimState, base_key: jax.Array,
+                       fail_round: jnp.ndarray, p: SwimParams,
+                       join_round: jnp.ndarray | None = None,
+                       ndev: int | None = None) -> SwimState:
+    """``swim_round`` sharded across ``ndev`` devices — bit-identical
+    output, ``state`` donated.  See _check_shardable for the alignment
+    constraints."""
+    ndev = ndev or _default_ndev()
+    fn = _swim_round_sharded_jit(p, ndev, join_round is not None)
+    args = (state, base_key, fail_round) + (
+        (join_round,) if join_round is not None else ())
+    return fn(*args)
+
+
+@functools.lru_cache(maxsize=None)
+def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
+                            trace: bool, unroll: int, has_join: bool,
+                            has_flight: bool):
+    from jax.experimental.shard_map import shard_map
+    _check_shardable(p, ndev)
+    mesh = _shard_mesh(ndev)
+    sc = _ShardCtx(ndev, p.n // ndev)
+    Ps = jax.sharding.PartitionSpec
+    st = _state_spec()
+    fl = FlightRing(rows=Ps(), cursor=Ps())
+    in_specs = ((st, Ps(), Ps())
+                + ((Ps(),) if has_join else ())
+                + ((fl,) if has_flight else ()))
+    carry_spec = (st, fl) if has_flight else st
+    tr = RoundTrace(*([Ps()] * len(RoundTrace._fields)))
+    out_specs = (carry_spec, tr) if trace else carry_spec
+
+    def _run(state, base_key, fail_round, *rest):
+        i = 0
+        join_round = flight = None
+        if has_join:
+            join_round = rest[i]
+            i += 1
+        if has_flight:
+            flight = rest[i]
+        carry, ys = _run_rounds_impl(state, base_key, fail_round, p, steps,
+                                     trace, unroll, join_round, flight, sc)
+        return (carry, ys) if trace else carry
+
+    donate = (0,) + ((3 + int(has_join),) if has_flight else ())
+    return jax.jit(shard_map(_run, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False),
+                   donate_argnums=donate)
+
+
+def run_rounds_sharded(state: SwimState, base_key: jax.Array,
+                       fail_round: jnp.ndarray, p: SwimParams, steps: int,
+                       trace: bool = False, unroll: int = 4,
+                       join_round: jnp.ndarray | None = None,
+                       flight: FlightRing | None = None,
+                       ndev: int | None = None):
+    """``run_rounds`` sharded across ``ndev`` devices (default: all
+    local devices) — same contract and bit-identical results; ``state``
+    and ``flight`` donated.  Compute and HBM traffic for the belief
+    matrix drop by ``ndev``; the circulant deliveries pay a log2(ndev)
+    ppermute halo exchange instead.  Constraints: n divisible by ndev
+    and by probe_every (_check_shardable)."""
+    ndev = ndev or _default_ndev()
+    fn = _run_rounds_sharded_jit(p, ndev, steps, trace, unroll,
+                                 join_round is not None, flight is not None)
+    args = [state, base_key, fail_round]
+    if join_round is not None:
+        args.append(join_round)
+    if flight is not None:
+        args.append(flight)
+    out = fn(*args)
+    return out if trace else (out, None)

@@ -9,10 +9,11 @@ Kernel composition (BASELINE config #5, the 1M-node shape):
 
 - ``D`` LAN pools of ``n_lan`` nodes each — one :class:`SwimState`
   with a leading DC axis, advanced by ``jax.vmap`` of the single-pool
-  round (per-DC PRNG keys).  On hardware the DC axis composes with the
-  node-axis sharding: LAN traffic stays inside a shard group (ICI),
-  and only the small WAN pool crosses slice boundaries (DCN) — the
-  same locality the reference gets from LAN-vs-WAN gossip profiles.
+  round (per-DC PRNG keys).  With ``lan_devices > 1`` each DC's round
+  runs through the shard_map'd kernel (``kernel.sharded_round_callable``):
+  LAN traffic stays inside a shard group (ICI) and only the small WAN
+  pool crosses slice boundaries (DCN) — the same locality the
+  reference gets from LAN-vs-WAN gossip profiles.
 - One WAN pool of ``D * n_servers`` nodes (server ``j`` of DC ``d`` is
   WAN id ``d * n_servers + j``) with the WAN timing profile.
 - Events: each DC floods its LAN event pool; every round, server
@@ -31,7 +32,8 @@ import jax.numpy as jnp
 
 from consul_tpu.gossip.events import (
     EventState, _SEEN, event_round, init_events)
-from consul_tpu.gossip.kernel import SwimState, init_state, swim_round
+from consul_tpu.gossip.kernel import (
+    SwimState, init_state, sharded_round_callable, swim_round)
 from consul_tpu.gossip.params import SwimParams, lan_profile, wan_profile
 
 
@@ -42,15 +44,21 @@ class MultiDCParams(NamedTuple):
     event_slots: int
     lan: SwimParams
     wan: SwimParams
+    # Devices each DC's LAN round is shard_map'd over (observer axis;
+    # kernel.sharded_round_callable).  0/1 = single-device LAN rounds.
+    # Requires n_lan % (lan_devices * lan.probe_every) alignment.
+    lan_devices: int = 0
 
 
 def make_params(n_dcs: int, n_lan: int, n_servers: int = 3,
-                event_slots: int = 32, **kw) -> MultiDCParams:
+                event_slots: int = 32, lan_devices: int = 0,
+                **kw) -> MultiDCParams:
     return MultiDCParams(
         n_dcs=n_dcs, n_lan=n_lan, n_servers=n_servers,
         event_slots=event_slots,
         lan=lan_profile(n_lan, **kw),
         wan=wan_profile(n_dcs * n_servers),
+        lan_devices=lan_devices,
     )
 
 
@@ -103,8 +111,16 @@ def multidc_round(state: MultiDCState, base_key: jax.Array,
     def _per_dc(tree, d):
         return jax.tree.map(lambda x: x[d], tree)
 
+    # DC x shard composition: with lan_devices > 1 each DC's round is
+    # the shard_map-wrapped kernel (observer axis split across ICI,
+    # kernel.py "ICI sharding"); the D-loop stays a static unroll, so
+    # the per-DC collectives schedule back-to-back on the same ring.
+    if p.lan_devices > 1:
+        _lan_round = sharded_round_callable(p.lan, p.lan_devices)
+    else:
+        _lan_round = functools.partial(swim_round, p=p.lan)
     lan_list = [
-        swim_round(_per_dc(state.lan, d), keys[d], lan_fail[d], p.lan)
+        _lan_round(_per_dc(state.lan, d), keys[d], lan_fail[d])
         for d in range(D)
     ]
     lan = jax.tree.map(lambda *xs: jnp.stack(xs), *lan_list)

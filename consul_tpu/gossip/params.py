@@ -47,8 +47,10 @@ class SwimParams:
     pushpull_every: int = 0
     # Hot-tier width: rounds with <= this many live episodes process
     # only the sliced subset of belief rows (kernel._hot_tail).
-    # 0 disables the tier (two-way cond: quiescent / full).  Default
-    # OFF pending on-chip re-measurement: the round-3 tier (traced-
+    # 0 disables the tier (two-way cond: quiescent / full).  Field
+    # default stays OFF (WAN and bare SwimParams); lan_profile defaults
+    # it to 8 now that the round-4 dynamic-slice rework landed.
+    # History: the round-3 tier (traced-
     # index row GATHERS, ~6.5ns/element) measured ~10x slower than the
     # full tail (15.7 vs 155 r/s at 1M, 10ppm churn); the round-4
     # rework moves rows with per-row dynamic slices at memory
@@ -141,6 +143,11 @@ class SwimParams:
 # Ready-made profiles mirroring memberlist's LAN and WAN defaults.
 def lan_profile(n: int, **kw) -> SwimParams:
     kw.setdefault("pushpull_every", 150)  # 30s / 200ms gossip
+    # Hot tier on by default: the few most-recently-touched rumor slots
+    # take the cheap narrow tail (kernel._hot_tail) while the full S-wide
+    # tail runs only when episodes overflow it.  Bit-identical to the
+    # full tail (tests/test_shard_map_parity.py::test_hot_default_parity).
+    kw.setdefault("hot_slots", 8)
     return SwimParams(n=n, probe_every=5, suspicion_mult=4.0, retransmit_mult=4.0,
                       fanout=3, gossip_interval_s=0.2, **kw)
 

@@ -125,6 +125,12 @@ class PlaneConfig:
     # as membership — real agents and the sim swarm share the flood —
     # instead of a host-side TCP fanout.
     event_slots: int = 64
+    # Devices the SWIM round is shard_map'd over (kernel.py "ICI
+    # sharding").  1 = single-device; >1 = explicit (start() raises if
+    # the universe size is not divisible by shard_devices and
+    # probe_every); 0 = auto: all local devices when the alignment
+    # constraints hold, else fall back to single-device.
+    shard_devices: int = 1
 
 
 @dataclass
@@ -186,6 +192,8 @@ class GossipPlane:
         self._fail: Optional[np.ndarray] = None
         self._rounds_done = 0
         self._t0 = 0.0
+        self._ndev = 1       # resolved in start() (config.shard_devices)
+        self._run = None     # bound round-runner (sharded or not)
         # Events-kernel session: fires queue between dispatches; slot
         # metadata (payloads never enter device arrays) + delivery
         # bookkeeping live host-side, keyed by (slot, start_round).
@@ -216,6 +224,21 @@ class GossipPlane:
 
         from consul_tpu.gossip.kernel import NEVER, init_state
         from consul_tpu.gossip.params import SwimParams
+
+        # Persistent compilation cache: the dispatch shape compiles in
+        # seconds-to-minutes; across restarts the plane should pay that
+        # once per (params, jaxlib), not once per boot (same wiring as
+        # bench.py _setup_jax; best-effort — older jaxlibs lack it).
+        try:
+            cache_dir = os.environ.get(
+                "CONSUL_TPU_COMPILE_CACHE",
+                os.path.join(os.path.expanduser("~"), ".cache",
+                             "consul_tpu_jax_cache"))
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:  # noqa: E02 — cache is an optimization only
+            pass
 
         c = self.config
         n = self.n_universe
@@ -255,9 +278,34 @@ class GossipPlane:
         import jax.numpy as jnp
 
         from consul_tpu.gossip.events import init_events, run_event_rounds
-        from consul_tpu.gossip.kernel import init_flight, run_rounds
+        from consul_tpu.gossip.kernel import (
+            _check_shardable, init_flight, run_rounds, run_rounds_sharded,
+            shard_state)
         from consul_tpu.obs.flight import FlightRecorder
         self._ev_state = init_events(self._p, slots=c.event_slots)
+        # Resolve the device count for the sharded round (config
+        # docstring: 1 = off, >1 = explicit/strict, 0 = auto when the
+        # alignment constraints hold).
+        ndev = c.shard_devices
+        if ndev == 0:
+            ndev = len(jax.devices())
+            if n % ndev or n % self._p.probe_every:
+                ndev = 1
+        if ndev > 1:
+            _check_shardable(self._p, ndev)  # raises with the constraint
+            self._state = shard_state(self._state, ndev)
+        self._ndev = ndev
+        if ndev > 1:
+            def _run(state, key, fail, steps, join_round, flight):
+                return run_rounds_sharded(
+                    state, key, fail, self._p, steps=steps, trace=True,
+                    join_round=join_round, flight=flight, ndev=self._ndev)
+        else:
+            def _run(state, key, fail, steps, join_round, flight):
+                return run_rounds(
+                    state, key, fail, self._p, steps=steps, trace=True,
+                    join_round=join_round, flight=flight)
+        self._run = _run
         # Flight ring sized so a full drain interval fits with headroom
         # (bounded-burst catch-up can run up to max_burst extra
         # dispatches before the drain counter trips).
@@ -265,11 +313,13 @@ class GossipPlane:
             ring_rounds=4 * FLIGHT_DRAIN_EVERY * STEPS_PER_TICK)
         self._flight_recorder = FlightRecorder()
         self._dispatches_since_drain = 0
-        jax.block_until_ready(run_rounds(
-            self._state, self._key, jnp.asarray(self._fail), self._p,
-            steps=STEPS_PER_TICK, trace=True,
-            join_round=jnp.asarray(self._join),
-            flight=self._flight)[0])
+        # run_rounds donates state+flight: warm up on copies so the
+        # session arrays survive the throwaway compile dispatch.
+        jax.block_until_ready(self._run(
+            jax.tree.map(jnp.copy, self._state), self._key,
+            jnp.asarray(self._fail), STEPS_PER_TICK,
+            jnp.asarray(self._join),
+            jax.tree.map(jnp.copy, self._flight))[0])
         jax.block_until_ready(run_event_rounds(
             self._ev_state, self._key, self._state.member, self._p,
             steps=STEPS_PER_TICK)[0])
@@ -425,13 +475,11 @@ class GossipPlane:
         membership transitions the verdicts imply."""
         import jax.numpy as jnp
 
-        from consul_tpu.gossip.kernel import PHASE_DEAD, run_rounds
+        from consul_tpu.gossip.kernel import PHASE_DEAD
 
-        (state, self._flight), trace = run_rounds(
-            self._state, self._key, jnp.asarray(self._fail), self._p,
-            steps=STEPS_PER_TICK, trace=True,
-            join_round=jnp.asarray(self._join),
-            flight=self._flight)
+        (state, self._flight), trace = self._run(
+            self._state, self._key, jnp.asarray(self._fail),
+            STEPS_PER_TICK, jnp.asarray(self._join), self._flight)
         self._state = state
         self._rounds_done += STEPS_PER_TICK
         # Amortized drain: one host transfer per FLIGHT_DRAIN_EVERY

@@ -200,6 +200,8 @@ class TestKernelFlight:
         steps = 50
         p, state, key, fail = self._setup(steps)
         base, _ = run_rounds(state, key, fail, p, steps=steps)
+        # run_rounds donates `state`; rebuild it for the second run.
+        _, state, _, _ = self._setup(steps)
         (with_fl, fl), _ = run_rounds(state, key, fail, p, steps=steps,
                                       flight=init_flight(64))
         for name in base._fields:

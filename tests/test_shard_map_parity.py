@@ -1,0 +1,183 @@
+"""Sharded-vs-single-device kernel parity (ISSUE 3 tentpole).
+
+The shard_map lowering (kernel.py "ICI sharding") is only allowed to
+change WHERE the belief matrix lives, never a single bit of the
+dynamics: every merge back to replicated space is a psum of disjoint
+integer contributions, so the final SwimState — counters, membership,
+slot registers, the heard matrix itself — must equal the unsharded
+kernel exactly.  These tests run both kernels on the conftest-forced
+8-device virtual CPU mesh with the same seed/params and compare every
+field bit-for-bit, across the regimes with distinct code paths:
+failures (probe/suspect/dead), joins, push-pull, packet loss, the hot
+tail, and the flight recorder + trace.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.timeout_s(600)
+
+
+def _assert_state_equal(a, b, ctx=""):
+    for f in a._fields:
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert np.array_equal(x, y), f"{ctx}SwimState.{f} diverged"
+
+
+def _fail_join(jnp, n):
+    NEVER = 2**31 - 1
+    fail = jnp.full((n,), NEVER, jnp.int32)
+    fail = fail.at[:5].set(jnp.arange(5, dtype=jnp.int32) * 30 + 10)
+    join = jnp.full((n,), NEVER, jnp.int32)
+    join = join.at[n - 4:].set(jnp.arange(4, dtype=jnp.int32) * 40 + 25)
+    return fail, join
+
+
+def _run_both(n, steps, *, slots=8, hot_slots=0, loss_rate=0.0,
+              pushpull_every=0, flight_rounds=0, trace=False, ndev=8):
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.kernel import (
+        init_flight, init_state, run_rounds, run_rounds_sharded, shard_state)
+    from consul_tpu.gossip.params import lan_profile
+
+    p = lan_profile(n, slots=slots, hot_slots=hot_slots,
+                    loss_rate=loss_rate, pushpull_every=pushpull_every)
+    key = jax.random.PRNGKey(7)
+    fail, join = _fail_join(jnp, n)
+
+    ref = run_rounds(init_state(p), key, fail, p, steps=steps, trace=trace,
+                     join_round=join,
+                     flight=init_flight(64) if flight_rounds else None)
+    out = run_rounds_sharded(
+        shard_state(init_state(p), ndev), key, fail, p, steps=steps,
+        trace=trace, join_round=join,
+        flight=init_flight(64) if flight_rounds else None, ndev=ndev)
+    return ref, out, p
+
+
+class TestShardedParity:
+    def test_state_parity_failures_joins(self):
+        """Core regime: failures + joins, no extras — every SwimState
+        field must match bit-for-bit after 400 rounds."""
+        (ref, _), (out, _) = _run_both(640, 400)[:2]
+        _assert_state_equal(ref, out)
+
+    def test_state_parity_loss_pushpull_hot(self):
+        """The branchy regimes at once: iid packet loss, periodic
+        push-pull anti-entropy, and the hot-tier tail dispatch."""
+        (ref, _), (out, _) = _run_both(
+            640, 400, hot_slots=4, loss_rate=0.02, pushpull_every=50)[:2]
+        _assert_state_equal(ref, out)
+
+    def test_trace_and_flight_parity(self):
+        """RoundTrace series and the FlightRing rows are derived from
+        sharded values via psum — they must match too (the plane's
+        dead-verdict fanout and the obs pipeline read them)."""
+        (refc, rtr), (outc, otr) = _run_both(
+            640, 200, trace=True, flight_rounds=64)[:2]
+        ref_st, ref_fl = refc
+        out_st, out_fl = outc
+        _assert_state_equal(ref_st, out_st)
+        for f in ref_fl._fields:
+            assert np.array_equal(np.asarray(getattr(ref_fl, f)),
+                                  np.asarray(getattr(out_fl, f))), \
+                f"FlightRing.{f} diverged"
+        for f in rtr._fields:
+            assert np.array_equal(np.asarray(getattr(rtr, f)),
+                                  np.asarray(getattr(otr, f))), \
+                f"RoundTrace.{f} diverged"
+
+    def test_single_round_parity_and_donation(self):
+        """swim_round_sharded: one round matches, and the donated input
+        state is actually consumed (buffers deleted on CPU)."""
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.kernel import (
+            init_state, shard_state, swim_round, swim_round_sharded)
+        from consul_tpu.gossip.params import lan_profile
+
+        p = lan_profile(640, slots=8)
+        key = jax.random.PRNGKey(0)
+        fail, _ = _fail_join(jnp, p.n)
+        ref = swim_round(init_state(p), key, fail, p)
+        donated = shard_state(init_state(p))
+        out = swim_round_sharded(donated, key, fail, p)
+        _assert_state_equal(ref, out)
+        with pytest.raises(RuntimeError):
+            np.asarray(donated.heard)  # donated buffer must be gone
+
+    def test_alignment_rejected(self):
+        """n not divisible by ndev or probe_every is a loud ValueError,
+        not silent wrong halos."""
+        from consul_tpu.gossip.kernel import _check_shardable
+        from consul_tpu.gossip.params import lan_profile
+
+        with pytest.raises(ValueError):
+            _check_shardable(lan_profile(641), 8)  # 641 % 8 != 0
+        with pytest.raises(ValueError):
+            _check_shardable(lan_profile(8 * 13), 8)  # 104 % probe_every(5)
+        _check_shardable(lan_profile(640), 8)  # aligned: no raise
+
+    def test_hot_default_parity(self):
+        """Satellite: lan_profile now defaults hot_slots=8; the hot
+        tail must engage (few live episodes, S > hot_slots) and stay
+        bit-identical to a full-tail-only run."""
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.kernel import init_state, run_rounds
+        from consul_tpu.gossip.params import lan_profile
+
+        p_hot = lan_profile(256, slots=32)
+        assert p_hot.hot_slots == 8  # the new default
+        p_full = lan_profile(256, slots=32, hot_slots=0)
+        key = jax.random.PRNGKey(11)
+        fail = jnp.full((256,), 2**31 - 1, jnp.int32).at[3].set(
+            10).at[99].set(60)  # <= hot_slots live episodes: hot path taken
+        a, _ = run_rounds(init_state(p_hot), key, fail, p_hot, steps=300)
+        b, _ = run_rounds(init_state(p_full), key, fail, p_full, steps=300)
+        _assert_state_equal(a, b)
+
+    def test_multidc_lan_devices_parity(self):
+        """DC x shard composition: multidc with lan_devices=8 equals
+        the single-device multidc bit-for-bit, events included."""
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.multidc import (
+            init_multidc, make_params, run_multidc_rounds)
+
+        D, nl = 2, 320
+        p0 = make_params(D, nl, slots=8)
+        p8 = make_params(D, nl, slots=8, lan_devices=8)
+        key = jax.random.PRNGKey(3)
+        NEVER = 2**31 - 1
+        lan_fail = jnp.full((D, nl), NEVER, jnp.int32
+                            ).at[0, 3].set(5).at[1, 7].set(9)
+        wan_fail = jnp.full((D * 3,), NEVER, jnp.int32)
+        a, cov_a = run_multidc_rounds(
+            init_multidc(p0), key, lan_fail, wan_fail, p0, 120)
+        b, cov_b = run_multidc_rounds(
+            init_multidc(p8), key, lan_fail, wan_fail, p8, 120)
+        _assert_state_equal(a.lan, b.lan, "lan ")
+        _assert_state_equal(a.wan, b.wan, "wan ")
+        assert np.array_equal(np.asarray(cov_a), np.asarray(cov_b))
+
+
+@pytest.mark.slow
+class TestShardedParitySlow:
+    def test_state_parity_large(self):
+        """Larger N (8 x 5 x 128 = 5120) with every feature on."""
+        (ref, _), (out, _) = _run_both(
+            5120, 600, slots=16, hot_slots=8, loss_rate=0.01,
+            pushpull_every=150)[:2]
+        _assert_state_equal(ref, out)
+
+    def test_state_parity_ndev_sweep(self):
+        """Parity holds at every divisor device count, not just 8."""
+        for ndev in (1, 2, 4):
+            (ref, _), (out, _) = _run_both(640, 200, ndev=ndev)[:2]
+            _assert_state_equal(ref, out, f"ndev={ndev} ")

@@ -55,6 +55,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1_000_000)
     ap.add_argument("--slots", type=int, default=64)
+    ap.add_argument("--shard-devices", dest="shard_devices", type=int,
+                    default=0,
+                    help="also profile the shard_map'd path over this many "
+                         "devices (0 = all local devices when n is aligned; "
+                         "skipped on a single device)")
     args = ap.parse_args()
 
     from consul_tpu.gossip.kernel import (
@@ -172,7 +177,7 @@ def main():
                      st.slot_start, st.slot_nsusp, st.slot_dead_round,
                      st.slot_of_node, st.incarnation, st.member, st.drops)
             if do_probe:
-                carry = _probe(p, rnd, k_probe, mf_, carry)
+                carry = _probe(p, rnd, k_probe, mf_, carry)[0]
             (heard_, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
              slot_dead_round, slot_of_node, incarnation, member_, drops) = carry
             rx = alive_ & member_
@@ -269,6 +274,85 @@ def main():
         c_eff = jnp.minimum(((h >> 4) & 0x3).astype(jnp.int32), cc[:, None])
         return tbl[c_eff]
     results["timeout_table_lookup"] = timed(make_timed(f_tbl), heard, conf_cap)
+
+    # -- sharded path (kernel.py "ICI sharding"): per-phase cost under
+    # shard_map.  Every entry below runs the SAME math as its unsharded
+    # counterpart above — the deltas price the collective schedule:
+    # psum merges in probe/finish, the ppermute halo exchange in the
+    # circulant rolls.  make_timed's outer jit inlines the donating
+    # jits, so donation never eats the reused profiling state.
+    ndev = args.shard_devices or len(jax.devices())
+    if ndev > 1 and n % ndev == 0 and n % p.probe_every == 0:
+        from jax.experimental.shard_map import shard_map
+
+        from consul_tpu.gossip.kernel import (
+            _SHARD_AXIS, _ShardCtx, _disseminate as _dis_sc,
+            _finish_round as _fin_sc, _probe_tick as _probe_sc,
+            _roll_sharded, _shard_mesh, _state_spec, run_rounds_sharded,
+            shard_state)
+
+        mesh = _shard_mesh(ndev)
+        sc = _ShardCtx(ndev, n // ndev)
+        Ps = jax.sharding.PartitionSpec
+        hspec = Ps(None, _SHARD_AXIS)
+        st_spec = _state_spec()
+
+        def sh(fn, in_specs, out_specs):
+            return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+
+        st_sh = shard_state(state, ndev)
+        f_scan_sh = make_timed(lambda st: run_rounds_sharded(
+            st, key, fail, p, steps=64)[0])
+        results[f"shard{ndev}_round_amortized_64"] = timed(
+            f_scan_sh, st_sh, iters=2, warmup=1) / 64
+
+        def f_probe_sh(st, mf_):
+            keys = jax.random.split(key, 4)
+            carry = (st.heard, st.slot_node, st.slot_phase, st.slot_inc,
+                     st.slot_start, st.slot_nsusp, st.slot_dead_round,
+                     st.slot_of_node, st.incarnation, st.member, st.drops)
+            return _probe_sc(p, st.round, keys, mf_, carry, sc)[0][0]
+        results[f"shard{ndev}_probe_tick"] = timed(
+            make_timed(sh(f_probe_sh, (st_spec, Ps()), hspec)), st_sh, mf)
+
+        h_sh = jax.device_put(heard, jax.sharding.NamedSharding(mesh, hspec))
+        results[f"shard{ndev}_disseminate"] = timed(
+            make_timed(sh(
+                lambda h, mf_, cc: _dis_sc(p, rnd, key, h, mf_, rx_ok, cc, sc),
+                (hspec, Ps(), Ps()), hspec)),
+            h_sh, mf, conf_cap)
+
+        def f_finish_sh(st, h, cc, rx):
+            return _fin_sc(p, st, st.round, fail, fail > st.round,
+                           st.member, h, None,
+                           jnp.arange(S, dtype=jnp.int32), st.slot_node,
+                           st.slot_phase, st.slot_inc, st.slot_start,
+                           st.slot_nsusp, st.slot_dead_round,
+                           st.slot_of_node, st.incarnation, st.drops,
+                           cc, rx, sc)
+        results[f"shard{ndev}_finish_tail"] = timed(
+            make_timed(sh(f_finish_sh, (st_spec, hspec, Ps(), Ps()),
+                          st_spec)),
+            st_sh, h_sh, conf_cap, rx_ok)
+
+        # ppermute halo isolation: one full circulant delivery roll vs
+        # the shard-local part alone — the delta is the ring exchange
+        # (log2(ndev) conditional ppermutes + the boundary neighbor).
+        packed_sh = jax.device_put(packed,
+                                   jax.sharding.NamedSharding(mesh, hspec))
+        o = jnp.int32(n // 3 + 1)  # crosses shard boundaries
+        results[f"shard{ndev}_roll_with_halo"] = timed(
+            make_timed(sh(lambda x, oo: _roll_sharded(sc, x, oo),
+                          (hspec, Ps()), hspec)),
+            packed_sh, o)
+        results[f"shard{ndev}_roll_local_only"] = timed(
+            make_timed(sh(lambda x, oo: jnp.roll(x, oo % sc.L, axis=-1),
+                          (hspec, Ps()), hspec)),
+            packed_sh, o)
+    elif ndev > 1:
+        print(f"[shard] skipped: n={n} not aligned to ndev={ndev} "
+              f"x probe_every={p.probe_every}", file=sys.stderr)
 
     print("\n-- sorted --", flush=True)
     for k, v in sorted(results.items(), key=lambda kv: -kv[1]):
