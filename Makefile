@@ -17,7 +17,7 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet bench dryrun clean
+.PHONY: default ci test integ vet obs-smoke bench dryrun clean
 
 default: test
 
@@ -43,6 +43,14 @@ VET_PATHS = consul_tpu tests tools demo bench.py __graft_entry__.py
 vet:
 	$(PYTHON) -m compileall -q $(VET_PATHS)
 	$(PYTHON) -m tools.vet $(VET_PATHS)
+	$(MAKE) obs-smoke
+
+# Observability gate: boot a small CPU plane + one kernel-backed agent,
+# scrape /v1/agent/metrics?format=prometheus, and hold every line to
+# the strict text-format checker (tools/check_prom.py) — including the
+# detection-latency histogram families and the /v1/agent/slo shell.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.obs_smoke
 
 # North-star benchmark (needs the real chip; emits one JSON line).
 bench:

@@ -257,6 +257,32 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         # One drain AFTER timing: proves rows were recorded without a
         # host transfer inside the measured blocks.
         result["flight_rows_recorded"] = int(fl.cursor)
+    if churn_ppm:
+        # Detection-latency observatory (untimed): one extra block on a
+        # fresh state with the in-kernel histogram banks threaded
+        # through, failures confined to the first half so verdicts have
+        # room to land.  Separate from the timed blocks — the headline
+        # rounds/s and compile_s stay what they always measured.
+        import numpy as np
+
+        from consul_tpu.gossip.kernel import init_hist
+        from consul_tpu.obs.hist import HistRecorder
+        _log("observatory block: detection-latency histograms (untimed)")
+        h_state = init_state(p)
+        if shard_devices:
+            h_state = shard_state(h_state, shard_devices)
+        h_fail = fail_round.at[:n_fail].set(
+            (jnp.arange(n_fail, dtype=jnp.int32) * (steps // 2))
+            // max(1, n_fail)) if n_fail else fail_round
+        out = run(h_state, key, h_fail, steps=steps, hist=init_hist())
+        (h_state, hist) = out[0]
+        _sync(jax, h_state)
+        rec = HistRecorder()
+        rec.ingest({f: np.asarray(getattr(hist, f))
+                    for f in hist._fields})
+        result["detect_count"] = int(rec.counts("detect").sum())
+        result["detect_p50_rounds"] = rec.percentile("detect", 50)
+        result["detect_p99_rounds"] = rec.percentile("detect", 99)
     return result
 
 

@@ -928,11 +928,16 @@ class Agent:
         router.add_put("/v1/event/fire/{name}", h(self._event_fire))
         router.add_get("/v1/event/list", h(self._event_list))
         router.add_get("/v1/agent/metrics", h(self._metrics))
+        # Detection-latency SLO: an operator health surface like
+        # /v1/agent/metrics, not a debug surface — always on.
+        router.add_get("/v1/agent/slo", h(self._slo))
         # Observability surfaces, gated like /debug/pprof/* (http.go
-        # EnableDebug): finished traces and the kernel flight recorder.
+        # EnableDebug): finished traces, the kernel flight recorder,
+        # and on-demand device profiling.
         if self.config.enable_debug:
             router.add_get("/v1/agent/traces", h(self._traces))
             router.add_get("/v1/agent/flight", h(self._flight))
+            router.add_get("/v1/agent/profile", h(self._profile))
 
     async def _metrics(self, request):
         """Telemetry snapshot: the inmem sink's interval ring (the
@@ -952,9 +957,51 @@ class Agent:
                 from consul_tpu.obs.flight import fold_summary
                 fr = await getter(timeout=2.0)
                 fold_summary(metrics, fr.get("summary") or {})
-            return web.Response(text=render_prometheus(metrics.snapshot()),
-                                content_type="text/plain")
+            # Same for the detection-latency banks: cumulative histogram
+            # families rendered with le/_sum/_count per the text format.
+            hists = None
+            slo_getter = getattr(self.lan_pool, "plane_slo", None)
+            if slo_getter is not None:
+                hists = (await slo_getter(timeout=2.0)).get("hists")
+            return web.Response(
+                text=render_prometheus(metrics.snapshot(), histograms=hists),
+                content_type="text/plain")
         return metrics.snapshot()
+
+    async def _slo(self, request):
+        """Detection-latency SLO observatory: burn-rate snapshot, exact
+        latency percentiles, cumulative histogram families — drained
+        live from the gossip plane's on-device banks.  Empty shell for
+        backends without a kernel."""
+        getter = getattr(self.lan_pool, "plane_slo", None)
+        if getter is None:
+            return {"backend": self.config.gossip_backend,
+                    "slo": {}, "latency": {}, "hists": []}
+        out = dict(await getter())
+        out.pop("t", None)  # bridge frame tag, not API surface
+        out.setdefault("backend", self.config.gossip_backend)
+        out.setdefault("slo", {})
+        out.setdefault("latency", {})
+        out.setdefault("hists", [])
+        return out
+
+    async def _profile(self, request):
+        """On-demand device profiling (debug-gated): capture a
+        jax.profiler trace of K kernel rounds on the plane and return
+        the trace directory + timing summary."""
+        getter = getattr(self.lan_pool, "plane_profile", None)
+        if getter is None:
+            return {"backend": self.config.gossip_backend,
+                    "error": "no kernel gossip plane attached"}
+        try:
+            steps = int(request.query.get("steps", "32"))
+        except ValueError:
+            steps = 32
+        phases = request.query.get("phases", "") in ("1", "true", "yes")
+        out = dict(await getter(steps=steps, phases=phases))
+        out.pop("t", None)
+        out.setdefault("backend", self.config.gossip_backend)
+        return out
 
     async def _traces(self, request):
         """Recent finished traces (obs/trace.py ring), newest first."""

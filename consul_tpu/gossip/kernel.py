@@ -79,6 +79,8 @@ import numpy as np
 
 from consul_tpu.gossip.params import SwimParams
 from consul_tpu.obs.flight import N_COLS as _FLIGHT_COLS
+from consul_tpu.obs.hist import LATENCY_BUCKETS as _HIST_LAT
+from consul_tpu.obs.hist import SPREAD_BUCKETS as _HIST_SPREAD
 
 MSG_NONE = 0
 MSG_SUSPECT = 1
@@ -166,6 +168,37 @@ class FlightRing(NamedTuple):
 def init_flight(ring_rounds: int = 256) -> FlightRing:
     return FlightRing(rows=jnp.zeros((ring_rounds, _FLIGHT_COLS), jnp.int32),
                       cursor=jnp.int32(0))
+
+
+class HistBank(NamedTuple):
+    """On-device detection-latency observatory: cumulative fixed-bucket
+    integer histograms accumulated INSIDE the scan body (bucket layouts
+    documented in ``obs.hist``).  The latency banks are one round per
+    bucket with a top overflow bucket — the host reconstructs the exact
+    observation multiset below the overflow; the spread bank is
+    log2-bucketed via integer bit_length (no float ops, so sharded and
+    unsharded banks stay bit-identical)."""
+
+    detect: jnp.ndarray  # i32 [LATENCY_BUCKETS] — fail_round -> dead verdict
+    dwell: jnp.ndarray   # i32 [LATENCY_BUCKETS] — episode start -> verdict
+    refute: jnp.ndarray  # i32 [LATENCY_BUCKETS] — episode start -> refute
+    spread: jnp.ndarray  # i32 [SPREAD_BUCKETS] — verdict holders at slot GC
+
+
+def init_hist() -> HistBank:
+    return HistBank(detect=jnp.zeros((_HIST_LAT,), jnp.int32),
+                    dwell=jnp.zeros((_HIST_LAT,), jnp.int32),
+                    refute=jnp.zeros((_HIST_LAT,), jnp.int32),
+                    spread=jnp.zeros((_HIST_SPREAD,), jnp.int32))
+
+
+def _hist_add(bank: jnp.ndarray, mask: jnp.ndarray,
+              val: jnp.ndarray) -> jnp.ndarray:
+    """Scatter masked observations into a bank: value clipped into the
+    top (overflow) bucket, unmasked lanes dropped out of range."""
+    B = bank.shape[0]
+    return bank.at[jnp.where(mask, jnp.clip(val, 0, B - 1), B)].add(
+        1, mode="drop")
 
 
 _AGE_FRESH = 0xF  # sentinel: written by this round's probe marks, pre-aging
@@ -634,11 +667,25 @@ def swim_round(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                             collect=False)[0]
 
 
+def swim_round_hist(state: SwimState, base_key: jax.Array,
+                    fail_round: jnp.ndarray, p: SwimParams, hist: HistBank,
+                    join_round: jnp.ndarray | None = None):
+    """One round threading the observatory banks: ``(state, hist)``.
+
+    NOT jitted — composes inside outer jits (multidc_round's per-DC
+    loop) exactly like ``sharded_round_callable``; jit'd callers own
+    donation."""
+    out = _swim_round_impl(state, base_key, fail_round, p, join_round,
+                           collect=False, hist=hist)
+    return out[0], out[2]
+
+
 def _swim_round_impl(state: SwimState, base_key: jax.Array,
                      fail_round: jnp.ndarray, p: SwimParams,
                      join_round: jnp.ndarray | None, collect: bool,
-                     sc: _ShardCtx | None = None):
-    """One round + (optionally) its flight-recorder row.
+                     sc: _ShardCtx | None = None,
+                     hist: HistBank | None = None):
+    """One round + (optionally) its flight-recorder row + histograms.
 
     ``collect`` is a PYTHON-level static: False compiles exactly the
     old round (the stats tuple is dropped and DCE'd — bit-identical
@@ -647,7 +694,13 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
     The only S×N-sized extra work is the dissemination-bytes
     reduction, and it sits behind the same ``n_active > 0`` cond as
     the round tail — a quiescent (healthy) round never touches the
-    belief matrix for it."""
+    belief matrix for it.
+
+    ``hist`` (optional HistBank, also Python-level static): thread the
+    observatory banks through the round — _finish_round accumulates at
+    the verdict/GC sites, a quiescent round passes them through
+    untouched (no episodes -> nothing to observe).  Returns
+    ``(state, row, hist)``; row/hist are None when compiled out."""
     rnd = state.round
     key = jax.random.fold_in(base_key, rnd)
     k_probe = jax.random.split(jax.random.fold_in(key, 1), 4)
@@ -721,7 +774,8 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
         return jax.lax.cond(rnd % p.pushpull_every == p.pushpull_every - 1,
                             _pushpull, lambda h: h, h)
 
-    def _full_tail(heard):
+    def _full_tail(op):
+        heard, hb = (op, None) if hist is None else op
         # -- 2+3. age (fused into the dissemination pack) + gossip push
         # via circulant rolls ---------------------------------------------
         heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap, sc)
@@ -730,9 +784,10 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
                              None, jnp.arange(S, dtype=jnp.int32), slot_node,
                              slot_phase, slot_inc, slot_start, slot_nsusp,
                              slot_dead_round, slot_of_node, incarnation,
-                             drops, conf_cap, rx_ok, sc)
+                             drops, conf_cap, rx_ok, sc, hb)
 
-    def _hot_tail(heard):
+    def _hot_tail(op):
+        heard, hb = (op, None) if hist is None else op
         # A handful of live episodes: slice just their belief rows, run
         # the identical age/gossip/timer pipeline on the [H, N] subset,
         # write back.  Inactive rows are all-zero, so excluding them
@@ -759,13 +814,15 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
                              heard, idx, slot_node, slot_phase, slot_inc,
                              slot_start, slot_nsusp, slot_dead_round,
                              slot_of_node, incarnation, drops, conf_cap,
-                             rx_ok, sc)
+                             rx_ok, sc, hb)
 
-    def _quiescent_tail(heard):
+    def _quiescent_tail(op):
+        heard, hb = (op, None) if hist is None else op
         # No active episode anywhere: the belief matrix is all-zero and
         # every age/gossip/timer/GC pass is a no-op.  A healthy cluster
-        # pays only the probe tick per round.
-        return SwimState(
+        # pays only the probe tick per round.  No episodes -> nothing
+        # for the observatory either: the banks pass through untouched.
+        st = SwimState(
             round=rnd + 1, heard=heard, slot_node=slot_node,
             slot_phase=slot_phase, slot_inc=slot_inc, slot_start=slot_start,
             slot_nsusp=slot_nsusp, slot_dead_round=slot_dead_round,
@@ -774,19 +831,21 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
             sum_detect_rounds=state.sum_detect_rounds,
             n_false_dead=state.n_false_dead, n_refuted=state.n_refuted,
         )
+        return st if hist is None else (st, hb)
 
     n_active = jnp.sum((slot_node >= 0).astype(jnp.int32))
 
-    def _nonquiescent(heard):
+    def _nonquiescent(op):
         if p.hot_slots and S > p.hot_slots:
             return jax.lax.cond(n_active <= p.hot_slots, _hot_tail,
-                                _full_tail, heard)
-        return _full_tail(heard)
+                                _full_tail, op)
+        return _full_tail(op)
 
-    new_state = jax.lax.cond(n_active > 0, _nonquiescent, _quiescent_tail,
-                             heard)
+    out = jax.lax.cond(n_active > 0, _nonquiescent, _quiescent_tail,
+                       heard if hist is None else (heard, hist))
+    new_state, hist_out = (out, None) if hist is None else out
     if not collect:
-        return new_state, None
+        return new_state, None, hist_out
 
     # -- flight row (obs.flight.FLIGHT_COLS order) ------------------------
     # Dissemination bytes: every in-budget rumor entry is pushed to
@@ -818,7 +877,7 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
         new_state.drops - state.drops,                     # drops
         jnp.sum(new_state.member.astype(jnp.int32)),       # members
     ]).astype(jnp.int32)
-    return new_state, row
+    return new_state, row, hist_out
 
 
 def gossip_offsets(key: jax.Array, n: int, fanout: int) -> jnp.ndarray:
@@ -1071,14 +1130,19 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
                   member, heard_sub, full_heard, idx, slot_node, slot_phase,
                   slot_inc, slot_start, slot_nsusp, slot_dead_round,
                   slot_of_node, incarnation, drops, conf_cap,
-                  rx_ok, sc=None) -> SwimState:
+                  rx_ok, sc=None, hist=None):
     """Refutation, suspicion-timer firing, episode GC, stats.
 
     Operates on ``heard_sub`` — the belief rows of the slots listed in
     ``idx`` ([H] distinct slot ids; inactive padding entries are
     no-ops).  The full path passes ``idx = arange(S)`` with
     ``full_heard=None`` (the subset IS the matrix); the hot path passes
-    the gathered active rows and scatters them back."""
+    the gathered active rows and scatters them back.
+
+    ``hist`` (optional HistBank, a Python-level static like the flight
+    ``collect`` flag): accumulate the observatory histograms at the
+    verdict/GC sites and return ``(state, hist)``; ``None`` compiles
+    them out entirely and returns the bare state."""
     N, S = p.n, p.slots
     H = idx.shape[0]
     is_full = full_heard is None
@@ -1096,6 +1160,7 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
     hrows = jnp.arange(H, dtype=jnp.int32)
     node_c = jnp.clip(sl_node, 0, N - 1)
     n_refuted = state.n_refuted
+    refute_now = jnp.zeros((H,), bool)
     if p.refute:
         if sc is None:
             own_msg = heard_sub[hrows, node_c] >> _MSG_SHIFT
@@ -1176,6 +1241,38 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
     expired = ((sl_phase > PHASE_FREE)
                & ((rnd - sl_start > p.slot_ttl_rounds) | verdict_done))
     is_dead = expired & (sl_phase == PHASE_DEAD)
+
+    # -- observatory histograms (hist is a Python-level static; None
+    # compiles this block out — bit-identical dynamics either way).
+    # Latencies are recorded at verdict time, spread at slot GC, all
+    # from replicated/psum-merged inputs, so the sharded and unsharded
+    # banks are bit-identical (tests/test_shard_map_parity.py).
+    if hist is not None:
+        # Dissemination spread: members still holding the episode's
+        # verdict message when its slot is recycled.  Must read
+        # heard_sub/member BEFORE the GC wipe below.
+        verdict_msg = jnp.where(sl_phase == PHASE_DEAD, MSG_DEAD, MSG_REFUTE)
+        mem_l = member if sc is None else _sloc(sc, member)
+        hold = (((heard_sub >> _MSG_SHIFT).astype(jnp.int32)
+                 == verdict_msg[:, None]) & mem_l[None, :])
+        n_hold = jnp.sum(hold, axis=1, dtype=jnp.int32)
+        if sc is not None:
+            n_hold = jax.lax.psum(n_hold, _SHARD_AXIS)
+        # Integer log2 bucket = bit_length via shift-and-count (no
+        # float ops — exactness under sharding).
+        blen = jnp.sum((n_hold[:, None]
+                        >> jnp.arange(31, dtype=jnp.int32)) > 0,
+                       axis=1, dtype=jnp.int32)
+        hist = HistBank(
+            detect=_hist_add(hist.detect, new_dead & truly_dead,
+                             rnd - fail_round[node_c]),
+            dwell=_hist_add(hist.dwell, new_dead | refute_now,
+                            rnd - sl_start),
+            refute=_hist_add(hist.refute, refute_now, rnd - sl_start),
+            spread=_hist_add(hist.spread, expired & (sl_dead_round >= 0),
+                             blen),
+        )
+
     member = member.at[jnp.where(is_dead, node_c, N)].set(False, mode="drop")
     slot_of_node = slot_of_node.at[jnp.where(expired, node_c, N)].set(-1, mode="drop")
     heard_sub = jnp.where(expired[:, None], jnp.uint8(0), heard_sub)
@@ -1203,7 +1300,7 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
         slot_phase_o = slot_phase.at[idx].set(sl_phase)
         slot_dead_o = slot_dead_round.at[idx].set(sl_dead_round)
 
-    return SwimState(
+    st = SwimState(
         round=rnd + 1,
         heard=heard,
         slot_node=slot_node_o,
@@ -1221,6 +1318,7 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
         n_false_dead=n_false_dead,
         n_refuted=n_refuted,
     )
+    return st if hist is None else (st, hist)
 
 
 class RoundTrace(NamedTuple):
@@ -1236,42 +1334,54 @@ class RoundTrace(NamedTuple):
 
 
 @functools.partial(jax.jit, static_argnames=("p", "steps", "trace", "unroll"),
-                   donate_argnames=("state", "flight"))
+                   donate_argnames=("state", "flight", "hist"))
 def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                p: SwimParams, steps: int, trace: bool = False,
                unroll: int = 4, join_round: jnp.ndarray | None = None,
-               flight: FlightRing | None = None):
+               flight: FlightRing | None = None,
+               hist: HistBank | None = None):
     """Scan ``steps`` rounds.  With ``trace``, also return per-round slot
     snapshots for detection-curve analysis (adds one S×N reduction/round).
     ``unroll`` fuses that many rounds per scan iteration — amortizes
     per-iteration dispatch/sync on backends where that dominates.
 
-    ``state`` and ``flight`` are DONATED: the belief matrix and the
-    ring are updated in place instead of copied per dispatch (64 MB
-    per copy at 1M nodes).  Callers must rebind both and never reuse
-    the passed-in arrays afterwards.
+    ``state``, ``flight`` and ``hist`` are DONATED: the belief matrix,
+    the ring and the banks are updated in place instead of copied per
+    dispatch (64 MB per copy at 1M nodes).  Callers must rebind all and
+    never reuse the passed-in arrays afterwards.
 
     ``flight`` (optional FlightRing): record one flight-recorder row
     per round into the on-device ring at ``cursor % R`` — no host
     transfer here; the caller drains the ring whenever it likes
-    (gossip/plane.py amortizes over >= 64 rounds).  When passed, the
-    scan carry is ``(state, flight)`` and the first return value is
-    that pair; ``None`` compiles the recorder out entirely."""
+    (gossip/plane.py amortizes over >= 64 rounds).
+
+    ``hist`` (optional HistBank): accumulate the detection-latency
+    observatory histograms in HBM (obs/hist.py bucket layouts), drained
+    on the same cadence.  Each optional extends the scan carry and the
+    first return value in order: ``state``, ``(state, flight)``,
+    ``(state, hist)``, or ``(state, flight, hist)``; ``None`` compiles
+    the machinery out entirely."""
     return _run_rounds_impl(state, base_key, fail_round, p, steps, trace,
-                            unroll, join_round, flight, None)
+                            unroll, join_round, flight, None, hist)
 
 
 def _run_rounds_impl(state, base_key, fail_round, p, steps, trace, unroll,
-                     join_round, flight, sc):
+                     join_round, flight, sc, hist=None):
+    has_fl = flight is not None
+    has_hb = hist is not None
 
     def body(carry, _):
-        if flight is not None:
-            st, fl = carry
+        if has_fl or has_hb:
+            parts = list(carry)
+            st = parts.pop(0)
+            fl = parts.pop(0) if has_fl else None
+            hb = parts.pop(0) if has_hb else None
         else:
-            st = carry
-        st, row = _swim_round_impl(st, base_key, fail_round, p, join_round,
-                                   collect=flight is not None, sc=sc)
-        if flight is not None:
+            st, fl, hb = carry, None, None
+        st, row, hb = _swim_round_impl(st, base_key, fail_round, p,
+                                       join_round, collect=has_fl, sc=sc,
+                                       hist=hb)
+        if has_fl:
             R = fl.rows.shape[0]
             fl = FlightRing(
                 rows=jax.lax.dynamic_update_slice(
@@ -1291,9 +1401,13 @@ def _run_rounds_impl(state, base_key, fail_round, p, steps, trace, unroll,
                            st.slot_dead_round, n_heard_dead, n_heard_alive)
         else:
             y = None
-        return (st, fl) if flight is not None else st, y
+        out = (st,) + ((fl,) if has_fl else ()) + ((hb,) if has_hb else ())
+        return (out if len(out) > 1 else st), y
 
-    init = (state, flight) if flight is not None else state
+    init = ((state,) + ((flight,) if has_fl else ())
+            + ((hist,) if has_hb else ()))
+    if len(init) == 1:
+        init = state
     return jax.lax.scan(body, init, None, length=steps,
                         unroll=min(unroll, max(steps, 1)))
 
@@ -1351,26 +1465,39 @@ def shard_state(state: SwimState, ndev: int | None = None) -> SwimState:
 
 
 @functools.lru_cache(maxsize=None)
-def sharded_round_callable(p: SwimParams, ndev: int, has_join: bool = False):
+def sharded_round_callable(p: SwimParams, ndev: int, has_join: bool = False,
+                           has_hist: bool = False):
     """The shard_map-wrapped single round, NOT jitted: composes inside
     outer jits (multidc_round's per-DC loop) or under the donating jit
     of ``swim_round_sharded``.  Signature: (state, base_key, fail_round
-    [, join_round]) -> state."""
+    [, join_round][, hist]) -> state, or (state, hist) with
+    ``has_hist`` (the banks are replicated — every increment derives
+    from replicated or psum-merged values)."""
     from jax.experimental.shard_map import shard_map
     _check_shardable(p, ndev)
     mesh = _shard_mesh(ndev)
     sc = _ShardCtx(ndev, p.n // ndev)
     Ps = jax.sharding.PartitionSpec
     st = _state_spec()
-    in_specs = (st, Ps(), Ps()) + ((Ps(),) if has_join else ())
+    hb = HistBank(*([Ps()] * len(HistBank._fields)))
+    in_specs = ((st, Ps(), Ps()) + ((Ps(),) if has_join else ())
+                + ((hb,) if has_hist else ()))
+    out_specs = (st, hb) if has_hist else st
 
     def _round(state, base_key, fail_round, *rest):
-        join_round = rest[0] if has_join else None
-        return _swim_round_impl(state, base_key, fail_round, p, join_round,
-                                collect=False, sc=sc)[0]
+        i = 0
+        join_round = hist = None
+        if has_join:
+            join_round = rest[i]
+            i += 1
+        if has_hist:
+            hist = rest[i]
+        out = _swim_round_impl(state, base_key, fail_round, p, join_round,
+                               collect=False, sc=sc, hist=hist)
+        return (out[0], out[2]) if has_hist else out[0]
 
-    return shard_map(_round, mesh=mesh, in_specs=in_specs, out_specs=st,
-                     check_rep=False)
+    return shard_map(_round, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
 
 
 @functools.lru_cache(maxsize=None)
@@ -1396,7 +1523,7 @@ def swim_round_sharded(state: SwimState, base_key: jax.Array,
 @functools.lru_cache(maxsize=None)
 def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
                             trace: bool, unroll: int, has_join: bool,
-                            has_flight: bool):
+                            has_flight: bool, has_hist: bool):
     from jax.experimental.shard_map import shard_map
     _check_shardable(p, ndev)
     mesh = _shard_mesh(ndev)
@@ -1404,26 +1531,39 @@ def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
     Ps = jax.sharding.PartitionSpec
     st = _state_spec()
     fl = FlightRing(rows=Ps(), cursor=Ps())
+    hb = HistBank(*([Ps()] * len(HistBank._fields)))
     in_specs = ((st, Ps(), Ps())
                 + ((Ps(),) if has_join else ())
-                + ((fl,) if has_flight else ()))
-    carry_spec = (st, fl) if has_flight else st
+                + ((fl,) if has_flight else ())
+                + ((hb,) if has_hist else ()))
+    carry_spec = ((st,) + ((fl,) if has_flight else ())
+                  + ((hb,) if has_hist else ()))
+    if len(carry_spec) == 1:
+        carry_spec = st
     tr = RoundTrace(*([Ps()] * len(RoundTrace._fields)))
     out_specs = (carry_spec, tr) if trace else carry_spec
 
     def _run(state, base_key, fail_round, *rest):
         i = 0
-        join_round = flight = None
+        join_round = flight = hist = None
         if has_join:
             join_round = rest[i]
             i += 1
         if has_flight:
             flight = rest[i]
+            i += 1
+        if has_hist:
+            hist = rest[i]
         carry, ys = _run_rounds_impl(state, base_key, fail_round, p, steps,
-                                     trace, unroll, join_round, flight, sc)
+                                     trace, unroll, join_round, flight, sc,
+                                     hist)
         return (carry, ys) if trace else carry
 
-    donate = (0,) + ((3 + int(has_join),) if has_flight else ())
+    donate = (0,)
+    if has_flight:
+        donate += (3 + int(has_join),)
+    if has_hist:
+        donate += (3 + int(has_join) + int(has_flight),)
     return jax.jit(shard_map(_run, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=False),
                    donate_argnums=donate)
@@ -1434,20 +1574,24 @@ def run_rounds_sharded(state: SwimState, base_key: jax.Array,
                        trace: bool = False, unroll: int = 4,
                        join_round: jnp.ndarray | None = None,
                        flight: FlightRing | None = None,
+                       hist: HistBank | None = None,
                        ndev: int | None = None):
     """``run_rounds`` sharded across ``ndev`` devices (default: all
-    local devices) — same contract and bit-identical results; ``state``
-    and ``flight`` donated.  Compute and HBM traffic for the belief
-    matrix drop by ``ndev``; the circulant deliveries pay a log2(ndev)
-    ppermute halo exchange instead.  Constraints: n divisible by ndev
-    and by probe_every (_check_shardable)."""
+    local devices) — same contract and bit-identical results; ``state``,
+    ``flight`` and ``hist`` donated.  Compute and HBM traffic for the
+    belief matrix drop by ``ndev``; the circulant deliveries pay a
+    log2(ndev) ppermute halo exchange instead.  Constraints: n
+    divisible by ndev and by probe_every (_check_shardable)."""
     ndev = ndev or _default_ndev()
     fn = _run_rounds_sharded_jit(p, ndev, steps, trace, unroll,
-                                 join_round is not None, flight is not None)
+                                 join_round is not None, flight is not None,
+                                 hist is not None)
     args = [state, base_key, fail_round]
     if join_round is not None:
         args.append(join_round)
     if flight is not None:
         args.append(flight)
+    if hist is not None:
+        args.append(hist)
     out = fn(*args)
     return out if trace else (out, None)

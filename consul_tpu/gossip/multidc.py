@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from consul_tpu.gossip.events import (
     EventState, _SEEN, event_round, init_events)
 from consul_tpu.gossip.kernel import (
-    SwimState, init_state, sharded_round_callable, swim_round)
+    HistBank, SwimState, init_hist, init_state, sharded_round_callable,
+    swim_round, swim_round_hist)
 from consul_tpu.gossip.params import SwimParams, lan_profile, wan_profile
 
 
@@ -81,6 +82,11 @@ def init_multidc(p: MultiDCParams) -> MultiDCState:
     )
 
 
+def init_multidc_hist(p: MultiDCParams) -> HistBank:
+    """Per-DC observatory banks: one HistBank with a leading D axis."""
+    return jax.vmap(lambda _: init_hist())(jnp.arange(p.n_dcs))
+
+
 def _merge_seen(dst: jnp.ndarray, src_seen: jnp.ndarray) -> jnp.ndarray:
     """Set the seen-bit (age 0) where src has seen and dst hasn't."""
     newly = src_seen & ((dst & _SEEN) == 0)
@@ -90,13 +96,17 @@ def _merge_seen(dst: jnp.ndarray, src_seen: jnp.ndarray) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnames=("p",))
 def multidc_round(state: MultiDCState, base_key: jax.Array,
                   lan_fail: jnp.ndarray, wan_fail: jnp.ndarray,
-                  p: MultiDCParams) -> MultiDCState:
+                  p: MultiDCParams, lan_hist: HistBank | None = None):
     """One LAN gossip interval across every pool.
 
     ``lan_fail``: [D, n_lan] per-pool fail rounds; ``wan_fail``:
     [D*n_servers].  The WAN pool ticks every round too — its *protocol*
     is slower via its own probe_every/suspicion params (its rounds are
     LAN-interval sized; wan_profile's probe_every scales accordingly).
+
+    ``lan_hist`` (optional, ``init_multidc_hist``): thread per-DC
+    observatory banks through each DC's LAN round; returns
+    ``(state, lan_hist)`` instead of the bare state.
     """
     D, s = p.n_dcs, p.n_servers
     keys = jax.random.split(jax.random.fold_in(base_key, 11), D)
@@ -115,14 +125,28 @@ def multidc_round(state: MultiDCState, base_key: jax.Array,
     # the shard_map-wrapped kernel (observer axis split across ICI,
     # kernel.py "ICI sharding"); the D-loop stays a static unroll, so
     # the per-DC collectives schedule back-to-back on the same ring.
+    has_hist = lan_hist is not None
     if p.lan_devices > 1:
-        _lan_round = sharded_round_callable(p.lan, p.lan_devices)
+        _lan_round = sharded_round_callable(p.lan, p.lan_devices,
+                                            has_hist=has_hist)
+    elif has_hist:
+        _lan_round = lambda st, k, f, hb: swim_round_hist(st, k, f, p.lan, hb)
     else:
         _lan_round = functools.partial(swim_round, p=p.lan)
-    lan_list = [
-        _lan_round(_per_dc(state.lan, d), keys[d], lan_fail[d])
-        for d in range(D)
-    ]
+    if has_hist:
+        pairs = [
+            _lan_round(_per_dc(state.lan, d), keys[d], lan_fail[d],
+                       _per_dc(lan_hist, d))
+            for d in range(D)
+        ]
+        lan_list = [st for st, _ in pairs]
+        lan_hist = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                *[hb for _, hb in pairs])
+    else:
+        lan_list = [
+            _lan_round(_per_dc(state.lan, d), keys[d], lan_fail[d])
+            for d in range(D)
+        ]
     lan = jax.tree.map(lambda *xs: jnp.stack(xs), *lan_list)
     lan_alive = (lan_fail > state.lan_events.round[:, None])
     lan_ev_list = [
@@ -161,8 +185,9 @@ def multidc_round(state: MultiDCState, base_key: jax.Array,
     lan_events = lan_events._replace(has=lan_has)
     wan_events = wan_events._replace(has=wan_has)
 
-    return MultiDCState(lan=lan, lan_events=lan_events,
-                        wan=wan, wan_events=wan_events)
+    out = MultiDCState(lan=lan, lan_events=lan_events,
+                       wan=wan, wan_events=wan_events)
+    return (out, lan_hist) if has_hist else out
 
 
 def fire_in_dc(state: MultiDCState, dc: int, node: int,
@@ -210,14 +235,25 @@ def event_coverage(state: MultiDCState) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnames=("p", "steps"))
 def run_multidc_rounds(state: MultiDCState, base_key: jax.Array,
                        lan_fail: jnp.ndarray, wan_fail: jnp.ndarray,
-                       p: MultiDCParams, steps: int
+                       p: MultiDCParams, steps: int,
+                       lan_hist: HistBank | None = None
                        ) -> Tuple[MultiDCState, jnp.ndarray]:
-    """Scan ``steps`` rounds; traces per-round [D, E] event coverage."""
+    """Scan ``steps`` rounds; traces per-round [D, E] event coverage.
 
-    def body(st, _):
-        st = multidc_round(st, base_key, lan_fail, wan_fail, p)
+    With ``lan_hist`` the carry (and first return value) is
+    ``(state, lan_hist)`` — per-DC observatory banks accumulated
+    through every LAN round."""
+    has_hist = lan_hist is not None
+
+    def body(carry, _):
+        if has_hist:
+            st, hb = carry
+            st, hb = multidc_round(st, base_key, lan_fail, wan_fail, p, hb)
+        else:
+            st = multidc_round(carry, base_key, lan_fail, wan_fail, p)
         seen = (st.lan_events.has & _SEEN) > 0
         cov = jnp.mean(seen.astype(jnp.float32), axis=2)
-        return st, cov
+        return ((st, hb) if has_hist else st), cov
 
-    return jax.lax.scan(body, state, None, length=steps)
+    init = (state, lan_hist) if has_hist else state
+    return jax.lax.scan(body, init, None, length=steps)

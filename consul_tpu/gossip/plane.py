@@ -131,6 +131,12 @@ class PlaneConfig:
     # probe_every); 0 = auto: all local devices when the alignment
     # constraints hold, else fall back to single-device.
     shard_devices: int = 1
+    # Detection-latency SLO objective in kernel rounds (obs/slo.py).
+    # 0 = auto: the params' worst-case Lifeguard suspicion window plus
+    # one probe-selection period (the latest round a clean detection
+    # can land when nothing goes wrong).
+    slo_objective_rounds: int = 0
+    slo_attainment_target: float = 0.99
 
 
 @dataclass
@@ -205,6 +211,12 @@ class GossipPlane:
         self._flight = None                  # FlightRing (device)
         self._flight_recorder = None         # obs.flight.FlightRecorder
         self._dispatches_since_drain = 0
+        # Detection-latency observatory: on-device histogram banks
+        # accumulated inside the same jit step, drained on the flight
+        # cadence into the host recorder + SLO burn-rate tracker.
+        self._hist = None                    # kernel.HistBank (device)
+        self._hist_recorder = None           # obs.hist.HistRecorder
+        self._slo = None                     # obs.slo.SloTracker
 
     # -- universe ----------------------------------------------------------
 
@@ -279,9 +291,11 @@ class GossipPlane:
 
         from consul_tpu.gossip.events import init_events, run_event_rounds
         from consul_tpu.gossip.kernel import (
-            _check_shardable, init_flight, run_rounds, run_rounds_sharded,
-            shard_state)
+            _check_shardable, init_flight, init_hist, run_rounds,
+            run_rounds_sharded, shard_state)
         from consul_tpu.obs.flight import FlightRecorder
+        from consul_tpu.obs.hist import HistRecorder
+        from consul_tpu.obs.slo import SloTracker
         self._ev_state = init_events(self._p, slots=c.event_slots)
         # Resolve the device count for the sharded round (config
         # docstring: 1 = off, >1 = explicit/strict, 0 = auto when the
@@ -296,15 +310,16 @@ class GossipPlane:
             self._state = shard_state(self._state, ndev)
         self._ndev = ndev
         if ndev > 1:
-            def _run(state, key, fail, steps, join_round, flight):
+            def _run(state, key, fail, steps, join_round, flight, hist):
                 return run_rounds_sharded(
                     state, key, fail, self._p, steps=steps, trace=True,
-                    join_round=join_round, flight=flight, ndev=self._ndev)
+                    join_round=join_round, flight=flight, hist=hist,
+                    ndev=self._ndev)
         else:
-            def _run(state, key, fail, steps, join_round, flight):
+            def _run(state, key, fail, steps, join_round, flight, hist):
                 return run_rounds(
                     state, key, fail, self._p, steps=steps, trace=True,
-                    join_round=join_round, flight=flight)
+                    join_round=join_round, flight=flight, hist=hist)
         self._run = _run
         # Flight ring sized so a full drain interval fits with headroom
         # (bounded-burst catch-up can run up to max_burst extra
@@ -313,13 +328,22 @@ class GossipPlane:
             ring_rounds=4 * FLIGHT_DRAIN_EVERY * STEPS_PER_TICK)
         self._flight_recorder = FlightRecorder()
         self._dispatches_since_drain = 0
-        # run_rounds donates state+flight: warm up on copies so the
+        # Observatory banks ride the same dispatch: cumulative on-device
+        # histograms drained on the flight cadence, feeding the live SLO.
+        self._hist = init_hist()
+        self._hist_recorder = HistRecorder()
+        objective = c.slo_objective_rounds or (
+            self._p.suspicion_max_rounds + self._p.probe_every)
+        self._slo = SloTracker(objective,
+                               attainment_target=c.slo_attainment_target)
+        # run_rounds donates state+flight+hist: warm up on copies so the
         # session arrays survive the throwaway compile dispatch.
         jax.block_until_ready(self._run(
             jax.tree.map(jnp.copy, self._state), self._key,
             jnp.asarray(self._fail), STEPS_PER_TICK,
             jnp.asarray(self._join),
-            jax.tree.map(jnp.copy, self._flight))[0])
+            jax.tree.map(jnp.copy, self._flight),
+            jax.tree.map(jnp.copy, self._hist))[0])
         jax.block_until_ready(run_event_rounds(
             self._ev_state, self._key, self._state.member, self._p,
             steps=STEPS_PER_TICK)[0])
@@ -477,9 +501,10 @@ class GossipPlane:
 
         from consul_tpu.gossip.kernel import PHASE_DEAD
 
-        (state, self._flight), trace = self._run(
+        (state, self._flight, self._hist), trace = self._run(
             self._state, self._key, jnp.asarray(self._fail),
-            STEPS_PER_TICK, jnp.asarray(self._join), self._flight)
+            STEPS_PER_TICK, jnp.asarray(self._join), self._flight,
+            self._hist)
         self._state = state
         self._rounds_done += STEPS_PER_TICK
         # Amortized drain: one host transfer per FLIGHT_DRAIN_EVERY
@@ -614,9 +639,22 @@ class GossipPlane:
         self._dispatches_since_drain = 0
         cursor = int(self._flight.cursor)
         if cursor == self._flight_recorder.last_cursor:
-            return  # nothing new since the last drain
+            return  # nothing new since the last drain (banks idle too)
         self._flight_recorder.ingest(
             np.asarray(self._flight.rows), cursor)
+        self._drain_hist()
+
+    def _drain_hist(self) -> None:
+        """Pull the on-device histogram banks to the host recorder and
+        feed the detect delta to the SLO tracker.  Rides the flight
+        drain cadence; also called on-demand for an ``slo`` query."""
+        if self._hist is None or self._hist_recorder is None:
+            return
+        deltas = self._hist_recorder.ingest(
+            {f: np.asarray(getattr(self._hist, f))
+             for f in self._hist._fields})
+        if self._slo is not None and "detect" in deltas:
+            self._slo.observe(deltas["detect"])
 
     def event_coverage(self) -> Dict[int, float]:
         """Live event slots -> fraction of members holding the event
@@ -716,6 +754,105 @@ class GossipPlane:
                        "n_refuted": int(st.n_refuted)},
         }
 
+    def _slo_wire(self) -> Dict[str, Any]:
+        """/v1/agent/slo payload: SLO burn-rate snapshot + exact latency
+        percentiles + cumulative histogram families.  Drains the device
+        banks first (on-demand sync — fine for an operator query)."""
+        self._drain_hist()
+        out: Dict[str, Any] = {"t": "slo"}
+        if self._slo is not None:
+            out["slo"] = self._slo.snapshot()
+        if self._hist_recorder is not None:
+            out["latency"] = self._hist_recorder.summary()
+            out["hists"] = self._hist_recorder.families()
+        return out
+
+    def _profile_wire(self, steps: int, phases: bool = False
+                      ) -> Dict[str, Any]:
+        """On-demand device profiling: run ``steps`` kernel rounds on
+        COPIES of the session arrays (the dispatch donates its inputs)
+        under ``jax.profiler.trace`` to a fresh temp dir, optionally
+        followed by per-phase timings through the shared harness
+        (tools/profile_kernel).  Synchronous by design — an operator
+        query against the already-compiled dispatch shape, bounded so
+        it cannot recompile or run away."""
+        import tempfile
+
+        payload: Dict[str, Any] = {"t": "profile"}
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            steps = max(STEPS_PER_TICK,
+                        min(int(steps), 64 * STEPS_PER_TICK))
+            ndisp = -(-steps // STEPS_PER_TICK)
+            fail = jnp.asarray(self._fail)
+            join = jnp.asarray(self._join)
+
+            def _one_dispatch():
+                out = self._run(
+                    jax.tree.map(jnp.copy, self._state), self._key, fail,
+                    STEPS_PER_TICK, join,
+                    jax.tree.map(jnp.copy, self._flight),
+                    jax.tree.map(jnp.copy, self._hist))
+                return out[0][0]
+
+            trace_dir = tempfile.mkdtemp(prefix="consul-tpu-profile-")
+            t0 = time.perf_counter()
+            with jax.profiler.trace(trace_dir):
+                for _ in range(ndisp):
+                    jax.block_until_ready(_one_dispatch())
+            wall = time.perf_counter() - t0
+            payload.update(
+                trace_dir=trace_dir, rounds=ndisp * STEPS_PER_TICK,
+                dispatches=ndisp, wall_s=wall,
+                round_ms=wall * 1e3 / (ndisp * STEPS_PER_TICK))
+            if phases:
+                payload["phases_ms"] = self._profile_phases()
+        except Exception as e:  # noqa: E02 — profiling must never kill the plane
+            payload["error"] = f"{type(e).__name__}: {e}"
+        return payload
+
+    def _profile_phases(self) -> Dict[str, float]:
+        """Per-phase timings (ms) via tools/profile_kernel's harness.
+        Single-device sessions only — the standalone phase callables
+        take unsharded arrays; a sharded session reports just the
+        profiler capture."""
+        if self._ndev > 1:
+            return {}
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.kernel import (
+            _age_tick, _disseminate, _probe_tick)
+        from tools.profile_kernel import make_timed, timed
+
+        p, st, key = self._p, self._state, self._key
+        fail = jnp.asarray(self._fail)
+        mf = jnp.where(st.member, fail, -1)
+        rx = (fail > st.round) & st.member
+        cc = jnp.minimum(p.max_confirmations,
+                         jnp.maximum(st.slot_nsusp - 1, 0))
+
+        def f_probe(s, mf_):
+            keys = jax.random.split(key, 4)
+            carry = (s.heard, s.slot_node, s.slot_phase, s.slot_inc,
+                     s.slot_start, s.slot_nsusp, s.slot_dead_round,
+                     s.slot_of_node, s.incarnation, s.member, s.drops)
+            return _probe_tick(p, s.round, keys, mf_, carry)[0]
+
+        out = {
+            "age_tick": timed(make_timed(_age_tick), st.heard,
+                              iters=4, warmup=1),
+            "probe_tick": timed(make_timed(f_probe), st, mf,
+                                iters=4, warmup=1),
+            "disseminate": timed(
+                make_timed(lambda h, m_, c_: _disseminate(
+                    p, st.round, key, h, m_, rx, c_)),
+                st.heard, mf, cc, iters=4, warmup=1),
+        }
+        return {k: v * 1e3 for k, v in out.items()}
+
     # -- bridge server -----------------------------------------------------
 
     async def _serve(self, reader: asyncio.StreamReader,
@@ -795,6 +932,20 @@ class GossipPlane:
                         payload.update(self._flight_recorder.wire(
                             limit=int(m.get("limit", 256) or 256)))
                     self._send(writer, payload)
+                elif t == "slo":
+                    # Detection-latency SLO observatory: burn rate,
+                    # exact percentiles, cumulative histogram families
+                    # (same keyring gate as stats).
+                    self._drain_flight()
+                    self._send(writer, self._slo_wire())
+                elif t == "profile":
+                    # On-demand device profiling of K kernel rounds.
+                    # Blocks this connection's loop while capturing —
+                    # an explicit, bounded operator action.
+                    self._send(writer, self._profile_wire(
+                        int(m.get("steps", 8 * STEPS_PER_TICK)
+                            or 8 * STEPS_PER_TICK),
+                        phases=bool(m.get("phases", False))))
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:

@@ -333,6 +333,14 @@ class TpuSerfPool:
             fut = getattr(self, "_flight_future", None)
             if fut is not None and not fut.done():
                 fut.set_result(m)
+        elif t == "slo":
+            fut = getattr(self, "_slo_future", None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
+        elif t == "profile":
+            fut = getattr(self, "_profile_future", None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
         elif t == "user":
             ltime = int(m.get("ltime", 0))
             self.event_ltime = max(self.event_ltime, ltime)
@@ -436,6 +444,42 @@ class TpuSerfPool:
             fut = self._flight_future = \
                 asyncio.get_event_loop().create_future()
             self._bridge.send({"t": "flight"})
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            return {}
+
+    async def plane_slo(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Detection-latency SLO observatory from the plane (the agent
+        side of /v1/agent/slo): burn-rate snapshot, exact latency
+        percentiles, cumulative histogram families.  Same shared-future
+        discipline as plane_stats."""
+        if self._bridge is None:
+            return {}
+        fut = getattr(self, "_slo_future", None)
+        if fut is None or fut.done():
+            fut = self._slo_future = \
+                asyncio.get_event_loop().create_future()
+            self._bridge.send({"t": "slo"})
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            return {}
+
+    async def plane_profile(self, steps: int = 32, phases: bool = False,
+                            timeout: float = 60.0) -> Dict[str, Any]:
+        """On-demand device profiling of ``steps`` kernel rounds on the
+        plane (the agent side of /v1/agent/profile).  The capture blocks
+        the plane-side connection loop, so the timeout is generous;
+        concurrent callers share one in-flight capture."""
+        if self._bridge is None:
+            return {}
+        fut = getattr(self, "_profile_future", None)
+        if fut is None or fut.done():
+            fut = self._profile_future = \
+                asyncio.get_event_loop().create_future()
+            self._bridge.send({"t": "profile", "steps": int(steps),
+                               "phases": bool(phases)})
         try:
             return await asyncio.wait_for(asyncio.shield(fut), timeout)
         except asyncio.TimeoutError:

@@ -101,13 +101,17 @@ class FlightRecorder:
         the number of new rows consumed."""
         cursor = int(cursor)
         ring_len = len(rows)
+        dropped = 0
         with self._lock:
             new = cursor - self._last_cursor
             if new <= 0 or ring_len == 0:
                 self._last_cursor = max(cursor, self._last_cursor)
                 return 0
             if new > ring_len:
-                self._overflowed += new - ring_len
+                # Rows overwritten before this drain: counted, never
+                # silently lost (consul.flight.dropped).
+                dropped = new - ring_len
+                self._overflowed += dropped
                 new = ring_len
             # Ring order: the kernel writes row i at slot i % R, so the
             # oldest retained row sits at slot (cursor - new) % R.
@@ -131,6 +135,9 @@ class FlightRecorder:
                 self._metrics.incr_counter(("consul", "flight", c), window[c])
         for c in _GAUGE_COLS:
             self._metrics.set_gauge(("consul", "flight", c), last[c])
+        if dropped:
+            self._metrics.incr_counter(("consul", "flight", "dropped"),
+                                       dropped)
         if self._overflowed:
             self._metrics.set_gauge(("consul", "flight", "overflowed"),
                                     self._overflowed)

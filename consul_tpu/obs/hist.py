@@ -1,0 +1,164 @@
+"""Detection-latency observatory — host side of the kernel histograms.
+
+The jitted gossip kernel (gossip/kernel.py, ``HistBank``) accumulates
+fixed-bucket integer histograms in HBM INSIDE the scan body — no host
+transfer per round:
+
+- ``detect``  — detection latency in rounds (``fail_round`` -> the dead
+  verdict firing), one-round-wide buckets,
+- ``dwell``   — suspicion dwell time (episode start -> verdict, dead OR
+  refuted),
+- ``refute``  — refutation latency (episode start -> refute applied),
+- ``spread``  — dissemination spread per rumor: members holding the
+  episode's verdict at slot GC, log2-bucketed.
+
+The banks are CUMULATIVE counters (never reset on device); the plane
+drains them on its flight cadence and hands them to ``HistRecorder``
+here, which keeps the latest cumulative view for Prometheus histogram
+exposition (obs/prom.py ``histograms=``) and returns per-drain deltas
+for the SLO burn-rate tracker (obs/slo.py).
+
+Bucket layouts (keep gossip/kernel.py in lockstep):
+
+- latency banks (``LATENCY_BUCKETS`` wide): bucket ``i`` holds
+  observations of exactly ``i`` rounds for ``i < LATENCY_BUCKETS - 1``;
+  the top bucket is the overflow (``>= LATENCY_BUCKETS - 1``).  One
+  round per bucket means the bank reconstructs the exact multiset below
+  the overflow — ``percentile()`` is bit-for-bit the crossval oracle's
+  ``pct`` on the same observations.
+- spread bank (``SPREAD_BUCKETS`` wide): bucket ``k`` holds rumors whose
+  holder count has bit_length ``k`` (``0``, then ``[2^(k-1), 2^k-1]``)
+  — integer shift-and-count on device, no float ops, so the sharded and
+  unsharded banks stay bit-identical.
+
+This module deliberately does NOT import jax: the agent process renders
+``/v1/agent/slo`` and the Prometheus histograms from bridge frames
+without a kernel context.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+LATENCY_BUCKETS = 256
+SPREAD_BUCKETS = 32
+
+# Bank name -> (metric name, help text).  Order = exposition order.
+BANK_METRICS = {
+    "detect": ("consul.swim.detection_latency_rounds",
+               "Rounds from a node's failure to its dead verdict firing."),
+    "dwell": ("consul.swim.suspicion_dwell_rounds",
+              "Rounds a suspicion episode stayed open before its verdict "
+              "(dead or refuted)."),
+    "refute": ("consul.swim.refutation_latency_rounds",
+               "Rounds from episode start to the subject's refutation."),
+    "spread": ("consul.swim.spread_members",
+               "Members holding an episode's verdict when its slot was "
+               "recycled (log2 buckets)."),
+}
+_LATENCY_BANKS = ("detect", "dwell", "refute")
+
+# Exposed `le` edges: powers of two for the one-round latency banks
+# (the fine 256-bucket bank collapses exactly onto them), bit_length
+# boundaries for the spread bank.  Each edge maps to the last fine
+# bucket it covers (le >= means cum = counts[:idx+1].sum()).
+_LATENCY_EDGES = [1, 2, 4, 8, 16, 32, 64, 128]
+_SPREAD_EDGES = [(str(2 ** k - 1), k) for k in range(1, SPREAD_BUCKETS)]
+
+
+def _edges(name: str) -> List[tuple]:
+    if name == "spread":
+        return [("0", 0)] + _SPREAD_EDGES
+    return [(str(e), e) for e in _LATENCY_EDGES]
+
+
+class HistRecorder:
+    """Host-side sink for drained histogram banks.
+
+    ``ingest(banks)`` takes a dict of bank name -> cumulative bucket
+    counts (any array-like of ints, straight off the device), stores
+    the latest cumulative view, and returns the per-drain deltas (new
+    observations since the previous drain) for the SLO tracker.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._banks: Dict[str, np.ndarray] = {}
+
+    # -- drain path ---------------------------------------------------------
+
+    def ingest(self, banks: Dict[str, Sequence[int]]) -> Dict[str, np.ndarray]:
+        deltas: Dict[str, np.ndarray] = {}
+        with self._lock:
+            for name, counts in banks.items():
+                cur = np.asarray(counts, dtype=np.int64)
+                prev = self._banks.get(name)
+                if prev is None or prev.shape != cur.shape:
+                    prev = np.zeros_like(cur)
+                deltas[name] = cur - prev
+                self._banks[name] = cur
+        return deltas
+
+    # -- read side ----------------------------------------------------------
+
+    def counts(self, name: str) -> np.ndarray:
+        with self._lock:
+            bank = self._banks.get(name)
+            return (np.array([], dtype=np.int64) if bank is None
+                    else bank.copy())
+
+    def percentile(self, name: str, q: float) -> Optional[float]:
+        """Exact percentile over the recorded multiset (one-round-wide
+        buckets; overflow-bucket observations count at the bucket floor).
+        Linear interpolation — identical to crossval's ``pct``."""
+        counts = self.counts(name)
+        total = int(counts.sum())
+        if total == 0:
+            return None
+        values = np.repeat(np.arange(counts.shape[0]), counts)
+        return float(np.percentile(values, q))
+
+    def families(self) -> List[Dict[str, Any]]:
+        """Prometheus histogram families over the cumulative banks.
+
+        ``sum`` is exact below the overflow bucket; overflow
+        observations contribute the bucket floor (a lower bound)."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            banks = {n: b.copy() for n, b in self._banks.items()}
+        for name, (metric, help_text) in BANK_METRICS.items():
+            counts = banks.get(name)
+            if counts is None:
+                continue
+            cum = np.cumsum(counts)
+            buckets = [(le, int(cum[min(idx, len(cum) - 1)]))
+                       for le, idx in _edges(name)]
+            if name == "spread":
+                # bit_length buckets: value floor of bucket k is 2^(k-1)
+                floors = np.concatenate(
+                    [[0], 2 ** np.arange(counts.shape[0] - 1)])
+                total_sum = int((counts * floors).sum())
+            else:
+                total_sum = int((counts * np.arange(counts.shape[0])).sum())
+            out.append({
+                "name": metric,
+                "help": help_text,
+                "buckets": buckets,
+                "sum": total_sum,
+                "count": int(counts.sum()),
+            })
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Latency percentiles for /v1/agent/slo (None until data)."""
+        s: Dict[str, Any] = {}
+        for name in _LATENCY_BANKS:
+            s[name] = {
+                "count": int(self.counts(name).sum()),
+                "p50_rounds": self.percentile(name, 50),
+                "p99_rounds": self.percentile(name, 99),
+            }
+        return s

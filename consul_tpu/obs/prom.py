@@ -4,18 +4,23 @@ Renders the ``utils.telemetry`` inmem snapshot (the same data
 ``/v1/agent/metrics`` serves as JSON) in the Prometheus text format
 (version 0.0.4): counters summed across retained intervals, gauges
 last-write-wins, timer samples as a summary pair (``_count``/``_sum``
-in seconds) plus ``_min``/``_max`` gauges.  Served by the agent at
+in seconds) plus ``_min``/``_max`` gauges.  Every family gets a
+``# HELP`` + ``# TYPE`` pair and label values are escaped per the
+format spec.  Served by the agent at
 ``/v1/agent/metrics?format=prometheus``.
 
 Flight-recorder series ride along automatically: the FlightRecorder
 folds drained kernel rows into the registry as ``consul.flight.*``,
-which render here as ``consul_flight_*``.
+which render here as ``consul_flight_*``.  The detection-latency
+observatory banks (obs/hist.py) render as CUMULATIVE histogram
+families via the ``histograms`` parameter
+(``consul_swim_detection_latency_rounds_bucket{le="..."}`` etc).
 """
 
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
@@ -30,6 +35,18 @@ def sanitize(name: str) -> str:
     return out
 
 
+def escape_label_value(v: Any) -> str:
+    """Escape a label value per the text format: backslash, double
+    quote, and newline."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _esc_help(v: Any) -> str:
+    """HELP text escaping: backslash and newline (quotes stay)."""
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt(v: float) -> str:
     f = float(v)
     if f == int(f) and abs(f) < 1e15:
@@ -37,9 +54,21 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
-def render_prometheus(snapshot: List[Dict[str, Any]]) -> str:
+def _family(lines: List[str], name: str, kind: str, help_text: str) -> None:
+    lines.append(f"# HELP {name} {_esc_help(help_text)}")
+    lines.append(f"# TYPE {name} {kind}")
+
+
+def render_prometheus(snapshot: List[Dict[str, Any]],
+                      histograms: Optional[List[Dict[str, Any]]] = None
+                      ) -> str:
     """Telemetry snapshot (list of interval dicts, oldest first) ->
-    Prometheus text format, one block per family with a TYPE line."""
+    Prometheus text format, one block per family with HELP/TYPE lines.
+
+    ``histograms``: optional list of cumulative histogram families
+    (obs.hist ``HistRecorder.families()`` shape: ``name``, ``help``,
+    ``buckets`` as ascending ``(le, cumulative_count)`` pairs, ``sum``,
+    ``count``); rendered with the mandatory ``+Inf`` bucket."""
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
     samples: Dict[str, Dict[str, float]] = {}
@@ -57,24 +86,48 @@ def render_prometheus(snapshot: List[Dict[str, Any]]) -> str:
             agg["min"] = min(agg["min"], float(s["min"]))
             agg["max"] = max(agg["max"], float(s["max"]))
     lines: List[str] = []
+    emitted: set = set()
     for k in sorted(counters):
         n = sanitize(k)
-        lines.append(f"# TYPE {n} counter")
+        if n in emitted:
+            continue
+        emitted.add(n)
+        _family(lines, n, "counter", f"Telemetry counter {k}.")
         lines.append(f"{n} {_fmt(counters[k])}")
     for k in sorted(gauges):
         n = sanitize(k)
-        lines.append(f"# TYPE {n} gauge")
+        # A name can land in the registry as BOTH counter and gauge
+        # when the gossip plane shares the agent's process (the plane's
+        # FlightRecorder counts consul.flight.* while the agent's
+        # scrape-time fold_summary mirrors the same names as gauges).
+        # One family per name: the counter wins, the mirror is dropped.
+        if n in emitted:
+            continue
+        emitted.add(n)
+        _family(lines, n, "gauge", f"Telemetry gauge {k}.")
         lines.append(f"{n} {_fmt(gauges[k])}")
     for k in sorted(samples):
         agg = samples[k]
         n = sanitize(k)
         # Timer samples are milliseconds in the registry; expose
         # base-unit seconds per Prometheus convention.
-        lines.append(f"# TYPE {n}_seconds summary")
+        _family(lines, f"{n}_seconds", "summary",
+                f"Telemetry timer {k} in seconds.")
         lines.append(f"{n}_seconds_count {_fmt(agg['count'])}")
         lines.append(f"{n}_seconds_sum {repr(agg['sum'] / 1000.0)}")
-        lines.append(f"# TYPE {n}_seconds_min gauge")
+        _family(lines, f"{n}_seconds_min", "gauge",
+                f"Minimum retained {k} sample in seconds.")
         lines.append(f"{n}_seconds_min {repr(agg['min'] / 1000.0)}")
-        lines.append(f"# TYPE {n}_seconds_max gauge")
+        _family(lines, f"{n}_seconds_max", "gauge",
+                f"Maximum retained {k} sample in seconds.")
         lines.append(f"{n}_seconds_max {repr(agg['max'] / 1000.0)}")
+    for fam in histograms or []:
+        n = sanitize(fam["name"])
+        _family(lines, n, "histogram", fam.get("help", ""))
+        for le, cum in fam.get("buckets", []):
+            lines.append(
+                f'{n}_bucket{{le="{escape_label_value(le)}"}} {_fmt(cum)}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {_fmt(fam["count"])}')
+        lines.append(f"{n}_sum {_fmt(fam['sum'])}")
+        lines.append(f"{n}_count {_fmt(fam['count'])}")
     return "\n".join(lines) + "\n" if lines else ""

@@ -1,0 +1,92 @@
+"""Rolling SLO burn-rate tracking over the detection-latency bank.
+
+The paper's headline acceptance gate — p99 failure-detection time
+within the Lifeguard bound — is an OFFLINE crossval check
+(gossip/crossval.py).  This module turns it into a live SLO: the plane
+feeds every drained ``detect``-bank delta (obs/hist.py) into a
+``SloTracker`` configured with an objective in rounds (default: the
+params' worst-case Lifeguard suspicion window), and the tracker keeps
+
+- cumulative attainment: fraction of ALL detections at or under the
+  objective,
+- windowed attainment over the last ``window`` non-empty drains,
+- the burn rate: ``(1 - windowed attainment) / (1 - target)`` — the
+  standard error-budget burn multiple (1.0 = burning exactly the
+  budget; > 1 = on track to violate the SLO).
+
+Served as ``/v1/agent/slo`` through the plane bridge; no jax imports
+here (the agent process renders it without a kernel context).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Optional, Sequence
+
+DEFAULT_WINDOW_DRAINS = 32
+
+
+class SloTracker:
+    """Attainment/burn-rate over per-drain detection-latency deltas.
+
+    ``objective_rounds``: detections at <= this latency (in rounds) are
+    within SLO.  ``attainment_target``: the objective's target fraction
+    (0.99 = "99% of detections within the bound").
+    """
+
+    def __init__(self, objective_rounds: int,
+                 attainment_target: float = 0.99,
+                 window: int = DEFAULT_WINDOW_DRAINS) -> None:
+        if objective_rounds < 0:
+            raise ValueError("objective_rounds must be >= 0")
+        if not 0.0 < attainment_target < 1.0:
+            raise ValueError("attainment_target must be in (0, 1)")
+        self.objective_rounds = int(objective_rounds)
+        self.attainment_target = float(attainment_target)
+        self._lock = threading.Lock()
+        # (n_total, n_within) per non-empty drain, newest last.
+        self._window: "deque[tuple]" = deque(maxlen=max(1, int(window)))
+        self._total = 0
+        self._within = 0
+
+    def observe(self, detect_delta: Sequence[int]) -> int:
+        """Fold one drained delta of the detect bank (per-bucket new
+        observation counts; bucket i = latency i rounds).  Returns the
+        number of new detections consumed."""
+        counts = [int(c) for c in detect_delta]
+        n = sum(counts)
+        if n <= 0:
+            return 0
+        cut = min(self.objective_rounds + 1, len(counts))
+        within = sum(counts[:cut])
+        with self._lock:
+            self._total += n
+            self._within += within
+            self._window.append((n, within))
+        return n
+
+    # -- read side ----------------------------------------------------------
+
+    def _attainment(self, total: int, within: int) -> Optional[float]:
+        return None if total == 0 else within / total
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            total, within = self._total, self._within
+            wt = sum(n for n, _ in self._window)
+            ww = sum(w for _, w in self._window)
+        att = self._attainment(total, within)
+        watt = self._attainment(wt, ww)
+        burn = 0.0
+        if watt is not None:
+            burn = (1.0 - watt) / (1.0 - self.attainment_target)
+        return {
+            "objective_rounds": self.objective_rounds,
+            "attainment_target": self.attainment_target,
+            "detections": total,
+            "attainment": att,
+            "window_detections": wt,
+            "window_attainment": watt,
+            "burn_rate": burn,
+        }

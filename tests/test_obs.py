@@ -247,7 +247,7 @@ def _validate_prom(text):
     """Strict-enough text-format validator: every line is a TYPE/HELP
     comment, a sample, or blank; every sample's metric name was
     declared by a preceding TYPE line (summaries declare their _count
-    / _sum children)."""
+    / _sum children, histograms additionally their _bucket series)."""
     declared = set()
     for line in text.splitlines():
         if not line.strip():
@@ -256,8 +256,10 @@ def _validate_prom(text):
             assert _TYPE_RE.match(line), f"bad TYPE line: {line!r}"
             name, kind = line.split()[2], line.split()[3]
             declared.add(name)
-            if kind == "summary":
+            if kind in ("summary", "histogram"):
                 declared.update({name + "_count", name + "_sum"})
+            if kind == "histogram":
+                declared.add(name + "_bucket")
             continue
         if line.startswith("#"):
             continue
@@ -265,6 +267,10 @@ def _validate_prom(text):
         mname = line.split("{")[0].split(" ")[0]
         assert mname in declared, f"undeclared metric: {mname}"
     assert text.endswith("\n")
+    # Double validation with the strict checker the obs-smoke gate runs
+    # (tools/check_prom.py): histogram ABI, label escapes, duplicates.
+    from tools.check_prom import check_text
+    assert check_text(text) == []
     return True
 
 
@@ -302,3 +308,220 @@ class TestPrometheus:
     def test_render_empty_snapshot(self):
         # an empty exposition body is valid (no families, no samples)
         assert render_prometheus([]) == ""
+
+    def test_escape_label_value(self):
+        from consul_tpu.obs.prom import escape_label_value
+        assert escape_label_value('a"b') == r'a\"b'
+        assert escape_label_value("a\\b") == r"a\\b"
+        assert escape_label_value("a\nb") == r"a\nb"
+
+    def test_help_lines_present_and_escaped(self):
+        m = Metrics()
+        m.incr_counter(("consul", "rpc", "query"), 1)
+        text = render_prometheus(m.snapshot())
+        assert "# HELP consul_rpc_query " in text
+        assert _validate_prom(text)
+
+    def test_counter_gauge_name_collision_dedupes(self):
+        """In-process plane + agent put consul.flight.* in the registry
+        as BOTH counters (FlightRecorder) and gauges (fold_summary
+        mirror); one family per name must survive, counter first."""
+        m = Metrics()
+        m.incr_counter(("consul", "flight", "probes"), 5)
+        m.set_gauge(("consul", "flight", "probes"), 5)
+        text = render_prometheus(m.snapshot())
+        assert text.count("# TYPE consul_flight_probes ") == 1
+        assert "# TYPE consul_flight_probes counter" in text
+        assert _validate_prom(text)
+
+    def test_histogram_families_render(self):
+        """Cumulative histogram exposition: ascending le buckets, the
+        mandatory +Inf bucket equal to _count, _sum, strict-checker
+        clean."""
+        from consul_tpu.obs.hist import LATENCY_BUCKETS, HistRecorder
+        import numpy as np
+        rec = HistRecorder()
+        detect = np.zeros(LATENCY_BUCKETS, np.int64)
+        detect[3] = 2
+        detect[70] = 1
+        rec.ingest({"detect": detect})
+        text = render_prometheus([], histograms=rec.families())
+        assert _validate_prom(text)
+        n = "consul_swim_detection_latency_rounds"
+        assert f"# TYPE {n} histogram" in text
+        assert f'{n}_bucket{{le="2"}} 0' in text
+        assert f'{n}_bucket{{le="4"}} 2' in text      # the two 3-round obs
+        assert f'{n}_bucket{{le="64"}} 2' in text
+        assert f'{n}_bucket{{le="128"}} 3' in text
+        assert f'{n}_bucket{{le="+Inf"}} 3' in text
+        assert f"{n}_sum {3 * 2 + 70}" in text
+        assert f"{n}_count 3" in text
+
+
+class TestHistRecorder:
+    def _bank(self, **at):
+        import numpy as np
+
+        from consul_tpu.obs.hist import LATENCY_BUCKETS
+        b = np.zeros(LATENCY_BUCKETS, np.int64)
+        for i, c in at.items():
+            b[int(i)] = c
+        return b
+
+    def test_ingest_returns_deltas(self):
+        from consul_tpu.obs.hist import HistRecorder
+        rec = HistRecorder()
+        d1 = rec.ingest({"detect": self._bank(**{"5": 2})})
+        assert d1["detect"][5] == 2
+        d2 = rec.ingest({"detect": self._bank(**{"5": 3, "9": 1})})
+        assert d2["detect"][5] == 1 and d2["detect"][9] == 1
+        assert rec.counts("detect")[5] == 3  # cumulative view kept
+
+    def test_percentile_matches_crossval_pct(self):
+        """The bank reconstructs the exact multiset below overflow, so
+        percentile() must equal numpy's percentile of the raw values —
+        the same ``pct`` the crossval oracle gates on."""
+        import numpy as np
+
+        from consul_tpu.obs.hist import HistRecorder
+        values = [3, 3, 7, 7, 7, 12, 40, 41, 90]
+        bank = self._bank()
+        for v in values:
+            bank[v] += 1
+        rec = HistRecorder()
+        rec.ingest({"detect": bank})
+        for q in (50, 90, 99):
+            assert rec.percentile("detect", q) == float(
+                np.percentile(np.asarray(values), q))
+        assert rec.percentile("dwell", 50) is None  # no data
+
+    def test_spread_family_log2_edges(self):
+        import numpy as np
+
+        from consul_tpu.obs.hist import SPREAD_BUCKETS, HistRecorder
+        bank = np.zeros(SPREAD_BUCKETS, np.int64)
+        bank[0] = 1   # 0 members
+        bank[3] = 2   # bit_length 3: 4..7 members
+        rec = HistRecorder()
+        rec.ingest({"spread": bank})
+        fam = [f for f in rec.families()
+               if f["name"] == "consul.swim.spread_members"][0]
+        by_le = dict(fam["buckets"])
+        assert by_le["0"] == 1
+        assert by_le["3"] == 1    # bit_length <= 2 -> only the zero
+        assert by_le["7"] == 3    # bit_length <= 3 includes both
+        assert fam["count"] == 3
+        assert fam["sum"] == 0 + 2 * 4  # floors: 0 and 2^(3-1)
+
+    def test_summary_shape(self):
+        from consul_tpu.obs.hist import HistRecorder
+        rec = HistRecorder()
+        rec.ingest({"detect": self._bank(**{"8": 4})})
+        s = rec.summary()
+        assert s["detect"] == {"count": 4, "p50_rounds": 8.0,
+                               "p99_rounds": 8.0}
+        assert s["refute"]["count"] == 0
+        assert s["refute"]["p99_rounds"] is None
+
+
+class TestSloTracker:
+    def test_attainment_and_burn(self):
+        from consul_tpu.obs.slo import SloTracker
+        t = SloTracker(objective_rounds=10, attainment_target=0.9)
+        # 8 within (buckets 0..10), 2 beyond
+        delta = [0] * 64
+        delta[5] = 4
+        delta[10] = 4
+        delta[30] = 2
+        assert t.observe(delta) == 10
+        s = t.snapshot()
+        assert s["detections"] == 10
+        assert s["attainment"] == 0.8
+        assert s["window_attainment"] == 0.8
+        assert s["burn_rate"] == pytest.approx((1 - 0.8) / (1 - 0.9))
+
+    def test_empty_snapshot_and_validation(self):
+        from consul_tpu.obs.slo import SloTracker
+        t = SloTracker(objective_rounds=5)
+        assert t.observe([0] * 8) == 0          # empty drain: no entry
+        s = t.snapshot()
+        assert s["attainment"] is None
+        assert s["burn_rate"] == 0.0
+        with pytest.raises(ValueError):
+            SloTracker(objective_rounds=-1)
+        with pytest.raises(ValueError):
+            SloTracker(objective_rounds=1, attainment_target=1.0)
+
+    def test_window_rolls(self):
+        from consul_tpu.obs.slo import SloTracker
+        t = SloTracker(objective_rounds=0, window=2)
+        bad = [0, 5]     # all beyond a 0-round objective... bucket 1 = 1 round
+        good = [5, 0]    # all within (bucket 0)
+        t.observe(bad)
+        t.observe(good)
+        t.observe(good)  # window now holds the two good drains only
+        s = t.snapshot()
+        assert s["window_attainment"] == 1.0
+        assert s["attainment"] == pytest.approx(10 / 15)
+
+
+class TestKernelHist:
+    """CPU execution of the jitted round with the observatory enabled."""
+
+    def test_hist_does_not_change_dynamics(self):
+        """Bit-identical SwimState with and without the banks: the
+        observation block reads verdict-round state, never writes it."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from consul_tpu.gossip.kernel import (
+            NEVER, init_hist, init_state, run_rounds)
+        from consul_tpu.gossip.params import SwimParams
+
+        p = SwimParams(n=64, slots=16)
+        key = jax.random.PRNGKey(0)
+        fail = jnp.full((p.n,), int(NEVER), jnp.int32).at[7].set(3)
+        base, _ = run_rounds(init_state(p), key, fail, p, steps=100)
+        (with_h, hb), _ = run_rounds(init_state(p), key, fail, p,
+                                     steps=100, hist=init_hist())
+        for name in base._fields:
+            assert np.array_equal(np.asarray(getattr(base, name)),
+                                  np.asarray(getattr(with_h, name))), name
+        assert int(np.asarray(hb.detect).sum()) == 1
+        assert int(np.asarray(hb.dwell).sum()) == 1
+
+    def test_detect_bank_matches_crossval_oracle(self):
+        """ISSUE 4 acceptance core: percentiles computed from the
+        in-kernel detect bank equal the crossval oracle's ``pct`` over
+        the SAME run's trace-derived latencies, exactly."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from consul_tpu.gossip.crossval import kernel_event_latencies
+        from consul_tpu.gossip.kernel import (
+            NEVER, init_hist, init_state, run_rounds)
+        from consul_tpu.gossip.params import lan_profile
+        from consul_tpu.obs.hist import HistRecorder
+        import jax
+
+        p = lan_profile(512, slots=16)
+        steps, seed = 300, 5
+        fail_at = {int(i * 37 % 512): 10 + 20 * i for i in range(6)}
+        fail = np.full(p.n, int(NEVER), np.int32)
+        for v, t in fail_at.items():
+            fail[v] = t
+        # crossval derives latencies from the round trace of its own
+        # run; replicate that run exactly (same key construction) with
+        # the banks threaded through.
+        (st, hb), _ = run_rounds(init_state(p), jax.random.key(seed),
+                                 jnp.asarray(fail), p, steps,
+                                 hist=init_hist())
+        lats, _, _, _ = kernel_event_latencies(p, fail_at, steps, seed)
+        rec = HistRecorder()
+        rec.ingest({"detect": np.asarray(hb.detect)})
+        assert len(lats) == len(fail_at)
+        assert int(rec.counts("detect").sum()) == len(lats)
+        a = np.asarray(lats)
+        for q in (50, 90, 99):
+            assert rec.percentile("detect", q) == float(np.percentile(a, q))

@@ -34,12 +34,14 @@ def _fail_join(jnp, n):
 
 
 def _run_both(n, steps, *, slots=8, hot_slots=0, loss_rate=0.0,
-              pushpull_every=0, flight_rounds=0, trace=False, ndev=8):
+              pushpull_every=0, flight_rounds=0, trace=False, hist=False,
+              ndev=8):
     import jax
     import jax.numpy as jnp
 
     from consul_tpu.gossip.kernel import (
-        init_flight, init_state, run_rounds, run_rounds_sharded, shard_state)
+        init_flight, init_hist, init_state, run_rounds, run_rounds_sharded,
+        shard_state)
     from consul_tpu.gossip.params import lan_profile
 
     p = lan_profile(n, slots=slots, hot_slots=hot_slots,
@@ -49,12 +51,20 @@ def _run_both(n, steps, *, slots=8, hot_slots=0, loss_rate=0.0,
 
     ref = run_rounds(init_state(p), key, fail, p, steps=steps, trace=trace,
                      join_round=join,
-                     flight=init_flight(64) if flight_rounds else None)
+                     flight=init_flight(64) if flight_rounds else None,
+                     hist=init_hist() if hist else None)
     out = run_rounds_sharded(
         shard_state(init_state(p), ndev), key, fail, p, steps=steps,
         trace=trace, join_round=join,
-        flight=init_flight(64) if flight_rounds else None, ndev=ndev)
+        flight=init_flight(64) if flight_rounds else None,
+        hist=init_hist() if hist else None, ndev=ndev)
     return ref, out, p
+
+
+def _assert_hist_equal(a, b, ctx=""):
+    for f in a._fields:
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert np.array_equal(x, y), f"{ctx}HistBank.{f} diverged"
 
 
 class TestShardedParity:
@@ -80,6 +90,52 @@ class TestShardedParity:
         ref_st, ref_fl = refc
         out_st, out_fl = outc
         _assert_state_equal(ref_st, out_st)
+        for f in ref_fl._fields:
+            assert np.array_equal(np.asarray(getattr(ref_fl, f)),
+                                  np.asarray(getattr(out_fl, f))), \
+                f"FlightRing.{f} diverged"
+        for f in rtr._fields:
+            assert np.array_equal(np.asarray(getattr(rtr, f)),
+                                  np.asarray(getattr(otr, f))), \
+                f"RoundTrace.{f} diverged"
+
+    def test_hist_bank_parity_failures_joins(self):
+        """Observatory acceptance (ISSUE 4): the histogram banks the
+        sharded kernel accumulates — detection latency, suspicion
+        dwell, refutation latency, dissemination spread — must equal
+        the unsharded kernel's bit-for-bit.  Every on-device merge is a
+        psum of disjoint integer contributions; the spread bucketing is
+        integer shift-and-count, so there is no float path to drift."""
+        (ref, _), (out, _) = _run_both(640, 400, hist=True)[:2]
+        ref_st, ref_hb = ref
+        out_st, out_hb = out
+        _assert_state_equal(ref_st, out_st)
+        _assert_hist_equal(ref_hb, out_hb)
+        # Not vacuous: the regime has 5 failures, so the detect bank
+        # carries observations and the spread bank saw recycled slots.
+        assert int(np.asarray(ref_hb.detect).sum()) >= 5
+        assert int(np.asarray(ref_hb.spread).sum()) > 0
+
+    def test_hist_bank_parity_loss_pushpull_hot(self):
+        """Banks stay bit-identical through the branchy regimes too:
+        iid packet loss, push-pull anti-entropy, the hot tail."""
+        (ref, _), (out, _) = _run_both(
+            640, 400, hot_slots=4, loss_rate=0.02, pushpull_every=50,
+            hist=True)[:2]
+        _assert_state_equal(ref[0], out[0])
+        _assert_hist_equal(ref[1], out[1])
+        assert int(np.asarray(ref[1].detect).sum()) > 0
+
+    def test_hist_flight_trace_triple_carry(self):
+        """All three observability carriers at once — (state, flight,
+        hist) + trace — keep parity; this is exactly the plane's
+        dispatch shape."""
+        (refc, rtr), (outc, otr) = _run_both(
+            640, 200, trace=True, flight_rounds=64, hist=True)[:2]
+        ref_st, ref_fl, ref_hb = refc
+        out_st, out_fl, out_hb = outc
+        _assert_state_equal(ref_st, out_st)
+        _assert_hist_equal(ref_hb, out_hb)
         for f in ref_fl._fields:
             assert np.array_equal(np.asarray(getattr(ref_fl, f)),
                                   np.asarray(getattr(out_fl, f))), \
@@ -165,6 +221,40 @@ class TestShardedParity:
         _assert_state_equal(a.lan, b.lan, "lan ")
         _assert_state_equal(a.wan, b.wan, "wan ")
         assert np.array_equal(np.asarray(cov_a), np.asarray(cov_b))
+
+    def test_multidc_hist_parity(self):
+        """Per-DC observatory banks through the DC x shard composition:
+        lan_devices=8 banks equal the single-device banks bit-for-bit,
+        and threading them does not perturb the dynamics."""
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.multidc import (
+            init_multidc, init_multidc_hist, make_params,
+            run_multidc_rounds)
+
+        D, nl = 2, 320
+        p0 = make_params(D, nl, slots=8)
+        p8 = make_params(D, nl, slots=8, lan_devices=8)
+        key = jax.random.PRNGKey(3)
+        NEVER = 2**31 - 1
+        lan_fail = jnp.full((D, nl), NEVER, jnp.int32
+                            ).at[0, 3].set(5).at[1, 7].set(9)
+        wan_fail = jnp.full((D * 3,), NEVER, jnp.int32)
+        (a, ha), _ = run_multidc_rounds(
+            init_multidc(p0), key, lan_fail, wan_fail, p0, 120,
+            lan_hist=init_multidc_hist(p0))
+        (b, hb), _ = run_multidc_rounds(
+            init_multidc(p8), key, lan_fail, wan_fail, p8, 120,
+            lan_hist=init_multidc_hist(p8))
+        _assert_state_equal(a.lan, b.lan, "lan ")
+        _assert_hist_equal(ha, hb, "multidc ")
+        # one failure per DC in-window: each DC's detect bank counts it
+        assert np.asarray(ha.detect).sum(axis=1).tolist() == [1, 1]
+        # no-hist run is bit-identical: banks are observers, not actors
+        c, _ = run_multidc_rounds(
+            init_multidc(p0), key, lan_fail, wan_fail, p0, 120)
+        _assert_state_equal(a.lan, c.lan, "hist-on vs off lan ")
 
 
 @pytest.mark.slow

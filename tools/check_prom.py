@@ -1,0 +1,237 @@
+"""Strict Prometheus text-format (0.0.4) line checker.
+
+Validates a scrape of ``/v1/agent/metrics?format=prometheus`` the way a
+strict ingester would, so exposition drift (obs/prom.py) fails `make
+obs-smoke` instead of a dashboard three deploys later:
+
+- every line is a ``# HELP``, a ``# TYPE``, a sample, or blank;
+- metric and label names match the spec grammar; label values use only
+  the three legal escapes (``\\``, ``\"``, ``\n``);
+- every sample belongs to a family with a declared TYPE, declared
+  BEFORE the first sample, at most once;
+- HELP (when present) is declared at most once, before the samples;
+- sample values parse as Go-style floats (incl. ``+Inf``/``-Inf``/
+  ``NaN``); optional timestamps are integers;
+- no duplicate (name, labelset) sample;
+- summary children are limited to ``_sum``/``_count`` (+ quantile'd
+  base series), histogram children to ``_bucket``/``_sum``/``_count``;
+- histogram buckets carry ``le``, appear in ascending ``le`` order
+  with non-decreasing cumulative counts, include the mandatory
+  ``+Inf`` bucket, and ``+Inf`` == ``_count``.
+
+Run: python -m tools.check_prom [file] [--require NAME ...]
+(reads stdin without a file; --require asserts at least one sample of
+that exact metric name exists — obs_smoke pins the observatory
+families with it).  Exit 0 clean, 1 findings.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(\{{(.*)\}})? ([^ ]+)( -?\d+)?$")
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+_VALUE_RE = re.compile(
+    r"^([+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?|[+-]?Inf|NaN)$")
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_SUMMARY_SUFFIXES = ("_sum", "_count")
+
+
+def _family_of(name: str, types: Dict[str, str]) -> Optional[str]:
+    """Resolve a sample name to its declared family (histogram/summary
+    children strip their suffix; exact match wins)."""
+    if name in types:
+        return name
+    for suf in _HIST_SUFFIXES:
+        if name.endswith(suf):
+            base = name[: -len(suf)]
+            if types.get(base) in ("histogram", "summary"):
+                return base
+    return None
+
+
+def _parse_labels(raw: str, lineno: int,
+                  errors: List[str]) -> Optional[List[Tuple[str, str]]]:
+    """Strict label-body parse: comma-separated name="value" pairs,
+    one optional trailing comma (per the format grammar)."""
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            errors.append(f"line {lineno}: bad label syntax at "
+                          f"{raw[pos:pos + 20]!r}")
+            return None
+        out.append((m.group(1), m.group(2)))
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                errors.append(f"line {lineno}: expected ',' between labels")
+                return None
+            pos += 1
+    return out
+
+
+def _float(v: str) -> float:
+    return float(v.replace("Inf", "inf").replace("NaN", "nan"))
+
+
+def check_text(text: str) -> List[str]:
+    """Validate a full exposition; returns a list of findings (empty =
+    clean)."""
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Dict[str, int] = {}
+    sampled: set = set()          # families that have emitted a sample
+    seen_series: set = set()      # (name, labelset) duplicates
+    # histogram bookkeeping per family
+    hist_buckets: Dict[str, List[Tuple[float, float]]] = {}
+    hist_count: Dict[str, float] = {}
+
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if line == "":
+            continue
+        if line != line.strip():
+            errors.append(f"line {lineno}: leading/trailing whitespace")
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line)
+            if m is not None:
+                fam = m.group(1)
+                if fam in helps:
+                    errors.append(f"line {lineno}: duplicate HELP for {fam}")
+                if fam in sampled:
+                    errors.append(
+                        f"line {lineno}: HELP for {fam} after its samples")
+                helps[fam] = lineno
+                continue
+            m = _TYPE_RE.match(line)
+            if m is not None:
+                fam = m.group(1)
+                if fam in types:
+                    errors.append(f"line {lineno}: duplicate TYPE for {fam}")
+                if fam in sampled:
+                    errors.append(
+                        f"line {lineno}: TYPE for {fam} after its samples")
+                types[fam] = m.group(2)
+                continue
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                errors.append(f"line {lineno}: malformed HELP/TYPE line")
+            continue  # other comments are legal and ignored
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name, _, label_raw, value, _ts = m.groups()
+        if not _VALUE_RE.match(value):
+            errors.append(f"line {lineno}: bad value {value!r}")
+            continue
+        labels = _parse_labels(label_raw or "", lineno, errors)
+        if labels is None:
+            continue
+        series = (name, tuple(sorted(labels)))
+        if series in seen_series:
+            errors.append(f"line {lineno}: duplicate series {name}"
+                          f"{dict(labels)}")
+        seen_series.add(series)
+        fam = _family_of(name, types)
+        if fam is None:
+            errors.append(
+                f"line {lineno}: sample {name} has no TYPE declaration")
+            continue
+        sampled.add(fam)
+        kind = types[fam]
+        child = name[len(fam):]
+        if kind == "histogram":
+            if child not in ("",) + _HIST_SUFFIXES or child == "":
+                # base-name samples are not part of the histogram ABI
+                errors.append(f"line {lineno}: {name} is not a valid "
+                              f"histogram child of {fam}")
+                continue
+            if child == "_bucket":
+                le = dict(labels).get("le")
+                if le is None:
+                    errors.append(
+                        f"line {lineno}: {name} bucket missing le label")
+                    continue
+                if not _VALUE_RE.match(le):
+                    errors.append(f"line {lineno}: bad le value {le!r}")
+                    continue
+                hist_buckets.setdefault(fam, []).append(
+                    (_float(le), _float(value)))
+            elif child == "_count":
+                hist_count[fam] = _float(value)
+        elif kind == "summary":
+            if child not in ("",) + _SUMMARY_SUFFIXES:
+                errors.append(f"line {lineno}: {name} is not a valid "
+                              f"summary child of {fam}")
+        elif child != "":
+            errors.append(f"line {lineno}: {name} sampled under {kind} "
+                          f"family {fam}")
+
+    for fam, kind in types.items():
+        if fam not in sampled:
+            errors.append(f"family {fam}: TYPE declared but no samples")
+    for fam in [f for f, k in types.items() if k == "histogram"
+                and f in sampled]:
+        buckets = hist_buckets.get(fam, [])
+        if not buckets:
+            errors.append(f"histogram {fam}: no _bucket samples")
+            continue
+        les = [le for le, _ in buckets]
+        if les != sorted(les):
+            errors.append(f"histogram {fam}: le edges not ascending")
+        if sorted(set(les)) != sorted(les):
+            errors.append(f"histogram {fam}: duplicate le edges")
+        cums = [c for _, c in buckets]
+        if any(b < a for a, b in zip(cums, cums[1:])):
+            errors.append(f"histogram {fam}: cumulative counts decrease")
+        if les[-1] != float("inf"):
+            errors.append(f"histogram {fam}: missing +Inf bucket")
+        elif fam not in hist_count:
+            errors.append(f"histogram {fam}: missing _count")
+        elif cums[-1] != hist_count[fam]:
+            errors.append(f"histogram {fam}: +Inf bucket {cums[-1]} != "
+                          f"_count {hist_count[fam]}")
+    return errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("file", nargs="?", help="exposition file (default stdin)")
+    ap.add_argument("--require", action="append", default=[],
+                    metavar="NAME",
+                    help="fail unless a sample with this exact metric "
+                         "name exists (repeatable)")
+    args = ap.parse_args(argv)
+    if args.file:
+        with open(args.file, "r", encoding="utf-8") as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    errors = check_text(text)
+    names = {m.group(1) for m in
+             (_SAMPLE_RE.match(ln) for ln in text.split("\n"))
+             if m is not None}
+    for want in args.require:
+        if want not in names:
+            errors.append(f"required metric {want} not found")
+    for e in errors:
+        print(f"check_prom: {e}", file=sys.stderr)
+    if not errors:
+        print(f"check_prom: ok ({len(names)} series names)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Observability smoke: boot a small CPU gossip plane + one
+kernel-backed agent, scrape ``/v1/agent/metrics?format=prometheus``,
+and validate every line with tools/check_prom's strict checker —
+including the detection-latency observatory's histogram families —
+then sanity-check the ``/v1/agent/slo`` JSON shell.
+
+This is the `make obs-smoke` gate: it catches exposition drift
+(obs/prom.py), bridge-frame drift (plane ``slo`` frame ->
+tpu_backend.plane_slo -> agent route), and plane wiring regressions
+in one boot.  Runs entirely on CPU (JAX_PLATFORMS=cpu) in one process.
+
+Run: python -m tools.obs_smoke
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Families the scrape MUST carry for the observatory to count as wired.
+REQUIRED = [
+    "consul_swim_detection_latency_rounds_bucket",
+    "consul_swim_suspicion_dwell_rounds_bucket",
+    "consul_swim_refutation_latency_rounds_bucket",
+    "consul_swim_spread_members_bucket",
+    "consul_flight_round",
+]
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=15) as r:
+        return r.read()
+
+
+async def main() -> int:
+    from consul_tpu.agent.agent import Agent, AgentConfig
+    from consul_tpu.consensus.raft import RaftConfig
+    from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+    from tools.check_prom import _SAMPLE_RE, check_text
+
+    plane = GossipPlane(PlaneConfig(
+        bind_port=0, capacity=16, slots=16, gossip_interval_s=0.02,
+        suspicion_mult=1.0, hb_lapse_s=0.3))
+    print("[obs-smoke] starting plane (first boot compiles the kernel)...",
+          flush=True)
+    await plane.start()
+    agent = None
+    try:
+        agent = Agent(AgentConfig(
+            node_name="obs-smoke", datacenter="dc1", server=True,
+            bootstrap=True, rpc_mesh_port=0, http_port=0, dns_port=0,
+            serf_wan_port=0, enable_debug=True,
+            raft_config=RaftConfig(
+                heartbeat_interval=0.03, election_timeout_min=0.06,
+                election_timeout_max=0.12, rpc_timeout=0.5),
+            gossip_backend="tpu",
+            gossip_plane="127.0.0.1:%d" % plane.local_addr[1]))
+        await agent.start()
+        # Let a few kernel dispatches land so the flight ring and the
+        # observatory banks have content behind the scrape.
+        await asyncio.sleep(1.0)
+        host, port = agent.http.addr
+        base = f"http://{host}:{port}"
+
+        text = (await asyncio.to_thread(
+            _get, f"{base}/v1/agent/metrics?format=prometheus")).decode()
+        errors = check_text(text)
+        names = {m.group(1) for m in
+                 (_SAMPLE_RE.match(ln) for ln in text.split("\n"))
+                 if m is not None}
+        for want in REQUIRED:
+            if want not in names:
+                errors.append(f"required metric {want} not in scrape")
+
+        slo = json.loads(await asyncio.to_thread(_get, f"{base}/v1/agent/slo"))
+        for key in ("slo", "latency", "hists"):
+            if key not in slo:
+                errors.append(f"/v1/agent/slo missing key {key!r}")
+        snap = slo.get("slo") or {}
+        for key in ("objective_rounds", "attainment_target", "burn_rate"):
+            if key not in snap:
+                errors.append(f"/v1/agent/slo slo snapshot missing {key!r}")
+
+        for e in errors:
+            print(f"[obs-smoke] FAIL: {e}", file=sys.stderr)
+        if errors:
+            return 1
+        print(f"[obs-smoke] ok: {len(names)} series names, "
+              f"{len(text.splitlines())} lines, slo objective "
+              f"{snap.get('objective_rounds')} rounds")
+        return 0
+    finally:
+        if agent is not None:
+            await agent.stop()
+        await plane.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
