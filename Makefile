@@ -17,7 +17,7 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet obs-smoke bench dryrun clean
+.PHONY: default ci test integ vet vet-fast obs-smoke bench dryrun clean
 
 default: test
 
@@ -33,17 +33,23 @@ test: vet
 integ:
 	$(PYTEST) tests/test_blackbox.py tests/test_linearizability.py
 
-# Static checks: byte-compile every source file, then the six-pass
+# Static checks: byte-compile every source file, then the ten-pass
 # analyzer (tools/vet/: names, async-safety, JAX tracer-purity,
-# wire-schema drift, exception hygiene — the `go vet` role in an image
-# without a Python linter).  Exit codes: 0 clean, 1 findings, 2 parse
-# error.  Suppress per line with `# noqa: CODE` or per finding in
-# tools/vet/baseline.txt.
+# wire-schema drift, exception hygiene, donation safety,
+# shard-exactness, carry-contract, overflow — the `go vet` role in an
+# image without a Python linter).  Exit codes: 0 clean, 1 findings, 2
+# parse error.  Suppress per line with `# noqa: CODE[,CODE]` or per
+# finding in tools/vet/baseline.txt.  `vet` writes the machine-readable
+# vet_report.json CI artifact; `vet-fast` skips the flow-sensitive JAX
+# passes for the inner loop.
 VET_PATHS = consul_tpu tests tools demo bench.py __graft_entry__.py
 vet:
 	$(PYTHON) -m compileall -q $(VET_PATHS)
-	$(PYTHON) -m tools.vet $(VET_PATHS)
+	$(PYTHON) -m tools.vet $(VET_PATHS) --report vet_report.json
 	$(MAKE) obs-smoke
+
+vet-fast:
+	$(PYTHON) -m tools.vet $(VET_PATHS) --fast
 
 # Observability gate: boot a small CPU plane + one kernel-backed agent,
 # scrape /v1/agent/metrics?format=prometheus, and hold every line to
@@ -65,3 +71,4 @@ dryrun:
 clean:
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 	rm -rf .jax_cache
+	rm -f vet_report.json

@@ -148,7 +148,7 @@ def event_round(state: EventState, base_key: jax.Array, alive: jnp.ndarray,
                      | jnp.minimum(age + 1, _AGE_MASK).astype(jnp.uint8),
                      cur)
     has = jnp.where(fresh, jnp.uint8(_SEEN), aged)
-    n_seen = state.n_seen + jnp.sum(fresh, axis=1, dtype=jnp.int32)
+    n_seen = state.n_seen + jnp.sum(fresh, axis=1, dtype=jnp.int32)  # noqa: O01 — monotone mod 2**32 (SwimState wrap convention, gossip/kernel.py); consumers take i32 deltas
 
     # lamport witness: clock = max(clock, max ltime of newly seen events)+1
     # (Serf witnessedClock). One max over slots is enough per round.

@@ -14,9 +14,19 @@ transfer per round:
 
 The banks are CUMULATIVE counters (never reset on device); the plane
 drains them on its flight cadence and hands them to ``HistRecorder``
-here, which keeps the latest cumulative view for Prometheus histogram
+here, which keeps the true cumulative view for Prometheus histogram
 exposition (obs/prom.py ``histograms=``) and returns per-drain deltas
 for the SLO burn-rate tracker (obs/slo.py).
+
+The device banks are **int32 and wrap** (JAX x64 stays off — see the
+SwimState wrap convention in gossip/kernel.py): at paper scale a hot
+bucket passes 2**31 well inside a long run.  The drain is therefore
+wrap-aware: deltas are computed modulo 2**32 on the raw 32-bit view
+(exact as long as one drain interval adds < 2**31 per bucket — hours
+of observations vs a sub-second drain cadence), and the recorder
+accumulates them into host-side int64 banks, which never wrap.  All
+read paths (percentiles, families, summary) use the int64 view, so a
+device wrap is invisible downstream.
 
 Bucket layouts (keep gossip/kernel.py in lockstep):
 
@@ -79,14 +89,23 @@ class HistRecorder:
     """Host-side sink for drained histogram banks.
 
     ``ingest(banks)`` takes a dict of bank name -> cumulative bucket
-    counts (any array-like of ints, straight off the device), stores
-    the latest cumulative view, and returns the per-drain deltas (new
+    counts (any array-like of ints, straight off the device), computes
+    the per-drain deltas modulo 2**32 (the device banks are int32 and
+    wrap — module docstring), folds them into a host-side int64
+    cumulative view that never wraps, and returns the deltas (new
     observations since the previous drain) for the SLO tracker.
+
+    A shape change (bank layout reconfigured) resets that bank's
+    history; a recorder must otherwise live exactly as long as the
+    device banks it drains (the plane creates both together).
     """
+
+    _WRAP = np.int64(2) ** 32
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._banks: Dict[str, np.ndarray] = {}
+        self._banks: Dict[str, np.ndarray] = {}   # true cumulative, i64
+        self._raw: Dict[str, np.ndarray] = {}     # last device view, u32
 
     # -- drain path ---------------------------------------------------------
 
@@ -94,12 +113,18 @@ class HistRecorder:
         deltas: Dict[str, np.ndarray] = {}
         with self._lock:
             for name, counts in banks.items():
-                cur = np.asarray(counts, dtype=np.int64)
-                prev = self._banks.get(name)
+                # reduce the device view to its 32 low bits so int32
+                # (possibly negative after a wrap) and uint32 inputs
+                # difference identically
+                cur = np.asarray(counts, dtype=np.int64) & (self._WRAP - 1)
+                prev = self._raw.get(name)
                 if prev is None or prev.shape != cur.shape:
                     prev = np.zeros_like(cur)
-                deltas[name] = cur - prev
-                self._banks[name] = cur
+                    self._banks[name] = np.zeros_like(cur)
+                delta = (cur - prev) % self._WRAP
+                deltas[name] = delta
+                self._raw[name] = cur
+                self._banks[name] = self._banks[name] + delta
         return deltas
 
     # -- read side ----------------------------------------------------------
@@ -113,13 +138,23 @@ class HistRecorder:
     def percentile(self, name: str, q: float) -> Optional[float]:
         """Exact percentile over the recorded multiset (one-round-wide
         buckets; overflow-bucket observations count at the bucket floor).
-        Linear interpolation — identical to crossval's ``pct``."""
+        Linear interpolation — identical to crossval's ``pct``, computed
+        from cumulative counts without materializing the multiset (the
+        wrap-aware banks legitimately exceed 2**31 observations)."""
         counts = self.counts(name)
         total = int(counts.sum())
         if total == 0:
             return None
-        values = np.repeat(np.arange(counts.shape[0]), counts)
-        return float(np.percentile(values, q))
+        cum = np.cumsum(counts)
+        # np.percentile 'linear': rank q/100*(n-1) = k + f; the value at
+        # sorted index i is the first bucket whose cumulative count
+        # exceeds i
+        rank = (q / 100.0) * (total - 1)
+        lo_i = int(np.floor(rank))
+        hi_i = int(np.ceil(rank))
+        lo = int(np.searchsorted(cum, lo_i, side="right"))
+        hi = int(np.searchsorted(cum, hi_i, side="right"))
+        return float(lo + (hi - lo) * (rank - lo_i))
 
     def families(self) -> List[Dict[str, Any]]:
         """Prometheus histogram families over the cumulative banks.

@@ -163,7 +163,8 @@ class TestShardedParity:
         out = swim_round_sharded(donated, key, fail, p)
         _assert_state_equal(ref, out)
         with pytest.raises(RuntimeError):
-            np.asarray(donated.heard)  # donated buffer must be gone
+            # the use-after-donate IS the assertion here (vet D01)
+            np.asarray(donated.heard)  # noqa: D01 — deliberate read of a deleted buffer to prove donation happened
 
     def test_alignment_rejected(self):
         """n not divisible by ndev or probe_every is a loud ValueError,

@@ -1,16 +1,21 @@
-"""tools/vet — the six-pass static analyzer.
+"""tools/vet — the ten-pass static analyzer.
 
 Each pass gets one known-bad snippet (the planted defect it must
 catch) and one clean snippet (the idiomatic fix it must NOT flag),
 plus the suppression machinery (``# noqa: CODE``, blanket ``# noqa``,
-baseline) and the exit-code contract.  The meta-test at the bottom
+baseline), the exit-code contract, and the ``--format json`` /
+``--report`` / ``--fast`` CI surface.  The meta-test at the bottom
 holds the analyzer to its own standard.
 """
 
+import json
 import textwrap
 from pathlib import Path
 
-from tools.vet import async_safety, exceptions, names, tracer_purity
+import pytest
+
+from tools.vet import async_safety, carry_contract, donation, exceptions
+from tools.vet import names, overflow, shard_exact, tracer_purity
 from tools.vet import wire_schema
 from tools.vet.core import FileCtx, parse_noqa
 from tools.vet.driver import main as vet_main
@@ -453,6 +458,456 @@ class TestExceptionHygiene:
         assert _codes(exceptions.check(ctx)) == ["E02"]
 
 
+# -- donation ----------------------------------------------------------------
+
+# indented to match the test-body snippets: _ctx dedents the
+# concatenation in one piece
+_DONATING_STEP = """\
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnames=("state",))
+            def step(state, key):
+                return state + key
+
+"""
+
+
+class TestDonation:
+    def test_d01_use_after_donate(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _DONATING_STEP + """\
+            def drive(state, key):
+                out = step(state, key)
+                return state + out
+            """)
+        found = donation.check_project([ctx])
+        assert _codes(found) == ["D01"]
+        assert "'state'" in found[0].message
+
+    def test_d01_rebind_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _DONATING_STEP + """\
+            def drive(state, key):
+                state = step(state, key)
+                return state
+            """)
+        assert donation.check_project([ctx]) == []
+
+    def test_d01_loop_carried(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _DONATING_STEP + """\
+            def drive(state, keys):
+                for k in keys:
+                    step(state, k)
+            """)
+        found = donation.check_project([ctx])
+        assert _codes(found) == ["D01"]
+        assert "loop" in found[0].message
+
+    def test_d01_block_until_ready_observe_clean(self, tmp_path):
+        # the deliberate observe-deletion idiom (test_shard_map_parity)
+        ctx = _ctx(tmp_path, "m.py", _DONATING_STEP + """\
+            def drive(state, key):
+                out = step(state, key)
+                jax.block_until_ready(state)
+                return out
+            """)
+        assert donation.check_project([ctx]) == []
+
+    def test_d01_traced_caller_exempt(self, tmp_path):
+        # an inner donating jit inlines under the outer trace — nothing
+        # is consumed at trace time (tools/profile_kernel.py relies on
+        # this)
+        ctx = _ctx(tmp_path, "m.py", _DONATING_STEP + """\
+            @jax.jit
+            def outer(state, key):
+                s1 = step(state, key)
+                s2 = step(state, key)
+                return s1 + s2
+            """)
+        assert donation.check_project([ctx]) == []
+
+    def test_d02_donated_attribute(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _DONATING_STEP + """\
+            class Plane:
+                def tick(self, key):
+                    step(self._state, key)
+            """)
+        found = donation.check_project([ctx])
+        assert _codes(found) == ["D02"]
+        assert "self._state" in found[0].message
+
+    def test_d02_attribute_rebind_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _DONATING_STEP + """\
+            class Plane:
+                def tick(self, key):
+                    self._state = step(self._state, key)
+            """)
+        assert donation.check_project([ctx]) == []
+
+    def test_d01_factory_assigned_donor(self, tmp_path):
+        # fn = factory(...) where the factory returns a donating jit
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+
+            def make_step(p):
+                def impl(state, key):
+                    return state + key + p
+                return jax.jit(impl, donate_argnums=(0,))
+
+            step2 = make_step(1)
+
+            def drive(state, key):
+                out = step2(state, key)
+                return state
+            """)
+        found = donation.check_project([ctx])
+        assert _codes(found) == ["D01"]
+
+    def test_d01_cross_file_donor(self, tmp_path):
+        kernel = _ctx(tmp_path, "kern.py", _DONATING_STEP)
+        caller = _ctx(tmp_path, "call.py", """\
+            import jax
+
+            from kern import step
+
+            def drive(state, key):
+                fresh = step(state, key)
+                return state, fresh
+            """)
+        found = donation.check_project([kernel, caller])
+        assert _codes(found) == ["D01"]
+        assert found[0].path.endswith("call.py")
+
+
+# -- shard-exact -------------------------------------------------------------
+
+_SHARD_HEADER = """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental.shard_map import shard_map
+
+"""
+
+
+class TestShardExact:
+    def test_s01_float_psum(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x):
+                return jax.lax.psum(x.astype(jnp.float32), "i")
+
+            def run(mesh, specs, x):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x)
+            """)
+        found = shard_exact.check(ctx)
+        assert _codes(found) == ["S01"]
+        assert "float32" in found[0].message
+
+    def test_s01_int_psum_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x):
+                return jax.lax.psum(x.astype(jnp.int32), "i")
+
+            def run(mesh, specs, x):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x)
+            """)
+        assert shard_exact.check(ctx) == []
+
+    def test_s01_pmean_always(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x):
+                return jax.lax.pmean(x, "i")
+
+            def run(mesh, specs, x):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x)
+            """)
+        assert _codes(shard_exact.check(ctx)) == ["S01"]
+
+    def test_s02_ungated_scatter(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x, reg):
+                i = jax.lax.axis_index("i")
+                return reg.at[i].set(x)
+
+            def run(mesh, specs, x, reg):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x, reg)
+            """)
+        found = shard_exact.check(ctx)
+        assert _codes(found) == ["S02"]
+
+    def test_s02_owner_gated_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x, reg, owned):
+                i = jax.lax.axis_index("i")
+                return reg.at[jnp.where(owned, i, 10**9)].set(
+                    x, mode="drop")
+
+            def run(mesh, specs, x, reg, owned):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x, reg, owned)
+            """)
+        assert shard_exact.check(ctx) == []
+
+    def test_s03_duplicate_destination(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x):
+                return jax.lax.ppermute(x, "i", perm=[(0, 1), (1, 1)])
+
+            def run(mesh, specs, x):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x)
+            """)
+        found = shard_exact.check(ctx)
+        assert _codes(found) == ["S03"]
+        assert "destination" in found[0].message
+
+    def test_s03_constant_comprehension_element(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x):
+                return jax.lax.ppermute(
+                    x, "i", perm=[(i, 0) for i in range(4)])
+
+            def run(mesh, specs, x):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x)
+            """)
+        assert _codes(shard_exact.check(ctx)) == ["S03"]
+
+    def test_s03_rotation_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _SHARD_HEADER + """\
+            def body(x):
+                return jax.lax.ppermute(
+                    x, "i", perm=[(i, (i + 1) % 4) for i in range(4)])
+
+            def run(mesh, specs, x):
+                return shard_map(body, mesh, in_specs=specs,
+                                 out_specs=specs)(x)
+            """)
+        assert shard_exact.check(ctx) == []
+
+
+# -- carry-contract ----------------------------------------------------------
+
+
+class TestCarryContract:
+    def test_c01_reordered_legs(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            from jax import lax
+
+            def body(carry, x):
+                a, b = carry
+                return (b, a), x
+
+            def run(xs):
+                return lax.scan(body, (0, 1), xs)
+            """)
+        found = carry_contract.check(ctx)
+        assert _codes(found) == ["C01"]
+        assert "reorders" in found[0].message
+
+    def test_c01_dropped_leg_while_loop(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            from jax import lax
+
+            def cond(carry):
+                a, b = carry
+                return a < b
+
+            def body(carry):
+                a, b = carry
+                return (a,)
+
+            def run():
+                return lax.while_loop(cond, body, (0, 10))
+            """)
+        found = carry_contract.check(ctx)
+        assert _codes(found) == ["C01"]
+        assert "'b'" in found[0].message
+
+    def test_c02_cast_leg(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            def body(carry, x):
+                a, b = carry
+                return (a, b.astype(jnp.int16)), x
+
+            def run(xs):
+                return lax.scan(body, (jnp.int32(0), jnp.int32(0)), xs)
+            """)
+        found = carry_contract.check(ctx)
+        assert _codes(found) == ["C02"]
+        assert "init pins int32" in found[0].message
+
+    def test_c02_cast_to_pinned_dtype_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            def body(carry, x):
+                a, b = carry
+                return (a, b.astype(jnp.int16)), x
+
+            def run(xs):
+                return lax.scan(body, (jnp.int32(0), jnp.int16(0)), xs)
+            """)
+        assert carry_contract.check(ctx) == []
+
+    def test_clean_threading(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            from jax import lax
+
+            def body(carry, x):
+                a, b = carry
+                return (a, b), x
+
+            def run(xs):
+                return lax.scan(body, (0, 1), xs)
+            """)
+        assert carry_contract.check(ctx) == []
+
+    def test_constructed_carry_skipped(self, tmp_path):
+        # _replace / conditional carries are the tracer's to check
+        ctx = _ctx(tmp_path, "m.py", """\
+            import jax
+            from jax import lax
+
+            def body(carry, x):
+                st = carry
+                return st._replace(round=st.round + 1), x
+
+            def run(st, xs):
+                return lax.scan(body, st, xs)
+            """)
+        assert carry_contract.check(ctx) == []
+
+
+# -- overflow ----------------------------------------------------------------
+
+_JAX_HEADER = """\
+            import jax
+            import jax.numpy as jnp
+
+"""
+
+
+class TestOverflow:
+    def test_o01_carry_accumulator(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(state, xs):
+                n_seen = state.n_seen + jnp.sum(xs)
+                return n_seen
+            """)
+        found = overflow.check(ctx)
+        assert _codes(found) == ["O01"]
+        assert "'n_seen'" in found[0].message
+
+    def test_o01_replace_kwarg(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(state, fresh):
+                return state._replace(n=state.n + jnp.sum(fresh))
+            """)
+        assert _codes(overflow.check(ctx)) == ["O01"]
+
+    def test_o01_scatter_add(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(bank, idx):
+                return bank.at[idx].add(1)
+            """)
+        found = overflow.check(ctx)
+        assert _codes(found) == ["O01"]
+        assert "scatter-add" in found[0].message
+
+    def test_o01_conditional_accumulate(self, tmp_path):
+        # x = where(c, x + inc, x) is still an accumulate
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(state, inc):
+                total = jnp.where(inc > 0, state.total + inc, state.total)
+                return total
+            """)
+        assert _codes(overflow.check(ctx)) == ["O01"]
+
+    def test_o01_small_constant_clean(self, tmp_path):
+        # +1 per round stays under 2**31 for a day at 10k rounds/s
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(state):
+                return state._replace(round=state.round + 1)
+            """)
+        assert overflow.check(ctx) == []
+
+    def test_o01_bool_mask_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(state, xs):
+                fresh = xs > 0
+                n = state.n + fresh.astype(jnp.int32)
+                return n
+            """)
+        assert overflow.check(ctx) == []
+
+    def test_o01_round_local_clean(self, tmp_path):
+        # freshly constructed each call: bounded by one round's work
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(hits):
+                n_sus = jnp.zeros((4,), jnp.int32)
+                n_sus = n_sus + hits
+                return n_sus
+            """)
+        assert overflow.check(ctx) == []
+
+    def test_o01_periodic_reset_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(total, inc, flag):
+                total = total + inc
+                total = jnp.where(flag, 0, total)
+                return total
+            """)
+        assert overflow.check(ctx) == []
+
+    def test_o02_mixed_width(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(a, b):
+                return a.astype(jnp.int16) + b.astype(jnp.int32)
+            """)
+        found = overflow.check(ctx)
+        assert _codes(found) == ["O02"]
+        assert "int16" in found[0].message
+
+    def test_o02_same_width_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            @jax.jit
+            def step(a, b):
+                return a.astype(jnp.int32) + b.astype(jnp.int32)
+            """)
+        assert overflow.check(ctx) == []
+
+    def test_untraced_host_code_exempt(self, tmp_path):
+        # host-side Python wraps into Python ints — not the kernel's
+        # problem
+        ctx = _ctx(tmp_path, "m.py", _JAX_HEADER + """\
+            def drain(state, xs):
+                return state.n_seen + jnp.sum(xs)
+            """)
+        assert overflow.check(ctx) == []
+
+
 # -- suppression: noqa + baseline --------------------------------------------
 
 
@@ -518,6 +973,52 @@ class TestSuppression:
         result = run_vet([str(p)], baseline_path=base)
         assert result.stale_baseline == ["gone.py|E02|no longer found"]
 
+    def test_multi_code_noqa_suppresses_both(self, tmp_path):
+        # one line, two codes from the overflow pass: O01 (accumulator)
+        # and O02 (mixed width inside the increment)
+        src = textwrap.dedent("""\
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(state, x, y):
+                n = state.n + jnp.sum(x.astype(jnp.int16) + y.astype(jnp.int32)){noqa}
+                return n
+            """)
+        p = tmp_path / "m.py"
+        p.write_text(src.format(noqa=""))
+        both = run_vet([str(p)], baseline_path=None)
+        assert sorted(_codes(both.findings)) == ["O01", "O02"]
+        p.write_text(src.format(noqa="  # noqa: O01,O02"))
+        assert run_vet([str(p)], baseline_path=None).findings == []
+
+    def test_multi_code_noqa_is_not_blanket(self, tmp_path):
+        src = textwrap.dedent("""\
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(state, x, y):
+                n = state.n + jnp.sum(x.astype(jnp.int16) + y.astype(jnp.int32))  # noqa: O01
+                return n
+            """)
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        result = run_vet([str(p)], baseline_path=None)
+        assert _codes(result.findings) == ["O02"]  # only O01 suppressed
+
+    def test_stale_baseline_across_new_pass_codes(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        base = tmp_path / "baseline.txt"
+        base.write_text("gone.py|D01|old donation finding\n"
+                        "gone.py|S02|old scatter finding\n"
+                        "gone.py|O01|old overflow finding\n")
+        result = run_vet([str(p)], baseline_path=base)
+        assert sorted(k.split("|")[1] for k in result.stale_baseline) \
+            == ["D01", "O01", "S02"]
+        assert result.rc == 0  # stale entries warn, they don't fail
+
     def test_write_baseline_roundtrip(self, tmp_path):
         p = tmp_path / "m.py"
         p.write_text("def f():\n    try:\n        return 1\n"
@@ -566,7 +1067,48 @@ class TestExitCodes:
         p = tmp_path / "m.py"
         p.write_text("def f():\n    try:\n        return 1\n"
                      "    except:\n        pass\n")
-        assert pyvet.main([str(p)]) == 0  # E01 is not a legacy pass
+        with pytest.warns(DeprecationWarning, match="deprecated"):
+            assert pyvet.main([str(p)]) == 0  # E01 is not a legacy pass
+
+
+# -- output formats (the CI artifact surface) --------------------------------
+
+_OVERFLOW_DEFECT = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(state, xs):
+    return state._replace(n=state.n + jnp.sum(xs))
+"""
+
+
+class TestOutputFormats:
+    def test_format_json(self, tmp_path, capsys):
+        p = tmp_path / "m.py"
+        p.write_text(_OVERFLOW_DEFECT)
+        rc = vet_main([str(p), "--no-baseline", "--format", "json"])
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 1 and data["rc"] == 1
+        assert data["files"] == 1
+        assert [f["code"] for f in data["findings"]] == ["O01"]
+        assert data["findings"][0]["path"].endswith("m.py")
+        assert data["per_pass"]["overflow"] == 1
+
+    def test_report_artifact_written(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        report = tmp_path / "vet_report.json"
+        rc = vet_main([str(p), "--no-baseline", "--report", str(report)])
+        data = json.loads(report.read_text())
+        assert rc == 0 and data["rc"] == 0
+        assert data["findings"] == [] and data["files"] == 1
+
+    def test_fast_skips_flow_passes(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(_OVERFLOW_DEFECT)
+        assert vet_main([str(p), "--no-baseline"]) == 1
+        assert vet_main([str(p), "--no-baseline", "--fast"]) == 0
 
 
 # -- meta: the analyzer meets its own standard -------------------------------

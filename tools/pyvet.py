@@ -1,17 +1,27 @@
-"""Back-compat shim: the two original pyvet passes (undefined names +
-unused imports) now live in ``tools/vet/names.py`` on the shared
-single-parse walker, honoring the package's ``# noqa: CODE``
-convention (blanket ``# noqa`` still suppresses everything on a line).
+"""DEPRECATED back-compat shim — use ``python -m tools.vet`` instead.
+
+The two original pyvet passes (undefined names + unused imports) live
+in ``tools/vet/names.py`` on the shared single-parse walker, honoring
+the package's ``# noqa: CODE`` convention (blanket ``# noqa`` still
+suppresses everything on a line).
 
 ``python tools/pyvet.py <paths>`` runs ONLY those two passes — the
-historical contract.  The full six-pass analyzer (async-safety,
-tracer-purity, wire-schema, exception-hygiene) is what ``make vet``
-runs:  ``python -m tools.vet <paths>``.
+historical contract, kept so old scripts keep their exit-code
+behavior.  The full ten-pass analyzer (async-safety, tracer-purity,
+wire-schema, exception-hygiene, donation, shard-exactness,
+carry-contract, overflow) is what ``make vet`` runs:
+``python -m tools.vet <paths>``.
+
+Removal window: this shim emits a DeprecationWarning now and will be
+deleted two PRs after the analyzer PR that deprecated it (keep
+``tests/test_vet.py::test_legacy_pyvet_cli_still_names_only`` green
+until then — delete the test together with the shim).
 """
 
 from __future__ import annotations
 
 import sys
+import warnings
 from pathlib import Path
 from typing import List, Optional, Sequence
 
@@ -22,6 +32,11 @@ from tools.vet.driver import LEGACY_PASSES, run_vet  # noqa: E402
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    warnings.warn(
+        "tools/pyvet.py is deprecated (names-only shim; scheduled for "
+        "removal): run `python -m tools.vet <paths>` for the full "
+        "analyzer",
+        DeprecationWarning, stacklevel=2)
     roots: List[str] = list(argv) if argv else ["consul_tpu", "tests"]
     result = run_vet(roots, passes=list(LEGACY_PASSES),
                      baseline_path=None)

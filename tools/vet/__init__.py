@@ -1,4 +1,4 @@
-"""`make vet`'s analyzer: six passes over one shared parse.
+"""`make vet`'s analyzer: ten passes over one shared parse.
 
 The ``go vet`` role for a tree with no third-party linter.  Passes
 (each module documents its codes and heuristics):
@@ -12,10 +12,23 @@ The ``go vet`` role for a tree with no third-party linter.  Passes
 - ``wire-schema``      W01 written-never-read, W02 read-never-written
 - ``exception-hygiene``  E01 bare except, E02 silent broad handler,
                        E03 swallowed CancelledError
+- ``donation``         D01 use-after-donate, D02 donated
+                       global/attribute (cross-file; kill rules:
+                       rebind, del, ``jax.block_until_ready``)
+- ``shard-exact``      S01 inexact collective, S02 ungated replicated
+                       write, S03 non-permutation ppermute table
+- ``carry-contract``   C01 carry shape drift, C02 carry dtype drift
+                       for scan/while/fori bodies
+- ``overflow``         O01 unbounded int32 accumulator at paper scale,
+                       O02 mixed-width integer arithmetic
+
+The last four are the flow-sensitive JAX-semantics passes (this PR's
+kernel-safety analyzer); ``--fast`` skips them for inner-loop runs.
 
 Suppression: ``# noqa: CODE[,CODE…]`` per line (blanket ``# noqa``
 still works), or an entry in ``tools/vet/baseline.txt`` for accepted
-legacy findings.  Run: ``python -m tools.vet <paths>``.
+legacy findings.  Run: ``python -m tools.vet <paths>``; add
+``--format json`` / ``--report vet_report.json`` for the CI artifact.
 """
 
 from tools.vet.core import FileCtx, Finding, Pass  # noqa: F401 (re-export)

@@ -1,0 +1,260 @@
+"""Carry-contract pass: the carry a ``lax.scan`` / ``while_loop`` /
+``fori_loop`` body returns must mirror the carry it receives — same
+legs, same order, same dtypes.
+
+JAX enforces pytree *structure* equality at trace time, but two
+classes of bug survive tracing:
+
+- legs of the same structure/dtype swapped (``return (hist, flight)``
+  for a ``(flight, hist)`` carry) trace fine and corrupt both streams
+  — exactly the risk of the state/flight/hist carry threading in the
+  SWIM scan (gossip/kernel.py ``_run_rounds_impl``);
+- a dtype cast on one leg (``x.astype(jnp.float32)``) fails only at
+  trace time *if* the shapes disagree too; a silent widening on a
+  weakly-typed leg changes numerics without any error.
+
+The pass is deliberately syntactic: it only judges bodies whose carry
+handling is statically visible — the first carry parameter unpacked by
+a single ``a, b, c = carry`` assignment (or a tuple parameter), and a
+``return`` whose carry-out is a tuple *literal*.  Conditional carries
+(``return (out if flag else st), y``), bare-name carries
+(``return st``) and constructed carries (``_replace(...)``) are
+skipped: those shapes are checked by the tracer itself, and guessing
+would only produce noise.
+
+- **C01 carry shape drift**: carry-out literal drops, adds, or
+  reorders legs relative to the carry-in unpacking.
+- **C02 carry dtype drift**: a carry-out leg is an explicit dtype cast
+  (``astype`` / ``jnp.int64(...)``-style constructor) of its own
+  carry-in leg, or its cast dtype disagrees with the dtype the
+  matching leg of a literal ``init`` tuple pins at the call site
+  (``jnp.zeros(n, jnp.int32)``, ``jnp.int32(0)``, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from tools.vet.core import FileCtx, Finding
+from tools.vet.tracer_purity import _SCAN_NAMES, _collect_defs, _tail
+
+CARRY_SHAPE = "C01"
+CARRY_DTYPE = "C02"
+
+_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+           "uint32", "uint64", "float16", "float32", "float64",
+           "bfloat16", "bool_"}
+
+# loop combinator -> (body arg index, init arg index, carry is first
+# element of a (carry, ys) return pair)
+_LOOP_SHAPES = {
+    "scan": (0, 1, True),
+    "while_loop": (1, 2, False),
+    "fori_loop": (2, 3, False),
+}
+
+
+@dataclass
+class _BodySite:
+    fn: ast.AST                   # the body FunctionDef
+    loop: str                     # "scan" | "while_loop" | "fori_loop"
+    pairs_return: bool            # scan returns (carry, y)
+    init: Optional[ast.expr]      # init expr at the call site, if any
+    carry_param_index: int        # 0 for scan/while, 1 for fori (i, c)
+
+
+def _body_sites(tree: ast.Module) -> List[_BodySite]:
+    defs = _collect_defs(tree)
+    sites: List[_BodySite] = []
+    seen: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        t = _tail(node.func)
+        if t not in _SCAN_NAMES or t not in _LOOP_SHAPES:
+            continue
+        body_i, init_i, pairs = _LOOP_SHAPES[t]
+        if len(node.args) <= body_i:
+            continue
+        fn_name = _tail(node.args[body_i])
+        if fn_name is None or fn_name not in defs:
+            continue
+        init = node.args[init_i] if len(node.args) > init_i else None
+        for info in defs[fn_name]:
+            if id(info.node) in seen:
+                continue
+            seen.add(id(info.node))
+            sites.append(_BodySite(
+                info.node, t, pairs, init,
+                carry_param_index=1 if t == "fori_loop" else 0))
+    return sites
+
+
+def _carry_legs(fn: ast.AST, param_index: int) -> Optional[List[str]]:
+    """Names of the carry legs, from ``a, b = carry`` unpacking of the
+    carry parameter in the body's first statements.  None when the
+    carry is used whole (bare name) — not judgeable."""
+    args = fn.args.posonlyargs + fn.args.args
+    if len(args) <= param_index:
+        return None
+    cname = args[param_index].arg
+    for st in fn.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.value, ast.Name) \
+                and st.value.id == cname \
+                and isinstance(st.targets[0], (ast.Tuple, ast.List)):
+            legs = []
+            for el in st.targets[0].elts:
+                if not isinstance(el, ast.Name):
+                    return None
+                legs.append(el.id)
+            return legs
+    return None
+
+
+def _carry_out(fn: ast.AST, pairs_return: bool) -> List[Tuple[ast.stmt,
+                                                              List[ast.expr]]]:
+    """(return stmt, carry-out literal elements) for every judgeable
+    return.  Non-literal carries are skipped."""
+    out = []
+    todo: List[ast.AST] = list(fn.body)
+    nodes: List[ast.AST] = []
+    while todo:  # returns of NESTED defs are not this body's carry
+        n = todo.pop()
+        nodes.append(n)
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            todo.extend(ast.iter_child_nodes(n))
+    for node in nodes:
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        val = node.value
+        if pairs_return:
+            if not (isinstance(val, ast.Tuple) and len(val.elts) == 2):
+                continue
+            val = val.elts[0]
+        if isinstance(val, (ast.Tuple, ast.List)):
+            out.append((node, list(val.elts)))
+    return out
+
+
+def _leg_name(expr: ast.expr) -> Optional[str]:
+    """The carry-in name an out-leg passes through, seeing through a
+    single dtype cast."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    cast = _cast_of(expr)
+    if cast is not None:
+        return cast[0]
+    return None
+
+
+def _cast_of(expr: ast.expr) -> Optional[Tuple[str, str]]:
+    """(name, dtype) when ``expr`` is ``name.astype(dt)`` or
+    ``jnp.dt(name)``."""
+    if not isinstance(expr, ast.Call):
+        return None
+    t = _tail(expr.func)
+    if t == "astype" and isinstance(expr.func, ast.Attribute) \
+            and isinstance(expr.func.value, ast.Name) and expr.args:
+        dt = _tail(expr.args[0])
+        if dt in _DTYPES:
+            return expr.func.value.id, dt
+    elif t in _DTYPES and len(expr.args) == 1 \
+            and isinstance(expr.args[0], ast.Name):
+        return expr.args[0].id, t
+    return None
+
+
+def _init_dtypes(init: Optional[ast.expr]) -> Dict[int, str]:
+    """leg index -> dtype for the statically readable legs of a
+    literal init tuple: ``jnp.int32(0)``, ``jnp.zeros(n, jnp.int32)``,
+    ``jnp.full(n, v, jnp.uint8)``, ``dtype=`` keywords."""
+    out: Dict[int, str] = {}
+    if not isinstance(init, (ast.Tuple, ast.List)):
+        return out
+    for i, el in enumerate(init.elts):
+        if not isinstance(el, ast.Call):
+            continue
+        t = _tail(el.func)
+        if t in _DTYPES:
+            out[i] = t
+            continue
+        for kw in el.keywords:
+            if kw.arg == "dtype" and _tail(kw.value) in _DTYPES:
+                out[i] = _tail(kw.value)  # type: ignore[assignment]
+        if i not in out and t in ("zeros", "ones", "full", "empty"):
+            # dtype as trailing positional: zeros(n, jnp.int32)
+            for a in el.args[1:]:
+                if _tail(a) in _DTYPES:
+                    out[i] = _tail(a)  # type: ignore[assignment]
+    return out
+
+
+def _judge(ctx: FileCtx, site: _BodySite, out: List[Finding]) -> None:
+    fn = site.fn
+    name = getattr(fn, "name", "<body>")
+    legs_in = _carry_legs(fn, site.carry_param_index)
+    if legs_in is None:
+        return
+    init_dts = _init_dtypes(site.init)
+    for ret, legs_out in _carry_out(fn, site.pairs_return):
+        names_out = [_leg_name(e) for e in legs_out]
+        if any(n is None for n in names_out):
+            continue  # constructed leg — tracer's problem, not ours
+        if len(legs_out) != len(legs_in):
+            missing = [n for n in legs_in if n not in names_out]
+            extra = [n for n in names_out if n not in legs_in]
+            detail = []
+            if missing:
+                detail.append(f"drops {', '.join(repr(m) for m in missing)}")
+            if extra:
+                detail.append(f"adds {', '.join(repr(e) for e in extra)}")
+            out.append(Finding(
+                ctx.path, ret.lineno, CARRY_SHAPE,
+                f"{site.loop} body '{name}' returns {len(legs_out)} carry "
+                f"leg(s) for a {len(legs_in)}-leg carry"
+                + (f" ({'; '.join(detail)})" if detail else "")
+                + " — the loop re-feeds a misshapen carry"))
+            continue
+        if set(names_out) == set(legs_in) and names_out != legs_in:
+            out.append(Finding(
+                ctx.path, ret.lineno, CARRY_SHAPE,
+                f"{site.loop} body '{name}' reorders its carry legs "
+                f"({', '.join(legs_in)}) -> ({', '.join(names_out)}) — "
+                "same-structure legs swap silently and corrupt both "
+                "streams"))
+            continue
+        for i, (el, nm) in enumerate(zip(legs_out, names_out)):
+            cast = _cast_of(el)
+            if cast is None:
+                continue
+            src, dt = cast
+            if nm != legs_in[i] or src != legs_in[i]:
+                continue  # reorder already reported above
+            pinned = init_dts.get(i)
+            if pinned is not None and pinned == dt:
+                continue  # cast back to the pinned dtype: a no-op
+            pin = f" (init pins {pinned})" if pinned else ""
+            out.append(Finding(
+                ctx.path, el.lineno, CARRY_DTYPE,
+                f"{site.loop} body '{name}' returns carry leg "
+                f"'{legs_in[i]}' cast to {dt}{pin} — carry-out dtype "
+                "must match carry-in, or every round re-casts and the "
+                "trace either fails late or silently changes numerics"))
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    if not any(k in ctx.src for k in _SCAN_NAMES):
+        return []
+    from tools.vet.async_safety import _module_imports
+    imports = _module_imports(ctx.tree)
+    if imports.get("jax") != "jax" and not any(
+            v == "jax" or v.startswith("jax.") for v in imports.values()):
+        return []
+    findings: List[Finding] = []
+    for site in _body_sites(ctx.tree):
+        _judge(ctx, site, findings)
+    return sorted(set(findings), key=lambda f: (f.line, f.code, f.message))

@@ -1,0 +1,552 @@
+"""Donation-safety pass: flag reads of buffers already handed to a
+donating jit (``donate_argnums`` / ``donate_argnames``).
+
+A donated buffer is DELETED by the dispatch — the kernel writes its
+output into the input's memory (64 MB per round at 1M nodes is why the
+SWIM jits donate, gossip/kernel.py).  A later read of the old binding
+raises ``RuntimeError: Array has been deleted`` on backends that honor
+donation, but *silently works* on backends that don't — exactly the
+class of bug no CPU test tier catches until a TPU run.
+
+Donating callables are discovered per module and shared across the
+project by simple name (the donating jits live in gossip/kernel.py;
+their call sites live in plane.py, the benches and the tests):
+
+- defs decorated ``@functools.partial(jax.jit, donate_arg*=...)``;
+- ``g = jax.jit(f, donate_arg*=...)`` assignments;
+- factory defs whose ``return`` is such a ``jax.jit(...)`` call
+  (``fn = factory(...)`` then makes ``fn`` donating);
+- wrapper propagation: a def that passes its OWN parameter (as a bare
+  name, no copy in between) at a donated slot of a known donating
+  callable donates that parameter too — including through
+  ``fn(*args)`` when ``args`` is a local tuple/list literal, and
+  through ``functools.partial(f, kw=...)`` aliases.
+
+Only module-level defs/assignments export their donation info to other
+files; function-local aliases stay file-local.
+
+Checks, within every non-traced scope (functions, lambdas, the module
+body):
+
+- **D01 use-after-donate**: a bare local name passed at a donated slot
+  is tainted from the call onward; any later read flags.  Kill rules:
+  the name is a target of the assignment *containing* the donating
+  call (``state = swim_round(state, ...)``), any later rebinding or
+  ``del``, or a ``jax.block_until_ready(name)`` sync (the deliberate
+  observe-deletion idiom — reads inside it are exempt and it ends the
+  taint).  A donating call inside a loop whose donated name is never
+  rebound in that loop flags too (iteration 2 reuses the deleted
+  buffer even though no textual read follows the call).
+- **D02 donated global/attribute**: the donated argument is an
+  attribute chain (``self._state``) or a name not bound in the current
+  scope — the stale binding outlives the call for every other reader.
+  Killed by a later store to the same dotted target (including targets
+  of the containing assignment).
+
+Calls inside functions that are themselves traced (jit-decorated or
+jit/scan/shard_map-rooted, transitively) are exempt: donation is a
+dispatch-boundary property, and an inner donating jit is inlined by
+the outer trace without consuming anything (tools/profile_kernel.py
+relies on this).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.vet.core import FileCtx, Finding, dotted_name
+from tools.vet.tracer_purity import (_collect_defs, _mark_roots, _reachable,
+                                     _tail)
+
+USE_AFTER_DONATE = "D01"
+DONATED_NONLOCAL = "D02"
+
+_DONATE_KWS = ("donate_argnums", "donate_argnames")
+
+
+@dataclass
+class _Donor:
+    """Donated positions/param names of one donating callable."""
+
+    positions: Set[int] = field(default_factory=set)
+    names: Set[str] = field(default_factory=set)
+
+    def merged(self, params: Sequence[str]) -> "_Donor":
+        """Positions with names resolved through the param list (and
+        vice versa) so positional and keyword call sites both match."""
+        d = _Donor(set(self.positions), set(self.names))
+        for i in self.positions:
+            if i < len(params):
+                d.names.add(params[i])
+        for n in self.names:
+            if n in params:
+                d.positions.add(params.index(n))
+        return d
+
+
+def _const_strs_ints(node: ast.AST) -> Tuple[Set[str], Set[int]]:
+    strs: Set[str] = set()
+    ints: Set[int] = set()
+    for c in ast.walk(node):
+        if isinstance(c, ast.Constant):
+            if isinstance(c.value, str):
+                strs.add(c.value)
+            elif isinstance(c.value, int) and not isinstance(c.value, bool):
+                ints.add(c.value)
+    return strs, ints
+
+
+def _donate_kw(call: ast.Call) -> Optional[_Donor]:
+    """The _Donor described by a ``jax.jit(...)``-style call's
+    donate_argnums/donate_argnames keywords, or None."""
+    d = _Donor()
+    found = False
+    for kw in call.keywords:
+        if kw.arg in _DONATE_KWS:
+            found = True
+            strs, ints = _const_strs_ints(kw.value)
+            d.names |= strs
+            d.positions |= ints
+    return d if found else None
+
+
+def _positional_params(fn) -> List[str]:
+    a = fn.args
+    return [x.arg for x in a.posonlyargs + a.args]
+
+
+def _own_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Every AST node of ``scope`` excluding nested function/lambda
+    bodies (each nested def or lambda is its own donation scope)."""
+    body = getattr(scope, "body", None)
+    todo: List[ast.AST] = list(body) if isinstance(body, list) \
+        else ([body] if body is not None else [])
+    while todo:
+        node = todo.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def _stored_names(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for c in ast.walk(node):
+        if isinstance(c, ast.Name) and isinstance(c.ctx, (ast.Store,
+                                                          ast.Del)):
+            out.add(c.id)
+        elif isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(c.name)
+    return out
+
+
+def _scope_params(scope: ast.AST) -> Set[str]:
+    if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+        return set()
+    a = scope.args
+    out = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    return out
+
+
+def _literal_seqs(scope: ast.AST) -> Dict[str, List[ast.expr]]:
+    """Local ``args = (a, b, c)`` / list-literal bindings, including
+    the ``(a, b) + ((c,) if cond else ())`` concatenation idiom (only
+    the leading literal elements matter)."""
+    out: Dict[str, List[ast.expr]] = {}
+    for node in _own_nodes(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        elems: Optional[List[ast.expr]] = None
+        if isinstance(val, (ast.Tuple, ast.List)):
+            elems = []
+            for el in val.elts:
+                if isinstance(el, ast.Starred):
+                    break
+                elems.append(el)
+        elif isinstance(val, ast.BinOp) and isinstance(val.op, ast.Add) \
+                and isinstance(val.left, (ast.Tuple, ast.List)):
+            elems = list(val.left.elts)
+        if elems is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = elems
+    return out
+
+
+class _DonorTable:
+    """name -> _Donor for every donating callable visible in a module.
+    ``seed`` carries project-wide donors from other files."""
+
+    def __init__(self, tree: ast.Module,
+                 seed: Optional[Dict[str, _Donor]] = None) -> None:
+        self.defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # first def wins; same-name redefinitions are rare and
+                # the analysis is best-effort
+                self.defs.setdefault(node.name, node)
+        self.donors: Dict[str, _Donor] = {}
+        for name, d in (seed or {}).items():
+            self._add(name, d)
+        self.factories: Dict[str, _Donor] = {}
+        self._direct()
+        self._assigned(tree)
+        self._propagate(tree)
+
+    def _add(self, name: str, donor: _Donor) -> bool:
+        cur = self.donors.setdefault(name, _Donor())
+        before = (len(cur.positions), len(cur.names))
+        cur.positions |= donor.positions
+        cur.names |= donor.names
+        return (len(cur.positions), len(cur.names)) != before
+
+    def _direct(self) -> None:
+        for name, fn in self.defs.items():
+            params = _positional_params(fn)
+            for dec in fn.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                t = _tail(dec.func)
+                d = None
+                if t == "jit":
+                    d = _donate_kw(dec)
+                elif t == "partial" and dec.args \
+                        and _tail(dec.args[0]) == "jit":
+                    d = _donate_kw(dec)
+                if d is not None:
+                    self._add(name, d.merged(params))
+            # factory form: `return jax.jit(..., donate_arg*=...)`
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) \
+                        and isinstance(node.value, ast.Call) \
+                        and _tail(node.value.func) == "jit":
+                    d = _donate_kw(node.value)
+                    if d is not None:
+                        self.factories[name] = d
+
+    def _assigned(self, tree: ast.Module) -> None:
+        # g = jax.jit(f, donate_arg*=...)   and   fn = factory(...)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            t = _tail(call.func)
+            d = None
+            if t == "jit":
+                d = _donate_kw(call)
+                if d is not None and call.args:
+                    inner = _tail(call.args[0])
+                    if inner in self.defs:
+                        d = d.merged(_positional_params(self.defs[inner]))
+            elif t in self.factories:
+                d = self.factories[t]
+            if d is None:
+                continue
+            for tgt in node.targets:
+                tn = _tail(tgt)
+                if tn:
+                    self._add(tn, d)
+
+    def _partial_aliases(self, tree: ast.Module) -> bool:
+        """g = functools.partial(f, kw=...) — keyword-only partials
+        keep positional donation; positional prefix args shift it."""
+        changed = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call) \
+                    or _tail(node.value.func) != "partial" \
+                    or not node.value.args:
+                continue
+            src = _tail(node.value.args[0])
+            donor = self.donors.get(src) if src else None
+            if not donor or not (donor.positions or donor.names):
+                continue
+            shift = len(node.value.args) - 1
+            bound = {kw.arg for kw in node.value.keywords}
+            d = _Donor({p - shift for p in donor.positions if p >= shift},
+                       {n for n in donor.names if n not in bound})
+            for tgt in node.targets:
+                tn = _tail(tgt)
+                if tn:
+                    changed |= self._add(tn, d)
+        return changed
+
+    def donated_args(self, call: ast.Call,
+                     literals: Dict[str, List[ast.expr]]) -> List[ast.expr]:
+        """Argument expressions of ``call`` landing on donated slots."""
+        t = _tail(call.func)
+        donor = self.donors.get(t) if t else None
+        if not donor or not (donor.positions or donor.names):
+            return []
+        out: List[ast.expr] = []
+        args = call.args
+        if len(args) == 1 and isinstance(args[0], ast.Starred):
+            # fn(*args) with a local literal-tuple `args`
+            star = args[0].value
+            elems = literals.get(star.id) \
+                if isinstance(star, ast.Name) else None
+            return [a for i, a in enumerate(elems or [])
+                    if i in donor.positions]
+        for i, a in enumerate(args):
+            if isinstance(a, ast.Starred):
+                break  # positions after a star are unknowable
+            if i in donor.positions:
+                out.append(a)
+        for kw in call.keywords:
+            if kw.arg in donor.names:
+                out.append(kw.value)
+        return out
+
+    def _propagate(self, tree: ast.Module) -> None:
+        # wrapper defs: param passed (bare) at a donated slot of a
+        # donating callable makes the wrapper donate it too
+        for _ in range(3):
+            changed = self._partial_aliases(tree)
+            for name, fn in self.defs.items():
+                params = _positional_params(fn)
+                pset = set(params)
+                literals = _literal_seqs(fn)
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for arg in self.donated_args(node, literals):
+                        if isinstance(arg, ast.Name) and arg.id in pset:
+                            changed |= self._add(
+                                name,
+                                _Donor(names={arg.id}).merged(params))
+            if not changed:
+                break
+
+    def exported(self, tree: ast.Module) -> Dict[str, _Donor]:
+        """Donors bound at module level — the names other files can
+        import.  Function-local aliases (``fn = factory(...)`` inside a
+        wrapper) stay file-local."""
+        top: Set[str] = set()
+        for st in tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                top.add(st.name)
+            elif isinstance(st, ast.Assign):
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Name):
+                        top.add(tgt.id)
+        return {n: d for n, d in self.donors.items()
+                if n in top and (d.positions or d.names)}
+
+
+# -- per-scope flow ----------------------------------------------------------
+
+
+class _Scope:
+    """One function/lambda (or the module body) under donation
+    analysis.  Flow is line-ordered — the straight-line dispatch style
+    of the kernel callers — with structural kills for the assignment
+    containing the donating call."""
+
+    def __init__(self, ctx: FileCtx, table: _DonorTable,
+                 scope: ast.AST) -> None:
+        self.ctx = ctx
+        self.table = table
+        self.scope = scope
+        self.nodes = list(_own_nodes(scope))
+        self.local = _scope_params(scope)
+        for n in self.nodes:
+            if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,
+                                                              ast.Del)):
+                self.local.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local.add(n.name)
+        self.end = max([getattr(n, "end_lineno", 0) or 0
+                        for n in self.nodes] or [0])
+        self.findings: List[Finding] = []
+
+    def _emit(self, line: int, code: str, msg: str) -> None:
+        self.findings.append(Finding(self.ctx.path, line, code, msg))
+
+    def _containing_assign(self, call: ast.Call) -> Optional[ast.stmt]:
+        for n in self.nodes:
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)) \
+                    and any(c is call for c in ast.walk(n)):
+                return n
+        return None
+
+    def _assign_targets(self, stmt: ast.stmt) -> List[ast.expr]:
+        return stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+
+    def _sync_lines(self, name: str) -> Set[int]:
+        """Lines where ``jax.block_until_ready`` receives ``name`` —
+        the sanctioned sync/observe-deletion idiom."""
+        out: Set[int] = set()
+        for n in self.nodes:
+            if isinstance(n, ast.Call) \
+                    and _tail(n.func) == "block_until_ready":
+                for a in n.args:
+                    if any(isinstance(c, ast.Name) and c.id == name
+                           for c in ast.walk(a)):
+                        out.add(n.lineno)
+        return out
+
+    def _kill_lines(self, call: ast.Call, name: str) -> Set[int]:
+        kills: Set[int] = set()
+        holder = self._containing_assign(call)
+        if holder is not None:
+            for t in self._assign_targets(holder):
+                if any(isinstance(c, ast.Name) and c.id == name
+                       for c in ast.walk(t)):
+                    kills.add(call.lineno)
+        for n in self.nodes:
+            if isinstance(n, ast.Name) and n.id == name \
+                    and isinstance(n.ctx, (ast.Store, ast.Del)) \
+                    and n.lineno > call.lineno:
+                kills.add(n.lineno)
+        return kills
+
+    def check_call(self, call: ast.Call,
+                   literals: Dict[str, List[ast.expr]]) -> None:
+        for arg in self.table.donated_args(call, literals):
+            if isinstance(arg, ast.Name):
+                if arg.id in self.local:
+                    self._check_local(call, arg.id)
+                else:
+                    self._check_nonlocal(call, arg.id, "global")
+            else:
+                dn = dotted_name(arg)
+                if dn is not None:
+                    self._check_nonlocal(call, dn, "attribute")
+                # anything else (a call, a copy, a subscript) builds a
+                # fresh value at the call site — nothing outlives it
+
+    def _check_local(self, call: ast.Call, name: str) -> None:
+        fn = _tail(call.func) or "?"
+        kills = self._kill_lines(call, name)
+        sync = self._sync_lines(name)
+        kill_at = min(kills | sync) if (kills | sync) else None
+        # the call's own (possibly multi-line) argument list is the
+        # donation itself, not a read after it
+        in_call = {id(c) for c in ast.walk(call)}
+        for n in self.nodes:
+            if not (isinstance(n, ast.Name) and n.id == name
+                    and isinstance(n.ctx, ast.Load)):
+                continue
+            if id(n) in in_call:
+                continue
+            if n.lineno <= call.lineno or n.lineno > self.end:
+                continue
+            if kill_at is not None and n.lineno >= kill_at:
+                continue
+            if n.lineno in sync:
+                continue  # inside the sanctioned sync itself
+            self._emit(
+                n.lineno, USE_AFTER_DONATE,
+                f"'{name}' read after being donated to {fn}() on line "
+                f"{call.lineno} — the buffer is deleted by the dispatch; "
+                "rebind the name or pass a copy")
+        # loop-carried reuse: donated every iteration, never rebound
+        for loop in self.nodes:
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            if not (loop.lineno <= call.lineno
+                    <= (loop.end_lineno or loop.lineno)):
+                continue
+            if name not in _stored_names(loop) and not any(
+                    loop.lineno <= s <= (loop.end_lineno or loop.lineno)
+                    for s in sync):
+                self._emit(
+                    call.lineno, USE_AFTER_DONATE,
+                    f"'{name}' donated to {fn}() inside a loop without "
+                    "being rebound in the loop body — iteration 2 passes "
+                    "an already-deleted buffer")
+                break
+
+    def _check_nonlocal(self, call: ast.Call, dotted: str,
+                        kind: str) -> None:
+        fn = _tail(call.func) or "?"
+        holder = self._containing_assign(call)
+        if holder is not None:
+            for t in self._assign_targets(holder):
+                for c in ast.walk(t):
+                    if isinstance(getattr(c, "ctx", None), ast.Store) \
+                            and dotted_name(c) == dotted:
+                        return  # rebound by the very same statement
+        if "." in dotted:
+            for n in self.nodes:
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.ctx, ast.Store) \
+                        and dotted_name(n) == dotted \
+                        and n.lineno >= call.lineno:
+                    return  # rebound later in this scope
+        else:
+            if any(ln >= call.lineno
+                   for ln in self._kill_lines(call, dotted)) \
+                    or self._sync_lines(dotted):
+                return
+        self._emit(
+            call.lineno, DONATED_NONLOCAL,
+            f"{kind} '{dotted}' donated to {fn}() is never rebound in "
+            "this scope — every later reader sees a deleted buffer; "
+            "rebind it after the call or pass a copy")
+
+
+def _imports_jax(ctx: FileCtx) -> bool:
+    if "jax" not in ctx.src:
+        return False
+    from tools.vet.async_safety import _module_imports
+    imports = _module_imports(ctx.tree)
+    return imports.get("jax") == "jax" or any(
+        v == "jax" or v.startswith("jax.") for v in imports.values())
+
+
+def check_project(ctxs: List[FileCtx]) -> List[Finding]:
+    jax_ctxs = [c for c in ctxs if _imports_jax(c)]
+    if not jax_ctxs:
+        return []
+    # two rounds so donors defined in a file processed later (kernel)
+    # still reach wrappers in files processed earlier (plane, tests)
+    shared: Dict[str, _Donor] = {}
+    tables: Dict[str, _DonorTable] = {}
+    for _ in range(2):
+        for ctx in jax_ctxs:
+            t = _DonorTable(ctx.tree, seed=shared)
+            tables[ctx.path] = t
+            for name, d in t.exported(ctx.tree).items():
+                cur = shared.setdefault(name, _Donor())
+                cur.positions |= d.positions
+                cur.names |= d.names
+
+    findings: List[Finding] = []
+    for ctx in jax_ctxs:
+        table = tables[ctx.path]
+        if not any(d.positions or d.names for d in table.donors.values()):
+            continue
+        defs = _collect_defs(ctx.tree)
+        _mark_roots(ctx.tree, defs)
+        traced_ids = {id(info.node) for info in _reachable(defs)}
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) not in traced_ids:
+                scopes.append(node)
+            elif isinstance(node, ast.Lambda):
+                scopes.append(node)
+        file_findings: List[Finding] = []
+        for scope_node in scopes:
+            sc = _Scope(ctx, table, scope_node)
+            literals = _literal_seqs(scope_node)
+            for n in sc.nodes:
+                if isinstance(n, ast.Call):
+                    sc.check_call(n, literals)
+            file_findings.extend(sc.findings)
+        findings.extend(sorted(set(file_findings),
+                               key=lambda f: (f.line, f.code, f.message)))
+    return findings

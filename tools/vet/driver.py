@@ -9,12 +9,14 @@ crash past them silently).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
-from tools.vet import (async_safety, exceptions, names, tracer_purity,
+from tools.vet import (async_safety, carry_contract, donation, exceptions,
+                       names, overflow, shard_exact, tracer_purity,
                        wire_schema)
 from tools.vet.core import (FileCtx, Finding, Pass, collect_files,
                             load_baseline, write_baseline)
@@ -31,10 +33,21 @@ PASSES: List[Pass] = [
          check_project=wire_schema.check_project),
     Pass("exception-hygiene", codes=("E01", "E02", "E03"),
          check=exceptions.check),
+    Pass("donation", codes=("D01", "D02"),
+         check_project=donation.check_project),
+    Pass("shard-exact", codes=("S01", "S02", "S03"),
+         check=shard_exact.check),
+    Pass("carry-contract", codes=("C01", "C02"),
+         check=carry_contract.check),
+    Pass("overflow", codes=("O01", "O02"), check=overflow.check),
 ]
 
 # pyvet backwards-compat: the two legacy passes ride in "names"
 LEGACY_PASSES = ("names",)
+
+# the flow-sensitive JAX-semantics passes: `--fast` (make vet-fast)
+# skips these for inner-loop runs
+FLOW_PASSES = ("donation", "shard-exact", "carry-contract", "overflow")
 
 
 @dataclass
@@ -101,6 +114,23 @@ def run_vet(roots: Sequence[str],
     return result
 
 
+def result_to_json(result: VetResult) -> Dict[str, object]:
+    """The machine-readable CI artifact (``--format json`` and
+    ``--report``): everything the text output says, keyed for tooling."""
+    def enc(f: Finding) -> Dict[str, object]:
+        return {"path": f.path, "line": f.line, "code": f.code,
+                "message": f.message}
+    return {
+        "files": result.files,
+        "rc": result.rc,
+        "findings": [enc(f) for f in result.findings],
+        "parse_errors": [enc(f) for f in result.parse_errors],
+        "per_pass": dict(result.per_pass),
+        "baselined": result.baselined,
+        "stale_baseline": list(result.stale_baseline),
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.vet",
@@ -111,12 +141,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--passes", default=None,
                     help="comma-separated subset of: "
                          + ",".join(p.name for p in PASSES))
+    ap.add_argument("--fast", action="store_true",
+                    help="skip the flow-sensitive JAX passes ("
+                         + ", ".join(FLOW_PASSES) + ") for inner-loop use")
     ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                     help="baseline file (default tools/vet/baseline.txt)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline file")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept all current findings into the baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="findings output format (default text)")
+    ap.add_argument("--report", default=None, metavar="PATH",
+                    help="also write the JSON report to PATH "
+                         "(the vet_report.json CI artifact)")
     args = ap.parse_args(argv)
 
     passes = None
@@ -128,11 +166,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"vet: unknown pass(es): {', '.join(unknown)}",
                   file=sys.stderr)
             return 2
+    if args.fast:
+        passes = [p.name for p in PASSES
+                  if (passes is None or p.name in passes)
+                  and p.name not in FLOW_PASSES]
 
     result = run_vet(
         args.paths, passes=passes,
         baseline_path=None if args.no_baseline else Path(args.baseline),
         update_baseline=args.write_baseline)
+
+    if args.report:
+        Path(args.report).write_text(
+            json.dumps(result_to_json(result), indent=2) + "\n",
+            encoding="utf-8")
+    if args.format == "json":
+        print(json.dumps(result_to_json(result), indent=2))
+        return result.rc
 
     for f in result.parse_errors + result.findings:
         print(f.render())
@@ -151,7 +201,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     return result.rc
 
 
-__all__ = ["run_vet", "main", "VetResult", "PASSES", "LEGACY_PASSES"]
+__all__ = ["run_vet", "main", "VetResult", "PASSES", "LEGACY_PASSES",
+           "FLOW_PASSES", "result_to_json"]
 
 if __name__ == "__main__":
     sys.exit(main())

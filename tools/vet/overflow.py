@@ -1,0 +1,390 @@
+"""Overflow pass: width/interval analysis over the integer
+accumulators that live inside the traced kernel.
+
+JAX defaults to 32-bit integers (this repo never enables ``x64`` —
+doing so would flip every default dtype and break the bit-parity
+suite, and ``jnp.int64`` silently *truncates back to int32* under the
+default config).  So every monotone counter threaded through the SWIM
+scan carry wraps at 2**31 − 1, and nothing crashes when it does: the
+crossval gates just start disagreeing with wrong percentiles.
+
+Bounds (documented assumptions, not config):
+
+- ``ROUNDS_BOUND`` = 10_000 rounds/s × 86_400 s ≈ 8.6e8 — one day of
+  the paper's 10k-rounds-per-second target (PAPER.md; params.py keeps
+  all protocol timing in rounds, so rounds/s is the only wall-clock
+  coupling).  A per-round increment of 1 therefore stays under
+  2**31 − 1 ≈ 2.1e9: plain ``round + 1`` / cursor bumps are fine.
+- ``NODES_BOUND`` = 1_000_000 — the paper's 1M-node scale target.  A
+  non-constant per-round increment (``jnp.sum(...)`` over nodes, a
+  served-count difference, a vector scatter-add) is bounded only by
+  the node count, and 1e6 × 8.6e8 obliterates int32 — and int64 too
+  if x64 were ever enabled, hence "fix" usually means wrap-aware
+  draining on the host, not widening on the device.
+
+Scope: functions reachable from a tracing entry point (same root set
+as the tracer-purity pass) — host-side Python wraps into Python ints
+and is exempt.
+
+- **O01 unbounded accumulator**: a self-accumulating statement —
+  ``x = x + inc`` / ``x += inc``, the carry idiom
+  ``f = state.f + inc`` (or ``_replace(f=state.f + inc)`` /
+  ``Type(f=state.f + inc)`` keywords), or a scatter-add
+  ``arr.at[i].add(inc)`` — whose increment bound × ``ROUNDS_BOUND``
+  exceeds the int32 range.  Kill rules: float evidence in the
+  expression (floats saturate precision, they don't wrap); a
+  top-level ``jnp.where`` whose other branch re-arms the accumulator
+  (a periodic reset bounds the sum); an increment that is a constant
+  small enough (|c| × ROUNDS_BOUND < 2**31).
+- **O02 mixed-width arithmetic**: a binary op whose two sides carry
+  *different* explicit integer dtype markers (``a.astype(jnp.int16) +
+  b.astype(jnp.int32)``) — the promotion is silent, and under
+  ``check_rep=False`` shard merges a width mismatch between shards'
+  contributions is exactly the kind of drift the parity suite cannot
+  localize.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.vet.core import FileCtx, Finding, dotted_name
+from tools.vet.tracer_purity import (_collect_defs, _mark_roots,
+                                     _reachable, _tail)
+
+UNBOUNDED_ACCUMULATOR = "O01"
+MIXED_WIDTH = "O02"
+
+ROUNDS_BOUND = 10_000 * 86_400          # one day at 10k rounds/s
+NODES_BOUND = 1_000_000                 # paper-scale cluster
+INT32_MAX = 2**31 - 1
+
+_INT_WIDTHS = {"int8": 8, "uint8": 8, "int16": 16, "uint16": 16,
+               "int32": 32, "uint32": 32, "int64": 64, "uint64": 64}
+_FLOAT_DTYPES = {"float16", "float32", "float64", "bfloat16"}
+
+
+def _has_float(expr: ast.expr) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Constant) and isinstance(n.value, float):
+            return True
+        if isinstance(n, (ast.Name, ast.Attribute)) \
+                and _tail(n) in _FLOAT_DTYPES:
+            return True
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Div):
+            return True
+    return False
+
+
+def _const_int(expr: ast.expr) -> Optional[int]:
+    if isinstance(expr, ast.Constant) \
+            and isinstance(expr.value, int) \
+            and not isinstance(expr.value, bool):
+        return expr.value
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        inner = _const_int(expr.operand)
+        return -inner if inner is not None else None
+    return None
+
+
+_LocalAssigns = Dict[str, List[ast.Assign]]
+
+_FRESH_CTORS = {"zeros", "ones", "full", "empty", "zeros_like",
+                "ones_like", "full_like", "arange"}
+
+
+def _local_assigns(fn: ast.AST) -> _LocalAssigns:
+    out: _LocalAssigns = {}
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(n)
+    return out
+
+
+def _bool_evidence(expr: ast.expr, locals_map: _LocalAssigns,
+                   depth: int = 0) -> bool:
+    """``expr`` is provably 0/1-valued: a comparison, a bool dtype, a
+    bool literal — resolving a bare name one level through its local
+    assignment (``joining = jnp.zeros((N,), bool).at[...].set(True)``)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Compare):
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, bool):
+            return True
+        if isinstance(n, (ast.Name, ast.Attribute)) \
+                and _tail(n) in ("bool", "bool_"):
+            return True
+    if depth < 1 and isinstance(expr, ast.Name):
+        return any(_bool_evidence(a.value, locals_map, depth + 1)
+                   for a in locals_map.get(expr.id, []))
+    return False
+
+
+def _inc_bound(inc: ast.expr,
+               locals_map: _LocalAssigns) -> Tuple[int, str]:
+    """(per-round bound, description) for an increment expression."""
+    c = _const_int(inc)
+    if c is not None:
+        return abs(c), f"constant {c}"
+    # a cast of a constant: jnp.int32(1)
+    if isinstance(inc, ast.Call) and len(inc.args) == 1:
+        c = _const_int(inc.args[0])
+        if c is not None and _tail(inc.func) in _INT_WIDTHS:
+            return abs(c), f"constant {c}"
+    # an element-wise 0/1 mask: mask.astype(jnp.int32), (x == y).astype
+    if isinstance(inc, ast.Call) and _tail(inc.func) == "astype" \
+            and isinstance(inc.func, ast.Attribute) \
+            and _bool_evidence(inc.func.value, locals_map):
+        return 1, "a 0/1 mask"
+    if _bool_evidence(inc, locals_map) and not any(
+            isinstance(n, ast.Call) and _tail(n.func) in ("sum",
+                                                          "count_nonzero")
+            for n in ast.walk(inc)):
+        return 1, "a 0/1 mask"
+    for n in ast.walk(inc):
+        if isinstance(n, ast.Call) and _tail(n.func) in ("sum",
+                                                         "count_nonzero"):
+            return NODES_BOUND, "a per-round jnp.sum over nodes"
+    return NODES_BOUND, "a non-constant per-round value"
+
+
+def _reset_anywhere(key: str, locals_map: _LocalAssigns) -> bool:
+    """True when SOME assignment to ``key`` in this function re-arms
+    it: a ``jnp.where`` with a branch that does not reference the
+    accumulator, a scatter ``key.at[...].set(...)``, or a fresh
+    constant/constructor rebind.  A periodically reset register is
+    bounded by its reset period, not the rounds bound."""
+    for a in locals_map.get(key, []):
+        v = a.value
+        if _reset_evidence(v, key):
+            return True
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                and v.func.attr == "set" \
+                and isinstance(v.func.value, ast.Subscript) \
+                and isinstance(v.func.value.value, ast.Attribute) \
+                and v.func.value.value.attr == "at" \
+                and _tail(v.func.value.value.value) == key:
+            return True
+        if _const_int(v) is not None:
+            return True
+        if isinstance(v, ast.Call) and _tail(v.func) in _FRESH_CTORS:
+            return True
+    return False
+
+
+def _round_local(key: str, lineno: int,
+                 locals_map: _LocalAssigns) -> bool:
+    """True when ``key`` is freshly constructed earlier in the same
+    function (``n_sus = jnp.zeros(...)``): the accumulation is bounded
+    by one round's work, not the rounds bound — cross-round state only
+    survives through the carry (params / unpacking / attributes)."""
+    for a in locals_map.get(key, []):
+        if a.lineno >= lineno:
+            continue
+        v = a.value
+        if _const_int(v) is not None:
+            return True
+        if isinstance(v, ast.Call) and (_tail(v.func) in _FRESH_CTORS
+                                        or (_tail(v.func) in _INT_WIDTHS
+                                            and v.args
+                                            and _const_int(v.args[0])
+                                            is not None)):
+            return True
+    return False
+
+
+def _split_self_add(target_key: str,
+                    value: ast.expr) -> Optional[ast.expr]:
+    """When ``value`` is ``<base> + inc`` (either side) with ``base``
+    naming the accumulator (bare name or attribute tail, e.g. both
+    ``x`` and ``state.x`` match key ``x``), return the increment.
+    Sees through a top-level ``jnp.where`` — a conditional accumulate
+    (``x = where(c, x + inc, x)``) is still an accumulate."""
+    if isinstance(value, ast.Call) and _tail(value.func) == "where" \
+            and len(value.args) == 3:
+        for branch in value.args[1:]:
+            inc = _split_self_add(target_key, branch)
+            if inc is not None:
+                return inc
+        return None
+    if not (isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add)):
+        return None
+
+    def names_key(side: ast.expr) -> bool:
+        return _tail(side) == target_key \
+            if isinstance(side, (ast.Name, ast.Attribute)) else False
+
+    if names_key(value.left):
+        return value.right
+    if names_key(value.right):
+        return value.left
+    return None
+
+
+def _reset_evidence(value: ast.expr, key: str) -> bool:
+    """``jnp.where(cond, reset, x + inc)`` at top level: one branch
+    re-arms the accumulator, bounding the sum between resets."""
+    if not (isinstance(value, ast.Call) and _tail(value.func) == "where"
+            and len(value.args) == 3):
+        return False
+    def refs(side: ast.expr) -> bool:
+        return any(_tail(n) == key
+                   for n in ast.walk(side)
+                   if isinstance(n, (ast.Name, ast.Attribute)))
+    branches = value.args[1:]
+    return any(not refs(b) for b in branches)
+
+
+def _emit_o01(ctx: FileCtx, fn_name: str, lineno: int, key: str,
+              inc: ast.expr, locals_map: _LocalAssigns,
+              out: List[Finding]) -> None:
+    bound, what = _inc_bound(inc, locals_map)
+    if bound * ROUNDS_BOUND <= INT32_MAX:
+        return
+    total = bound * ROUNDS_BOUND
+    out.append(Finding(
+        ctx.path, lineno, UNBOUNDED_ACCUMULATOR,
+        f"accumulator '{key}' in traced '{fn_name}' grows by {what} "
+        f"every round — bound {bound:,} x {ROUNDS_BOUND:,} rounds/day "
+        f"~= {total:.1e} overflows int32 ({INT32_MAX:,}); drain it "
+        "wrap-aware on the host or document the wrap convention"))
+
+
+def _check_assign(ctx: FileCtx, fn_name: str, node: ast.stmt,
+                  locals_map: _LocalAssigns, out: List[Finding]) -> None:
+    if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+        key = _tail(node.target)
+        if key is not None and not _has_float(node.value) \
+                and not (isinstance(node.target, ast.Name)
+                         and _round_local(key, node.lineno, locals_map)):
+            _emit_o01(ctx, fn_name, node.lineno, key, node.value,
+                      locals_map, out)
+        return
+    if not isinstance(node, ast.Assign):
+        return
+    for tgt in node.targets:
+        key = _tail(tgt)
+        if key is None:
+            continue
+        key = key.rsplit(".", 1)[-1]
+        val = node.value
+        if _reset_evidence(val, key):
+            continue
+        inc = _split_self_add(key, val)
+        if inc is None or _has_float(node.value):
+            continue
+        if isinstance(tgt, ast.Name) \
+                and isinstance(val, ast.BinOp) \
+                and isinstance(val.left, ast.Name) \
+                and _round_local(key, node.lineno, locals_map):
+            continue  # fresh per-call accumulator, not carry state
+        if isinstance(tgt, ast.Name) and _reset_anywhere(key, locals_map):
+            continue  # periodically re-armed register, bounded
+        _emit_o01(ctx, fn_name, node.lineno, key, inc, locals_map, out)
+
+
+def _check_kwargs(ctx: FileCtx, fn_name: str, call: ast.Call,
+                  locals_map: _LocalAssigns, out: List[Finding]) -> None:
+    """carry-constructor idiom: SwimState(..., n=state.n + inc) or
+    state._replace(n=state.n + inc)."""
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        if _reset_evidence(kw.value, kw.arg):
+            continue
+        inc = _split_self_add(kw.arg, kw.value)
+        if inc is not None and not _has_float(kw.value):
+            _emit_o01(ctx, fn_name, kw.value.lineno, kw.arg, inc,
+                      locals_map, out)
+
+
+def _check_scatter_add(ctx: FileCtx, fn_name: str, call: ast.Call,
+                       out: List[Finding]) -> None:
+    # <arr>.at[idx].add(inc): a vector accumulator.  The per-round
+    # increment is the scatter payload; a full-vector scatter lands up
+    # to one count per node per round on *some* bucket.
+    if not (isinstance(call.func, ast.Attribute) and call.func.attr == "add"
+            and isinstance(call.func.value, ast.Subscript)
+            and isinstance(call.func.value.value, ast.Attribute)
+            and call.func.value.value.attr == "at"
+            and call.args):
+        return
+    base = dotted_name(call.func.value.value.value) or "<array>"
+    inc = call.args[0]
+    if _has_float(inc):
+        return
+    c = _const_int(inc)
+    # even a constant payload lands once per scatter lane, and the
+    # kernel's scatters are per-node — fan-in is the node count
+    per_round = (abs(c) if c is not None else 1) * NODES_BOUND
+    if per_round * ROUNDS_BOUND <= INT32_MAX:
+        return
+    out.append(Finding(
+        ctx.path, call.lineno, UNBOUNDED_ACCUMULATOR,
+        f"scatter-add into '{base}' in traced '{fn_name}' accumulates "
+        f"up to ~{per_round:.0e}/round across lanes — overflows int32 "
+        f"({INT32_MAX:,}) well inside a day at 10k rounds/s; drain it "
+        "wrap-aware on the host or document the wrap convention"))
+
+
+def _int_marker(expr: ast.expr) -> Optional[str]:
+    """The explicit integer dtype a side of a BinOp is cast to, if
+    exactly one marker is visible."""
+    found: Set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            t = _tail(n.func)
+            if t in _INT_WIDTHS:
+                found.add(t)
+            elif t == "astype" and n.args and _tail(n.args[0]) in _INT_WIDTHS:
+                found.add(_tail(n.args[0]))  # type: ignore[arg-type]
+    return found.pop() if len(found) == 1 else None
+
+
+def _check_mixed_width(ctx: FileCtx, fn_name: str, node: ast.BinOp,
+                       out: List[Finding]) -> None:
+    if not isinstance(node.op, (ast.Add, ast.Sub, ast.Mult, ast.BitOr,
+                                ast.BitAnd, ast.BitXor)):
+        return
+    lm, rm = _int_marker(node.left), _int_marker(node.right)
+    if lm is None or rm is None or lm == rm:
+        return
+    if _INT_WIDTHS[lm] == _INT_WIDTHS[rm]:
+        return  # same width, signedness mix — a different (rarer) story
+    out.append(Finding(
+        ctx.path, node.lineno, MIXED_WIDTH,
+        f"mixed-width integer arithmetic in traced '{fn_name}': "
+        f"{lm} {type(node.op).__name__.lower()} {rm} promotes "
+        "silently — cast both sides to one width so shard merges and "
+        "the reference kernel agree"))
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    if "jax" not in ctx.src:
+        return []
+    from tools.vet.async_safety import _module_imports
+    imports = _module_imports(ctx.tree)
+    if imports.get("jax") != "jax" and not any(
+            v == "jax" or v.startswith("jax.") for v in imports.values()):
+        return []
+    defs = _collect_defs(ctx.tree)
+    _mark_roots(ctx.tree, defs)
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for info in _reachable(defs):
+        if id(info.node) in seen:
+            continue
+        seen.add(id(info.node))
+        locals_map = _local_assigns(info.node)
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                _check_assign(ctx, info.name, node, locals_map, findings)
+            elif isinstance(node, ast.Call):
+                _check_kwargs(ctx, info.name, node, locals_map, findings)
+                _check_scatter_add(ctx, info.name, node, findings)
+            elif isinstance(node, ast.BinOp):
+                _check_mixed_width(ctx, info.name, node, findings)
+    return sorted(set(findings), key=lambda f: (f.line, f.code, f.message))

@@ -1,0 +1,292 @@
+"""Shard-exactness pass: check the conventions that make the sharded
+SWIM kernel bit-identical to the single-device kernel.
+
+The shard_map port (gossip/kernel.py §"ICI sharding") runs with
+``check_rep=False``, so XLA verifies *nothing* about replication: the
+merge discipline is a human convention — every cross-device combine is
+an integer ``psum`` of **disjoint** shard-local contributions, and
+every write to a replicated register is owner-gated (``jnp.where(
+owned, loc, OOB)`` + ``mode="drop"``).  Break the convention and the
+sharded kernel diverges from the reference kernel silently; the parity
+suite only catches it for the shapes it happens to run.
+
+Scope: functions passed callable-first to ``shard_map(...)`` plus
+everything transitively called from them (simple-name call graph) in
+the same module.
+
+- **S01 inexact collective**: ``psum``/``pmax``/``pmin`` whose operand
+  shows float evidence — a float dtype cast/constructor, a float
+  literal, true division, or ``mean``/``pmean`` — and no integer cast
+  downstream of it.  Float addition is not associative, so a float
+  psum is ordering-dependent across device layouts and can never be
+  bit-exact.  ``pmean`` flags unconditionally (it divides).  Kill
+  rule: an ``astype(<int dtype>)`` / int-constructor wrapping the
+  operand restores exactness.
+- **S02 ungated replicated write**: an ``x.at[idx].set/add/...(...)``
+  scatter whose index derives from ``axis_index`` arithmetic with
+  neither a ``jnp.where`` owner-mask in the index nor ``mode="drop"``
+  on the write.  Each replica writes a *different* slot, so the
+  "replicated" register diverges across devices — exactly what
+  ``check_rep=False`` stops catching.  Kill rules: ``jnp.where``
+  anywhere in the index expression (the owner-predicate idiom routes
+  non-owners out of bounds) or a ``mode=`` keyword on the op (dropped
+  lanes are the gate).
+- **S03 non-permutation ppermute table**: a ``ppermute`` whose literal
+  ``perm`` table repeats a source or destination (lost or duplicated
+  payloads — ppermute delivers nothing to an uncovered destination,
+  which is only sound when that is the intent).  Comprehension tables
+  ``[(i, (i + k) % n) for i in range(n)]`` are accepted when both pair
+  elements reference the comprehension variable; a constant element
+  (``(i, 0)``: everyone sends to device 0) flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.vet.core import FileCtx, Finding
+from tools.vet.tracer_purity import _collect_defs, _tail
+
+INEXACT_COLLECTIVE = "S01"
+UNGATED_WRITE = "S02"
+BAD_PERM = "S03"
+
+_REDUCERS = {"psum", "pmax", "pmin", "psum_scatter"}
+_FLOAT_DTYPES = {"float16", "float32", "float64", "bfloat16", "half",
+                 "single", "double"}
+_INT_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+               "uint32", "uint64", "bool_"}
+_FLOAT_CALLS = {"mean", "average", "pmean", "std", "var", "norm"}
+_SCATTER_OPS = {"set", "add", "max", "min", "mul", "apply"}
+
+
+def _shard_rooted(tree: ast.Module) -> Set[int]:
+    """id() of every def reachable from a shard_map callable-first
+    call site, by simple-name edges."""
+    defs = _collect_defs(tree)
+    roots: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args \
+                and _tail(node.func) == "shard_map":
+            fn = _tail(node.args[0])
+            if fn in defs:
+                roots.append(fn)
+    seen: Set[int] = set()
+    out: Set[int] = set()
+    todo = [i for name in roots for i in defs.get(name, [])]
+    while todo:
+        info = todo.pop()
+        if id(info) in seen:
+            continue
+        seen.add(id(info))
+        out.add(id(info.node))
+        for callee in info.calls:
+            todo.extend(defs.get(callee, []))
+    return out
+
+
+def _float_evidence(expr: ast.expr) -> Optional[str]:
+    """Why ``expr`` may be float-valued, or None.  An int cast at the
+    top level launders everything under it."""
+    if isinstance(expr, ast.Call):
+        ct = _tail(expr.func)
+        if ct == "astype" and expr.args:
+            adt = _tail(expr.args[0])
+            if adt in _INT_DTYPES:
+                return None           # exact by construction
+            if adt in _FLOAT_DTYPES:
+                return f"astype({adt})"
+        if ct in _INT_DTYPES:
+            return None
+        if ct in _FLOAT_DTYPES:
+            return f"{ct}() cast"
+        if ct in _FLOAT_CALLS:
+            return f"{ct}()"
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, float):
+            return f"float literal {node.value}"
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            return "true division"
+        if isinstance(node, ast.Call):
+            ct = _tail(node.func)
+            if ct in _FLOAT_CALLS:
+                return f"{ct}()"
+            if ct == "astype" and node.args \
+                    and _tail(node.args[0]) in _FLOAT_DTYPES:
+                return f"astype({_tail(node.args[0])})"
+            if ct in _FLOAT_DTYPES:
+                return f"{ct}() cast"
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if _tail(node) in _FLOAT_DTYPES:
+                return f"{_tail(node)} dtype"
+    return None
+
+
+def _index_exprs(sub: ast.expr) -> List[ast.expr]:
+    if isinstance(sub, ast.Tuple):
+        return list(sub.elts)
+    return [sub]
+
+
+def _axis_tainted(fn: ast.AST) -> Set[str]:
+    """Names derived (transitively, 2 rounds) from ``axis_index``."""
+    tainted: Set[str] = set()
+
+    def mentions(expr: ast.expr) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, (ast.Name, ast.Attribute)) \
+                    and _tail(n) == "axis_index":
+                return True
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+        return False
+
+    assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)]
+    for _ in range(2):
+        changed = False
+        for node in assigns:
+            if not mentions(node.value):
+                continue
+            for t in node.targets:
+                for el in ast.walk(t):
+                    if isinstance(el, ast.Name) and el.id not in tainted:
+                        tainted.add(el.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _check_scatter(ctx: FileCtx, fn_name: str, node: ast.Call,
+                   tainted: Set[str], out: List[Finding]) -> None:
+    # shape: <base>.at[<idx>].<op>(<val>, [mode=...])
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SCATTER_OPS
+            and isinstance(node.func.value, ast.Subscript)
+            and isinstance(node.func.value.value, ast.Attribute)
+            and node.func.value.value.attr == "at"):
+        return
+    if any(kw.arg == "mode" for kw in node.keywords):
+        return  # dropped out-of-bounds lanes are the owner gate
+    idx = node.func.value.slice
+    derived = False
+    for part in _index_exprs(idx):
+        for n in ast.walk(part):
+            if isinstance(n, ast.Call) and _tail(n.func) == "where":
+                return  # owner-predicate mask in the index
+            if isinstance(n, (ast.Name, ast.Attribute)) \
+                    and _tail(n) == "axis_index":
+                derived = True
+            if isinstance(n, ast.Name) and n.id in tainted:
+                derived = True
+    if derived:
+        out.append(Finding(
+            ctx.path, node.lineno, UNGATED_WRITE,
+            f"scatter .at[...].{node.func.attr}() in shard_map body "
+            f"'{fn_name}' indexes with axis_index-derived values but has "
+            "no jnp.where owner mask and no mode=\"drop\" — each replica "
+            "writes a different slot, so the replicated register "
+            "diverges across devices"))
+
+
+def _perm_table(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "perm":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _check_perm(ctx: FileCtx, fn_name: str, call: ast.Call,
+                out: List[Finding]) -> None:
+    perm = _perm_table(call)
+    if perm is None:
+        return
+
+    def emit(msg: str) -> None:
+        out.append(Finding(
+            ctx.path, call.lineno, BAD_PERM,
+            f"ppermute table in shard_map body '{fn_name}' {msg}"))
+
+    if isinstance(perm, (ast.List, ast.Tuple, ast.Set)):
+        srcs: List[object] = []
+        dsts: List[object] = []
+        for el in perm.elts:
+            if not (isinstance(el, (ast.Tuple, ast.List))
+                    and len(el.elts) == 2):
+                return  # non-pair element: not statically checkable
+            pair = []
+            for part in el.elts:
+                if isinstance(part, ast.Constant) \
+                        and isinstance(part.value, int):
+                    pair.append(part.value)
+                else:
+                    return  # symbolic entry: give up on this table
+            srcs.append(pair[0])
+            dsts.append(pair[1])
+        if len(set(srcs)) != len(srcs):
+            emit("repeats a source device — duplicated sends are not a "
+                 "permutation; the payload ordering is undefined")
+        elif len(set(dsts)) != len(dsts):
+            emit("repeats a destination device — colliding sends lose "
+                 "payloads; not a permutation")
+    elif isinstance(perm, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        elt = perm.elt
+        if not (isinstance(elt, (ast.Tuple, ast.List))
+                and len(elt.elts) == 2):
+            return
+        tvars = {n.id for gen in perm.generators
+                 for n in ast.walk(gen.target) if isinstance(n, ast.Name)}
+        for part in elt.elts:
+            refs = {n.id for n in ast.walk(part)
+                    if isinstance(n, ast.Name)}
+            if not (refs & tvars):
+                emit("maps every source to the same destination "
+                     "(comprehension element does not use the loop "
+                     "variable) — collapsed sends lose payloads")
+                return
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    if "shard_map" not in ctx.src:
+        return []
+    from tools.vet.async_safety import _module_imports
+    imports = _module_imports(ctx.tree)
+    if imports.get("jax") != "jax" and not any(
+            v == "jax" or v.startswith("jax.") for v in imports.values()):
+        return []
+    rooted = _shard_rooted(ctx.tree)
+    if not rooted:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or id(node) not in rooted:
+            continue
+        tainted = _axis_tainted(node)
+        for c in ast.walk(node):
+            if not isinstance(c, ast.Call):
+                continue
+            t = _tail(c.func)
+            if t in _REDUCERS and c.args:
+                why = _float_evidence(c.args[0])
+                if why is not None:
+                    findings.append(Finding(
+                        ctx.path, c.lineno, INEXACT_COLLECTIVE,
+                        f"{t}() over a possibly-float value in shard_map "
+                        f"body '{node.name}' ({why}) — float reduction "
+                        "is ordering-dependent and cannot be bit-exact; "
+                        "reduce integers (astype an int dtype) or move "
+                        "the float math after the merge"))
+            elif t == "pmean" and c.args:
+                findings.append(Finding(
+                    ctx.path, c.lineno, INEXACT_COLLECTIVE,
+                    f"pmean() in shard_map body '{node.name}' divides by "
+                    "the axis size — inherently inexact; psum integers "
+                    "and divide after the merge"))
+            elif t == "ppermute":
+                _check_perm(ctx, node.name, c, findings)
+            _check_scatter(ctx, node.name, c, tainted, findings)
+    return sorted(set(findings), key=lambda f: (f.line, f.code, f.message))
