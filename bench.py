@@ -109,14 +109,18 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
     return False, "; ".join(tail[-3:]) if tail else f"rc={r.returncode}"
 
 
-def _setup_jax(retries: int = 5, probe_timeout_s: float = 75.0):
+def _setup_jax(retries: int = 6, probe_timeout_s: float = 40.0):
     """Probe backend liveness out-of-process, then init in-process with
     the persistent compile cache enabled.
 
-    Several short probes with backoff, not two long ones: the round-3
-    capture lost its whole window to 2×240s hangs.  A healthy backend
-    answers the probe in ~10-20s; 75s is already generous, and a wedged
-    tunnel-grant usually clears between probes once the holder dies."""
+    Many SHORT probes with exponential backoff, not a few long ones:
+    the round-3 capture lost its whole window to 2×240s hangs.  A
+    healthy backend answers the probe in ~10-20s, so 40s already has
+    2x headroom — a probe that silent past that is wedged, not slow.
+    The pause doubles (4s -> 64s cap) because a stuck tunnel-grant
+    clears when its holder dies, on a timescale of tens of seconds:
+    early retries catch a fast recovery, the growing pause stops the
+    probes themselves from burning the window when it is a slow one."""
     last = "unknown"
     for attempt in range(1, retries + 1):
         ok, info = _probe_backend(probe_timeout_s)
@@ -126,7 +130,7 @@ def _setup_jax(retries: int = 5, probe_timeout_s: float = 75.0):
         last = info
         _log(f"backend probe failed (attempt {attempt}/{retries}): {info}")
         if attempt < retries:
-            time.sleep(min(10.0 * attempt, 45.0))
+            time.sleep(min(4.0 * 2 ** (attempt - 1), 64.0))
     else:
         raise RuntimeError(f"jax backend unreachable after {retries} probes: {last}")
 
@@ -166,7 +170,7 @@ def _sync(jax, state) -> None:
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
                churn_ppm: int = 1000, dissem_swar: bool = True,
                hot_slots: int = 0, flight: bool = False,
-               shard_devices: int = 0) -> dict:
+               shard_devices: int = 0, nemesis: str = "") -> dict:
     import functools
 
     import jax.numpy as jnp
@@ -213,12 +217,58 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         fail_round = fail_round.at[:n_fail].set(
             (jnp.arange(n_fail, dtype=jnp.int32) * total_rounds) // n_fail)
 
+    # Nemesis regime (gossip/nemesis.py): the scenario's injection
+    # schedule — partition/loss masks, flapping rejoin, the Lifeguard
+    # LHM carry — rides the TIMED blocks, so the regime A/Bs the
+    # fault-injection overhead against its churn baseline.  The window
+    # is widened to the whole run: the catalog windows are oracle-scale
+    # and would elapse inside warmup here, leaving the masks compiled
+    # in but the fault dormant.
+    nem = nem_join = ns = None
+    if nemesis:
+        import dataclasses
+
+        from consul_tpu.gossip.kernel import init_nem_state
+        from consul_tpu.gossip.nemesis import build as build_nemesis
+        sc = build_nemesis(nemesis, n)
+        nem = dataclasses.replace(sc.nem, start=0, stop=2**31 - 1)
+        fail_round = jnp.minimum(fail_round, jnp.asarray(sc.fail_round))
+        if nem.needs_join:
+            nem_join = (jnp.asarray(sc.join_round)
+                        if sc.join_round is not None
+                        else jnp.full((p.n,), 2**31 - 1, jnp.int32))
+        if nem.needs_state:
+            ns = init_nem_state(p.n)
+
+    def _dispatch(state, fail, fl=None, ns=None, hist=None):
+        """One run_rounds call with whatever extras this regime
+        threads; unpacks the carry in its fixed
+        (state[, flight][, hist][, nem_state]) order."""
+        kw = {}
+        if fl is not None:
+            kw["flight"] = fl
+        if hist is not None:
+            kw["hist"] = hist
+        if nem is not None:
+            kw["nem"] = nem
+            if nem_join is not None:
+                kw["join_round"] = nem_join
+            if ns is not None:
+                kw["nem_state"] = ns
+        out, _ = run(state, key, fail, steps=steps, **kw)
+        parts = (out,) if hasattr(out, "member") else tuple(out)
+        state, i = parts[0], 1
+        if fl is not None:
+            fl, i = parts[i], i + 1
+        if hist is not None:
+            hist, i = parts[i], i + 1
+        if ns is not None:
+            ns = parts[i]
+        return state, fl, ns, hist
+
     _log(f"lan n={n} slots={slots}: compiling + warmup ({steps} rounds)")
     t0 = time.perf_counter()
-    if flight:
-        (state, fl), _ = run(state, key, fail_round, steps=steps, flight=fl)
-    else:
-        state, _ = run(state, key, fail_round, steps=steps)
+    state, fl, ns, _ = _dispatch(state, fail_round, fl, ns)
     _sync(jax, state)
     compile_s = time.perf_counter() - t0
     _log(f"compile+warmup done in {compile_s:.1f}s")
@@ -226,11 +276,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
     best = float("inf")
     for r in range(repeats):
         t0 = time.perf_counter()
-        if flight:
-            (state, fl), _ = run(state, key, fail_round, steps=steps,
-                                 flight=fl)
-        else:
-            state, _ = run(state, key, fail_round, steps=steps)
+        state, fl, ns, _ = _dispatch(state, fail_round, fl, ns)
         _sync(jax, state)
         dt = time.perf_counter() - t0
         best = min(best, dt)
@@ -243,7 +289,8 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
                    + (f"_hot{hot_slots}" if hot_slots else "")
                    + ("" if dissem_swar else "_planes")
                    + ("_flight" if flight else "")
-                   + (f"_shard{shard_devices}" if shard_devices else "")),
+                   + (f"_shard{shard_devices}" if shard_devices else "")
+                   + (f"_nem_{nemesis}" if nemesis else "")),
         "value": round(rps, 1),
         "unit": "rounds/s",
         "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 3),
@@ -257,7 +304,9 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         # One drain AFTER timing: proves rows were recorded without a
         # host transfer inside the measured blocks.
         result["flight_rows_recorded"] = int(fl.cursor)
-    if churn_ppm:
+    if nemesis:
+        result["nemesis"] = nemesis
+    if churn_ppm or nemesis:
         # Detection-latency observatory (untimed): one extra block on a
         # fresh state with the in-kernel histogram banks threaded
         # through, failures confined to the first half so verdicts have
@@ -265,7 +314,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         # rounds/s and compile_s stay what they always measured.
         import numpy as np
 
-        from consul_tpu.gossip.kernel import init_hist
+        from consul_tpu.gossip.kernel import init_hist, init_nem_state
         from consul_tpu.obs.hist import HistRecorder
         _log("observatory block: detection-latency histograms (untimed)")
         h_state = init_state(p)
@@ -274,15 +323,28 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         h_fail = fail_round.at[:n_fail].set(
             (jnp.arange(n_fail, dtype=jnp.int32) * (steps // 2))
             // max(1, n_fail)) if n_fail else fail_round
-        out = run(h_state, key, h_fail, steps=steps, hist=init_hist())
-        (h_state, hist) = out[0]
+        h_ns = (init_nem_state(p.n)
+                if nem is not None and nem.needs_state else None)
+        h_state, _, _, hist = _dispatch(h_state, h_fail, None, h_ns,
+                                        init_hist())
         _sync(jax, h_state)
         rec = HistRecorder()
-        rec.ingest({f: np.asarray(getattr(hist, f))
-                    for f in hist._fields})
+        deltas = rec.ingest({f: np.asarray(getattr(hist, f))
+                             for f in hist._fields},
+                            scenario=nemesis or None)
         result["detect_count"] = int(rec.counts("detect").sum())
         result["detect_p50_rounds"] = rec.percentile("detect", 50)
         result["detect_p99_rounds"] = rec.percentile("detect", 99)
+        if nemesis:
+            # Per-scenario SLO readout (BENCH_NOTES §8): same objective
+            # the live plane serves at /v1/agent/slo.
+            from consul_tpu.obs.slo import SloTracker
+            tr = SloTracker(p.suspicion_max_rounds + p.probe_every)
+            tr.observe(deltas["detect"])
+            snap = tr.snapshot()
+            result["slo"] = {k: snap[k] for k in
+                             ("objective_rounds", "detections",
+                              "attainment", "burn_rate")}
     return result
 
 
@@ -347,24 +409,27 @@ _LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # [+ "_churn{ppm}ppm" for non-default churn | "_{d}dc" for multidc]
 # [+ "_planes" for the fallback dissemination strategy]
 # [+ "_flight" with the kernel flight recorder enabled]
-# [+ "_shard{d}" for the shard_map'd kernel over d devices].
+# [+ "_shard{d}" for the shard_map'd kernel over d devices]
+# [+ "_nem_{scenario}" with a nemesis injection schedule active].
 _METRIC_RE = re.compile(
     r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
     r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?(_planes)?(_flight)?"
-    r"(?:_shard(\d+))?$")
+    r"(?:_shard(\d+))?(?:_nem_([a-z0-9_]+))?$")
 
 
 def _regime_key(multidc: bool, churn_ppm: int,
                 planes: bool = False, hot: int = 0,
-                flight: bool = False, shard: int = 0) -> tuple:
+                flight: bool = False, shard: int = 0,
+                nemesis: str = "") -> tuple:
     """Cache-matching key: bench variant + churn regime + dissemination
-    strategy + device count, size-agnostic.  The default LAN run (churn
-    1000 ppm) has NO suffix historically, so the regime must be
-    recovered from the parsed name, not a string prefix — a churn-0
-    quiescent entry is ~10x the churned number and must never stand in
-    for it."""
+    strategy + device count + nemesis scenario, size-agnostic.  The
+    default LAN run (churn 1000 ppm) has NO suffix historically, so the
+    regime must be recovered from the parsed name, not a string prefix
+    — a churn-0 quiescent entry is ~10x the churned number and must
+    never stand in for it."""
     return ("multidc" if multidc else "gossip",
-            None if multidc else churn_ppm, planes, hot, flight, shard)
+            None if multidc else churn_ppm, planes, hot, flight, shard,
+            nemesis)
 
 
 def _parse_metric_regime(name: str) -> tuple | None:
@@ -378,7 +443,8 @@ def _parse_metric_regime(name: str) -> tuple | None:
             m.group(6) is not None,
             int(m.group(5)) if m.group(5) is not None else 0,
             m.group(7) is not None,
-            int(m.group(8)) if m.group(8) is not None else 0)
+            int(m.group(8)) if m.group(8) is not None else 0,
+            m.group(9) or "")
 
 
 def _read_cache() -> dict:
@@ -404,13 +470,15 @@ def _same_platform_class(a: str, b: str) -> bool:
 
 def _read_last_good(multidc: bool, churn_ppm: int, planes: bool = False,
                     hot: int = 0, flight: bool = False, shard: int = 0,
+                    nemesis: str = "",
                     platform: str | None = None) -> dict | None:
     """Last cached measurement of this exact regime (variant + churn +
     strategy) ON THIS BACKEND PLATFORM CLASS, preferring the largest n.
     A CPU smoke run must never stand in for a chip measurement (or vice
     versa); "axon"/"tpu"/untagged are all the chip class.  A corrupt
     cache must never take down the metric emit."""
-    want = _regime_key(multidc, churn_ppm, planes, hot, flight, shard)
+    want = _regime_key(multidc, churn_ppm, planes, hot, flight, shard,
+                       nemesis)
     plat = platform if platform is not None else _PLATFORM
     candidates = [
         v for k, v in _read_cache().items()
@@ -438,7 +506,8 @@ def _store_result(result: dict) -> None:
 
 def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                 dissem_swar: bool = True, hot_slots: int = 0,
-                flight: bool = False, shard_devices: int = 0) -> dict:
+                flight: bool = False, shard_devices: int = 0,
+                nemesis: str = "") -> dict:
     """One regime with reduced-N fallback.  Returns a result dict; on
     total failure returns an error dict carrying the regime-matched
     last-known-good."""
@@ -461,7 +530,8 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                                     args.repeats, churn_ppm=churn_ppm,
                                     dissem_swar=dissem_swar,
                                     hot_slots=hot_slots, flight=flight,
-                                    shard_devices=shard_devices)
+                                    shard_devices=shard_devices,
+                                    nemesis=nemesis)
             if n != args.n:
                 result["reduced_from_n"] = args.n
             _store_result(result)
@@ -479,7 +549,7 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                "error": f"all sizes failed; last: "
                         f"{type(last_err).__name__}: {last_err}"}
     last = _read_last_good(multidc, churn_ppm, not dissem_swar, hot_slots,
-                           flight, shard_devices)
+                           flight, shard_devices, nemesis)
     if last is not None:
         payload["last_known_good"] = last
     return payload
@@ -520,9 +590,15 @@ def main() -> None:
                     help="run the shard_map'd kernel over this many local "
                          "devices for single-regime runs (0 = unsharded; "
                          "the table sweeps 1..all local devices)")
+    ap.add_argument("--nemesis", type=str, default="",
+                    help="run the timed blocks under this nemesis "
+                         "injection schedule (gossip/nemesis.py catalog "
+                         "name, window widened to the whole run); the "
+                         "table A/Bs two scenarios against churn1000ppm")
     args = ap.parse_args()
 
-    single_regime = args.multidc or args.churn_ppm is not None
+    single_regime = (args.multidc or args.churn_ppm is not None
+                     or bool(args.nemesis))
 
     try:
         jax = _setup_jax()
@@ -571,7 +647,8 @@ def main() -> None:
         _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn,
                           dissem_swar=args.dissem == "swar",
                           hot_slots=args.hot_slots, flight=args.flight,
-                          shard_devices=args.shard_devices))
+                          shard_devices=args.shard_devices,
+                          nemesis=args.nemesis))
         return
 
     # -- default: the full regime table, one JSON line -------------------
@@ -597,6 +674,17 @@ def main() -> None:
     regimes["realistic_churn10ppm_hot8"] = _run_regime(
         jax, args, multidc=False, churn_ppm=10, hot_slots=8)
     regimes["multidc"] = _run_regime(jax, args, multidc=True, churn_ppm=0)
+    # Nemesis fault-injection overhead A/Bs (gossip/nemesis.py,
+    # BENCH_NOTES §8) against the churn1000ppm baseline: asym_loss
+    # prices the partition/loss edge masks, degraded_observer the
+    # Lifeguard LHM state threaded through the scan carry.  Each also
+    # reports its scenario-attributed detection SLO from the untimed
+    # observatory block.
+    regimes["nemesis_asym_loss"] = _run_regime(
+        jax, args, multidc=False, churn_ppm=1000, nemesis="asym_loss")
+    regimes["nemesis_degraded_observer"] = _run_regime(
+        jax, args, multidc=False, churn_ppm=1000,
+        nemesis="degraded_observer")
     # ICI-sharding scaling curve (BENCH_NOTES §sharding): the
     # shard_map'd kernel at the headline churn regime, one entry per
     # power-of-two local device count.  shard1 isolates the shard_map
@@ -628,8 +716,18 @@ def main() -> None:
     }
     if "error" in headline:
         payload["error"] = headline["error"]
-        if "last_known_good" in headline:
-            payload["last_known_good"] = headline["last_known_good"]
+        lkg = headline.get("last_known_good")
+        if lkg is not None:
+            # One wedged regime must not zero the whole round's headline
+            # series: substitute the regime-matched last-known-good and
+            # mark the provenance so a reader can tell it from a live
+            # measurement.
+            payload["value"] = lkg.get("value", 0.0)
+            payload["vs_baseline"] = lkg.get("vs_baseline", 0.0)
+            payload["headline_source"] = "last_known_good"
+            payload["last_known_good"] = lkg
+    else:
+        payload["headline_source"] = "live"
     _emit(payload)
 
 

@@ -254,20 +254,29 @@ def cmd_gossipd(args) -> int:
         from consul_tpu.agent.keyring import Keyring
         keys.extend(k for k in Keyring(path=args.keyring_file).list_keys()
                     if k not in keys)
+    if args.nemesis:
+        # Validate against the catalog before the kernel session boots —
+        # a typo'd scenario must fail here, not deep in plane startup.
+        from consul_tpu.gossip.nemesis import names as nemesis_names
+        if args.nemesis not in nemesis_names():
+            print(f"Unknown nemesis scenario {args.nemesis!r}; catalog: "
+                  f"{', '.join(nemesis_names())}", file=sys.stderr)
+            return 1
     cfg = PlaneConfig(
         bind_addr=args.bind, bind_port=args.port, unix_path=args.unix,
         capacity=args.capacity, sim_nodes=args.sim_nodes,
         gossip_interval_s=args.gossip_interval,
         hb_lapse_s=args.hb_lapse, suspicion_mult=args.suspicion_mult,
-        slots=args.slots, encrypt_keys=keys)
+        slots=args.slots, encrypt_keys=keys, nemesis=args.nemesis)
 
     async def serve() -> None:
         plane = GossipPlane(cfg)
         await plane.start()
         addr = cfg.unix_path or "%s:%s" % plane.local_addr
+        nem = f", nemesis={cfg.nemesis}" if cfg.nemesis else ""
         print(f"==> gossip plane running at {addr} "
               f"(capacity={cfg.capacity}, sim_nodes={cfg.sim_nodes}, "
-              f"round={cfg.gossip_interval_s * 1000:.0f}ms)", flush=True)
+              f"round={cfg.gossip_interval_s * 1000:.0f}ms{nem})", flush=True)
         loop = asyncio.get_event_loop()
         stop = asyncio.Event()
         loop.add_signal_handler(signal.SIGINT, stop.set)
@@ -638,6 +647,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "a keyring HMAC proof (repeatable for rotation)")
     p.add_argument("-keyring-file", dest="keyring_file", default="",
                    help="load accepted keys from a serf keyring file")
+    p.add_argument("-nemesis", default="",
+                   help="run the kernel under a correlated-fault scenario "
+                        "from the nemesis catalog (gossip/nemesis.py); "
+                        "detection SLOs come back scenario-labeled at "
+                        "/v1/agent/slo and in the Prometheus scrape")
     p.set_defaults(fn=cmd_gossipd)
 
     p = sub.add_parser("configtest", help="Validates config files/dirs")

@@ -200,6 +200,188 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
     }
 
 
+# -- nemesis scenarios (gossip/nemesis.py: correlated faults; the
+# oracle models the same injection schedule) --------------------------------
+
+
+def _flap_down_windows(nem) -> list:
+    """[(down_start, down_end)] for a flapping schedule — the rounds a
+    flap node is actually dead; detection events are attributed to the
+    window they fired in (both models use the window start as the
+    fail round)."""
+    out = []
+    td = nem.start + nem.flap_up
+    while td < nem.stop:
+        out.append((td, min(td + nem.flap_period - nem.flap_up, nem.stop)))
+        td += nem.flap_period
+    return out
+
+
+def kernel_nemesis_stats(p, sc, steps: int, seed: int, ndev: int = 0):
+    """One kernel run under a nemesis scenario.  Returns
+    ``(latencies, n_false_dead, n_refuted, drops, member_frac_end)``.
+
+    Latencies cover static kills (``sc.fail_round``) and, for flapping
+    scenarios, the FIRST dead verdict per flap node attributed to its
+    down-phase window — the same one-event-per-subject definition the
+    refmodel's ``dead_declared`` guard enforces."""
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.kernel import (PHASE_DEAD, init_nem_state,
+                                          init_state, run_rounds,
+                                          run_rounds_sharded, shard_state)
+
+    nem = sc.nem
+    active = (nem.has_partition or nem.has_flap or nem.has_degraded
+              or nem.heal_rejoin)
+    kw = dict(
+        trace=True,
+        join_round=(jnp.asarray(sc.join_round)
+                    if sc.join_round is not None else None),
+        nem=nem if active else None,
+        nem_state=(init_nem_state(p.n)
+                   if active and nem.needs_state else None),
+    )
+    fail = jnp.asarray(sc.fail_round)
+    if ndev > 1:
+        out, trace = run_rounds_sharded(
+            shard_state(init_state(p), ndev), jax.random.key(seed),
+            fail, p, steps, ndev=ndev, **kw)
+    else:
+        out, trace = run_rounds(init_state(p), jax.random.key(seed),
+                                fail, p, steps, **kw)
+    # the carry is (state[, hist][, nem_state]) when extras are
+    # threaded; SwimState is itself a tuple, so sniff the field
+    st = out if hasattr(out, "member") else out[0]
+    slot_node = np.asarray(trace.slot_node)
+    slot_dead = np.asarray(trace.slot_dead_round)
+    slot_phase = np.asarray(trace.slot_phase)
+    lats = []
+    for v in np.nonzero(sc.killed)[0]:
+        t_fail = int(sc.fail_round[v])
+        mask = ((slot_node == v) & (slot_dead >= t_fail)
+                & (slot_phase == PHASE_DEAD))
+        if mask.any():
+            lats.append(int(slot_dead[mask].min()) - t_fail)
+    if nem.has_flap:
+        wins = _flap_down_windows(nem)
+        for v in range(nem.flap_lo, min(nem.flap_hi, p.n)):
+            for td, te in wins:
+                mask = ((slot_node == v) & (slot_phase == PHASE_DEAD)
+                        & (slot_dead >= td) & (slot_dead < te))
+                if mask.any():
+                    lats.append(int(slot_dead[mask].min()) - td)
+                    break
+    member_frac = float(np.asarray(st.member).mean())
+    return (lats, int(st.n_false_dead), int(st.n_refuted), int(st.drops),
+            member_frac)
+
+
+def run_nemesis_config(name: str, n: int, seeds: int, ndev: int = 0,
+                       slots: int | None = None,
+                       steps: int | None = None) -> dict:
+    """One nemesis scenario, kernel vs oracle — both models inject the
+    SAME schedule (``nemesis.build``).  Returns the report row (same
+    statistics families as ``run_config`` plus the scenario label and
+    end-state membership recovery).
+
+    Slot sizing: a partition manufactures up to n/2 concurrent
+    cross-side suspicion episodes (every far-side node at once), so the
+    default provisions ``max(64, n)`` — the iid ``loss_sized_slots``
+    estimate badly under-provisions correlated regimes."""
+    from consul_tpu.gossip import nemesis
+    from consul_tpu.gossip.params import SwimParams
+    from consul_tpu.gossip.refmodel import RefModel
+
+    sc = nemesis.build(name, n)
+    nem = sc.nem
+    if slots is None:
+        slots = max(64, 1 << (n - 1).bit_length())
+    if steps is None:
+        steps = sc.steps
+    p = SwimParams(n=n, slots=slots, probe_every=5)
+    fail_at = {int(v): int(sc.fail_round[v])
+               for v in np.nonzero(sc.killed)[0]}
+    expected = (len(fail_at)
+                + (nem.flap_hi - nem.flap_lo if nem.has_flap else 0)) * seeds
+
+    k_lats, r_lats = [], []
+    k_fp = r_fp = k_ref = r_ref = k_drops = 0
+    k_mem, r_mem = [], []
+    t0 = time.time()
+    for s in range(seeds):
+        kl, kf, kr, kd, km = kernel_nemesis_stats(p, sc, steps, seed=s,
+                                                  ndev=ndev)
+        k_lats += kl
+        k_fp += kf
+        k_ref += kr
+        k_drops += kd
+        k_mem.append(km)
+    t_kernel = time.time() - t0
+    t0 = time.time()
+    for s in range(seeds):
+        m = RefModel(p, dict(fail_at), seed=1000 + s, nemesis=nem)
+        m.run(steps)
+        r_lats += m.detection_latencies()
+        r_fp += m.n_false_dead
+        r_ref += m.n_refuted
+        alive = [i for i in range(n) if m._alive_truth(i)]
+        r_mem.append(float(np.mean([m._member_count(i) / (n - 1)
+                                    for i in alive])) if alive else 0.0)
+    t_ref = time.time() - t0
+
+    k = np.asarray(k_lats, float)
+    r = np.asarray(r_lats, float)
+
+    def pct(a, q):
+        return float(np.percentile(a, q)) if len(a) else None
+
+    def rel(kv, rv):
+        if kv is None or rv is None or not rv:
+            return None
+        return round(abs(kv - rv) / rv, 4)
+
+    return {
+        "scenario": name,
+        "description": sc.description,
+        "n": n,
+        "slots": slots,
+        "seeds": seeds,
+        "steps": steps,
+        "samples": {"kernel": len(k), "refmodel": len(r)},
+        "expected_events": expected,
+        "completeness": {
+            "kernel": round(len(k) / expected, 4) if expected else None,
+            "refmodel": round(len(r) / expected, 4) if expected else None,
+        },
+        "kernel_slot_drops": k_drops,
+        "detection_latency_rounds": {
+            "kernel": {"mean": round(float(k.mean()), 2) if len(k) else None,
+                       "p50": pct(k, 50), "p99": pct(k, 99)},
+            "refmodel": {"mean": round(float(r.mean()), 2) if len(r) else None,
+                         "p50": pct(r, 50), "p99": pct(r, 99)},
+        },
+        "relative_error": {
+            "mean": rel(float(k.mean()) if len(k) else None,
+                        float(r.mean()) if len(r) else None),
+            "p50": rel(pct(k, 50), pct(r, 50)),
+            "p99": rel(pct(k, 99), pct(r, 99)),
+        },
+        "false_dead": {"kernel": k_fp, "refmodel": r_fp},
+        "refutes": {"kernel": k_ref, "refmodel": r_ref},
+        # End-state membership recovery: after a heal/flap window closes
+        # the membership view must converge back (>= 0.95 gates).
+        "member_frac_end": {
+            "kernel": round(float(np.mean(k_mem)), 4),
+            "refmodel": round(float(np.mean(r_mem)), 4),
+        },
+        "lifeguard_envelope_rounds": [p.suspicion_min_rounds,
+                                      p.suspicion_max_rounds],
+        "wall_s": {"kernel": round(t_kernel, 1), "refmodel": round(t_ref, 1)},
+    }
+
+
 # -- join churn (gossip.html.markdown:10-43: joins propagate as
 # gossiped alive messages; consumed by consul/leader.go:354-421) ------------
 

@@ -77,6 +77,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consul_tpu.gossip.nemesis import NemesisParams
 from consul_tpu.gossip.params import SwimParams
 from consul_tpu.obs.flight import N_COLS as _FLIGHT_COLS
 from consul_tpu.obs.hist import LATENCY_BUCKETS as _HIST_LAT
@@ -215,6 +216,66 @@ def _hist_add(bank: jnp.ndarray, mask: jnp.ndarray,
     # between drains is absorbed exactly.
     return bank.at[jnp.where(mask, jnp.clip(val, 0, B - 1), B)].add(  # noqa: O01 — wrap-aware host drain (obs/hist.py)
         1, mode="drop")
+
+
+class NemState(NamedTuple):
+    """Per-node Lifeguard local-health registers, threaded through the
+    scan carry (like HistBank) when a nemesis scenario needs them
+    (``NemesisParams.needs_state``).  Replicated under sharding — every
+    update derives from replicated B-space probe lanes or psum-merged
+    refute bits, so the sharded and single-device copies stay
+    bit-identical (tests/test_shard_map_parity.py)."""
+
+    lhm: jnp.ndarray     # i32 [N] — local-health multiplier, [0, lhm_max]
+    streak: jnp.ndarray  # i32 [N] — consecutive direct-probe misses,
+                         #   clamped at lhm_max + 1 (only the > compare
+                         #   is read, and the clamp bounds the counter)
+
+
+def init_nem_state(n: int) -> NemState:
+    return NemState(lhm=jnp.zeros((n,), jnp.int32),
+                    streak=jnp.zeros((n,), jnp.int32))
+
+
+def _nem_group(nem: NemesisParams, n: int) -> jnp.ndarray:
+    """Partition group bit per node, [n] i32 — derived inside the jit
+    from statics only; bit-for-bit nemesis.group_of (the hash uses
+    uint32 wraparound, identical in numpy and jnp)."""
+    if nem.part_kind == "hash":
+        ids = jnp.arange(n, dtype=jnp.uint32)
+        return ((ids * jnp.uint32(2654435761)) >> 31).astype(jnp.int32)
+    return (jnp.arange(n, dtype=jnp.int32) >= (n // 2)).astype(jnp.int32)
+
+
+def _nem_in_window(nem: NemesisParams, rnd) -> jnp.ndarray:
+    return (rnd >= nem.start) & (rnd < nem.stop)
+
+
+def _nem_schedule(nem: NemesisParams, rnd, fail_round, join_round):
+    """Apply the round's injection schedule to the ground-truth inputs
+    (the kills half of the catalog; the loss half lives in the probe
+    and dissemination phases).  Pure function of replicated [N] arrays
+    and statics — shard-safe by construction.
+
+    - flapping: the down phase overrides ``fail_round`` to "failed
+      now"; the up phase re-arms ``join_round`` so the node rejoins via
+      the ordinary join tick (incarnation bump + alive@inc flood).
+    - heal_rejoin: after the window closes every node is join-pending —
+      members are ignored by the join tick's ``~member`` gate, so only
+      falsely-declared-dead nodes actually rejoin."""
+    if nem.has_flap:
+        n = fail_round.shape[0]
+        ids = jnp.arange(n, dtype=jnp.int32)
+        flap = (ids >= nem.flap_lo) & (ids < nem.flap_hi)
+        down_phase = ((rnd - nem.start) % nem.flap_period) >= nem.flap_up
+        down = flap & _nem_in_window(nem, rnd) & down_phase
+        fail_round = jnp.where(down, jnp.minimum(fail_round, rnd),
+                               fail_round)
+        join_round = jnp.where(flap & ~down,
+                               jnp.minimum(join_round, rnd), join_round)
+    if nem.heal_rejoin:
+        join_round = jnp.minimum(join_round, jnp.int32(nem.stop))
+    return fail_round, join_round
 
 
 _AGE_FRESH = 0xF  # sentinel: written by this round's probe marks, pre-aging
@@ -455,11 +516,20 @@ def _block_size(p: SwimParams) -> int:
     return max(1, -(-p.n // p.probe_every))
 
 
-def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple, sc=None):
+def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple, sc=None,
+                nem=None, nem_state=None):
     """One round's probe slice: direct probe -> k indirect probes ->
     suspicion initiation for this round's prober block (reference
     per-node behavior: memberlist probe cycle as configured at
     consul/config.go:266-272, with per-node stagger).
+
+    ``nem``/``nem_state`` (Python-level statics, None = compiled out,
+    bit-identical to the baseline): a nemesis schedule adds cross-group
+    drop legs to the probe round-trips, spurious reply drops for
+    degraded observers, and — when ``nem_state`` is threaded — the
+    Lifeguard local-health-multiplier dynamics that suppress a degraded
+    observer's false suspicions.  Returns ``(carry, probe_stats)``, or
+    ``(carry, probe_stats, nem_state)`` when ``nem_state`` is threaded.
 
     ``mf`` packs membership and ground truth into one readable i32:
     ``member ? fail_round : -1`` — so ``mf[x] > rnd`` is alive-member
@@ -503,8 +573,40 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple, sc=None):
     tgt_member = mf_t >= 0
     tgt_alive = mf_t > rnd
 
+    # -- nemesis probe legs (statics; compiled out when nem is None).
+    # All draws are B-space off the previously-unused _k_h probe key —
+    # replicated under sharding, and the baseline key schedule (k_t,
+    # k_dl, k_hl, k_gossip, ...) is untouched either way.
+    dir_nem_drop = jnp.zeros((B,), bool)
+    degraded = jnp.zeros((B,), bool)
+    if nem is not None and (nem.has_partition or nem.has_degraded):
+        k_np, k_no, k_nip, k_nio = jax.random.split(_k_h, 4)
+        in_win = _nem_in_window(nem, rnd)
+        if nem.has_partition:
+            grp = _nem_group(nem, N)
+            grp2 = jnp.concatenate([grp, grp])
+
+            def _grp_block(offset):
+                return jax.lax.dynamic_slice(grp2, ((blk + offset) % N,),
+                                             (B,))
+
+            g_p = jax.lax.dynamic_slice(grp2, (blk,), (B,))
+            g_t = _grp_block(offs[0])
+            cross_t = g_p != g_t
+            # A probe round-trip crosses both directions once, so the
+            # drop probability is direction-independent (nemesis.py).
+            p_rt = nem.p_roundtrip
+            u_np = jax.random.uniform(k_np, (B,))
+            dir_nem_drop = in_win & cross_t & (u_np < p_rt)
+        if nem.has_degraded:
+            degraded = (in_win & (pid >= nem.obs_lo) & (pid < nem.obs_hi))
+            u_no = jax.random.uniform(k_no, (B,))
+            dir_nem_drop = dir_nem_drop | (degraded
+                                           & (u_no < nem.p_obs_miss))
+
     u = jax.random.uniform(k_dl, (B,))
-    direct_fail = tgt_member & (~tgt_alive | (u < p.p_direct_fail_alive))
+    direct_fail = tgt_member & (~tgt_alive | (u < p.p_direct_fail_alive)
+                                | dir_nem_drop)
 
     if p.indirect_k:
         hu = jax.random.uniform(k_hl, (B, p.indirect_k))
@@ -513,6 +615,28 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple, sc=None):
         ind_ok = (helper_alive
                   & tgt_alive[:, None] & tgt_member[:, None]
                   & (hu >= p.p_indirect_fail_alive))
+        if nem is not None and nem.has_partition:
+            # Indirect legs: prober<->helper and helper<->target are
+            # each a cross-or-not round trip; one draw per helper at
+            # the combined drop probability (distributionally identical
+            # to independent per-leg draws — the refmodel mirrors the
+            # same combination).
+            g_h = jnp.stack([_grp_block(offs[1 + j])
+                             for j in range(p.indirect_k)], axis=1)
+            n_cross = ((g_p[:, None] != g_h).astype(jnp.int32)
+                       + (g_h != g_t[:, None]).astype(jnp.int32))
+            p_rt1 = nem.p_roundtrip
+            p_rt2 = 1.0 - (1.0 - p_rt1) * (1.0 - p_rt1)
+            p_ind = jnp.where(n_cross == 0, 0.0,
+                              jnp.where(n_cross == 1, p_rt1, p_rt2))
+            hu_p = jax.random.uniform(k_nip, (B, p.indirect_k))
+            ind_ok = ind_ok & ~(in_win & (hu_p < p_ind))
+        if nem is not None and nem.has_degraded:
+            # A degraded prober also mishandles replies relayed back by
+            # its helpers — Lifeguard's slow-observer case.
+            hu_o = jax.random.uniform(k_nio, (B, p.indirect_k))
+            ind_ok = ind_ok & ~(degraded[:, None]
+                                & (hu_o < nem.p_obs_miss))
         rescued = jnp.any(ind_ok, axis=1)
     else:
         rescued = jnp.zeros((B,), bool)
@@ -545,6 +669,34 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple, sc=None):
     else:
         cur = heard[jnp.clip(s_t, 0, S - 1), pid_c]
     init = init & ~((s_t >= 0) & ((cur >> _MSG_SHIFT) == MSG_DEAD))
+
+    # -- Lifeguard local-health multiplier (static; compiled out unless
+    # the scenario threads NemState).  A prober only initiates suspicion
+    # after more consecutive direct misses than its current LHM — with
+    # LHM 0 the gate is `streak >= 1`, true for every miss, so the
+    # baseline dynamics are bit-identical.  LHM rises on NACK-style
+    # evidence (direct miss while helpers vouch for the target: the
+    # observer, not the target, is the problem) and on being refuted
+    # (_finish_round); it falls on clean probe success.  All lanes are
+    # replicated B-space values, so the scatters below are shard-exact.
+    if nem is not None and nem_state is not None:
+        lhm, streak = nem_state
+        lhm2 = jnp.concatenate([lhm, lhm])
+        streak2 = jnp.concatenate([streak, streak])
+        lhm_b = jax.lax.dynamic_slice(lhm2, (blk,), (B,))
+        streak_b = jax.lax.dynamic_slice(streak2, (blk,), (B,))
+        miss = prober_ok & tgt_member & direct_fail
+        streak_new = jnp.where(
+            miss, jnp.minimum(streak_b + 1, nem.lhm_max + 1), 0)
+        init = init & (streak_new > lhm_b)
+        lhm_up = miss & rescued
+        lhm_dn = prober_ok & tgt_member & ~direct_fail
+        lhm_new = jnp.clip(lhm_b + lhm_up.astype(jnp.int32)
+                           - lhm_dn.astype(jnp.int32), 0, nem.lhm_max)
+        widx = jnp.where(pvalid, pid, N)
+        lhm = lhm.at[widx].set(lhm_new, mode="drop")
+        streak = streak.at[widx].set(streak_new, mode="drop")
+        nem_state = NemState(lhm=lhm, streak=streak)
 
     # All slot bookkeeping below runs in B-space (this round's probers)
     # and S-space — never N-space.  The previous formulation scattered
@@ -659,9 +811,12 @@ def _probe_tick(p: SwimParams, rnd, keys, mf, state_tuple, sc=None):
                  & tgt_member).astype(jnp.int32)),             #   escalations
         jnp.sum(init.astype(jnp.int32)),                       # suspicions
     )
-    return (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
-            slot_dead_round, slot_of_node, incarnation, member,
-            drops), probe_stats
+    out_carry = (heard, slot_node, slot_phase, slot_inc, slot_start,
+                 slot_nsusp, slot_dead_round, slot_of_node, incarnation,
+                 member, drops)
+    if nem_state is not None:
+        return out_carry, probe_stats, nem_state
+    return out_carry, probe_stats
 
 
 @functools.partial(jax.jit, static_argnames=("p",),
@@ -700,7 +855,9 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
                      fail_round: jnp.ndarray, p: SwimParams,
                      join_round: jnp.ndarray | None, collect: bool,
                      sc: _ShardCtx | None = None,
-                     hist: HistBank | None = None):
+                     hist: HistBank | None = None,
+                     nem: NemesisParams | None = None,
+                     nem_state: NemState | None = None):
     """One round + (optionally) its flight-recorder row + histograms.
 
     ``collect`` is a PYTHON-level static: False compiles exactly the
@@ -715,14 +872,32 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
     ``hist`` (optional HistBank, also Python-level static): thread the
     observatory banks through the round — _finish_round accumulates at
     the verdict/GC sites, a quiescent round passes them through
-    untouched (no episodes -> nothing to observe).  Returns
-    ``(state, row, hist)``; row/hist are None when compiled out."""
+    untouched (no episodes -> nothing to observe).
+
+    ``nem`` (optional NemesisParams, static): apply a nemesis injection
+    schedule — kill/flap/heal rewrites of the ground-truth inputs here,
+    cross-partition drop legs in the probe/gossip/push-pull phases, and
+    (with ``nem_state``) the Lifeguard LHM dynamics.  ``None`` compiles
+    every injection point out — bit-identical to the baseline round.
+
+    Returns ``(state, row, hist, nem_state)``; legs are None when
+    compiled out."""
     rnd = state.round
     key = jax.random.fold_in(base_key, rnd)
     k_probe = jax.random.split(jax.random.fold_in(key, 1), 4)
     k_gossip = jax.random.fold_in(key, 2)
 
     N, S = p.n, p.slots
+    if nem is not None:
+        # The kills half of the schedule: flap square waves and the
+        # post-heal rejoin rewrite fail_round/join_round before any
+        # phase reads them.
+        if nem.needs_join and join_round is None:
+            raise ValueError(
+                f"nemesis scenario {nem.scenario!r} rewrites join_round; "
+                "pass a join_round array (all-NEVER works)")
+        fail_round, join_round = _nem_schedule(nem, rnd, fail_round,
+                                               join_round)
     alive = fail_round > rnd
 
     carry = (state.heard, state.slot_node, state.slot_phase, state.slot_inc,
@@ -749,7 +924,12 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
     # FIRST, on the un-aged matrix: its decisions read only msg/conf
     # bits, and its fresh marks carry the _AGE_FRESH sentinel that the
     # tail's age tick turns into age 0 --------------------------------
-    carry, probe_stats = _probe_tick(p, rnd, k_probe, mf, carry, sc)
+    if nem is not None and nem_state is not None:
+        carry, probe_stats, nem_state = _probe_tick(
+            p, rnd, k_probe, mf, carry, sc, nem, nem_state)
+    else:
+        carry, probe_stats = _probe_tick(p, rnd, k_probe, mf, carry, sc,
+                                         nem)
     (heard, slot_node, slot_phase, slot_inc, slot_start, slot_nsusp,
      slot_dead_round, slot_of_node, incarnation, member, drops) = carry
 
@@ -776,10 +956,26 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
             # symmetric, as memberlist's push/pull TCP sync is.
             o = jax.random.randint(kpp, (), 1, N, dtype=jnp.int32)
             rxl = sub_rx_ok if sc is None else _sloc(sc, sub_rx_ok)
-            for shift in (o, -o):
+            for j, shift in enumerate((o, -o)):
                 mfl = (jnp.roll(mf, shift) if sc is None
                        else _sloc_roll(sc, mf, shift))
                 ok = rxl & (mfl > rnd)
+                if nem is not None and nem.has_partition:
+                    # Cross-group sync legs drop at the sender-group
+                    # edge probability.  Full-[N] draws off a replicated
+                    # key, sliced per shard — bit-parity preserved.
+                    grp = _nem_group(nem, N)
+                    g_src = (jnp.roll(grp, shift) if sc is None
+                             else _sloc_roll(sc, grp, shift))
+                    g_dst = grp if sc is None else _sloc(sc, grp)
+                    p_edge = jnp.where(g_src == 0, nem.p_ab, nem.p_ba)
+                    dv_full = jax.random.uniform(
+                        jax.random.fold_in(jax.random.fold_in(key, 5), j),
+                        (N,))
+                    dv = dv_full if sc is None else _sloc(sc, dv_full)
+                    drop = (_nem_in_window(nem, rnd) & (g_src != g_dst)
+                            & (dv < p_edge))
+                    ok = ok & ~drop
                 hin = (jnp.roll(h, shift, axis=1) if sc is None
                        else _roll_sharded(sc, h, shift))
                 upgraded = (((hin >> _MSG_SHIFT) > (h >> _MSG_SHIFT))
@@ -790,20 +986,42 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
         return jax.lax.cond(rnd % p.pushpull_every == p.pushpull_every - 1,
                             _pushpull, lambda h: h, h)
 
+    # The loss half of the nemesis schedule needs per-leg drop draws in
+    # the dissemination phase; key 4 is reserved for it (5 = push/pull).
+    k_nem = (jax.random.fold_in(key, 4)
+             if nem is not None and nem.has_partition else None)
+    has_ns = nem_state is not None
+
+    def _tail_unpack(op):
+        if hist is None and not has_ns:
+            return op, None, None
+        parts = list(op)
+        heard = parts.pop(0)
+        hb = parts.pop(0) if hist is not None else None
+        nsv = parts.pop(0) if has_ns else None
+        return heard, hb, nsv
+
+    def _tail_pack(heard, hb, nsv):
+        if hist is None and not has_ns:
+            return heard
+        return ((heard,) + ((hb,) if hist is not None else ())
+                + ((nsv,) if has_ns else ()))
+
     def _full_tail(op):
-        heard, hb = (op, None) if hist is None else op
+        heard, hb, nsv = _tail_unpack(op)
         # -- 2+3. age (fused into the dissemination pack) + gossip push
         # via circulant rolls ---------------------------------------------
-        heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap, sc)
+        heard = _disseminate(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap,
+                             sc, nem, k_nem)
         heard = _maybe_pushpull(heard, rx_ok)
         return _finish_round(p, state, rnd, fail_round, alive, member, heard,
                              None, jnp.arange(S, dtype=jnp.int32), slot_node,
                              slot_phase, slot_inc, slot_start, slot_nsusp,
                              slot_dead_round, slot_of_node, incarnation,
-                             drops, conf_cap, rx_ok, sc, hb)
+                             drops, conf_cap, rx_ok, sc, hb, nem, nsv)
 
     def _hot_tail(op):
-        heard, hb = (op, None) if hist is None else op
+        heard, hb, nsv = _tail_unpack(op)
         # A handful of live episodes: slice just their belief rows, run
         # the identical age/gossip/timer pipeline on the [H, N] subset,
         # write back.  Inactive rows are all-zero, so excluding them
@@ -824,16 +1042,16 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
             jax.lax.dynamic_slice_in_dim(heard, idx[j], 1, axis=0)
             for j in range(p.hot_slots)], axis=0)
         sub = _disseminate(p, rnd, k_gossip, sub, mf, rx_ok, conf_cap[idx],
-                           sc)
+                           sc, nem, k_nem)
         sub = _maybe_pushpull(sub, rx_ok)
         return _finish_round(p, state, rnd, fail_round, alive, member, sub,
                              heard, idx, slot_node, slot_phase, slot_inc,
                              slot_start, slot_nsusp, slot_dead_round,
                              slot_of_node, incarnation, drops, conf_cap,
-                             rx_ok, sc, hb)
+                             rx_ok, sc, hb, nem, nsv)
 
     def _quiescent_tail(op):
-        heard, hb = (op, None) if hist is None else op
+        heard, hb, nsv = _tail_unpack(op)
         # No active episode anywhere: the belief matrix is all-zero and
         # every age/gossip/timer/GC pass is a no-op.  A healthy cluster
         # pays only the probe tick per round.  No episodes -> nothing
@@ -847,7 +1065,7 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
             sum_detect_rounds=state.sum_detect_rounds,
             n_false_dead=state.n_false_dead, n_refuted=state.n_refuted,
         )
-        return st if hist is None else (st, hb)
+        return _tail_pack(st, hb, nsv)
 
     n_active = jnp.sum((slot_node >= 0).astype(jnp.int32))
 
@@ -858,10 +1076,10 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
         return _full_tail(op)
 
     out = jax.lax.cond(n_active > 0, _nonquiescent, _quiescent_tail,
-                       heard if hist is None else (heard, hist))
-    new_state, hist_out = (out, None) if hist is None else out
+                       _tail_pack(heard, hist, nem_state))
+    new_state, hist_out, ns_out = _tail_unpack(out)
     if not collect:
-        return new_state, None, hist_out
+        return new_state, None, hist_out, ns_out
 
     # -- flight row (obs.flight.FLIGHT_COLS order) ------------------------
     # Dissemination bytes: every in-budget rumor entry is pushed to
@@ -893,7 +1111,7 @@ def _swim_round_impl(state: SwimState, base_key: jax.Array,
         new_state.drops - state.drops,                     # drops
         jnp.sum(new_state.member.astype(jnp.int32)),       # members
     ]).astype(jnp.int32)
-    return new_state, row, hist_out
+    return new_state, row, hist_out, ns_out
 
 
 def gossip_offsets(key: jax.Array, n: int, fanout: int) -> jnp.ndarray:
@@ -956,21 +1174,43 @@ def _byte_sel(mask, a, b):
 
 
 def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
-                 conf_cap, sc=None) -> jnp.ndarray:
+                 conf_cap, sc=None, nem=None, k_nem=None) -> jnp.ndarray:
     """One round of rumor push: ``fanout`` circulant-shift deliveries,
     merged per destination with message-priority + Lifeguard
     confirmation counting.  Dispatches on ``p.dissem_swar`` (static):
     the two strategies are bit-identical (tested); the flag exists for
-    an on-chip A/B and a one-line fallback."""
+    an on-chip A/B and a one-line fallback.
+
+    ``nem``/``k_nem`` (static / replicated key): a partitioned nemesis
+    schedule drops each cross-group delivery leg at the sender-group
+    edge probability — per-leg full-[N] draws off ``k_nem`` (replicated,
+    shard-sliced, so sharded and single-device rounds stay
+    bit-identical)."""
     if p.dissem_swar:
         return _disseminate_swar(p, rnd, k_gossip, heard, mf, rx_ok,
-                                 conf_cap, sc)
+                                 conf_cap, sc, nem, k_nem)
     return _disseminate_planes(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap,
-                               sc)
+                               sc, nem, k_nem)
+
+
+def _nem_leg_drop(p: SwimParams, nem, k_nem, rnd, f, o, sc):
+    """Per-destination drop mask for gossip leg ``f`` (shift ``o``):
+    the sender into destination d is d - o, so the sender group is the
+    rolled group vector; cross-group lanes drop at the sender-group
+    edge probability inside the fault window.  Returns a local-[L]
+    (or [N]) bool mask."""
+    N = p.n
+    grp = _nem_group(nem, N)
+    g_src = jnp.roll(grp, o) if sc is None else _sloc_roll(sc, grp, o)
+    g_dst = grp if sc is None else _sloc(sc, grp)
+    p_edge = jnp.where(g_src == 0, nem.p_ab, nem.p_ba)
+    dv_full = jax.random.uniform(jax.random.fold_in(k_nem, f), (N,))
+    dv = dv_full if sc is None else _sloc(sc, dv_full)
+    return _nem_in_window(nem, rnd) & (g_src != g_dst) & (dv < p_edge)
 
 
 def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
-                      conf_cap, sc=None) -> jnp.ndarray:
+                      conf_cap, sc=None, nem=None, k_nem=None) -> jnp.ndarray:
     """The belief matrix moves as u32 words holding FOUR slot-rows per
     element; the whole merge is SWAR on those words — one fused
     elementwise pass that reads the current matrix and the ``fanout``
@@ -1017,7 +1257,11 @@ def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
         # replicated mf is a local slice of its doubled copy).
         o = offs[f]
         mf_r = jnp.roll(mf, o) if sc is None else _sloc_roll(sc, mf, o)
-        src = jnp.where(mf_r > rnd,
+        src_live = mf_r > rnd
+        if nem is not None and nem.has_partition:
+            src_live = src_live & ~_nem_leg_drop(p, nem, k_nem, rnd, f, o,
+                                                 sc)
+        src = jnp.where(src_live,
                         jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[None, :]
         pin = (jnp.roll(packed, o, axis=1) if sc is None
                else _roll_sharded(sc, packed, o))
@@ -1063,7 +1307,8 @@ def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
 
 
 def _disseminate_planes(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
-                        conf_cap, sc=None) -> jnp.ndarray:
+                        conf_cap, sc=None, nem=None,
+                        k_nem=None) -> jnp.ndarray:
     """The round-3 strategy (kept for A/B + fallback, see
     ``_disseminate``): merge logic runs per byte-plane on native
     u32 lanes, producing four [S4, N] plane outputs.  Measured
@@ -1097,6 +1342,8 @@ def _disseminate_planes(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
         o = offs[f]
         src_ok = (jnp.roll(mf, o) if sc is None
                   else _sloc_roll(sc, mf, o)) > rnd
+        if nem is not None and nem.has_partition:
+            src_ok = src_ok & ~_nem_leg_drop(p, nem, k_nem, rnd, f, o, sc)
         pins.append(((jnp.roll(packed, o, axis=1) if sc is None
                       else _roll_sharded(sc, packed, o)), src_ok))
 
@@ -1146,7 +1393,7 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
                   member, heard_sub, full_heard, idx, slot_node, slot_phase,
                   slot_inc, slot_start, slot_nsusp, slot_dead_round,
                   slot_of_node, incarnation, drops, conf_cap,
-                  rx_ok, sc=None, hist=None):
+                  rx_ok, sc=None, hist=None, nem=None, nem_state=None):
     """Refutation, suspicion-timer firing, episode GC, stats.
 
     Operates on ``heard_sub`` — the belief rows of the slots listed in
@@ -1157,8 +1404,15 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
 
     ``hist`` (optional HistBank, a Python-level static like the flight
     ``collect`` flag): accumulate the observatory histograms at the
-    verdict/GC sites and return ``(state, hist)``; ``None`` compiles
-    them out entirely and returns the bare state."""
+    verdict/GC sites; ``None`` compiles them out entirely.
+
+    ``nem``/``nem_state`` (statics): with LHM threaded, a subject that
+    had to refute a suspicion about itself just learned it answers
+    probes too slowly — its own LHM rises (Lifeguard increments the
+    local health multiplier on self-refutation, alongside the probe
+    tick's missed-ack/NACK signals).  Returns the state packed with
+    whichever of hist/nem_state are threaded (matching the round
+    tails' ``_tail_pack`` order: state[, hist][, nem_state])."""
     N, S = p.n, p.slots
     H = idx.shape[0]
     is_full = full_heard is None
@@ -1208,6 +1462,18 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
             heard_sub = heard_sub.at[hrows, jnp.where(owned, loc, sc.L)].max(
                 refute_val, mode="drop")
         n_refuted = n_refuted + jnp.sum(refute_now.astype(jnp.int32))  # noqa: O01 — monotone mod 2**32 (SwimState wrap convention)
+
+    if nem is not None and nem_state is not None:
+        # Lifeguard: self-refutation bumps the refuter's own LHM (it
+        # answered a suspicion too slowly to prevent it).  refute_now is
+        # replicated (psum-merged own_msg under sharding) and slot
+        # subjects are distinct node ids, so the scatter is shard-exact
+        # and collision-free; the min clamps keep the register bounded.
+        lhm_r, streak_r = nem_state
+        lhm_r = jnp.minimum(
+            lhm_r.at[jnp.where(refute_now, node_c, N)].add(1, mode="drop"),  # noqa: O01 — clamped to nem.lhm_max every round: carry-in <= lhm_max, +1/slot, min() bounds it
+            nem.lhm_max)
+        nem_state = NemState(lhm=lhm_r, streak=streak_r)
 
     # -- 5. suspicion timers fire -> dead declared ------------------------
     tbl = jnp.asarray(p.timeout_table())
@@ -1334,7 +1600,9 @@ def _finish_round(p: SwimParams, state: SwimState, rnd, fail_round, alive,
         n_false_dead=n_false_dead,
         n_refuted=n_refuted,
     )
-    return st if hist is None else (st, hist)
+    out = ((st,) + ((hist,) if hist is not None else ())
+           + ((nem_state,) if nem_state is not None else ()))
+    return out[0] if len(out) == 1 else out
 
 
 class RoundTrace(NamedTuple):
@@ -1349,22 +1617,25 @@ class RoundTrace(NamedTuple):
                                  #   rumor (join announcements / refutes)
 
 
-@functools.partial(jax.jit, static_argnames=("p", "steps", "trace", "unroll"),
-                   donate_argnames=("state", "flight", "hist"))
+@functools.partial(jax.jit,
+                   static_argnames=("p", "steps", "trace", "unroll", "nem"),
+                   donate_argnames=("state", "flight", "hist", "nem_state"))
 def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
                p: SwimParams, steps: int, trace: bool = False,
                unroll: int = 4, join_round: jnp.ndarray | None = None,
                flight: FlightRing | None = None,
-               hist: HistBank | None = None):
+               hist: HistBank | None = None,
+               nem: NemesisParams | None = None,
+               nem_state: NemState | None = None):
     """Scan ``steps`` rounds.  With ``trace``, also return per-round slot
     snapshots for detection-curve analysis (adds one S×N reduction/round).
     ``unroll`` fuses that many rounds per scan iteration — amortizes
     per-iteration dispatch/sync on backends where that dominates.
 
-    ``state``, ``flight`` and ``hist`` are DONATED: the belief matrix,
-    the ring and the banks are updated in place instead of copied per
-    dispatch (64 MB per copy at 1M nodes).  Callers must rebind all and
-    never reuse the passed-in arrays afterwards.
+    ``state``, ``flight``, ``hist`` and ``nem_state`` are DONATED: the
+    belief matrix, the ring and the banks are updated in place instead
+    of copied per dispatch (64 MB per copy at 1M nodes).  Callers must
+    rebind all and never reuse the passed-in arrays afterwards.
 
     ``flight`` (optional FlightRing): record one flight-recorder row
     per round into the on-device ring at ``cursor % R`` — no host
@@ -1373,30 +1644,44 @@ def run_rounds(state: SwimState, base_key: jax.Array, fail_round: jnp.ndarray,
 
     ``hist`` (optional HistBank): accumulate the detection-latency
     observatory histograms in HBM (obs/hist.py bucket layouts), drained
-    on the same cadence.  Each optional extends the scan carry and the
-    first return value in order: ``state``, ``(state, flight)``,
-    ``(state, hist)``, or ``(state, flight, hist)``; ``None`` compiles
-    the machinery out entirely."""
+    on the same cadence.
+
+    ``nem`` (optional NemesisParams, STATIC — part of the jit cache
+    key): run every round under a nemesis injection schedule
+    (gossip/nemesis.py).  A scenario with ``needs_state`` additionally
+    threads ``nem_state`` (kernel.NemState) through the carry for the
+    Lifeguard LHM dynamics.  Each optional extends the scan carry and
+    the first return value in order: ``state``[, ``flight``][,
+    ``hist``][, ``nem_state``]; ``None`` compiles the machinery out
+    entirely."""
+    if nem is not None and nem.needs_state and nem_state is None:
+        raise ValueError(
+            f"nemesis scenario {nem.scenario!r} needs NemState; pass "
+            "nem_state=init_nem_state(p.n)")
     return _run_rounds_impl(state, base_key, fail_round, p, steps, trace,
-                            unroll, join_round, flight, None, hist)
+                            unroll, join_round, flight, None, hist, nem,
+                            nem_state)
 
 
 def _run_rounds_impl(state, base_key, fail_round, p, steps, trace, unroll,
-                     join_round, flight, sc, hist=None):
+                     join_round, flight, sc, hist=None, nem=None,
+                     nem_state=None):
     has_fl = flight is not None
     has_hb = hist is not None
+    has_ns = nem_state is not None
 
     def body(carry, _):
-        if has_fl or has_hb:
+        if has_fl or has_hb or has_ns:
             parts = list(carry)
             st = parts.pop(0)
             fl = parts.pop(0) if has_fl else None
             hb = parts.pop(0) if has_hb else None
+            ns = parts.pop(0) if has_ns else None
         else:
-            st, fl, hb = carry, None, None
-        st, row, hb = _swim_round_impl(st, base_key, fail_round, p,
-                                       join_round, collect=has_fl, sc=sc,
-                                       hist=hb)
+            st, fl, hb, ns = carry, None, None, None
+        st, row, hb, ns = _swim_round_impl(st, base_key, fail_round, p,
+                                           join_round, collect=has_fl, sc=sc,
+                                           hist=hb, nem=nem, nem_state=ns)
         if has_fl:
             R = fl.rows.shape[0]
             fl = FlightRing(
@@ -1417,11 +1702,13 @@ def _run_rounds_impl(state, base_key, fail_round, p, steps, trace, unroll,
                            st.slot_dead_round, n_heard_dead, n_heard_alive)
         else:
             y = None
-        out = (st,) + ((fl,) if has_fl else ()) + ((hb,) if has_hb else ())
+        out = ((st,) + ((fl,) if has_fl else ()) + ((hb,) if has_hb else ())
+               + ((ns,) if has_ns else ()))
         return (out if len(out) > 1 else st), y
 
     init = ((state,) + ((flight,) if has_fl else ())
-            + ((hist,) if has_hb else ()))
+            + ((hist,) if has_hb else ())
+            + ((nem_state,) if has_ns else ()))
     if len(init) == 1:
         init = state
     return jax.lax.scan(body, init, None, length=steps,
@@ -1482,13 +1769,16 @@ def shard_state(state: SwimState, ndev: int | None = None) -> SwimState:
 
 @functools.lru_cache(maxsize=None)
 def sharded_round_callable(p: SwimParams, ndev: int, has_join: bool = False,
-                           has_hist: bool = False):
+                           has_hist: bool = False,
+                           nem: NemesisParams | None = None,
+                           has_nem_state: bool = False):
     """The shard_map-wrapped single round, NOT jitted: composes inside
     outer jits (multidc_round's per-DC loop) or under the donating jit
     of ``swim_round_sharded``.  Signature: (state, base_key, fail_round
-    [, join_round][, hist]) -> state, or (state, hist) with
-    ``has_hist`` (the banks are replicated — every increment derives
-    from replicated or psum-merged values)."""
+    [, join_round][, hist][, nem_state]) -> state packed with whichever
+    of hist/nem_state are threaded (the banks and the LHM registers are
+    replicated — every increment derives from replicated or psum-merged
+    values)."""
     from jax.experimental.shard_map import shard_map
     _check_shardable(p, ndev)
     mesh = _shard_mesh(ndev)
@@ -1496,21 +1786,32 @@ def sharded_round_callable(p: SwimParams, ndev: int, has_join: bool = False,
     Ps = jax.sharding.PartitionSpec
     st = _state_spec()
     hb = HistBank(*([Ps()] * len(HistBank._fields)))
+    ns = NemState(*([Ps()] * len(NemState._fields)))
     in_specs = ((st, Ps(), Ps()) + ((Ps(),) if has_join else ())
-                + ((hb,) if has_hist else ()))
-    out_specs = (st, hb) if has_hist else st
+                + ((hb,) if has_hist else ())
+                + ((ns,) if has_nem_state else ()))
+    out_specs = ((st,) + ((hb,) if has_hist else ())
+                 + ((ns,) if has_nem_state else ()))
+    if len(out_specs) == 1:
+        out_specs = st
 
     def _round(state, base_key, fail_round, *rest):
         i = 0
-        join_round = hist = None
+        join_round = hist = nem_state = None
         if has_join:
             join_round = rest[i]
             i += 1
         if has_hist:
             hist = rest[i]
+            i += 1
+        if has_nem_state:
+            nem_state = rest[i]
         out = _swim_round_impl(state, base_key, fail_round, p, join_round,
-                               collect=False, sc=sc, hist=hist)
-        return (out[0], out[2]) if has_hist else out[0]
+                               collect=False, sc=sc, hist=hist, nem=nem,
+                               nem_state=nem_state)
+        packed = ((out[0],) + ((out[2],) if has_hist else ())
+                  + ((out[3],) if has_nem_state else ()))
+        return packed[0] if len(packed) == 1 else packed
 
     return shard_map(_round, mesh=mesh, in_specs=in_specs,
                      out_specs=out_specs, check_rep=False)
@@ -1539,7 +1840,9 @@ def swim_round_sharded(state: SwimState, base_key: jax.Array,
 @functools.lru_cache(maxsize=None)
 def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
                             trace: bool, unroll: int, has_join: bool,
-                            has_flight: bool, has_hist: bool):
+                            has_flight: bool, has_hist: bool,
+                            nem: NemesisParams | None = None,
+                            has_nem_state: bool = False):
     from jax.experimental.shard_map import shard_map
     _check_shardable(p, ndev)
     mesh = _shard_mesh(ndev)
@@ -1548,12 +1851,15 @@ def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
     st = _state_spec()
     fl = FlightRing(rows=Ps(), cursor=Ps())
     hb = HistBank(*([Ps()] * len(HistBank._fields)))
+    ns = NemState(*([Ps()] * len(NemState._fields)))
     in_specs = ((st, Ps(), Ps())
                 + ((Ps(),) if has_join else ())
                 + ((fl,) if has_flight else ())
-                + ((hb,) if has_hist else ()))
+                + ((hb,) if has_hist else ())
+                + ((ns,) if has_nem_state else ()))
     carry_spec = ((st,) + ((fl,) if has_flight else ())
-                  + ((hb,) if has_hist else ()))
+                  + ((hb,) if has_hist else ())
+                  + ((ns,) if has_nem_state else ()))
     if len(carry_spec) == 1:
         carry_spec = st
     tr = RoundTrace(*([Ps()] * len(RoundTrace._fields)))
@@ -1561,7 +1867,7 @@ def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
 
     def _run(state, base_key, fail_round, *rest):
         i = 0
-        join_round = flight = hist = None
+        join_round = flight = hist = nem_state = None
         if has_join:
             join_round = rest[i]
             i += 1
@@ -1570,9 +1876,12 @@ def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
             i += 1
         if has_hist:
             hist = rest[i]
+            i += 1
+        if has_nem_state:
+            nem_state = rest[i]
         carry, ys = _run_rounds_impl(state, base_key, fail_round, p, steps,
                                      trace, unroll, join_round, flight, sc,
-                                     hist)
+                                     hist, nem, nem_state)
         return (carry, ys) if trace else carry
 
     donate = (0,)
@@ -1580,6 +1889,8 @@ def _run_rounds_sharded_jit(p: SwimParams, ndev: int, steps: int,
         donate += (3 + int(has_join),)
     if has_hist:
         donate += (3 + int(has_join) + int(has_flight),)
+    if has_nem_state:
+        donate += (3 + int(has_join) + int(has_flight) + int(has_hist),)
     return jax.jit(shard_map(_run, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=False),
                    donate_argnums=donate)
@@ -1591,17 +1902,25 @@ def run_rounds_sharded(state: SwimState, base_key: jax.Array,
                        join_round: jnp.ndarray | None = None,
                        flight: FlightRing | None = None,
                        hist: HistBank | None = None,
+                       nem: NemesisParams | None = None,
+                       nem_state: NemState | None = None,
                        ndev: int | None = None):
     """``run_rounds`` sharded across ``ndev`` devices (default: all
     local devices) — same contract and bit-identical results; ``state``,
-    ``flight`` and ``hist`` donated.  Compute and HBM traffic for the
-    belief matrix drop by ``ndev``; the circulant deliveries pay a
-    log2(ndev) ppermute halo exchange instead.  Constraints: n
-    divisible by ndev and by probe_every (_check_shardable)."""
+    ``flight``, ``hist`` and ``nem_state`` donated.  Compute and HBM
+    traffic for the belief matrix drop by ``ndev``; the circulant
+    deliveries pay a log2(ndev) ppermute halo exchange instead.
+    Constraints: n divisible by ndev and by probe_every
+    (_check_shardable)."""
+    if nem is not None and nem.needs_state and nem_state is None:
+        raise ValueError(
+            f"nemesis scenario {nem.scenario!r} needs NemState; pass "
+            "nem_state=init_nem_state(p.n)")
     ndev = ndev or _default_ndev()
     fn = _run_rounds_sharded_jit(p, ndev, steps, trace, unroll,
                                  join_round is not None, flight is not None,
-                                 hist is not None)
+                                 hist is not None, nem,
+                                 nem_state is not None)
     args = [state, base_key, fail_round]
     if join_round is not None:
         args.append(join_round)
@@ -1609,5 +1928,7 @@ def run_rounds_sharded(state: SwimState, base_key: jax.Array,
         args.append(flight)
     if hist is not None:
         args.append(hist)
+    if nem_state is not None:
+        args.append(nem_state)
     out = fn(*args)
     return out if trace else (out, None)

@@ -137,6 +137,15 @@ class PlaneConfig:
     # can land when nothing goes wrong).
     slo_objective_rounds: int = 0
     slo_attainment_target: float = 0.99
+    # Nemesis scenario to run the kernel under (gossip/nemesis.py
+    # catalog name; "" = none).  The scenario's injection schedule —
+    # partition/asymmetric-loss edge drops, flapping, degraded
+    # observers — applies to every dispatch, its scheduled kills merge
+    # into the heartbeat-driven fail rounds, and every drained
+    # histogram delta is attributed to the scenario label, giving the
+    # SLO observatory a per-failure-mode breakdown (/v1/agent/slo
+    # ``scenarios``, scenario-labeled Prometheus histograms).
+    nemesis: str = ""
 
 
 @dataclass
@@ -217,6 +226,10 @@ class GossipPlane:
         self._hist = None                    # kernel.HistBank (device)
         self._hist_recorder = None           # obs.hist.HistRecorder
         self._slo = None                     # obs.slo.SloTracker
+        self._slo_board = None               # obs.slo.SloBoard (nemesis)
+        self._nem = None                     # nemesis.NemesisParams
+        self._nem_state = None               # kernel.NemState (device)
+        self._nem_fail = None                # scheduled kills (np i32 [n])
 
     # -- universe ----------------------------------------------------------
 
@@ -291,12 +304,27 @@ class GossipPlane:
 
         from consul_tpu.gossip.events import init_events, run_event_rounds
         from consul_tpu.gossip.kernel import (
-            _check_shardable, init_flight, init_hist, run_rounds,
-            run_rounds_sharded, shard_state)
+            _check_shardable, init_flight, init_hist, init_nem_state,
+            run_rounds, run_rounds_sharded, shard_state)
         from consul_tpu.obs.flight import FlightRecorder
         from consul_tpu.obs.hist import HistRecorder
-        from consul_tpu.obs.slo import SloTracker
+        from consul_tpu.obs.slo import SloBoard, SloTracker
         self._ev_state = init_events(self._p, slots=c.event_slots)
+        # Nemesis injection (config docstring): the schedule is a jit
+        # static, the scenario's static kills merge into the dispatch
+        # fail rounds, and LHM scenarios thread NemState through the
+        # donated carry.
+        self._nem = None
+        self._nem_state = None
+        self._nem_fail = None
+        if c.nemesis:
+            from consul_tpu.gossip.nemesis import build as build_nemesis
+            sc = build_nemesis(c.nemesis, n)
+            self._nem = sc.nem
+            self._nem_fail = (np.asarray(sc.fail_round)
+                              if bool(sc.killed.any()) else None)
+            if sc.nem.needs_state:
+                self._nem_state = init_nem_state(n)
         # Resolve the device count for the sharded round (config
         # docstring: 1 = off, >1 = explicit/strict, 0 = auto when the
         # alignment constraints hold).
@@ -310,16 +338,19 @@ class GossipPlane:
             self._state = shard_state(self._state, ndev)
         self._ndev = ndev
         if ndev > 1:
-            def _run(state, key, fail, steps, join_round, flight, hist):
+            def _run(state, key, fail, steps, join_round, flight, hist,
+                     nem_state=None):
                 return run_rounds_sharded(
                     state, key, fail, self._p, steps=steps, trace=True,
                     join_round=join_round, flight=flight, hist=hist,
-                    ndev=self._ndev)
+                    nem=self._nem, nem_state=nem_state, ndev=self._ndev)
         else:
-            def _run(state, key, fail, steps, join_round, flight, hist):
+            def _run(state, key, fail, steps, join_round, flight, hist,
+                     nem_state=None):
                 return run_rounds(
                     state, key, fail, self._p, steps=steps, trace=True,
-                    join_round=join_round, flight=flight, hist=hist)
+                    join_round=join_round, flight=flight, hist=hist,
+                    nem=self._nem, nem_state=nem_state)
         self._run = _run
         # Flight ring sized so a full drain interval fits with headroom
         # (bounded-burst catch-up can run up to max_burst extra
@@ -336,14 +367,19 @@ class GossipPlane:
             self._p.suspicion_max_rounds + self._p.probe_every)
         self._slo = SloTracker(objective,
                                attainment_target=c.slo_attainment_target)
-        # run_rounds donates state+flight+hist: warm up on copies so the
-        # session arrays survive the throwaway compile dispatch.
+        self._slo_board = SloBoard(
+            objective, attainment_target=c.slo_attainment_target)
+        # run_rounds donates state+flight+hist (+nem_state): warm up on
+        # copies so the session arrays survive the throwaway compile
+        # dispatch.
         jax.block_until_ready(self._run(
             jax.tree.map(jnp.copy, self._state), self._key,
             jnp.asarray(self._fail), STEPS_PER_TICK,
             jnp.asarray(self._join),
             jax.tree.map(jnp.copy, self._flight),
-            jax.tree.map(jnp.copy, self._hist))[0])
+            jax.tree.map(jnp.copy, self._hist),
+            (jax.tree.map(jnp.copy, self._nem_state)
+             if self._nem_state is not None else None))[0])
         jax.block_until_ready(run_event_rounds(
             self._ev_state, self._key, self._state.member, self._p,
             steps=STEPS_PER_TICK)[0])
@@ -501,10 +537,19 @@ class GossipPlane:
 
         from consul_tpu.gossip.kernel import PHASE_DEAD
 
-        (state, self._flight, self._hist), trace = self._run(
-            self._state, self._key, jnp.asarray(self._fail),
+        fail = self._fail
+        if self._nem_fail is not None:
+            # Scenario-scheduled kills (absolute kernel rounds) override
+            # live heartbeats — an injected fault IS the node failing.
+            fail = np.minimum(fail, self._nem_fail)
+        out, trace = self._run(
+            self._state, self._key, jnp.asarray(fail),
             STEPS_PER_TICK, jnp.asarray(self._join), self._flight,
-            self._hist)
+            self._hist, self._nem_state)
+        if self._nem_state is not None:
+            state, self._flight, self._hist, self._nem_state = out
+        else:
+            state, self._flight, self._hist = out
         self._state = state
         self._rounds_done += STEPS_PER_TICK
         # Amortized drain: one host transfer per FLIGHT_DRAIN_EVERY
@@ -650,11 +695,16 @@ class GossipPlane:
         drain cadence; also called on-demand for an ``slo`` query."""
         if self._hist is None or self._hist_recorder is None:
             return
+        scenario = self._nem.scenario if self._nem is not None else None
         deltas = self._hist_recorder.ingest(
             {f: np.asarray(getattr(self._hist, f))
-             for f in self._hist._fields})
-        if self._slo is not None and "detect" in deltas:
-            self._slo.observe(deltas["detect"])
+             for f in self._hist._fields},
+            scenario=scenario)
+        if "detect" in deltas:
+            if self._slo is not None:
+                self._slo.observe(deltas["detect"])
+            if scenario and self._slo_board is not None:
+                self._slo_board.observe(scenario, deltas["detect"])
 
     def event_coverage(self) -> Dict[int, float]:
         """Live event slots -> fraction of members holding the event
@@ -760,11 +810,23 @@ class GossipPlane:
         banks first (on-demand sync — fine for an operator query)."""
         self._drain_hist()
         out: Dict[str, Any] = {"t": "slo"}
+        if self._nem is not None:
+            out["scenario"] = self._nem.scenario
         if self._slo is not None:
             out["slo"] = self._slo.snapshot()
         if self._hist_recorder is not None:
             out["latency"] = self._hist_recorder.summary()
             out["hists"] = self._hist_recorder.families()
+            # Per-scenario breakdown: one burn-rate + percentile row per
+            # nemesis scenario that has attributed detections.
+            board = (self._slo_board.snapshot()
+                     if self._slo_board is not None else {})
+            scns = self._hist_recorder.scenarios()
+            if scns:
+                out["scenarios"] = {
+                    scn: {"slo": board.get(scn),
+                          "latency": self._hist_recorder.summary(scn)}
+                    for scn in scns}
         return out
 
     def _profile_wire(self, steps: int, phases: bool = False
@@ -794,7 +856,9 @@ class GossipPlane:
                     jax.tree.map(jnp.copy, self._state), self._key, fail,
                     STEPS_PER_TICK, join,
                     jax.tree.map(jnp.copy, self._flight),
-                    jax.tree.map(jnp.copy, self._hist))
+                    jax.tree.map(jnp.copy, self._hist),
+                    (jax.tree.map(jnp.copy, self._nem_state)
+                     if self._nem_state is not None else None))
                 return out[0][0]
 
             trace_dir = tempfile.mkdtemp(prefix="consul-tpu-profile-")

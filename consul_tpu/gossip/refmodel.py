@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from consul_tpu.gossip.nemesis import NemesisParams, group_of
 from consul_tpu.gossip.params import SwimParams
 
 ALIVE, SUSPECT, DEAD = 0, 1, 2
@@ -78,11 +79,27 @@ class RefModel:
     """Per-node discrete-event SWIM simulation."""
 
     def __init__(self, p: SwimParams, fail_tick: Dict[int, int], seed: int = 0,
-                 join_tick: Optional[Dict[int, int]] = None):
+                 join_tick: Optional[Dict[int, int]] = None,
+                 nemesis: Optional[NemesisParams] = None):
         self.p = p
         self.n = p.n
         self.rng = random.Random(seed)
         self.fail_tick = dict(fail_tick)
+        # Nemesis schedule (gossip/nemesis.py): the oracle models the
+        # SAME correlated faults the kernel injects — partition /
+        # asymmetric-loss edge drops, flapping truth overrides with
+        # rejoin-on-up-edge, heal rejoin, degraded-observer reply drops
+        # and the Lifeguard local-health multiplier.
+        self.nemesis = nemesis
+        self._nem_group = (group_of(nemesis, self.n)
+                           if nemesis is not None and nemesis.has_partition
+                           else None)
+        # Lifeguard LHM registers (kernel.NemState rule, per prober):
+        # suspicion initiation gates on streak > lhm; +1 on NACK-style
+        # evidence (direct miss while a helper vouches) and on being
+        # refuted, -1 on clean probe success.
+        self._lhm = [0] * self.n
+        self._lhm_streak = [0] * self.n
         # Joins (memberlist: a join is a TCP state sync with one contact
         # node followed by a gossiped alive@inc broadcast —
         # gossip.html.markdown:10-43): nodes with a join_tick do not
@@ -179,7 +196,62 @@ class RefModel:
 
     def _alive_truth(self, i: int) -> bool:
         return (self.fail_tick.get(i, 1 << 60) > self.tick
-                and self._joined(i))
+                and self._joined(i) and not self._flap_down(i))
+
+    # -- nemesis fault injection (mirrors kernel._nem_* derivations) ------
+
+    def _nem_window(self, t: Optional[int] = None) -> bool:
+        nem = self.nemesis
+        if nem is None:
+            return False
+        t = self.tick if t is None else t
+        return nem.start <= t < nem.stop
+
+    def _flap_down(self, i: int, t: Optional[int] = None) -> bool:
+        """Square-wave truth override: up ``flap_up`` rounds, then down
+        for the rest of the period, inside the fault window."""
+        nem = self.nemesis
+        if nem is None or not nem.has_flap:
+            return False
+        if not (nem.flap_lo <= i < nem.flap_hi):
+            return False
+        t = self.tick if t is None else t
+        if not (nem.start <= t < nem.stop):
+            return False
+        return ((t - nem.start) % nem.flap_period) >= nem.flap_up
+
+    def _edge_lost(self, src: int, dst: int) -> bool:
+        """One directed message leg crossing the partition: dropped with
+        the source group's edge probability."""
+        nem = self.nemesis
+        if self._nem_group is None or not self._nem_window():
+            return False
+        gs = int(self._nem_group[src])
+        if gs == int(self._nem_group[dst]):
+            return False
+        pe = nem.p_ab if gs == 0 else nem.p_ba
+        return pe > 0 and self.rng.random() < pe
+
+    def _truth_fail_tick(self, subject: int) -> int:
+        """Tick the subject ACTUALLY went down — its scheduled fail
+        tick, or the start of its current flap down-phase (flap victims
+        have no ``fail_tick`` entry)."""
+        ft = self.fail_tick.get(subject)
+        if ft is not None and ft <= self.tick:
+            return ft
+        nem = self.nemesis
+        if nem is not None and self._flap_down(subject):
+            rel = (self.tick - nem.start) % nem.flap_period
+            return self.tick - (rel - nem.flap_up)
+        return self.tick
+
+    def _obs_miss(self, i: int) -> bool:
+        """Degraded observer: prober ``i`` drops a reply it DID receive
+        (the observer is slow, not the target)."""
+        nem = self.nemesis
+        return (nem is not None and nem.has_degraded and self._nem_window()
+                and nem.obs_lo <= i < nem.obs_hi
+                and self.rng.random() < nem.p_obs_miss)
 
     def _joined(self, i: int) -> bool:
         return self.join_tick.get(i, -(1 << 60)) <= self.tick
@@ -221,6 +293,11 @@ class RefModel:
             if msg.kind in (SUSPECT, DEAD) and self.p.refute and msg.inc >= self.incarnation[i]:
                 self.incarnation[i] = msg.inc + 1
                 self.n_refuted += 1
+                if self.nemesis is not None and self.nemesis.lhm_max > 0:
+                    # Lifeguard: being refuted is evidence the LOCAL
+                    # node is degraded — raise its multiplier.
+                    self._lhm[i] = min(self._lhm[i] + 1,
+                                       self.nemesis.lhm_max)
                 self._enqueue(i, Message(REFUTE, i, self.incarnation[i], i))
             return
         b = self._belief(i, subject)
@@ -278,7 +355,7 @@ class RefModel:
             truly = not self._alive_truth(subject)
             if truly:
                 self.events.append(DetectionEvent(
-                    subject, self.fail_tick[subject],
+                    subject, self._truth_fail_tick(subject),
                     self.first_suspect.get(subject, self.tick), self.tick))
             else:
                 self.n_false_dead += 1
@@ -306,15 +383,45 @@ class RefModel:
         else:
             return
         target_up = self._alive_truth(t)
-        ok = target_up and not self._lost() and not self._lost()
+        # Direct probe: request i->t, ack t->i — two iid loss draws plus
+        # one partition draw per direction plus the degraded-observer
+        # chance of dropping the ack after receipt.
+        direct_ok = (target_up and not self._lost() and not self._lost()
+                     and not self._edge_lost(i, t)
+                     and not self._edge_lost(t, i)
+                     and not self._obs_miss(i))
+        ok = direct_ok
+        rescued = False
         if not ok:
             helpers = self._sample_members(i, self.p.indirect_k, exclude=(t,))
             for h in helpers:
                 if not self._alive_truth(h):
                     continue
-                if target_up and not any(self._lost() for _ in range(4)):
-                    ok = True
+                # Four legs: i->h, h->t, t->h, h->i — each crosses the
+                # partition independently; the final reply can still be
+                # dropped by a degraded prober.
+                if (target_up and not any(self._lost() for _ in range(4))
+                        and not self._edge_lost(i, h)
+                        and not self._edge_lost(h, t)
+                        and not self._edge_lost(t, h)
+                        and not self._edge_lost(h, i)
+                        and not self._obs_miss(i)):
+                    ok = rescued = True
                     break
+        nem = self.nemesis
+        if nem is not None and nem.lhm_max > 0:
+            # Lifeguard local-health multiplier — the kernel NemState
+            # rule verbatim: gate on the OLD multiplier, then update.
+            miss = not direct_ok
+            streak = (min(self._lhm_streak[i] + 1, nem.lhm_max + 1)
+                      if miss else 0)
+            gate = streak > self._lhm[i]
+            self._lhm[i] = min(max(
+                self._lhm[i] + (1 if (miss and rescued) else 0)
+                - (0 if miss else 1), 0), nem.lhm_max)
+            self._lhm_streak[i] = streak
+            if not ok and not gate:
+                return  # LHM suppresses this round's suspicion
         if not ok:
             b = self._belief(i, t)
             if b.status == ALIVE:
@@ -343,7 +450,8 @@ class RefModel:
                 if b.remaining <= 0:
                     break
                 b.remaining -= 1
-                if self._alive_truth(t) and not self._lost():
+                if (self._alive_truth(t) and not self._lost()
+                        and not self._edge_lost(i, t)):
                     self._handle(t, b.msg)
         self.queues[i] = [b for b in self.queues[i] if b.remaining > 0]
 
@@ -359,6 +467,8 @@ class RefModel:
         j = partners[0]
         if not self._alive_truth(j):
             return  # TCP dial to a dead node fails
+        if self._edge_lost(i, j) or self._edge_lost(j, i):
+            return  # TCP sync crossing the partition fails
         kind_of = {SUSPECT: SUSPECT, DEAD: DEAD, ALIVE: REFUTE}
         for a, b in ((i, j), (j, i)):
             for subject, bel in list(self.beliefs[b].items()):
@@ -383,7 +493,9 @@ class RefModel:
         floods through gossip (the same REFUTE message class)."""
         self.incarnation[j] = max(1, self.incarnation[j] + 1)
         contacts = [x for x in range(self.n)
-                    if x != j and self._alive_truth(x)]
+                    if x != j and self._alive_truth(x)
+                    and not self._edge_lost(j, x)
+                    and not self._edge_lost(x, j)]
         if contacts:
             c = self.rng.choice(contacts)
             # joiner adopts the contact's membership view...
@@ -399,6 +511,23 @@ class RefModel:
 
     def step(self) -> None:
         t = self.tick
+        nem = self.nemesis
+        if nem is not None and nem.has_flap:
+            # Flap up edge: the node restarts — incarnation bump +
+            # alive@inc flood through the ordinary join path (the
+            # kernel re-arms join_round to the same effect).
+            for i in range(nem.flap_lo, min(nem.flap_hi, self.n)):
+                if (self._flap_down(i, t - 1) and not self._flap_down(i, t)
+                        and self.fail_tick.get(i, 1 << 60) > t
+                        and self._joined(i)):
+                    self._do_join(i)
+        if nem is not None and nem.heal_rejoin and t == nem.stop:
+            # Partition heal: every node falsely declared dead rejoins
+            # (kernel: join_round = min(join_round, stop)).
+            for j in range(self.n):
+                if self._alive_truth(j) and (j in self.dead_declared
+                                             or self._dead_knowers.get(j)):
+                    self._do_join(j)
         for j, jt in self.join_tick.items():
             if jt == t and self.fail_tick.get(j, 1 << 60) > t:
                 self._do_join(j)

@@ -109,7 +109,15 @@ class HistRecorder:
 
     # -- drain path ---------------------------------------------------------
 
-    def ingest(self, banks: Dict[str, Sequence[int]]) -> Dict[str, np.ndarray]:
+    def ingest(self, banks: Dict[str, Sequence[int]],
+               scenario: Optional[str] = None) -> Dict[str, np.ndarray]:
+        """``scenario``: attribute this drain's deltas to a nemesis
+        scenario (gossip/nemesis.py) as well — the deltas additionally
+        fold into ``"<name>@<scenario>"`` banks, which ``families()``
+        exposes as scenario-labeled Prometheus series and the SLO board
+        reads per scenario.  The wrap bookkeeping (``_raw``) stays
+        keyed by the bare bank name: there is ONE physical device bank
+        regardless of which scenario is active when it drains."""
         deltas: Dict[str, np.ndarray] = {}
         with self._lock:
             for name, counts in banks.items():
@@ -125,6 +133,12 @@ class HistRecorder:
                 deltas[name] = delta
                 self._raw[name] = cur
                 self._banks[name] = self._banks[name] + delta
+                if scenario:
+                    key = f"{name}@{scenario}"
+                    bank = self._banks.get(key)
+                    if bank is None or bank.shape != delta.shape:
+                        bank = np.zeros_like(delta)
+                    self._banks[key] = bank + delta
         return deltas
 
     # -- read side ----------------------------------------------------------
@@ -156,44 +170,76 @@ class HistRecorder:
         hi = int(np.searchsorted(cum, hi_i, side="right"))
         return float(lo + (hi - lo) * (rank - lo_i))
 
+    def scenarios(self) -> List[str]:
+        """Sorted nemesis scenario labels with attributed banks."""
+        with self._lock:
+            return sorted({k.split("@", 1)[1] for k in self._banks
+                           if "@" in k})
+
+    @staticmethod
+    def _one_family(name: str, metric: str, help_text: str,
+                    counts: np.ndarray,
+                    labels: Optional[Dict[str, str]]) -> Dict[str, Any]:
+        cum = np.cumsum(counts)
+        buckets = [(le, int(cum[min(idx, len(cum) - 1)]))
+                   for le, idx in _edges(name)]
+        if name == "spread":
+            # bit_length buckets: value floor of bucket k is 2^(k-1)
+            floors = np.concatenate(
+                [[0], 2 ** np.arange(counts.shape[0] - 1)])
+            total_sum = int((counts * floors).sum())
+        else:
+            total_sum = int((counts * np.arange(counts.shape[0])).sum())
+        fam: Dict[str, Any] = {
+            "name": metric,
+            "help": help_text,
+            "buckets": buckets,
+            "sum": total_sum,
+            "count": int(counts.sum()),
+        }
+        if labels:
+            fam["labels"] = dict(labels)
+        return fam
+
     def families(self) -> List[Dict[str, Any]]:
         """Prometheus histogram families over the cumulative banks.
 
         ``sum`` is exact below the overflow bucket; overflow
-        observations contribute the bucket floor (a lower bound)."""
+        observations contribute the bucket floor (a lower bound).
+
+        Scenario-attributed banks (``ingest(..., scenario=...)``) emit
+        additional families with the SAME metric name and a
+        ``{"scenario": ...}`` label set, right after their unlabeled
+        aggregate (obs/prom.py emits HELP/TYPE once per name)."""
         out: List[Dict[str, Any]] = []
         with self._lock:
             banks = {n: b.copy() for n, b in self._banks.items()}
+        scns = sorted({k.split("@", 1)[1] for k in banks if "@" in k})
         for name, (metric, help_text) in BANK_METRICS.items():
             counts = banks.get(name)
             if counts is None:
                 continue
-            cum = np.cumsum(counts)
-            buckets = [(le, int(cum[min(idx, len(cum) - 1)]))
-                       for le, idx in _edges(name)]
-            if name == "spread":
-                # bit_length buckets: value floor of bucket k is 2^(k-1)
-                floors = np.concatenate(
-                    [[0], 2 ** np.arange(counts.shape[0] - 1)])
-                total_sum = int((counts * floors).sum())
-            else:
-                total_sum = int((counts * np.arange(counts.shape[0])).sum())
-            out.append({
-                "name": metric,
-                "help": help_text,
-                "buckets": buckets,
-                "sum": total_sum,
-                "count": int(counts.sum()),
-            })
+            out.append(self._one_family(name, metric, help_text, counts,
+                                        None))
+            for scn in scns:
+                sc_counts = banks.get(f"{name}@{scn}")
+                if sc_counts is not None:
+                    out.append(self._one_family(
+                        name, metric, help_text, sc_counts,
+                        {"scenario": scn}))
         return out
 
-    def summary(self) -> Dict[str, Any]:
-        """Latency percentiles for /v1/agent/slo (None until data)."""
+    def summary(self, scenario: Optional[str] = None) -> Dict[str, Any]:
+        """Latency percentiles for /v1/agent/slo (None until data).
+        ``scenario``: read the scenario-attributed banks instead of the
+        aggregate."""
+        suffix = f"@{scenario}" if scenario else ""
         s: Dict[str, Any] = {}
         for name in _LATENCY_BANKS:
+            key = name + suffix
             s[name] = {
-                "count": int(self.counts(name).sum()),
-                "p50_rounds": self.percentile(name, 50),
-                "p99_rounds": self.percentile(name, 99),
+                "count": int(self.counts(key).sum()),
+                "p50_rounds": self.percentile(key, 50),
+                "p99_rounds": self.percentile(key, 99),
             }
         return s

@@ -121,13 +121,25 @@ def render_prometheus(snapshot: List[Dict[str, Any]],
         _family(lines, f"{n}_seconds_max", "gauge",
                 f"Maximum retained {k} sample in seconds.")
         lines.append(f"{n}_seconds_max {repr(agg['max'] / 1000.0)}")
+    hist_seen: set = set()
     for fam in histograms or []:
         n = sanitize(fam["name"])
-        _family(lines, n, "histogram", fam.get("help", ""))
+        # One HELP/TYPE block per family name: scenario-labeled
+        # variants (obs.hist families with a "labels" dict) share the
+        # name with their unlabeled aggregate and must not repeat the
+        # header — Prometheus parsers reject duplicate TYPE lines.
+        if n not in hist_seen:
+            hist_seen.add(n)
+            _family(lines, n, "histogram", fam.get("help", ""))
+        labels = fam.get("labels") or {}
+        pre = "".join(f'{sanitize(str(k))}="{escape_label_value(v)}",'
+                      for k, v in sorted(labels.items()))
+        tail = "{" + pre[:-1] + "}" if pre else ""
         for le, cum in fam.get("buckets", []):
             lines.append(
-                f'{n}_bucket{{le="{escape_label_value(le)}"}} {_fmt(cum)}')
-        lines.append(f'{n}_bucket{{le="+Inf"}} {_fmt(fam["count"])}')
-        lines.append(f"{n}_sum {_fmt(fam['sum'])}")
-        lines.append(f"{n}_count {_fmt(fam['count'])}")
+                f'{n}_bucket{{{pre}le="{escape_label_value(le)}"}} '
+                f'{_fmt(cum)}')
+        lines.append(f'{n}_bucket{{{pre}le="+Inf"}} {_fmt(fam["count"])}')
+        lines.append(f"{n}_sum{tail} {_fmt(fam['sum'])}")
+        lines.append(f"{n}_count{tail} {_fmt(fam['count'])}")
     return "\n".join(lines) + "\n" if lines else ""

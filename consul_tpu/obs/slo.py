@@ -90,3 +90,39 @@ class SloTracker:
             "window_attainment": watt,
             "burn_rate": burn,
         }
+
+
+class SloBoard:
+    """Per-scenario SLO trackers sharing one objective.
+
+    The nemesis observatory (gossip/nemesis.py) attributes every
+    drained detection delta to the scenario active when it was
+    observed; the board keeps an independent ``SloTracker`` per label
+    so each failure mode gets its own attainment + burn-rate readout
+    (``/v1/agent/slo`` ``scenarios`` key).  Trackers are created
+    lazily on first observation — a scenario that never detected
+    anything is absent, not a zero row."""
+
+    def __init__(self, objective_rounds: int,
+                 attainment_target: float = 0.99,
+                 window: int = DEFAULT_WINDOW_DRAINS) -> None:
+        self._objective = int(objective_rounds)
+        self._target = float(attainment_target)
+        self._window = int(window)
+        self._lock = threading.Lock()
+        self._trackers: Dict[str, SloTracker] = {}
+
+    def observe(self, scenario: str, detect_delta: Sequence[int]) -> int:
+        if not scenario:
+            return 0
+        with self._lock:
+            tr = self._trackers.get(scenario)
+            if tr is None:
+                tr = self._trackers[scenario] = SloTracker(
+                    self._objective, self._target, self._window)
+        return tr.observe(detect_delta)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            trackers = dict(self._trackers)
+        return {scn: tr.snapshot() for scn, tr in sorted(trackers.items())}

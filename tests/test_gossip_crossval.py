@@ -176,3 +176,40 @@ def test_lifeguard_envelope_at_scale_with_pushpull():
     assert lo * 0.8 <= p99 <= hi, (p99, lo, hi)
     assert out["false_dead"]["kernel"] == 0
     assert out["kernel_slot_drops"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_nemesis_partition_heal_tracks_oracle():
+    """Nemesis catalog (gossip/nemesis.py): full bisection rounds
+    [40, 160), then heal.  Both models must manufacture false dead
+    verdicts during the partition — each half declaring the other dead
+    IS the fault being modeled — and must fully recover membership
+    through the heal-rejoin path.  Tool-run evidence (n=256, 2 seeds):
+    false_dead 256/256, member_frac_end 1.0/1.0."""
+    from consul_tpu.gossip.crossval import run_nemesis_config
+    out = run_nemesis_config("partition_heal", 256, seeds=2)
+    assert out["false_dead"]["kernel"] > 0, out["false_dead"]
+    assert out["false_dead"]["refmodel"] > 0, out["false_dead"]
+    assert out["member_frac_end"]["kernel"] >= 0.95, out["member_frac_end"]
+    assert out["member_frac_end"]["refmodel"] >= 0.95, out["member_frac_end"]
+    assert out["kernel_slot_drops"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_nemesis_flapping_tracks_oracle():
+    """Nemesis catalog: flapping ids through [30, 310), down phases
+    sized past the Lifeguard suspicion timeout.  Gates: both models
+    detect every flap victim (completeness), every victim rejoins
+    through the join tick by the end (membership recovery), and the
+    detection-latency medians track.  Tool-run evidence (n=256,
+    2 seeds): completeness 1.0/1.0, p50 50 vs 51.5."""
+    from consul_tpu.gossip.crossval import run_nemesis_config
+    out = run_nemesis_config("flapping", 256, seeds=2)
+    assert out["completeness"]["kernel"] >= 0.9, out["completeness"]
+    assert out["completeness"]["refmodel"] >= 0.9, out["completeness"]
+    assert out["member_frac_end"]["kernel"] >= 0.95, out["member_frac_end"]
+    assert out["member_frac_end"]["refmodel"] >= 0.95, out["member_frac_end"]
+    assert out["relative_error"]["p50"] is not None
+    assert out["relative_error"]["p50"] <= 0.25, out["relative_error"]

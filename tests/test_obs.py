@@ -525,3 +525,78 @@ class TestKernelHist:
         a = np.asarray(lats)
         for q in (50, 90, 99):
             assert rec.percentile("detect", q) == float(np.percentile(a, q))
+
+
+class TestScenarioObs:
+    """Scenario dimension of the observatory (gossip/nemesis.py):
+    scenario-attributed ingest, labeled Prometheus families, the
+    per-scenario SLO board, and the exposition contract (one TYPE per
+    family, per-labelset bucket ladders)."""
+
+    def _recorder_with_scenarios(self):
+        import numpy as np
+
+        from consul_tpu.obs.hist import HistRecorder
+        rec = HistRecorder()
+        det = np.zeros(256, dtype=np.int64)
+        det[50] = 3
+        rec.ingest({"detect": det}, scenario="block_kill")
+        det2 = det.copy()
+        det2[70] = 2
+        rec.ingest({"detect": det2}, scenario="flapping")
+        return rec, det, det2
+
+    def test_scenario_ingest_attributes_deltas(self):
+        rec, det, det2 = self._recorder_with_scenarios()
+        # aggregate = all deltas; each scenario = deltas while active
+        assert int(rec.counts("detect").sum()) == 5
+        assert int(rec.counts("detect@block_kill").sum()) == 3
+        assert int(rec.counts("detect@flapping").sum()) == 2
+        assert rec.scenarios() == ["block_kill", "flapping"]
+        # the wrap bookkeeping stays keyed by the bare bank name: the
+        # flapping delta was det2 - det, not det2 - 0
+        assert int(rec.counts("detect@flapping")[70]) == 2
+        assert int(rec.counts("detect@flapping")[50]) == 0
+
+    def test_scenario_families_and_summary(self):
+        rec, _, _ = self._recorder_with_scenarios()
+        fams = [f for f in rec.families()
+                if f["name"].endswith("detection_latency_rounds")]
+        # unlabeled aggregate first, then one labeled family per scenario
+        assert "labels" not in fams[0]
+        assert [f.get("labels") for f in fams[1:]] == [
+            {"scenario": "block_kill"}, {"scenario": "flapping"}]
+        assert fams[0]["count"] == 5
+        assert fams[1]["count"] == 3
+        s = rec.summary("flapping")
+        assert s["detect"]["count"] == 2
+        assert s["detect"]["p50_rounds"] == 70.0
+        assert rec.summary()["detect"]["count"] == 5
+
+    def test_scenario_labeled_exposition_is_strict_clean(self):
+        from tools.check_prom import _iter_series, check_text
+        rec, _, _ = self._recorder_with_scenarios()
+        text = render_prometheus([], histograms=rec.families())
+        assert check_text(text) == []
+        # exactly one TYPE line per family name despite three variants
+        assert text.count(
+            "# TYPE consul_swim_detection_latency_rounds ") == 1
+        labeled = [(n, lab) for n, lab in _iter_series(text)
+                   if lab.get("scenario") == "block_kill"]
+        assert any(n.endswith("_bucket") for n, _ in labeled)
+        assert any(n.endswith("_count") for n, _ in labeled)
+
+    def test_slo_board_lazy_per_scenario(self):
+        from consul_tpu.obs.slo import SloBoard
+        board = SloBoard(100, attainment_target=0.9)
+        assert board.snapshot() == {}
+        assert board.observe("", [1]) == 0          # unattributed: dropped
+        assert board.observe("block_kill", [0] * 50 + [4]) == 4
+        assert board.observe("flapping", [0] * 150 + [2]) == 2
+        snap = board.snapshot()
+        assert sorted(snap) == ["block_kill", "flapping"]
+        assert snap["block_kill"]["attainment"] == 1.0
+        assert snap["block_kill"]["burn_rate"] == 0.0
+        # flapping latencies (150 rounds) blow the 100-round objective
+        assert snap["flapping"]["attainment"] == 0.0
+        assert snap["flapping"]["burn_rate"] == pytest.approx(10.0)

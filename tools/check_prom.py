@@ -17,12 +17,18 @@ obs-smoke` instead of a dashboard three deploys later:
   base series), histogram children to ``_bucket``/``_sum``/``_count``;
 - histogram buckets carry ``le``, appear in ascending ``le`` order
   with non-decreasing cumulative counts, include the mandatory
-  ``+Inf`` bucket, and ``+Inf`` == ``_count``.
+  ``+Inf`` bucket, and ``+Inf`` == ``_count`` — checked PER LABELSET
+  (minus ``le``), so scenario-labeled nemesis ladders
+  (``{scenario="..."}``, obs/hist.py) are each validated as their own
+  histogram instead of being pooled with the unlabeled aggregate.
 
 Run: python -m tools.check_prom [file] [--require NAME ...]
 (reads stdin without a file; --require asserts at least one sample of
 that exact metric name exists — obs_smoke pins the observatory
-families with it).  Exit 0 clean, 1 findings.
+families with it.  A matcher form ``NAME{label="value",...}`` requires
+a sample of that name whose labelset includes every listed pair, e.g.
+``consul_swim_detection_latency_rounds_bucket{scenario="block_kill"}``).
+Exit 0 clean, 1 findings.
 """
 
 from __future__ import annotations
@@ -93,9 +99,10 @@ def check_text(text: str) -> List[str]:
     helps: Dict[str, int] = {}
     sampled: set = set()          # families that have emitted a sample
     seen_series: set = set()      # (name, labelset) duplicates
-    # histogram bookkeeping per family
-    hist_buckets: Dict[str, List[Tuple[float, float]]] = {}
-    hist_count: Dict[str, float] = {}
+    # histogram bookkeeping per (family, labelset-minus-le): each
+    # labeled variant (nemesis scenario ladders) is its own histogram
+    hist_buckets: Dict[Tuple[str, tuple], List[Tuple[float, float]]] = {}
+    hist_count: Dict[Tuple[str, tuple], float] = {}
 
     for lineno, line in enumerate(text.split("\n"), 1):
         if line == "":
@@ -157,6 +164,7 @@ def check_text(text: str) -> List[str]:
                 errors.append(f"line {lineno}: {name} is not a valid "
                               f"histogram child of {fam}")
                 continue
+            lset = tuple(sorted((k, v) for k, v in labels if k != "le"))
             if child == "_bucket":
                 le = dict(labels).get("le")
                 if le is None:
@@ -166,10 +174,10 @@ def check_text(text: str) -> List[str]:
                 if not _VALUE_RE.match(le):
                     errors.append(f"line {lineno}: bad le value {le!r}")
                     continue
-                hist_buckets.setdefault(fam, []).append(
+                hist_buckets.setdefault((fam, lset), []).append(
                     (_float(le), _float(value)))
             elif child == "_count":
-                hist_count[fam] = _float(value)
+                hist_count[(fam, lset)] = _float(value)
         elif kind == "summary":
             if child not in ("",) + _SUMMARY_SUFFIXES:
                 errors.append(f"line {lineno}: {name} is not a valid "
@@ -183,26 +191,63 @@ def check_text(text: str) -> List[str]:
             errors.append(f"family {fam}: TYPE declared but no samples")
     for fam in [f for f, k in types.items() if k == "histogram"
                 and f in sampled]:
-        buckets = hist_buckets.get(fam, [])
-        if not buckets:
+        keys = sorted(set(k for k in hist_buckets if k[0] == fam)
+                      | set(k for k in hist_count if k[0] == fam))
+        if not keys:
             errors.append(f"histogram {fam}: no _bucket samples")
             continue
-        les = [le for le, _ in buckets]
-        if les != sorted(les):
-            errors.append(f"histogram {fam}: le edges not ascending")
-        if sorted(set(les)) != sorted(les):
-            errors.append(f"histogram {fam}: duplicate le edges")
-        cums = [c for _, c in buckets]
-        if any(b < a for a, b in zip(cums, cums[1:])):
-            errors.append(f"histogram {fam}: cumulative counts decrease")
-        if les[-1] != float("inf"):
-            errors.append(f"histogram {fam}: missing +Inf bucket")
-        elif fam not in hist_count:
-            errors.append(f"histogram {fam}: missing _count")
-        elif cums[-1] != hist_count[fam]:
-            errors.append(f"histogram {fam}: +Inf bucket {cums[-1]} != "
-                          f"_count {hist_count[fam]}")
+        for key in keys:
+            _, lset = key
+            who = fam + ("{" + ",".join(f'{k}="{v}"' for k, v in lset) + "}"
+                         if lset else "")
+            buckets = hist_buckets.get(key, [])
+            if not buckets:
+                errors.append(f"histogram {who}: no _bucket samples")
+                continue
+            les = [le for le, _ in buckets]
+            if les != sorted(les):
+                errors.append(f"histogram {who}: le edges not ascending")
+            if sorted(set(les)) != sorted(les):
+                errors.append(f"histogram {who}: duplicate le edges")
+            cums = [c for _, c in buckets]
+            if any(b < a for a, b in zip(cums, cums[1:])):
+                errors.append(f"histogram {who}: cumulative counts decrease")
+            if les[-1] != float("inf"):
+                errors.append(f"histogram {who}: missing +Inf bucket")
+            elif key not in hist_count:
+                errors.append(f"histogram {who}: missing _count")
+            elif cums[-1] != hist_count[key]:
+                errors.append(f"histogram {who}: +Inf bucket {cums[-1]} != "
+                              f"_count {hist_count[key]}")
     return errors
+
+
+def _iter_series(text: str):
+    """(name, labels dict) for every parseable sample line."""
+    for ln in text.split("\n"):
+        m = _SAMPLE_RE.match(ln)
+        if m is None:
+            continue
+        labels = _parse_labels(m.group(3) or "", 0, [])
+        yield m.group(1), dict(labels or [])
+
+
+def _require_ok(want: str, series: List[Tuple[str, Dict[str, str]]],
+                errors: List[str]) -> bool:
+    """``NAME`` requires any sample of that name; ``NAME{l="v",...}``
+    additionally requires the listed label pairs (subset match, so a
+    bucket's ``le`` doesn't have to be spelled out)."""
+    name, _, label_raw = want.partition("{")
+    need: Dict[str, str] = {}
+    if label_raw:
+        parsed = _parse_labels(label_raw.rstrip("}"), 0, [])
+        if parsed is None:
+            errors.append(f"--require {want!r}: bad label matcher syntax")
+            return False
+        need = dict(parsed)
+    return any(n == name and all(labels.get(k) == v
+                                 for k, v in need.items())
+               for n, labels in series)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -212,7 +257,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--require", action="append", default=[],
                     metavar="NAME",
                     help="fail unless a sample with this exact metric "
-                         "name exists (repeatable)")
+                         "name exists (repeatable); NAME{l=\"v\"} also "
+                         "requires the label pairs")
     args = ap.parse_args(argv)
     if args.file:
         with open(args.file, "r", encoding="utf-8") as f:
@@ -220,11 +266,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         text = sys.stdin.read()
     errors = check_text(text)
-    names = {m.group(1) for m in
-             (_SAMPLE_RE.match(ln) for ln in text.split("\n"))
-             if m is not None}
+    series = list(_iter_series(text))
+    names = {n for n, _ in series}
     for want in args.require:
-        if want not in names:
+        if not _require_ok(want, series, errors):
             errors.append(f"required metric {want} not found")
     for e in errors:
         print(f"check_prom: {e}", file=sys.stderr)

@@ -6,7 +6,11 @@ with matched protocol configs, plus the heavy-loss false-positive
 config.  The statistics core is ``consul_tpu.gossip.crossval`` — the
 same code the in-suite regression tier gates on
 (``tests/test_gossip_crossval.py``), so this artifact can never drift
-from what the suite asserts.
+from what the suite asserts.  Every config row carries a ``scenario``
+column: ``"iid"`` for the historical bernoulli-churn configs, the
+catalog name for the nemesis correlated-fault rows
+(``gossip/nemesis.py``), so per-scenario oracle-vs-kernel detection
+fidelity is one report.
 
 Run:  python tools/crossval_report.py [--quick]
 """
@@ -27,7 +31,17 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 from consul_tpu.gossip.crossval import (run_config, run_event_config,  # noqa: E402
-                                        run_join_config)
+                                        run_join_config,
+                                        run_nemesis_config)
+from consul_tpu.gossip.nemesis import names as nemesis_names  # noqa: E402
+
+
+def _iid(row: dict) -> dict:
+    """Tag a bernoulli-churn config row for the scenario column (the
+    nemesis rows carry their catalog name; everything historical is
+    "iid")."""
+    row.setdefault("scenario", "iid")
+    return row
 
 
 def main() -> None:
@@ -55,7 +69,19 @@ def main() -> None:
 
     for n in (1000, 10000):
         print(f"[crossval] n={n} ...", file=sys.stderr, flush=True)
-        report["configs"].append(run_config(n, victims, seeds))
+        report["configs"].append(_iid(run_config(n, victims, seeds)))
+        _flush()
+    # Nemesis catalog fidelity (gossip/nemesis.py): one row per
+    # correlated-fault scenario, oracle modeling the same fault, so the
+    # per-scenario detection story lives in the same artifact as the
+    # iid rows.  Oracle-tractable scale — the per-node refmodel pays
+    # O(n) python per message and the partition scenarios manufacture
+    # n/2 concurrent episodes.
+    nem_n, nem_seeds = 256, (1 if args.quick else 2)
+    for name in nemesis_names():
+        print(f"[crossval] nemesis {name} n={nem_n} ...", file=sys.stderr,
+              flush=True)
+        report["configs"].append(run_nemesis_config(name, nem_n, nem_seeds))
         _flush()
     # False-positive + completeness behavior under heavy loss (BASELINE
     # config #2 tail).  Loss makes the per-node oracle pathologically
@@ -64,8 +90,8 @@ def main() -> None:
     # RATES and detection completeness, which n=500 resolves fine.
     # Slot provisioning is loss-sized (crossval.loss_sized_slots).
     print("[crossval] n=500 loss=0.25 ...", file=sys.stderr, flush=True)
-    report["configs"].append(run_config(500, max(4, victims // 2),
-                                        max(2, seeds // 4), loss=0.25))
+    report["configs"].append(_iid(run_config(500, max(4, victims // 2),
+                                             max(2, seeds // 4), loss=0.25)))
     _flush()
     # Same loss regime with push/pull armed in BOTH models: anti-entropy
     # is exactly what memberlist relies on at this loss rate (rumors
@@ -73,9 +99,9 @@ def main() -> None:
     # recovered by the periodic full sync).
     print("[crossval] n=500 loss=0.25 +pushpull ...", file=sys.stderr,
           flush=True)
-    report["configs"].append(run_config(500, max(4, victims // 2),
-                                        max(2, seeds // 4), loss=0.25,
-                                        pushpull=True))
+    report["configs"].append(_iid(run_config(500, max(4, victims // 2),
+                                             max(2, seeds // 4), loss=0.25,
+                                             pushpull=True)))
     _flush()
     # BASELINE table row 4: 100k nodes, Lifeguard + push/pull.  The
     # pure-Python oracle is tractable to a few thousand nodes, so this
@@ -84,9 +110,9 @@ def main() -> None:
     # oracle-validated at 1k/10k above (sampling documented here).
     print("[crossval] n=100000 +pushpull (envelope gate) ...",
           file=sys.stderr, flush=True)
-    report["configs"].append(run_config(100_000, victims,
-                                        max(2, seeds // 4),
-                                        pushpull=True, oracle=False))
+    report["configs"].append(_iid(run_config(100_000, victims,
+                                             max(2, seeds // 4),
+                                             pushpull=True, oracle=False)))
     _flush()
     # Join churn (gossip.html.markdown:10-43): concurrent joins +
     # failures, detection gates unchanged, join-propagation latency

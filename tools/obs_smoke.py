@@ -4,6 +4,12 @@ and validate every line with tools/check_prom's strict checker —
 including the detection-latency observatory's histogram families —
 then sanity-check the ``/v1/agent/slo`` JSON shell.
 
+A second boot runs the plane under a live nemesis scenario
+(``PlaneConfig(nemesis="block_kill")``, gossip/nemesis.py) and holds
+the scrape to the scenario-labeled contract: labeled histogram series
+in the Prometheus text, and the ``scenario``/``scenarios`` breakdown
+at ``/v1/agent/slo``.
+
 This is the `make obs-smoke` gate: it catches exposition drift
 (obs/prom.py), bridge-frame drift (plane ``slo`` frame ->
 tpu_backend.plane_slo -> agent route), and plane wiring regressions
@@ -34,23 +40,24 @@ REQUIRED = [
     "consul_flight_round",
 ]
 
+NEMESIS = "block_kill"  # scenario the second boot runs live
+
 
 def _get(url: str) -> bytes:
     with urllib.request.urlopen(url, timeout=15) as r:
         return r.read()
 
 
-async def main() -> int:
+async def _boot_and_scrape(nemesis: str = ""):
+    """One plane + one kernel-backed agent; returns the Prometheus
+    text and the /v1/agent/slo JSON after a few dispatches land."""
     from consul_tpu.agent.agent import Agent, AgentConfig
     from consul_tpu.consensus.raft import RaftConfig
     from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
-    from tools.check_prom import _SAMPLE_RE, check_text
 
     plane = GossipPlane(PlaneConfig(
         bind_port=0, capacity=16, slots=16, gossip_interval_s=0.02,
-        suspicion_mult=1.0, hb_lapse_s=0.3))
-    print("[obs-smoke] starting plane (first boot compiles the kernel)...",
-          flush=True)
+        suspicion_mult=1.0, hb_lapse_s=0.3, nemesis=nemesis))
     await plane.start()
     agent = None
     try:
@@ -69,38 +76,71 @@ async def main() -> int:
         await asyncio.sleep(1.0)
         host, port = agent.http.addr
         base = f"http://{host}:{port}"
-
         text = (await asyncio.to_thread(
             _get, f"{base}/v1/agent/metrics?format=prometheus")).decode()
-        errors = check_text(text)
-        names = {m.group(1) for m in
-                 (_SAMPLE_RE.match(ln) for ln in text.split("\n"))
-                 if m is not None}
-        for want in REQUIRED:
-            if want not in names:
-                errors.append(f"required metric {want} not in scrape")
-
-        slo = json.loads(await asyncio.to_thread(_get, f"{base}/v1/agent/slo"))
-        for key in ("slo", "latency", "hists"):
-            if key not in slo:
-                errors.append(f"/v1/agent/slo missing key {key!r}")
-        snap = slo.get("slo") or {}
-        for key in ("objective_rounds", "attainment_target", "burn_rate"):
-            if key not in snap:
-                errors.append(f"/v1/agent/slo slo snapshot missing {key!r}")
-
-        for e in errors:
-            print(f"[obs-smoke] FAIL: {e}", file=sys.stderr)
-        if errors:
-            return 1
-        print(f"[obs-smoke] ok: {len(names)} series names, "
-              f"{len(text.splitlines())} lines, slo objective "
-              f"{snap.get('objective_rounds')} rounds")
-        return 0
+        slo = json.loads(await asyncio.to_thread(
+            _get, f"{base}/v1/agent/slo"))
+        return text, slo
     finally:
         if agent is not None:
             await agent.stop()
         await plane.stop()
+
+
+async def main() -> int:
+    from tools.check_prom import _iter_series, _require_ok, check_text
+
+    errors = []
+
+    print("[obs-smoke] starting plane (first boot compiles the kernel)...",
+          flush=True)
+    text, slo = await _boot_and_scrape()
+    errors += check_text(text)
+    names = {n for n, _ in _iter_series(text)}
+    for want in REQUIRED:
+        if want not in names:
+            errors.append(f"required metric {want} not in scrape")
+    for key in ("slo", "latency", "hists"):
+        if key not in slo:
+            errors.append(f"/v1/agent/slo missing key {key!r}")
+    snap = slo.get("slo") or {}
+    for key in ("objective_rounds", "attainment_target", "burn_rate"):
+        if key not in snap:
+            errors.append(f"/v1/agent/slo slo snapshot missing {key!r}")
+
+    # -- nemesis phase: the same contract under a live fault scenario.
+    # The scenario banks exist from the first attributed drain (zero
+    # deltas still create them), so the labeled series and the
+    # ``scenarios`` breakdown must be present even before any
+    # detection fires.
+    print(f"[obs-smoke] rebooting plane under nemesis={NEMESIS!r} "
+          "(new static schedule recompiles)...", flush=True)
+    ntext, nslo = await _boot_and_scrape(nemesis=NEMESIS)
+    nerrors = check_text(ntext)
+    for fam in REQUIRED[:4]:
+        want = fam + f'{{scenario="{NEMESIS}"}}'
+        if not _require_ok(want, list(_iter_series(ntext)), nerrors):
+            nerrors.append(f"nemesis scrape missing labeled series {want}")
+    if nslo.get("scenario") != NEMESIS:
+        nerrors.append(f"/v1/agent/slo scenario = {nslo.get('scenario')!r}, "
+                       f"want {NEMESIS!r}")
+    scns = nslo.get("scenarios")
+    if not isinstance(scns, dict) or NEMESIS not in scns:
+        nerrors.append(f"/v1/agent/slo scenarios breakdown missing {NEMESIS!r}")
+    elif "latency" not in scns[NEMESIS]:
+        nerrors.append("scenarios breakdown row missing 'latency'")
+    errors += nerrors
+
+    for e in errors:
+        print(f"[obs-smoke] FAIL: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print(f"[obs-smoke] ok: {len(names)} series names, "
+          f"{len(text.splitlines())} lines, slo objective "
+          f"{snap.get('objective_rounds')} rounds; nemesis scrape "
+          f"{len(ntext.splitlines())} lines, scenarios "
+          f"{sorted(scns)}")
+    return 0
 
 
 if __name__ == "__main__":

@@ -60,6 +60,12 @@ def main():
                     help="also profile the shard_map'd path over this many "
                          "devices (0 = all local devices when n is aligned; "
                          "skipped on a single device)")
+    ap.add_argument("--scenario", type=str, default="",
+                    help="also profile the 64-round amortized scan under "
+                         "this nemesis injection schedule "
+                         "(gossip/nemesis.py catalog name; window widened "
+                         "so the fault masks stay live) — the delta over "
+                         "round_amortized_64 prices the scenario")
     args = ap.parse_args()
 
     from consul_tpu.gossip.kernel import (
@@ -112,6 +118,31 @@ def main():
         lambda st: run_rounds(st, key, fail, p_planes, steps=64)[0])
     results["round_amortized_64_planes"] = timed(
         f_scan_pl, state, iters=2, warmup=1) / 64
+
+    # -- nemesis injection overhead (--scenario): the identical scan
+    # with the scenario's schedule compiled in.  The catalog windows
+    # are oracle-scale and the warmed state is past them, so the
+    # window is widened to keep the fault masks live during timing.
+    if args.scenario:
+        import dataclasses as _dc
+
+        from consul_tpu.gossip.kernel import init_nem_state
+        from consul_tpu.gossip.nemesis import build as build_nemesis
+        nem_sc = build_nemesis(args.scenario, n)
+        nem = _dc.replace(nem_sc.nem, start=0, stop=int(NEVER))
+        nem_fail = jnp.minimum(fail, jnp.asarray(nem_sc.fail_round))
+        nem_kw = {"nem": nem}
+        if nem.needs_join:
+            nem_kw["join_round"] = (
+                jnp.asarray(nem_sc.join_round)
+                if nem_sc.join_round is not None
+                else jnp.full((n,), NEVER, jnp.int32))
+        if nem.needs_state:
+            nem_kw["nem_state"] = init_nem_state(n)
+        f_scan_nem = make_timed(lambda st: run_rounds(
+            st, key, nem_fail, p, steps=64, **nem_kw)[0])
+        results[f"round_amortized_64_nem_{args.scenario}"] = timed(
+            f_scan_nem, state, iters=2, warmup=1) / 64
 
     # -- join-tick overhead: the same 64-round scan with the join input
     # armed but quiescent (all NEVER — one N-compare + cond per round)
