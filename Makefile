@@ -17,7 +17,7 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet vet-fast obs-smoke bench dryrun clean
+.PHONY: default ci test integ vet vet-fast obs-smoke bench bench-serve dryrun clean
 
 default: test
 
@@ -61,6 +61,14 @@ obs-smoke:
 # North-star benchmark (needs the real chip; emits one JSON line).
 bench:
 	$(PYTHON) bench.py
+
+# Serving-plane microbench (CPU-only): forks one agent per worker
+# count and drives keep-alive HTTP load over the KV hot path
+# (stale/default/consistent legs); JSON to stdout, numbers land in
+# BENCH_NOTES.md §9.
+bench-serve:
+	$(PYTHON) tools/bench_serve.py --requests 8000 --concurrency 32 \
+	  --workers 1,4
 
 # Multi-chip sharding dry-run on the 8-device virtual CPU mesh —
 # exactly what the driver validates.

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import tempfile
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
@@ -110,6 +111,11 @@ class AgentConfig:
     raft_config: Optional[Any] = None   # RaftConfig override (tests)
     reconcile_interval: float = 60.0    # leader full-reconcile cadence
     enable_debug: bool = False  # route /debug/pprof/* (http.go:259-264)
+    # Serving-plane fan-out: total HTTP serving processes on the public
+    # TCP port (1 = master only).  N > 1 spawns N-1 SO_REUSEPORT worker
+    # processes that run hot ops over the IPC gateway and proxy the
+    # rest (agent/workers.py).  Ignored for unix-socket HTTP listeners.
+    http_workers: int = 1
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -169,6 +175,11 @@ class Agent:
         self.log = LogHub(self.config.extra.get("log_level", "INFO"))
         self.ipc = IPCServer(self)
         self.ipc_port: Optional[int] = self.config.extra.get("ipc_port")
+        # Multi-worker serving front (created in _start_http when
+        # http_workers > 1): dedicated IPC listener for the workers'
+        # `serve` command + the tracked worker Popen pool.
+        self.worker_pool = None
+        self._worker_gateway = None
         self._left: Optional[asyncio.Event] = None  # armed in start()
         # Gossip keyring (setupKeyrings, agent.go:350-388): an encrypt key
         # or an existing keyring file arms it.
@@ -254,10 +265,38 @@ class Agent:
             if ssl_ctx is None:
                 raise ValueError(
                     "ports.https set but cert_file/key_file missing")
+        workers = max(1, int(self.config.http_workers))
+        # Workers dispatch hot ops against the local raft/store, so the
+        # front only multiplies on server-mode agents (a client proxies
+        # every request over the mesh anyway).
+        multi = (workers > 1 and unix_path is None
+                 and self.config.http_port >= 0
+                 and getattr(self.server, "raft", None) is not None)
+        internal_unix = self._serving_sock("proxy") if multi else None
         await self.http.start(self.config.bind_addr, self.config.http_port,
                               unix_path=unix_path,
                               https_port=self.config.https_port,
-                              ssl_context=ssl_ctx)
+                              ssl_context=ssl_ctx,
+                              reuse_port=multi,
+                              internal_unix_path=internal_unix)
+        if multi:
+            from consul_tpu.agent.workers import WorkerPool
+            from consul_tpu.ipc.server import IPCServer
+            gw_path = self._serving_sock("gw")
+            self._worker_gateway = IPCServer(self)
+            await self._worker_gateway.start(unix_path=gw_path)
+            self.worker_pool = WorkerPool()
+            # Spawn against the BOUND port (ephemeral :0 support).
+            self.worker_pool.spawn(workers - 1, self.config.bind_addr,
+                                   self.http.addr[1], gw_path, internal_unix)
+
+    def _serving_sock(self, name: str) -> str:
+        """Unix-socket path for the worker plumbing: under data_dir when
+        persistent, else the system tmpdir, always pid-qualified so
+        parallel test agents never collide."""
+        base = (self.config.data_dir if self.config.data_dir
+                else tempfile.gettempdir())
+        return os.path.join(base, f"consul-{os.getpid()}-{name}.sock")
 
     async def _start_gossip(self) -> None:
         """Arm the LAN (+WAN for servers) pools, rejoin from snapshots,
@@ -371,8 +410,28 @@ class Agent:
         if self._retry_join_task is not None:
             self._retry_join_task.cancel()
         await self.ipc.stop()
+        if self.worker_pool is not None:
+            # Workers first (by tracked PID), then their gateway — a
+            # worker mid-request sees a clean connection close, not a
+            # half-up master.
+            await self.worker_pool.stop()
+            self.worker_pool = None
+        if self._worker_gateway is not None:
+            await self._worker_gateway.stop()
+            gw_path = self._worker_gateway.unix_path
+            if gw_path:
+                try:
+                    os.unlink(gw_path)
+                except FileNotFoundError:
+                    pass
+            self._worker_gateway = None
         await self.dns.stop()
         await self.http.stop()
+        if self.http.internal_unix_path:
+            try:
+                os.unlink(self.http.internal_unix_path)
+            except FileNotFoundError:
+                pass
         if self.wan_pool is not None:
             await self.wan_pool.stop()
         if self.lan_pool is not None:
@@ -963,8 +1022,20 @@ class Agent:
             slo_getter = getattr(self.lan_pool, "plane_slo", None)
             if slo_getter is not None:
                 hists = (await slo_getter(timeout=2.0)).get("hists")
+            # Serving-plane request stats: per-endpoint counters +
+            # p50/p99 latency summaries (obs/reqstats.py).  Gateway hot
+            # ops and edge handlers share this registry.
+            from consul_tpu.obs.reqstats import reqstats
+            counter_rows, summaries = reqstats.prom_families()
             return web.Response(
-                text=render_prometheus(metrics.snapshot(), histograms=hists),
+                text=render_prometheus(metrics.snapshot(), histograms=hists,
+                                       summaries=summaries,
+                                       labeled_counters=[{
+                                           "name": "consul_http_requests_total",
+                                           "help": "HTTP requests served, "
+                                                   "by endpoint.",
+                                           "rows": counter_rows,
+                                       }] if counter_rows else None),
                 content_type="text/plain")
         return metrics.snapshot()
 

@@ -83,6 +83,9 @@ class Config:
     client_addr: str = "127.0.0.1"
     addresses: Dict[str, str] = field(default_factory=dict)
     ports: PortConfig = field(default_factory=PortConfig)
+    # total HTTP serving processes on the public TCP port (1 = the
+    # agent alone; N > 1 adds N-1 SO_REUSEPORT workers, agent/workers.py)
+    http_workers: int = 1
 
     # clustering
     start_join: List[str] = field(default_factory=list)
@@ -320,6 +323,8 @@ def validate_config(cfg: Config) -> List[str]:
     if cfg.gossip_backend == "tpu" and not cfg.gossip_plane:
         problems.append("gossip_backend=tpu requires gossip_plane "
                         "(the plane daemon's address)")
+    if int(cfg.http_workers) < 1:
+        problems.append(f"http_workers must be >= 1, got {cfg.http_workers}")
     if cfg.acl_datacenter and cfg.acl_default_policy not in ("allow", "deny"):
         problems.append(f"Invalid ACL default policy: {cfg.acl_default_policy}")
     if cfg.acl_datacenter and cfg.acl_down_policy not in (
@@ -400,4 +405,5 @@ def to_agent_config(cfg: Config):
         gossip_backend=cfg.gossip_backend,
         gossip_plane=cfg.gossip_plane,
         enable_debug=cfg.enable_debug,
+        http_workers=int(cfg.http_workers),
     )

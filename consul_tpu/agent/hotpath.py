@@ -1,0 +1,215 @@
+"""Compact-serialization fast path for the hot serving endpoints.
+
+The generic HTTP path pays, per request: QueryOptions/KeyRequest
+construction, the blocking-query prologue, ``to_wire``/``to_api`` dict
+recursion with per-key case mapping, and a ``json.dumps`` whose output
+aiohttp re-encodes from ``text``.  For the endpoints that dominate the
+serving plane (KV GET/PUT/DELETE, health service, catalog, status)
+this module computes the response ONCE as raw bytes plus headers — a
+transport-neutral ``(status, headers, content_type, body)`` quadruple
+consumed by
+
+  * the in-process aiohttp handlers (``http_api.py`` routes delegate
+    here when the query string stays inside the hot subset), and
+  * the SO_REUSEPORT worker gateway (``workers.py``), which ships the
+    quadruple to worker processes as one msgpack frame over the IPC
+    layer — the body bytes go straight out the worker's socket, no
+    decode/re-encode hop.
+
+Wire shape parity: byte-identical to the generic path now that
+``_json`` emits compact separators (tests/test_serving.py asserts it).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from consul_tpu.structs.structs import (
+    KVSOp, KVSRequest, DirEntry, QueryOptions)
+
+HotResponse = Tuple[int, Dict[str, str], str, bytes]
+
+_JSON = "application/json"
+_OCTET = "application/octet-stream"
+
+
+def _dumps(value: Any) -> bytes:
+    return json.dumps(value, separators=(",", ":")).encode("utf-8")
+
+
+def _index_headers(srv, index: int) -> Dict[str, str]:
+    """X-Consul-* trio, mirroring endpoints._set_meta + the edge
+    header formatting without a QueryMeta round-trip."""
+    raft = srv.raft
+    if raft.is_leader():
+        known, contact = "true", "0"
+    else:
+        known = "true" if raft.leader_id else "false"
+        last = getattr(raft, "last_leader_contact", None)
+        contact = "0" if last is None else str(
+            int(max(0.0, time.monotonic() - last) * 1000))
+    return {"X-Consul-Index": str(index),
+            "X-Consul-KnownLeader": known,
+            "X-Consul-LastContact": contact}
+
+
+def _dir_entry_obj(ent: DirEntry) -> Dict[str, Any]:
+    """Reference-shaped KV entry (kvs_endpoint.go marshaling order)."""
+    return {
+        "Key": ent.key,
+        "Value": base64.b64encode(ent.value or b"").decode("ascii"),
+        "Flags": ent.flags,
+        "Session": ent.session,
+        "LockIndex": ent.lock_index,
+        "CreateIndex": ent.create_index,
+        "ModifyIndex": ent.modify_index,
+    }
+
+
+# -- hot operations ---------------------------------------------------------
+
+async def kv_get(srv, key: str, *, stale: bool = False,
+                 consistent: bool = False, token: str = "",
+                 raw: bool = False) -> HotResponse:
+    if consistent:
+        # Lease short-circuit inline (skips the barrier span + shared
+        # future machinery); expiry falls back to the full coalesced
+        # barrier/ReadIndex path.
+        from consul_tpu.utils.telemetry import metrics
+        raft = srv.raft
+        idx = raft.lease_read_index()
+        if idx is not None:
+            metrics.incr_counter(("consul", "read", "lease"))
+            if raft.last_applied < idx:
+                await raft.wait_applied(idx)
+        else:
+            await srv.consistent_read_barrier()
+    if srv.acl_resolver.enabled:
+        acl = await srv.resolve_token(token)
+        if acl is not None and not acl.key_read(key):
+            raise PermissionError("Permission denied")
+    idx, ent = srv.store.kvs_get(key)
+    index = ent.modify_index if ent is not None else idx
+    hdrs = _index_headers(srv, index)
+    if ent is None:
+        return 404, hdrs, "text/plain", b""
+    if raw:
+        return 200, hdrs, _OCTET, bytes(ent.value or b"")
+    return 200, hdrs, _JSON, _dumps([_dir_entry_obj(ent)])
+
+
+async def kv_put(srv, key: str, value: bytes, *, flags: Optional[int] = None,
+                 cas: Optional[int] = None, acquire: str = "",
+                 release: str = "", token: str = "") -> HotResponse:
+    d = DirEntry(key=key, value=value)
+    if flags is not None:
+        d.flags = flags
+    op = KVSOp.SET.value
+    if cas is not None:
+        d.modify_index = cas
+        op = KVSOp.CAS.value
+    elif acquire:
+        d.session = acquire
+        op = KVSOp.LOCK.value
+    elif release:
+        d.session = release
+        op = KVSOp.UNLOCK.value
+    ok = await srv.kvs.apply(KVSRequest(op=op, dir_ent=d, token=token))
+    return 200, {}, _JSON, b"true" if ok else b"false"
+
+
+async def kv_delete(srv, key: str, *, recurse: bool = False,
+                    cas: Optional[int] = None,
+                    token: str = "") -> HotResponse:
+    d = DirEntry(key=key)
+    op = KVSOp.DELETE.value
+    if recurse:
+        op = KVSOp.DELETE_TREE.value
+    elif cas is not None:
+        d.modify_index = cas
+        op = KVSOp.DELETE_CAS.value
+    ok = await srv.kvs.apply(KVSRequest(op=op, dir_ent=d, token=token))
+    return 200, {}, _JSON, b"true" if ok else b"false"
+
+
+async def health_service(srv, service: str, *, tag: str = "",
+                         passing: bool = False, stale: bool = False,
+                         consistent: bool = False,
+                         token: str = "") -> HotResponse:
+    from consul_tpu.agent.http_api import to_api
+    opts = QueryOptions(token=token, allow_stale=stale,
+                        require_consistent=consistent)
+    meta, csns = await srv.health.service_nodes(service, opts, tag, passing)
+    return 200, _index_headers(srv, meta.index), _JSON, _dumps(to_api(csns))
+
+
+async def catalog_nodes(srv, *, stale: bool = False, consistent: bool = False,
+                        token: str = "") -> HotResponse:
+    from consul_tpu.agent.http_api import to_api
+    opts = QueryOptions(token=token, allow_stale=stale,
+                        require_consistent=consistent)
+    meta, nodes = await srv.catalog.list_nodes(opts)
+    return 200, _index_headers(srv, meta.index), _JSON, _dumps(to_api(nodes))
+
+
+async def catalog_services(srv, *, stale: bool = False,
+                           consistent: bool = False,
+                           token: str = "") -> HotResponse:
+    opts = QueryOptions(token=token, allow_stale=stale,
+                        require_consistent=consistent)
+    meta, services = await srv.catalog.list_services(opts)
+    return 200, _index_headers(srv, meta.index), _JSON, _dumps(services)
+
+
+async def catalog_service(srv, service: str, *, tag: str = "",
+                          stale: bool = False, consistent: bool = False,
+                          token: str = "") -> HotResponse:
+    from consul_tpu.agent.http_api import to_api
+    opts = QueryOptions(token=token, allow_stale=stale,
+                        require_consistent=consistent)
+    meta, nodes = await srv.catalog.service_nodes(service, opts, tag)
+    return 200, _index_headers(srv, meta.index), _JSON, _dumps(to_api(nodes))
+
+
+async def status_leader(srv) -> HotResponse:
+    return 200, {}, _JSON, _dumps(srv.leader_addr())
+
+
+async def status_lease(srv) -> HotResponse:
+    return 200, {}, _JSON, _dumps(srv.lease_state())
+
+
+# -- gateway dispatch -------------------------------------------------------
+
+OPS = {
+    "kv_get": kv_get,
+    "kv_put": kv_put,
+    "kv_delete": kv_delete,
+    "health_service": health_service,
+    "catalog_nodes": catalog_nodes,
+    "catalog_services": catalog_services,
+    "catalog_service": catalog_service,
+    "status_leader": status_leader,
+    "status_lease": status_lease,
+}
+
+
+async def handle(srv, op: str, args: Dict[str, Any]) -> HotResponse:
+    """Run one hot op for the worker gateway, mapping exceptions to
+    the same statuses the HTTP edge layer produces (http.go wrap())."""
+    from consul_tpu.server.endpoints import EndpointError
+    fn = OPS.get(op)
+    if fn is None:
+        return 500, {}, "text/plain", f"unknown hot op: {op}".encode()
+    positional = args.pop("_args", [])
+    try:
+        return await fn(srv, *positional, **args)
+    except EndpointError as e:
+        return 400, {}, "text/plain", str(e).encode()
+    except PermissionError as e:
+        return 403, {}, "text/plain", (str(e) or "Permission denied").encode()
+    except Exception as e:
+        return 500, {}, "text/plain", f"{type(e).__name__}: {e}".encode()

@@ -82,6 +82,7 @@ class HTTPServer:
         self.addr: Optional[tuple] = None
         self.https_addr: Optional[tuple] = None
         self.unix_path: Optional[str] = None
+        self.internal_unix_path: Optional[str] = None
 
     @property
     def srv(self):
@@ -92,7 +93,9 @@ class HTTPServer:
     async def start(self, host: str = "127.0.0.1", port: int = 8500,
                     unix_path: str | None = None,
                     https_port: int = -1,
-                    ssl_context=None) -> None:
+                    ssl_context=None,
+                    reuse_port: bool = False,
+                    internal_unix_path: str | None = None) -> None:
         """Mount the API on every configured listener.
 
         The reference serves the same mux over plain HTTP, HTTPS, and
@@ -119,9 +122,23 @@ class HTTPServer:
             await site.start()
             self.unix_path = unix_path
         elif port >= 0:
-            site = web.TCPSite(self._runner, host, port)
+            # reuse_port: SO_REUSEPORT so the http_workers processes
+            # can bind the same port (agent/workers.py); the kernel
+            # spreads accepted connections across all listeners.
+            site = web.TCPSite(self._runner, host, port,
+                               reuse_port=reuse_port or None)
             await site.start()
             self.addr = site._server.sockets[0].getsockname()[:2]
+        if internal_unix_path:
+            # Workers proxy every non-hot route here — the same app,
+            # reachable without racing the public-port load balancing.
+            try:
+                os.unlink(internal_unix_path)
+            except FileNotFoundError:
+                pass
+            isite = web.UnixSite(self._runner, internal_unix_path)
+            await isite.start()
+            self.internal_unix_path = internal_unix_path
         if https_port > 0 and ssl_context is not None:
             ssite = web.TCPSite(self._runner, host, https_port,
                                 ssl_context=ssl_context)
@@ -140,6 +157,7 @@ class HTTPServer:
         h = self._handler
         r.add_get("/v1/status/leader", h(self._status_leader))
         r.add_get("/v1/status/peers", h(self._status_peers))
+        r.add_get("/v1/status/lease", h(self._status_lease))
 
         r.add_put("/v1/catalog/register", h(self._catalog_register))
         r.add_put("/v1/catalog/deregister", h(self._catalog_deregister))
@@ -213,6 +231,7 @@ class HTTPServer:
         import time as _time
 
         from consul_tpu.obs import trace as obs_trace
+        from consul_tpu.obs.reqstats import reqstats
         from consul_tpu.utils.telemetry import metrics
         name = fn.__name__.lstrip("_")
         mkey = ("consul", "http", name)
@@ -244,17 +263,31 @@ class HTTPServer:
             finally:
                 span.finish()
                 metrics.measure_since(mkey, t0)
+                reqstats.record(name, (_time.monotonic() - t0) * 1000)
 
         return handle
 
     def _json(self, request: web.Request, value: Any,
               meta: Optional[QueryMeta] = None) -> web.Response:
-        pretty = "pretty" in request.query
-        body = json.dumps(value, indent=4 if pretty else None)
+        # Compact separators on the hot path — json.dumps pads with
+        # ", "/": " when indent=None; pretty only on explicit ?pretty.
+        if "pretty" in request.query:
+            body = json.dumps(value, indent=4)
+        else:
+            body = json.dumps(value, separators=(",", ":"))
         resp = web.Response(text=body, content_type="application/json")
         if meta is not None:
             self._set_index_headers(resp, meta)
         return resp
+
+    def _hot_response(self, status: int, hdrs: Dict[str, str], ct: str,
+                      body: bytes) -> web.Response:
+        # charset matches the text= responses of the generic path so
+        # hot/generic stay header-identical (tests/test_serving.py).
+        return web.Response(status=status, body=body, content_type=ct,
+                            charset="utf-8" if ct.startswith(
+                                ("application/json", "text/")) else None,
+                            headers=hdrs or None)
 
     def _set_index_headers(self, resp: web.Response, meta: QueryMeta) -> None:
         """X-Consul-* headers (http.go:383-409)."""
@@ -308,6 +341,11 @@ class HTTPServer:
 
     async def _status_peers(self, request):
         return await self.srv.status.peers()
+
+    async def _status_lease(self, request):
+        """Leader-lease state of this server (serving-plane routing +
+        the lease-safety test surface; no reference parity route)."""
+        return await self.srv.status.lease()
 
     # -- catalog ------------------------------------------------------------
 
@@ -407,9 +445,27 @@ class HTTPServer:
             return await self._kvs_put(request, key)
         return await self._kvs_delete(request, key)
 
+    # Query keys each hot-path op may see; anything else (index/wait
+    # blocking, recurse, pretty, dc, cas…) takes the generic path.
+    _HOT_GET = frozenset(("stale", "consistent", "token", "raw"))
+    _HOT_PUT = frozenset(("flags", "cas", "acquire", "release", "token"))
+    _HOT_DELETE = frozenset(("recurse", "cas", "token"))
+
     async def _kvs_get(self, request, key: str):
-        opts = self._query_opts(request)
+        if not request.query_string and self._hot_capable:
+            # Bare GET (the dominant request in every KV workload):
+            # skip the MultiDict query parse entirely.
+            from consul_tpu.agent import hotpath
+            return self._hot_response(*await hotpath.kv_get(
+                self.srv, key, token=self.agent.config.acl_token))
         q = request.query
+        if self._hot_ok(q, self._HOT_GET):
+            from consul_tpu.agent import hotpath
+            return self._hot_response(*await hotpath.kv_get(
+                self.srv, key, stale="stale" in q,
+                consistent="consistent" in q, raw="raw" in q,
+                token=self._token(request)))
+        opts = self._query_opts(request)
         if "keys" in q:
             args = KeyListRequest(prefix=key, separator=q.get("separator", ""),
                                   **_opt_kw(opts))
@@ -436,9 +492,32 @@ class HTTPServer:
             return resp
         return self._json(request, to_api(ents), meta)
 
+    @property
+    def _hot_capable(self) -> bool:
+        # The fast path reads raft/store/ACLs locally; a client-mode
+        # agent (server/client.py proxy object, no raft) must keep
+        # taking the generic mesh-forwarded path.
+        return getattr(self.agent.server, "raft", None) is not None
+
+    def _hot_ok(self, q, allowed: frozenset) -> bool:
+        if not self._hot_capable:
+            return False
+        keys = set(q.keys())
+        if not keys <= allowed:
+            return False
+        return not ("stale" in keys and "consistent" in keys)
+
     async def _kvs_put(self, request, key: str):
         q = request.query
         value = await request.read()
+        if self._hot_ok(q, self._HOT_PUT):
+            from consul_tpu.agent import hotpath
+            return self._hot_response(*await hotpath.kv_put(
+                self.srv, key, value,
+                flags=int(q["flags"]) if "flags" in q else None,
+                cas=int(q["cas"]) if "cas" in q else None,
+                acquire=q.get("acquire", ""), release=q.get("release", ""),
+                token=self._token(request)))
         d = DirEntry(key=key, value=value)
         if "flags" in q:
             d.flags = int(q["flags"])
@@ -457,6 +536,12 @@ class HTTPServer:
 
     async def _kvs_delete(self, request, key: str):
         q = request.query
+        if self._hot_ok(q, self._HOT_DELETE):
+            from consul_tpu.agent import hotpath
+            return self._hot_response(*await hotpath.kv_delete(
+                self.srv, key, recurse="recurse" in q,
+                cas=int(q["cas"]) if "cas" in q else None,
+                token=self._token(request)))
         d = DirEntry(key=key)
         op = KVSOp.DELETE.value
         if "recurse" in q:

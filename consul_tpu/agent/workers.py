@@ -1,0 +1,413 @@
+"""SO_REUSEPORT HTTP worker processes for the serving plane.
+
+One agent process saturates a single core parsing HTTP and encoding
+JSON long before the Raft core does; the reference sidesteps this with
+Go's multi-core runtime.  Here the serving plane scales out as worker
+PROCESSES: the master binds the public HTTP port with ``SO_REUSEPORT``
+and spawns ``http_workers - 1`` copies of this module, each binding
+the same port (the kernel load-balances accepted connections across
+listeners).  Workers own only edge work — HTTP parse, query
+classification, response write:
+
+  * HOT requests (KV GET/PUT/DELETE, health service, catalog, status —
+    query string inside the hot subsets below) become one ``serve``
+    command over the agent's IPC layer (ipc/server.py); the reply is
+    the precomputed ``(status, headers, content_type, body)`` quadruple
+    from agent/hotpath.py, written straight out the worker's socket
+    with no decode/re-encode hop.
+  * Everything else (blocking queries, ``?pretty``, recurse, UI,
+    agent-local endpoints) proxies verbatim to the master's internal
+    unix-socket HTTP listener, so every route keeps working with
+    byte-identical semantics.
+
+Lifecycle: the master tracks each worker's Popen and terminates by
+PID on shutdown (SIGTERM, bounded wait, SIGKILL) — never by process
+name.  A worker that loses its gateway connection retries once, then
+serves 502 until the master returns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+
+# Query keys each hot op may see — kept in lockstep with
+# http_api.HTTPServer._HOT_* (tests/test_serving.py asserts parity).
+# ``stale`` + ``consistent`` together is rejected edge-side the same
+# way the master's _hot_ok does.
+HOT_GET = frozenset(("stale", "consistent", "token", "raw"))
+HOT_PUT = frozenset(("flags", "cas", "acquire", "release", "token"))
+HOT_DELETE = frozenset(("recurse", "cas", "token"))
+HOT_HEALTH = frozenset(("tag", "passing", "stale", "consistent", "token"))
+HOT_CATALOG = frozenset(("stale", "consistent", "token"))
+HOT_CATALOG_SVC = frozenset(("tag", "stale", "consistent", "token"))
+
+# Hop-by-hop / recomputed headers stripped when proxying.
+_SKIP_REQ = frozenset(("host", "content-length", "transfer-encoding",
+                       "connection"))
+_SKIP_RESP = frozenset(("content-length", "transfer-encoding", "connection",
+                        "content-type", "content-encoding", "date", "server"))
+
+
+def _hot_ok(q, allowed: frozenset) -> bool:
+    keys = set(q.keys())
+    if not keys <= allowed:
+        return False
+    return not ("stale" in keys and "consistent" in keys)
+
+
+class GatewayClient:
+    """Multiplexing client for the IPC ``serve`` command.
+
+    One persistent unix-socket connection per worker; requests carry
+    client-assigned Seq numbers and replies resolve out-of-order via a
+    Seq -> Future map, so a slow consistent read never head-of-line
+    blocks a stale one.  Header + body are written back-to-back with
+    no await in between — frames from concurrent callers can't tear.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._conn_lock = asyncio.Lock()
+
+    async def connect(self) -> None:
+        async with self._conn_lock:
+            if self._writer is not None:
+                return
+            reader, writer = await asyncio.open_unix_connection(self.path)
+            unpacker = msgpack.Unpacker(raw=False)
+            writer.write(msgpack.packb({"Command": "handshake", "Seq": 0},
+                                       use_bin_type=True))
+            writer.write(msgpack.packb({"Version": 1}, use_bin_type=True))
+            await writer.drain()
+            hdr = await _next_obj(reader, unpacker)
+            if hdr.get("Error"):
+                writer.close()
+                raise ConnectionError(f"gateway handshake: {hdr['Error']}")
+            self._writer = writer
+            self._reader_task = asyncio.get_event_loop().create_task(
+                self._read_loop(reader, unpacker))
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+    async def _read_loop(self, reader, unpacker) -> None:
+        try:
+            while True:
+                hdr = await _next_obj(reader, unpacker)
+                fut = self._pending.pop(hdr.get("Seq"), None)
+                if hdr.get("Error"):
+                    if fut is not None and not fut.done():
+                        fut.set_exception(ConnectionError(hdr["Error"]))
+                    continue
+                body = await _next_obj(reader, unpacker)
+                if fut is not None and not fut.done():
+                    fut.set_result(body)
+        except asyncio.CancelledError:
+            self._fail_pending()
+            raise
+        except (ConnectionError, asyncio.IncompleteReadError):
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        self._writer = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("gateway lost"))
+        self._pending.clear()
+
+    async def request(self, op: str,
+                      args: Dict[str, Any]) -> Tuple[int, Dict, str, bytes]:
+        if self._writer is None:
+            await self.connect()
+        seq = next(self._seq)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[seq] = fut
+        self._writer.write(msgpack.packb({"Command": "serve", "Seq": seq},
+                                         use_bin_type=True))
+        self._writer.write(msgpack.packb({"Op": op, "Args": args},
+                                         use_bin_type=True))
+        await self._writer.drain()
+        body = await fut
+        return (body["Status"], body.get("Hdrs") or {},
+                body.get("CT", "application/json"), body.get("Body", b""))
+
+
+async def _next_obj(reader: asyncio.StreamReader,
+                    unpacker: msgpack.Unpacker) -> Any:
+    while True:
+        try:
+            return next(unpacker)
+        except StopIteration:
+            data = await reader.read(4096)
+            if not data:
+                raise ConnectionError("gateway closed")
+            unpacker.feed(data)
+
+
+class WorkerFront:
+    """One worker's aiohttp app: hot routes -> gateway, rest -> proxy."""
+
+    def __init__(self, gateway_path: str, upstream_path: str) -> None:
+        self.gw = GatewayClient(gateway_path)
+        self.upstream_path = upstream_path
+        self._session = None  # lazy aiohttp.ClientSession over unix socket
+
+    def build_app(self):
+        from aiohttp import web
+        app = web.Application()
+        r = app.router
+        r.add_get("/v1/kv/{key:.*}", self._kv_get)
+        r.add_put("/v1/kv/{key:.*}", self._kv_put)
+        r.add_delete("/v1/kv/{key:.*}", self._kv_delete)
+        r.add_get("/v1/health/service/{service}", self._health_service)
+        r.add_get("/v1/catalog/nodes", self._catalog_nodes)
+        r.add_get("/v1/catalog/services", self._catalog_services)
+        r.add_get("/v1/catalog/service/{service}", self._catalog_service)
+        r.add_get("/v1/status/leader", self._status_leader)
+        r.add_get("/v1/status/lease", self._status_lease)
+        r.add_route("*", "/{tail:.*}", self._proxy)
+        return app
+
+    def _respond(self, quad: Tuple[int, Dict, str, bytes]):
+        from aiohttp import web
+        status, hdrs, ct, body = quad
+        # charset parity with the master edge's _hot_response.
+        return web.Response(status=status, body=body, content_type=ct,
+                            charset="utf-8" if ct.startswith(
+                                ("application/json", "text/")) else None,
+                            headers=hdrs or None)
+
+    async def _hot(self, request, op: str, args: Dict[str, Any]):
+        """One gateway round-trip; a lost master answers 502 (the
+        reverse-proxy convention for a dead upstream)."""
+        from aiohttp import web
+        try:
+            return self._respond(await self.gw.request(op, args))
+        except ConnectionError as e:
+            return web.Response(status=502, text=f"gateway: {e}")
+
+    # -- hot handlers -------------------------------------------------------
+
+    async def _kv_get(self, request):
+        key = request.match_info["key"]
+        if not request.query_string:
+            return await self._hot(request, "kv_get",
+                                   {"_args": [key], "token": None})
+        q = request.query
+        if not _hot_ok(q, HOT_GET):
+            return await self._proxy(request)
+        return await self._hot(request, "kv_get", {
+            "_args": [key], "stale": "stale" in q,
+            "consistent": "consistent" in q, "raw": "raw" in q,
+            "token": q.get("token") or None})
+
+    async def _kv_put(self, request):
+        q = request.query
+        if not _hot_ok(q, HOT_PUT):
+            return await self._proxy(request)
+        key = request.match_info["key"]
+        value = await request.read()
+        try:
+            flags = int(q["flags"]) if "flags" in q else None
+            cas = int(q["cas"]) if "cas" in q else None
+        except ValueError:
+            return await self._proxy(request)  # master shapes the error
+        return await self._hot(request, "kv_put", {
+            "_args": [key, value], "flags": flags, "cas": cas,
+            "acquire": q.get("acquire", ""), "release": q.get("release", ""),
+            "token": q.get("token") or None})
+
+    async def _kv_delete(self, request):
+        q = request.query
+        if not _hot_ok(q, HOT_DELETE):
+            return await self._proxy(request)
+        try:
+            cas = int(q["cas"]) if "cas" in q else None
+        except ValueError:
+            return await self._proxy(request)
+        return await self._hot(request, "kv_delete", {
+            "_args": [request.match_info["key"]], "recurse": "recurse" in q,
+            "cas": cas, "token": q.get("token") or None})
+
+    async def _health_service(self, request):
+        q = request.query
+        if not _hot_ok(q, HOT_HEALTH):
+            return await self._proxy(request)
+        return await self._hot(request, "health_service", {
+            "_args": [request.match_info["service"]],
+            "tag": q.get("tag", ""), "passing": "passing" in q,
+            "stale": "stale" in q, "consistent": "consistent" in q,
+            "token": q.get("token") or None})
+
+    async def _catalog_nodes(self, request):
+        return await self._catalog(request, "catalog_nodes", HOT_CATALOG)
+
+    async def _catalog_services(self, request):
+        return await self._catalog(request, "catalog_services", HOT_CATALOG)
+
+    async def _catalog_service(self, request):
+        q = request.query
+        if not _hot_ok(q, HOT_CATALOG_SVC):
+            return await self._proxy(request)
+        return await self._hot(request, "catalog_service", {
+            "_args": [request.match_info["service"]], "tag": q.get("tag", ""),
+            "stale": "stale" in q, "consistent": "consistent" in q,
+            "token": q.get("token") or None})
+
+    async def _catalog(self, request, op: str, allowed: frozenset):
+        q = request.query
+        if not _hot_ok(q, allowed):
+            return await self._proxy(request)
+        return await self._hot(request, op, {
+            "stale": "stale" in q, "consistent": "consistent" in q,
+            "token": q.get("token") or None})
+
+    async def _status_leader(self, request):
+        if request.query_string:
+            return await self._proxy(request)
+        return await self._hot(request, "status_leader", {})
+
+    async def _status_lease(self, request):
+        if request.query_string:
+            return await self._proxy(request)
+        return await self._hot(request, "status_lease", {})
+
+    # -- everything else ----------------------------------------------------
+
+    async def _proxy(self, request):
+        """Verbatim passthrough to the master's internal unix listener."""
+        import aiohttp
+        from aiohttp import web
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.UnixConnector(path=self.upstream_path),
+                auto_decompress=False)
+        body = await request.read()
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _SKIP_REQ}
+        async with self._session.request(
+                request.method, f"http://agent{request.path_qs}",
+                data=body, headers=headers) as up:
+            data = await up.read()
+            out = {k: v for k, v in up.headers.items()
+                   if k.lower() not in _SKIP_RESP}
+            return web.Response(status=up.status, body=data,
+                                content_type=up.content_type,
+                                charset=up.charset, headers=out)
+
+    async def close(self) -> None:
+        await self.gw.close()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class WorkerPool:
+    """Master-side registry of worker processes.
+
+    Shutdown is strictly by TRACKED PID: SIGTERM each live child,
+    bounded wait, SIGKILL stragglers.  Never signals by process name —
+    a name match can catch unrelated processes (including the test
+    harness itself)."""
+
+    def __init__(self) -> None:
+        self.procs: List[subprocess.Popen] = []
+
+    def spawn(self, count: int, host: str, port: int,
+              gateway_path: str, upstream_path: str) -> None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        for i in range(count):
+            cmd = [sys.executable, "-m", "consul_tpu.agent.workers",
+                   "--host", host, "--port", str(port),
+                   "--gateway", gateway_path, "--upstream", upstream_path,
+                   "--id", str(i + 1)]
+            self.procs.append(subprocess.Popen(cmd, env=env))
+
+    async def stop(self, timeout: float = 5.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + timeout
+        for p in self.procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        self.procs.clear()
+
+
+# -- worker process entry ---------------------------------------------------
+
+async def _amain(args) -> None:
+    import signal as _signal
+
+    from aiohttp import web
+    front = WorkerFront(args.gateway, args.upstream)
+    # The master starts the gateway before spawning us, but give a
+    # slow box a few grace rounds before giving up.
+    for attempt in range(20):
+        try:
+            await front.gw.connect()
+            break
+        except (ConnectionError, OSError, FileNotFoundError):
+            if attempt == 19:
+                raise
+            await asyncio.sleep(0.25)
+    runner = web.AppRunner(front.build_app(), access_log=None,
+                           shutdown_timeout=0.5)
+    await runner.setup()
+    site = web.TCPSite(runner, args.host, args.port, reuse_port=True)
+    await site.start()
+    stop_evt = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        loop.add_signal_handler(sig, stop_evt.set)
+    await stop_evt.wait()
+    await runner.cleanup()
+    await front.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="consul-http-worker",
+        description="SO_REUSEPORT HTTP worker (spawned by the agent)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--gateway", required=True,
+                   help="agent worker-gateway unix socket")
+    p.add_argument("--upstream", required=True,
+                   help="agent internal HTTP unix socket (non-hot proxy)")
+    p.add_argument("--id", default="0", help="worker index (logs only)")
+    args = p.parse_args(argv)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

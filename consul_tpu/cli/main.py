@@ -74,6 +74,8 @@ def cmd_agent(args) -> int:
         flag_doc["bootstrap"] = True
     if args.protocol is not None:
         flag_doc["protocol"] = args.protocol
+    if args.http_workers is not None:
+        flag_doc["http_workers"] = args.http_workers
     if flag_doc:
         cfg = merge_config(cfg, decode_config(json.dumps(flag_doc)))
     role_configured = cfg._set_fields & {"server", "bootstrap",
@@ -622,6 +624,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-http-port", dest="http_port", type=int, default=None)
     p.add_argument("-dns-port", dest="dns_port", type=int, default=None)
     p.add_argument("-rpc-port", dest="rpc_port", type=int, default=None)
+    p.add_argument("-http-workers", dest="http_workers", type=int,
+                   default=None,
+                   help="total HTTP serving processes on the public port "
+                        "(1 = agent only; N > 1 adds N-1 SO_REUSEPORT "
+                        "workers)")
     p.add_argument("-protocol", dest="protocol", type=int, default=None,
                    help="protocol version to speak (vsn tag; "
                         "consul/config.go:92-94)")

@@ -60,6 +60,16 @@ class RaftConfig:
     max_append_entries: int = 64
     snapshot_threshold: int = 8192
     trailing_logs: int = 128
+    # Leader lease (Raft §6.4 / "Scaling Strongly Consistent
+    # Replication"): base lease window renewed by each acked
+    # replication round.  0 = auto (election_timeout_min); negative
+    # disables leases entirely.  The effective window is
+    # min(lease_timeout, election_timeout_min) * (1 - lease_clock_skew)
+    # so a deposed or partitioned leader's lease always expires before
+    # any follower's election timer can fire, even with clock-rate
+    # skew up to the configured margin.
+    lease_timeout: float = 0.0
+    lease_clock_skew: float = 0.15
 
 
 @dataclass
@@ -206,6 +216,16 @@ class RaftNode:
         self._repl_tasks: List[asyncio.Task] = []
         self._leader_obs: List[Callable[[bool], None]] = []
         self._snapshotting = False
+        # Leader lease: per-peer monotonic SEND time of the most recent
+        # replication round that peer acknowledged at our term.  The
+        # lease anchor is the quorum-th most recent of these (self acks
+        # implicitly); anchoring at send time bounds what a follower
+        # could have promised before it reset its election timer.
+        self._lease_ack: Dict[str, float] = {}
+        # Own-term no-op index from _become_leader: until it commits, a
+        # fresh leader's commit_index may lag entries its predecessor
+        # acked, so the lease may not serve reads (Raft §6.4).
+        self._lease_guard_index = 0
 
         latest = self.snaps.latest()
         if latest is not None:
@@ -357,6 +377,69 @@ class RaftNode:
                     f"apply lag: {self.last_applied} < {index}")
             await asyncio.sleep(0.005)
 
+    # -- leader lease ------------------------------------------------------
+
+    def _lease_duration(self) -> float:
+        """Effective lease window in seconds (<= 0 disables).
+
+        Clamped to election_timeout_min regardless of config: the
+        safety argument is that a quorum of followers reset their
+        election timers no EARLIER than the lease anchor, so no new
+        leader can exist until anchor + election_timeout_min — the
+        lease must expire strictly before that, with margin for
+        clock-rate skew."""
+        lt = self.config.lease_timeout
+        if lt < 0:
+            return 0.0
+        if lt == 0:
+            lt = self.config.election_timeout_min
+        lt = min(lt, self.config.election_timeout_min)
+        return lt * (1.0 - self.config.lease_clock_skew)
+
+    def _lease_anchor(self) -> float:
+        """Quorum-th most recent acked-round send time (0.0 = none)."""
+        need = self._quorum() - 1  # self acknowledges implicitly
+        if need <= 0:
+            return time.monotonic()  # single-node: always freshly anchored
+        acks = sorted((self._lease_ack.get(p, 0.0)
+                       for p in self.peers if p != self.id), reverse=True)
+        if len(acks) < need:
+            return 0.0
+        return acks[need - 1]
+
+    def lease_valid(self, now: Optional[float] = None) -> bool:
+        """True while this leader may serve consistent reads locally
+        with no barrier/ReadIndex round-trip: it holds a live
+        quorum-renewed lease AND has committed an entry of its own
+        term (so commit_index is current, Raft §6.4)."""
+        if self.role != LEADER:
+            return False
+        dur = self._lease_duration()
+        if dur <= 0.0:
+            return False
+        if self.commit_index < self._lease_guard_index:
+            return False
+        anchor = self._lease_anchor()
+        if anchor <= 0.0:
+            return False
+        if now is None:
+            now = time.monotonic()
+        return now < anchor + dur
+
+    def lease_read_index(self) -> Optional[int]:
+        """Read-safe index under the leader lease, or None when the
+        lease doesn't hold (caller falls back to ReadIndex)."""
+        if not self.lease_valid():
+            return None
+        return self.commit_index
+
+    def lease_remaining(self) -> float:
+        """Seconds of lease validity left (0.0 when invalid)."""
+        if not self.lease_valid():
+            return 0.0
+        return max(0.0, self._lease_anchor() + self._lease_duration()
+                   - time.monotonic())
+
     async def add_peer(self, peer: str, timeout: float = 30.0) -> None:
         if peer in self.peers:
             return
@@ -490,8 +573,11 @@ class RaftNode:
         self._repl_tasks = [loop.create_task(self._replicate(p))
                             for p in self.peers if p != self.id]
         # Commit-term guard: a no-op at the new term lets prior-term
-        # entries commit (Raft §5.4.2).
+        # entries commit (Raft §5.4.2).  Its index doubles as the lease
+        # guard: the lease may not serve reads until it commits.
         entry = LogEntry(index=last + 1, term=self.current_term, type=LOG_NOOP)
+        self._lease_guard_index = entry.index
+        self._lease_ack = {}
         self.log.append([entry])
         self._kick_replication()
         self._maybe_advance_commit()
@@ -502,6 +588,7 @@ class RaftNode:
         for t in self._repl_tasks:
             t.cancel()
         self._repl_tasks = []
+        self._lease_ack = {}  # deposed: the lease is gone with the role
         self._fail_pending(NotLeaderError(self.leader_id))
         for cb in self._leader_obs:
             cb(False)
@@ -577,6 +664,8 @@ class RaftNode:
             entries.append(e)
         req = AppendReq(self.current_term, self.id, prev_index, prev_term,
                         entries, self.commit_index)
+        sent = time.monotonic()
+        term = self.current_term
         resp = await asyncio.wait_for(
             self.transport.call(self.id, peer, "append_entries", req),
             self.config.rpc_timeout)
@@ -585,6 +674,15 @@ class RaftNode:
             return
         if self.role != LEADER:
             return
+        if self.current_term == term:
+            # Lease renewal: any same-term response (even a log
+            # conflict) means the follower processed our AppendEntries
+            # at our term and reset its election timer no earlier than
+            # `sent` — it cannot vote a new leader in before
+            # sent + election_timeout_min.
+            prev = self._lease_ack.get(peer, 0.0)
+            if sent > prev:
+                self._lease_ack[peer] = sent
         if resp.success:
             if entries:
                 self.match_index[peer] = entries[-1].index
@@ -821,4 +919,6 @@ class RaftNode:
             "applied_index": str(self.last_applied),
             "last_snapshot_index": str(self._snap_index),
             "num_peers": str(len(self.peers)),
+            "lease": "valid" if self.lease_valid() else "invalid",
+            "lease_remaining_ms": str(int(self.lease_remaining() * 1000)),
         }

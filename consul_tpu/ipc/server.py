@@ -21,7 +21,7 @@ MAX_IPC_VERSION = 1
 
 COMMANDS = ("handshake", "join", "members-lan", "members-wan", "monitor",
             "stop", "leave", "force-leave", "stats", "reload",
-            "install-key", "use-key", "remove-key", "list-keys")
+            "install-key", "use-key", "remove-key", "list-keys", "serve")
 
 
 class IPCServer:
@@ -200,6 +200,50 @@ class _Conn:
         await self.writer.drain()
         self._monitors[seq] = sink
         self.agent.log_sink_add(sink, level)
+
+    async def _cmd_serve(self, seq: int) -> None:
+        """Worker-gateway request (agent/workers.py): run one hot op
+        (agent/hotpath.py) against the in-process server core and ship
+        the precomputed (status, headers, content_type, body) quadruple
+        back as a single msgpack frame.
+
+        Unlike the admin commands, serve requests are CONCURRENT: the
+        body is read inline (keeping the request stream in sync) and
+        the op runs in a spawned task, so a blocking op never stalls
+        the next request on the same connection.  Replies are matched
+        by Seq; _send writes header+body with no await in between, so
+        interleaved task replies can't tear each other's frames."""
+        req = await self._next_obj()
+        op = req.get("Op", "")
+        args = dict(req.get("Args") or {})
+        if "token" in args and args["token"] is None:
+            # Default-token resolution happens agent-side so workers
+            # never need ACL material in their own config.
+            args["token"] = self.agent.config.acl_token
+        task = asyncio.get_event_loop().create_task(
+            self._serve_one(seq, op, args))
+        self._drains.add(task)
+        task.add_done_callback(self._drains.discard)
+
+    async def _serve_one(self, seq: int, op: str, args: Dict[str, Any]) -> None:
+        import time
+
+        from consul_tpu.agent import hotpath
+        from consul_tpu.obs.reqstats import reqstats
+        t0 = time.monotonic()
+        try:
+            status, hdrs, ct, body = await hotpath.handle(
+                self.agent.server, op, args)
+            self._send({"Seq": seq, "Error": ""},
+                       {"Status": status, "Hdrs": hdrs, "CT": ct,
+                        "Body": body})
+        except Exception as e:  # noqa: E02 — reply channel of last resort
+            self._send({"Seq": seq, "Error": str(e)})
+        finally:
+            # Gateway ops land in the same per-endpoint stats registry
+            # the edge handlers feed, under their hot-op name.
+            reqstats.record(op, (time.monotonic() - t0) * 1000)
+        await _drain(self.writer)
 
     async def _cmd_stop(self, seq: int) -> None:
         req = await self._next_obj()

@@ -60,7 +60,9 @@ def _family(lines: List[str], name: str, kind: str, help_text: str) -> None:
 
 
 def render_prometheus(snapshot: List[Dict[str, Any]],
-                      histograms: Optional[List[Dict[str, Any]]] = None
+                      histograms: Optional[List[Dict[str, Any]]] = None,
+                      summaries: Optional[List[Dict[str, Any]]] = None,
+                      labeled_counters: Optional[List[Dict[str, Any]]] = None
                       ) -> str:
     """Telemetry snapshot (list of interval dicts, oldest first) ->
     Prometheus text format, one block per family with HELP/TYPE lines.
@@ -68,7 +70,15 @@ def render_prometheus(snapshot: List[Dict[str, Any]],
     ``histograms``: optional list of cumulative histogram families
     (obs.hist ``HistRecorder.families()`` shape: ``name``, ``help``,
     ``buckets`` as ascending ``(le, cumulative_count)`` pairs, ``sum``,
-    ``count``); rendered with the mandatory ``+Inf`` bucket."""
+    ``count``); rendered with the mandatory ``+Inf`` bucket.
+
+    ``summaries``: optional quantile summary families (serving-plane
+    p50/p99, obs.reqstats): ``name``, ``help``, ``labels`` dict,
+    ``quantiles`` as ``(q, value)`` pairs, ``sum``, ``count``.
+    Labelset variants share one HELP/TYPE block per name.
+
+    ``labeled_counters``: optional labeled counter families:
+    ``name``, ``help``, ``rows`` as ``(labels_dict, value)`` pairs."""
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
     samples: Dict[str, Dict[str, float]] = {}
@@ -140,6 +150,33 @@ def render_prometheus(snapshot: List[Dict[str, Any]],
                 f'{n}_bucket{{{pre}le="{escape_label_value(le)}"}} '
                 f'{_fmt(cum)}')
         lines.append(f'{n}_bucket{{{pre}le="+Inf"}} {_fmt(fam["count"])}')
+        lines.append(f"{n}_sum{tail} {_fmt(fam['sum'])}")
+        lines.append(f"{n}_count{tail} {_fmt(fam['count'])}")
+    for fam in labeled_counters or []:
+        n = sanitize(fam["name"])
+        if n in emitted:
+            continue
+        emitted.add(n)
+        _family(lines, n, "counter", fam.get("help", ""))
+        for labels, value in fam.get("rows", []):
+            body = ",".join(f'{sanitize(str(k))}="{escape_label_value(v)}"'
+                            for k, v in sorted(labels.items()))
+            lines.append(f"{n}{{{body}}} {_fmt(value)}")
+    sum_seen: set = set()
+    for fam in summaries or []:
+        n = sanitize(fam["name"])
+        if n in emitted:
+            continue
+        if n not in sum_seen:
+            sum_seen.add(n)
+            _family(lines, n, "summary", fam.get("help", ""))
+        labels = fam.get("labels") or {}
+        pre = "".join(f'{sanitize(str(k))}="{escape_label_value(v)}",'
+                      for k, v in sorted(labels.items()))
+        tail = "{" + pre[:-1] + "}" if pre else ""
+        for q, v in fam.get("quantiles", []):
+            lines.append(
+                f'{n}{{{pre}quantile="{escape_label_value(q)}"}} {_fmt(v)}')
         lines.append(f"{n}_sum{tail} {_fmt(fam['sum'])}")
         lines.append(f"{n}_count{tail} {_fmt(fam['count'])}")
     return "\n".join(lines) + "\n" if lines else ""

@@ -1,0 +1,91 @@
+"""Serving-plane per-endpoint request statistics.
+
+The telemetry registry's ``AggregateSample`` keeps count/sum/min/max
+only — no percentiles — so the serving plane records each request's
+latency here too: a per-endpoint counter plus a bounded ring of recent
+latency samples, from which p50/p99 are computed at scrape time.
+``/v1/agent/metrics?format=prometheus`` renders the snapshot as a
+labeled counter + summary family (the JSON form stays the raw inmem
+interval list for compatibility)::
+
+    consul_http_requests_total{endpoint="kvs"} 1234
+    consul_http_request_ms{endpoint="kvs",quantile="0.5"} 1.2
+    consul_http_request_ms{endpoint="kvs",quantile="0.99"} 4.8
+
+Endpoint names are the HTTP handler names (``kvs``, ``status_leader``,
+…) for edge-served requests, and hot-op names (``kv_get``, ``kv_put``,
+…) for requests served to SO_REUSEPORT workers through the gateway —
+both planes land in the one master-process registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+_WINDOW = 1024  # recent-latency ring size per endpoint
+
+
+class EndpointStats:
+    def __init__(self, window: int = _WINDOW) -> None:
+        self._window = window
+        self._stats: Dict[str, Dict[str, Any]] = {}
+
+    def record(self, name: str, ms: float) -> None:
+        st = self._stats.get(name)
+        if st is None:
+            st = self._stats[name] = {
+                "count": 0, "sum_ms": 0.0,
+                "ring": [0.0] * self._window, "filled": 0, "next": 0}
+        st["count"] += 1
+        st["sum_ms"] += ms
+        ring = st["ring"]
+        ring[st["next"]] = ms
+        st["next"] = (st["next"] + 1) % self._window
+        if st["filled"] < self._window:
+            st["filled"] += 1
+
+    def reset(self) -> None:
+        self._stats.clear()
+
+    @staticmethod
+    def _pct(sorted_lat: List[float], q: float) -> float:
+        return sorted_lat[min(len(sorted_lat) - 1,
+                              int(q * len(sorted_lat)))]
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """{endpoint: {count, sum_ms, p50_ms, p99_ms}} over the
+        retained window (percentiles) / process lifetime (counts)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, st in self._stats.items():
+            lat = sorted(st["ring"][: st["filled"]])
+            row = {"count": st["count"],
+                   "sum_ms": round(st["sum_ms"], 3)}
+            if lat:
+                row["p50_ms"] = round(self._pct(lat, 0.50), 3)
+                row["p99_ms"] = round(self._pct(lat, 0.99), 3)
+            out[name] = row
+        return out
+
+    def prom_families(self) -> tuple:
+        """(counter_rows, summary_families) for obs.prom rendering:
+        counter_rows is ``[(labels, value)]`` for
+        ``consul_http_requests_total``; summary_families follow the
+        render_prometheus ``summaries=`` shape."""
+        counter_rows = []
+        summaries = []
+        for name, row in sorted(self.snapshot().items()):
+            labels = {"endpoint": name}
+            counter_rows.append((labels, float(row["count"])))
+            if "p50_ms" in row:
+                summaries.append({
+                    "name": "consul_http_request_ms",
+                    "help": "Recent request latency per endpoint (ms).",
+                    "labels": labels,
+                    "quantiles": [(0.5, row["p50_ms"]),
+                                  (0.99, row["p99_ms"])],
+                    "sum": row["sum_ms"], "count": float(row["count"]),
+                })
+        return counter_rows, summaries
+
+
+reqstats = EndpointStats()

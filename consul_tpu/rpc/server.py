@@ -238,6 +238,10 @@ def _build_handlers() -> Dict[str, Tuple[str, Callable]]:
     async def status_peers(srv, body):
         return srv.raft_peers()
 
+    @reg("Status.Lease", LOCAL)
+    async def status_lease(srv, body):
+        return srv.lease_state()
+
     # The generic write-forward target: the originating server validated
     # and ACL-checked; the leader applies through consensus.
     @reg("Server.Apply", WRITE)

@@ -233,6 +233,11 @@ class _RemoteStatus(_Remote):
     async def peers(self) -> List[str]:
         return await self.c.rpc("Status.Peers", {})
 
+    async def lease(self) -> dict:
+        # Lease state of whichever server the client is affined to —
+        # the client itself holds no raft state.
+        return await self.c.rpc("Status.Lease", {})
+
 
 class _RemoteCatalog(_Remote):
     async def register(self, args) -> None:

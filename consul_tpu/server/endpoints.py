@@ -106,6 +106,14 @@ class Status(_Endpoint):
     async def peers(self) -> List[str]:
         return self.srv.raft_peers()
 
+    async def lease(self) -> dict:
+        """Leader-lease state of THIS server (no forwarding): drives
+        read-replica routing — a worker or follower seeing
+        ``valid: true`` knows consistent reads here are barrier-free
+        at ``read_index`` (served locally once ``applied_index``
+        catches up via wait_applied)."""
+        return self.srv.lease_state()
+
 
 class Catalog(_Endpoint):
     async def register(self, args: RegisterRequest) -> None:

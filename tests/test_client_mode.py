@@ -143,6 +143,38 @@ class TestClientCore:
             await server.stop()
         loop.run_until_complete(body())
 
+    def test_client_edge_skips_hot_path_and_forwards_lease(self, loop):
+        """The serving fast path reads raft/store locally — a client
+        agent must keep routing KV through the generic mesh-forwarded
+        handlers, and /v1/status/lease must answer via Status.Lease
+        RPC (the client holds no lease of its own)."""
+        async def body():
+            import aiohttp
+            server = await _mk_server("srv1")
+            client = await _mk_client("cli1", _lan_seed(server))
+            await _wait(lambda: client.server.server_count() > 0)
+            assert not client.http._hot_capable
+            assert client.worker_pool is None
+            host, port = client.http.addr
+            async with aiohttp.ClientSession() as s:
+                # stale falls inside the hot subset on servers; on the
+                # client it must take the generic path, not 500.
+                async with s.put(f"http://{host}:{port}/v1/kv/hk",
+                                 data=b"x") as r:
+                    assert await r.json() is True
+                async with s.get(f"http://{host}:{port}"
+                                 "/v1/kv/hk?stale") as r:
+                    assert r.status == 200
+                    assert (await r.json())[0]["Key"] == "hk"
+                async with s.get(f"http://{host}:{port}"
+                                 "/v1/status/lease") as r:
+                    lease = await r.json()
+                    assert lease["is_leader"] is True  # the server's
+                    assert lease["valid"] is True
+            await client.stop()
+            await server.stop()
+        loop.run_until_complete(body())
+
 
 class TestClientCatalog:
     def test_reconcile_registers_client_with_serf_health(self, loop):

@@ -1,0 +1,365 @@
+"""Leader-lease safety and the zero-RPC consistent-read fast path.
+
+The lease argument (Raft §6.4 / leases as in "Scaling Strongly
+Consistent Replication"): a quorum of followers processed an
+AppendEntries round the leader SENT at time t, so none of them starts
+an election before t + election_timeout_min; the effective lease
+min(lease_timeout, election_timeout_min) * (1 - clock_skew) expires
+strictly earlier.  These tests pin the safety edges:
+
+  * a lease-holding leader serves a consistent read with ZERO
+    barrier/ReadIndex RPCs (the ISSUE acceptance test);
+  * lease expiry (stopped heartbeats, partition) falls back to the
+    coalesced barrier path — never an unprotected local read;
+  * a deposed leader that still THINKS it leads cannot serve a stale
+    consistent read: its lease dies with the role, and any same-term
+    survivor window is shorter than the minimum election timeout;
+  * the effective window is clamped and skew-discounted;
+  * single-node clusters are always freshly anchored (leases are pure
+    win there).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from consul_tpu.consensus.raft import (
+    LEADER, MemoryTransport, RaftConfig, RaftNode)
+from consul_tpu.server.server import Server, ServerConfig
+from consul_tpu.structs.structs import DirEntry, KVSOp, KVSRequest
+
+
+def fast_raft(**kw) -> RaftConfig:
+    base = dict(heartbeat_interval=0.02, election_timeout_min=0.1,
+                election_timeout_max=0.2, rpc_timeout=0.05)
+    base.update(kw)
+    return RaftConfig(**base)
+
+
+def make_servers(n, **raft_kw):
+    tr = MemoryTransport()
+    names = [f"s{i}" for i in range(n)]
+    servers = [Server(ServerConfig(node_name=name, peers=names,
+                                   raft=fast_raft(**raft_kw)), transport=tr)
+               for name in names]
+    return tr, servers
+
+
+async def start_and_elect(servers):
+    for s in servers:
+        await s.start()
+    deadline = asyncio.get_event_loop().time() + 5
+    while asyncio.get_event_loop().time() < deadline:
+        leaders = [s for s in servers if s.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        await asyncio.sleep(0.01)
+    raise AssertionError("no leader")
+
+
+async def stop_all(servers):
+    for s in servers:
+        await s.stop()
+
+
+async def wait_until(pred, timeout=5.0, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError(f"timeout: {msg}")
+
+
+async def wait_for_lease(srv, timeout=5.0):
+    await wait_until(lambda: srv.raft.lease_valid(), timeout=timeout,
+                     msg="leader lease")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class BarrierSpy:
+    """Counts every leadership-proof RPC avenue a consistent read could
+    take: barrier commits, AppendEntries sends, and leader-forwarded
+    ReadIndex calls."""
+
+    def __init__(self, srv):
+        self.srv = srv
+        self.barriers = 0
+        self.transport_calls = 0
+        self.forwards = 0
+        self._orig_barrier = srv.raft.barrier
+        self._orig_call = srv.raft.transport.call
+        self._orig_fwd = srv.forward_leader
+
+    def install(self):
+        async def barrier(*a, **kw):
+            self.barriers += 1
+            return await self._orig_barrier(*a, **kw)
+
+        async def call(src, dst, method, msg):
+            if src == self.srv.raft.id:
+                self.transport_calls += 1
+            return await self._orig_call(src, dst, method, msg)
+
+        async def fwd(*a, **kw):
+            self.forwards += 1
+            return await self._orig_fwd(*a, **kw)
+
+        self.srv.raft.barrier = barrier
+        self.srv.raft.transport.call = call
+        self.srv.forward_leader = fwd
+        return self
+
+    def uninstall(self):
+        self.srv.raft.barrier = self._orig_barrier
+        self.srv.raft.transport.call = self._orig_call
+        self.srv.forward_leader = self._orig_fwd
+
+
+class TestLeaseFastPath:
+    def test_consistent_read_zero_rpcs_under_lease(self):
+        """THE acceptance test: consistent read on a lease-holding
+        leader performs no barrier and no ReadIndex RPC — only the
+        background heartbeat traffic continues."""
+        async def main():
+            _, servers = make_servers(3)
+            leader = await start_and_elect(servers)
+            await leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="k", value=b"v")))
+            await wait_for_lease(leader)
+            spy = BarrierSpy(leader).install()
+            try:
+                # Heartbeats race through transport.call concurrently;
+                # distinguish read-path RPCs by running the read with
+                # the event loop otherwise idle: the read must finish
+                # without yielding to a replication round it caused.
+                before = spy.barriers
+                idx = await leader._leader_confirm()
+                assert spy.barriers == before == 0, \
+                    "lease-holding leader ran a barrier commit"
+                assert idx == leader.raft.commit_index
+                # Full endpoint path: the read itself (not the prologue)
+                await leader.consistent_read_barrier()
+                assert spy.barriers == 0
+                assert spy.forwards == 0
+                _, ent = leader.store.kvs_get("k")
+                assert ent is not None and bytes(ent.value) == b"v"
+            finally:
+                spy.uninstall()
+                await stop_all(servers)
+        run(main())
+
+    def test_lease_metrics_counters(self):
+        """Lease-served and barrier-served reads are separately
+        countable (consul.read.lease / consul.read.barrier)."""
+        async def main():
+            from consul_tpu.utils.telemetry import metrics
+            _, servers = make_servers(3)
+            leader = await start_and_elect(servers)
+            await wait_for_lease(leader)
+            base = _counter_sum(metrics, "read.lease")
+            await leader.consistent_read_barrier()
+            assert _counter_sum(metrics, "read.lease") == base + 1
+            await stop_all(servers)
+        run(main())
+
+    def test_single_node_lease_always_anchored(self):
+        async def main():
+            srv = Server(ServerConfig(node_name="solo",
+                                      raft=fast_raft()))
+            await srv.start()
+            await srv.wait_for_leader()
+            await wait_for_lease(srv)
+            spy = BarrierSpy(srv).install()
+            try:
+                await srv.consistent_read_barrier()
+                assert spy.barriers == 0
+                assert spy.transport_calls == 0
+            finally:
+                spy.uninstall()
+                await srv.stop()
+        run(main())
+
+    def test_follower_readindex_rides_leader_lease(self):
+        """_ri_leader_runner short-circuits to commit_index under the
+        lease: the follower ReadIndex costs one forward RPC and no
+        barrier commit."""
+        async def main():
+            _, servers = make_servers(3)
+            leader = await start_and_elect(servers)
+            await wait_for_lease(leader)
+            spy = BarrierSpy(leader).install()
+            try:
+                idx = await leader._ri_leader_runner()
+                assert idx == leader.raft.commit_index
+                assert spy.barriers == 0
+            finally:
+                spy.uninstall()
+                await stop_all(servers)
+        run(main())
+
+
+class TestLeaseFallback:
+    def test_expired_lease_falls_back_to_barrier(self):
+        """Cut the leader off from its followers: once the lease
+        window lapses, lease_read_index is None and a consistent read
+        attempts the barrier path (which can no longer succeed against
+        a lost quorum — it must NOT serve locally)."""
+        async def main():
+            tr, servers = make_servers(3)
+            leader = await start_and_elect(servers)
+            await wait_for_lease(leader)
+            tr.isolate(leader.raft.id)
+            dur = leader.raft._lease_duration()
+            await asyncio.sleep(dur + 0.05)
+            assert not leader.raft.lease_valid()
+            assert leader.raft.lease_read_index() is None
+            spy = BarrierSpy(leader).install()
+            try:
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(leader._leader_confirm(),
+                                           timeout=0.5)
+                assert spy.barriers == 1, "expiry must take the barrier path"
+            finally:
+                spy.uninstall()
+                await stop_all(servers)
+        run(main())
+
+    def test_stepdown_invalidates_lease(self):
+        """A deposed leader's lease dies WITH the role (not just by
+        timeout): _stop_leading clears the ack table, so even within
+        the old window lease_valid() is False."""
+        async def main():
+            tr, servers = make_servers(3)
+            leader = await start_and_elect(servers)
+            await wait_for_lease(leader)
+            tr.isolate(leader.raft.id)
+            others = [s for s in servers if s is not leader]
+            await wait_until(lambda: any(s.is_leader() for s in others),
+                             msg="new leader elected")
+            tr.rejoin(leader.raft.id)
+            await wait_until(lambda: not leader.is_leader(),
+                             msg="old leader stepped down")
+            assert not leader.raft.lease_valid()
+            assert leader.raft._lease_ack == {}
+            # ...and the fast path refuses it even if role flaps back:
+            assert leader.raft.lease_read_index() is None
+            await stop_all(servers)
+        run(main())
+
+    def test_deposed_leader_never_serves_stale_consistent_read(self):
+        """The money property: partition the leader, elect a new one,
+        write through the new leader — the OLD leader (still in LEADER
+        role, unaware) must not serve a consistent read that misses the
+        new write.  Its lease expired before the new election could
+        finish, so the fast path is closed and the barrier path cannot
+        commit against a lost quorum."""
+        async def main():
+            tr, servers = make_servers(3)
+            leader = await start_and_elect(servers)
+            await leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="x", value=b"old")))
+            await wait_for_lease(leader)
+            tr.isolate(leader.raft.id)
+            others = [s for s in servers if s is not leader]
+            await wait_until(lambda: any(s.is_leader() for s in others),
+                             msg="new leader")
+            new_leader = next(s for s in others if s.is_leader())
+            await new_leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value, dir_ent=DirEntry(key="x", value=b"new")))
+            # Old leader may still be in LEADER role behind the wall.
+            if leader.raft.role == LEADER:
+                # Lease safety: by the time ANY new leader exists, the
+                # old lease has expired (the clock-skew margin is what
+                # guarantees the strict ordering).
+                assert not leader.raft.lease_valid()
+                with pytest.raises(Exception):
+                    await asyncio.wait_for(leader.consistent_read_barrier(),
+                                           timeout=0.5)
+            await stop_all(servers)
+        run(main())
+
+
+class TestLeaseWindow:
+    def test_duration_clamped_and_skew_discounted(self):
+        node = RaftNode("n", ["n"], fsm=None, transport=MemoryTransport(),
+                        config=fast_raft(lease_timeout=10.0,
+                                         lease_clock_skew=0.15))
+        # 10s config clamps to election_timeout_min (0.1) then takes
+        # the 15% skew discount.
+        assert node._lease_duration() == pytest.approx(0.1 * 0.85)
+
+    def test_negative_timeout_disables(self):
+        node = RaftNode("n", ["n"], fsm=None, transport=MemoryTransport(),
+                        config=fast_raft(lease_timeout=-1.0))
+        assert node._lease_duration() == 0.0
+        assert not node.lease_valid()
+
+    def test_anchor_is_quorum_th_most_recent(self):
+        node = RaftNode("a", ["a", "b", "c", "d", "e"], fsm=None,
+                        transport=MemoryTransport(), config=fast_raft())
+        node.role = LEADER
+        now = time.monotonic()
+        # quorum of 5 = 3; self implicit, need 2 follower acks.
+        node._lease_ack = {"b": now - 0.01, "c": now - 0.05,
+                           "d": now - 0.50}
+        # 2nd most recent follower ack anchors the lease.
+        assert node._lease_anchor() == pytest.approx(now - 0.05)
+
+    def test_insufficient_acks_no_anchor(self):
+        node = RaftNode("a", ["a", "b", "c"], fsm=None,
+                        transport=MemoryTransport(), config=fast_raft())
+        node.role = LEADER
+        assert node._lease_anchor() == 0.0
+        assert not node.lease_valid()
+
+    def test_fresh_leader_guard_blocks_until_own_term_commit(self):
+        """Raft §6.4 precondition: before the no-op of its own term
+        commits, a fresh leader's commit_index may lag — the lease may
+        not serve reads even with fresh acks."""
+        node = RaftNode("a", ["a", "b", "c"], fsm=None,
+                        transport=MemoryTransport(), config=fast_raft())
+        node.role = LEADER
+        now = time.monotonic()
+        node._lease_ack = {"b": now, "c": now}
+        node._lease_guard_index = 7
+        node.commit_index = 6
+        assert not node.lease_valid()
+        node.commit_index = 7
+        assert node.lease_valid()
+
+    def test_lease_in_stats(self):
+        async def main():
+            _, servers = make_servers(3)
+            leader = await start_and_elect(servers)
+            await wait_for_lease(leader)
+            st = leader.raft.stats()
+            assert st["lease"] == "valid"
+            assert int(st["lease_remaining_ms"]) >= 0
+            follower = next(s for s in servers if not s.is_leader())
+            assert follower.raft.stats()["lease"] == "invalid"
+            ls = leader.lease_state()
+            assert ls["valid"] and ls["is_leader"]
+            assert ls["read_index"] == leader.raft.commit_index
+            await stop_all(servers)
+        run(main())
+
+
+def _counter_sum(metrics, suffix: str) -> float:
+    total = 0.0
+    for iv in metrics.snapshot():
+        for k, c in iv.get("Counters", {}).items():
+            if k.endswith(suffix):
+                total += c["sum"]
+    return total

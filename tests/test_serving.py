@@ -1,0 +1,243 @@
+"""Serving-plane fast path: compact serialization, hot-op parity,
+request stats, and the multi-worker front.
+
+The fast path (agent/hotpath.py) computes hot responses as raw bytes
+once; these tests pin the properties that keep it honest:
+
+  * wire parity — a hot-path KV GET is byte-identical to the generic
+    path's compact JSON (same key order, same b64, same headers);
+  * ``?pretty`` still pretty-prints, everything else is compact;
+  * 404s and ``?raw`` keep their index headers / octet-stream shape;
+  * per-endpoint request stats (obs/reqstats.py) surface in the
+    Prometheus exposition and pass tools/check_prom.py;
+  * the worker front's hot-subset tables stay in lockstep with the
+    edge's (a drifted table silently reroutes traffic);
+  * a forked multi-worker agent answers hot and non-hot (proxied)
+    routes correctly end to end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from test_agent_http import AgentHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = AgentHarness().start()
+    yield h
+    h.stop()
+
+
+def _call(h, coro):
+    return asyncio.run_coroutine_threadsafe(coro, h.loop).result(10)
+
+
+class TestCompactJSON:
+    def test_default_compact_pretty_opt_in(self, harness):
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            assert c.put("/v1/kv/compact", content=b"v").json() is True
+            flat = c.get("/v1/kv/compact").text
+            assert ": " not in flat and ", " not in flat
+            pretty = c.get("/v1/kv/compact?pretty").text
+            assert pretty.startswith("[\n")
+            assert json.loads(flat) == json.loads(pretty)
+
+    def test_hot_get_parity_with_generic_path(self, harness):
+        """Same key, hot path (bare GET) vs generic path (?keys-free
+        query outside the hot subset forces the generic handler):
+        byte-identical body, same index headers."""
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            c.put("/v1/kv/parity?flags=7", content=b"payload")
+            hot = c.get("/v1/kv/parity")
+            # dc= falls outside _HOT_GET -> generic QueryOptions path.
+            generic = c.get("/v1/kv/parity?dc=")
+            assert hot.content == generic.content
+            assert hot.headers["content-type"] == \
+                generic.headers["content-type"]
+            for hdr in ("x-consul-index", "x-consul-knownleader"):
+                assert hot.headers[hdr] == generic.headers[hdr]
+            ent = hot.json()[0]
+            assert list(ent.keys()) == [
+                "Key", "Value", "Flags", "Session", "LockIndex",
+                "CreateIndex", "ModifyIndex"]
+            assert ent["Flags"] == 7
+
+    def test_hot_404_keeps_index_headers(self, harness):
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            r = c.get("/v1/kv/definitely-missing")
+            assert r.status_code == 404
+            assert int(r.headers["x-consul-index"]) >= 0
+            assert r.headers["x-consul-knownleader"] == "true"
+
+    def test_hot_raw(self, harness):
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            c.put("/v1/kv/rawkey", content=b"\x00binary\xff")
+            r = c.get("/v1/kv/rawkey?raw")
+            assert r.content == b"\x00binary\xff"
+            assert r.headers["content-type"].startswith(
+                "application/octet-stream")
+
+    def test_hot_consistent_and_stale(self, harness):
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            c.put("/v1/kv/modes", content=b"m")
+            for qs in ("?consistent", "?stale"):
+                r = c.get("/v1/kv/modes" + qs)
+                assert r.status_code == 200
+                assert r.json()[0]["Key"] == "modes"
+            # both at once is contradictory -> generic path rejects
+            r = c.get("/v1/kv/modes?consistent&stale")
+            assert r.status_code == 400
+
+    def test_status_lease_route(self, harness):
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            ls = c.get("/v1/status/lease").json()
+            assert ls["is_leader"] is True
+            assert ls["valid"] is True  # single node: always anchored
+            assert ls["read_index"] >= 0
+
+
+class TestRequestStats:
+    def test_counters_and_quantiles_exposed(self, harness):
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            for _ in range(5):
+                c.get("/v1/kv/stats-probe")
+            text = c.get("/v1/agent/metrics?format=prometheus").text
+        assert '# TYPE consul_http_requests_total counter' in text
+        assert 'consul_http_requests_total{endpoint="kvs"}' in text
+        assert '# TYPE consul_http_request_ms summary' in text
+        assert 'consul_http_request_ms{endpoint="kvs",quantile="0.5"}' in text
+        assert 'consul_http_request_ms_count{endpoint="kvs"}' in text
+
+    def test_exposition_passes_check_prom(self, harness, tmp_path):
+        import subprocess
+        import sys
+        with httpx.Client(base_url=harness.http_addr, timeout=10) as c:
+            c.put("/v1/kv/cp", content=b"x")
+            c.get("/v1/kv/cp")
+            text = c.get("/v1/agent/metrics?format=prometheus").text
+        p = tmp_path / "metrics.prom"
+        p.write_text(text)
+        out = subprocess.run(
+            [sys.executable, "tools/check_prom.py", str(p),
+             "--require", "consul_http_requests_total",
+             "--require", "consul_http_request_ms"],
+            capture_output=True, text=True, cwd=_repo_root())
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_snapshot_shape(self):
+        from consul_tpu.obs.reqstats import EndpointStats
+        st = EndpointStats(window=8)
+        for ms in (1.0, 2.0, 3.0, 100.0):
+            st.record("ep", ms)
+        snap = st.snapshot()["ep"]
+        assert snap["count"] == 4
+        assert snap["p50_ms"] == 3.0
+        assert snap["p99_ms"] == 100.0
+        rows, summaries = st.prom_families()
+        assert rows == [({"endpoint": "ep"}, 4.0)]
+        assert summaries[0]["quantiles"][0] == (0.5, 3.0)
+
+    def test_window_bounds_memory(self):
+        from consul_tpu.obs.reqstats import EndpointStats
+        st = EndpointStats(window=16)
+        for i in range(1000):
+            st.record("ep", float(i))
+        snap = st.snapshot()["ep"]
+        assert snap["count"] == 1000          # lifetime counter
+        assert snap["p50_ms"] >= 984.0        # ring kept only the tail
+
+
+class TestWorkerFrontTables:
+    def test_hot_subsets_match_edge(self):
+        """The worker classifies requests with its own copies of the
+        hot-key tables; drift silently sends hot traffic down the slow
+        proxy (or worse, non-hot down the fast path)."""
+        from consul_tpu.agent import workers
+        from consul_tpu.agent.http_api import HTTPServer
+        assert workers.HOT_GET == HTTPServer._HOT_GET
+        assert workers.HOT_PUT == HTTPServer._HOT_PUT
+        assert workers.HOT_DELETE == HTTPServer._HOT_DELETE
+
+    def test_hot_ok_rejects_contradiction_and_strangers(self):
+        from consul_tpu.agent.workers import HOT_GET, _hot_ok
+        assert _hot_ok({}, HOT_GET)
+        assert _hot_ok({"stale": ""}, HOT_GET)
+        assert not _hot_ok({"stale": "", "consistent": ""}, HOT_GET)
+        assert not _hot_ok({"index": "5"}, HOT_GET)  # blocking -> proxy
+
+    def test_gateway_ops_cover_worker_routes(self):
+        from consul_tpu.agent import hotpath
+        for op in ("kv_get", "kv_put", "kv_delete", "health_service",
+                   "catalog_nodes", "catalog_services", "catalog_service",
+                   "status_leader", "status_lease"):
+            assert op in hotpath.OPS
+
+    def test_handle_maps_unknown_op(self):
+        from consul_tpu.agent import hotpath
+        status, _, _, body = asyncio.new_event_loop().run_until_complete(
+            hotpath.handle(None, "nope", {}))
+        assert status == 500 and b"unknown hot op" in body
+
+
+@pytest.mark.slow
+class TestMultiWorkerBlackbox:
+    def test_workers_serve_hot_and_proxied_routes(self):
+        """Forked agent with http_workers=3: hot KV round-trips, the
+        proxied (?pretty) leg, and gateway-recorded request stats all
+        work; shutdown reaps every worker by tracked PID."""
+        import sys
+        import urllib.request
+        sys.path.insert(0, _repo_root() + "/tests")
+        from test_blackbox import TestServer
+        s = TestServer("mworkers", config_extra={"http_workers": 3})
+        try:
+            s.start()
+            s.wait_for_api()
+            s.wait_for_leader()
+            base = f"http://127.0.0.1:{s.ports['http']}"
+
+            def req(method, path, data=None):
+                r = urllib.request.Request(base + path, data=data,
+                                           method=method)
+                with urllib.request.urlopen(r, timeout=10) as resp:
+                    return resp.status, resp.read()
+            import time as _t
+            _t.sleep(1.5)  # let the workers bind before driving load
+            assert req("PUT", "/v1/kv/mw", b"val")[1] == b"true"
+            for _ in range(40):
+                st, body = req("GET", "/v1/kv/mw")
+                assert st == 200
+                assert json.loads(body)[0]["Key"] == "mw"
+            st, body = req("GET", "/v1/kv/mw?pretty")  # proxied leg
+            assert st == 200 and body.startswith(b"[\n")
+            st, body = req("GET", "/v1/agent/metrics?format=prometheus")
+            text = body.decode()
+            served = {
+                name: _scrape_counter(text, name)
+                for name in ("kv_get", "kvs")}
+            # SO_REUSEPORT spreads connections across master + workers;
+            # both planes must have served some share.
+            assert served["kv_get"] + served["kvs"] >= 40
+            assert served["kv_get"] > 0, \
+                "no request reached a worker's gateway"
+        finally:
+            s.stop()
+
+
+def _scrape_counter(text: str, endpoint: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(
+                f'consul_http_requests_total{{endpoint="{endpoint}"}}'):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _repo_root() -> str:
+    import os
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,224 @@
+"""Serving-plane KV microbench — stale / default / consistent legs.
+
+Forks a single local server (``tests/blackbox_util.TestServer``) and
+drives ``/v1/kv/bench`` over raw asyncio HTTP/1.1 keep-alive
+connections — no client-side HTTP framework, so on a shared-core box
+the measurement tracks the *server's* cost per request rather than
+the client's.  Legs:
+
+    kv_put             PUT through raft group-commit
+    kv_get             default consistency (leader-local read)
+    kv_get_stale       ?stale (any-server local read)
+    kv_get_consistent  ?consistent (lease short-circuit or ReadIndex)
+
+``--workers 1,4`` repeats the run at each ``http_workers`` setting
+(SO_REUSEPORT worker processes in front of the agent core); when the
+value is 1 the key is omitted from the forked config so the bench
+also runs against builds that predate it.  Output is one JSON object
+with GET/s and p50/p99 per leg per worker count.
+
+Child processes are terminated by tracked PID only (TestServer.stop
+sends SIGTERM to its own Popen handle, then SIGKILL after a grace
+period) — never by name matching.
+
+Run:    python tools/bench_serve.py [--requests 4000] [--concurrency 32]
+                                    [--workers 1,4] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+PUT_BODY = b"74a31e96-1d0f-4fa7-aa14-7212a326986e"
+
+
+class KeepAliveConn:
+    """One HTTP/1.1 keep-alive connection speaking just enough HTTP."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self.reader = None
+        self.writer = None
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port)
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self.writer = None
+
+    def _frame(self, method: str, path: str, body: bytes | None) -> bytes:
+        head = (f"{method} {path} HTTP/1.1\r\n"
+                f"Host: {self.host}:{self.port}\r\n")
+        if body is not None:
+            head += f"Content-Length: {len(body)}\r\n"
+        head += "\r\n"
+        return head.encode("ascii") + (body or b"")
+
+    async def request(self, method: str, path: str,
+                      body: bytes | None = None) -> int:
+        """Issue one request, drain the response, return the status."""
+        if self.writer is None:
+            await self.connect()
+        frame = self._frame(method, path, body)
+        try:
+            self.writer.write(frame)
+            await self.writer.drain()
+            return await self._read_response()
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            # Server rotated the keep-alive connection: one reconnect.
+            await self.close()
+            await self.connect()
+            self.writer.write(frame)
+            await self.writer.drain()
+            return await self._read_response()
+
+    async def _read_response(self) -> int:
+        head = await self.reader.readuntil(b"\r\n\r\n")
+        lines = head.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(" ", 2)[1])
+        length = 0
+        chunked = False
+        for ln in lines[1:]:
+            low = ln.lower()
+            if low.startswith("content-length:"):
+                length = int(ln.split(":", 1)[1])
+            elif low.startswith("transfer-encoding:") and "chunked" in low:
+                chunked = True
+        if chunked:
+            while True:
+                size_ln = await self.reader.readuntil(b"\r\n")
+                size = int(size_ln.strip(), 16)
+                await self.reader.readexactly(size + 2)
+                if size == 0:
+                    break
+        elif length:
+            await self.reader.readexactly(length)
+        return status
+
+
+async def drive(host: str, port: int, method: str, path: str,
+                body: bytes | None, total: int, concurrency: int) -> dict:
+    latencies: list = []
+    errors = [0]
+    sample_err = [None]
+    queue: asyncio.Queue = asyncio.Queue()
+    for _ in range(total):
+        queue.put_nowait(None)
+
+    async def worker() -> None:
+        conn = KeepAliveConn(host, port)
+        try:
+            await conn.connect()
+            while True:
+                try:
+                    queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    status = await conn.request(method, path, body)
+                    if status >= 400:
+                        errors[0] += 1
+                        if sample_err[0] is None:
+                            sample_err[0] = f"status {status}"
+                except Exception as e:
+                    errors[0] += 1
+                    if sample_err[0] is None:
+                        sample_err[0] = f"{type(e).__name__}: {e}"
+                    await conn.close()
+                latencies.append((time.perf_counter() - t0) * 1000)
+        finally:
+            await conn.close()
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[worker() for _ in range(concurrency)])
+    wall = time.perf_counter() - t0
+    lat = sorted(latencies) or [0.0]
+
+    def pct(q: float) -> float:
+        return lat[min(len(lat) - 1, int(q / 100 * len(lat)))]
+
+    out = {
+        "requests": total, "errors": errors[0],
+        "req_per_sec": round(total / wall, 1),
+        "p50_ms": round(pct(50), 2), "p99_ms": round(pct(99), 2),
+    }
+    if sample_err[0] is not None:
+        out["sample_error"] = sample_err[0]
+    return out
+
+
+async def bench_one(nworkers: int, requests: int, concurrency: int) -> dict:
+    from blackbox_util import TestServer
+
+    extra = {"http_workers": nworkers} if nworkers > 1 else {}
+    srv = TestServer(f"bs{nworkers}", config_extra=extra).start()
+    try:
+        srv.wait_for_api()
+        srv.wait_for_leader()
+        host, port = "127.0.0.1", srv.ports["http"]
+        warm = KeepAliveConn(host, port)
+        await warm.connect()
+        await warm.request("PUT", "/v1/kv/bench", PUT_BODY)
+        for _ in range(20):
+            await warm.request("GET", "/v1/kv/bench")
+        await warm.close()
+
+        results = {}
+        legs = [
+            ("kv_put", "PUT", "/v1/kv/bench", PUT_BODY),
+            ("kv_get", "GET", "/v1/kv/bench", None),
+            ("kv_get_stale", "GET", "/v1/kv/bench?stale", None),
+            ("kv_get_consistent", "GET", "/v1/kv/bench?consistent", None),
+        ]
+        for name, method, path, body in legs:
+            print(f"[bench-serve] workers={nworkers} {name} x{requests}"
+                  f" @{concurrency}", file=sys.stderr)
+            results[name] = await drive(host, port, method, path, body,
+                                        requests, concurrency)
+        return results
+    finally:
+        srv.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=4000)
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--workers", default="1",
+                    help="comma list of http_workers settings, e.g. 1,4")
+    ap.add_argument("--out", default="", help="also write JSON to this file")
+    args = ap.parse_args()
+
+    out = {"requests": args.requests, "concurrency": args.concurrency,
+           "runs": {}}
+    for n in [int(w) for w in args.workers.split(",") if w.strip()]:
+        out["runs"][f"workers={n}"] = asyncio.run(
+            bench_one(n, args.requests, args.concurrency))
+    text = json.dumps(out, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,7 @@ WIRE_MODULES = (
     "consul_tpu/server/client.py",
     "consul_tpu/ipc/server.py",
     "consul_tpu/ipc/client.py",
+    "consul_tpu/agent/workers.py",
 )
 
 # (unit name, module suffixes) whose Capitalized keys form one shared
@@ -66,7 +67,8 @@ ENVELOPE_GROUPS = (
     ("rpc-envelope", ("consul_tpu/rpc/server.py",
                       "consul_tpu/rpc/pool.py")),
     ("ipc-envelope", ("consul_tpu/ipc/server.py",
-                      "consul_tpu/ipc/client.py")),
+                      "consul_tpu/ipc/client.py",
+                      "consul_tpu/agent/workers.py")),
 )
 
 # decode-table entries -> the encode unit they must mirror
