@@ -990,13 +990,19 @@ class Agent:
         # Detection-latency SLO: an operator health surface like
         # /v1/agent/metrics, not a debug surface — always on.
         router.add_get("/v1/agent/slo", h(self._slo))
+        # Consensus-plane observatory (obs/raftstats.py): raft stats +
+        # latency histograms + per-peer replication state + the
+        # leadership/lease event timeline.  Operator surface like
+        # /v1/agent/slo — always on (empty-ish in client mode).
+        router.add_get("/v1/operator/raft/telemetry", h(self._raft_telemetry))
         # Observability surfaces, gated like /debug/pprof/* (http.go
         # EnableDebug): finished traces, the kernel flight recorder,
-        # and on-demand device profiling.
+        # on-demand device profiling, and the one-shot incident bundle.
         if self.config.enable_debug:
             router.add_get("/v1/agent/traces", h(self._traces))
             router.add_get("/v1/agent/flight", h(self._flight))
             router.add_get("/v1/agent/profile", h(self._profile))
+            router.add_get("/v1/agent/debug/bundle", h(self._debug_bundle))
 
     async def _metrics(self, request):
         """Telemetry snapshot: the inmem sink's interval ring (the
@@ -1006,38 +1012,111 @@ class Agent:
         from consul_tpu.utils.telemetry import metrics
         if request.query.get("format") == "prometheus":
             from aiohttp import web
-
-            from consul_tpu.obs.prom import render_prometheus
-            # Scrape-time collection of the kernel flight recorder: it
-            # lives in the plane process, so pull its summary over the
-            # bridge and mirror it here as consul.flight.* gauges.
-            getter = getattr(self.lan_pool, "plane_flight", None)
-            if getter is not None:
-                from consul_tpu.obs.flight import fold_summary
-                fr = await getter(timeout=2.0)
-                fold_summary(metrics, fr.get("summary") or {})
-            # Same for the detection-latency banks: cumulative histogram
-            # families rendered with le/_sum/_count per the text format.
-            hists = None
-            slo_getter = getattr(self.lan_pool, "plane_slo", None)
-            if slo_getter is not None:
-                hists = (await slo_getter(timeout=2.0)).get("hists")
-            # Serving-plane request stats: per-endpoint counters +
-            # p50/p99 latency summaries (obs/reqstats.py).  Gateway hot
-            # ops and edge handlers share this registry.
-            from consul_tpu.obs.reqstats import reqstats
-            counter_rows, summaries = reqstats.prom_families()
-            return web.Response(
-                text=render_prometheus(metrics.snapshot(), histograms=hists,
-                                       summaries=summaries,
-                                       labeled_counters=[{
-                                           "name": "consul_http_requests_total",
-                                           "help": "HTTP requests served, "
-                                                   "by endpoint.",
-                                           "rows": counter_rows,
-                                       }] if counter_rows else None),
-                content_type="text/plain")
+            return web.Response(text=await self._prom_text(),
+                                content_type="text/plain")
         return metrics.snapshot()
+
+    async def _prom_text(self) -> str:
+        """Assemble the full Prometheus exposition: telemetry registry,
+        kernel flight-recorder fold, detection-latency banks, request
+        stats, and the consensus-plane observatory.  Shared by the
+        scrape endpoint and the debug bundle's metrics snapshot."""
+        from consul_tpu.obs import raftstats
+        from consul_tpu.obs.prom import render_prometheus
+        from consul_tpu.obs.reqstats import reqstats
+        from consul_tpu.utils.telemetry import metrics
+        # Scrape-time collection of the kernel flight recorder: it
+        # lives in the plane process, so pull its summary over the
+        # bridge and mirror it here as consul.flight.* gauges.
+        getter = getattr(self.lan_pool, "plane_flight", None)
+        if getter is not None:
+            from consul_tpu.obs.flight import fold_summary
+            fr = await getter(timeout=2.0)
+            fold_summary(metrics, fr.get("summary") or {})
+        # Same for the detection-latency banks: cumulative histogram
+        # families rendered with le/_sum/_count per the text format.
+        hists = []
+        slo_getter = getattr(self.lan_pool, "plane_slo", None)
+        if slo_getter is not None:
+            hists += (await slo_getter(timeout=2.0)).get("hists") or []
+        # Serving-plane request stats: per-endpoint counters +
+        # p50/p99 latency summaries (obs/reqstats.py).  Gateway hot
+        # ops and edge handlers share this registry.
+        counter_rows, summaries = reqstats.prom_families()
+        labeled_counters = []
+        if counter_rows:
+            labeled_counters.append({
+                "name": "consul_http_requests_total",
+                "help": "HTTP requests served, by endpoint.",
+                "rows": counter_rows,
+            })
+        # Consensus-plane observatory: raft latency ladders + per-peer
+        # replication series (client mode has no raft — skip).
+        labeled_gauges = []
+        raft = getattr(self.server, "raft", None)
+        if raft is not None:
+            r_hists, r_gauges, r_counters = raftstats.prom_families(raft)
+            hists += r_hists
+            labeled_gauges += r_gauges
+            labeled_counters += r_counters
+        ae_hists, ae_counters = raftstats.aestats.families()
+        hists += ae_hists
+        labeled_counters += ae_counters
+        # Rendered as a label-less family (not a telemetry point: the
+        # registry would interpose the node name and break the stable
+        # consul_antientropy_* schema across agents).
+        labeled_gauges.append({
+            "name": "consul_antientropy_pending_ops",
+            "help": "Catalog operations the next anti-entropy pass "
+                    "would issue.",
+            "rows": [({}, float(self.local.pending_ops()))],
+        })
+        snap = metrics.snapshot()
+        # Lease-vs-barrier consistent-read split as one labeled family
+        # (the registry names may carry the node name between the first
+        # two key parts — match by suffix).  Both rows always render so
+        # lease efficacy is graphable from the first scrape.
+        reads = {"lease": 0.0, "barrier": 0.0}
+        for iv in snap:
+            for k, c in iv.get("Counters", {}).items():
+                for path in reads:
+                    if k.endswith("read." + path):
+                        reads[path] += float(c["sum"])
+        labeled_counters.append({
+            "name": "consul_consistent_reads_total",
+            "help": "Consistent reads served, by confirmation path "
+                    "(lease fast path vs barrier/ReadIndex).",
+            "rows": [({"path": p}, v) for p, v in sorted(reads.items())],
+        })
+        return render_prometheus(snap, histograms=hists or None,
+                                 summaries=summaries,
+                                 labeled_counters=labeled_counters,
+                                 labeled_gauges=labeled_gauges or None)
+
+    async def _raft_telemetry(self, request):
+        """Consensus-plane telemetry JSON: raft stats, latency
+        histograms, per-peer replication state, the leadership/lease
+        event timeline, and anti-entropy sync state."""
+        from consul_tpu.obs import raftstats
+        return raftstats.telemetry(getattr(self.server, "raft", None),
+                                   local=self.local)
+
+    async def _debug_bundle(self, request):
+        """One-shot incident capture (the `consul debug` analog):
+        sample over a short window, return a tar.gz."""
+        from aiohttp import web
+
+        from consul_tpu.agent import bundle
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            seconds = 2.0
+        seconds = max(0.0, min(30.0, seconds))
+        data = await bundle.capture(self, seconds)
+        return web.Response(
+            body=data, content_type="application/gzip",
+            headers={"Content-Disposition":
+                     'attachment; filename="consul-debug.tar.gz"'})
 
     async def _slo(self, request):
         """Detection-latency SLO observatory: burn-rate snapshot, exact

@@ -1,0 +1,99 @@
+"""One-shot debug bundle: the ``consul debug`` analog.
+
+The reference ships a ``consul debug`` command that captures metrics,
+pprof profiles, logs, and cluster state over a sample window into a
+single archive an operator can attach to an incident.  This module is
+that capture for this codebase: ``capture(agent, seconds)`` samples the
+agent over the window and returns a gzipped tarball of:
+
+* ``manifest.json``         — capture metadata + section list
+* ``metrics/snapshot_start.json`` / ``snapshot_end.json`` — the inmem
+  telemetry ring at both window edges (rates are derivable)
+* ``metrics/prometheus.txt`` — the full scrape-format exposition,
+  including the consensus-plane families (obs/raftstats.py)
+* ``slo.json``              — detection-latency SLO observatory state
+* ``traces.json``           — recent finished traces (obs/trace.py)
+* ``flight.json``           — kernel flight-recorder drain
+* ``raft/telemetry.json``   — raft stats + histograms + per-peer rows
+  + the leadership/election/lease event timeline
+* ``tasks.txt``             — thread + asyncio task dump (agent/debug.py)
+* ``config.json``           — agent config with secrets redacted
+
+Served via ``/v1/agent/debug/bundle?seconds=N`` (enable_debug-gated,
+like the pprof routes) and fetched by the ``consul-tpu debug`` CLI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import io
+import json
+import tarfile
+import time
+from typing import Any, Dict
+
+from consul_tpu.version import VERSION
+
+# AgentConfig fields whose values must never leave the process in a
+# bundle (gossip key, ACL tokens).
+SECRET_FIELDS = ("encrypt", "acl_master_token", "acl_token")
+
+SECTIONS = ("metrics", "slo", "traces", "flight", "raft", "tasks", "config")
+
+
+def redacted_config(config: Any) -> Dict[str, Any]:
+    cfg = dataclasses.asdict(config)
+    for k in SECRET_FIELDS:
+        if cfg.get(k):
+            cfg[k] = "<redacted>"
+    return cfg
+
+
+async def capture(agent: Any, seconds: float) -> bytes:
+    """Sample ``agent`` over ``seconds`` and return the tar.gz bytes."""
+    from consul_tpu.obs import raftstats
+    from consul_tpu.obs.trace import tracer
+    from consul_tpu.utils.telemetry import metrics
+
+    from consul_tpu.agent import debug
+
+    start_snap = metrics.snapshot()
+    if seconds > 0:
+        await asyncio.sleep(seconds)
+    end_snap = metrics.snapshot()
+
+    files: Dict[str, bytes] = {}
+
+    def put_json(name: str, obj: Any) -> None:
+        files[name] = json.dumps(obj, indent=1, default=str).encode()
+
+    put_json("metrics/snapshot_start.json", start_snap)
+    put_json("metrics/snapshot_end.json", end_snap)
+    files["metrics/prometheus.txt"] = (await agent._prom_text()).encode()
+    put_json("slo.json", await agent._slo(None))
+    put_json("traces.json", tracer.traces(200))
+    put_json("flight.json", await agent._flight(None))
+    put_json("raft/telemetry.json", raftstats.telemetry(
+        getattr(agent.server, "raft", None), local=agent.local))
+    files["tasks.txt"] = debug.task_dump().encode()
+    put_json("config.json", redacted_config(agent.config))
+    put_json("manifest.json", {
+        "created": time.time(),
+        "seconds": seconds,
+        "node": agent.config.node_name,
+        "version": VERSION,
+        "sections": list(SECTIONS),
+        "files": sorted(files) + ["manifest.json"],
+    })
+
+    buf = io.BytesIO()
+    now = int(time.time())
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for name in sorted(files):
+            data = files[name]
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = now
+            tar.addfile(info, io.BytesIO(data))
+    return buf.getvalue()

@@ -70,10 +70,10 @@ async def profile(request):
         content_type="text/plain")
 
 
-async def goroutine(request):
-    """All thread stacks + all asyncio task stacks."""
-    from aiohttp import web
-
+def task_dump() -> str:
+    """All thread stacks + all asyncio task stacks, as text.  Shared by
+    the ``/debug/pprof/goroutine`` route and the debug bundle's
+    ``tasks.txt`` section (agent/bundle.py)."""
     out = io.StringIO()
     names = {t.ident: t.name for t in threading.enumerate()}
     frames = sys._current_frames()
@@ -89,7 +89,14 @@ async def goroutine(request):
         buf = io.StringIO()
         t.print_stack(limit=12, file=buf)
         out.write(buf.getvalue())
-    return web.Response(text=out.getvalue(), content_type="text/plain")
+    return out.getvalue()
+
+
+async def goroutine(request):
+    """All thread stacks + all asyncio task stacks."""
+    from aiohttp import web
+
+    return web.Response(text=task_dump(), content_type="text/plain")
 
 
 _heap_windows = 0      # overlapping /heap captures in flight

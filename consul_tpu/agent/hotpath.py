@@ -83,6 +83,9 @@ async def kv_get(srv, key: str, *, stale: bool = False,
         idx = raft.lease_read_index()
         if idx is not None:
             metrics.incr_counter(("consul", "read", "lease"))
+            if raft.obs is not None:
+                raft.obs.lease_observe(raft.lease_remaining() * 1000.0,
+                                       raft.current_term)
             if raft.last_applied < idx:
                 await raft.wait_applied(idx)
         else:

@@ -17,8 +17,12 @@ from __future__ import annotations
 import asyncio
 import math
 import random
+import time
 from typing import Dict, Optional
 
+# Module-attr access (not ``from ... import aestats``) so tests can
+# swap the singleton under us.
+from consul_tpu.obs import raftstats
 from consul_tpu.structs.structs import (
     DeregisterRequest, HealthCheck, NodeService, RegisterRequest,
     SERF_CHECK_ID)
@@ -151,8 +155,25 @@ class LocalState:
             pass
 
     async def sync_once(self) -> None:
-        await self.set_sync_state()
+        t0 = time.monotonic()
+        try:
+            await self.set_sync_state()
+        except Exception:
+            raftstats.aestats.failure("diff")
+            raise
         await self.sync_changes()
+        raftstats.aestats.sync_done((time.monotonic() - t0) * 1000.0)
+
+    def pending_ops(self) -> int:
+        """Catalog operations the next sync pass would issue: queued
+        deregisters plus entries marked out of sync (the scrape-time
+        ``consul_antientropy_pending_ops`` gauge)."""
+        return (len(self._deregister_services)
+                + len(self._deregister_checks)
+                + sum(1 for sid, ok in self._service_sync.items()
+                      if not ok and sid in self.services)
+                + sum(1 for cid, ok in self._check_sync.items()
+                      if not ok and cid in self.checks))
 
     # -- diff against the catalog (setSyncState, local.go:342-430) ----------
 
@@ -199,27 +220,43 @@ class LocalState:
         addr = self.agent.advertise_addr
 
         for sid in list(self._deregister_services):
-            await self.agent.catalog_deregister(DeregisterRequest(
-                node=node, service_id=sid,
-                token=self.service_tokens.get(sid, "")))
+            try:
+                await self.agent.catalog_deregister(DeregisterRequest(
+                    node=node, service_id=sid,
+                    token=self.service_tokens.get(sid, "")))
+            except Exception:
+                raftstats.aestats.failure("service_deregister")
+                raise
             self._deregister_services.discard(sid)
         for cid in list(self._deregister_checks):
-            await self.agent.catalog_deregister(DeregisterRequest(
-                node=node, check_id=cid,
-                token=self.check_tokens.get(cid, "")))
+            try:
+                await self.agent.catalog_deregister(DeregisterRequest(
+                    node=node, check_id=cid,
+                    token=self.check_tokens.get(cid, "")))
+            except Exception:
+                raftstats.aestats.failure("check_deregister")
+                raise
             self._deregister_checks.discard(cid)
 
         for sid, in_sync in list(self._service_sync.items()):
             if in_sync or sid not in self.services:
                 continue
-            await self.agent.catalog_register(RegisterRequest(
-                node=node, address=addr, service=self.services[sid],
-                token=self.service_tokens.get(sid, "")))
+            try:
+                await self.agent.catalog_register(RegisterRequest(
+                    node=node, address=addr, service=self.services[sid],
+                    token=self.service_tokens.get(sid, "")))
+            except Exception:
+                raftstats.aestats.failure("service_register")
+                raise
             self._service_sync[sid] = True
         for cid, in_sync in list(self._check_sync.items()):
             if in_sync or cid not in self.checks:
                 continue
-            await self.agent.catalog_register(RegisterRequest(
-                node=node, address=addr, check=self.checks[cid],
-                token=self.check_tokens.get(cid, "")))
+            try:
+                await self.agent.catalog_register(RegisterRequest(
+                    node=node, address=addr, check=self.checks[cid],
+                    token=self.check_tokens.get(cid, "")))
+            except Exception:
+                raftstats.aestats.failure("check_register")
+                raise
             self._check_sync[cid] = True

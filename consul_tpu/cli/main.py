@@ -314,6 +314,52 @@ def cmd_configtest(args) -> int:
     return 0
 
 
+# -- debug (the `consul debug` one-shot capture) -----------------------------
+
+
+def cmd_debug(args) -> int:
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    url = (f"http://{args.http_addr}/v1/agent/debug/bundle"
+           f"?seconds={args.seconds}")
+    req = urllib.request.Request(url)
+    if getattr(args, "token", ""):
+        req.add_header("X-Consul-Token", args.token)
+    try:
+        with urllib.request.urlopen(req,
+                                    timeout=args.seconds + 30.0) as resp:
+            data = resp.read()
+    except urllib.error.HTTPError as e:
+        detail = ("capture requires enable_debug on the agent"
+                  if e.code == 404 else e.reason)
+        print(f"Error capturing bundle: {e.code} {detail}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        print(f"Error capturing bundle: {e}", file=sys.stderr)
+        return 1
+    out = args.output or _time.strftime("consul-debug-%Y%m%d-%H%M%S.tar.gz")
+    with open(out, "wb") as f:
+        f.write(data)
+    # Surface the manifest so the operator sees what was captured.
+    import io
+    import json as _json
+    import tarfile
+    try:
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+            m = tar.extractfile("manifest.json")
+            manifest = _json.load(m) if m is not None else {}
+    except (tarfile.TarError, _json.JSONDecodeError):
+        manifest = {}
+    print(f"Wrote {out} ({len(data)} bytes)")
+    if manifest:
+        print(f"  node:     {manifest.get('node', '?')}")
+        print(f"  window:   {manifest.get('seconds', '?')}s")
+        print(f"  sections: {', '.join(manifest.get('sections', []))}")
+    return 0
+
+
 # -- event -------------------------------------------------------------------
 
 
@@ -665,6 +711,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-config-file", action="append", dest="config_file")
     p.add_argument("-config-dir", action="append", dest="config_dir")
     p.set_defaults(fn=cmd_configtest)
+
+    p = sub.add_parser("debug", help="Capture a debug bundle from an agent")
+    _add_http_flag(p)
+    p.add_argument("-seconds", type=float, default=5.0,
+                   help="metrics sample window (clamped to 0..30 agent-side)")
+    p.add_argument("-output", default="",
+                   help="output path (default consul-debug-<ts>.tar.gz)")
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("event", help="Fire a user event")
     _add_http_flag(p)

@@ -28,6 +28,7 @@ from consul_tpu.consensus.log import (
     LOG_BARRIER, LOG_COMMAND, LOG_CONFIGURATION, LOG_NOOP, LogEntry,
     MemoryLogStore)
 from consul_tpu.consensus.snapshot import MemorySnapshotStore
+from consul_tpu.obs import raftstats
 from consul_tpu.obs import trace as obs_trace
 
 import msgpack
@@ -226,6 +227,11 @@ class RaftNode:
         # fresh leader's commit_index may lag entries its predecessor
         # acked, so the lease may not serve reads (Raft §6.4).
         self._lease_guard_index = 0
+        # Consensus observatory (obs/raftstats.py).  None when compiled
+        # out via CONSUL_TPU_RAFT_OBS=0 — every hot-path hook below is
+        # then a single is-None test (the bench A/B leg).
+        self.obs: Optional[raftstats.RaftStats] = (
+            raftstats.RaftStats(node_id) if raftstats.enabled() else None)
 
         latest = self.snaps.latest()
         if latest is not None:
@@ -498,6 +504,8 @@ class RaftNode:
             return
         self.log.append(batch, sync=False)
         self._dirty_evt.set()
+        if self.obs is not None:
+            self.obs.note_append(batch[-1].index)
         # (LOG_CONFIGURATION entries were applied eagerly in _submit.)
         # Replication is kicked immediately (pipelined past our own
         # fsync); _maybe_advance_commit counts only durable_index for
@@ -531,6 +539,8 @@ class RaftNode:
         self.voted_for = self.id
         self._persist_term()
         term = self.current_term
+        if self.obs is not None:
+            self.obs.note_election(term)
         votes = 1  # self
         if votes >= self._quorum():
             self._become_leader()
@@ -578,6 +588,8 @@ class RaftNode:
         entry = LogEntry(index=last + 1, term=self.current_term, type=LOG_NOOP)
         self._lease_guard_index = entry.index
         self._lease_ack = {}
+        if self.obs is not None:
+            self.obs.note_leader(self.current_term)
         self.log.append([entry])
         self._kick_replication()
         self._maybe_advance_commit()
@@ -589,6 +601,8 @@ class RaftNode:
             t.cancel()
         self._repl_tasks = []
         self._lease_ack = {}  # deposed: the lease is gone with the role
+        if self.obs is not None:
+            self.obs.note_deposed(self.current_term, self.leader_id)
         self._fail_pending(NotLeaderError(self.leader_id))
         for cb in self._leader_obs:
             cb(False)
@@ -601,6 +615,8 @@ class RaftNode:
             self._persist_term()
         self.role = FOLLOWER
         if leader is not None:
+            if self.obs is not None and leader != self.leader_id:
+                self.obs.note_new_leader(self.current_term, leader)
             self.leader_id = leader
         if was_leader:
             self._step_down_evt.set()
@@ -631,6 +647,8 @@ class RaftNode:
                 try:
                     await self._replicate_once(peer)
                 except (TransportError, asyncio.TimeoutError):
+                    if self.obs is not None:
+                        self.obs.peer_fail(peer)
                     await asyncio.sleep(cfg.heartbeat_interval)
                     continue
                 evt = self._peer_evts.get(peer)
@@ -683,6 +701,10 @@ class RaftNode:
             prev = self._lease_ack.get(peer, 0.0)
             if sent > prev:
                 self._lease_ack[peer] = sent
+            if self.obs is not None:
+                self.obs.peer_ok(peer, sent)
+                self.obs.lease_observe(
+                    self.lease_remaining() * 1000.0, term)
         if resp.success:
             if entries:
                 self.match_index[peer] = entries[-1].index
@@ -699,6 +721,7 @@ class RaftNode:
         meta, state = latest
         req = SnapReq(self.current_term, self.id, meta.index, meta.term,
                       meta.peers, state)
+        t0 = time.monotonic()
         resp = await asyncio.wait_for(
             self.transport.call(self.id, peer, "install_snapshot", req),
             self.config.rpc_timeout * 4)
@@ -708,6 +731,11 @@ class RaftNode:
         if resp.success:
             self.match_index[peer] = meta.index
             self.next_index[peer] = meta.index + 1
+            if self.obs is not None:
+                self.obs.snapshot_install.observe(
+                    (time.monotonic() - t0) * 1000.0)
+                self.obs.event("snapshot-sent", peer=peer,
+                               index=meta.index)
 
     def _term_at(self, index: int) -> int:
         if index == 0:
@@ -731,6 +759,8 @@ class RaftNode:
         n = matches[self._quorum() - 1]
         if n > self.commit_index and self._term_at(n) == self.current_term:
             self.commit_index = n
+            if self.obs is not None:
+                self.obs.note_commit(n)
             self._apply_committed()
 
     # -- apply -------------------------------------------------------------
@@ -764,6 +794,8 @@ class RaftNode:
                     fut.set_exception(result)
                 else:
                     fut.set_result(result)
+        if self.obs is not None:
+            self.obs.note_applied(self.last_applied)
         self._maybe_snapshot()
 
     def _apply_configuration(self, e: LogEntry) -> None:
@@ -803,6 +835,9 @@ class RaftNode:
             cut = self.last_applied - self.config.trailing_logs
             if cut > 0 and self.log.first_index() and cut >= self.log.first_index():
                 self.log.delete_to(cut)
+            if self.obs is not None:
+                self.obs.event("snapshot-taken", index=self._snap_index,
+                               term=term)
         finally:
             self._snapshotting = False
 
@@ -841,6 +876,11 @@ class RaftNode:
             return AppendResp(self.current_term, False, self.last_log_index())
         if req.term > self.current_term or self.role != FOLLOWER:
             self._become_follower(req.term, req.leader)
+        if self.obs is not None and req.leader != self.leader_id:
+            # First contact from a leader we voted for arrives with role
+            # already FOLLOWER at its term — it bypasses
+            # _become_follower, so the timeline event lands here.
+            self.obs.note_new_leader(self.current_term, req.leader)
         self.leader_id = req.leader
         self.last_leader_contact = time.monotonic()
         self._heartbeat_evt.set()
@@ -886,6 +926,8 @@ class RaftNode:
 
         if req.leader_commit > self.commit_index:
             self.commit_index = min(req.leader_commit, self.last_log_index())
+            if self.obs is not None:
+                self.obs.note_commit(self.commit_index)
             self._apply_committed()
         return AppendResp(self.current_term, True, match)
 
@@ -897,6 +939,7 @@ class RaftNode:
         self._heartbeat_evt.set()
         if req.last_index <= self._snap_index:
             return SnapResp(self.current_term, True)
+        t0 = time.monotonic()
         self.fsm.restore(req.data)
         self.snaps.create(req.last_index, req.last_term, req.peers, req.data)
         if self.log.first_index():
@@ -905,12 +948,17 @@ class RaftNode:
         self.peers = list(req.peers)
         self.commit_index = req.last_index
         self.last_applied = req.last_index
+        if self.obs is not None:
+            self.obs.snapshot_install.observe(
+                (time.monotonic() - t0) * 1000.0)
+            self.obs.event("snapshot-installed", leader=req.leader,
+                           index=req.last_index)
         return SnapResp(self.current_term, True)
 
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> Dict[str, str]:
-        return {
+        out = {
             "state": self.role,
             "term": str(self.current_term),
             "last_log_index": str(self.last_log_index()),
@@ -922,3 +970,6 @@ class RaftNode:
             "lease": "valid" if self.lease_valid() else "invalid",
             "lease_remaining_ms": str(int(self.lease_remaining() * 1000)),
         }
+        if self.obs is not None:
+            out.update(self.obs.stats_rows())
+        return out

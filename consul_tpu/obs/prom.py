@@ -62,7 +62,8 @@ def _family(lines: List[str], name: str, kind: str, help_text: str) -> None:
 def render_prometheus(snapshot: List[Dict[str, Any]],
                       histograms: Optional[List[Dict[str, Any]]] = None,
                       summaries: Optional[List[Dict[str, Any]]] = None,
-                      labeled_counters: Optional[List[Dict[str, Any]]] = None
+                      labeled_counters: Optional[List[Dict[str, Any]]] = None,
+                      labeled_gauges: Optional[List[Dict[str, Any]]] = None
                       ) -> str:
     """Telemetry snapshot (list of interval dicts, oldest first) ->
     Prometheus text format, one block per family with HELP/TYPE lines.
@@ -78,7 +79,11 @@ def render_prometheus(snapshot: List[Dict[str, Any]],
     Labelset variants share one HELP/TYPE block per name.
 
     ``labeled_counters``: optional labeled counter families:
-    ``name``, ``help``, ``rows`` as ``(labels_dict, value)`` pairs."""
+    ``name``, ``help``, ``rows`` as ``(labels_dict, value)`` pairs.
+
+    ``labeled_gauges``: same rows shape as ``labeled_counters`` but
+    rendered with ``# TYPE ... gauge`` (per-peer replication lag and
+    contact-age series from obs.raftstats)."""
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
     samples: Dict[str, Dict[str, float]] = {}
@@ -152,16 +157,20 @@ def render_prometheus(snapshot: List[Dict[str, Any]],
         lines.append(f'{n}_bucket{{{pre}le="+Inf"}} {_fmt(fam["count"])}')
         lines.append(f"{n}_sum{tail} {_fmt(fam['sum'])}")
         lines.append(f"{n}_count{tail} {_fmt(fam['count'])}")
-    for fam in labeled_counters or []:
-        n = sanitize(fam["name"])
-        if n in emitted:
-            continue
-        emitted.add(n)
-        _family(lines, n, "counter", fam.get("help", ""))
-        for labels, value in fam.get("rows", []):
-            body = ",".join(f'{sanitize(str(k))}="{escape_label_value(v)}"'
-                            for k, v in sorted(labels.items()))
-            lines.append(f"{n}{{{body}}} {_fmt(value)}")
+    for kind, fams in (("counter", labeled_counters),
+                       ("gauge", labeled_gauges)):
+        for fam in fams or []:
+            n = sanitize(fam["name"])
+            if n in emitted:
+                continue
+            emitted.add(n)
+            _family(lines, n, kind, fam.get("help", ""))
+            for labels, value in fam.get("rows", []):
+                body = ",".join(
+                    f'{sanitize(str(k))}="{escape_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+                tail = f"{{{body}}}" if body else ""
+                lines.append(f"{n}{tail} {_fmt(value)}")
     sum_seen: set = set()
     for fam in summaries or []:
         n = sanitize(fam["name"])

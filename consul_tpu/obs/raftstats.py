@@ -1,0 +1,406 @@
+"""Consensus-plane observatory: raft/replication + anti-entropy stats.
+
+The gossip kernel has a flight recorder and detection-latency banks
+(obs/flight.py, obs/hist.py) and the HTTP edge has reqstats — this
+module gives the consensus plane the same treatment.  A ``RaftStats``
+instance rides on each ``RaftNode`` (consensus/raft.py) and collects:
+
+* latency histograms — append→quorum-ack, commit→FSM-apply, snapshot
+  install, and the leader-lease renewal margin (how much lease window
+  was left each time it renewed or served a read);
+* per-peer replication state — last-contact send stamp plus
+  failed/recovered RPC counters (match-index lag is computed against
+  the live node at read time, not stored);
+* a bounded leadership/election/lease event timeline ring — the
+  consensus-plane black box an incident bundle drains.
+
+``AntiEntropyStats`` (module singleton ``aestats``) does the same for
+the agent's catalog sync loop (agent/local.py): sync duration
+histogram and per-kind failure counters; the pending-ops gauge is
+computed from live ``LocalState`` at scrape time.
+
+Conventions, matching the rest of obs/:
+
+* histogram banks are host-side cumulative counts in plain Python
+  ints — the PR 5 HistRecorder convention's int64 banks, which never
+  wrap (the device-side wrap dance doesn't apply: there is no 32-bit
+  accumulator anywhere in this path);
+* everything here runs on the agent's single event loop, so there are
+  no locks (same discipline as obs/reqstats.py);
+* no jax imports — the agent process renders these without a kernel.
+
+The whole observatory can be compiled out for A/B overhead runs:
+``CONSUL_TPU_RAFT_OBS=0`` in the environment makes ``enabled()``
+false, RaftNode then carries ``obs = None`` and every hot-path hook is
+one attribute-is-None test (BENCH_NOTES.md §10 measures the delta).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Tuple
+
+# Millisecond bucket ladder shared by every consensus-plane latency
+# histogram.  Cumulative counts over these edges render directly as a
+# Prometheus histogram family (obs/prom.py ``histograms=``).
+MS_EDGES: Tuple[float, ...] = (0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+                               50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0)
+TIMELINE_CAP = 256    # leadership/election/lease events retained
+_PENDING_CAP = 1024   # in-flight append/commit stamps (leak guard)
+
+
+def enabled() -> bool:
+    """Observatory switch: CONSUL_TPU_RAFT_OBS=0 compiles it out (the
+    A/B leg of the bench overhead measurement)."""
+    return os.environ.get("CONSUL_TPU_RAFT_OBS", "1").lower() not in (
+        "0", "false", "no")
+
+
+def _le(edge: float) -> str:
+    return str(int(edge)) if edge == int(edge) else repr(edge)
+
+
+class LatencyHist:
+    """Fixed-edge cumulative millisecond histogram.
+
+    ``observe(ms, n=1)`` is the only write; banks are plain ints so a
+    bucket legitimately holding more than 2**32 observations stays
+    exact (the wrap-aware HistRecorder contract, minus the device
+    drain — tests/test_raft_obs.py holds this to the same bar).
+    """
+
+    __slots__ = ("name", "help", "_counts", "_sum", "_count")
+
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name = name
+        self.help = help_text
+        self._counts = [0] * len(MS_EDGES)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, ms: float, n: int = 1) -> None:
+        self._count += n
+        self._sum += ms * n
+        i = bisect_left(MS_EDGES, ms)
+        if i < len(self._counts):
+            self._counts[i] += n
+        # else: overflow — counted only by the +Inf bucket (count)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def family(self) -> Dict[str, Any]:
+        """obs/prom.py ``histograms=`` family shape."""
+        cum = 0
+        buckets = []
+        for edge, c in zip(MS_EDGES, self._counts):
+            cum += c
+            buckets.append((_le(edge), cum))
+        return {"name": self.name, "help": self.help, "buckets": buckets,
+                "sum": round(self._sum, 3), "count": self._count}
+
+    def quantile_ms(self, q: float) -> Optional[float]:
+        """Upper bucket edge covering quantile ``q`` (None until data;
+        observations past the last edge report that edge — an
+        operator-facing bound, not an exact percentile)."""
+        if self._count == 0:
+            return None
+        need = q * self._count
+        cum = 0
+        for edge, c in zip(MS_EDGES, self._counts):
+            cum += c
+            if cum >= need:
+                return edge
+        return MS_EDGES[-1]
+
+    def wire(self) -> Dict[str, Any]:
+        return {"count": self._count, "sum_ms": round(self._sum, 3),
+                "p50_ms": self.quantile_ms(0.50),
+                "p99_ms": self.quantile_ms(0.99)}
+
+
+class RaftStats:
+    """Per-RaftNode consensus observatory (module docstring)."""
+
+    def __init__(self, node_id: str = "") -> None:
+        self.node_id = node_id
+        self.append_quorum = LatencyHist(
+            "consul_raft_append_quorum_ms",
+            "Leader append flush to quorum commit, milliseconds.")
+        self.commit_apply = LatencyHist(
+            "consul_raft_commit_apply_ms",
+            "Entry commit to local FSM apply, milliseconds.")
+        self.snapshot_install = LatencyHist(
+            "consul_raft_snapshot_install_ms",
+            "Snapshot send (leader) / restore (follower), milliseconds.")
+        self.lease_margin = LatencyHist(
+            "consul_raft_lease_margin_ms",
+            "Leader-lease window remaining at renewal/read, milliseconds.")
+        self.elections_started = 0
+        self.leadership_gained = 0
+        self.leadership_lost = 0
+        self.events_total = 0
+        self._append_pending: Dict[int, float] = {}      # index -> t_flush
+        self._commit_pending: List[Tuple[int, float]] = []  # (idx, t_commit)
+        self._peers: Dict[str, Dict[str, Any]] = {}
+        self._events: List[Dict[str, Any]] = []
+        self._ev_next = 0
+        self._lease_was_valid = False
+
+    # -- raft hot-path hooks (every call is O(small)) -----------------------
+
+    def note_append(self, index: int) -> None:
+        """A flushed leader batch ending at ``index`` hit the log."""
+        if len(self._append_pending) < _PENDING_CAP:
+            self._append_pending[index] = time.monotonic()
+
+    def note_commit(self, commit_index: int) -> None:
+        """commit_index advanced (leader quorum or follower header)."""
+        now = time.monotonic()
+        if self._append_pending:
+            for idx in [i for i in self._append_pending if i <= commit_index]:
+                self.append_quorum.observe(
+                    (now - self._append_pending.pop(idx)) * 1000.0)
+        if len(self._commit_pending) < _PENDING_CAP:
+            self._commit_pending.append((commit_index, now))
+
+    def note_applied(self, applied_index: int) -> None:
+        """The FSM caught up through ``applied_index``."""
+        if not self._commit_pending:
+            return
+        now = time.monotonic()
+        keep = []
+        for idx, t0 in self._commit_pending:
+            if idx <= applied_index:
+                self.commit_apply.observe((now - t0) * 1000.0)
+            else:
+                keep.append((idx, t0))
+        self._commit_pending = keep
+
+    def _peer(self, peer: str) -> Dict[str, Any]:
+        st = self._peers.get(peer)
+        if st is None:
+            st = self._peers[peer] = {"last_contact": 0.0, "failed": 0,
+                                      "recovered": 0, "in_retry": False}
+        return st
+
+    def peer_ok(self, peer: str, sent: float) -> None:
+        """Same-term AppendEntries response from ``peer`` for the round
+        sent at monotonic ``sent``."""
+        st = self._peer(peer)
+        if sent > st["last_contact"]:
+            st["last_contact"] = sent
+        if st["in_retry"]:
+            st["in_retry"] = False
+            st["recovered"] += 1
+
+    def peer_fail(self, peer: str) -> None:
+        """Replication RPC to ``peer`` failed (transport or timeout)."""
+        st = self._peer(peer)
+        st["failed"] += 1
+        st["in_retry"] = True
+
+    def lease_observe(self, remaining_ms: float, term: int) -> None:
+        """Sample the lease window at a renewal or lease-path read;
+        <= 0 means the lease does not currently hold.  Validity
+        transitions land on the timeline."""
+        valid = remaining_ms > 0.0
+        if valid:
+            self.lease_margin.observe(remaining_ms)
+        if valid != self._lease_was_valid:
+            self._lease_was_valid = valid
+            self.event("lease-acquired" if valid else "lease-lost",
+                       term=term)
+
+    # -- leadership/election/lease timeline ---------------------------------
+
+    def event(self, kind: str, **detail: Any) -> None:
+        ev: Dict[str, Any] = {"t": time.time(), "kind": kind}
+        ev.update(detail)
+        self.events_total += 1
+        if len(self._events) < TIMELINE_CAP:
+            self._events.append(ev)
+        else:
+            self._events[self._ev_next] = ev
+            self._ev_next = (self._ev_next + 1) % TIMELINE_CAP
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        """Retained events, oldest first."""
+        if len(self._events) < TIMELINE_CAP:
+            return list(self._events)
+        return self._events[self._ev_next:] + self._events[:self._ev_next]
+
+    def note_election(self, term: int) -> None:
+        self.elections_started += 1
+        self.event("election-start", term=term)
+
+    def note_leader(self, term: int) -> None:
+        self.leadership_gained += 1
+        self.event("leader-elected", term=term)
+
+    def note_deposed(self, term: int, leader: Optional[str]) -> None:
+        self.leadership_lost += 1
+        self.event("leader-deposed", term=term, leader=leader or "")
+        self.lease_observe(0.0, term)  # the lease is gone with the role
+
+    def note_new_leader(self, term: int, leader: str) -> None:
+        self.event("new-leader", term=term, leader=leader)
+
+    # -- read side ----------------------------------------------------------
+
+    def hists(self) -> List[LatencyHist]:
+        return [self.append_quorum, self.commit_apply,
+                self.snapshot_install, self.lease_margin]
+
+    def peer_rows(self, node: Any) -> List[Dict[str, Any]]:
+        """Per-peer replication rows; lag/age computed against the live
+        node so the scrape never reads stale gauges."""
+        now = time.monotonic()
+        last = node.last_log_index()
+        rows = []
+        for peer in sorted(self._peers):
+            st = self._peers[peer]
+            lc = st["last_contact"]
+            rows.append({
+                "peer": peer,
+                "match_lag_entries": max(
+                    0, last - node.match_index.get(peer, 0)),
+                "last_contact_age_ms": (round((now - lc) * 1000.0, 3)
+                                        if lc else None),
+                "rpc_failed": st["failed"],
+                "rpc_recovered": st["recovered"],
+            })
+        return rows
+
+    def wire(self, node: Any) -> Dict[str, Any]:
+        return {
+            "histograms": {h.name: h.wire() for h in self.hists()},
+            "counters": {
+                "elections_started": self.elections_started,
+                "leadership_gained": self.leadership_gained,
+                "leadership_lost": self.leadership_lost,
+                "timeline_events_total": self.events_total,
+            },
+            "peers": self.peer_rows(node),
+            "timeline": self.timeline(),
+        }
+
+    def stats_rows(self) -> Dict[str, str]:
+        """String-valued rows for raft.stats() — the ``consul info`` /
+        ``/v1/agent/self`` convention."""
+        return {
+            "append_quorum_p50_ms": str(self.append_quorum.quantile_ms(0.5)),
+            "commit_apply_p50_ms": str(self.commit_apply.quantile_ms(0.5)),
+            "lease_margin_p50_ms": str(self.lease_margin.quantile_ms(0.5)),
+            "elections_started": str(self.elections_started),
+            "leadership_gained": str(self.leadership_gained),
+            "leadership_lost": str(self.leadership_lost),
+            "timeline_events": str(self.events_total),
+        }
+
+
+def prom_families(node: Any) -> Tuple[List[Dict[str, Any]],
+                                      List[Dict[str, Any]],
+                                      List[Dict[str, Any]]]:
+    """(histograms, labeled_gauges, labeled_counters) for the scrape,
+    from a live RaftNode carrying a RaftStats at ``node.obs``.  The
+    histogram families are always emitted (zero-count ladders included)
+    so dashboards see the full schema before traffic."""
+    obs = getattr(node, "obs", None)
+    if obs is None:
+        return [], [], []
+    hists = [h.family() for h in obs.hists()]
+    lag_rows, age_rows, fail_rows, rec_rows = [], [], [], []
+    for row in obs.peer_rows(node):
+        labels = {"peer": row["peer"]}
+        lag_rows.append((labels, float(row["match_lag_entries"])))
+        if row["last_contact_age_ms"] is not None:
+            age_rows.append((labels, row["last_contact_age_ms"]))
+        fail_rows.append((labels, float(row["rpc_failed"])))
+        rec_rows.append((labels, float(row["rpc_recovered"])))
+    gauges = []
+    if lag_rows:
+        gauges.append({"name": "consul_raft_peer_match_lag_entries",
+                       "help": "Entries the peer's match index trails the "
+                               "leader's last log index by.",
+                       "rows": lag_rows})
+    if age_rows:
+        gauges.append({"name": "consul_raft_peer_last_contact_age_ms",
+                       "help": "Milliseconds since the peer last "
+                               "acknowledged a replication round.",
+                       "rows": age_rows})
+    counters = []
+    if fail_rows:
+        counters.append({"name": "consul_raft_peer_rpc_failed_total",
+                         "help": "Failed replication RPCs per peer.",
+                         "rows": fail_rows})
+    if rec_rows:
+        counters.append({"name": "consul_raft_peer_rpc_recovered_total",
+                         "help": "Replication rounds that succeeded after "
+                                 "one or more failures, per peer.",
+                         "rows": rec_rows})
+    return hists, gauges, counters
+
+
+def telemetry(node: Any, local: Any = None) -> Dict[str, Any]:
+    """JSON payload of /v1/operator/raft/telemetry: raft stats + the
+    observatory + anti-entropy state.  ``node`` may be None (client
+    mode) and the observatory may be compiled out — the route then
+    reports what it can."""
+    out: Dict[str, Any] = {"enabled": enabled()}
+    if node is not None:
+        out["raft"] = node.stats()
+        obs = getattr(node, "obs", None)
+        if obs is not None:
+            out.update(obs.wire(node))
+    ae: Dict[str, Any] = aestats.wire()
+    if local is not None:
+        ae["pending_ops"] = local.pending_ops()
+    out["antientropy"] = ae
+    return out
+
+
+class AntiEntropyStats:
+    """Catalog anti-entropy observatory (agent/local.py hooks)."""
+
+    _KINDS = ("diff", "service_register", "service_deregister",
+              "check_register", "check_deregister")
+
+    def __init__(self) -> None:
+        self.sync = LatencyHist(
+            "consul_antientropy_sync_ms",
+            "Full anti-entropy pass (diff + push) duration, milliseconds.")
+        self.syncs_total = 0
+        self.failures: Dict[str, int] = {}
+
+    def sync_done(self, ms: float) -> None:
+        self.syncs_total += 1
+        self.sync.observe(ms)
+
+    def failure(self, kind: str) -> None:
+        self.failures[kind] = self.failures.get(kind, 0) + 1
+
+    def families(self) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+        """(histograms, labeled_counters) for the scrape; every failure
+        kind is emitted (zeros included) so the family schema is stable."""
+        rows = [({"kind": k}, float(self.failures.get(k, 0)))
+                for k in self._KINDS]
+        return [self.sync.family()], [{
+            "name": "consul_antientropy_failures_total",
+            "help": "Anti-entropy sync failures by operation kind.",
+            "rows": rows,
+        }]
+
+    def wire(self) -> Dict[str, Any]:
+        return {"sync": self.sync.wire(), "syncs_total": self.syncs_total,
+                "failures": {k: self.failures.get(k, 0)
+                             for k in self._KINDS}}
+
+
+# Process-global anti-entropy stats, mirroring obs.reqstats.reqstats
+# (one agent per process; call sites go through the module attribute so
+# tests can swap it).
+aestats = AntiEntropyStats()

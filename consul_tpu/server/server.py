@@ -309,6 +309,10 @@ class Server:
         idx = self.raft.lease_read_index()
         if idx is not None:
             metrics.incr_counter(("consul", "read", "lease"))
+            if self.raft.obs is not None:
+                self.raft.obs.lease_observe(
+                    self.raft.lease_remaining() * 1000.0,
+                    self.raft.current_term)
             await self.raft.wait_applied(idx, timeout=ENQUEUE_LIMIT)
             return idx
         metrics.incr_counter(("consul", "read", "barrier"))
@@ -350,6 +354,10 @@ class Server:
         if idx is not None:
             from consul_tpu.utils.telemetry import metrics
             metrics.incr_counter(("consul", "read", "lease"))
+            if self.raft.obs is not None:
+                self.raft.obs.lease_observe(
+                    self.raft.lease_remaining() * 1000.0,
+                    self.raft.current_term)
             return idx
         return await self.raft.barrier(timeout=ENQUEUE_LIMIT) - 1
 

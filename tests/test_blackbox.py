@@ -433,6 +433,94 @@ class TestObservabilityBlackBox:
             for s in servers:
                 s.stop()
 
+    def test_raft_telemetry_and_debug_bundle(self):
+        """Consensus-plane observatory acceptance: a lease-holding
+        leader's Prometheus scrape carries the consul_raft_* histogram
+        ladders and per-peer replication gauges (check_prom-clean), and
+        a debug bundle pulled from a live 3-node cluster has the full
+        manifest (metrics / slo / traces / flight / raft / tasks)."""
+        import io
+        import json as _json
+        import tarfile
+        import urllib.request
+
+        from tools.check_prom import _iter_series, _require_ok, check_text
+
+        def raw(s, path):
+            with urllib.request.urlopen(s._url(path), timeout=30) as r:
+                return r.read()
+
+        dbg = {"enable_debug": True}
+        s1 = TestServer("bb-d1", bootstrap=False, bootstrap_expect=3,
+                        config_extra=dbg).start()
+        servers = [s1]
+        try:
+            s1.wait_for_api()
+            for name in ("bb-d2", "bb-d3"):
+                s = TestServer(name, bootstrap=False, bootstrap_expect=3,
+                               retry_join=[s1.lan_addr],
+                               config_extra=dbg).start()
+                servers.append(s)
+                s.wait_for_api()
+            for s in servers:
+                s.wait_for_leader(60)
+            leader_name = servers[0].http_get("/v1/status/leader")
+            leader = next(s for s in servers if s.name == leader_name)
+            followers = [s.name for s in servers if s is not leader]
+            # raft traffic + a lease-path consistent read on the leader
+            assert leader.http_put("/v1/kv/obs/bundle-probe", b"x") is True
+            leader.http_get("/v1/kv/obs/bundle-probe?consistent")
+
+            text = raw(leader,
+                       "/v1/agent/metrics?format=prometheus").decode()
+            errors = check_text(text)
+            assert errors == [], errors
+            series = list(_iter_series(text))
+            for want in [
+                    'consul_raft_append_quorum_ms_bucket{le="+Inf"}',
+                    'consul_raft_commit_apply_ms_bucket{le="+Inf"}',
+                    'consul_raft_lease_margin_ms_bucket{le="+Inf"}',
+                    'consul_consistent_reads_total{path="lease"}',
+                    'consul_antientropy_sync_ms_bucket{le="+Inf"}'] + [
+                    f'consul_raft_peer_match_lag_entries{{peer="{p}"}}'
+                    for p in followers]:
+                assert _require_ok(want, series, errors), \
+                    f"scrape missing {want}"
+            # stats rows ride /v1/agent/self on every node
+            stats = leader.http_get("/v1/agent/self")["Stats"]["raft"]
+            assert "leadership_gained" in stats
+
+            # telemetry route (always-on) from a follower
+            t = next(s for s in servers if s is not leader).http_get(
+                "/v1/operator/raft/telemetry")
+            assert "raft" in t and "timeline" in t and "antientropy" in t
+
+            # one-shot bundle from the leader
+            data = raw(leader, "/v1/agent/debug/bundle?seconds=1")
+            with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+                names = set(tar.getnames())
+                manifest = _json.load(tar.extractfile("manifest.json"))
+                assert {"metrics", "slo", "traces", "flight", "raft",
+                        "tasks"} <= set(manifest["sections"])
+                assert manifest["node"] == leader.name
+                for want in ("metrics/prometheus.txt", "raft/telemetry.json",
+                             "tasks.txt", "config.json"):
+                    assert want in names, names
+                rt = _json.load(tar.extractfile("raft/telemetry.json"))
+                assert rt["raft"]["state"] == "Leader"
+                assert any(ev["kind"] == "leader-elected"
+                           for ev in rt["timeline"])
+                assert "asyncio tasks" in \
+                    tar.extractfile("tasks.txt").read().decode()
+        except Exception:
+            for s in servers:
+                print(f"--- {s.name} ---")
+                print(s.output()[-2000:])
+            raise
+        finally:
+            for s in servers:
+                s.stop()
+
     def test_sigusr1_dumps_metrics(self):
         """SIGUSR1 -> telemetry dump on stderr (agent.go:623-631 role),
         against a real forked process."""

@@ -1,0 +1,363 @@
+"""Consensus-plane observatory tests (obs/raftstats.py).
+
+Unit coverage for the latency histograms (bucket placement, cumulative
+rendering, no-wrap banks per the PR 5 HistRecorder convention), the
+bounded event timeline ring, and the anti-entropy stats — plus
+compressed-timer cluster tests holding the live instrumentation to the
+leader / follower / deposed-leader contracts and the Prometheus
+exposition to tools/check_prom.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from consul_tpu.agent.local import LocalState
+from consul_tpu.obs import raftstats
+from consul_tpu.obs.prom import render_prometheus
+from consul_tpu.obs.raftstats import (
+    MS_EDGES, TIMELINE_CAP, AntiEntropyStats, LatencyHist, RaftStats)
+from tests.test_raft import (
+    make_cluster, put, start_all, stop_all, wait_for_leader, wait_until)
+from tools.check_prom import _iter_series, _require_ok, check_text
+
+
+# -- LatencyHist ------------------------------------------------------------
+
+
+def test_hist_bucket_placement_and_family():
+    h = LatencyHist("consul_raft_test_ms", "test")
+    h.observe(0.1)      # below first edge -> first bucket
+    h.observe(3.0)      # -> le=2.5 is too small; lands in le=5
+    h.observe(10.0)     # exact edge is inclusive (le semantics)
+    h.observe(9999.0)   # beyond last edge -> +Inf only
+    fam = h.family()
+    assert fam["name"] == "consul_raft_test_ms"
+    assert fam["count"] == 4
+    assert fam["sum"] == pytest.approx(0.1 + 3.0 + 10.0 + 9999.0)
+    by_le = dict(fam["buckets"])
+    assert by_le["0.25"] == 1
+    assert by_le["2.5"] == 1          # cumulative: only the 0.1 obs
+    assert by_le["5"] == 2
+    assert by_le["10"] == 3
+    assert by_le[str(int(MS_EDGES[-1]))] == 3  # 9999 only in +Inf
+    # buckets are cumulative and monotonic
+    counts = [c for _, c in fam["buckets"]]
+    assert counts == sorted(counts)
+
+
+def test_hist_no_wrap_past_2_32():
+    """The PR 5 convention: host banks are unbounded ints — a bucket
+    holding more than 2**32 observations must stay exact, not wrap."""
+    h = LatencyHist("consul_raft_test_ms", "test")
+    big = 2 ** 32 + 5
+    h.observe(1.0, n=big)
+    h.observe(1.0)
+    assert h.count == big + 1
+    fam = h.family()
+    assert dict(fam["buckets"])["1"] == big + 1
+    assert fam["count"] == big + 1
+
+
+def test_hist_quantiles():
+    h = LatencyHist("consul_raft_test_ms", "test")
+    assert h.quantile_ms(0.5) is None
+    for _ in range(99):
+        h.observe(0.6)   # -> le=1 bucket
+    h.observe(2000.0)    # -> le=2500 bucket
+    assert h.quantile_ms(0.5) == 1.0
+    assert h.quantile_ms(0.99) == 1.0
+    assert h.wire()["p50_ms"] == 1.0
+
+
+# -- timeline ring ----------------------------------------------------------
+
+
+def test_timeline_ring_bounded_and_ordered():
+    rs = RaftStats("n1")
+    for i in range(TIMELINE_CAP + 40):
+        rs.event("election-start", term=i)
+    tl = rs.timeline()
+    assert len(tl) == TIMELINE_CAP
+    assert rs.events_total == TIMELINE_CAP + 40
+    terms = [ev["term"] for ev in tl]
+    # oldest retained first, newest last, contiguous
+    assert terms == list(range(40, TIMELINE_CAP + 40))
+
+
+def test_lease_observe_transitions():
+    rs = RaftStats("n1")
+    rs.lease_observe(12.0, term=3)    # invalid -> valid
+    rs.lease_observe(8.0, term=3)     # still valid: no new event
+    rs.lease_observe(0.0, term=3)     # valid -> invalid
+    kinds = [ev["kind"] for ev in rs.timeline()]
+    assert kinds == ["lease-acquired", "lease-lost"]
+    assert rs.lease_margin.count == 2  # only valid samples observed
+
+
+# -- pending-stamp pipeline -------------------------------------------------
+
+
+def test_append_commit_apply_pipeline():
+    rs = RaftStats("n1")
+    rs.note_append(5)
+    rs.note_append(7)
+    rs.note_commit(5)            # pops index 5 only
+    assert rs.append_quorum.count == 1
+    rs.note_commit(7)
+    assert rs.append_quorum.count == 2
+    rs.note_applied(6)           # drains the commit stamp for 5 only
+    assert rs.commit_apply.count == 1
+    rs.note_applied(7)
+    assert rs.commit_apply.count == 2
+
+
+def test_peer_fail_recover_counters():
+    rs = RaftStats("n1")
+    rs.peer_fail("s2")
+    rs.peer_fail("s2")
+    rs.peer_ok("s2", sent=1.0)
+    rs.peer_ok("s2", sent=2.0)
+
+    class FakeNode:
+        match_index = {"s2": 3}
+
+        def last_log_index(self):
+            return 10
+
+    rows = rs.peer_rows(FakeNode())
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["peer"] == "s2"
+    assert row["rpc_failed"] == 2
+    assert row["rpc_recovered"] == 1   # one failure episode ended
+    assert row["match_lag_entries"] == 7
+    assert row["last_contact_age_ms"] is not None
+
+
+# -- live clusters ----------------------------------------------------------
+
+
+def test_single_node_leader_histograms_and_lease():
+    async def main():
+        _, nodes = make_cluster(1)
+        await start_all(nodes)
+        leader = await wait_for_leader(nodes)
+        for i in range(5):
+            await leader.apply(put(f"k{i}", i))
+        obs = leader.obs
+        assert obs is not None
+        assert obs.append_quorum.count >= 1
+        assert obs.commit_apply.count >= 1
+        assert obs.leadership_gained == 1
+        kinds = [ev["kind"] for ev in obs.timeline()]
+        assert "election-start" in kinds and "leader-elected" in kinds
+        # lease rows ride into stats()
+        stats = leader.stats()
+        assert "elections_started" in stats
+        assert stats["leadership_gained"] == "1"
+        await stop_all(nodes)
+    asyncio.run(main())
+
+
+def test_three_node_follower_and_peer_rows():
+    async def main():
+        _, nodes = make_cluster(3)
+        await start_all(nodes)
+        leader = await wait_for_leader(nodes)
+        for i in range(5):
+            await leader.apply(put(f"k{i}", i))
+        await wait_until(
+            lambda: all(x.last_applied >= 5 for x in nodes),
+            msg="apply convergence")
+        # Leader: quorum + lease ladders have content; per-peer rows
+        # exist for both followers with fresh contact stamps.
+        obs = leader.obs
+        assert obs.append_quorum.count >= 1
+        await wait_until(lambda: obs.lease_margin.count >= 1,
+                         msg="lease margin samples")
+        rows = {r["peer"]: r for r in obs.peer_rows(leader)}
+        assert set(rows) == {x.id for x in nodes if x is not leader}
+        for r in rows.values():
+            assert r["last_contact_age_ms"] is not None
+        await wait_until(
+            lambda: all(r["match_lag_entries"] == 0
+                        for r in obs.peer_rows(leader)), msg="lag drains")
+        # Followers: commit→apply ladder populated via the header-commit
+        # path, no leadership events.
+        follower = next(x for x in nodes if not x.is_leader())
+        assert follower.obs.commit_apply.count >= 1
+        assert follower.obs.leadership_gained == 0
+        assert any(ev["kind"] == "new-leader"
+                   for ev in follower.obs.timeline())
+        await stop_all(nodes)
+    asyncio.run(main())
+
+
+def test_deposed_leader_events_and_fail_counters():
+    async def main():
+        transport, nodes = make_cluster(3)
+        await start_all(nodes)
+        leader = await wait_for_leader(nodes)
+        await leader.apply(put("a", 1))
+        old = leader
+        transport.isolate(old.id)
+        others = [x for x in nodes if x is not old]
+        new = await wait_for_leader(others)
+        # The cut-off leader's replication streams count RPC failures.
+        await wait_until(
+            lambda: any(st["failed"] > 0
+                        for st in old.obs._peers.values()),
+            msg="peer_fail counts on the isolated leader")
+        transport.rejoin(old.id)
+        await wait_until(lambda: old.role != "Leader" and old.obs
+                         .leadership_lost >= 1, msg="deposed")
+        kinds = [ev["kind"] for ev in old.obs.timeline()]
+        assert "leader-deposed" in kinds
+        assert new.obs.leadership_gained >= 1
+        await stop_all(nodes)
+    asyncio.run(main())
+
+
+def test_obs_compiled_out(monkeypatch):
+    monkeypatch.setenv("CONSUL_TPU_RAFT_OBS", "0")
+    assert not raftstats.enabled()
+
+    async def main():
+        _, nodes = make_cluster(1)
+        assert all(x.obs is None for x in nodes)
+        await start_all(nodes)
+        leader = await wait_for_leader(nodes)
+        assert await leader.apply(put("a", 1)) == 1
+        assert "elections_started" not in leader.stats()
+        await stop_all(nodes)
+    asyncio.run(main())
+
+
+# -- exposition -------------------------------------------------------------
+
+
+def test_prom_families_pass_check_prom():
+    async def main():
+        _, nodes = make_cluster(3)
+        await start_all(nodes)
+        leader = await wait_for_leader(nodes)
+        for i in range(5):
+            await leader.apply(put(f"k{i}", i))
+        hists, gauges, counters = raftstats.prom_families(leader)
+        assert {f["name"] for f in hists} == {
+            "consul_raft_append_quorum_ms", "consul_raft_commit_apply_ms",
+            "consul_raft_snapshot_install_ms", "consul_raft_lease_margin_ms"}
+        ae_h, ae_c = raftstats.aestats.families()
+        text = render_prometheus([], histograms=hists + ae_h,
+                                 labeled_counters=counters + ae_c,
+                                 labeled_gauges=gauges)
+        errors = check_text(text)
+        assert errors == [], errors
+        series = list(_iter_series(text))
+        followers = [x.id for x in nodes if x is not leader]
+        for want in ['consul_raft_append_quorum_ms_bucket{le="+Inf"}',
+                     'consul_antientropy_failures_total{kind="diff"}'] + [
+                f'consul_raft_peer_match_lag_entries{{peer="{p}"}}'
+                for p in followers] + [
+                f'consul_raft_peer_last_contact_age_ms{{peer="{p}"}}'
+                for p in followers]:
+            ok = _require_ok(want, series, errors)
+            assert ok, f"missing {want}: {errors}"
+        await stop_all(nodes)
+    asyncio.run(main())
+
+
+def test_telemetry_payload_shapes():
+    async def main():
+        _, nodes = make_cluster(1)
+        await start_all(nodes)
+        leader = await wait_for_leader(nodes)
+        await leader.apply(put("a", 1))
+        t = raftstats.telemetry(leader)
+        assert t["enabled"] is True
+        assert t["raft"]["state"] == "Leader"
+        assert "consul_raft_append_quorum_ms" in t["histograms"]
+        assert isinstance(t["timeline"], list)
+        assert "antientropy" in t
+        # client mode: no node at all
+        t2 = raftstats.telemetry(None)
+        assert "raft" not in t2 and "antientropy" in t2
+        await stop_all(nodes)
+    asyncio.run(main())
+
+
+# -- anti-entropy stats -----------------------------------------------------
+
+
+class FailingCatalogAgent:
+    """LocalState's agent interface with a catalog whose register path
+    always fails (per-kind failure counting)."""
+
+    node_name = "ae-test"
+    advertise_addr = "127.0.0.1"
+
+    def cluster_size(self):
+        return 1
+
+    async def catalog_node_services(self, node):
+        return {}
+
+    async def catalog_node_checks(self, node):
+        return []
+
+    async def catalog_register(self, req):
+        raise RuntimeError("catalog down")
+
+    async def catalog_deregister(self, req):
+        raise RuntimeError("catalog down")
+
+
+def test_antientropy_failure_kinds_and_pending_ops(monkeypatch):
+    from consul_tpu.structs.structs import NodeService
+
+    fresh = AntiEntropyStats()
+    monkeypatch.setattr(raftstats, "aestats", fresh)
+
+    async def main():
+        state = LocalState(FailingCatalogAgent())
+        state.add_service(NodeService(id="web", service="web", port=80))
+        assert state.pending_ops() == 1
+        with pytest.raises(RuntimeError):
+            await state.sync_once()
+        assert fresh.failures.get("service_register") == 1
+        assert fresh.syncs_total == 0          # the pass never completed
+        assert state.pending_ops() == 1        # still out of sync
+    asyncio.run(main())
+
+
+def test_antientropy_success_path(monkeypatch):
+    from consul_tpu.structs.structs import NodeService
+
+    fresh = AntiEntropyStats()
+    monkeypatch.setattr(raftstats, "aestats", fresh)
+
+    class OkAgent(FailingCatalogAgent):
+        async def catalog_register(self, req):
+            return True
+
+        async def catalog_deregister(self, req):
+            return True
+
+    async def main():
+        state = LocalState(OkAgent())
+        state.add_service(NodeService(id="web", service="web", port=80))
+        await state.sync_once()
+        assert fresh.syncs_total == 1
+        assert fresh.sync.count == 1
+        assert state.pending_ops() == 0
+    asyncio.run(main())
+
+    fams_h, fams_c = fresh.families()
+    rows = dict((tuple(lbl.items())[0][1], v)
+                for lbl, v in fams_c[0]["rows"])
+    assert set(rows) == {"diff", "service_register", "service_deregister",
+                         "check_register", "check_deregister"}
+    assert all(v == 0.0 for v in rows.values())

@@ -205,7 +205,9 @@ def main() -> None:
     ap.add_argument("--concurrency", type=int, default=32)
     ap.add_argument("--workers", default="1",
                     help="comma list of http_workers settings, e.g. 1,4")
-    ap.add_argument("--out", default="", help="also write JSON to this file")
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SERVE.json"),
+                    help="also write JSON here (the serving-plane "
+                         "trajectory file, like BENCH_r*.json; '' skips)")
     args = ap.parse_args()
 
     out = {"requests": args.requests, "concurrency": args.concurrency,

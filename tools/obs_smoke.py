@@ -4,6 +4,12 @@ and validate every line with tools/check_prom's strict checker —
 including the detection-latency observatory's histogram families —
 then sanity-check the ``/v1/agent/slo`` JSON shell.
 
+The first boot also runs the consensus-plane deep phase: KV traffic
+through raft, a ``?consistent`` lease-path read, the
+``/v1/operator/raft/telemetry`` route, and a ``/v1/agent/debug/bundle``
+capture which is untarred in memory and held to the manifest contract
+(check_prom runs on the bundled metrics snapshot too).
+
 A second boot runs the plane under a live nemesis scenario
 (``PlaneConfig(nemesis="block_kill")``, gossip/nemesis.py) and holds
 the scrape to the scenario-labeled contract: labeled histogram series
@@ -42,15 +48,39 @@ REQUIRED = [
 
 NEMESIS = "block_kill"  # scenario the second boot runs live
 
+# Consensus-plane families the deep phase must surface on a
+# lease-holding (single-node) leader after a little KV traffic.
+REQUIRED_RAFT = [
+    "consul_raft_append_quorum_ms_bucket",
+    "consul_raft_commit_apply_ms_bucket",
+    "consul_raft_lease_margin_ms_bucket",
+    "consul_raft_snapshot_install_ms_bucket",
+    "consul_antientropy_sync_ms_bucket",
+    "consul_antientropy_failures_total",
+    "consul_consistent_reads_total",
+]
+
+# Bundle manifest sections the acceptance contract names.
+REQUIRED_SECTIONS = {"metrics", "slo", "traces", "flight", "raft", "tasks"}
+
 
 def _get(url: str) -> bytes:
     with urllib.request.urlopen(url, timeout=15) as r:
         return r.read()
 
 
-async def _boot_and_scrape(nemesis: str = ""):
+def _put(url: str, data: bytes) -> bytes:
+    req = urllib.request.Request(url, data=data, method="PUT")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return r.read()
+
+
+async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
     """One plane + one kernel-backed agent; returns the Prometheus
-    text and the /v1/agent/slo JSON after a few dispatches land."""
+    text and the /v1/agent/slo JSON after a few dispatches land.
+    ``deep`` additionally drives KV traffic through raft (so the
+    consensus-plane histograms have content), then captures the raft
+    telemetry JSON and a debug bundle — returned as two extra items."""
     from consul_tpu.agent.agent import Agent, AgentConfig
     from consul_tpu.consensus.raft import RaftConfig
     from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
@@ -76,15 +106,74 @@ async def _boot_and_scrape(nemesis: str = ""):
         await asyncio.sleep(1.0)
         host, port = agent.http.addr
         base = f"http://{host}:{port}"
+        telemetry = bundle = None
+        if deep:
+            # KV writes through raft group-commit populate the
+            # append→quorum and commit→apply ladders; a ?consistent
+            # read on the (always lease-holding) single-node leader
+            # exercises the lease fast path.
+            for i in range(5):
+                await asyncio.to_thread(
+                    _put, f"{base}/v1/kv/obs-smoke/k{i}", b"v")
+            await asyncio.to_thread(
+                _get, f"{base}/v1/kv/obs-smoke/k0?consistent")
+            telemetry = json.loads(await asyncio.to_thread(
+                _get, f"{base}/v1/operator/raft/telemetry"))
+            bundle = await asyncio.to_thread(
+                _get, f"{base}/v1/agent/debug/bundle?seconds=1")
         text = (await asyncio.to_thread(
             _get, f"{base}/v1/agent/metrics?format=prometheus")).decode()
         slo = json.loads(await asyncio.to_thread(
             _get, f"{base}/v1/agent/slo"))
-        return text, slo
+        return text, slo, telemetry, bundle
     finally:
         if agent is not None:
             await agent.stop()
         await plane.stop()
+
+
+def _check_bundle(bundle: bytes, errors: list) -> None:
+    """Untar the capture in memory and hold it to the manifest +
+    exposition contract (check_prom on the bundled scrape)."""
+    import io
+    import tarfile
+
+    from tools.check_prom import check_text
+
+    try:
+        tar = tarfile.open(fileobj=io.BytesIO(bundle), mode="r:gz")
+    except tarfile.TarError as e:
+        errors.append(f"bundle is not a tar.gz: {e}")
+        return
+    with tar:
+        names = set(tar.getnames())
+        m = tar.extractfile("manifest.json") if "manifest.json" in names \
+            else None
+        if m is None:
+            errors.append("bundle has no manifest.json")
+            return
+        manifest = json.load(m)
+        missing = REQUIRED_SECTIONS - set(manifest.get("sections", []))
+        if missing:
+            errors.append(f"bundle manifest missing sections {sorted(missing)}")
+        for want in ("metrics/prometheus.txt", "metrics/snapshot_start.json",
+                     "metrics/snapshot_end.json", "raft/telemetry.json",
+                     "tasks.txt", "config.json", "slo.json", "traces.json",
+                     "flight.json"):
+            if want not in names:
+                errors.append(f"bundle missing file {want}")
+        if "metrics/prometheus.txt" in names:
+            ptxt = tar.extractfile("metrics/prometheus.txt").read().decode()
+            errors += [f"bundled scrape: {e}" for e in check_text(ptxt)]
+        if "raft/telemetry.json" in names:
+            rt = json.load(tar.extractfile("raft/telemetry.json"))
+            if "timeline" not in rt:
+                errors.append("bundled raft telemetry has no timeline")
+        if "config.json" in names:
+            cfg = json.load(tar.extractfile("config.json"))
+            for k in ("encrypt", "acl_master_token", "acl_token"):
+                if cfg.get(k) not in ("", "<redacted>"):
+                    errors.append(f"bundle config leaks secret field {k}")
 
 
 async def main() -> int:
@@ -94,12 +183,30 @@ async def main() -> int:
 
     print("[obs-smoke] starting plane (first boot compiles the kernel)...",
           flush=True)
-    text, slo = await _boot_and_scrape()
+    text, slo, telemetry, bundle = await _boot_and_scrape(deep=True)
     errors += check_text(text)
-    names = {n for n, _ in _iter_series(text)}
-    for want in REQUIRED:
+    series = list(_iter_series(text))
+    names = {n for n, _ in series}
+    for want in REQUIRED + REQUIRED_RAFT:
         if want not in names:
             errors.append(f"required metric {want} not in scrape")
+    # Lease efficacy split: the deep phase's ?consistent read on a
+    # lease-holding single-node leader must land on the lease row.
+    if not _require_ok('consul_consistent_reads_total{path="lease"}',
+                       series, errors):
+        errors.append('scrape missing consul_consistent_reads_total'
+                      '{path="lease"}')
+    # Raft telemetry route: stats + observatory payload shape.
+    if telemetry is None or "raft" not in telemetry:
+        errors.append("/v1/operator/raft/telemetry missing 'raft'")
+    else:
+        for key in ("histograms", "timeline", "antientropy"):
+            if key not in telemetry:
+                errors.append(f"/v1/operator/raft/telemetry missing {key!r}")
+    if bundle is None:
+        errors.append("no debug bundle captured")
+    else:
+        _check_bundle(bundle, errors)
     for key in ("slo", "latency", "hists"):
         if key not in slo:
             errors.append(f"/v1/agent/slo missing key {key!r}")
@@ -115,7 +222,7 @@ async def main() -> int:
     # detection fires.
     print(f"[obs-smoke] rebooting plane under nemesis={NEMESIS!r} "
           "(new static schedule recompiles)...", flush=True)
-    ntext, nslo = await _boot_and_scrape(nemesis=NEMESIS)
+    ntext, nslo, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
     nerrors = check_text(ntext)
     for fam in REQUIRED[:4]:
         want = fam + f'{{scenario="{NEMESIS}"}}'
@@ -137,7 +244,8 @@ async def main() -> int:
         return 1
     print(f"[obs-smoke] ok: {len(names)} series names, "
           f"{len(text.splitlines())} lines, slo objective "
-          f"{snap.get('objective_rounds')} rounds; nemesis scrape "
+          f"{snap.get('objective_rounds')} rounds, debug bundle "
+          f"{len(bundle)} bytes; nemesis scrape "
           f"{len(ntext.splitlines())} lines, scenarios "
           f"{sorted(scns)}")
     return 0
