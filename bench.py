@@ -46,7 +46,13 @@ a tunnel hang that starved the whole capture):
     wedge mid-table still leaves the earlier regimes' live numbers;
   * any terminal failure still emits a parseable JSON line with an
     "error" field, with the cache fallback matched to the exact regime
-    (variant + churn suffix) that failed.
+    (variant + churn suffix) that failed;
+  * every regime carries a "phases" event timeline (probe attempts in
+    the payload-level "boot_phases", then compile/measure blocks and
+    salvage decisions with durations and outcomes) — written for
+    successful runs too, so BENCH_r06+ have trend data and the next
+    tunnel hang is a readable event log instead of a zero.  A single
+    table row reruns by name via --regime (e.g. --regime healthy).
 """
 
 from __future__ import annotations
@@ -61,11 +67,15 @@ import time
 TARGET_ROUNDS_PER_SEC = 10_000.0
 MIN_FALLBACK_N = 65_536
 
-# Dense-regime roofline (BENCH_NOTES.md §1c): every non-quiescent round
-# materializes the S×N belief matrix ~5 times (1 read + 3 shifted reads
-# + 1 write) at the chip's measured effective ~185 GB/s.
-EFFECTIVE_HBM_GBPS = 185.0
-DENSE_PASSES_PER_ROUND = 5
+# Dense-regime roofline (BENCH_NOTES.md §1c) — single source of truth
+# in obs/devstats.py (no jax import there, so safe pre-probe); bench,
+# tools/profile_kernel.py, and the live agent all report the same
+# derivation, closing the loop between bench numbers and the serving
+# plane.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from consul_tpu.obs.devstats import (  # noqa: E402
+    DENSE_PASSES_PER_ROUND, EFFECTIVE_HBM_GBPS, dense_bytes_per_round,
+    roofline_utilization)
 
 
 def _log(msg: str) -> None:
@@ -74,6 +84,33 @@ def _log(msg: str) -> None:
 
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+
+
+class _Timeline:
+    """Per-regime phase event log (the post-hoc diagnosis the BENCH_r04/
+    r05 zeros never had): every probe attempt, compile, timed block, and
+    salvage decision lands here with a wall-clock offset and outcome,
+    and the list is persisted into the JSON payload for successful AND
+    wedged regimes alike."""
+
+    def __init__(self) -> None:
+        self._t0 = time.monotonic()
+        self.events: list[dict] = []
+
+    def note(self, phase: str, outcome: str = "ok",
+             dur_s: float | None = None, **detail) -> None:
+        ev = {"phase": phase,
+              "t_s": round(time.monotonic() - self._t0, 3),
+              "outcome": outcome}
+        if dur_s is not None:
+            ev["dur_s"] = round(dur_s, 3)
+        ev.update(detail)
+        self.events.append(ev)
+
+
+# Process-lifetime timeline: backend probe attempts + backend-up/gave-up
+# verdicts, emitted as "boot_phases" alongside every payload shape.
+_BOOT = _Timeline()
 
 
 def _want_cpu() -> bool:
@@ -123,15 +160,23 @@ def _setup_jax(retries: int = 6, probe_timeout_s: float = 40.0):
     probes themselves from burning the window when it is a slow one."""
     last = "unknown"
     for attempt in range(1, retries + 1):
+        t0 = time.perf_counter()
         ok, info = _probe_backend(probe_timeout_s)
+        dt = time.perf_counter() - t0
         if ok:
             _log(f"backend probe ok: {info}")
+            _BOOT.note("backend_probe", dur_s=dt, attempt=attempt,
+                       info=info)
             break
         last = info
         _log(f"backend probe failed (attempt {attempt}/{retries}): {info}")
+        _BOOT.note("backend_probe", outcome="fail", dur_s=dt,
+                   attempt=attempt, info=info)
         if attempt < retries:
             time.sleep(min(4.0 * 2 ** (attempt - 1), 64.0))
     else:
+        _BOOT.note("backend_up", outcome="gave_up", attempts=retries,
+                   info=last)
         raise RuntimeError(f"jax backend unreachable after {retries} probes: {last}")
 
     if _want_cpu():
@@ -154,6 +199,7 @@ def _setup_jax(retries: int = 6, probe_timeout_s: float = 40.0):
     _PLATFORM = devs[0].platform
     _log(f"backend up: {len(devs)}x {devs[0].platform} "
          f"({getattr(devs[0], 'device_kind', '?')})")
+    _BOOT.note("backend_up", platform=devs[0].platform, devices=len(devs))
     return jax
 
 
@@ -170,7 +216,8 @@ def _sync(jax, state) -> None:
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
                churn_ppm: int = 1000, dissem_swar: bool = True,
                hot_slots: int = 0, flight: bool = False,
-               shard_devices: int = 0, nemesis: str = "") -> dict:
+               shard_devices: int = 0, nemesis: str = "",
+               tl: _Timeline | None = None) -> dict:
     import functools
 
     import jax.numpy as jnp
@@ -266,12 +313,14 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
             ns = parts[i]
         return state, fl, ns, hist
 
+    tl = tl or _Timeline()
     _log(f"lan n={n} slots={slots}: compiling + warmup ({steps} rounds)")
     t0 = time.perf_counter()
     state, fl, ns, _ = _dispatch(state, fail_round, fl, ns)
     _sync(jax, state)
     compile_s = time.perf_counter() - t0
     _log(f"compile+warmup done in {compile_s:.1f}s")
+    tl.note("compile_warmup", dur_s=compile_s, n=n, rounds=steps)
 
     best = float("inf")
     for r in range(repeats):
@@ -281,6 +330,8 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         dt = time.perf_counter() - t0
         best = min(best, dt)
         _log(f"block {r + 1}/{repeats}: {steps / dt:.1f} rounds/s")
+        tl.note("measure", dur_s=dt, block=r + 1,
+                rounds_per_sec=round(steps / dt, 1))
 
     rps = steps / best
     result = {
@@ -300,6 +351,13 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         "hot_slots": hot_slots,
         "shard_devices": shard_devices,
     }
+    # The same roofline-utilization figure the live agent exports
+    # (consul_kernel_roofline_utilization — one derivation, devstats):
+    # achieved HBM traffic over the §1c ceiling.  Quiescent regimes can
+    # exceed 1.0 — they skip the dense passes the estimate assumes.
+    util = roofline_utilization(dense_bytes_per_round(slots, n), rps)
+    if util is not None:
+        result["roofline_utilization"] = round(util, 6)
     if flight:
         # One drain AFTER timing: proves rows were recorded without a
         # host transfer inside the measured blocks.
@@ -317,6 +375,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         from consul_tpu.gossip.kernel import init_hist, init_nem_state
         from consul_tpu.obs.hist import HistRecorder
         _log("observatory block: detection-latency histograms (untimed)")
+        t_obs = time.perf_counter()
         h_state = init_state(p)
         if shard_devices:
             h_state = shard_state(h_state, shard_devices)
@@ -335,6 +394,8 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         result["detect_count"] = int(rec.counts("detect").sum())
         result["detect_p50_rounds"] = rec.percentile("detect", 50)
         result["detect_p99_rounds"] = rec.percentile("detect", 99)
+        tl.note("observatory", dur_s=time.perf_counter() - t_obs,
+                detections=result["detect_count"])
         if nemesis:
             # Per-scenario SLO readout (BENCH_NOTES §8): same objective
             # the live plane serves at /v1/agent/slo.
@@ -349,7 +410,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
 
 
 def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
-                   repeats: int) -> dict:
+                   repeats: int, tl: _Timeline | None = None) -> dict:
     """Config #5 shape: D LAN pools + WAN pool + cross-DC event propagation."""
     import jax.numpy as jnp
 
@@ -373,6 +434,7 @@ def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
                 .at[:, s0:s0 + n_fail].set(per_dc[None, :]))
     wan_fail = jnp.full((p.n_dcs * p.n_servers,), NEVER, jnp.int32)
 
+    tl = tl or _Timeline()
     _log(f"multidc n={n} dcs={dcs}: compiling + warmup ({steps} rounds)")
     t0 = time.perf_counter()
     state, _ = run_multidc_rounds(state, key, lan_fail, wan_fail, p,
@@ -380,6 +442,7 @@ def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
     _sync(jax, state.wan)
     compile_s = time.perf_counter() - t0
     _log(f"compile+warmup done in {compile_s:.1f}s")
+    tl.note("compile_warmup", dur_s=compile_s, n=n, rounds=steps)
 
     best = float("inf")
     for r in range(repeats):
@@ -390,6 +453,8 @@ def _bench_multidc(jax, n: int, dcs: int, slots: int, steps: int,
         dt = time.perf_counter() - t0
         best = min(best, dt)
         _log(f"block {r + 1}/{repeats}: {steps / dt:.1f} rounds/s")
+        tl.note("measure", dur_s=dt, block=r + 1,
+                rounds_per_sec=round(steps / dt, 1))
 
     rps = steps / best
     return {
@@ -514,6 +579,10 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
     n = args.n
     last_err: Exception | None = None
     first = True
+    # One timeline per regime: probe history lives in _BOOT; this one
+    # carries compile/measure/salvage and is attached to the result for
+    # successful AND failed regimes (the diagnosable-zero requirement).
+    tl = _Timeline()
     while first or n >= MIN_FALLBACK_N:
         first = False
         if shard_devices:
@@ -524,24 +593,30 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
         try:
             if multidc:
                 result = _bench_multidc(jax, n, args.dcs, args.slots,
-                                        args.steps, args.repeats)
+                                        args.steps, args.repeats, tl=tl)
             else:
                 result = _bench_lan(jax, n, args.slots, args.steps,
                                     args.repeats, churn_ppm=churn_ppm,
                                     dissem_swar=dissem_swar,
                                     hot_slots=hot_slots, flight=flight,
                                     shard_devices=shard_devices,
-                                    nemesis=nemesis)
+                                    nemesis=nemesis, tl=tl)
             if n != args.n:
                 result["reduced_from_n"] = args.n
+            result["phases"] = tl.events
             _store_result(result)
             return result
         except Exception as e:
             last_err = e
             _log(f"run at n={n} failed: {type(e).__name__}: {e}")
-            n //= 4
+            from_n, n = n, n // 4
             if n >= MIN_FALLBACK_N:
                 _log(f"falling back to n={n}")
+                tl.note("salvage", outcome="reduced_n", from_n=from_n,
+                        to_n=n, error=f"{type(e).__name__}: {e}")
+            else:
+                tl.note("salvage", outcome="gave_up", from_n=from_n,
+                        error=f"{type(e).__name__}: {e}")
     fail_metric = ("swim_multidc_rounds_per_sec" if multidc
                    else "swim_gossip_rounds_per_sec")
     payload = {"metric": fail_metric, "value": 0.0, "unit": "rounds/s",
@@ -552,15 +627,54 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                            flight, shard_devices, nemesis)
     if last is not None:
         payload["last_known_good"] = last
+        tl.note("salvage", outcome="last_known_good",
+                metric=last.get("metric"), value=last.get("value"))
+    payload["phases"] = tl.events
     return payload
 
 
 def _roofline(n: int, slots: int) -> float:
     """Dense-regime ceiling for ANY implementation of these semantics on
     this chip: DENSE_PASSES_PER_ROUND materializations of the S×N belief
-    matrix per round at the measured effective HBM rate."""
-    bytes_per_round = DENSE_PASSES_PER_ROUND * slots * n
-    return EFFECTIVE_HBM_GBPS * 1e9 / bytes_per_round
+    matrix per round at the measured effective HBM rate (shared
+    derivation: obs/devstats.py)."""
+    return EFFECTIVE_HBM_GBPS * 1e9 / dense_bytes_per_round(slots, n)
+
+
+# The regime table by name, for `--regime NAME` (diagnosis reruns of
+# exactly one table row — the full table costs a chip-hour).  Keys match
+# the payload's regimes{} keys; churn1000ppm_shard{d} is accepted via
+# the pattern below.
+_NAMED_REGIMES: dict[str, dict] = {
+    "healthy": dict(multidc=False, churn_ppm=0),
+    "healthy_flight": dict(multidc=False, churn_ppm=0, flight=True),
+    "churn1000ppm": dict(multidc=False, churn_ppm=1000),
+    "churn1000ppm_planes": dict(multidc=False, churn_ppm=1000,
+                                dissem_swar=False),
+    "realistic_churn10ppm": dict(multidc=False, churn_ppm=10),
+    "realistic_churn10ppm_hot8": dict(multidc=False, churn_ppm=10,
+                                      hot_slots=8),
+    "multidc": dict(multidc=True, churn_ppm=0),
+    "nemesis_asym_loss": dict(multidc=False, churn_ppm=1000,
+                              nemesis="asym_loss"),
+    "nemesis_degraded_observer": dict(multidc=False, churn_ppm=1000,
+                                      nemesis="degraded_observer"),
+}
+
+_SHARD_REGIME_RE = re.compile(r"^churn1000ppm_shard(\d+)$")
+
+
+def _named_regime(name: str) -> dict:
+    """_run_regime kwargs for a regime-table row name; raises
+    SystemExit with the known names on a miss (argparse convention)."""
+    if name in _NAMED_REGIMES:
+        return dict(_NAMED_REGIMES[name])
+    m = _SHARD_REGIME_RE.match(name)
+    if m:
+        return dict(multidc=False, churn_ppm=1000,
+                    shard_devices=int(m.group(1)))
+    known = ", ".join(sorted(_NAMED_REGIMES) + ["churn1000ppm_shard{d}"])
+    raise SystemExit(f"unknown --regime {name!r}; known: {known}")
 
 
 def main() -> None:
@@ -595,10 +709,15 @@ def main() -> None:
                          "injection schedule (gossip/nemesis.py catalog "
                          "name, window widened to the whole run); the "
                          "table A/Bs two scenarios against churn1000ppm")
+    ap.add_argument("--regime", type=str, default="",
+                    help="run exactly one regime-table row by its "
+                         "payload key (healthy, churn1000ppm_planes, "
+                         "churn1000ppm_shard2, ...) — the diagnosis "
+                         "rerun path; combines with --n/--steps etc.")
     args = ap.parse_args()
 
     single_regime = (args.multidc or args.churn_ppm is not None
-                     or bool(args.nemesis))
+                     or bool(args.nemesis) or bool(args.regime))
 
     try:
         jax = _setup_jax()
@@ -608,7 +727,10 @@ def main() -> None:
         # this run WOULD have measured (the round-3 artifact carried
         # only one stale number and the whole regime story was lost).
         plat = "cpu" if _want_cpu() else "axon"
-        if args.multidc:
+        if args.regime:
+            rk = _named_regime(args.regime)
+            multidc, churn = rk["multidc"], rk["churn_ppm"]
+        elif args.multidc:
             multidc, churn = True, 0
         else:
             churn = args.churn_ppm if args.churn_ppm is not None else 0
@@ -639,16 +761,23 @@ def main() -> None:
                 k: v for k, v in lkg.items() if v is not None}
             if lkg["churn1000ppm"] is not None:  # the headline regime
                 payload["last_known_good"] = lkg["churn1000ppm"]
+        payload["boot_phases"] = _BOOT.events
         _emit(payload)
         return
 
     if single_regime:
-        churn = args.churn_ppm if args.churn_ppm is not None else 1000
-        _emit(_run_regime(jax, args, multidc=args.multidc, churn_ppm=churn,
+        if args.regime:
+            kwargs = _named_regime(args.regime)
+        else:
+            churn = args.churn_ppm if args.churn_ppm is not None else 1000
+            kwargs = dict(multidc=args.multidc, churn_ppm=churn,
                           dissem_swar=args.dissem == "swar",
                           hot_slots=args.hot_slots, flight=args.flight,
                           shard_devices=args.shard_devices,
-                          nemesis=args.nemesis))
+                          nemesis=args.nemesis)
+        payload = _run_regime(jax, args, **kwargs)
+        payload["boot_phases"] = _BOOT.events
+        _emit(payload)
         return
 
     # -- default: the full regime table, one JSON line -------------------
@@ -713,6 +842,7 @@ def main() -> None:
                           "healthy regime takes the quiescent fast path "
                           "and is not bounded by it"),
         "measured_live": [k for k, v in regimes.items() if "error" not in v],
+        "boot_phases": _BOOT.events,
     }
     if "error" in headline:
         payload["error"] = headline["error"]

@@ -990,6 +990,11 @@ class Agent:
         # Detection-latency SLO: an operator health surface like
         # /v1/agent/metrics, not a debug surface — always on.
         router.add_get("/v1/agent/slo", h(self._slo))
+        # Device/kernel observatory (obs/devstats.py): dispatch-latency
+        # hists, rounds/s EWMA, HBM occupancy, compile + roofline
+        # telemetry.  Operator surface like /v1/agent/slo — always on
+        # (reports enabled=false when CONSUL_TPU_DEV_OBS=0).
+        router.add_get("/v1/agent/device", h(self._device))
         # Consensus-plane observatory (obs/raftstats.py): raft stats +
         # latency histograms + per-peer replication state + the
         # leadership/lease event timeline.  Operator surface like
@@ -1062,6 +1067,19 @@ class Agent:
         ae_hists, ae_counters = raftstats.aestats.families()
         hists += ae_hists
         labeled_counters += ae_counters
+        # Device/kernel observatory: dispatch hists, HBM gauges, compile
+        # counters pulled over the bridge (absent when CONSUL_TPU_DEV_OBS=0
+        # or for backends without a kernel plane).
+        dev_getter = getattr(self.lan_pool, "plane_device", None)
+        if dev_getter is not None:
+            fams = (await dev_getter(timeout=2.0)).get("families") or {}
+            hists += fams.get("histograms") or []
+            labeled_gauges += fams.get("gauges") or []
+            labeled_counters += fams.get("counters") or []
+        # Standard scrape hygiene, never gated: build identity + liveness.
+        from consul_tpu.obs import devstats
+        bi_gauges = devstats.build_info_families(self.config.gossip_backend)
+        labeled_gauges += bi_gauges
         # Rendered as a label-less family (not a telemetry point: the
         # registry would interpose the node name and break the stable
         # consul_antientropy_* schema across agents).
@@ -1135,6 +1153,25 @@ class Agent:
         out.setdefault("hists", [])
         return out
 
+    async def _device(self, request):
+        """Device/kernel observatory JSON twin of the consul_device_*/
+        consul_kernel_* scrape families: dispatch-latency histograms,
+        rounds/s EWMA, per-device HBM + live-buffer rows, compile wall
+        times + cache counters, and the derived roofline-utilization
+        figure.  Empty shell for backends without a kernel."""
+        from consul_tpu.obs import devstats
+        getter = getattr(self.lan_pool, "plane_device", None)
+        if getter is None:
+            out = {"backend": self.config.gossip_backend,
+                   "enabled": devstats.enabled(), "devices": []}
+        else:
+            out = dict(await getter())
+            out.pop("t", None)  # bridge frame tag, not API surface
+            out.setdefault("backend", self.config.gossip_backend)
+            out.setdefault("devices", [])
+        out["build"] = devstats.build_info(self.config.gossip_backend)
+        return out
+
     async def _profile(self, request):
         """On-demand device profiling (debug-gated): capture a
         jax.profiler trace of K kernel rounds on the plane and return
@@ -1182,6 +1219,16 @@ class Agent:
 
     async def _self(self, request):
         """/v1/agent/self (agent_endpoint.go:24-34): config + stats."""
+        stats = self.server.stats()
+        # Device observatory rows (stringly-typed like the reference's
+        # runtime stats); only present when a kernel plane is attached.
+        getter = getattr(self.lan_pool, "plane_device", None)
+        if getter is not None:
+            from consul_tpu.obs import devstats
+            rows = devstats.stats_rows(await getter(timeout=2.0))
+            if rows:
+                stats = dict(stats)
+                stats["device"] = rows
         return {
             "Config": {
                 "Datacenter": self.config.datacenter,
@@ -1191,7 +1238,7 @@ class Agent:
                 "Domain": self.config.domain,
                 "Version": VERSION,
             },
-            "Stats": self.server.stats(),
+            "Stats": stats,
         }
 
     async def _services(self, request):

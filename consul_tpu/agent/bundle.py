@@ -16,6 +16,8 @@ agent over the window and returns a gzipped tarball of:
 * ``flight.json``           — kernel flight-recorder drain
 * ``raft/telemetry.json``   — raft stats + histograms + per-peer rows
   + the leadership/election/lease event timeline
+* ``device/telemetry.json`` — device/kernel observatory: dispatch
+  hists, HBM occupancy, compile + roofline telemetry (obs/devstats.py)
 * ``tasks.txt``             — thread + asyncio task dump (agent/debug.py)
 * ``config.json``           — agent config with secrets redacted
 
@@ -39,7 +41,8 @@ from consul_tpu.version import VERSION
 # bundle (gossip key, ACL tokens).
 SECRET_FIELDS = ("encrypt", "acl_master_token", "acl_token")
 
-SECTIONS = ("metrics", "slo", "traces", "flight", "raft", "tasks", "config")
+SECTIONS = ("metrics", "slo", "traces", "flight", "raft", "device",
+            "tasks", "config")
 
 
 def redacted_config(config: Any) -> Dict[str, Any]:
@@ -76,6 +79,7 @@ async def capture(agent: Any, seconds: float) -> bytes:
     put_json("flight.json", await agent._flight(None))
     put_json("raft/telemetry.json", raftstats.telemetry(
         getattr(agent.server, "raft", None), local=agent.local))
+    put_json("device/telemetry.json", await agent._device(None))
     files["tasks.txt"] = debug.task_dump().encode()
     put_json("config.json", redacted_config(agent.config))
     put_json("manifest.json", {

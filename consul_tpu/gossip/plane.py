@@ -230,6 +230,12 @@ class GossipPlane:
         self._nem = None                     # nemesis.NemesisParams
         self._nem_state = None               # kernel.NemState (device)
         self._nem_fail = None                # scheduled kills (np i32 [n])
+        # Device/kernel observatory (obs/devstats.py): dispatch-latency
+        # hists, rounds/s EWMA, HBM occupancy, compile + roofline
+        # telemetry.  None when CONSUL_TPU_DEV_OBS=0 — every hot-path
+        # hook is then a single attribute-is-None test.
+        self._dev = None                     # devstats.DevStats
+        self._cache_dir = ""                 # persistent compile cache
 
     # -- universe ----------------------------------------------------------
 
@@ -254,16 +260,17 @@ class GossipPlane:
         # seconds-to-minutes; across restarts the plane should pay that
         # once per (params, jaxlib), not once per boot (same wiring as
         # bench.py _setup_jax; best-effort — older jaxlibs lack it).
+        cache_dir = os.environ.get(
+            "CONSUL_TPU_COMPILE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "consul_tpu_jax_cache"))
         try:
-            cache_dir = os.environ.get(
-                "CONSUL_TPU_COMPILE_CACHE",
-                os.path.join(os.path.expanduser("~"), ".cache",
-                             "consul_tpu_jax_cache"))
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            self._cache_dir = cache_dir
         except Exception:  # noqa: E02 — cache is an optimization only
-            pass
+            self._cache_dir = ""
 
         c = self.config
         n = self.n_universe
@@ -369,9 +376,22 @@ class GossipPlane:
                                attainment_target=c.slo_attainment_target)
         self._slo_board = SloBoard(
             objective, attainment_target=c.slo_attainment_target)
+        # Device/kernel observatory (obs/devstats.py): created here so
+        # the warmup compiles below are its first compile-telemetry
+        # samples; compiled out to a None attribute when disabled.
+        from consul_tpu.obs import devstats
+        self._dev = devstats.DevStats() if devstats.enabled() else None
+        if self._dev is not None:
+            self._dev.set_session(slots=c.slots, n=n,
+                                  steps_per_dispatch=STEPS_PER_TICK,
+                                  ndev=ndev)
         # run_rounds donates state+flight+hist (+nem_state): warm up on
         # copies so the session arrays survive the throwaway compile
-        # dispatch.
+        # dispatch.  The wall time around each warmup is the compile
+        # telemetry; persistent-cache hit/miss is read off the cache
+        # dir's entry count (a hit persists nothing new).
+        cache_before = devstats.cache_entries(self._cache_dir)
+        t_compile = time.monotonic()
         jax.block_until_ready(self._run(
             jax.tree.map(jnp.copy, self._state), self._key,
             jnp.asarray(self._fail), STEPS_PER_TICK,
@@ -380,9 +400,43 @@ class GossipPlane:
             jax.tree.map(jnp.copy, self._hist),
             (jax.tree.map(jnp.copy, self._nem_state)
              if self._nem_state is not None else None))[0])
+        if self._dev is not None:
+            after = devstats.cache_entries(self._cache_dir)
+            hit = (None if cache_before is None or after is None
+                   else after == cache_before)
+            self._dev.note_compile("plane_dispatch",
+                                   time.monotonic() - t_compile,
+                                   cache_hit=hit)
+            cache_before = after
+        t_compile = time.monotonic()
         jax.block_until_ready(run_event_rounds(
             self._ev_state, self._key, self._state.member, self._p,
             steps=STEPS_PER_TICK)[0])
+        if self._dev is not None:
+            after = devstats.cache_entries(self._cache_dir)
+            hit = (None if cache_before is None or after is None
+                   else after == cache_before)
+            self._dev.note_compile("event_dispatch",
+                                   time.monotonic() - t_compile,
+                                   cache_hit=hit)
+            # Lowered cost_analysis of the dispatch shape: FLOPs +
+            # bytes-accessed estimates feed the derived roofline gauge.
+            # Lowering only traces (no second compile; the inner jits'
+            # donation is inlined away — the profile_kernel pattern);
+            # best-effort across backends.
+            try:
+                lowered = jax.jit(
+                    lambda st, k, f, j, fl, h, ns: self._run(
+                        st, k, f, STEPS_PER_TICK, j, fl, h, ns)[0]
+                ).lower(self._state, self._key, jnp.asarray(self._fail),
+                        jnp.asarray(self._join), self._flight,
+                        self._hist, self._nem_state)
+                self._dev.note_cost("plane_dispatch",
+                                    lowered.cost_analysis(),
+                                    steps=STEPS_PER_TICK)
+            except Exception:  # noqa: E02 — estimates only, never fatal
+                pass
+            self._dev.sample_devices()
         self._rounds_done = 0
         self._t0 = time.monotonic()
 
@@ -537,6 +591,8 @@ class GossipPlane:
 
         from consul_tpu.gossip.kernel import PHASE_DEAD
 
+        dev = self._dev
+        t_disp = time.monotonic() if dev is not None else 0.0
         fail = self._fail
         if self._nem_fail is not None:
             # Scenario-scheduled kills (absolute kernel rounds) override
@@ -576,6 +632,12 @@ class GossipPlane:
         # per-round slot registers: subject + phase).
         slot_node = np.asarray(trace.slot_node)    # [T, S]
         slot_phase = np.asarray(trace.slot_phase)  # [T, S]
+        if dev is not None:
+            # The trace fetch above forced the device work, so this is
+            # the dispatch's true host-visible latency.
+            dev.note_dispatch(
+                "sharded_round" if self._ndev > 1 else "round_step",
+                (time.monotonic() - t_disp) * 1e3, STEPS_PER_TICK)
         dead_mask = (slot_phase == PHASE_DEAD) & (slot_node >= 0)
         for sid in np.unique(slot_node[dead_mask]):
             node = self._nodes_by_id.get(int(sid))
@@ -682,12 +744,19 @@ class GossipPlane:
         if self._flight is None or self._flight_recorder is None:
             return
         self._dispatches_since_drain = 0
+        dev = self._dev
+        t_drain = time.monotonic() if dev is not None else 0.0
         cursor = int(self._flight.cursor)
         if cursor == self._flight_recorder.last_cursor:
             return  # nothing new since the last drain (banks idle too)
         self._flight_recorder.ingest(
             np.asarray(self._flight.rows), cursor)
         self._drain_hist()
+        if dev is not None:
+            dev.note_drain((time.monotonic() - t_drain) * 1e3)
+            # Heavier device sampling (HBM stats + live-buffer census)
+            # rides this cadence, never the per-dispatch path.
+            dev.sample_devices()
 
     def _drain_hist(self) -> None:
         """Pull the on-device histogram banks to the host recorder and
@@ -829,6 +898,23 @@ class GossipPlane:
                     for scn in scns}
         return out
 
+    def _device_wire(self) -> Dict[str, Any]:
+        """/v1/agent/device payload: the device/kernel observatory's
+        dispatch hists, rounds/s EWMA, per-device HBM + live-buffer
+        rows, compile + roofline telemetry — plus the ready-to-render
+        Prometheus families the agent splices into its scrape.  A
+        disabled observatory reports just that (the JSON twin of the
+        compiled-out hooks)."""
+        out: Dict[str, Any] = {"t": "device",
+                               "enabled": self._dev is not None}
+        if self._dev is not None:
+            self._dev.sample_devices()
+            out.update(self._dev.wire())
+            hists, gauges, counters = self._dev.prom_families()
+            out["families"] = {"histograms": hists, "gauges": gauges,
+                               "counters": counters}
+        return out
+
     def _profile_wire(self, steps: int, phases: bool = False
                       ) -> Dict[str, Any]:
         """On-demand device profiling: run ``steps`` kernel rounds on
@@ -871,6 +957,15 @@ class GossipPlane:
                 trace_dir=trace_dir, rounds=ndisp * STEPS_PER_TICK,
                 dispatches=ndisp, wall_s=wall,
                 round_ms=wall * 1e3 / (ndisp * STEPS_PER_TICK))
+            # The same roofline-utilization derivation the devstats
+            # observatory and bench.py report (obs/devstats.py) —
+            # profiling paths must agree on one figure.
+            from consul_tpu.obs import devstats
+            util = devstats.roofline_utilization(
+                devstats.dense_bytes_per_round(self._p.slots, self._p.n),
+                1000.0 / payload["round_ms"])
+            if util is not None:
+                payload["roofline_utilization"] = round(util, 6)
             if phases:
                 payload["phases_ms"] = self._profile_phases()
         except Exception as e:  # noqa: E02 — profiling must never kill the plane
@@ -1002,6 +1097,11 @@ class GossipPlane:
                     # (same keyring gate as stats).
                     self._drain_flight()
                     self._send(writer, self._slo_wire())
+                elif t == "device":
+                    # Device/kernel observatory query (obs/devstats.py):
+                    # dispatch hists, HBM rows, compile + roofline
+                    # telemetry (same keyring gate as stats).
+                    self._send(writer, self._device_wire())
                 elif t == "profile":
                     # On-demand device profiling of K kernel rounds.
                     # Blocks this connection's loop while capturing —

@@ -341,6 +341,10 @@ class TpuSerfPool:
             fut = getattr(self, "_profile_future", None)
             if fut is not None and not fut.done():
                 fut.set_result(m)
+        elif t == "device":
+            fut = getattr(self, "_device_future", None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
         elif t == "user":
             ltime = int(m.get("ltime", 0))
             self.event_ltime = max(self.event_ltime, ltime)
@@ -461,6 +465,23 @@ class TpuSerfPool:
             fut = self._slo_future = \
                 asyncio.get_event_loop().create_future()
             self._bridge.send({"t": "slo"})
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            return {}
+
+    async def plane_device(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Device/kernel observatory from the plane (the agent side of
+        /v1/agent/device): dispatch-latency hists, rounds/s EWMA, HBM
+        occupancy + live-buffer census, compile + roofline telemetry.
+        Same shared-future discipline as plane_stats."""
+        if self._bridge is None:
+            return {}
+        fut = getattr(self, "_device_future", None)
+        if fut is None or fut.done():
+            fut = self._device_future = \
+                asyncio.get_event_loop().create_future()
+            self._bridge.send({"t": "device"})
         try:
             return await asyncio.wait_for(asyncio.shield(fut), timeout)
         except asyncio.TimeoutError:

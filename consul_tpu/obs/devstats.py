@@ -1,0 +1,487 @@
+"""Device & kernel observatory: the instrument, instrumented.
+
+The observability stack covers the protocol (flight recorder,
+detection-latency banks, SLO board), the edge (reqstats), and the
+consensus plane (raftstats) — this module covers the layer the repo
+exists for: the accelerator running the SWIM kernel.  A ``DevStats``
+instance rides on the gossip plane (gossip/plane.py) and collects:
+
+* **dispatch telemetry** — host-monotonic latency histograms per jit
+  dispatch class (``round_step``, ``sharded_round``, ``multidc_outer``,
+  ``drain``), plus a rounds/s EWMA gauge refreshed every dispatch.
+  The hists observe every dispatch (two clock reads — far cheaper than
+  the dispatch itself); the heavier device sampling below rides the
+  plane's flight-drain cadence instead.
+* **device telemetry** — per-device HBM bytes-in-use / bytes-limit via
+  ``Device.memory_stats()`` plus a live-buffer census over
+  ``jax.live_arrays()``.  Both degrade gracefully: CPU backends report
+  no ``memory_stats`` (the HBM gauges are simply absent), and a
+  process without jax reports no devices at all.
+* **compile telemetry** — per-callable compile wall time, persistent-
+  cache hit/miss counters (detected by counting cache-dir entries
+  around the compile — a fresh compile persists new entries, a hit
+  does not), and lowered ``cost_analysis()`` FLOPs / bytes-accessed
+  estimates.  From these a **roofline-utilization gauge** is derived:
+  achieved HBM traffic (bytes/round x rounds/s) over the BENCH_NOTES
+  §1c effective ceiling — computed, never hand-maintained.  The same
+  derivation (:func:`roofline_utilization`) is the one bench.py,
+  tools/profile_kernel.py, and ``/v1/agent/profile`` report, so every
+  profiling path agrees on one figure.
+
+Conventions, matching the rest of obs/:
+
+* host-side plain-int banks (the raftstats/HistRecorder contract —
+  never wrap), no locks (single event loop), and **no module-level jax
+  import** — the agent process renders wire payloads without a kernel;
+  only the device-sampling helpers import jax, lazily, and degrade.
+
+The whole observatory compiles out for A/B overhead runs:
+``CONSUL_TPU_DEV_OBS=0`` makes ``enabled()`` false, the plane then
+carries ``_dev = None`` and every hot-path hook is one
+attribute-is-None test (BENCH_NOTES.md §11 measures the delta).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from consul_tpu.obs.raftstats import LatencyHist
+from consul_tpu.version import VERSION
+
+# Dense-regime roofline inputs (BENCH_NOTES.md §1c): every
+# non-quiescent round materializes the S×N belief matrix ~5 times
+# (1 read + 3 shifted reads + 1 write) at the chip's measured
+# effective ~185 GB/s.  Single source of truth — bench.py imports
+# these rather than restating the prose.
+EFFECTIVE_HBM_GBPS = 185.0
+DENSE_PASSES_PER_ROUND = 5
+
+# Jit dispatch classes the plane (and bench) attribute latency to.
+# ``multidc_outer`` is reserved for the multi-DC outer jit
+# (gossip/multidc.py run_multidc_rounds — bench regime today, a
+# multi-DC plane tomorrow); its ladder renders zero-count until then
+# so dashboards see the full schema.
+DISPATCH_CLASSES: Tuple[str, ...] = ("round_step", "sharded_round",
+                                     "multidc_outer", "drain")
+
+_EWMA_ALPHA = 0.2   # rounds/s gauge smoothing per dispatch sample
+
+
+def enabled() -> bool:
+    """Observatory switch: CONSUL_TPU_DEV_OBS=0 compiles it out (the
+    A/B leg of the BENCH_NOTES §11 overhead measurement)."""
+    return os.environ.get("CONSUL_TPU_DEV_OBS", "1").lower() not in (
+        "0", "false", "no")
+
+
+# -- the shared roofline derivation (bench / profile / agent) -------------
+
+def dense_bytes_per_round(slots: int, n: int) -> float:
+    """HBM bytes one dense (non-quiescent) round moves: the §1c
+    analytic estimate used until a lowered cost_analysis() refines it."""
+    return float(DENSE_PASSES_PER_ROUND) * float(slots) * float(n)
+
+
+def roofline_utilization(bytes_per_round: float, rounds_per_sec: float,
+                         ceiling_gbps: float = EFFECTIVE_HBM_GBPS
+                         ) -> Optional[float]:
+    """Achieved HBM bandwidth over the effective ceiling, as a 0..1
+    fraction (can exceed 1 when the workload takes the quiescent fast
+    path and skips the dense passes the estimate assumes).  None when
+    either input is unknown/zero."""
+    if not bytes_per_round or not rounds_per_sec:
+        return None
+    if bytes_per_round < 0 or rounds_per_sec < 0 or ceiling_gbps <= 0:
+        return None
+    return (bytes_per_round * rounds_per_sec) / (ceiling_gbps * 1e9)
+
+
+# -- device sampling (lazy jax; degrades to absent) -----------------------
+
+def device_rows() -> List[Dict[str, Any]]:
+    """One row per local device: platform/kind, HBM occupancy when the
+    backend exposes ``memory_stats()`` (CPU returns None — the hbm_*
+    keys are then absent, not zero), and a live-buffer census over
+    ``jax.live_arrays()`` (bytes of a multi-device array are split
+    evenly across its devices).  Returns [] when jax is unavailable."""
+    try:
+        import jax
+    except Exception:
+        return []
+    census: Dict[int, List[float]] = {}
+    try:
+        for arr in jax.live_arrays():
+            try:
+                devs = list(arr.devices())
+                nb = float(getattr(arr, "nbytes", 0) or 0) / max(
+                    1, len(devs))
+                for d in devs:
+                    c = census.setdefault(d.id, [0, 0.0])
+                    c[0] += 1
+                    c[1] += nb
+            except Exception:
+                continue  # array deleted mid-iteration
+    except Exception:
+        census = {}
+    rows: List[Dict[str, Any]] = []
+    try:
+        devices = jax.devices()
+    except Exception:
+        return []
+    for d in devices:
+        row: Dict[str, Any] = {
+            "id": int(d.id),
+            "platform": str(getattr(d, "platform", "")),
+            "kind": str(getattr(d, "device_kind", "")),
+        }
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            if stats.get("bytes_in_use") is not None:
+                row["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+            limit = (stats.get("bytes_limit")
+                     or stats.get("bytes_reservable_limit"))
+            if limit:
+                row["hbm_bytes_limit"] = int(limit)
+        cnt, nb = census.get(int(d.id), [0, 0.0])
+        row["live_buffers"] = int(cnt)
+        row["live_buffer_bytes"] = int(nb)
+        rows.append(row)
+    return rows
+
+
+def cache_entries(cache_dir: str) -> Optional[int]:
+    """Entry count of the persistent compile cache directory (None when
+    unset/absent) — counted before/after a compile, the delta tells a
+    cache hit (no new entries persisted) from a miss."""
+    if not cache_dir:
+        return None
+    try:
+        return sum(1 for _ in os.scandir(cache_dir))
+    except OSError:
+        return None
+
+
+def jax_version() -> str:
+    """Installed jax version WITHOUT importing jax (metadata read only
+    — the agent process must stay kernel-free)."""
+    try:
+        from importlib import metadata
+        return metadata.version("jax")
+    except Exception:
+        return "absent"
+
+
+def build_info(backend: str) -> Dict[str, str]:
+    return {"version": VERSION, "jax_version": jax_version(),
+            "backend": backend}
+
+
+def build_info_families(backend: str) -> List[Dict[str, Any]]:
+    """Standard Prometheus hygiene gauges, NOT gated on ``enabled()``:
+    ``consul_build_info`` (constant 1, identity in the labels) and
+    ``consul_up`` (a scrape that renders at all is up — the gauge
+    exists so absence alerts are writable)."""
+    return [
+        {"name": "consul_build_info",
+         "help": "Build identity; constant 1, identity in the labels.",
+         "rows": [(build_info(backend), 1.0)]},
+        {"name": "consul_up",
+         "help": "Agent liveness: 1 while the scrape endpoint serves.",
+         "rows": [({}, 1.0)]},
+    ]
+
+
+# -- the observatory ------------------------------------------------------
+
+class DevStats:
+    """Per-plane device/kernel observatory (module docstring).  All
+    writes happen on the plane's event loop; reads ship over the bridge
+    as the ``device`` frame."""
+
+    def __init__(self) -> None:
+        self.dispatch: Dict[str, LatencyHist] = {
+            cls: LatencyHist(
+                "consul_kernel_dispatch_ms",
+                "Host-monotonic jit dispatch latency by dispatch "
+                "class, milliseconds.")
+            for cls in DISPATCH_CLASSES}
+        self.rounds_per_sec_ewma = 0.0
+        self._ewma_last_t: Optional[float] = None
+        self.compile_wall_s: Dict[str, float] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+        # callable -> {"flops": f, "bytes_accessed": b} from a lowered
+        # cost_analysis(); estimates for ONE dispatch (steps rounds).
+        self.cost: Dict[str, Dict[str, float]] = {}
+        # Session geometry for the analytic roofline fallback and for
+        # normalizing per-dispatch cost estimates to per-round.
+        self._slots = 0
+        self._n = 0
+        self._steps_per_dispatch = 1
+        self._ndev = 1
+        # Device rows sampled on the plane's flight-drain cadence (the
+        # census walks every live array — too heavy per dispatch).
+        self._device_rows: List[Dict[str, Any]] = []
+        self._device_sampled_at = 0.0
+
+    # -- hot-path hooks (each guarded by one `is not None` at the call
+    # -- site; everything here is O(small)) -------------------------------
+
+    def note_dispatch(self, cls: str, ms: float, rounds: int,
+                      now: Optional[float] = None) -> None:
+        """One completed jit dispatch of ``rounds`` kernel rounds that
+        took ``ms`` host-monotonic milliseconds.  ``rounds > 0``
+        refreshes the rounds/s EWMA from the inter-dispatch wall time
+        (the plane idles between ticks, so in-dispatch rate would
+        overstate throughput)."""
+        h = self.dispatch.get(cls)
+        if h is None:
+            h = self.dispatch[cls] = LatencyHist(
+                "consul_kernel_dispatch_ms",
+                "Host-monotonic jit dispatch latency by dispatch "
+                "class, milliseconds.")
+        h.observe(ms)
+        if rounds <= 0:
+            return
+        t = time.monotonic() if now is None else now
+        if self._ewma_last_t is not None:
+            dt = t - self._ewma_last_t
+            if dt > 0:
+                inst = rounds / dt
+                if self.rounds_per_sec_ewma:
+                    self.rounds_per_sec_ewma += _EWMA_ALPHA * (
+                        inst - self.rounds_per_sec_ewma)
+                else:
+                    self.rounds_per_sec_ewma = inst
+        self._ewma_last_t = t
+
+    def note_drain(self, ms: float) -> None:
+        """A flight/hist drain's host transfer completed (rides the
+        ``drain`` dispatch class; no EWMA contribution)."""
+        self.note_dispatch("drain", ms, 0)
+
+    # -- compile / session bookkeeping (cold path) ------------------------
+
+    def set_session(self, slots: int, n: int, steps_per_dispatch: int,
+                    ndev: int = 1) -> None:
+        self._slots = int(slots)
+        self._n = int(n)
+        self._steps_per_dispatch = max(1, int(steps_per_dispatch))
+        self._ndev = max(1, int(ndev))
+
+    def note_compile(self, name: str, wall_s: float,
+                     cache_hit: Optional[bool] = None) -> None:
+        """A callable finished its warmup compile in ``wall_s`` seconds;
+        ``cache_hit`` is the persistent-cache verdict (None = the cache
+        dir could not be probed — neither counter moves)."""
+        self.compile_wall_s[name] = round(float(wall_s), 3)
+        if cache_hit is True:
+            self.cache_hits += 1
+        elif cache_hit is False:
+            self.cache_misses += 1
+
+    def note_cost(self, name: str, cost: Any,
+                  steps: Optional[int] = None) -> None:
+        """Record a lowered/compiled ``cost_analysis()`` estimate for
+        one dispatch of ``steps`` rounds.  jax returns a dict (Lowered)
+        or a one-element list of dicts (Compiled) with ``"flops"`` and
+        ``"bytes accessed"`` keys — both shapes accepted; anything else
+        is ignored (cost analysis is best-effort across backends)."""
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not isinstance(cost, dict):
+            return
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes accessed", cost.get("bytes_accessed"))
+        row: Dict[str, float] = {}
+        if flops is not None:
+            row["flops"] = float(flops)
+        if nbytes is not None:
+            row["bytes_accessed"] = float(nbytes)
+        if not row:
+            return
+        if steps:
+            row["steps"] = float(steps)
+        self.cost[name] = row
+
+    # -- device sampling (flight-drain cadence) ---------------------------
+
+    def sample_devices(self) -> None:
+        """Refresh the cached per-device rows (called by the plane on
+        the flight-drain cadence and before serving a device query)."""
+        self._device_rows = device_rows()
+        self._device_sampled_at = time.time()
+
+    # -- derived roofline -------------------------------------------------
+
+    def bytes_per_round(self) -> Tuple[Optional[float], str]:
+        """(bytes one round moves, provenance): the lowered
+        cost_analysis estimate when one landed (normalized per round),
+        else the §1c dense analytic from the session geometry."""
+        for row in self.cost.values():
+            b = row.get("bytes_accessed")
+            if b:
+                steps = row.get("steps") or self._steps_per_dispatch
+                return b / max(1.0, steps), "cost_analysis"
+        if self._slots and self._n:
+            return dense_bytes_per_round(self._slots, self._n), "dense"
+        return None, "unknown"
+
+    def roofline(self) -> Dict[str, Any]:
+        bpr, source = self.bytes_per_round()
+        util = roofline_utilization(bpr or 0.0, self.rounds_per_sec_ewma)
+        return {
+            "bytes_per_round": None if bpr is None else round(bpr, 1),
+            "bytes_source": source,
+            "rounds_per_sec_ewma": round(self.rounds_per_sec_ewma, 2),
+            "ceiling_gbps": EFFECTIVE_HBM_GBPS,
+            "utilization": None if util is None else round(util, 6),
+        }
+
+    # -- read side --------------------------------------------------------
+
+    def wire(self) -> Dict[str, Any]:
+        """JSON twin payload (/v1/agent/device body, minus the agent's
+        build row)."""
+        if not self._device_rows:
+            self.sample_devices()
+        return {
+            "dispatch": {cls: h.wire()
+                         for cls, h in self.dispatch.items()},
+            "rounds_per_sec_ewma": round(self.rounds_per_sec_ewma, 2),
+            "compile": {
+                "wall_s": dict(self.compile_wall_s),
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "cost": {k: dict(v) for k, v in self.cost.items()},
+            },
+            "roofline": self.roofline(),
+            "devices": list(self._device_rows),
+            "devices_sampled_at": self._device_sampled_at,
+        }
+
+    def prom_families(self) -> Tuple[List[Dict[str, Any]],
+                                     List[Dict[str, Any]],
+                                     List[Dict[str, Any]]]:
+        """(histograms, labeled_gauges, labeled_counters) for the
+        scrape.  Dispatch ladders are always emitted (zero-count
+        included) so dashboards see the full schema before traffic;
+        HBM gauges appear only on backends that report memory_stats."""
+        hists = []
+        disp_rows = []
+        for cls in sorted(self.dispatch):
+            fam = self.dispatch[cls].family()
+            fam["labels"] = {"class": cls}
+            hists.append(fam)
+            disp_rows.append(({"class": cls},
+                              float(self.dispatch[cls].count)))
+        gauges: List[Dict[str, Any]] = [{
+            "name": "consul_kernel_rounds_per_sec",
+            "help": "Kernel rounds per second, EWMA over dispatches.",
+            "rows": [({}, round(self.rounds_per_sec_ewma, 2))],
+        }]
+        if self.compile_wall_s:
+            gauges.append({
+                "name": "consul_kernel_compile_wall_seconds",
+                "help": "Warmup compile wall time per callable, "
+                        "seconds.",
+                "rows": [({"callable": k}, v) for k, v in
+                         sorted(self.compile_wall_s.items())]})
+        flop_rows = [({"callable": k}, v["flops"])
+                     for k, v in sorted(self.cost.items())
+                     if "flops" in v]
+        byte_rows = [({"callable": k}, v["bytes_accessed"])
+                     for k, v in sorted(self.cost.items())
+                     if "bytes_accessed" in v]
+        if flop_rows:
+            gauges.append({
+                "name": "consul_kernel_cost_flops",
+                "help": "Lowered cost_analysis FLOPs estimate per "
+                        "dispatch, by callable.",
+                "rows": flop_rows})
+        if byte_rows:
+            gauges.append({
+                "name": "consul_kernel_cost_bytes_accessed",
+                "help": "Lowered cost_analysis bytes-accessed estimate "
+                        "per dispatch, by callable.",
+                "rows": byte_rows})
+        util = self.roofline()["utilization"]
+        if util is not None:
+            gauges.append({
+                "name": "consul_kernel_roofline_utilization",
+                "help": "Achieved HBM traffic over the effective "
+                        "bandwidth ceiling (BENCH_NOTES §1c), 0..1.",
+                "rows": [({}, util)]})
+        hbm_use, hbm_lim, buf_cnt, buf_bytes = [], [], [], []
+        for row in self._device_rows:
+            labels = {"device": str(row["id"])}
+            if "hbm_bytes_in_use" in row:
+                hbm_use.append((labels, float(row["hbm_bytes_in_use"])))
+            if "hbm_bytes_limit" in row:
+                hbm_lim.append((labels, float(row["hbm_bytes_limit"])))
+            buf_cnt.append((labels, float(row["live_buffers"])))
+            buf_bytes.append((labels, float(row["live_buffer_bytes"])))
+        if hbm_use:
+            gauges.append({
+                "name": "consul_device_hbm_bytes_in_use",
+                "help": "Device memory in use (Device.memory_stats), "
+                        "bytes.",
+                "rows": hbm_use})
+        if hbm_lim:
+            gauges.append({
+                "name": "consul_device_hbm_bytes_limit",
+                "help": "Device memory limit (Device.memory_stats), "
+                        "bytes.",
+                "rows": hbm_lim})
+        if buf_cnt:
+            gauges.append({
+                "name": "consul_device_live_buffers",
+                "help": "Live jax arrays resident on the device.",
+                "rows": buf_cnt})
+            gauges.append({
+                "name": "consul_device_live_buffer_bytes",
+                "help": "Bytes of live jax arrays resident on the "
+                        "device.",
+                "rows": buf_bytes})
+        counters: List[Dict[str, Any]] = [
+            {"name": "consul_kernel_dispatches_total",
+             "help": "Jit dispatches by dispatch class.",
+             "rows": disp_rows},
+            {"name": "consul_kernel_compile_cache_hits_total",
+             "help": "Warmup compiles served from the persistent "
+                     "compilation cache.",
+             "rows": [({}, float(self.cache_hits))]},
+            {"name": "consul_kernel_compile_cache_misses_total",
+             "help": "Warmup compiles that compiled fresh (and "
+                     "persisted new cache entries).",
+             "rows": [({}, float(self.cache_misses))]},
+        ]
+        return hists, gauges, counters
+
+
+def stats_rows(wire: Dict[str, Any]) -> Dict[str, str]:
+    """String-valued rows for /v1/agent/self Stats (the ``consul
+    info`` convention), derived from a ``device`` frame payload —
+    pure dict math so the agent renders it without a kernel."""
+    if not wire or not wire.get("enabled"):
+        return {"enabled": "false"} if wire else {}
+    disp = wire.get("dispatch") or {}
+    comp = wire.get("compile") or {}
+    roof = wire.get("roofline") or {}
+    step = disp.get("round_step") or disp.get("sharded_round") or {}
+    return {
+        "enabled": "true",
+        "rounds_per_sec_ewma": str(wire.get("rounds_per_sec_ewma", 0)),
+        "dispatch_p50_ms": str(step.get("p50_ms")),
+        "dispatches": str(sum(int(d.get("count", 0) or 0)
+                              for d in disp.values())),
+        "compile_cache_hits": str(comp.get("cache_hits", 0)),
+        "compile_cache_misses": str(comp.get("cache_misses", 0)),
+        "roofline_utilization": str(roof.get("utilization")),
+        "devices": str(len(wire.get("devices") or [])),
+    }

@@ -486,6 +486,10 @@ class TestObservabilityBlackBox:
                     for p in followers]:
                 assert _require_ok(want, series, errors), \
                     f"scrape missing {want}"
+            # scrape hygiene gauges ride every agent's exposition
+            fam_names = {n for n, _ in series}
+            assert "consul_build_info" in fam_names
+            assert "consul_up" in fam_names
             # stats rows ride /v1/agent/self on every node
             stats = leader.http_get("/v1/agent/self")["Stats"]["raft"]
             assert "leadership_gained" in stats
@@ -501,11 +505,14 @@ class TestObservabilityBlackBox:
                 names = set(tar.getnames())
                 manifest = _json.load(tar.extractfile("manifest.json"))
                 assert {"metrics", "slo", "traces", "flight", "raft",
-                        "tasks"} <= set(manifest["sections"])
+                        "device", "tasks"} <= set(manifest["sections"])
                 assert manifest["node"] == leader.name
                 for want in ("metrics/prometheus.txt", "raft/telemetry.json",
-                             "tasks.txt", "config.json"):
+                             "device/telemetry.json", "tasks.txt",
+                             "config.json"):
                     assert want in names, names
+                dt = _json.load(tar.extractfile("device/telemetry.json"))
+                assert "enabled" in dt and "build" in dt
                 rt = _json.load(tar.extractfile("raft/telemetry.json"))
                 assert rt["raft"]["state"] == "Leader"
                 assert any(ev["kind"] == "leader-elected"

@@ -1,0 +1,361 @@
+"""Device/kernel observatory tests (obs/devstats.py).
+
+Unit coverage for the env gate, the dispatch-latency hists + rounds/s
+EWMA, compile/cache counters, cost_analysis ingestion (both jax return
+shapes), the shared roofline derivation, CPU degradation (no
+memory_stats -> the HBM gauges are absent, not zero), strict
+tools/check_prom validation of the rendered families, the
+/v1/agent/self stats rows, and the bundle manifest contract — plus
+slow live-plane legs for the enabled and compiled-out
+(CONSUL_TPU_DEV_OBS=0) postures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from consul_tpu.agent import bundle
+from consul_tpu.obs import devstats
+from consul_tpu.obs.devstats import DevStats
+from consul_tpu.obs.prom import render_prometheus
+from consul_tpu.version import VERSION
+from tools.check_prom import _iter_series, check_text
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+# -- env gate ---------------------------------------------------------------
+
+
+def test_enabled_default_and_off_values(monkeypatch):
+    monkeypatch.delenv("CONSUL_TPU_DEV_OBS", raising=False)
+    assert devstats.enabled()
+    for off in ("0", "false", "no", "FALSE", "No"):
+        monkeypatch.setenv("CONSUL_TPU_DEV_OBS", off)
+        assert not devstats.enabled()
+    for on in ("1", "true", "yes", ""):
+        monkeypatch.setenv("CONSUL_TPU_DEV_OBS", on)
+        assert devstats.enabled()
+
+
+def test_plane_carries_no_observatory_before_start():
+    """The hot-path contract: every hook guards on ``_dev is not None``
+    and a fresh (un-started) plane carries None."""
+    from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+    plane = GossipPlane(PlaneConfig(bind_port=0, capacity=8, slots=8))
+    assert plane._dev is None
+
+
+# -- dispatch hists + EWMA --------------------------------------------------
+
+
+def test_dispatch_hist_observe_and_family():
+    d = DevStats()
+    d.note_dispatch("round_step", 2.0, 4, now=1.0)
+    d.note_dispatch("round_step", 3.0, 4, now=2.0)
+    d.note_drain(0.4)
+    fam = d.dispatch["round_step"].family()
+    assert fam["name"] == "consul_kernel_dispatch_ms"
+    assert fam["count"] == 2
+    assert fam["sum"] == pytest.approx(5.0)
+    assert d.dispatch["drain"].count == 1
+    # all four classes exist from construction (full dashboard schema)
+    assert set(d.dispatch) == set(devstats.DISPATCH_CLASSES)
+
+
+def test_dispatch_unknown_class_autovivifies():
+    d = DevStats()
+    d.note_dispatch("pallas_fused", 1.0, 4, now=1.0)
+    assert d.dispatch["pallas_fused"].count == 1
+
+
+def test_ewma_from_inter_dispatch_wall_time():
+    d = DevStats()
+    # first dispatch: no prior timestamp -> no rate yet
+    d.note_dispatch("round_step", 1.0, 4, now=10.0)
+    assert d.rounds_per_sec_ewma == 0.0
+    # 4 rounds in 0.1s -> 40 rounds/s seeds the EWMA exactly
+    d.note_dispatch("round_step", 1.0, 4, now=10.1)
+    assert d.rounds_per_sec_ewma == pytest.approx(40.0)
+    # a slower sample moves it toward 20 by alpha
+    d.note_dispatch("round_step", 1.0, 4, now=10.3)
+    assert d.rounds_per_sec_ewma == pytest.approx(40.0 + 0.2 * (20.0 - 40.0))
+
+
+def test_drain_contributes_no_ewma():
+    d = DevStats()
+    d.note_dispatch("round_step", 1.0, 4, now=1.0)
+    d.note_dispatch("round_step", 1.0, 4, now=1.1)
+    before = d.rounds_per_sec_ewma
+    d.note_drain(5.0)
+    assert d.rounds_per_sec_ewma == before
+
+
+# -- compile telemetry ------------------------------------------------------
+
+
+def test_compile_counters_and_wall_times():
+    d = DevStats()
+    d.note_compile("plane_dispatch", 1.5, cache_hit=False)
+    d.note_compile("event_dispatch", 0.2, cache_hit=True)
+    d.note_compile("unknown_cache", 0.1, cache_hit=None)
+    assert d.cache_hits == 1 and d.cache_misses == 1
+    assert d.compile_wall_s == {"plane_dispatch": 1.5,
+                                "event_dispatch": 0.2,
+                                "unknown_cache": 0.1}
+
+
+def test_cache_entries_counts_and_degrades(tmp_path):
+    assert devstats.cache_entries("") is None
+    assert devstats.cache_entries(str(tmp_path / "missing")) is None
+    d = tmp_path / "cache"
+    d.mkdir()
+    assert devstats.cache_entries(str(d)) == 0
+    (d / "a").write_text("x")
+    (d / "b").write_text("y")
+    assert devstats.cache_entries(str(d)) == 2
+
+
+def test_note_cost_accepts_both_jax_shapes():
+    d = DevStats()
+    # Lowered.cost_analysis() -> dict with "bytes accessed" (space!)
+    d.note_cost("lowered", {"flops": 1e6, "bytes accessed": 5e6}, steps=4)
+    assert d.cost["lowered"] == {"flops": 1e6, "bytes_accessed": 5e6,
+                                 "steps": 4.0}
+    # Compiled.cost_analysis() -> one-element list of dicts
+    d.note_cost("compiled", [{"flops": 2.0, "bytes_accessed": 8.0}])
+    assert d.cost["compiled"] == {"flops": 2.0, "bytes_accessed": 8.0}
+    # garbage shapes are ignored, never raise (best-effort contract)
+    d.note_cost("junk", None)
+    d.note_cost("junk", "nope")
+    d.note_cost("junk", [])
+    d.note_cost("junk", {"neither": 1})
+    assert "junk" not in d.cost
+
+
+# -- roofline derivation ----------------------------------------------------
+
+
+def test_roofline_utilization_math():
+    # 1 GB/round at 92.5 rounds/s = 92.5 GB/s over 185 GB/s = 0.5
+    util = devstats.roofline_utilization(1e9, 92.5)
+    assert util == pytest.approx(0.5)
+    assert devstats.roofline_utilization(0.0, 10.0) is None
+    assert devstats.roofline_utilization(1e9, 0.0) is None
+    assert devstats.roofline_utilization(1e9, 10.0, ceiling_gbps=0) is None
+
+
+def test_dense_bytes_per_round_matches_section_1c():
+    assert devstats.dense_bytes_per_round(64, 1_000_000) == pytest.approx(
+        devstats.DENSE_PASSES_PER_ROUND * 64 * 1_000_000)
+
+
+def test_bytes_per_round_prefers_cost_analysis_over_analytic():
+    d = DevStats()
+    assert d.bytes_per_round() == (None, "unknown")
+    d.set_session(slots=64, n=1000, steps_per_dispatch=4)
+    bpr, src = d.bytes_per_round()
+    assert src == "dense"
+    assert bpr == pytest.approx(devstats.dense_bytes_per_round(64, 1000))
+    # a lowered estimate for a 4-round dispatch refines it, per-round
+    d.note_cost("plane_dispatch", {"bytes accessed": 4e6}, steps=4)
+    bpr, src = d.bytes_per_round()
+    assert src == "cost_analysis"
+    assert bpr == pytest.approx(1e6)
+
+
+def test_roofline_gauge_wire_shape():
+    d = DevStats()
+    d.set_session(slots=64, n=1000, steps_per_dispatch=4)
+    d.note_dispatch("round_step", 1.0, 4, now=1.0)
+    d.note_dispatch("round_step", 1.0, 4, now=1.1)
+    roof = d.roofline()
+    assert roof["ceiling_gbps"] == devstats.EFFECTIVE_HBM_GBPS
+    assert roof["bytes_source"] == "dense"
+    # the wire value is rounded to 6 decimals
+    assert roof["utilization"] == pytest.approx(
+        devstats.dense_bytes_per_round(64, 1000) * roof["rounds_per_sec_ewma"]
+        / (devstats.EFFECTIVE_HBM_GBPS * 1e9), abs=1e-6)
+
+
+# -- device telemetry (CPU degradation) -------------------------------------
+
+
+def test_device_rows_cpu_has_census_but_no_hbm():
+    rows = devstats.device_rows()
+    assert rows, "jax is available in the test env"
+    for row in rows:
+        assert isinstance(row["id"], int)
+        assert row["platform"] == "cpu"
+        # CPU memory_stats() is None -> HBM keys ABSENT, not zero
+        assert "hbm_bytes_in_use" not in row
+        assert "hbm_bytes_limit" not in row
+        assert isinstance(row["live_buffers"], int)
+        assert isinstance(row["live_buffer_bytes"], int)
+
+
+def test_sample_devices_caches_rows():
+    d = DevStats()
+    assert d._device_rows == []
+    d.sample_devices()
+    assert d._device_rows and d._device_sampled_at > 0
+
+
+# -- exposition -------------------------------------------------------------
+
+
+def _populated() -> DevStats:
+    d = DevStats()
+    d.set_session(slots=64, n=1000, steps_per_dispatch=4)
+    d.note_compile("plane_dispatch", 1.2, cache_hit=False)
+    d.note_cost("plane_dispatch", {"flops": 1e6, "bytes accessed": 4e6},
+                steps=4)
+    d.note_dispatch("round_step", 2.0, 4, now=1.0)
+    d.note_dispatch("round_step", 2.5, 4, now=1.1)
+    d.note_drain(0.3)
+    d.sample_devices()
+    return d
+
+
+def test_prom_families_render_strict_clean():
+    hists, gauges, counters = _populated().prom_families()
+    text = render_prometheus(
+        [], histograms=hists,
+        labeled_gauges=gauges + devstats.build_info_families("tpu"),
+        labeled_counters=counters)
+    assert check_text(text) == [], check_text(text)
+    names = {n for n, _ in _iter_series(text)}
+    for want in ("consul_kernel_dispatch_ms_bucket",
+                 "consul_kernel_rounds_per_sec",
+                 "consul_kernel_compile_wall_seconds",
+                 "consul_kernel_cost_bytes_accessed",
+                 "consul_kernel_roofline_utilization",
+                 "consul_kernel_dispatches_total",
+                 "consul_kernel_compile_cache_hits_total",
+                 "consul_kernel_compile_cache_misses_total",
+                 "consul_device_live_buffers",
+                 "consul_build_info", "consul_up"):
+        assert want in names, f"missing {want}"
+    # CPU: the HBM families must be absent, not zero-valued
+    assert "consul_device_hbm_bytes_in_use" not in names
+
+
+def test_dispatch_ladders_render_all_classes_before_traffic():
+    hists, _, counters = DevStats().prom_families()
+    assert len(hists) == len(devstats.DISPATCH_CLASSES)
+    disp = next(c for c in counters
+                if c["name"] == "consul_kernel_dispatches_total")
+    assert {lbl["class"] for lbl, _ in disp["rows"]} == set(
+        devstats.DISPATCH_CLASSES)
+
+
+# -- /v1/agent/self rows + build info ---------------------------------------
+
+
+def test_stats_rows_from_wire():
+    d = _populated()
+    wire = d.wire()
+    wire["enabled"] = True
+    rows = devstats.stats_rows(wire)
+    assert rows["enabled"] == "true"
+    assert rows["dispatches"] == "3"
+    assert rows["compile_cache_misses"] == "1"
+    assert float(rows["rounds_per_sec_ewma"]) > 0
+    # disabled plane -> single row; no frame at all -> no rows
+    assert devstats.stats_rows({"enabled": False}) == {"enabled": "false"}
+    assert devstats.stats_rows({}) == {}
+
+
+def test_build_info_contents():
+    bi = devstats.build_info("tpu")
+    assert bi["version"] == VERSION
+    assert bi["backend"] == "tpu"
+    assert bi["jax_version"] not in ("", None)
+    fams = devstats.build_info_families("tpu")
+    assert [f["name"] for f in fams] == ["consul_build_info", "consul_up"]
+    assert fams[0]["rows"][0] == (bi, 1.0)
+    assert fams[1]["rows"][0] == ({}, 1.0)
+
+
+def test_bundle_sections_carry_device():
+    assert "device" in bundle.SECTIONS
+
+
+# -- live plane legs (kernel compile; slow tier) ----------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_live_plane_observatory_enabled(loop):
+    """A started plane carries a populated observatory: compile wall
+    times from warmup, dispatch hists after a few ticks, and a
+    check_prom-clean ``device`` frame."""
+    from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+
+    async def body():
+        plane = GossipPlane(PlaneConfig(
+            bind_port=0, capacity=8, slots=8, gossip_interval_s=0.02,
+            suspicion_mult=1.0, hb_lapse_s=0.3))
+        await plane.start()
+        try:
+            assert plane._dev is not None
+            assert "plane_dispatch" in plane._dev.compile_wall_s
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while (plane._dev.dispatch["round_step"].count == 0
+                   and asyncio.get_event_loop().time() < deadline):
+                await asyncio.sleep(0.05)
+            wire = plane._device_wire()
+            assert wire["enabled"] is True
+            assert wire["dispatch"]["round_step"]["count"] > 0
+            fams = wire["families"]
+            text = render_prometheus(
+                [], histograms=fams["histograms"],
+                labeled_gauges=fams["gauges"],
+                labeled_counters=fams["counters"])
+            assert check_text(text) == [], check_text(text)
+        finally:
+            await plane.stop()
+    loop.run_until_complete(body())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_live_plane_compiled_out(loop, monkeypatch):
+    """CONSUL_TPU_DEV_OBS=0: the plane starts and runs with _dev None
+    (every hook reduced to one attribute test) and the device frame
+    reports enabled=false with no telemetry keys."""
+    monkeypatch.setenv("CONSUL_TPU_DEV_OBS", "0")
+    from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+
+    async def body():
+        plane = GossipPlane(PlaneConfig(
+            bind_port=0, capacity=8, slots=8, gossip_interval_s=0.02,
+            suspicion_mult=1.0, hb_lapse_s=0.3))
+        await plane.start()
+        try:
+            assert plane._dev is None
+            await asyncio.sleep(0.3)  # dispatches run with hooks off
+            wire = plane._device_wire()
+            assert wire["enabled"] is False
+            assert "dispatch" not in wire
+        finally:
+            await plane.stop()
+    loop.run_until_complete(body())
+
+
+def test_devstats_module_never_imports_jax_at_module_level():
+    """The agent process renders device payloads without a kernel: the
+    module source must keep jax imports inside functions."""
+    import inspect
+    src = inspect.getsource(devstats)
+    for line in src.splitlines():
+        # only column-0 imports are module-level; the lazy in-function
+        # `import jax` in device_rows() is the sanctioned exception
+        assert not line.startswith(("import jax", "from jax")), line

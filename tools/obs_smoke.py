@@ -8,7 +8,11 @@ The first boot also runs the consensus-plane deep phase: KV traffic
 through raft, a ``?consistent`` lease-path read, the
 ``/v1/operator/raft/telemetry`` route, and a ``/v1/agent/debug/bundle``
 capture which is untarred in memory and held to the manifest contract
-(check_prom runs on the bundled metrics snapshot too).
+(check_prom runs on the bundled metrics snapshot too).  The same boot
+checks the device/kernel observatory (obs/devstats.py): the
+``consul_device_*``/``consul_kernel_*`` families plus
+``consul_build_info``/``consul_up`` in the scrape, the
+``/v1/agent/device`` JSON twin, and the bundle's ``device/`` member.
 
 A second boot runs the plane under a live nemesis scenario
 (``PlaneConfig(nemesis="block_kill")``, gossip/nemesis.py) and holds
@@ -46,6 +50,20 @@ REQUIRED = [
     "consul_flight_round",
 ]
 
+# Device/kernel observatory families (obs/devstats.py) + scrape hygiene.
+# The HBM gauges are deliberately NOT here: CPU reports no
+# memory_stats, so on this smoke they are absent by design.
+REQUIRED_DEVICE = [
+    "consul_kernel_dispatch_ms_bucket",
+    "consul_kernel_rounds_per_sec",
+    "consul_kernel_dispatches_total",
+    "consul_kernel_compile_cache_hits_total",
+    "consul_kernel_compile_cache_misses_total",
+    "consul_device_live_buffers",
+    "consul_build_info",
+    "consul_up",
+]
+
 NEMESIS = "block_kill"  # scenario the second boot runs live
 
 # Consensus-plane families the deep phase must surface on a
@@ -61,7 +79,8 @@ REQUIRED_RAFT = [
 ]
 
 # Bundle manifest sections the acceptance contract names.
-REQUIRED_SECTIONS = {"metrics", "slo", "traces", "flight", "raft", "tasks"}
+REQUIRED_SECTIONS = {"metrics", "slo", "traces", "flight", "raft",
+                     "device", "tasks"}
 
 
 def _get(url: str) -> bytes:
@@ -125,7 +144,9 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
             _get, f"{base}/v1/agent/metrics?format=prometheus")).decode()
         slo = json.loads(await asyncio.to_thread(
             _get, f"{base}/v1/agent/slo"))
-        return text, slo, telemetry, bundle
+        device = json.loads(await asyncio.to_thread(
+            _get, f"{base}/v1/agent/device"))
+        return text, slo, telemetry, bundle, device
     finally:
         if agent is not None:
             await agent.stop()
@@ -158,8 +179,8 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
             errors.append(f"bundle manifest missing sections {sorted(missing)}")
         for want in ("metrics/prometheus.txt", "metrics/snapshot_start.json",
                      "metrics/snapshot_end.json", "raft/telemetry.json",
-                     "tasks.txt", "config.json", "slo.json", "traces.json",
-                     "flight.json"):
+                     "device/telemetry.json", "tasks.txt", "config.json",
+                     "slo.json", "traces.json", "flight.json"):
             if want not in names:
                 errors.append(f"bundle missing file {want}")
         if "metrics/prometheus.txt" in names:
@@ -169,6 +190,10 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
             rt = json.load(tar.extractfile("raft/telemetry.json"))
             if "timeline" not in rt:
                 errors.append("bundled raft telemetry has no timeline")
+        if "device/telemetry.json" in names:
+            dt = json.load(tar.extractfile("device/telemetry.json"))
+            if "enabled" not in dt:
+                errors.append("bundled device telemetry has no 'enabled'")
         if "config.json" in names:
             cfg = json.load(tar.extractfile("config.json"))
             for k in ("encrypt", "acl_master_token", "acl_token"):
@@ -183,13 +208,24 @@ async def main() -> int:
 
     print("[obs-smoke] starting plane (first boot compiles the kernel)...",
           flush=True)
-    text, slo, telemetry, bundle = await _boot_and_scrape(deep=True)
+    text, slo, telemetry, bundle, device = await _boot_and_scrape(deep=True)
     errors += check_text(text)
     series = list(_iter_series(text))
     names = {n for n, _ in series}
-    for want in REQUIRED + REQUIRED_RAFT:
+    for want in REQUIRED + REQUIRED_RAFT + REQUIRED_DEVICE:
         if want not in names:
             errors.append(f"required metric {want} not in scrape")
+    # Device observatory JSON twin: the bridge `device` frame rendered
+    # at /v1/agent/device, plus the agent's build row.
+    if not device.get("enabled"):
+        errors.append(f"/v1/agent/device enabled = {device.get('enabled')!r}")
+    for key in ("dispatch", "roofline", "devices", "compile", "build"):
+        if key not in device:
+            errors.append(f"/v1/agent/device missing key {key!r}")
+    build = device.get("build") or {}
+    for key in ("version", "jax_version", "backend"):
+        if not build.get(key):
+            errors.append(f"/v1/agent/device build missing {key!r}")
     # Lease efficacy split: the deep phase's ?consistent read on a
     # lease-holding single-node leader must land on the lease row.
     if not _require_ok('consul_consistent_reads_total{path="lease"}',
@@ -222,7 +258,7 @@ async def main() -> int:
     # detection fires.
     print(f"[obs-smoke] rebooting plane under nemesis={NEMESIS!r} "
           "(new static schedule recompiles)...", flush=True)
-    ntext, nslo, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
+    ntext, nslo, _, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
     nerrors = check_text(ntext)
     for fam in REQUIRED[:4]:
         want = fam + f'{{scenario="{NEMESIS}"}}'

@@ -389,6 +389,19 @@ def main():
     for k, v in sorted(results.items(), key=lambda kv: -kv[1]):
         print(f"{k:32s} {v * 1e3:9.2f} ms", flush=True)
 
+    # Roofline utilization from the honest amortized number — the SAME
+    # derivation (obs/devstats.py) the live agent exports as
+    # consul_kernel_roofline_utilization and bench.py persists, so all
+    # three profiling paths agree on one figure instead of §1c prose.
+    from consul_tpu.obs.devstats import (
+        EFFECTIVE_HBM_GBPS, dense_bytes_per_round, roofline_utilization)
+    util = roofline_utilization(dense_bytes_per_round(S, n),
+                                1.0 / results["round_amortized_64"])
+    if util is not None:
+        print(f"\nroofline_utilization {util:.4f} "
+              f"(dense {dense_bytes_per_round(S, n) / 1e6:.1f} MB/round "
+              f"@ {EFFECTIVE_HBM_GBPS:.0f} GB/s ceiling)", flush=True)
+
 
 if __name__ == "__main__":
     main()
