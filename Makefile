@@ -17,7 +17,7 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet vet-fast obs-smoke bench bench-serve dryrun clean
+.PHONY: default ci test integ vet vet-fast obs-smoke bench bench-serve bench-watch dryrun clean
 
 default: test
 
@@ -46,6 +46,7 @@ VET_PATHS = consul_tpu tests tools demo bench.py __graft_entry__.py
 vet:
 	$(PYTHON) -m compileall -q $(VET_PATHS)
 	$(PYTHON) -m tools.vet $(VET_PATHS) --report vet_report.json
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.store_crossval --fast
 	$(MAKE) obs-smoke
 
 vet-fast:
@@ -69,6 +70,12 @@ bench:
 bench-serve:
 	$(PYTHON) tools/bench_serve.py --requests 8000 --concurrency 32 \
 	  --workers 1,4
+
+# Watch-matching storm (CPU-only): device matcher vs host radix walk
+# A/B over correlated invalidation bursts at >=10^4 standing watches;
+# medians-of-3 land in BENCH_WATCH.json (BENCH_NOTES.md section 12).
+bench-watch:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.watchstorm --watches 10000
 
 # Multi-chip sharding dry-run on the 8-device virtual CPU mesh —
 # exactly what the driver validates.

@@ -116,6 +116,10 @@ class AgentConfig:
     # processes that run hot ops over the IPC gateway and proxy the
     # rest (agent/workers.py).  Ignored for unix-socket HTTP listeners.
     http_workers: int = 1
+    # Device-resident state store (server mode only, PR 11): batched
+    # FSM apply + device-side watch matching, host authoritative.
+    device_store: bool = False
+    device_store_capacity: int = 1 << 16
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -143,7 +147,16 @@ class Agent:
                 acl_default_policy=self.config.acl_default_policy,
                 acl_down_policy=self.config.acl_down_policy,
                 acl_master_token=self.config.acl_master_token,
+                device_store=self.config.device_store,
+                device_store_capacity=self.config.device_store_capacity,
             ))
+            if self.config.device_store:
+                bridge = self.server.fsm.device
+                if bridge is not None:
+                    # Device watch verdicts invalidate + refresh the KV
+                    # byte cache (hotpath.py) right at the batch boundary.
+                    from consul_tpu.agent import hotpath
+                    hotpath.attach_kv_cache(self.server, bridge)
         else:
             # Client mode: no Raft, no store — LAN gossip + RPC
             # forwarding with last-server affinity (consul.NewClient,
@@ -1067,6 +1080,18 @@ class Agent:
         ae_hists, ae_counters = raftstats.aestats.families()
         hists += ae_hists
         labeled_counters += ae_counters
+        # Device state-store observatory (obs/storestats.py): apply/match
+        # dispatch ladders, batch shape, table health.  Present only when
+        # device_store is on AND the CONSUL_TPU_DEV_OBS gate left the
+        # bridge with a StoreStats.
+        fsm = getattr(self.server, "fsm", None)
+        bridge = getattr(fsm, "device", None) if fsm is not None else None
+        if bridge is not None and bridge.stats is not None:
+            s_hists, s_gauges, s_counters = bridge.stats.families(
+                occupancy=bridge.occupancy(), capacity=bridge.capacity)
+            hists += s_hists
+            labeled_gauges += s_gauges
+            labeled_counters += s_counters
         # Device/kernel observatory: dispatch hists, HBM gauges, compile
         # counters pulled over the bridge (absent when CONSUL_TPU_DEV_OBS=0
         # or for backends without a kernel plane).

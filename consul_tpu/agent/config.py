@@ -86,6 +86,10 @@ class Config:
     # total HTTP serving processes on the public TCP port (1 = the
     # agent alone; N > 1 adds N-1 SO_REUSEPORT workers, agent/workers.py)
     http_workers: int = 1
+    # device-resident state store (server mode, state/device_store.py):
+    # batched FSM apply + device-side watch matching
+    device_store: bool = False
+    device_store_capacity: int = 1 << 16
 
     # clustering
     start_join: List[str] = field(default_factory=list)
@@ -325,6 +329,12 @@ def validate_config(cfg: Config) -> List[str]:
                         "(the plane daemon's address)")
     if int(cfg.http_workers) < 1:
         problems.append(f"http_workers must be >= 1, got {cfg.http_workers}")
+    if cfg.device_store and not cfg.server:
+        problems.append("device_store requires server mode")
+    cap = int(cfg.device_store_capacity)
+    if cfg.device_store and (cap < 64 or cap & (cap - 1)):
+        problems.append("device_store_capacity must be a power of two "
+                        f">= 64, got {cfg.device_store_capacity}")
     if cfg.acl_datacenter and cfg.acl_default_policy not in ("allow", "deny"):
         problems.append(f"Invalid ACL default policy: {cfg.acl_default_policy}")
     if cfg.acl_datacenter and cfg.acl_down_policy not in (
@@ -406,4 +416,6 @@ def to_agent_config(cfg: Config):
         gossip_plane=cfg.gossip_plane,
         enable_debug=cfg.enable_debug,
         http_workers=int(cfg.http_workers),
+        device_store=cfg.device_store,
+        device_store_capacity=int(cfg.device_store_capacity),
     )

@@ -69,6 +69,78 @@ def _dir_entry_obj(ent: DirEntry) -> Dict[str, Any]:
     }
 
 
+# -- device-store KV byte cache (PR 11) -------------------------------------
+
+_KV_CACHE_MAX = 1024
+
+
+class KVByteCache:
+    """Rendered-bytes cache for hot KV GETs, wired up when the device
+    state store is on (state/device_store.py).
+
+    Validity is the KV table index: a row rendered at store index I is
+    served only while ``store.last_index(kvs, tombstones)`` is still I,
+    so ANY kv/tombstone mutation is an implicit full invalidation — the
+    cache can never serve stale bytes, batched or not.  The device
+    bridge's ``render_hook`` re-renders the keys a committed batch
+    touched (only those already cached), so hot keys are warm again at
+    the new index before the woken blocking queries re-read them.
+
+    X-Consul-* headers are rebuilt per hit (leader-contact is live);
+    only the status/content-type/body triple is cached.
+    """
+
+    __slots__ = ("srv", "max_entries", "entries", "hits", "misses")
+
+    def __init__(self, srv, max_entries: int = _KV_CACHE_MAX) -> None:
+        self.srv = srv
+        self.max_entries = max_entries
+        # key -> (valid_at_index, status, ctype, body, header_index)
+        self.entries: Dict[str, Tuple[int, int, str, bytes, int]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def _store_index(self) -> int:
+        return self.srv.store.last_index("kvs", "tombstones")
+
+    def lookup(self, key: str) -> Optional[Tuple[int, int, str, bytes, int]]:
+        row = self.entries.get(key)
+        if row is None or row[0] != self._store_index():
+            self.misses += 1
+            return None
+        self.hits += 1
+        return row
+
+    def render(self, key: str) -> Tuple[int, int, str, bytes, int]:
+        """Render one key through the store and remember the bytes."""
+        idx, ent = self.srv.store.kvs_get(key)
+        if ent is None:
+            row = (idx, 404, "text/plain", b"", idx)
+        else:
+            row = (idx, 200, _JSON, _dumps([_dir_entry_obj(ent)]),
+                   ent.modify_index)
+        if key not in self.entries and len(self.entries) >= self.max_entries:
+            self.entries.pop(next(iter(self.entries)))  # FIFO bound
+        self.entries[key] = row
+        return row
+
+    def refresh(self, keys) -> None:
+        """Device-bridge render hook: after a committed batch, re-render
+        the touched keys that serving has already asked for."""
+        for k in keys:
+            if k in self.entries:
+                self.render(k)
+
+
+def attach_kv_cache(srv, bridge, max_entries: int = _KV_CACHE_MAX):
+    """Hang a KVByteCache off the server and point the device bridge's
+    render hook at it (called by Agent when device_store is on)."""
+    cache = KVByteCache(srv, max_entries)
+    srv.kv_byte_cache = cache
+    bridge.render_hook = cache.refresh
+    return cache
+
+
 # -- hot operations ---------------------------------------------------------
 
 async def kv_get(srv, key: str, *, stale: bool = False,
@@ -94,6 +166,13 @@ async def kv_get(srv, key: str, *, stale: bool = False,
         acl = await srv.resolve_token(token)
         if acl is not None and not acl.key_read(key):
             raise PermissionError("Permission denied")
+    cache = getattr(srv, "kv_byte_cache", None)
+    if cache is not None and not raw:
+        # Index-validated rendered bytes (device store path); safe after
+        # the ACL check above, self-invalidating on any kv write.
+        row = cache.lookup(key) or cache.render(key)
+        _vidx, status, ctype, body, hidx = row
+        return status, _index_headers(srv, hidx), ctype, body
     idx, ent = srv.store.kvs_get(key)
     index = ent.modify_index if ent is not None else idx
     hdrs = _index_headers(srv, index)

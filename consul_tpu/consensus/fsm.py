@@ -73,6 +73,10 @@ class ConsulFSM:
         # (fsm.go:275-363), so the backend must be recreatable.
         self._kv_backend_factory = kv_backend_factory
         self.store = StateStore(gc_hint=gc_hint, kv_backend=self._new_backend())
+        # Optional device twin (state/device_store.DeviceStoreBridge):
+        # when attached, apply_batch ships each committed batch to the
+        # device as one scatter + one watch-match dispatch.
+        self.device: Optional[Any] = None
         self._handlers: Dict[int, Callable[[int, bytes], Any]] = {
             MessageType.REGISTER: self._apply_register,
             MessageType.DEREGISTER: self._apply_deregister,
@@ -109,6 +113,52 @@ class ConsulFSM:
         finally:
             obs_trace.finish_span(span)
             metrics.measure_since(_FSM_METRIC_KEYS[msg_type & ~IGNORE_UNKNOWN_FLAG], t0)
+
+    def attach_device_store(self, bridge: Any) -> None:
+        """Attach the device twin and seed it from the current store
+        (PR 11). Idempotent; restore() re-seeds automatically."""
+        self.device = bridge
+        bridge.rebuild_from_store(self.store)
+
+    def _apply_one(self, index: int, data: bytes, ctx: Any) -> Any:
+        """One entry with its submitter's trace context re-activated
+        (moved from raft._apply_committed so batched and single apply
+        share the span/metric/error contract). FSM errors are returned,
+        not raised — raftApply surfaces them to the caller."""
+        token = obs_trace.set_context(ctx) if ctx is not None else None
+        try:
+            return self.apply(index, data)
+        except Exception as exc:
+            return exc
+        finally:
+            if token is not None:
+                obs_trace.reset_context(token)
+
+    def apply_batch(self, entries) -> list:
+        """Apply a contiguous run of committed entries — the commit→
+        apply boundary batching hook (consensus/raft.py collects the
+        runs; obs/raftstats.py already instruments the boundary).
+
+        Without a device twin this is exactly the sequential loop
+        (identical notify ordering, zero added work). With one, the
+        whole run applies inside a ``store.capture_apply()`` scope:
+        watch firing is deferred, the bridge ships the batch as one
+        device scatter + one watch-match dispatch, cross-checks the
+        verdicts, and fires the NotifyGroups. A bridge failure degrades
+        to the host flush path — serving never depends on the device.
+        """
+        if self.device is None:
+            return [self._apply_one(i, d, c) for i, d, c in entries]
+        results = []
+        with self.store.capture_apply() as cap:
+            for index, data, ctx in entries:
+                results.append(self._apply_one(index, data, ctx))
+            try:
+                self.device.on_batch(cap, self.store)
+            except Exception:
+                # cap stays unconsumed → scope exit host-fires it.
+                metrics.incr_counter(("consul", "fsm", "device_batch_error"))
+        return results
 
     def _apply_register(self, index: int, payload: bytes) -> Any:
         req = codec.decode_payload(payload, RegisterRequest)
@@ -224,4 +274,7 @@ class ConsulFSM:
                 self.store.acl_restore(ACL.from_wire(wire))
             else:
                 raise ValueError(f"unrecognized snapshot record kind {kind!r}")
+        if self.device is not None:
+            # The restore built a FRESH store — the device table follows.
+            self.device.rebuild_from_store(self.store)
         return last_index

@@ -767,36 +767,61 @@ class RaftNode:
 
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
+            # Batch the contiguous LOG_COMMAND run ending at the commit
+            # point into ONE fsm.apply_batch call (PR 11): with a device
+            # store attached that run becomes a single device scatter +
+            # watch-match dispatch; without one it is the same
+            # sequential loop as before. Trace contexts ride per-entry
+            # (fsm._apply_one re-activates each submitter's span).
+            run: list = []
+            while self.last_applied + len(run) < self.commit_index:
+                j = self.last_applied + len(run) + 1
+                ej = self.log.get(j)
+                if ej is None or ej.type != LOG_COMMAND:
+                    break
+                run.append((ej.index, ej.data, self._trace_ctx.pop(j, None)))
+            if run:
+                results = self._apply_run(run)
+                for (idx, _, _), result in zip(run, results):
+                    self.last_applied = idx
+                    fut = self._pending.pop(idx, None)
+                    if fut is not None and not fut.done():
+                        if isinstance(result, Exception):
+                            fut.set_exception(result)
+                        else:
+                            fut.set_result(result)
+                continue
             i = self.last_applied + 1
             e = self.log.get(i)
-            if e is None:  # compacted under us — snapshot already covers it
-                self.last_applied = i
-                continue
-            result: Any = None
-            if e.type == LOG_COMMAND:
-                # Re-activate the submitting request's trace context (if
-                # any) so fsm.apply's span lands in the right trace even
-                # though we're running in the durability-pump task.
-                ctx = self._trace_ctx.pop(i, None)
-                token = obs_trace.set_context(ctx) if ctx is not None \
-                    else None
-                try:
-                    result = self.fsm.apply(e.index, e.data)
-                except Exception as exc:  # FSM errors surface to the caller
-                    result = exc
-                finally:
-                    if token is not None:
-                        obs_trace.reset_context(token)
             self.last_applied = i
+            if e is None:  # compacted under us — snapshot already covers it
+                continue
+            # Non-command entry (noop/configuration): resolve its future.
             fut = self._pending.pop(i, None)
             if fut is not None and not fut.done():
-                if isinstance(result, Exception):
-                    fut.set_exception(result)
-                else:
-                    fut.set_result(result)
+                fut.set_result(None)
         if self.obs is not None:
             self.obs.note_applied(self.last_applied)
         self._maybe_snapshot()
+
+    def _apply_run(self, run: list) -> list:
+        """One contiguous LOG_COMMAND run → per-entry results. FSMs
+        without an apply_batch hook (duck-typed test FSMs) get the
+        pre-PR-11 sequential loop."""
+        apply_batch = getattr(self.fsm, "apply_batch", None)
+        if apply_batch is not None:
+            return apply_batch(run)
+        results = []
+        for idx, data, ctx in run:
+            token = obs_trace.set_context(ctx) if ctx is not None else None
+            try:
+                results.append(self.fsm.apply(idx, data))
+            except Exception as exc:  # FSM errors surface to the caller
+                results.append(exc)
+            finally:
+                if token is not None:
+                    obs_trace.reset_context(token)
+        return results
 
     def _apply_configuration(self, e: LogEntry) -> None:
         """Peer-set changes take effect as soon as they're appended

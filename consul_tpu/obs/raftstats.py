@@ -71,19 +71,23 @@ class LatencyHist:
     drain — tests/test_raft_obs.py holds this to the same bar).
     """
 
-    __slots__ = ("name", "help", "_counts", "_sum", "_count")
+    __slots__ = ("name", "help", "edges", "_counts", "_sum", "_count")
 
-    def __init__(self, name: str, help_text: str) -> None:
+    def __init__(self, name: str, help_text: str,
+                 edges: Optional[Tuple[float, ...]] = None) -> None:
         self.name = name
         self.help = help_text
-        self._counts = [0] * len(MS_EDGES)
+        # Custom edges let non-latency distributions (e.g. apply-batch
+        # entry counts, PR 11) reuse the same bank/render machinery.
+        self.edges = MS_EDGES if edges is None else tuple(edges)
+        self._counts = [0] * len(self.edges)
         self._sum = 0.0
         self._count = 0
 
     def observe(self, ms: float, n: int = 1) -> None:
         self._count += n
         self._sum += ms * n
-        i = bisect_left(MS_EDGES, ms)
+        i = bisect_left(self.edges, ms)
         if i < len(self._counts):
             self._counts[i] += n
         # else: overflow — counted only by the +Inf bucket (count)
@@ -96,7 +100,7 @@ class LatencyHist:
         """obs/prom.py ``histograms=`` family shape."""
         cum = 0
         buckets = []
-        for edge, c in zip(MS_EDGES, self._counts):
+        for edge, c in zip(self.edges, self._counts):
             cum += c
             buckets.append((_le(edge), cum))
         return {"name": self.name, "help": self.help, "buckets": buckets,
@@ -110,11 +114,11 @@ class LatencyHist:
             return None
         need = q * self._count
         cum = 0
-        for edge, c in zip(MS_EDGES, self._counts):
+        for edge, c in zip(self.edges, self._counts):
             cum += c
             if cum >= need:
                 return edge
-        return MS_EDGES[-1]
+        return self.edges[-1]
 
     def wire(self) -> Dict[str, Any]:
         return {"count": self._count, "sum_ms": round(self._sum, 3),

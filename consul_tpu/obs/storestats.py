@@ -1,0 +1,141 @@
+"""Device state-store observatory: apply/match dispatch + table health.
+
+PR 11's device-resident KV table (state/device_store.py) gets the same
+treatment the kernel plane got in obs/devstats.py: host-monotonic
+dispatch-latency histograms bracketed exactly like ``plane._dispatch()``
+(wall time around the jit call including fetching the verdicts, which
+forces the device work), plus batch-shape and table-health series.
+
+Families (all behind the existing ``CONSUL_TPU_DEV_OBS`` gate — one
+switch for everything device-side):
+
+* ``consul_store_dispatch_ms{class=store_apply|watch_match}`` — jit
+  dispatch latency histograms per dispatch class;
+* ``consul_store_apply_batch_entries`` — committed entries per apply
+  batch (count-edged histogram — the LatencyHist bank machinery with
+  entry-count edges instead of the ms ladder);
+* ``consul_store_applied_entries_total`` / ``consul_watch_fired_total``
+  / ``consul_watch_match_events_total`` — throughput counters;
+* ``consul_store_divergence_total`` — host/device lockstep violations
+  (the crossval contract says this stays 0);
+* ``consul_store_table_full_total`` — probe-window exhaustion
+  degradations (host unaffected, device row dropped);
+* ``consul_store_occupancy{state=live|tombstone}`` /
+  ``consul_store_capacity`` / ``consul_watch_registered`` gauges.
+
+Conventions match the rest of obs/: plain-int banks (no 32-bit wrap
+anywhere host-side), no jax imports (gauge reads take pre-fetched ints,
+the bridge does the one jit reduction), no locks (single event loop),
+and ``enabled()`` compiled-out-to-``None`` hot paths.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from consul_tpu.obs.raftstats import LatencyHist
+
+# Entry-count edges for the apply-batch-size histogram.
+BATCH_EDGES: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256,
+                                  512, 1024, 2048, 4096)
+
+DISPATCH_CLASSES: Tuple[str, ...] = ("store_apply", "watch_match")
+
+
+def enabled() -> bool:
+    """Rides the device-observatory gate: CONSUL_TPU_DEV_OBS=0 compiles
+    the store observatory out with the kernel one."""
+    return os.environ.get("CONSUL_TPU_DEV_OBS", "1").lower() not in (
+        "0", "false", "no")
+
+
+class StoreStats:
+    """Per-bridge device-store observatory (module docstring)."""
+
+    def __init__(self) -> None:
+        self.dispatch: Dict[str, LatencyHist] = {
+            cls: LatencyHist(
+                "consul_store_dispatch_ms",
+                "Host-monotonic jit dispatch latency of the device "
+                "state store, by dispatch class, milliseconds.")
+            for cls in DISPATCH_CLASSES}
+        self.batch_entries = LatencyHist(
+            "consul_store_apply_batch_entries",
+            "Committed entries per device apply batch.",
+            edges=BATCH_EDGES)
+        self.applied_entries = 0
+        self.fired_watchers = 0
+        self.match_events = 0
+        self.divergence = 0
+        self.watch_registered = 0
+
+    # -- hot-path hooks (one is-not-None test at each call site) ------
+
+    def note_apply(self, ms: float, entries: int) -> None:
+        self.dispatch["store_apply"].observe(ms)
+        self.batch_entries.observe(float(entries))
+        self.applied_entries += entries
+
+    def note_match(self, ms: float, events: int, fired: int) -> None:
+        self.dispatch["watch_match"].observe(ms)
+        self.match_events += events
+        self.fired_watchers += fired
+
+    # -- scrape assembly ----------------------------------------------
+
+    def families(self, occupancy: Optional[Tuple[int, int, int]] = None,
+                 capacity: int = 0
+                 ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]],
+                            List[Dict[str, Any]]]:
+        """(histograms, labeled_gauges, labeled_counters) in the
+        obs/prom.py family shapes (the devstats.prom_families idiom).
+        ``occupancy`` is the bridge's (live, tombstone, degraded)
+        pre-fetched at scrape time — no device work in here."""
+        hists: List[Dict[str, Any]] = []
+        for cls in sorted(self.dispatch):
+            fam = self.dispatch[cls].family()
+            fam["labels"] = {"class": cls}
+            hists.append(fam)
+        hists.append(self.batch_entries.family())
+
+        gauges: List[Dict[str, Any]] = [{
+            "name": "consul_watch_registered",
+            "help": "KV watches currently registered.",
+            "rows": [({}, float(self.watch_registered))],
+        }]
+        if capacity:
+            gauges.append({
+                "name": "consul_store_capacity",
+                "help": "Device KV table slot capacity.",
+                "rows": [({}, float(capacity))]})
+        if occupancy is not None:
+            live, tomb, _deg = occupancy
+            gauges.append({
+                "name": "consul_store_occupancy",
+                "help": "Device KV table slots in use, by state.",
+                "rows": [({"state": "live"}, float(live)),
+                         ({"state": "tombstone"}, float(tomb))]})
+
+        counters: List[Dict[str, Any]] = [
+            {"name": "consul_store_applied_entries_total",
+             "help": "KV entries applied through the device store.",
+             "rows": [({}, float(self.applied_entries))]},
+            {"name": "consul_watch_fired_total",
+             "help": "Watchers fired by the device matcher.",
+             "rows": [({}, float(self.fired_watchers))]},
+            {"name": "consul_watch_match_events_total",
+             "help": "Mutation events evaluated by the device matcher.",
+             "rows": [({}, float(self.match_events))]},
+            {"name": "consul_store_divergence_total",
+             "help": "Host/device verdict or fired-set divergences "
+                     "(lockstep contract: stays 0).",
+             "rows": [({}, float(self.divergence))]},
+        ]
+        if occupancy is not None and occupancy[2]:
+            counters.append({
+                "name": "consul_store_table_full_total",
+                "help": "SETs dropped by the device table on probe-"
+                        "window exhaustion (host store unaffected).",
+                "rows": [({}, float(occupancy[2]))]})
+        return hists, gauges, counters

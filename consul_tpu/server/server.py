@@ -60,6 +60,12 @@ class ServerConfig:
     acl_default_policy: str = "allow"
     acl_down_policy: str = "extend-cache"
     acl_master_token: str = ""
+    # Device-resident state store (PR 11): mirror the KV table into a
+    # fixed-capacity device hash table, batch committed entries at the
+    # commit→apply boundary, and match watches device-side.  Host stays
+    # authoritative; the bridge cross-checks every verdict.
+    device_store: bool = False
+    device_store_capacity: int = 1 << 16
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -89,6 +95,11 @@ class Server:
         self.fsm = ConsulFSM(
             gc_hint=lambda idx: self.gc.hint(idx, time.monotonic()),
             kv_backend_factory=kv_factory)
+        if self.config.device_store:
+            # Lazy import: pulls in jax; only paid when the flag is on.
+            from consul_tpu.state.device_store import DeviceStoreBridge
+            self.fsm.attach_device_store(DeviceStoreBridge(
+                capacity=self.config.device_store_capacity))
         self.start_time = time.monotonic()
 
         if self.config.bootstrap_expect:

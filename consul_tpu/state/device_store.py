@@ -1,0 +1,711 @@
+"""Device-resident state store: batched FSM apply + device watch matching.
+
+The gossip plane put membership on the device (gossip/kernel.py); this
+module does the same for the raft/FSM/KV path — ROADMAP item 3, and the
+"consensus data path is offloadable" thesis of Network Hardware-
+Accelerated Consensus (PAPERS.md). Two jitted entry points over a
+device-resident open-addressed key-hash table:
+
+* **Batched apply** (``_build_apply``): one committed-entry batch from
+  the FSM (consensus/fsm.py ``apply_batch``) becomes one device
+  dispatch — a ``lax.scan`` over the batch (entries in a batch may
+  touch the same key, so within-batch order is sequential, exactly like
+  the host) scattering insert/update/delete-with-tombstone into the
+  table arrays, returning per-entry (existed, old_modify_index)
+  verdicts.
+* **Batched watch matching** (``_build_match``): the registered watch
+  set — padded (kind, key-hash, key-length, min-index) arrays for up to
+  10⁵–10⁶ watchers — is evaluated against the batch's mutation events
+  in one pass, emitting a fired-watcher bitmask the host NotifyGroup
+  plumbing (state/notify.py ``KVWatchSet``) consumes.
+
+Authority and lockstep
+----------------------
+The host store stays authoritative: the FSM applies each entry to the
+host store first (capturing per-key ops and watch events —
+``store.ApplyCapture``), then ships the whole batch to the device in one
+dispatch. Lockstep is *verified*, continuously: device (existed,
+old_index) verdicts must equal the host's observed pre-state, and the
+device fired-watcher set must equal the host radix-walk match set —
+any difference increments ``consul_store_divergence_total`` (crossval
+asserts it stays 0). Wakeups fire the *union* of host and device
+verdicts, so a (never-observed) divergence can only produce a spurious
+wakeup — harmless, blocking queries re-check their index — never a
+missed one. This ordering also resolves delete-tree circularity: the
+victim key set depends on pre-state the host already has.
+
+Watch-match semantics (must equal state/notify.py's host walk):
+a watch registered at ``w`` fires for a mutation at ``path`` iff
+``path.startswith(w)`` — evaluated on device by comparing the hash of
+``path``'s first ``len(w)`` bytes (rolling FNV-1a prefix-hash rows
+shipped per event) against ``w``'s stored hash. The delete-tree extra
+direction (``w.startswith(path)``, strictly longer ``w``) would need
+every watch's full prefix-hash matrix ([W, Lmax] memory); tree deletes
+are rare, so that one direction is host-walked and unioned in.
+Hash matches are two independent 32-bit FNV streams → ~2⁻⁶⁴ false-fire
+probability per (watch, event) pair; a false fire is a spurious wakeup,
+and the host-union keeps wakeup semantics exact regardless.
+
+Index wrap convention (vet O01): modify/create indexes live on device
+as ``uint32`` — raft indexes folded mod 2³². Verdict comparison folds
+the host index the same way, and the ``index > min_index`` watch gate
+uses plain uint32 compare, which is exact while true indexes stay
+below 2³² (~5 days at 10k writes/s before a wrap; the gossip kernel's
+counters accept the same convention, gossip/kernel.py).
+
+Keys longer than ``lmax`` bytes can't ride the prefix-hash rows; such
+watches go on a host-evaluated fallback list, and events at such paths
+still match device watches up to ``lmax`` (the event row carries hashes
+for lengths 0..lmax and its true byte length).
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from consul_tpu.state.notify import (
+    KIND_KEY, KIND_PREFIX, KIND_TABLE, StoreMutation, WatchPredicate,
+    match_batch)
+
+# Two independent FNV-1a-style 32-bit streams (second uses different
+# offset basis and prime, gossip/ops/feistel.py keeps the same style of
+# fixed odd multipliers).
+_FNV1_BASIS = np.uint32(2166136261)
+_FNV1_PRIME = np.uint32(16777619)
+_FNV2_BASIS = np.uint32(0x811C9DC5 ^ 0x5BD1E995)
+_FNV2_PRIME = np.uint32(0x01000193 ^ 0x00010146)  # odd → invertible mod 2^32
+
+# Table slot states.
+SLOT_EMPTY = 0
+SLOT_LIVE = 1
+SLOT_TOMB = 2
+
+# Op codes in the batched-apply stream (pad rows are OP_PAD).
+OP_SET = 0
+OP_DEL = 1
+OP_PAD = -1
+
+# Event kinds in the watch-match stream.
+EV_KV = 0
+EV_TABLE = 1
+EV_PAD = -1
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _encode_keys(keys: Sequence[bytes], lmax: int) -> np.ndarray:
+    """[N, lmax] uint32 byte matrix, zero-padded."""
+    mat = np.zeros((len(keys), lmax), dtype=np.uint32)
+    for i, kb in enumerate(keys):
+        kb = kb[:lmax]
+        if kb:
+            mat[i, : len(kb)] = np.frombuffer(kb, dtype=np.uint8)
+    return mat
+
+
+def _full_hashes(keys: Sequence[bytes], lmax: int
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(h1, h2, byte_len) of each key's first min(len, lmax) bytes —
+    vectorized across keys: O(lmax) numpy passes however many keys."""
+    lens = np.array([min(len(k), lmax) for k in keys], dtype=np.int32)
+    mat = _encode_keys(keys, lmax)
+    h1 = np.full(len(keys), _FNV1_BASIS, dtype=np.uint32)
+    h2 = np.full(len(keys), _FNV2_BASIS, dtype=np.uint32)
+    for j in range(int(lens.max()) if len(keys) else 0):
+        act = j < lens
+        h1 = np.where(act, (h1 ^ mat[:, j]) * _FNV1_PRIME, h1)
+        h2 = np.where(act, (h2 ^ mat[:, j]) * _FNV2_PRIME, h2)
+    return h1, h2, lens
+
+
+def _prefix_hashes(paths: Sequence[bytes], lmax: int
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Rolling prefix hashes: [N, lmax+1] rows where column ``l`` is the
+    hash of the path's first ``l`` bytes (frozen once l exceeds the
+    path length — guarded by the length compare at match time)."""
+    n = len(paths)
+    lens = np.array([min(len(p), lmax) for p in paths], dtype=np.int32)
+    mat = _encode_keys(paths, lmax)
+    hp1 = np.empty((n, lmax + 1), dtype=np.uint32)
+    hp2 = np.empty((n, lmax + 1), dtype=np.uint32)
+    h1 = np.full(n, _FNV1_BASIS, dtype=np.uint32)
+    h2 = np.full(n, _FNV2_BASIS, dtype=np.uint32)
+    hp1[:, 0] = h1
+    hp2[:, 0] = h2
+    for j in range(lmax):
+        act = j < lens
+        h1 = np.where(act, (h1 ^ mat[:, j]) * _FNV1_PRIME, h1)
+        h2 = np.where(act, (h2 ^ mat[:, j]) * _FNV2_PRIME, h2)
+        hp1[:, j + 1] = h1
+        hp2[:, j + 1] = h2
+    return hp1, hp2, lens
+
+
+def _digest(value: bytes) -> int:
+    """uint32 value digest — crc32 (C-speed, stdlib)."""
+    return zlib.crc32(value) & 0xFFFFFFFF
+
+
+def _build_apply(jnp, lax, jax, capacity: int, probe: int):
+    """Jitted batched apply over the table carry (donated)."""
+
+    cap_mask = np.uint32(capacity - 1)
+    probe_off = np.arange(probe, dtype=np.uint32)
+
+    def step(tab, op):
+        state, fp1, fp2, modify, create, digest, flags, full = tab
+        opc, h1, h2, index, dig, flg = op
+        idx = ((h1 + probe_off) & cap_mask).astype(jnp.int32)  # [P]
+        st = state[idx]
+        match = (st != SLOT_EMPTY) & (fp1[idx] == h1) & (fp2[idx] == h2)
+        any_match = jnp.any(match)
+        first_match = jnp.argmax(match)
+        empty = st == SLOT_EMPTY
+        window_ok = any_match | jnp.any(empty)
+        t = jnp.where(any_match, first_match, jnp.argmax(empty))
+        slot = idx[t]
+        existed = any_match & (st[first_match] == SLOT_LIVE)
+        old_index = jnp.where(existed, modify[idx[first_match]],
+                              jnp.uint32(0))
+        is_set = opc == OP_SET
+        is_del = opc == OP_DEL
+        # SET needs a slot (match or empty); DEL only acts on a live key.
+        write = (is_set & window_ok) | (is_del & existed)
+        new_state = jnp.where(is_set, SLOT_LIVE, SLOT_TOMB)
+        # Host create_index semantics: live key keeps create; empty or
+        # tombstone (host popped it on delete) re-creates at this index.
+        new_create = jnp.where(is_set & ~existed, index, create[slot])
+        state = state.at[slot].set(jnp.where(write, new_state, state[slot]))
+        fp1 = fp1.at[slot].set(jnp.where(write, h1, fp1[slot]))
+        fp2 = fp2.at[slot].set(jnp.where(write, h2, fp2[slot]))
+        modify = modify.at[slot].set(jnp.where(write, index, modify[slot]))
+        create = create.at[slot].set(jnp.where(write, new_create,
+                                               create[slot]))
+        digest = digest.at[slot].set(
+            jnp.where(write, jnp.where(is_set, dig, jnp.uint32(0)),
+                      digest[slot]))
+        flags = flags.at[slot].set(jnp.where(write & is_set, flg,
+                                             flags[slot]))
+        # Probe window exhausted on a SET: table degraded (counted; the
+        # authoritative host store is unaffected).
+        # O01 decision: uint32 with intended mod-2³² wrap, like every
+        # device-side index here (module docstring).  A wrap needs 2³²
+        # degraded SETs — the table is declared degraded (and sized up)
+        # at the FIRST one; the counter's only job is "zero or not".
+        full = full + jnp.where(is_set & ~window_ok, jnp.uint32(1),  # noqa: O01
+                                jnp.uint32(0))
+        return ((state, fp1, fp2, modify, create, digest, flags, full),
+                (existed, old_index))
+
+    def apply_batch(tab, ops):
+        return lax.scan(step, tab, ops)
+
+    return jax.jit(apply_batch, donate_argnums=(0,))
+
+
+def _build_match(jnp, lax, jax, lmax: int):
+    """Jitted watch matcher: scan over events OR-ing a fired mask [W]
+    (O(W) memory — never materializes the [B, W] cross product), then
+    packs it into a uint32 bitmask."""
+
+    def step(carry, ev):
+        fired, w_kind, w_h1, w_h2, w_len, w_min = carry
+        kind, e_len, e_index, hp1, hp2, th1, th2 = ev
+        at = jnp.clip(w_len, 0, lmax)
+        kv = kind == EV_KV
+        cond_kv = (kv & (w_kind != KIND_TABLE) & (w_len <= e_len)
+                   & (hp1[at] == w_h1) & (hp2[at] == w_h2))
+        cond_tab = ((kind == EV_TABLE) & (w_kind == KIND_TABLE)
+                    & (th1 == w_h1) & (th2 == w_h2))
+        # uint32 index gate (wrap convention in module docstring).
+        gate = (w_kind >= 0) & (e_index > w_min)
+        fired = fired | ((cond_kv | cond_tab) & gate)
+        return (fired, w_kind, w_h1, w_h2, w_len, w_min), None
+
+    def match(w_kind, w_h1, w_h2, w_len, w_min, events):
+        fired0 = jnp.zeros(w_kind.shape, dtype=bool)
+        carry, _ = lax.scan(step, (fired0, w_kind, w_h1, w_h2, w_len,
+                                   w_min), events)
+        fired = carry[0]
+        bits = fired.reshape(-1, 32).astype(jnp.uint32)
+        packed = (bits << jnp.arange(32, dtype=jnp.uint32)).sum(
+            axis=1, dtype=jnp.uint32)
+        return fired, packed
+
+    return jax.jit(match)
+
+
+class DeviceKVTable:
+    """Fixed-capacity open-addressed hash table in device memory.
+
+    Arrays: slot state (empty/live/tombstone), two uint32 key
+    fingerprints, modify/create indexes (uint32, mod-2³² convention),
+    crc32 value digest, flags. Probing is a static ``probe``-slot
+    linear window gathered per op; a tombstone keeps its fingerprints so
+    a re-set of the same key reuses its slot (no duplicate rows).
+    """
+
+    def __init__(self, capacity: int = 1 << 16, probe: int = 16) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        self._jax, self._jnp = jax, jnp
+        self.capacity = _pow2(max(int(capacity), probe))
+        self.probe = int(probe)
+        self._apply = _build_apply(jnp, lax, jax, self.capacity, self.probe)
+        self._occupancy = jax.jit(
+            lambda st: ((st == SLOT_LIVE).sum(dtype=jnp.int32),
+                        (st == SLOT_TOMB).sum(dtype=jnp.int32)))
+        self.reset()
+
+    def reset(self) -> None:
+        jnp = self._jnp
+        c = self.capacity
+        self.tab = (jnp.zeros(c, jnp.int32),    # state
+                    jnp.zeros(c, jnp.uint32),   # fp1
+                    jnp.zeros(c, jnp.uint32),   # fp2
+                    jnp.zeros(c, jnp.uint32),   # modify
+                    jnp.zeros(c, jnp.uint32),   # create
+                    jnp.zeros(c, jnp.uint32),   # digest
+                    jnp.zeros(c, jnp.uint32),   # flags
+                    jnp.uint32(0))              # table-full degradations
+
+    def apply(self, ops: Tuple[np.ndarray, ...]) -> Tuple[np.ndarray,
+                                                          np.ndarray]:
+        """Apply one padded op batch; returns host (existed, old_index)
+        arrays (padding rows included — callers slice)."""
+        self.tab, (existed, old_index) = self._apply(self.tab, ops)
+        return np.asarray(existed), np.asarray(old_index)
+
+    def occupancy(self) -> Tuple[int, int, int]:
+        """(live, tombstone, degraded-sets) — one small jit reduction."""
+        live, tomb = self._occupancy(self.tab[0])
+        return int(live), int(tomb), int(self.tab[7])
+
+
+class DeviceStoreBridge:
+    """Glue between the host store/FSM and the device twin.
+
+    ``on_batch(cap, store)`` is called by the FSM once per committed
+    batch (consensus/fsm.py ``apply_batch``) with the store's
+    ``ApplyCapture``: it ships the per-key ops as one device scatter,
+    runs the watch matcher over the batch's events, cross-checks both
+    against the host verdicts, fires the NotifyGroups (host∪device),
+    and feeds the PR-7 hotpath byte cache via ``render_hook``.
+
+    Dispatch bracketing mirrors ``gossip/plane._dispatch()``: wall time
+    around the jit call *including* fetching the verdicts (which forces
+    the device work), recorded per dispatch class (``store_apply``,
+    ``watch_match``) in obs/storestats.py.
+    """
+
+    def __init__(self, capacity: int = 1 << 16, probe: int = 16,
+                 lmax: int = 64, max_batch: int = 4096,
+                 stats: Optional[object] = None) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        self._jax, self._jnp = jax, jnp
+        self.table = DeviceKVTable(capacity, probe)
+        self.capacity = self.table.capacity
+        self.lmax = int(lmax)
+        self.max_batch = int(max_batch)
+        self._match = _build_match(jnp, lax, jax, self.lmax)
+        if stats is None:
+            from consul_tpu.obs import storestats
+            stats = storestats.StoreStats() if storestats.enabled() else None
+        self.stats = stats
+        # Rebuilt lazily from KVWatchSet when its version moves.
+        self._w_version = -1
+        self._w_arrays: Optional[Tuple] = None
+        self._w_groups: List[Tuple[str, object]] = []
+        self._w_fallback: List[Tuple[str, object]] = []  # len > lmax
+        self.divergence = 0
+        self.render_hook = None  # set by Server: fired keys -> byte cache
+
+    # -- watch-set encoding -------------------------------------------
+
+    def _encode_watches(self, watchset) -> None:
+        jnp = self._jnp
+        reg = watchset.registered()
+        self._w_groups = [(p, g) for p, g in reg
+                          if len(p.encode("utf-8")) <= self.lmax]
+        self._w_fallback = [(p, g) for p, g in reg
+                            if len(p.encode("utf-8")) > self.lmax]
+        w = len(self._w_groups)
+        wp = _pow2(max(w, 1), floor=32)
+        kind = np.full(wp, -1, dtype=np.int32)
+        kind[:w] = KIND_PREFIX
+        keys = [p.encode("utf-8") for p, _ in self._w_groups]
+        h1 = np.zeros(wp, dtype=np.uint32)
+        h2 = np.zeros(wp, dtype=np.uint32)
+        ln = np.zeros(wp, dtype=np.int32)
+        if w:
+            h1[:w], h2[:w], ln[:w] = _full_hashes(keys, self.lmax)
+        wmin = np.zeros(wp, dtype=np.uint32)  # plumbing registers min=0
+        self._w_arrays = tuple(jnp.asarray(a)
+                               for a in (kind, h1, h2, ln, wmin))
+        self._w_version = watchset.version
+        if self.stats is not None:
+            self.stats.watch_registered = len(reg)
+
+    def encode_predicates(self, preds: Sequence[WatchPredicate]) -> Tuple:
+        """Encode explicit predicates (crossval / watchstorm path —
+        exercises KIND_TABLE and min_index, which the NotifyGroup
+        plumbing never sets)."""
+        jnp = self._jnp
+        w = len(preds)
+        wp = _pow2(max(w, 1), floor=32)
+        kind = np.full(wp, -1, dtype=np.int32)
+        h1 = np.zeros(wp, dtype=np.uint32)
+        h2 = np.zeros(wp, dtype=np.uint32)
+        ln = np.zeros(wp, dtype=np.int32)
+        wmin = np.zeros(wp, dtype=np.uint32)
+        if w:
+            keys = [p.value.encode("utf-8") for p in preds]
+            h1[:w], h2[:w], ln[:w] = _full_hashes(keys, self.lmax)
+            kind[:w] = [p.kind for p in preds]
+            wmin[:w] = [p.min_index & 0xFFFFFFFF for p in preds]
+            ln[:w] = np.where(np.array([p.kind for p in preds]) == KIND_TABLE,
+                              0, ln[:w])
+        return tuple(jnp.asarray(a) for a in (kind, h1, h2, ln, wmin))
+
+    def _encode_events(self, notifies: Sequence[tuple]) -> Tuple:
+        """Pack capture notify events into padded device rows."""
+        jnp = self._jnp
+        b = len(notifies)
+        bp = _pow2(max(b, 1))
+        kind = np.full(bp, EV_PAD, dtype=np.int32)
+        e_len = np.zeros(bp, dtype=np.int32)
+        e_index = np.zeros(bp, dtype=np.uint32)
+        th1 = np.zeros(bp, dtype=np.uint32)
+        th2 = np.zeros(bp, dtype=np.uint32)
+        kv_paths: List[bytes] = []
+        for i, ev in enumerate(notifies):
+            if ev[0] == "kv":
+                kind[i] = EV_KV
+                kv_paths.append(ev[1].encode("utf-8"))
+                e_index[i] = ev[3] & 0xFFFFFFFF
+            else:
+                kind[i] = EV_TABLE
+                kv_paths.append(b"")
+                e_index[i] = ev[2] & 0xFFFFFFFF
+        hp1, hp2, lens = _prefix_hashes(kv_paths, self.lmax)
+        hp1_p = np.zeros((bp, self.lmax + 1), dtype=np.uint32)
+        hp2_p = np.zeros((bp, self.lmax + 1), dtype=np.uint32)
+        hp1_p[:b], hp2_p[:b] = hp1, hp2
+        for i, ev in enumerate(notifies):
+            if ev[0] == "kv":
+                # True byte length (uncapped) so w_len <= e_len is exact
+                # for long paths; hashes only cover the first lmax bytes.
+                e_len[i] = len(ev[1].encode("utf-8"))
+            else:
+                t1, t2, _ = _full_hashes([ev[1].encode("utf-8")], self.lmax)
+                th1[i], th2[i] = t1[0], t2[0]
+        return tuple(jnp.asarray(a) for a in
+                     (kind, e_len, e_index, hp1_p, hp2_p, th1, th2))
+
+    # -- op-stream encoding -------------------------------------------
+
+    def _encode_ops(self, kv_ops: Sequence[tuple]) -> Tuple[Tuple, int]:
+        jnp = self._jnp
+        b = len(kv_ops)
+        bp = _pow2(max(b, 1))  # callers chunk to max_batch first
+        opc = np.full(bp, OP_PAD, dtype=np.int32)
+        index = np.zeros(bp, dtype=np.uint32)
+        dig = np.zeros(bp, dtype=np.uint32)
+        flg = np.zeros(bp, dtype=np.uint32)
+        keys = []
+        for i, op in enumerate(kv_ops):
+            keys.append(op[1].encode("utf-8"))
+            index[i] = op[2] & 0xFFFFFFFF
+            if op[0] == "set":
+                opc[i] = OP_SET
+                dig[i] = _digest(op[6])
+                flg[i] = op[5] & 0xFFFFFFFF
+            else:
+                opc[i] = OP_DEL
+        h1 = np.zeros(bp, dtype=np.uint32)
+        h2 = np.zeros(bp, dtype=np.uint32)
+        if b:
+            # Full-key hashing beyond lmax for table fingerprints: hash
+            # the whole key (table identity must distinguish keys that
+            # share their first lmax bytes).
+            h1[:b], h2[:b], _ = _full_hashes(keys, max(
+                self.lmax, max(len(k) for k in keys)))
+        return (tuple(jnp.asarray(a)
+                      for a in (opc, h1, h2, index, dig, flg)), b)
+
+    # -- the per-batch entry point ------------------------------------
+
+    def on_batch(self, cap, store) -> None:
+        """One committed batch: device scatter + device watch match,
+        host cross-check, union-fire, cache render."""
+        t0 = time.monotonic()
+        n_ops = len(cap.kv_ops)
+        if n_ops:
+            chunks = [cap.kv_ops[i:i + self.max_batch]
+                      for i in range(0, n_ops, self.max_batch)]
+            for chunk in chunks:
+                ops, _b = self._encode_ops(chunk)
+                existed, old_index = self.table.apply(ops)
+                for i, op in enumerate(chunk):
+                    # set: ("set", key, index, old_index, existed, ...);
+                    # del: ("del", key, index, old_index) — only ever
+                    # recorded for keys that existed (store pops first).
+                    h_existed = op[4] if op[0] == "set" else True
+                    h_old = (op[3] & 0xFFFFFFFF) if h_existed else 0
+                    if (bool(existed[i]) != bool(h_existed)
+                            or int(old_index[i]) != h_old):
+                        self.divergence += 1
+            if self.stats is not None:
+                ms = (time.monotonic() - t0) * 1e3
+                self.stats.note_apply(ms, n_ops)
+
+        self._fire_watches(cap, store)
+        if self.render_hook is not None:
+            keys = [op[1] for op in cap.kv_ops]
+            if keys:
+                self.render_hook(keys)
+        cap.consumed = True
+
+    def _fire_watches(self, cap, store) -> None:
+        """Device bitmask ∪ host walk → NotifyGroup firing + prune."""
+        watchset = store._kv_watch
+        if watchset.version != self._w_version:
+            self._encode_watches(watchset)
+
+        # Host-authoritative match set (ordered as the sequential path
+        # would have fired), incl. the delete-tree reverse direction and
+        # any over-lmax fallback watches the device can't encode.
+        host_fired: List[Tuple[str, object]] = []
+        seen: Set[int] = set()
+        for ev in cap.notifies:
+            if ev[0] != "kv":
+                continue
+            for p, g in watchset.matched(ev[1], ev[2]):
+                if id(g) not in seen:
+                    seen.add(id(g))
+                    host_fired.append((p, g))
+
+        kv_events = [ev for ev in cap.notifies if ev[0] == "kv"]
+        device_fired: List[Tuple[str, object]] = []
+        if kv_events and self._w_groups:
+            t0 = time.monotonic()
+            events = self._encode_events(kv_events)
+            fired, _packed = self._match(*self._w_arrays, events)
+            fired = np.asarray(fired)[: len(self._w_groups)]
+            device_fired = [self._w_groups[i]
+                            for i in np.nonzero(fired)[0]]
+            if self.stats is not None:
+                ms = (time.monotonic() - t0) * 1e3
+                self.stats.note_match(ms, len(kv_events),
+                                      int(fired.sum()))
+
+        # Device must agree with the host walk on every watch it
+        # encodes, *except* the delete-tree reverse direction which is
+        # host-only by design (module docstring).
+        host_keys = {id(g) for p, g in host_fired}
+        dev_keys = {id(g) for p, g in device_fired}
+        encoded = {id(g) for _, g in self._w_groups}
+        expect_dev = set()
+        for p, g in host_fired:
+            if id(g) not in encoded:
+                continue  # over-lmax fallback watch, host-only by design
+            if any(ev[1].startswith(p) for ev in kv_events):
+                # The forward (path startswith watch) direction is the
+                # device's; reverse-only tree matches are host-only.
+                expect_dev.add(id(g))
+        missing = {k for k in expect_dev if k not in dev_keys}
+        spurious = dev_keys - host_keys
+        if missing or spurious:
+            self.divergence += len(missing) + len(spurious)
+        if self.stats is not None:
+            self.stats.divergence = self.divergence
+
+        # Fire the union: host order first (authoritative), then any
+        # device-only extras (spurious-wake-safe).
+        union = host_fired + [(p, g) for p, g in device_fired
+                              if id(g) not in host_keys]
+        watchset.notify_groups(union)  # prune bumps version → re-encode
+
+        # Table notify events stay host-fired (one standing group per
+        # table, never pruned — nothing for the device to win there).
+        for ev in cap.notifies:
+            if ev[0] == "table":
+                store._watch[ev[1]].notify()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def rebuild_from_store(self, store) -> None:
+        """Reset + re-apply every live host row (snapshot restore path —
+        fsm.restore builds a fresh store, the device twin follows)."""
+        self.table.reset()
+        rows: List[tuple] = []
+        for _, ent in store._kv.items(""):
+            if ent.create_index != ent.modify_index:
+                # Two-step so the device's create_index lands on the
+                # host's: first set creates at create_index, second set
+                # (existed) keeps it and moves modify_index.
+                rows.append(("set", ent.key, ent.create_index, 0, False,
+                             ent.flags, b""))
+                rows.append(("set", ent.key, ent.modify_index,
+                             ent.create_index, True, ent.flags, ent.value))
+            else:
+                rows.append(("set", ent.key, ent.modify_index, 0, False,
+                             ent.flags, ent.value))
+        for i in range(0, len(rows), self.max_batch):
+            ops, _ = self._encode_ops(rows[i:i + self.max_batch])
+            self.table.apply(ops)
+        self._w_version = -1
+
+    def occupancy(self) -> Tuple[int, int, int]:
+        return self.table.occupancy()
+
+
+# ---------------------------------------------------------------------
+# Crossval oracle (the contract): randomized apply/watch workloads
+# through device AND host, asserting identical verdicts and fired sets.
+# ---------------------------------------------------------------------
+
+def _random_key(rng, prefixes: Sequence[str], long_tail: bool) -> str:
+    p = prefixes[rng.randrange(len(prefixes))]
+    leaf = f"{rng.randrange(64):x}"
+    if long_tail and rng.random() < 0.05:
+        leaf += "x" * 80  # push past lmax to exercise the fallback list
+    return f"{p}{leaf}"
+
+
+def crossval(n_batches: int = 20, batch: int = 32, n_watches: int = 200,
+             capacity: int = 1 << 12, seed: int = 0,
+             lmax: int = 64) -> Dict[str, Any]:
+    """Drive randomized batches through host store + device bridge.
+
+    Asserts (1) zero verdict/fired divergence via the bridge's own
+    continuous cross-check, (2) the device fired set equals the pure
+    ``match_batch`` oracle on explicit predicates (exact/prefix/table
+    kinds incl. min_index gates), (3) blocking-style waiters wake
+    identically. Returns a summary dict for tools/store_crossval.py.
+    """
+    import random
+
+    from consul_tpu.state.store import StateStore
+    from consul_tpu.structs.structs import DirEntry
+
+    rng = random.Random(seed)
+    store = StateStore()
+    bridge = DeviceStoreBridge(capacity=capacity, lmax=lmax, stats=None)
+    prefixes = ["web/", "web/a/", "db/", "db/shard/", "cfg/", ""]
+
+    class Flag:
+        def __init__(self) -> None:
+            self.sets = 0
+
+        def set(self) -> None:
+            self.sets += 1
+
+    # Standing watch population with churn.
+    flags: Dict[str, Flag] = {}
+    for i in range(n_watches):
+        w = _random_key(rng, prefixes, long_tail=True)
+        flags[w] = Flag()
+        store.watch_kv(w, flags[w])
+
+    index = 0
+    fired_total = 0
+    for bi in range(n_batches):
+        before = {w: f.sets for w, f in flags.items()}
+        with store.capture_apply() as cap:
+            for _ in range(batch):
+                index += 1
+                r = rng.random()
+                key = _random_key(rng, prefixes, long_tail=True)
+                if r < 0.55:
+                    store.kvs_set(index, DirEntry(
+                        key=key, value=rng.randbytes(8),
+                        flags=rng.randrange(1 << 16)))
+                elif r < 0.7:
+                    store.kvs_check_and_set(index, DirEntry(
+                        key=key, value=b"cas",
+                        modify_index=rng.choice([0, index - 1])))
+                elif r < 0.85:
+                    store.kvs_delete(index, key)
+                else:
+                    store.kvs_delete_tree(
+                        index, prefixes[rng.randrange(len(prefixes) - 1)])
+            bridge.on_batch(cap, store)
+
+        # Host-semantics oracle for wakeups: re-walk the events against
+        # the *pre-batch* watch registry via the pure evaluator.
+        muts = [StoreMutation(path=ev[1], index=ev[3], kv=True,
+                              prefix=ev[2])
+                for ev in cap.notifies if ev[0] == "kv"]
+        preds = [WatchPredicate(KIND_PREFIX, w) for w in before]
+        oracle = match_batch(preds, muts)
+        for i, w in enumerate(before):
+            woke = flags[w].sets > before[w]
+            if woke != (i in oracle):
+                raise AssertionError(
+                    f"wakeup divergence batch {bi}: watch {w!r} "
+                    f"woke={woke} oracle={i in oracle}")
+            if woke:
+                fired_total += 1
+                # Exactly-once + re-register (NotifyGroup contract).
+                assert flags[w].sets == before[w] + 1
+                store.watch_kv(w, flags[w])
+
+        if bridge.divergence:
+            raise AssertionError(
+                f"device/host divergence after batch {bi}: "
+                f"{bridge.divergence}")
+
+    # Verify the device table mirrors the host live set (digest +
+    # indexes), via one rebuilt op-stream comparison.
+    live, tomb, degraded = bridge.occupancy()
+    host_live = sum(1 for _ in store._kv.items(""))
+    if degraded == 0 and live != host_live:
+        raise AssertionError(f"occupancy mismatch: device {live} "
+                             f"host {host_live}")
+
+    # Explicit predicate-kind sweep (KIND_KEY/TABLE + min_index) through
+    # the low-level matcher against the pure evaluator.
+    preds = ([WatchPredicate(KIND_KEY, _random_key(rng, prefixes, False))
+              for _ in range(32)]
+             + [WatchPredicate(KIND_PREFIX, p) for p in prefixes[:-1]]
+             + [WatchPredicate(KIND_TABLE, "nodes"),
+                WatchPredicate(KIND_TABLE, "sessions"),
+                WatchPredicate(KIND_KEY, "web/", min_index=index + 10)])
+    muts = ([StoreMutation(path=_random_key(rng, prefixes, False),
+                           index=index + 1 + i) for i in range(16)]
+            + [StoreMutation(path="nodes", index=index + 1, kv=False)])
+    arrays = bridge.encode_predicates(preds)
+    events = bridge._encode_events(
+        [("kv", m.path, m.prefix, m.index) if m.kv
+         else ("table", m.path, m.index) for m in muts])
+    fired, packed = bridge._match(*arrays, events)
+    fired = set(np.nonzero(np.asarray(fired)[:len(preds)])[0].tolist())
+    want = match_batch(preds, muts)
+    if fired != want:
+        raise AssertionError(f"predicate sweep divergence: "
+                             f"device {sorted(fired)} oracle {sorted(want)}")
+    # Bitmask packing is exact.
+    unpacked = {i for i in range(len(preds))
+                if (int(np.asarray(packed)[i // 32]) >> (i % 32)) & 1}
+    assert unpacked == fired
+
+    return {"batches": n_batches, "batch": batch, "ops": index,
+            "watches": n_watches, "fired_wakeups": fired_total,
+            "device_live": live, "device_tombstones": tomb,
+            "degraded": degraded, "divergence": bridge.divergence,
+            "predicate_sweep": {"fired": len(fired), "total": len(preds)}}

@@ -1,18 +1,110 @@
-"""Watch notification groups.
+"""Watch notification: pure predicates + host-side notify plumbing.
 
 Parity target: ``consul/notify.go`` — NotifyGroup lets blocking queries
 register a wakeup, mutations fire every registered wakeup exactly once
 and clear the registry (notify.go:11-55: non-blocking channel send, then
 the waiter re-registers on its next loop iteration).
 
+PR 11 splits the watch machinery into two layers so the device twin
+(state/device_store.py) can share the *decision* logic without touching
+the *wakeup* logic:
+
+- **Pure predicates** (`WatchPredicate`, `StoreMutation`, `match_batch`):
+  side-effect-free evaluation of "does this mutation fire this watch".
+  This is the host oracle the device watch matcher is cross-validated
+  against, and the fallback evaluator for watches the device encoding
+  can't carry (keys longer than its hash window).
+- **Plumbing** (`NotifyGroup`, `KVWatchSet`): waiter registries and the
+  radix-backed KV prefix watch table, moved here from store.py so the
+  store mutates state and *describes* what changed, while firing is one
+  pluggable step (host walk today, device bitmask when a bridge is
+  attached).
+
 The waiter handle is anything with a ``set()`` method: ``threading.Event``
 for synchronous callers, or an adapter around ``asyncio.Event`` supplied
 by the RPC layer (which routes the set through its event loop).
+
+KV watch semantics (reference notifyKV, state_store.go:463-491) are
+*symmetric-prefix*: a watch registered at ``w`` fires for a mutation at
+``path`` iff ``path.startswith(w)``, and a prefix mutation (delete-tree
+at ``path``) additionally fires any strictly-longer ``w`` with
+``w.startswith(path)``. Registration does not distinguish "key" from
+"prefix" watches — the kinds below exist so encoders/observability can
+tell intent apart; KIND_KEY and KIND_PREFIX match identically, exactly
+like the host radix walk treats them.
 """
 
 from __future__ import annotations
 
-from typing import Protocol, Set
+import dataclasses
+from typing import Iterable, List, Protocol, Sequence, Set, Tuple
+
+from consul_tpu.state.radix import RadixTree
+
+# Predicate kinds. KEY and PREFIX share the symmetric-prefix rule (see
+# module docstring); TABLE fires on whole-table mutations only.
+KIND_KEY = 0
+KIND_PREFIX = 1
+KIND_TABLE = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreMutation:
+    """One watch-relevant event, as the store would have notified it.
+
+    ``kv=True``: a KV mutation at ``path`` (``prefix=True`` when it was a
+    delete-tree covering everything under ``path``). ``kv=False``: a
+    table mutation; ``path`` holds the table name. ``index`` is the raft
+    index that produced it.
+    """
+
+    path: str
+    index: int
+    kv: bool = True
+    prefix: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchPredicate:
+    """Pure watch predicate: (kind, value, min_index).
+
+    ``min_index`` mirrors blocking_query's MinQueryIndex: a mutation only
+    *usefully* wakes a watcher when its index advanced past it. The host
+    NotifyGroup plumbing registers with min_index=0 (it wakes on any
+    covered mutation and lets the query re-check), and the device matcher
+    honors whatever the encoder supplied.
+    """
+
+    kind: int
+    value: str
+    min_index: int = 0
+
+    def matches(self, m: StoreMutation) -> bool:
+        if m.index <= self.min_index:
+            return False
+        if self.kind == KIND_TABLE:
+            return (not m.kv) and m.path == self.value
+        if not m.kv:
+            return False
+        if m.path.startswith(self.value):
+            return True
+        return (m.prefix and len(self.value) > len(m.path)
+                and self.value.startswith(m.path))
+
+
+def match_batch(predicates: Sequence[WatchPredicate],
+                mutations: Iterable[StoreMutation]) -> Set[int]:
+    """Host reference evaluator: indices of predicates fired by any
+    mutation in the batch. This is the oracle the device watch matcher
+    is cross-validated against (bit-identical fired sets)."""
+    fired: Set[int] = set()
+    muts = list(mutations)
+    for i, p in enumerate(predicates):
+        for m in muts:
+            if p.matches(m):
+                fired.add(i)
+                break
+    return fired
 
 
 class Waiter(Protocol):
@@ -39,3 +131,68 @@ class NotifyGroup:
 
     def __len__(self) -> int:
         return len(self._waiters)
+
+
+class KVWatchSet:
+    """Radix-backed prefix → NotifyGroup registry (the KV half of the
+    reference's state-store watch plumbing, moved out of store.py).
+
+    ``version`` bumps whenever the *set of registered prefixes* changes
+    (not on waiter churn within a group) — the device bridge uses it to
+    know when its padded watch arrays are stale.
+    """
+
+    def __init__(self) -> None:
+        self._tree = RadixTree()  # prefix -> NotifyGroup
+        self.version = 0
+
+    def watch(self, prefix: str, waiter: Waiter) -> None:
+        grp = self._tree.get(prefix)
+        if grp is None:
+            grp = NotifyGroup()
+            self._tree.insert(prefix, grp)
+            self.version += 1
+        grp.wait(waiter)
+
+    def stop(self, prefix: str, waiter: Waiter) -> None:
+        grp = self._tree.get(prefix)
+        if grp is not None:
+            grp.clear(waiter)
+            if len(grp) == 0:
+                self._tree.delete(prefix)
+                self.version += 1
+
+    def matched(self, path: str, prefix: bool) -> List[Tuple[str, NotifyGroup]]:
+        """Groups the reference walk would notify for this mutation —
+        pure lookup, nothing fired (reference notifyKV's match set,
+        state_store.go:463-477)."""
+        out = list(self._tree.walk_path(path))
+        if prefix:
+            out += [(p, g) for p, g in self._tree.walk_prefix(path)
+                    if len(p) > len(path)]
+        return out
+
+    def notify(self, path: str, prefix: bool) -> None:
+        """Walk + fire + prune (reference notifyKV, state_store.go:463-491)."""
+        self.notify_groups(self.matched(path, prefix))
+
+    def notify_groups(self, groups: Iterable[Tuple[str, NotifyGroup]]) -> None:
+        """Fire pre-matched groups, pruning ones left empty (reference
+        toDelete loop, state_store.go:478-489). The device bridge feeds
+        this from its fired-watcher bitmask."""
+        for p, g in groups:
+            g.notify()
+            if len(g) == 0 and self._tree.get(p) is g:
+                self._tree.delete(p)
+                self.version += 1
+
+    def registered(self) -> List[Tuple[str, NotifyGroup]]:
+        """All live (prefix, group) pairs — the device bridge encodes
+        these into its padded watch arrays."""
+        return list(self._tree.walk_prefix(""))
+
+    def group(self, prefix: str) -> "NotifyGroup | None":
+        return self._tree.get(prefix)
+
+    def __len__(self) -> int:
+        return len(self.registered())

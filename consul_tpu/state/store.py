@@ -27,12 +27,12 @@ checked on the leader, kvs_endpoint.go:52-61).
 from __future__ import annotations
 
 import bisect
+import contextlib
 import dataclasses
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
-from consul_tpu.state.notify import NotifyGroup, Waiter
-from consul_tpu.state.radix import RadixTree
+from consul_tpu.state.notify import KVWatchSet, NotifyGroup, Waiter
 from consul_tpu.structs.structs import (
     ACL,
     CheckServiceNode,
@@ -112,6 +112,40 @@ class _SortedKeys:
         return self._keys[lo:hi]
 
 
+class ApplyCapture:
+    """Record of one committed-entry batch (PR 11, device state store).
+
+    ``kv_ops`` carries per-key row mutations for the device table scatter
+    — op, key, index, plus the host's verdict (existed/old_index) that
+    the device apply must reproduce to stay in lockstep. ``notifies``
+    carries the watch events exactly as the sequential path would have
+    fired them, in order.
+    """
+
+    __slots__ = ("kv_ops", "notifies", "consumed")
+
+    def __init__(self) -> None:
+        # ("set", key, index, old_index, existed, flags, value) |
+        # ("del", key, index, old_index)
+        self.kv_ops: List[tuple] = []
+        # ("kv", path, prefix, index) | ("table", table, index)
+        self.notifies: List[tuple] = []
+        self.consumed = False
+
+    def note_kv(self, path: str, prefix: bool, index: int) -> None:
+        self.notifies.append(("kv", path, prefix, index))
+
+    def note_table(self, table: str, index: int) -> None:
+        self.notifies.append(("table", table, index))
+
+    def note_set(self, key: str, index: int, old_index: int, existed: bool,
+                 flags: int, value: bytes) -> None:
+        self.kv_ops.append(("set", key, index, old_index, existed, flags, value))
+
+    def note_del(self, key: str, index: int, old_index: int) -> None:
+        self.kv_ops.append(("del", key, index, old_index))
+
+
 class StateStore:
     def __init__(self, gc_hint: Optional[Callable[[int], None]] = None,
                  kv_backend: Optional[object] = None) -> None:
@@ -142,10 +176,14 @@ class StateStore:
                            TABLE_TOMBSTONES, TABLE_SESSIONS, TABLE_ACLS)
         }
         self._watch: Dict[str, NotifyGroup] = {t: NotifyGroup() for t in self._last_index}
-        self._kv_watch = RadixTree()  # prefix -> NotifyGroup
+        self._kv_watch = KVWatchSet()  # prefix -> NotifyGroup plumbing
         # key -> monotonic expiry of the anti-split-brain lock delay
         self._lock_delay: Dict[str, float] = {}
         self._gc_hint = gc_hint
+        # Active ApplyCapture while an apply-batch scope is open (PR 11):
+        # mutation methods record what changed instead of firing watches;
+        # the scope exit (or the device bridge) fires them in one pass.
+        self._capture: Optional[ApplyCapture] = None
 
     # -- index / watch plumbing -------------------------------------------
 
@@ -164,36 +202,60 @@ class StateStore:
             self._watch[t].clear(waiter)
 
     def watch_kv(self, prefix: str, waiter: Waiter) -> None:
-        grp = self._kv_watch.get(prefix)
-        if grp is None:
-            grp = NotifyGroup()
-            self._kv_watch.insert(prefix, grp)
-        grp.wait(waiter)
+        self._kv_watch.watch(prefix, waiter)
 
     def stop_watch_kv(self, prefix: str, waiter: Waiter) -> None:
-        grp = self._kv_watch.get(prefix)
-        if grp is not None:
-            grp.clear(waiter)
-            if len(grp) == 0:
-                self._kv_watch.delete(prefix)
+        self._kv_watch.stop(prefix, waiter)
 
     def _notify(self, table: str) -> None:
+        if self._capture is not None:
+            self._capture.note_table(table, self._last_index[table])
+            return
         self._watch[table].notify()
 
-    def _notify_kv(self, path: str, prefix: bool) -> None:
+    def _notify_kv(self, path: str, prefix: bool,
+                   index: Optional[int] = None) -> None:
         """Wake watchers whose registered prefix covers ``path``
-        (reference notifyKV, state_store.go:463-491)."""
-        matched = list(self._kv_watch.walk_path(path))
-        if prefix:
-            matched += [(p, g) for p, g in self._kv_watch.walk_prefix(path)
-                        if len(p) > len(path)]
-        for p, g in matched:
-            g.notify()
-            # Fired groups are empty until waiters re-register; prune them
-            # so ephemeral prefixes don't accrete (reference toDelete loop,
-            # state_store.go:478-489).
-            if len(g) == 0:
-                self._kv_watch.delete(p)
+        (reference notifyKV, state_store.go:463-491). Inside an
+        apply-batch scope the event is recorded instead; the scope exit
+        replays it through the same KVWatchSet walk (or the device
+        bridge fires from its bitmask)."""
+        if self._capture is not None:
+            if index is None:
+                index = self._last_index[TABLE_KVS]
+            self._capture.note_kv(path, prefix, index)
+            return
+        self._kv_watch.notify(path, prefix)
+
+    @contextlib.contextmanager
+    def capture_apply(self):
+        """Scope for one committed-entry batch: watch firing is deferred
+        and per-key KV ops are recorded for the device store. Safe only
+        because the replicated apply path is synchronous — no waiter can
+        run (and so none can re-register) between the mutations and the
+        deferred fire, making deferred firing observably identical to
+        the reference's fire-per-mutation ordering.
+
+        On exit the capture is flushed through the host walk unless a
+        device bridge already consumed it (``cap.consumed = True``).
+        """
+        prev, self._capture = self._capture, ApplyCapture()
+        cap = self._capture
+        try:
+            yield cap
+        finally:
+            self._capture = prev
+            if not cap.consumed:
+                self.flush_capture(cap)
+
+    def flush_capture(self, cap: "ApplyCapture") -> None:
+        """Fire deferred notifies exactly as the sequential path would
+        have (same events, same order, same prune semantics)."""
+        for ev in cap.notifies:
+            if ev[0] == "kv":
+                self._kv_watch.notify(ev[1], ev[2])
+            else:
+                self._watch[ev[1]].notify()
 
     # -- catalog: nodes / services / checks --------------------------------
 
@@ -472,7 +534,12 @@ class StateStore:
 
         self._kv.put(d, old=exist)
         self._last_index[TABLE_KVS] = index
-        self._notify_kv(d.key, prefix=False)
+        if self._capture is not None:
+            self._capture.note_set(
+                d.key, index,
+                old_index=exist.modify_index if exist is not None else 0,
+                existed=exist is not None, flags=d.flags, value=d.value)
+        self._notify_kv(d.key, prefix=False, index=index)
         return True
 
     def kvs_get(self, key: str) -> Tuple[int, Optional[DirEntry]]:
@@ -544,6 +611,9 @@ class StateStore:
             if ent is None:
                 continue
             deleted += 1
+            if self._capture is not None:
+                self._capture.note_del(key, index,
+                                       old_index=ent.modify_index)
             tomb = ent.clone()
             tomb.modify_index = index
             tomb.value = b""
@@ -553,7 +623,7 @@ class StateStore:
         if deleted:
             self._last_index[TABLE_KVS] = index
             self._last_index[TABLE_TOMBSTONES] = index
-            self._notify_kv(notify_path, prefix=notify_prefix)
+            self._notify_kv(notify_path, prefix=notify_prefix, index=index)
             if self._gc_hint is not None:
                 self._gc_hint(index)
 
@@ -665,9 +735,14 @@ class StateStore:
             kv.session = ""
             kv.modify_index = index
             self._kv.put(kv, old=old)
+            if self._capture is not None:
+                self._capture.note_set(key, index,
+                                       old_index=old.modify_index,
+                                       existed=True, flags=kv.flags,
+                                       value=kv.value)
             if delay > 0:
                 self._lock_delay[key] = expires
-            self._notify_kv(key, prefix=False)
+            self._notify_kv(key, prefix=False, index=index)
         if keys:
             self._last_index[TABLE_KVS] = index
 

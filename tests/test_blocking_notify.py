@@ -1,0 +1,224 @@
+"""Pin tests for the blocking-query timeout clamp and NotifyGroup.
+
+These nail down the host-side watch plumbing semantics *before* the
+device-store refactor (PR 11): ``clamp_wait``'s default/max/jitter
+bounds (consul/rpc.go:366-377) and NotifyGroup's exactly-once +
+re-register contract (consul/notify.go:15-55). The refactored KVWatchSet
+and device watch matcher must keep every behavior pinned here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+from consul_tpu.server.blocking import (
+    DEFAULT_QUERY_TIME, JITTER_FRACTION, MAX_QUERY_TIME,
+    AsyncWaiter, blocking_query, clamp_wait)
+from consul_tpu.state.notify import NotifyGroup
+from consul_tpu.state.store import StateStore
+from consul_tpu.structs.structs import (
+    DirEntry, QueryMeta, QueryOptions, RegisterRequest)
+
+
+class Flag:
+    """Minimal Waiter: records every set() call."""
+
+    def __init__(self) -> None:
+        self.sets = 0
+
+    def set(self) -> None:
+        self.sets += 1
+
+
+class TestClampWait:
+    def test_zero_uses_default(self):
+        for _ in range(64):
+            w = clamp_wait(0)
+            assert DEFAULT_QUERY_TIME * (1 - 1 / JITTER_FRACTION) <= w
+            assert w <= DEFAULT_QUERY_TIME
+
+    def test_negative_uses_default(self):
+        w = clamp_wait(-5.0)
+        assert DEFAULT_QUERY_TIME * (1 - 1 / JITTER_FRACTION) <= w
+        assert w <= DEFAULT_QUERY_TIME
+
+    def test_capped_at_max(self):
+        for _ in range(64):
+            w = clamp_wait(10_000.0)
+            assert MAX_QUERY_TIME * (1 - 1 / JITTER_FRACTION) <= w
+            assert w <= MAX_QUERY_TIME
+
+    def test_explicit_wait_jittered_downward(self):
+        for _ in range(64):
+            w = clamp_wait(160.0)
+            assert 160.0 * (1 - 1 / JITTER_FRACTION) <= w <= 160.0
+
+    def test_jitter_varies(self):
+        # rpc.go:29-41: jitter staggers the re-poll herd — repeated
+        # clamps of the same request must not all collapse to one value.
+        vals = {round(clamp_wait(600.0), 9) for _ in range(32)}
+        assert len(vals) > 1
+
+
+class TestNotifyGroup:
+    def test_notify_fires_each_waiter_exactly_once(self):
+        g = NotifyGroup()
+        a, b = Flag(), Flag()
+        g.wait(a)
+        g.wait(b)
+        g.notify()
+        assert (a.sets, b.sets) == (1, 1)
+        # Registry swapped out: a second notify fires nobody.
+        g.notify()
+        assert (a.sets, b.sets) == (1, 1)
+
+    def test_double_register_is_idempotent(self):
+        g = NotifyGroup()
+        a = Flag()
+        g.wait(a)
+        g.wait(a)
+        assert len(g) == 1
+        g.notify()
+        assert a.sets == 1
+
+    def test_clear_deregisters(self):
+        g = NotifyGroup()
+        a, b = Flag(), Flag()
+        g.wait(a)
+        g.wait(b)
+        g.clear(a)
+        g.notify()
+        assert (a.sets, b.sets) == (0, 1)
+
+    def test_clear_unregistered_is_noop(self):
+        g = NotifyGroup()
+        g.clear(Flag())  # must not raise
+        assert len(g) == 0
+
+    def test_reregister_after_notify(self):
+        # notify.go:15-27 — the waiter re-registers on its next loop
+        # iteration and is woken again by the next mutation.
+        g = NotifyGroup()
+        a = Flag()
+        g.wait(a)
+        g.notify()
+        g.wait(a)
+        g.notify()
+        assert a.sets == 2
+
+
+class TestStoreWatchPlumbing:
+    """Pin the store-side registration API the refactor must preserve."""
+
+    def test_table_watch_fires_on_mutation(self):
+        # KV writes fire only the radix KV watch; table groups fire on
+        # catalog/session/acl mutations (state_store.go notify sites).
+        s = StateStore()
+        a = Flag()
+        s.watch(("nodes",), a)
+        s.ensure_registration(1, RegisterRequest(node="n1", address="1.2.3.4"))
+        assert a.sets == 1
+        # One-shot: a second write without re-register fires nothing.
+        s.ensure_registration(2, RegisterRequest(node="n2", address="1.2.3.5"))
+        assert a.sets == 1
+
+    def test_kv_prefix_watch_path_and_prefix(self):
+        s = StateStore()
+        exact, pfx, other = Flag(), Flag(), Flag()
+        s.watch_kv("web/a", exact)     # woken: key under this path
+        s.watch_kv("web/", pfx)        # woken: watch prefixes the key
+        s.watch_kv("db/", other)       # untouched prefix stays asleep
+        s.kvs_set(1, DirEntry(key="web/a/leaf", value=b"v"))
+        assert (exact.sets, pfx.sets, other.sets) == (1, 1, 0)
+
+    def test_stop_watch_kv_prunes(self):
+        s = StateStore()
+        a = Flag()
+        s.watch_kv("web/", a)
+        s.stop_watch_kv("web/", a)
+        s.kvs_set(1, DirEntry(key="web/x", value=b"v"))
+        assert a.sets == 0
+
+
+class TestAsyncWaiter:
+    def test_set_from_loop_and_thread(self):
+        async def main():
+            loop = asyncio.get_running_loop()
+            w = AsyncWaiter(loop)
+            w.set()  # same-loop path
+            await asyncio.wait_for(w._event.wait(), 1.0)
+            w.clear()
+            t = threading.Thread(target=w.set)  # cross-thread path
+            t.start()
+            await asyncio.wait_for(w._event.wait(), 1.0)
+            t.join()
+
+        asyncio.run(main())
+
+
+class TestBlockingQuery:
+    def _opts(self, min_index: int, wait: float = 5.0) -> QueryOptions:
+        return QueryOptions(min_query_index=min_index, max_query_time=wait)
+
+    def test_min_index_zero_runs_once(self):
+        s = StateStore()
+        runs = []
+
+        async def main():
+            meta = QueryMeta()
+
+            async def run():
+                runs.append(1)
+                meta.index = 7
+
+            await blocking_query(s, self._opts(0), meta, run,
+                                 tables=("kvs",))
+
+        asyncio.run(main())
+        assert runs == [1]
+
+    def test_wakes_on_kv_write(self):
+        s = StateStore()
+        s.kvs_set(5, DirEntry(key="web/a", value=b"v"))
+
+        async def main():
+            meta = QueryMeta()
+
+            async def run():
+                _, e = s.kvs_get("web/a")
+                meta.index = e.modify_index if e else 0
+
+            async def writer():
+                await asyncio.sleep(0.05)
+                s.kvs_set(9, DirEntry(key="web/a", value=b"v2"))
+
+            t = asyncio.get_running_loop().create_task(writer())
+            await asyncio.wait_for(
+                blocking_query(s, self._opts(5), meta, run,
+                               kv_prefix="web/a"),
+                timeout=3.0)
+            await t
+            return meta.index
+
+        assert asyncio.run(main()) == 9
+
+    def test_returns_on_deadline_without_write(self):
+        s = StateStore()
+        s.kvs_set(5, DirEntry(key="web/a", value=b"v"))
+
+        async def main():
+            meta = QueryMeta()
+
+            async def run():
+                meta.index = 5
+
+            # max_query_time is clamped+jittered but never inflated, so
+            # a 0.1s budget returns well inside the watchdog window.
+            await asyncio.wait_for(
+                blocking_query(s, self._opts(5, wait=0.1), meta, run,
+                               kv_prefix="web/a"),
+                timeout=3.0)
+            return meta.index
+
+        assert asyncio.run(main()) == 5

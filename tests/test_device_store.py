@@ -1,0 +1,308 @@
+"""Device-resident state store (state/device_store.py): deterministic
+replay, host/device lockstep, FSM batching integration, rebuild after
+restore, the hotpath byte cache, and the storestats exposition.
+
+The crossval oracle is the contract (ISSUE: bit-identical verdicts,
+fired sets, and wakeups on the forced 8-CPU-device mesh — conftest.py
+sets the mesh).  The suite keeps the fast sizing; the full vet-gate
+sweep lives in tools/store_crossval.py and the heavy tier here is
+``@pytest.mark.slow``.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from consul_tpu.state.device_store import (
+    DeviceStoreBridge, crossval)
+from consul_tpu.state.store import StateStore
+from consul_tpu.structs import codec
+from consul_tpu.structs.structs import (
+    DirEntry, KVSOp, KVSRequest, MessageType)
+
+
+def _kv_entry(key, value=b"v", op=KVSOp.SET.value, modify_index=0,
+              flags=0):
+    d = DirEntry(key=key, value=value)
+    d.flags = flags
+    if modify_index:
+        d.modify_index = modify_index
+    req = KVSRequest(op=op, dir_ent=d)
+    return bytes([MessageType.KVS]) + codec.encode_payload(req)
+
+
+def _batches(seed=0, n_batches=6, batch=8):
+    """A deterministic (index, data, ctx) entry stream with set /
+    delete / delete-tree / cas mixed in."""
+    rng = np.random.default_rng(seed)
+    out, index = [], 10
+    for _ in range(n_batches):
+        entries = []
+        for _ in range(batch):
+            index += 1
+            r = rng.random()
+            key = f"app/{int(rng.integers(12))}/k{int(rng.integers(6))}"
+            if r < 0.55:
+                data = _kv_entry(key, b"v%d" % index)
+            elif r < 0.75:
+                data = _kv_entry(key, op=KVSOp.DELETE.value)
+            elif r < 0.9:
+                data = _kv_entry(f"app/{int(rng.integers(12))}/",
+                                 op=KVSOp.DELETE_TREE.value)
+            else:
+                data = _kv_entry(key, b"c%d" % index, op=KVSOp.CAS.value)
+            entries.append((index, data, None))
+        out.append(entries)
+    return out
+
+
+def _fsm_with_bridge(capacity=1 << 9):
+    from consul_tpu.consensus.fsm import ConsulFSM
+
+    fsm = ConsulFSM()
+    fsm.attach_device_store(DeviceStoreBridge(capacity=capacity, probe=16,
+                                              stats=None))
+    return fsm
+
+
+class TestDeterministicReplay:
+    def test_same_stream_identical_table(self):
+        """Tier-1 pin for the acceptance criterion: replaying the same
+        batch sequence yields a bit-identical device table."""
+        tabs = []
+        for _ in range(2):
+            fsm = _fsm_with_bridge()
+            for entries in _batches(seed=3):
+                fsm.apply_batch(entries)
+            assert fsm.device.divergence == 0
+            tabs.append(fsm.device.table.tab)
+        for a, b in zip(*tabs):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_replay_after_reset_matches(self):
+        fsm = _fsm_with_bridge()
+        stream = _batches(seed=7, n_batches=4)
+        for entries in stream:
+            fsm.apply_batch(entries)
+        first = [np.asarray(a).copy() for a in fsm.device.table.tab]
+        fsm.device.table.reset()
+        fsm2 = _fsm_with_bridge()
+        for entries in stream:
+            fsm2.apply_batch(entries)
+        for a, b in zip(first, fsm2.device.table.tab):
+            assert np.array_equal(a, np.asarray(b))
+
+
+class TestCrossval:
+    def test_fast_oracle(self):
+        """In-suite slice of the crossval contract (the full sweep is
+        tools/store_crossval.py in `make vet`)."""
+        summary = crossval(n_batches=5, batch=12, n_watches=48,
+                           capacity=1 << 10, seed=1)
+        assert summary["divergence"] == 0
+        assert summary["degraded"] == 0
+
+    @pytest.mark.slow
+    def test_full_oracle_sweep(self):
+        for seed in range(3):
+            summary = crossval(n_batches=20, batch=32, n_watches=200,
+                               capacity=1 << 12, seed=seed)
+            assert summary["divergence"] == 0
+
+
+class TestFSMIntegration:
+    def test_batch_verdicts_lockstep(self):
+        fsm = _fsm_with_bridge()
+        for entries in _batches(seed=5):
+            fsm.apply_batch(entries)
+        assert fsm.device.divergence == 0
+        live, _tomb, degraded = fsm.device.occupancy()
+        assert degraded == 0
+        assert live == len(fsm.store.kvs_list("")[2])
+
+    def test_results_match_sequential(self):
+        """Same entries through a bridged and a plain FSM return the
+        same per-entry results (CAS verdicts included)."""
+        from consul_tpu.consensus.fsm import ConsulFSM
+
+        plain, bridged = ConsulFSM(), _fsm_with_bridge()
+        for entries in _batches(seed=9, n_batches=4):
+            r_plain = plain.apply_batch(entries)
+            r_bridged = bridged.apply_batch(entries)
+            assert r_plain == r_bridged
+        a = {e.key: (e.modify_index, e.value)
+             for _, e in (plain.store.kvs_get(k)
+                          for k in _all_keys(plain.store))}
+        b = {e.key: (e.modify_index, e.value)
+             for _, e in (bridged.store.kvs_get(k)
+                          for k in _all_keys(bridged.store))}
+        assert a == b
+
+    def test_bridge_failure_degrades_to_host(self):
+        fsm = _fsm_with_bridge()
+
+        def boom(cap, store):
+            raise RuntimeError("device fell over")
+
+        fsm.device.on_batch = boom
+        entries = [(21, _kv_entry("deg/a", b"x"), None),
+                   (22, _kv_entry("deg/b", b"y"), None)]
+        results = fsm.apply_batch(entries)
+        assert results == [None, None]
+        _, ent = fsm.store.kvs_get("deg/a")
+        assert ent is not None and ent.modify_index == 21
+
+    def test_watch_fires_through_batch(self):
+        fsm = _fsm_with_bridge()
+        fired = []
+
+        class Flag:
+            def set(self):
+                fired.append(True)
+
+        fsm.store.watch_kv("app/", Flag())
+        fsm.apply_batch([(31, _kv_entry("app/1/k0", b"z"), None)])
+        assert fired and fsm.device.divergence == 0
+
+
+def _all_keys(store):
+    return [e.key for e in store.kvs_list("")[2]]
+
+
+class TestRestoreRebuild:
+    def test_restore_reseeds_device(self):
+        fsm = _fsm_with_bridge()
+        for entries in _batches(seed=11, n_batches=3):
+            fsm.apply_batch(entries)
+        live_before = fsm.device.occupancy()[0]
+        snap = fsm.snapshot(999)
+
+        fsm2 = _fsm_with_bridge()
+        fsm2.restore(snap)
+        assert fsm2.device.occupancy()[0] == live_before
+        # Post-restore applies stay lockstep (create/modify split held).
+        for entries in _batches(seed=12, n_batches=2):
+            fsm2.apply_batch(entries)
+        assert fsm2.device.divergence == 0
+
+
+class TestByteCache:
+    def _srv(self):
+        store = StateStore()
+        return types.SimpleNamespace(store=store)
+
+    def test_hit_and_write_invalidation(self):
+        from consul_tpu.agent.hotpath import KVByteCache
+
+        srv = self._srv()
+        srv.store.kvs_set(5, DirEntry(key="c/a", value=b"one"))
+        cache = KVByteCache(srv)
+        row = cache.render("c/a")
+        assert row[1] == 200 and b"c/a" in row[3]
+        assert cache.lookup("c/a") == row and cache.hits == 1
+        srv.store.kvs_set(6, DirEntry(key="c/other", value=b"two"))
+        assert cache.lookup("c/a") is None  # any write invalidates
+        row2 = cache.render("c/a")
+        assert row2[0] == 6 and row2[4] == 5  # header index = entry's
+
+    def test_miss_renders_404(self):
+        from consul_tpu.agent.hotpath import KVByteCache
+
+        cache = KVByteCache(self._srv())
+        row = cache.render("nope")
+        assert row[1] == 404 and row[3] == b""
+
+    def test_refresh_only_cached_keys(self):
+        from consul_tpu.agent.hotpath import KVByteCache
+
+        srv = self._srv()
+        srv.store.kvs_set(5, DirEntry(key="c/a", value=b"one"))
+        cache = KVByteCache(srv)
+        cache.render("c/a")
+        srv.store.kvs_set(6, DirEntry(key="c/a", value=b"two"))
+        srv.store.kvs_set(7, DirEntry(key="c/b", value=b"three"))
+        cache.refresh(["c/a", "c/b"])
+        assert cache.lookup("c/a")[3].find(b"dHdv") >= 0  # b64("two")
+        assert "c/b" not in cache.entries  # never asked for -> not warmed
+
+    def test_fifo_bound(self):
+        from consul_tpu.agent.hotpath import KVByteCache
+
+        srv = self._srv()
+        cache = KVByteCache(srv, max_entries=4)
+        for i in range(8):
+            cache.render(f"k{i}")
+        assert len(cache.entries) == 4
+        assert "k0" not in cache.entries and "k7" in cache.entries
+
+    def test_attach_sets_render_hook(self):
+        from consul_tpu.agent.hotpath import attach_kv_cache
+
+        srv = self._srv()
+        bridge = types.SimpleNamespace(render_hook=None)
+        cache = attach_kv_cache(srv, bridge)
+        assert srv.kv_byte_cache is cache
+        assert bridge.render_hook == cache.refresh
+
+
+class TestStoreStatsExposition:
+    def test_families_pass_strict_checker(self):
+        from consul_tpu.obs.prom import render_prometheus
+        from consul_tpu.obs.storestats import StoreStats
+        from tools.check_prom import check_text
+
+        stats = StoreStats()
+        stats.watch_registered = 7
+        stats.note_apply(1.2, 16)
+        stats.note_apply(0.4, 3)
+        stats.note_match(0.8, 16, 5)
+        hists, gauges, counters = stats.families(
+            occupancy=(12, 3, 0), capacity=1 << 10)
+        text = render_prometheus([], histograms=hists,
+                                 labeled_counters=counters,
+                                 labeled_gauges=gauges)
+        assert check_text(text) == []
+        for fam in ("consul_store_dispatch_ms_bucket",
+                    "consul_store_apply_batch_entries_bucket",
+                    "consul_store_applied_entries_total",
+                    "consul_watch_fired_total",
+                    "consul_store_divergence_total",
+                    "consul_store_capacity",
+                    "consul_store_occupancy",
+                    "consul_watch_registered"):
+            assert fam in text, fam
+
+    def test_table_full_counter_only_when_degraded(self):
+        from consul_tpu.obs.storestats import StoreStats
+
+        stats = StoreStats()
+        _h, _g, counters = stats.families(occupancy=(1, 0, 0), capacity=64)
+        names = {c["name"] for c in counters}
+        assert "consul_store_table_full_total" not in names
+        _h, _g, counters = stats.families(occupancy=(1, 0, 2), capacity=64)
+        names = {c["name"] for c in counters}
+        assert "consul_store_table_full_total" in names
+
+
+class TestServerWiring:
+    def test_server_flag_attaches_bridge(self):
+        from consul_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(device_store=True,
+                                  device_store_capacity=1 << 9))
+        assert srv.fsm.device is not None
+        assert srv.fsm.device.capacity == 1 << 9
+
+    def test_config_validation(self):
+        from consul_tpu.agent.config import Config, validate_config
+
+        cfg = Config(device_store=True, server=False)
+        assert any("server mode" in p for p in validate_config(cfg))
+        cfg = Config(device_store=True, server=True,
+                     device_store_capacity=100)
+        assert any("power of two" in p for p in validate_config(cfg))
+        cfg = Config(device_store=True, server=True,
+                     device_store_capacity=1 << 10, node_name="n1",
+                     data_dir="/tmp/x")
+        assert not any("device_store" in p for p in validate_config(cfg))

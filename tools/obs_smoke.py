@@ -82,6 +82,21 @@ REQUIRED_RAFT = [
 REQUIRED_SECTIONS = {"metrics", "slo", "traces", "flight", "raft",
                      "device", "tasks"}
 
+# Device state-store observatory families (obs/storestats.py), present
+# on the third boot (device_store=True) after a little KV traffic with
+# a standing watch.
+REQUIRED_STORE = [
+    "consul_store_dispatch_ms_bucket",
+    "consul_store_apply_batch_entries_bucket",
+    "consul_store_applied_entries_total",
+    "consul_watch_fired_total",
+    "consul_watch_match_events_total",
+    "consul_store_divergence_total",
+    "consul_store_capacity",
+    "consul_store_occupancy",
+    "consul_watch_registered",
+]
+
 
 def _get(url: str) -> bytes:
     with urllib.request.urlopen(url, timeout=15) as r:
@@ -151,6 +166,54 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
         if agent is not None:
             await agent.stop()
         await plane.stop()
+
+
+async def _boot_device_store():
+    """Third boot: a swim-backed server agent with the device-resident
+    state store on (state/device_store.py).  KV writes travel raft's
+    commit→apply batching into the device table; a standing KV watch
+    exercises the device matcher; two GETs of the same key exercise the
+    index-validated byte cache.  Returns the Prometheus text plus the
+    bridge/cache ground truth for the caller's assertions."""
+    from consul_tpu.agent.agent import Agent, AgentConfig
+    from consul_tpu.consensus.raft import RaftConfig
+    from consul_tpu.server.blocking import AsyncWaiter
+
+    agent = Agent(AgentConfig(
+        node_name="obs-smoke-store", datacenter="dc1", server=True,
+        bootstrap=True, rpc_mesh_port=0, http_port=0, dns_port=0,
+        serf_wan_port=0, device_store=True,
+        device_store_capacity=1 << 10,
+        raft_config=RaftConfig(
+            heartbeat_interval=0.03, election_timeout_min=0.06,
+            election_timeout_max=0.12, rpc_timeout=0.5)))
+    await agent.start()
+    try:
+        srv = agent.server
+        waiter = AsyncWaiter(asyncio.get_running_loop())
+        srv.store.watch_kv("obs-smoke/", waiter)
+        host, port = agent.http.addr
+        base = f"http://{host}:{port}"
+        for i in range(6):
+            await asyncio.to_thread(
+                _put, f"{base}/v1/kv/obs-smoke/k{i}", b"v")
+        await waiter.wait(2.0)  # the device∪host matcher must wake us
+        for _ in range(2):      # second GET lands in the byte cache
+            await asyncio.to_thread(_get, f"{base}/v1/kv/obs-smoke/k0")
+        text = (await asyncio.to_thread(
+            _get, f"{base}/v1/agent/metrics?format=prometheus")).decode()
+        bridge = srv.fsm.device
+        cache = getattr(srv, "kv_byte_cache", None)
+        info = {
+            "attached": bridge is not None,
+            "divergence": None if bridge is None else bridge.divergence,
+            "occupancy": None if bridge is None else bridge.occupancy(),
+            "woke": waiter._event.is_set(),
+            "cache_hits": None if cache is None else cache.hits,
+        }
+        return text, info
+    finally:
+        await agent.stop()
 
 
 def _check_bundle(bundle: bytes, errors: list) -> None:
@@ -274,6 +337,35 @@ async def main() -> int:
         nerrors.append("scenarios breakdown row missing 'latency'")
     errors += nerrors
 
+    # -- device state-store phase: batched apply + device watch match
+    # must surface the consul_store_*/consul_watch_* families, wake the
+    # standing watch, keep host/device lockstep (divergence 0), and
+    # serve the second GET from the byte cache.
+    print("[obs-smoke] rebooting with device_store=True "
+          "(device table + watch matcher compile)...", flush=True)
+    stext, sinfo = await _boot_device_store()
+    serrors = check_text(stext)
+    snames = {n for n, _ in _iter_series(stext)}
+    for want in REQUIRED_STORE:
+        if want not in snames:
+            serrors.append(f"device-store scrape missing {want}")
+    if not _require_ok('consul_store_dispatch_ms_bucket{class="store_apply"}',
+                       list(_iter_series(stext)), serrors):
+        serrors.append("device-store scrape missing store_apply class")
+    if not _require_ok('consul_store_dispatch_ms_bucket{class="watch_match"}',
+                       list(_iter_series(stext)), serrors):
+        serrors.append("device-store scrape missing watch_match class")
+    if not sinfo["attached"]:
+        serrors.append("device_store=True but no bridge on the FSM")
+    if sinfo["divergence"] != 0:
+        serrors.append(f"device-store divergence {sinfo['divergence']} != 0")
+    if not sinfo["woke"]:
+        serrors.append("standing KV watch never woke on committed writes")
+    if not sinfo["cache_hits"]:
+        serrors.append(f"KV byte cache hits = {sinfo['cache_hits']!r}, "
+                       "wanted > 0")
+    errors += serrors
+
     for e in errors:
         print(f"[obs-smoke] FAIL: {e}", file=sys.stderr)
     if errors:
@@ -283,7 +375,8 @@ async def main() -> int:
           f"{snap.get('objective_rounds')} rounds, debug bundle "
           f"{len(bundle)} bytes; nemesis scrape "
           f"{len(ntext.splitlines())} lines, scenarios "
-          f"{sorted(scns)}")
+          f"{sorted(scns)}; device store occupancy "
+          f"{sinfo['occupancy']}, cache hits {sinfo['cache_hits']}")
     return 0
 
 

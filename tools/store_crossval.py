@@ -1,0 +1,67 @@
+"""Device state-store crossval gate: host/device lockstep or bust.
+
+Runs state/device_store.py's randomized crossval oracle — batched
+applies + watch matching through BOTH the host StateStore and the
+device table, asserting bit-identical modify-index/existed verdicts,
+identical fired-watcher sets, identical wakeups, and zero divergence —
+on the forced 8-CPU-device mesh (the multi-device sharding shape tests
+run under, tests/conftest.py).
+
+Fast mode (the `make vet` hook) trims the workload to a few seconds;
+the full mode sweeps more seeds and a deeper batch stream.
+
+Run: python -m tools.store_crossval [--fast] [--seeds N]
+Exit 0 clean, 1 on any divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") +
+     " --xla_force_host_platform_device_count=8").strip())
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="vet-gate sizing (a few seconds)")
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="seed count override")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from consul_tpu.state.device_store import crossval
+
+    if args.fast:
+        seeds = args.seeds or 2
+        kw = dict(n_batches=8, batch=16, n_watches=64, capacity=1 << 10)
+    else:
+        seeds = args.seeds or 4
+        kw = dict(n_batches=20, batch=32, n_watches=200, capacity=1 << 12)
+
+    print(f"[store-crossval] backend={jax.default_backend()} "
+          f"devices={jax.device_count()} seeds={seeds} {kw}", flush=True)
+    for seed in range(seeds):
+        try:
+            summary = crossval(seed=seed, **kw)
+        except AssertionError as e:
+            print(f"[store-crossval] FAIL seed={seed}: {e}", file=sys.stderr)
+            return 1
+        print(f"[store-crossval]   seed={seed}: {summary}", flush=True)
+    print("[store-crossval] ok: host/device lockstep held "
+          f"({seeds} seeds, {jax.device_count()} devices)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

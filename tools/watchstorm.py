@@ -1,0 +1,202 @@
+"""Watch-matching storm bench: device matcher vs host radix walk, A/B.
+
+The device-resident state store (state/device_store.py) evaluates the
+whole registered watch set against a committed batch in one device
+pass.  This bench prices that pass against the host's per-event radix
+walk (state/notify.py KVWatchSet.matched) on the SAME watch set and
+the SAME correlated mutation bursts, so BENCH_NOTES can quote an
+honest A/B instead of a synthetic kernel number.
+
+Workload shape (the Consul deployment the paper talks about): W
+standing prefix watches, one per service shard (``svc/<i>/``), plus a
+broad ``svc/`` watch that fires on everything.  Each batch is a
+correlated invalidation burst — all mutations land under a handful of
+hot shards, the way a deploy or a node death invalidates one service's
+keys at once rather than spraying the keyspace.
+
+Per batch the bench times:
+
+* host: ``watchset.matched(path)`` walked for every event in the
+  batch (exactly what ``DeviceStoreBridge._fire_watches`` runs as the
+  authoritative side);
+* device: event encoding + the jitted matcher dispatch + fetching the
+  fired vector (the production per-batch cost; watch-set encoding is
+  amortised across batches exactly as in production and is excluded,
+  but reported separately as ``encode_watches_ms``).
+
+Both sides' fired sets are compared every batch — a mismatch fails the
+run (the crossval contract, forward direction).  Timings are
+median-of-``--trials`` (default 3) over the per-trial mean batch
+latency.  Results land in BENCH_WATCH.json.
+
+Run (the `make bench-watch` target):
+    python -m tools.watchstorm --watches 10000
+Storm tiers (slow, gated behind explicit opt-in):
+    python -m tools.watchstorm --watches 10000,100000,1000000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+class _Flag:
+    """Inert NotifyGroup waiter (never awaited — matching only)."""
+
+    def set(self) -> None:  # pragma: no cover - never fired here
+        pass
+
+
+def _build_watchset(n_watches: int):
+    """W-1 shard watches + one broad ``svc/`` watch."""
+    from consul_tpu.state.notify import KVWatchSet
+
+    ws = KVWatchSet()
+    ws.watch("svc/", _Flag())
+    for i in range(n_watches - 1):
+        ws.watch(f"svc/{i:07d}/", _Flag())
+    return ws
+
+
+def _bursts(n_batches: int, batch: int, n_watches: int, seed: int,
+            hot_shards: int = 4):
+    """Correlated invalidation bursts: each batch mutates keys under
+    ``hot_shards`` randomly chosen shards.  Events are the capture
+    notify tuples _fire_watches consumes: (kind, path, prefix, index)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    index = 100
+    for _ in range(n_batches):
+        hot = rng.integers(0, max(n_watches - 1, 1), size=hot_shards)
+        evs = []
+        for k in range(batch):
+            shard = int(hot[k % hot_shards])
+            index += 1
+            evs.append(("kv", f"svc/{shard:07d}/key/{int(rng.integers(64))}",
+                        False, index))
+        out.append(evs)
+    return out
+
+
+def _host_pass(ws, batches):
+    """Radix walk per event, deduped per batch — the authoritative side
+    of _fire_watches, minus the firing."""
+    fired_sets = []
+    t0 = time.perf_counter()
+    for evs in batches:
+        seen = set()
+        for _, path, prefix, _idx in evs:
+            for p, _g in ws.matched(path, prefix):
+                seen.add(p)
+        fired_sets.append(seen)
+    return (time.perf_counter() - t0), fired_sets
+
+
+def _device_pass(bridge, groups, batches):
+    """Encode + dispatch + fetch per batch (production per-batch cost)."""
+    fired_sets = []
+    t0 = time.perf_counter()
+    for evs in batches:
+        events = bridge._encode_events(evs)
+        fired, _packed = bridge._match(*bridge._w_arrays, events)
+        fired = np.asarray(fired)[: len(groups)]
+        fired_sets.append({groups[i][0] for i in np.nonzero(fired)[0]})
+    return (time.perf_counter() - t0), fired_sets
+
+
+def run_tier(n_watches: int, batch: int, n_batches: int, trials: int,
+             seed: int) -> dict:
+    from consul_tpu.state.device_store import DeviceStoreBridge
+
+    ws = _build_watchset(n_watches)
+    bridge = DeviceStoreBridge(capacity=64, stats=None)
+    t0 = time.perf_counter()
+    bridge._encode_watches(ws)
+    encode_ms = (time.perf_counter() - t0) * 1e3
+    groups = bridge._w_groups
+
+    batches = _bursts(n_batches, batch, n_watches, seed)
+    # Warmup: compiles the matcher for this (W, B) shape.
+    _device_pass(bridge, groups, batches[:1])
+
+    host_ms, dev_ms = [], []
+    for _ in range(trials):
+        h_s, h_fired = _host_pass(ws, batches)
+        d_s, d_fired = _device_pass(bridge, groups, batches)
+        for b, (hf, df) in enumerate(zip(h_fired, d_fired)):
+            if hf != df:
+                raise SystemExit(
+                    f"[watchstorm] A/B DISAGREE at W={n_watches} batch {b}: "
+                    f"host-only={sorted(hf - df)[:3]} "
+                    f"device-only={sorted(df - hf)[:3]}")
+        host_ms.append(h_s * 1e3 / n_batches)
+        dev_ms.append(d_s * 1e3 / n_batches)
+
+    h_med, d_med = statistics.median(host_ms), statistics.median(dev_ms)
+    evals = n_watches * batch  # watch evaluations per device pass
+    return {
+        "watches": n_watches,
+        "events_per_batch": batch,
+        "batches": n_batches,
+        "trials": trials,
+        "host_ms_per_batch": round(h_med, 4),
+        "device_ms_per_batch": round(d_med, 4),
+        "device_evals_per_sec": round(evals / (d_med / 1e3)),
+        "host_speedup_over_device": round(d_med / h_med, 2),
+        "encode_watches_ms": round(encode_ms, 2),
+        "agreement": True,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--watches", default="10000",
+                    help="comma-separated watch-count tiers (default 10000)")
+    ap.add_argument("--events", type=int, default=256,
+                    help="mutations per burst batch")
+    ap.add_argument("--batches", type=int, default=20)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_WATCH.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+    tiers = [int(w) for w in str(args.watches).split(",")]
+    results = []
+    for w in tiers:
+        print(f"[watchstorm] tier W={w} B={args.events} "
+              f"({args.batches} batches x {args.trials} trials)...",
+              flush=True)
+        r = run_tier(w, args.events, args.batches, args.trials, args.seed)
+        print(f"[watchstorm]   host {r['host_ms_per_batch']}ms/batch  "
+              f"device {r['device_ms_per_batch']}ms/batch  "
+              f"({r['device_evals_per_sec']:,} evals/s device)", flush=True)
+        results.append(r)
+
+    out = {
+        "bench": "watchstorm",
+        "platform": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "tiers": results,
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"[watchstorm] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
