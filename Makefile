@@ -47,6 +47,7 @@ vet:
 	$(PYTHON) -m compileall -q $(VET_PATHS)
 	$(PYTHON) -m tools.vet $(VET_PATHS) --report vet_report.json
 	JAX_PLATFORMS=cpu $(PYTHON) -m tools.store_crossval --fast
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.fused_crossval --fast
 	$(MAKE) obs-smoke
 
 vet-fast:

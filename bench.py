@@ -19,11 +19,12 @@ and the dense-regime roofline estimate:
                  "realistic_churn10ppm_hot8": {...}, "multidc": {...}},
      "roofline_rounds_per_sec": N, ...}
 
-Two A/Bs ride the table so pending lowering decisions are settled by
-whatever capture next reaches a chip: churn1000ppm vs _planes is the
-dissemination-strategy A/B (params.dissem_swar), and
-realistic_churn10ppm vs _hot8 is the hot-tier decision
-(params.hot_slots) in the 1-2-live-episode regime the tier exists for.
+A/Bs ride the table so pending lowering decisions are settled by
+whatever capture next reaches a chip: churn1000ppm vs _planes vs
+_prefused is the dissemination-strategy A/B (params.dissem; _prefused
+also rides the healthy regime), and realistic_churn10ppm vs _hot8 is
+the hot-tier decision (params.hot_slots) in the 1-2-live-episode
+regime the tier exists for.
 
 The headline metric/value is the historical churn1000ppm stress regime
 (cross-round comparability); the healthy row is the operating point
@@ -214,7 +215,7 @@ def _sync(jax, state) -> None:
 
 
 def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
-               churn_ppm: int = 1000, dissem_swar: bool = True,
+               churn_ppm: int = 1000, dissem: str = "swar",
                hot_slots: int = 0, flight: bool = False,
                shard_devices: int = 0, nemesis: str = "",
                tl: _Timeline | None = None) -> dict:
@@ -226,7 +227,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         init_flight, init_state, run_rounds, run_rounds_sharded, shard_state)
     from consul_tpu.gossip.params import lan_profile
 
-    p = lan_profile(n, slots=slots, dissem_swar=dissem_swar,
+    p = lan_profile(n, slots=slots, dissem=dissem,
                     hot_slots=hot_slots)
     state = init_state(p)
     # shard_devices > 0: the shard_map'd kernel over that many local
@@ -338,7 +339,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         "metric": (f"swim_gossip_rounds_per_sec_{n}_nodes"
                    + ("" if churn_ppm == 1000 else f"_churn{churn_ppm}ppm")
                    + (f"_hot{hot_slots}" if hot_slots else "")
-                   + ("" if dissem_swar else "_planes")
+                   + ("" if dissem == "swar" else f"_{dissem}")
                    + ("_flight" if flight else "")
                    + (f"_shard{shard_devices}" if shard_devices else "")
                    + (f"_nem_{nemesis}" if nemesis else "")),
@@ -347,7 +348,7 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
         "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 3),
         "compile_s": round(compile_s, 1),
         "n_nodes": n,
-        "dissem": "swar" if dissem_swar else "planes",
+        "dissem": dissem,
         "hot_slots": hot_slots,
         "shard_devices": shard_devices,
     }
@@ -355,7 +356,8 @@ def _bench_lan(jax, n: int, slots: int, steps: int, repeats: int,
     # (consul_kernel_roofline_utilization — one derivation, devstats):
     # achieved HBM traffic over the §1c ceiling.  Quiescent regimes can
     # exceed 1.0 — they skip the dense passes the estimate assumes.
-    util = roofline_utilization(dense_bytes_per_round(slots, n), rps)
+    util = roofline_utilization(dense_bytes_per_round(slots, n, dissem),
+                                rps)
     if util is not None:
         result["roofline_utilization"] = round(util, 6)
     if flight:
@@ -472,18 +474,20 @@ _LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 # Metric-name shape: swim_{gossip|multidc}_rounds_per_sec_{n}_nodes
 # [+ "_churn{ppm}ppm" for non-default churn | "_{d}dc" for multidc]
-# [+ "_planes" for the fallback dissemination strategy]
+# [+ "_planes"/"_prefused"/"_fused" for a non-default dissemination
+#    strategy (params.dissem; swar has no suffix historically)]
 # [+ "_flight" with the kernel flight recorder enabled]
 # [+ "_shard{d}" for the shard_map'd kernel over d devices]
 # [+ "_nem_{scenario}" with a nemesis injection schedule active].
 _METRIC_RE = re.compile(
     r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
-    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?(_planes)?(_flight)?"
+    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?"
+    r"(_planes|_prefused|_fused)?(_flight)?"
     r"(?:_shard(\d+))?(?:_nem_([a-z0-9_]+))?$")
 
 
 def _regime_key(multidc: bool, churn_ppm: int,
-                planes: bool = False, hot: int = 0,
+                dissem: str = "swar", hot: int = 0,
                 flight: bool = False, shard: int = 0,
                 nemesis: str = "") -> tuple:
     """Cache-matching key: bench variant + churn regime + dissemination
@@ -493,7 +497,7 @@ def _regime_key(multidc: bool, churn_ppm: int,
     — a churn-0 quiescent entry is ~10x the churned number and must
     never stand in for it."""
     return ("multidc" if multidc else "gossip",
-            None if multidc else churn_ppm, planes, hot, flight, shard,
+            None if multidc else churn_ppm, dissem, hot, flight, shard,
             nemesis)
 
 
@@ -505,7 +509,7 @@ def _parse_metric_regime(name: str) -> tuple | None:
     variant = m.group(1)
     churn = int(m.group(3)) if m.group(3) is not None else 1000
     return (variant, None if variant == "multidc" else churn,
-            m.group(6) is not None,
+            m.group(6).lstrip("_") if m.group(6) is not None else "swar",
             int(m.group(5)) if m.group(5) is not None else 0,
             m.group(7) is not None,
             int(m.group(8)) if m.group(8) is not None else 0,
@@ -533,7 +537,7 @@ def _same_platform_class(a: str, b: str) -> bool:
     return a == b or (a in _CHIP_PLATFORMS and b in _CHIP_PLATFORMS)
 
 
-def _read_last_good(multidc: bool, churn_ppm: int, planes: bool = False,
+def _read_last_good(multidc: bool, churn_ppm: int, dissem: str = "swar",
                     hot: int = 0, flight: bool = False, shard: int = 0,
                     nemesis: str = "",
                     platform: str | None = None) -> dict | None:
@@ -542,7 +546,7 @@ def _read_last_good(multidc: bool, churn_ppm: int, planes: bool = False,
     A CPU smoke run must never stand in for a chip measurement (or vice
     versa); "axon"/"tpu"/untagged are all the chip class.  A corrupt
     cache must never take down the metric emit."""
-    want = _regime_key(multidc, churn_ppm, planes, hot, flight, shard,
+    want = _regime_key(multidc, churn_ppm, dissem, hot, flight, shard,
                        nemesis)
     plat = platform if platform is not None else _PLATFORM
     candidates = [
@@ -570,7 +574,7 @@ def _store_result(result: dict) -> None:
 
 
 def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
-                dissem_swar: bool = True, hot_slots: int = 0,
+                dissem: str = "swar", hot_slots: int = 0,
                 flight: bool = False, shard_devices: int = 0,
                 nemesis: str = "") -> dict:
     """One regime with reduced-N fallback.  Returns a result dict; on
@@ -597,7 +601,7 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
             else:
                 result = _bench_lan(jax, n, args.slots, args.steps,
                                     args.repeats, churn_ppm=churn_ppm,
-                                    dissem_swar=dissem_swar,
+                                    dissem=dissem,
                                     hot_slots=hot_slots, flight=flight,
                                     shard_devices=shard_devices,
                                     nemesis=nemesis, tl=tl)
@@ -623,7 +627,7 @@ def _run_regime(jax, args, *, multidc: bool, churn_ppm: int,
                "vs_baseline": 0.0,
                "error": f"all sizes failed; last: "
                         f"{type(last_err).__name__}: {last_err}"}
-    last = _read_last_good(multidc, churn_ppm, not dissem_swar, hot_slots,
+    last = _read_last_good(multidc, churn_ppm, dissem, hot_slots,
                            flight, shard_devices, nemesis)
     if last is not None:
         payload["last_known_good"] = last
@@ -648,9 +652,13 @@ def _roofline(n: int, slots: int) -> float:
 _NAMED_REGIMES: dict[str, dict] = {
     "healthy": dict(multidc=False, churn_ppm=0),
     "healthy_flight": dict(multidc=False, churn_ppm=0, flight=True),
+    "healthy_prefused": dict(multidc=False, churn_ppm=0,
+                             dissem="prefused"),
     "churn1000ppm": dict(multidc=False, churn_ppm=1000),
     "churn1000ppm_planes": dict(multidc=False, churn_ppm=1000,
-                                dissem_swar=False),
+                                dissem="planes"),
+    "churn1000ppm_prefused": dict(multidc=False, churn_ppm=1000,
+                                  dissem="prefused"),
     "realistic_churn10ppm": dict(multidc=False, churn_ppm=10),
     "realistic_churn10ppm_hot8": dict(multidc=False, churn_ppm=10,
                                       hot_slots=8),
@@ -689,9 +697,12 @@ def main() -> None:
     ap.add_argument("--churn-ppm", type=int, default=None,
                     help="single regime: failing nodes per million; 0 = "
                          "healthy-cluster (quiescent fast path)")
-    ap.add_argument("--dissem", choices=("swar", "planes"), default="swar",
+    ap.add_argument("--dissem",
+                    choices=("swar", "planes", "prefused", "fused"),
+                    default="swar",
                     help="dissemination strategy for single-regime runs "
-                         "(the table always measures both)")
+                         "(params.dissem; the table A/Bs swar vs planes "
+                         "vs prefused)")
     ap.add_argument("--hot-slots", dest="hot_slots", type=int, default=0,
                     help="hot-tier width for single-regime runs "
                          "(the table A/Bs full vs hot8 at realistic churn)")
@@ -750,7 +761,11 @@ def main() -> None:
                                                   platform=plat),
                 "churn1000ppm": _read_last_good(False, 1000, platform=plat),
                 "churn1000ppm_planes": _read_last_good(
-                    False, 1000, planes=True, platform=plat),
+                    False, 1000, dissem="planes", platform=plat),
+                "healthy_prefused": _read_last_good(
+                    False, 0, dissem="prefused", platform=plat),
+                "churn1000ppm_prefused": _read_last_good(
+                    False, 1000, dissem="prefused", platform=plat),
                 "realistic_churn10ppm": _read_last_good(
                     False, 10, platform=plat),
                 "realistic_churn10ppm_hot8": _read_last_good(
@@ -771,7 +786,7 @@ def main() -> None:
         else:
             churn = args.churn_ppm if args.churn_ppm is not None else 1000
             kwargs = dict(multidc=args.multidc, churn_ppm=churn,
-                          dissem_swar=args.dissem == "swar",
+                          dissem=args.dissem,
                           hot_slots=args.hot_slots, flight=args.flight,
                           shard_devices=args.shard_devices,
                           nemesis=args.nemesis)
@@ -789,11 +804,18 @@ def main() -> None:
                                             churn_ppm=0, flight=True)
     regimes["churn1000ppm"] = _run_regime(jax, args, multidc=False,
                                           churn_ppm=1000)
-    # Dissemination-strategy A/B in the stress regime: the table
-    # records both so the better lowering is picked from evidence
-    # (params.dissem_swar), not hope.
+    # Dissemination-strategy A/Bs in the stress regime: the table
+    # records all so the better lowering is picked from evidence
+    # (params.dissem), not hope.  prefused is the round-12 one-fewer-
+    # HBM-pass variant (age commuted across the rolls); it also rides
+    # the healthy regime because the quiescent fast path must not
+    # regress from carrying the alternate tail.
     regimes["churn1000ppm_planes"] = _run_regime(
-        jax, args, multidc=False, churn_ppm=1000, dissem_swar=False)
+        jax, args, multidc=False, churn_ppm=1000, dissem="planes")
+    regimes["churn1000ppm_prefused"] = _run_regime(
+        jax, args, multidc=False, churn_ppm=1000, dissem="prefused")
+    regimes["healthy_prefused"] = _run_regime(
+        jax, args, multidc=False, churn_ppm=0, dissem="prefused")
     # Hot-tier A/B at realistic churn (1-2 live episodes — the regime
     # the tier exists for; bench churn is ~100x real failure rates):
     # the captured pair IS the hot_slots default decision the last two

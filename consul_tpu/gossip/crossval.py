@@ -100,7 +100,8 @@ def loss_sized_slots(n: int, loss: float, base: int = 64) -> int:
 
 def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
                slots: int | None = None, pushpull: bool = False,
-               oracle: bool = True, ndev: int = 0) -> dict:
+               oracle: bool = True, ndev: int = 0,
+               dissem: str = "swar") -> dict:
     """One matched kernel-vs-oracle config; returns the report row.
 
     ``pushpull`` arms anti-entropy in BOTH models (memberlist
@@ -109,12 +110,16 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
     envelope only — the pure-Python oracle is tractable to a few
     thousand nodes, so the 100k BASELINE row (whose published
     criterion IS "p99 within Lifeguard bounds") runs kernel-only,
-    with the same config shape oracle-validated at 1k/10k."""
+    with the same config shape oracle-validated at 1k/10k.
+    ``dissem`` selects the kernel's dissemination lowering
+    (params.SwimParams.dissem) — the oracle never sees it, so running
+    the same config at two strategies is an end-to-end statistical
+    parity check on top of the bit-parity tier."""
     from consul_tpu.gossip.params import SwimParams
     if slots is None:
         slots = loss_sized_slots(n, loss)
     p = SwimParams(n=n, slots=slots, probe_every=5, loss_rate=loss,
-                   pushpull_every=150 if pushpull else 0)
+                   pushpull_every=150 if pushpull else 0, dissem=dissem)
     first_fail = 30
     spacing = max(5, p.suspicion_min_rounds // 4)
     fail_at = {(n // (n_victims + 1)) * (i + 1): first_fail + i * spacing
@@ -158,6 +163,7 @@ def run_config(n: int, n_victims: int, seeds: int, loss: float = 0.0,
         "n": n,
         "loss_rate": loss,
         "slots": slots,
+        "dissem": dissem,
         "pushpull_every": p.pushpull_every,
         # A skipped oracle must never read as an oracle that detected
         # nothing: its stats are None and the row says why.

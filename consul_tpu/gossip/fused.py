@@ -1,0 +1,236 @@
+"""One-pass Pallas dissemination: age + circulant gossip + SWAR-merge
+in a single traversal of the belief matrix.
+
+BENCH_NOTES §1c prices the dense round at ~5 full [S, N] passes (1
+read + 3 shifted reads + 1 write at the chip's ~185 GB/s effective
+bandwidth) and attributes the remaining headroom to XLA
+materialization boundaries between the age / gossip / merge stages.
+This module is the direct attack (ROADMAP item 2): a ``pallas_call``
+whose grid walks the observer axis in column blocks, reading each
+block of ``heard`` once, computing every rolled pin delivery *in
+VMEM*, and writing each output block once — the matrix crosses HBM
+twice per round instead of five times.
+
+**Static-offset block windows.** The circulant shifts are traced
+per-round scalars, and an earlier attempt to express the shifted
+reads as arbitrary-offset ``make_async_copy`` DMAs was rejected by
+Mosaic.  The restructuring that sidesteps it: a shift ``o``
+decomposes into a block part ``q = o // Bn`` and a residue
+``r = o % Bn``, so output block ``j`` of the rolled matrix is fully
+covered by input blocks ``(j - q - 1) % nb`` and ``(j - q) % nb``.
+Block indices are data-dependent but *block-granular* — exactly what
+``pltpu.PrefetchScalarGridSpec`` exists for: the ``(q, r)`` pairs ride
+a scalar-prefetch operand, the ``BlockSpec`` index maps read ``q``
+to pick the two windows, and the kernel body splices the residue with
+one in-VMEM ``dynamic_slice``.  No arbitrary-shift DMA anywhere.
+
+**Bit-exactness.** The merge body is the per-byte meaning of the SWAR
+word ops in ``kernel._disseminate_swar`` (every compared field is
+< 0x80, so ``_byte_ge``/``_byte_eq``/``_byte_sel`` are exact per-byte
+``>=``/``==``/``where``), and aging commutes with the rolls (it is
+elementwise; a roll is a permutation), so applying ``_age_tick``'s
+semantics to each rolled pin equals rolling the aged matrix.  Parity
+with ``_disseminate_swar`` is pinned bit-for-bit by
+``tests/test_fused_parity.py`` across healthy/churn/loss/pushpull/
+hot-tier/sharded rounds.
+
+**Where it runs.** Hardware is currently unreachable, so every path
+here must execute on this box: the kernel runs under
+``interpret=True`` whenever the backend is not a TPU (CPU CI, the
+8-device virtual mesh) and compiles via Mosaic on a real chip — §5c's
+next chip session flips nothing but the backend.
+
+**Sharded composition.** Under ``shard_map`` the rolled pins cross
+shard boundaries, which is the existing halo hop's job
+(``kernel._roll_sharded``: local roll + log2(P) conditional ppermutes
++ one neighbor exchange) — a Pallas grid cannot issue collectives
+mid-kernel.  The sharded leg therefore pre-rolls the pins in XLA and
+fuses everything after the halo (aging, budget mask, priority merge,
+confirmation count) in one elementwise Pallas pass over the local
+block.  Single-device keeps the full one-pass structure.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from consul_tpu.gossip.kernel import (_AGE_FRESH, _AGE_MASK, _CONF_MASK,
+                                      _CONF_SHIFT, _MSG_SHIFT, _nem_leg_drop,
+                                      _roll_sharded, _sloc, _sloc_roll,
+                                      MSG_SUSPECT, gossip_offsets)
+from consul_tpu.gossip.params import SwimParams
+
+
+@functools.lru_cache(maxsize=1)
+def _interpret() -> bool:
+    """Pallas interpret mode everywhere except a real TPU backend (the
+    CPU mesh runs the same kernel body through the reference
+    interpreter — bit-identical, just not fast)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _age_u8(x):
+    """``_age_tick`` semantics on int32 lanes each holding one belief
+    byte: fresh probe marks (``_AGE_FRESH``) become age 0, real ages
+    saturate at ``_AGE_MASK - 1``, message-free bytes are untouched."""
+    age = x & _AGE_MASK
+    new_age = jnp.where(age == _AGE_FRESH, 0,
+                        jnp.minimum(age + 1, _AGE_MASK - 1))
+    return jnp.where((x >> _MSG_SHIFT) > 0, (x & ~_AGE_MASK) | new_age, x)
+
+
+def _merge(p: SwimParams, cur, pins, srcs, rx, cap):
+    """Priority-max merge + Lifeguard confirmation counting on int32
+    lanes — the per-byte meaning of the SWAR block in
+    ``_disseminate_swar`` (each comment there applies here verbatim).
+    ``cur``/``pins`` are ALREADY aged; ``srcs``/``rx`` are 0/1 masks;
+    ``cap`` broadcasts per slot row."""
+    budget = p.spread_budget_rounds
+    in_msg = jnp.zeros_like(cur)
+    n_sus = jnp.zeros_like(cur)
+    for pin, src in zip(pins, srcs):
+        live = ((pin & _AGE_MASK) < budget) & (src > 0)
+        m = jnp.where(live, pin >> _MSG_SHIFT, 0)
+        in_msg = jnp.maximum(in_msg, m)
+        n_sus = n_sus + (m == MSG_SUSPECT).astype(jnp.int32)
+    rxm = rx > 0
+    cur_msg = cur >> _MSG_SHIFT
+    age_c = cur & _AGE_MASK
+    conf = (cur >> _CONF_SHIFT) & _CONF_MASK
+    upgraded = (in_msg > cur_msg) & rxm
+    bump = (cur_msg == MSG_SUSPECT) & (in_msg == MSG_SUSPECT) & rxm
+    # conf + n_sus <= 6: no overflow anywhere near the int32 lane.
+    conf_new = jnp.where(bump, jnp.minimum(conf + n_sus, cap), conf)
+    # Rising confirmation count refreshes the spread window (memberlist
+    # re-enqueue semantics — the long comment in _disseminate_swar).
+    conf_rose = conf_new > conf
+    out_msg = jnp.where(upgraded, in_msg, cur_msg)
+    out_age = jnp.where(upgraded | conf_rose, 0, age_c)
+    out_conf = jnp.where(upgraded, 0, conf_new)
+    return (out_msg << _MSG_SHIFT) | (out_conf << _CONF_SHIFT) | out_age
+
+
+def _src_masks(p: SwimParams, rnd, offs, mf, sc, nem, k_nem):
+    """[fanout, L] uint8 sender-liveness masks, one per gossip leg —
+    O(N) vectors built in XLA (they are three orders of magnitude
+    smaller than the belief matrix; fusing them into the Pallas pass
+    would buy nothing and cost the nemesis composition)."""
+    rows = []
+    for f in range(p.fanout):
+        o = offs[f]
+        mf_r = jnp.roll(mf, o) if sc is None else _sloc_roll(sc, mf, o)
+        src_live = mf_r > rnd
+        if nem is not None and nem.has_partition:
+            src_live = src_live & ~_nem_leg_drop(p, nem, k_nem, rnd, f, o,
+                                                 sc)
+        rows.append(src_live)
+    return jnp.stack(rows).astype(jnp.uint8)
+
+
+# -- single-device: the one-pass block-window kernel ----------------------
+
+def _fused_single(p: SwimParams, heard, offs, src, rx, cap) -> jnp.ndarray:
+    S, N = heard.shape
+    nb = p.fused_nb
+    if N % nb:
+        raise ValueError(
+            f"dissem='fused' needs n % fused_nb == 0 (n={N}, "
+            f"fused_nb={nb})")
+    Bn = N // nb
+    fanout = p.fanout
+
+    def kern(qr_ref, cur_ref, *rest):
+        ab = rest[:2 * fanout]
+        src_ref, rx_ref, cap_ref, out_ref = rest[2 * fanout:]
+        cur = _age_u8(cur_ref[...].astype(jnp.int32))
+        pins, srcs = [], []
+        for f in range(fanout):
+            # Window splice: blocks A|B side by side, the pin block
+            # starts r columns before the A/B seam (module docstring).
+            r = qr_ref[fanout + f]
+            pair = jnp.concatenate(
+                [ab[2 * f][...], ab[2 * f + 1][...]],
+                axis=1).astype(jnp.int32)
+            pin = jax.lax.dynamic_slice(pair, (0, Bn - r), (S, Bn))
+            pins.append(_age_u8(pin))
+            srcs.append(src_ref[f, :][None, :].astype(jnp.int32))
+        out = _merge(p, cur, pins, srcs,
+                     rx_ref[...].astype(jnp.int32),
+                     cap_ref[...].astype(jnp.int32))
+        out_ref[...] = out.astype(jnp.uint8)
+
+    in_specs = [pl.BlockSpec((S, Bn), lambda j, qr: (0, j))]
+    for f in range(fanout):
+        in_specs.append(pl.BlockSpec(
+            (S, Bn), lambda j, qr, f=f: (0, (j - qr[f] - 1) % nb)))
+        in_specs.append(pl.BlockSpec(
+            (S, Bn), lambda j, qr, f=f: (0, (j - qr[f]) % nb)))
+    in_specs += [
+        pl.BlockSpec((fanout, Bn), lambda j, qr: (0, j)),
+        pl.BlockSpec((1, Bn), lambda j, qr: (0, j)),
+        pl.BlockSpec((S, 1), lambda j, qr: (0, 0)),
+    ]
+    qr = jnp.concatenate([offs // Bn, offs % Bn]).astype(jnp.int32)
+    operands = [heard] + [heard] * (2 * fanout) + [
+        src, rx[None, :], cap.astype(jnp.int32)[:, None]]
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nb,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((S, Bn), lambda j, qr: (0, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, N), jnp.uint8),
+        interpret=_interpret(),
+    )(qr, *operands)
+
+
+# -- sharded: halo-hop pins in XLA, everything after fused ----------------
+
+def _fused_sharded(p: SwimParams, heard, offs, src, rx, cap,
+                   sc) -> jnp.ndarray:
+    S, L = heard.shape
+    fanout = p.fanout
+    pins = jnp.stack([_roll_sharded(sc, heard, offs[f])
+                      for f in range(fanout)])
+
+    def kern(cur_ref, pins_ref, src_ref, rx_ref, cap_ref, out_ref):
+        cur = _age_u8(cur_ref[...].astype(jnp.int32))
+        ps = [_age_u8(pins_ref[f].astype(jnp.int32))
+              for f in range(fanout)]
+        srcs = [src_ref[f, :][None, :].astype(jnp.int32)
+                for f in range(fanout)]
+        out = _merge(p, cur, ps, srcs,
+                     rx_ref[...].astype(jnp.int32),
+                     cap_ref[...].astype(jnp.int32))
+        out_ref[...] = out.astype(jnp.uint8)
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((S, L), jnp.uint8),
+        interpret=_interpret(),
+    )(heard, pins, src, rx[None, :], cap.astype(jnp.int32)[:, None])
+
+
+def fused_disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
+                      conf_cap, sc=None, nem=None,
+                      k_nem=None) -> jnp.ndarray:
+    """Drop-in for ``kernel._disseminate_swar`` behind
+    ``SwimParams.dissem == "fused"`` — same signature, bit-identical
+    output (module docstring)."""
+    offs = gossip_offsets(k_gossip, p.n, p.fanout)
+    src = _src_masks(p, rnd, offs, mf, sc, nem, k_nem)
+    rx_l = rx_ok if sc is None else _sloc(sc, rx_ok)
+    rx = rx_l.astype(jnp.uint8)
+    if sc is None:
+        return _fused_single(p, heard, offs, src, rx, conf_cap)
+    return _fused_sharded(p, heard, offs, src, rx, conf_cap, sc)

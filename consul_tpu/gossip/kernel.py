@@ -1177,20 +1177,27 @@ def _disseminate(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
                  conf_cap, sc=None, nem=None, k_nem=None) -> jnp.ndarray:
     """One round of rumor push: ``fanout`` circulant-shift deliveries,
     merged per destination with message-priority + Lifeguard
-    confirmation counting.  Dispatches on ``p.dissem_swar`` (static):
-    the two strategies are bit-identical (tested); the flag exists for
-    an on-chip A/B and a one-line fallback.
+    confirmation counting.  Dispatches on ``p.dissem`` (static): all
+    four strategies are bit-identical (tested); the switch exists for
+    on-chip A/Bs and a one-line fallback.
 
     ``nem``/``k_nem`` (static / replicated key): a partitioned nemesis
     schedule drops each cross-group delivery leg at the sender-group
     edge probability — per-leg full-[N] draws off ``k_nem`` (replicated,
     shard-sliced, so sharded and single-device rounds stay
     bit-identical)."""
-    if p.dissem_swar:
-        return _disseminate_swar(p, rnd, k_gossip, heard, mf, rx_ok,
+    if p.dissem == "planes":
+        return _disseminate_planes(p, rnd, k_gossip, heard, mf, rx_ok,
+                                   conf_cap, sc, nem, k_nem)
+    if p.dissem == "fused":
+        from consul_tpu.gossip.fused import fused_disseminate
+        return fused_disseminate(p, rnd, k_gossip, heard, mf, rx_ok,
                                  conf_cap, sc, nem, k_nem)
-    return _disseminate_planes(p, rnd, k_gossip, heard, mf, rx_ok, conf_cap,
-                               sc, nem, k_nem)
+    if p.dissem == "prefused":
+        return _disseminate_swar(p, rnd, k_gossip, heard, mf, rx_ok,
+                                 conf_cap, sc, nem, k_nem, prefuse=True)
+    return _disseminate_swar(p, rnd, k_gossip, heard, mf, rx_ok,
+                             conf_cap, sc, nem, k_nem)
 
 
 def _nem_leg_drop(p: SwimParams, nem, k_nem, rnd, f, o, sc):
@@ -1209,15 +1216,65 @@ def _nem_leg_drop(p: SwimParams, nem, k_nem, rnd, f, o, sc):
     return _nem_in_window(nem, rnd) & (g_src != g_dst) & (dv < p_edge)
 
 
+def _swar_age_field(packed):
+    """The aged AGE field alone (no recombination into the word): fresh
+    probe marks (the per-byte ``_AGE_FRESH`` sentinel) become age 0,
+    real ages saturate at 14, message-free bytes keep their raw age.
+    ``inc`` stays byte-isolated: age <= 0xF so age+1 never carries
+    across a byte lane."""
+    age = packed & jnp.uint32(_AGE4)
+    has_msg = ~_byte_eq(packed >> _MSG_SHIFT & jnp.uint32(_MSG4),
+                        jnp.uint32(0))
+    fresh = _byte_eq(age, jnp.uint32(_AGE4))  # == _AGE_FRESH per byte
+    inc = age + jnp.uint32(_LSB)
+    sat = _byte_ge(inc, jnp.uint32((_AGE_MASK - 1) * _LSB))
+    aged = _byte_sel(fresh, jnp.uint32(0),
+                     _byte_sel(sat, jnp.uint32((_AGE_MASK - 1) * _LSB), inc))
+    return _byte_sel(has_msg, aged, age)
+
+
+def _swar_age(packed):
+    """The age tick as SWAR on packed u32 words (see ``_age_tick`` for
+    the semantics): fresh probe marks (the per-byte ``_AGE_FRESH``
+    sentinel) become age 0, real ages saturate at 14, message-free
+    bytes are untouched.  ``inc`` stays byte-isolated: age <= 0xF so
+    age+1 never carries across a byte lane.  (Kept as a whole-word
+    select rather than ``_swar_age_field`` splicing — algebraically
+    identical, but this op shape is the one XLA:CPU fuses without an
+    extra materialization, measured via ``cost_analysis``.)"""
+    age = packed & jnp.uint32(_AGE4)
+    has_msg = ~_byte_eq(packed >> _MSG_SHIFT & jnp.uint32(_MSG4),
+                        jnp.uint32(0))
+    fresh = _byte_eq(age, jnp.uint32(_AGE4))  # == _AGE_FRESH per byte
+    inc = age + jnp.uint32(_LSB)
+    sat = _byte_ge(inc, jnp.uint32((_AGE_MASK - 1) * _LSB))
+    aged = _byte_sel(fresh, jnp.uint32(0),
+                     _byte_sel(sat, jnp.uint32((_AGE_MASK - 1) * _LSB), inc))
+    return _byte_sel(has_msg, (packed & ~jnp.uint32(_AGE4)) | aged, packed)
+
+
 def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
-                      conf_cap, sc=None, nem=None, k_nem=None) -> jnp.ndarray:
+                      conf_cap, sc=None, nem=None, k_nem=None,
+                      prefuse: bool = False) -> jnp.ndarray:
     """The belief matrix moves as u32 words holding FOUR slot-rows per
     element; the whole merge is SWAR on those words — one fused
     elementwise pass that reads the current matrix and the ``fanout``
     rolled copies once each, instead of the per-byte-plane loop that
     produces four separate [S4, N] outputs (each re-reading every
     pin).  IO per round drops from ~12 pin reads + 4 plane read/writes
-    to fanout+1 reads + 1 write."""
+    to fanout+1 reads + 1 write.
+
+    ``prefuse`` (static; ``p.dissem == "prefused"``): commute the age
+    tick across the circulant rolls.  Aging is elementwise and a roll
+    is a permutation, so ``roll(age(x)) == age(roll(x))`` exactly —
+    instead of materializing an aged copy of the whole packed matrix
+    before the pin reads (a full [S,N] read+write the multi-consumer
+    boundary forces on XLA), the deferred tick folds into each leg's
+    actual use: the pins' budget test becomes a shifted-threshold
+    compare on raw ages (see the in-loop comment — no per-pin age
+    pass at all), and the current-value leg computes only the aged
+    AGE field.  Bit-identical by the commutation; one fewer dense
+    pass by construction, and near-zero redundant flops."""
     S, N = heard.shape
     S4 = -(-S // 4)
     pad = 4 * S4 - S
@@ -1228,19 +1285,10 @@ def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
               | (planes[:, 2] << 16) | (planes[:, 3] << 24))
 
     # Age tick, fused into the packed chain (the standalone u8 pass
-    # costs a full read+write of the matrix): fresh probe marks
-    # (_AGE_FRESH sentinel) become age 0, real ages saturate at 14.
-    # See _age_tick for the semantics.
-    age = packed & jnp.uint32(_AGE4)
-    has_msg = ~_byte_eq(packed >> _MSG_SHIFT & jnp.uint32(_MSG4),
-                        jnp.uint32(0))
-    fresh = _byte_eq(age, jnp.uint32(_AGE4))  # == _AGE_FRESH per byte
-    inc = age + jnp.uint32(_LSB)
-    sat = _byte_ge(inc, jnp.uint32((_AGE_MASK - 1) * _LSB))
-    aged = _byte_sel(fresh, jnp.uint32(0),
-                     _byte_sel(sat, jnp.uint32((_AGE_MASK - 1) * _LSB), inc))
-    packed = _byte_sel(has_msg,
-                       (packed & ~jnp.uint32(_AGE4)) | aged, packed)
+    # costs a full read+write of the matrix).  The prefused strategy
+    # defers this into the per-leg chains below instead.
+    if not prefuse:
+        packed = _swar_age(packed)
 
     # Offsets are drawn over the GLOBAL observer count: under sharding
     # the local width is N/ndev but the circulant graph spans the pool.
@@ -1265,7 +1313,24 @@ def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
                         jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[None, :]
         pin = (jnp.roll(packed, o, axis=1) if sc is None
                else _roll_sharded(sc, packed, o))
-        live = ~_byte_ge(pin & jnp.uint32(_AGE4), budget_b) & src
+        if prefuse:
+            # The pin leg consumes the aged pin ONLY through (a) its
+            # msg bits — which aging never touches — and (b) this
+            # budget test, so the deferred age tick folds into the
+            # compare instead of running per pin:
+            #   aged_age >= b  ⟺  raw_age ∈ [b-1, 14], fresh exempt
+            # (aged = fresh ? 0 : min(age+1, 14), and b is clamped to
+            # [1, 14] by SwimParams.spread_budget_rounds, so no edge
+            # branches).  Message-free bytes disagree with the aged
+            # compare at raw_age ∈ {b-1, 0xF}, but their msg bits are
+            # 0 so ``m`` is 0 either way — bit-exact.
+            a = pin & jnp.uint32(_AGE4)
+            dead = (_byte_ge(a, jnp.uint32(
+                (p.spread_budget_rounds - 1) * _LSB))
+                    & ~_byte_eq(a, jnp.uint32(_AGE4)))
+            live = ~dead & src
+        else:
+            live = ~_byte_ge(pin & jnp.uint32(_AGE4), budget_b) & src
         m = (pin >> _MSG_SHIFT) & jnp.uint32(_MSG4) & live
         in_msg = _byte_sel(_byte_ge(m, in_msg), m, in_msg)
         n_sus = n_sus + ((_byte_eq(m, jnp.uint32(MSG_SUSPECT * _LSB))
@@ -1276,9 +1341,13 @@ def _disseminate_swar(p: SwimParams, rnd, k_gossip, heard, mf, rx_ok,
     cap_packed = (cap_b[:, 0] | (cap_b[:, 1] << 8)
                   | (cap_b[:, 2] << 16) | (cap_b[:, 3] << 24))[:, None]
 
-    cur_msg = (packed >> _MSG_SHIFT) & jnp.uint32(_MSG4)
-    age_c = packed & jnp.uint32(_AGE4)
-    conf = (packed >> _CONF_SHIFT) & jnp.uint32(_MSG4)
+    # The current-value leg needs the aged AGE field (it lands in
+    # ``out_age``), but msg/conf bits are age-invariant — under prefuse
+    # compute just the field instead of rebuilding the whole word.
+    cur = packed
+    cur_msg = (cur >> _MSG_SHIFT) & jnp.uint32(_MSG4)
+    age_c = _swar_age_field(packed) if prefuse else cur & jnp.uint32(_AGE4)
+    conf = (cur >> _CONF_SHIFT) & jnp.uint32(_MSG4)
     upgraded = ~_byte_ge(cur_msg, in_msg) & rx
     sus_b = jnp.uint32(MSG_SUSPECT * _LSB)
     bump = _byte_eq(cur_msg, sus_b) & _byte_eq(in_msg, sus_b) & rx

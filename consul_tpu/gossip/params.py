@@ -57,15 +57,45 @@ class SwimParams:
     # bandwidth instead (profile_kernel.py realistic_churn_* entries
     # are the decision gate).
     hot_slots: int = 0
-    # Dissemination merge strategy: True = single SWAR pass over the
-    # packed u32 words (round-4 rewrite, ~2.3x less IO by counting);
-    # False = the round-3 per-byte-plane loop (measured 155-166 r/s at
-    # 1M/64-slot churn).  Both are bit-identical; the switch exists so
-    # an on-chip A/B is one flag and a surprise regression on the real
-    # lowering is a one-line revert.
-    dissem_swar: bool = True
+    # Dissemination merge strategy (all four are bit-identical; the
+    # switch exists so an on-chip A/B is one flag and a surprise
+    # regression on the real lowering is a one-line revert):
+    #   "swar"     - single SWAR pass over packed u32 words (round-4
+    #                rewrite, ~2.3x less IO by counting; the default).
+    #   "planes"   - the round-3 per-byte-plane loop (measured 155-166
+    #                r/s at 1M/64-slot churn).
+    #   "prefused" - SWAR with the age tick commuted across the
+    #                circulant rolls (age is elementwise, roll is a
+    #                permutation, so age(roll(x)) == roll(age(x))):
+    #                no aged copy of the packed matrix is materialized
+    #                before the pin reads — one fewer full [S,N]
+    #                read+write per dense round (round 12).
+    #   "fused"    - Pallas one-pass kernel (gossip/fused.py): rolls,
+    #                merge, and aging in one traversal of the belief
+    #                matrix; interpret-mode on CPU, Mosaic on TPU.
+    dissem: str = "swar"
+    # Column-block count for the fused Pallas kernel's grid (dissem=
+    # "fused" only): the observer axis splits into this many
+    # ``n/fused_nb``-wide blocks, each read/written once per round.
+    # 1 = whole-row blocks (rolls become pure VMEM compute; the right
+    # shape whenever S rows fit VMEM).  Must divide ``n``; the slow
+    # parity tests sweep it.
+    fused_nb: int = 1
+
+    def __post_init__(self) -> None:
+        if self.dissem not in ("swar", "planes", "prefused", "fused"):
+            raise ValueError(
+                f"dissem must be swar|planes|prefused|fused, got "
+                f"{self.dissem!r}")
+        if self.fused_nb < 1:
+            raise ValueError(f"fused_nb must be >= 1, got {self.fused_nb}")
 
     # ---- derived, all static ----
+
+    @property
+    def dissem_swar(self) -> bool:
+        """Back-compat view of the pre-round-12 two-way A/B flag."""
+        return self.dissem != "planes"
 
     @property
     def log_n(self) -> float:

@@ -146,6 +146,11 @@ class PlaneConfig:
     # SLO observatory a per-failure-mode breakdown (/v1/agent/slo
     # ``scenarios``, scenario-labeled Prometheus histograms).
     nemesis: str = ""
+    # Dissemination merge strategy for the kernel round
+    # (params.SwimParams.dissem: swar | planes | prefused | fused —
+    # all bit-identical; see gossip/params.py).  The live-plane default
+    # stays "swar" until §5c's chip session settles the A/B.
+    dissem: str = "swar"
 
 
 @dataclass
@@ -277,7 +282,8 @@ class GossipPlane:
         self._p = SwimParams(
             n=n, slots=c.slots, probe_every=c.probe_every,
             suspicion_mult=c.suspicion_mult,
-            gossip_interval_s=c.gossip_interval_s)
+            gossip_interval_s=c.gossip_interval_s,
+            dissem=c.dissem)
         self._state = init_state(self._p)
         # Only registered agents (and live sim nodes) are members; start
         # with an empty membership and admit on register.
@@ -384,7 +390,7 @@ class GossipPlane:
         if self._dev is not None:
             self._dev.set_session(slots=c.slots, n=n,
                                   steps_per_dispatch=STEPS_PER_TICK,
-                                  ndev=ndev)
+                                  ndev=ndev, dissem=c.dissem)
         # run_rounds donates state+flight+hist (+nem_state): warm up on
         # copies so the session arrays survive the throwaway compile
         # dispatch.  The wall time around each warmup is the compile
@@ -1000,12 +1006,18 @@ class GossipPlane:
                      s.slot_of_node, s.incarnation, s.member, s.drops)
             return _probe_tick(p, s.round, keys, mf_, carry)[0]
 
+        # Label parity with tools/profile_kernel: the swar-family
+        # strategies age INSIDE dissemination, so their row is the
+        # merged age+gossip phase and the standalone age row is marked
+        # as such; planes really dispatches both.
+        dis_key = ("disseminate" if p.dissem == "planes"
+                   else "age_gossip_merge")
         out = {
-            "age_tick": timed(make_timed(_age_tick), st.heard,
-                              iters=4, warmup=1),
+            "age_tick_standalone": timed(make_timed(_age_tick), st.heard,
+                                         iters=4, warmup=1),
             "probe_tick": timed(make_timed(f_probe), st, mf,
                                 iters=4, warmup=1),
-            "disseminate": timed(
+            dis_key: timed(
                 make_timed(lambda h, m_, c_: _disseminate(
                     p, st.round, key, h, m_, rx, c_)),
                 st.heard, mf, cc, iters=4, warmup=1),

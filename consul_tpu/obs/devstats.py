@@ -58,6 +58,16 @@ from consul_tpu.version import VERSION
 EFFECTIVE_HBM_GBPS = 185.0
 DENSE_PASSES_PER_ROUND = 5
 
+# Analytic dense passes per dissemination strategy (BENCH_NOTES §13):
+# swar/planes materialize the aged matrix, the three rolled pins, and
+# the output (~5); prefused commutes the age tick across the rolls so
+# the aged copy never lands (~4); the fused Pallas kernel reads each
+# block once and writes it once (~2).  cost_analysis() supersedes all
+# of these when a lowering lands (DevStats.bytes_per_round).
+DENSE_PASSES_BY_DISSEM = {"swar": DENSE_PASSES_PER_ROUND,
+                          "planes": DENSE_PASSES_PER_ROUND,
+                          "prefused": 4, "fused": 2}
+
 # Jit dispatch classes the plane (and bench) attribute latency to.
 # ``multidc_outer`` is reserved for the multi-DC outer jit
 # (gossip/multidc.py run_multidc_rounds — bench regime today, a
@@ -78,10 +88,13 @@ def enabled() -> bool:
 
 # -- the shared roofline derivation (bench / profile / agent) -------------
 
-def dense_bytes_per_round(slots: int, n: int) -> float:
+def dense_bytes_per_round(slots: int, n: int,
+                          dissem: str = "swar") -> float:
     """HBM bytes one dense (non-quiescent) round moves: the §1c
-    analytic estimate used until a lowered cost_analysis() refines it."""
-    return float(DENSE_PASSES_PER_ROUND) * float(slots) * float(n)
+    analytic estimate (strategy-aware, DENSE_PASSES_BY_DISSEM) used
+    until a lowered cost_analysis() refines it."""
+    passes = DENSE_PASSES_BY_DISSEM.get(dissem, DENSE_PASSES_PER_ROUND)
+    return float(passes) * float(slots) * float(n)
 
 
 def roofline_utilization(bytes_per_round: float, rounds_per_sec: float,
@@ -224,6 +237,7 @@ class DevStats:
         self._n = 0
         self._steps_per_dispatch = 1
         self._ndev = 1
+        self._dissem = "swar"
         # Device rows sampled on the plane's flight-drain cadence (the
         # census walks every live array — too heavy per dispatch).
         self._device_rows: List[Dict[str, Any]] = []
@@ -268,11 +282,12 @@ class DevStats:
     # -- compile / session bookkeeping (cold path) ------------------------
 
     def set_session(self, slots: int, n: int, steps_per_dispatch: int,
-                    ndev: int = 1) -> None:
+                    ndev: int = 1, dissem: str = "swar") -> None:
         self._slots = int(slots)
         self._n = int(n)
         self._steps_per_dispatch = max(1, int(steps_per_dispatch))
         self._ndev = max(1, int(ndev))
+        self._dissem = str(dissem)
 
     def note_compile(self, name: str, wall_s: float,
                      cache_hit: Optional[bool] = None) -> None:
@@ -329,7 +344,8 @@ class DevStats:
                 steps = row.get("steps") or self._steps_per_dispatch
                 return b / max(1.0, steps), "cost_analysis"
         if self._slots and self._n:
-            return dense_bytes_per_round(self._slots, self._n), "dense"
+            return dense_bytes_per_round(self._slots, self._n,
+                                         self._dissem), "dense"
         return None, "unknown"
 
     def roofline(self) -> Dict[str, Any]:

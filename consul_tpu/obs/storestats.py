@@ -21,7 +21,10 @@ switch for everything device-side):
 * ``consul_store_table_full_total`` — probe-window exhaustion
   degradations (host unaffected, device row dropped);
 * ``consul_store_occupancy{state=live|tombstone}`` /
-  ``consul_store_capacity`` / ``consul_watch_registered`` gauges.
+  ``consul_store_capacity`` / ``consul_watch_registered`` gauges;
+* ``consul_watch_match_backend`` — the bridge auto-gate's live
+  decision (1 device matcher, 0 host radix walk), so a scrape shows
+  which leg production batches actually take on this backend.
 
 Conventions match the rest of obs/: plain-int banks (no 32-bit wrap
 anywhere host-side), no jax imports (gauge reads take pre-fetched ints,
@@ -69,6 +72,10 @@ class StoreStats:
         self.match_events = 0
         self.divergence = 0
         self.watch_registered = 0
+        # Watch-matching backend decision (DeviceStoreBridge auto-gate):
+        # None until the first batch decides; then True = device
+        # matcher, False = host radix walk.
+        self.match_backend_device: Optional[bool] = None
 
     # -- hot-path hooks (one is-not-None test at each call site) ------
 
@@ -104,6 +111,13 @@ class StoreStats:
             "help": "KV watches currently registered.",
             "rows": [({}, float(self.watch_registered))],
         }]
+        if self.match_backend_device is not None:
+            gauges.append({
+                "name": "consul_watch_match_backend",
+                "help": "Watch-matching backend the bridge auto-gate "
+                        "selected: 1 = device matcher, 0 = host radix "
+                        "walk (BENCH_WATCH.json crossover).",
+                "rows": [({}, 1.0 if self.match_backend_device else 0.0)]})
         if capacity:
             gauges.append({
                 "name": "consul_store_capacity",

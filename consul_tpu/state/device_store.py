@@ -293,6 +293,17 @@ class DeviceKVTable:
         return int(live), int(tomb), int(self.tab[7])
 
 
+# Auto-gate floor for the device watch matcher on a CPU backend.  The
+# measured crossover (BENCH_WATCH.json, 10k standing watches on this
+# box): host radix walk 0.6231 ms/batch vs device 14.1468 ms/batch —
+# the interpreted device pass is 22.71x SLOWER, dominated by per-batch
+# dispatch overhead that a real chip amortizes.  On CPU the device leg
+# only has a chance once the O(W x B) evaluation itself dwarfs
+# dispatch, far above the measured 10k point; on a non-CPU backend the
+# device matcher is taken unconditionally.
+WATCH_DEVICE_MIN_CPU = 1 << 16
+
+
 class DeviceStoreBridge:
     """Glue between the host store/FSM and the device twin.
 
@@ -311,16 +322,23 @@ class DeviceStoreBridge:
 
     def __init__(self, capacity: int = 1 << 16, probe: int = 16,
                  lmax: int = 64, max_batch: int = 4096,
-                 stats: Optional[object] = None) -> None:
+                 stats: Optional[object] = None,
+                 match_backend: str = "auto") -> None:
         import jax
         import jax.numpy as jnp
         from jax import lax
 
+        if match_backend not in ("auto", "device", "host"):
+            raise ValueError(
+                f"match_backend must be auto|device|host, got "
+                f"{match_backend!r}")
         self._jax, self._jnp = jax, jnp
         self.table = DeviceKVTable(capacity, probe)
         self.capacity = self.table.capacity
         self.lmax = int(lmax)
         self.max_batch = int(max_batch)
+        self.match_backend = match_backend
+        self._platform = jax.default_backend()
         self._match = _build_match(jnp, lax, jax, self.lmax)
         if stats is None:
             from consul_tpu.obs import storestats
@@ -480,11 +498,29 @@ class DeviceStoreBridge:
                 self.render_hook(keys)
         cap.consumed = True
 
+    def _use_device_match(self) -> bool:
+        """The watch-matching backend decision (``match_backend``).
+
+        "auto" picks the device matcher off-CPU, or on CPU once the
+        standing-watch population is large enough that the O(W x B)
+        evaluation dominates dispatch overhead (WATCH_DEVICE_MIN_CPU;
+        BENCH_WATCH.json medians).  Below that, the host radix walk —
+        which runs anyway as the authoritative path — is strictly
+        cheaper and the device leg is skipped entirely."""
+        if self.match_backend != "auto":
+            return self.match_backend == "device"
+        if self._platform != "cpu":
+            return True
+        return len(self._w_groups) >= WATCH_DEVICE_MIN_CPU
+
     def _fire_watches(self, cap, store) -> None:
         """Device bitmask ∪ host walk → NotifyGroup firing + prune."""
         watchset = store._kv_watch
         if watchset.version != self._w_version:
             self._encode_watches(watchset)
+        use_device = self._use_device_match()
+        if self.stats is not None:
+            self.stats.match_backend_device = use_device
 
         # Host-authoritative match set (ordered as the sequential path
         # would have fired), incl. the delete-tree reverse direction and
@@ -501,7 +537,8 @@ class DeviceStoreBridge:
 
         kv_events = [ev for ev in cap.notifies if ev[0] == "kv"]
         device_fired: List[Tuple[str, object]] = []
-        if kv_events and self._w_groups:
+        host_keys = {id(g) for p, g in host_fired}
+        if kv_events and self._w_groups and use_device:
             t0 = time.monotonic()
             events = self._encode_events(kv_events)
             fired, _packed = self._match(*self._w_arrays, events)
@@ -513,24 +550,26 @@ class DeviceStoreBridge:
                 self.stats.note_match(ms, len(kv_events),
                                       int(fired.sum()))
 
-        # Device must agree with the host walk on every watch it
-        # encodes, *except* the delete-tree reverse direction which is
-        # host-only by design (module docstring).
-        host_keys = {id(g) for p, g in host_fired}
-        dev_keys = {id(g) for p, g in device_fired}
-        encoded = {id(g) for _, g in self._w_groups}
-        expect_dev = set()
-        for p, g in host_fired:
-            if id(g) not in encoded:
-                continue  # over-lmax fallback watch, host-only by design
-            if any(ev[1].startswith(p) for ev in kv_events):
-                # The forward (path startswith watch) direction is the
-                # device's; reverse-only tree matches are host-only.
-                expect_dev.add(id(g))
-        missing = {k for k in expect_dev if k not in dev_keys}
-        spurious = dev_keys - host_keys
-        if missing or spurious:
-            self.divergence += len(missing) + len(spurious)
+            # Device must agree with the host walk on every watch it
+            # encodes, *except* the delete-tree reverse direction which
+            # is host-only by design (module docstring).  The
+            # cross-check only means something when the device matcher
+            # actually ran — a host-gated batch has nothing to compare.
+            dev_keys = {id(g) for p, g in device_fired}
+            encoded = {id(g) for _, g in self._w_groups}
+            expect_dev = set()
+            for p, g in host_fired:
+                if id(g) not in encoded:
+                    continue  # over-lmax fallback watch, host-only
+                if any(ev[1].startswith(p) for ev in kv_events):
+                    # The forward (path startswith watch) direction is
+                    # the device's; reverse-only tree matches are
+                    # host-only.
+                    expect_dev.add(id(g))
+            missing = {k for k in expect_dev if k not in dev_keys}
+            spurious = dev_keys - host_keys
+            if missing or spurious:
+                self.divergence += len(missing) + len(spurious)
         if self.stats is not None:
             self.stats.divergence = self.divergence
 
@@ -605,7 +644,10 @@ def crossval(n_batches: int = 20, batch: int = 32, n_watches: int = 200,
 
     rng = random.Random(seed)
     store = StateStore()
-    bridge = DeviceStoreBridge(capacity=capacity, lmax=lmax, stats=None)
+    # match_backend forced: the lockstep oracle exists to exercise the
+    # device matcher, so the CPU auto-gate must not silently skip it.
+    bridge = DeviceStoreBridge(capacity=capacity, lmax=lmax, stats=None,
+                               match_backend="device")
     prefixes = ["web/", "web/a/", "db/", "db/shard/", "cfg/", ""]
 
     class Flag:

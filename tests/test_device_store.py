@@ -61,8 +61,12 @@ def _fsm_with_bridge(capacity=1 << 9):
     from consul_tpu.consensus.fsm import ConsulFSM
 
     fsm = ConsulFSM()
+    # match_backend forced: these tests exist to exercise the device
+    # matcher + lockstep cross-check, which the CPU auto-gate would
+    # otherwise skip (test_watch_match_auto_gate pins the gate itself).
     fsm.attach_device_store(DeviceStoreBridge(capacity=capacity, probe=16,
-                                              stats=None))
+                                              stats=None,
+                                              match_backend="device"))
     return fsm
 
 
@@ -244,6 +248,103 @@ class TestByteCache:
         cache = attach_kv_cache(srv, bridge)
         assert srv.kv_byte_cache is cache
         assert bridge.render_hook == cache.refresh
+
+
+class TestWatchMatchAutoGate:
+    """The match_backend auto-gate (DeviceStoreBridge): on this CPU box
+    the device matcher loses by ~23x (BENCH_WATCH.json), so production
+    batches must take the host radix walk — and say so on the gauge."""
+
+    def _bridged_fsm(self, stats):
+        from consul_tpu.consensus.fsm import ConsulFSM
+
+        fsm = ConsulFSM()
+        fsm.attach_device_store(
+            DeviceStoreBridge(capacity=1 << 9, stats=stats))
+        return fsm
+
+    def test_auto_chooses_host_on_cpu(self):
+        from consul_tpu.obs.storestats import StoreStats
+
+        stats = StoreStats()
+        fsm = self._bridged_fsm(stats)
+        fired = []
+
+        class Flag:
+            def set(self):
+                fired.append(True)
+
+        fsm.store.watch_kv("gate/", Flag())
+        fsm.apply_batch([(41, _kv_entry("gate/k", b"v"), None)])
+        # Decision recorded, host leg selected, device matcher skipped
+        # entirely — but the (host-authoritative) watch still fired.
+        assert stats.match_backend_device is False
+        assert stats.match_events == 0
+        assert fired
+        assert fsm.device.divergence == 0
+
+    def test_gate_heuristic_and_overrides(self):
+        from consul_tpu.state.device_store import WATCH_DEVICE_MIN_CPU
+
+        b = DeviceStoreBridge(capacity=64, stats=None)
+        assert b._platform == "cpu" and b.match_backend == "auto"
+        assert b._use_device_match() is False
+        # Non-CPU backend: device unconditionally.
+        b._platform = "tpu"
+        assert b._use_device_match() is True
+        # CPU past the standing-watch floor: device.
+        b._platform = "cpu"
+        b._w_groups = [("p", None)] * WATCH_DEVICE_MIN_CPU
+        assert b._use_device_match() is True
+        # Explicit overrides beat the heuristic both ways.
+        b.match_backend = "host"
+        assert b._use_device_match() is False
+        b.match_backend = "device"
+        b._w_groups = []
+        assert b._use_device_match() is True
+        with pytest.raises(ValueError):
+            DeviceStoreBridge(capacity=64, stats=None,
+                              match_backend="maybe")
+
+    def test_forced_device_still_crosschecks(self):
+        from consul_tpu.obs.storestats import StoreStats
+
+        from consul_tpu.consensus.fsm import ConsulFSM
+
+        stats = StoreStats()
+        fsm = ConsulFSM()
+        fsm.attach_device_store(DeviceStoreBridge(
+            capacity=1 << 9, stats=stats, match_backend="device"))
+
+        class Flag:
+            def set(self):
+                pass
+
+        fsm.store.watch_kv("gate/", Flag())
+        fsm.apply_batch([(51, _kv_entry("gate/k", b"v"), None)])
+        assert stats.match_backend_device is True
+        assert stats.match_events > 0
+        assert fsm.device.divergence == 0
+
+    def test_backend_gauge_exported(self):
+        from consul_tpu.obs.prom import render_prometheus
+        from consul_tpu.obs.storestats import StoreStats
+        from tools.check_prom import check_text
+
+        stats = StoreStats()
+        _h, gauges, _c = stats.families()
+        assert not any(g["name"] == "consul_watch_match_backend"
+                       for g in gauges)  # no decision yet -> no row
+        stats.match_backend_device = False
+        hists, gauges, counters = stats.families()
+        rows = [g for g in gauges
+                if g["name"] == "consul_watch_match_backend"]
+        assert rows and rows[0]["rows"][0][1] == 0.0
+        text = render_prometheus([], histograms=hists,
+                                 labeled_counters=counters,
+                                 labeled_gauges=gauges)
+        assert check_text(text) == []
+        assert "consul_watch_match_backend" in text
 
 
 class TestStoreStatsExposition:

@@ -1,0 +1,235 @@
+"""Dissemination-strategy parity (PR 12 tentpole): the Pallas one-pass
+``fused`` kernel (gossip/fused.py, interpret-mode on this CPU box) and
+the roll-commuted ``prefused`` XLA tail must be bit-identical to the
+SWAR reference — at the single-call level on small shapes, over full
+round loops in every regime with a distinct code path (healthy, churn,
+loss, push-pull, hot tier), through the 8-device shard_map lowering
+(fused's halo-hop hybrid), and under nemesis injection.  The slow tier
+sweeps the fused kernel's column-block grid (``SwimParams.fused_nb``)
+across divisors of n, including single-column blocks.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.timeout_s(600)
+
+NEVER = 2**31 - 1
+STRATEGIES = ("prefused", "fused")
+
+
+def _assert_state_equal(a, b, ctx=""):
+    for f in a._fields:
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert np.array_equal(x, y), f"{ctx}SwimState.{f} diverged"
+
+
+def _random_round_inputs(S, N, seed=0):
+    """A saturated, adversarial belief matrix + masks: every message
+    kind, confirmation count, and age (incl. the _AGE_FRESH sentinel
+    and budget-edge values) so each merge branch is exercised."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    heard = ((rng.integers(0, 4, (S, N)) << 6)
+             | (rng.integers(0, 4, (S, N)) << 4)
+             | rng.integers(0, 16, (S, N))).astype(np.uint8)
+    mf = rng.choice(np.asarray([-1, 10, 200, NEVER], np.int32), (N,))
+    rx_ok = rng.random(N) < 0.9
+    conf_cap = rng.integers(0, 4, (S,)).astype(np.int32)
+    return (jnp.asarray(heard), jnp.asarray(mf), jnp.asarray(rx_ok),
+            jnp.asarray(conf_cap))
+
+
+def _dis(p, heard, mf, rx_ok, conf_cap, rnd=50, seed=3):
+    import jax
+
+    from consul_tpu.gossip.kernel import _disseminate
+    return np.asarray(_disseminate(p, rnd, jax.random.key(seed), heard,
+                                   mf, rx_ok, conf_cap))
+
+
+def _end_state(p, fail, steps, ndev=0, seed=7):
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.kernel import (init_state, run_rounds,
+                                          run_rounds_sharded, shard_state)
+    key = jax.random.PRNGKey(seed)
+    if ndev > 1:
+        st, _ = run_rounds_sharded(shard_state(init_state(p), ndev), key,
+                                   jnp.asarray(fail), p, steps, ndev=ndev)
+    else:
+        st, _ = run_rounds(init_state(p), key, jnp.asarray(fail), p, steps)
+    return st
+
+
+def _fails(n, spec):
+    f = np.full(n, NEVER, np.int32)
+    for idx, rnd in spec:
+        f[idx] = rnd
+    return f
+
+
+class TestSingleCallParity:
+    """One _disseminate call on adversarial inputs — the finest-grained
+    pin: any divergence here names the exact output bytes."""
+
+    @pytest.mark.parametrize("shape", [(4, 24), (8, 120), (16, 96)])
+    def test_all_strategies_match_swar(self, shape):
+        from consul_tpu.gossip.params import SwimParams
+
+        S, N = shape
+        heard, mf, rx_ok, cap = _random_round_inputs(S, N)
+        ref = _dis(SwimParams(n=N, slots=S), heard, mf, rx_ok, cap)
+        for dissem in ("planes",) + STRATEGIES:
+            p = SwimParams(n=N, slots=S, dissem=dissem)
+            np.testing.assert_array_equal(
+                _dis(p, heard, mf, rx_ok, cap), ref, err_msg=dissem)
+
+    def test_fused_block_grid_small(self):
+        """A first block sweep rides tier-1 (nb=1 whole-row, nb=4, and
+        a residue-heavy nb); the divisor sweep is @slow."""
+        from consul_tpu.gossip.params import SwimParams
+
+        S, N = 8, 120
+        heard, mf, rx_ok, cap = _random_round_inputs(S, N, seed=1)
+        ref = _dis(SwimParams(n=N, slots=S), heard, mf, rx_ok, cap)
+        for nb in (1, 4, 24):
+            p = SwimParams(n=N, slots=S, dissem="fused", fused_nb=nb)
+            np.testing.assert_array_equal(
+                _dis(p, heard, mf, rx_ok, cap), ref, err_msg=f"nb={nb}")
+
+    def test_fused_nb_must_divide_n(self):
+        from consul_tpu.gossip.params import SwimParams
+
+        S, N = 4, 24
+        heard, mf, rx_ok, cap = _random_round_inputs(S, N)
+        p = SwimParams(n=N, slots=S, dissem="fused", fused_nb=7)
+        with pytest.raises(ValueError, match="fused_nb"):
+            _dis(p, heard, mf, rx_ok, cap)
+
+    def test_dissem_value_validated(self):
+        from consul_tpu.gossip.params import SwimParams
+
+        with pytest.raises(ValueError, match="dissem"):
+            SwimParams(n=64, dissem="bogus")
+        with pytest.raises(ValueError, match="fused_nb"):
+            SwimParams(n=64, fused_nb=0)
+
+
+REGIMES = {
+    "healthy": (dict(), []),
+    "churn": (dict(), [(40, 20), (90, 35), (170, 50), (230, 65)]),
+    "loss": (dict(loss_rate=0.1), [(40, 20), (170, 50)]),
+    "pushpull": (dict(pushpull_every=20, loss_rate=0.05),
+                 [(40, 20), (170, 50)]),
+    "hot_tier": (dict(hot_slots=4), [(40, 20), (170, 50)]),
+}
+
+
+class TestFullRoundParity:
+    @pytest.mark.parametrize("regime", sorted(REGIMES))
+    def test_regime_parity(self, regime):
+        """200 full rounds per regime: the entire SwimState — heard
+        matrix, slot registers, counters — bit-identical to SWAR."""
+        from consul_tpu.gossip.params import SwimParams
+
+        kw, spec = REGIMES[regime]
+        n, steps = 240, 200
+        fail = _fails(n, spec)
+        base = dict(n=n, slots=16, probe_every=5, **kw)
+        ref = _end_state(SwimParams(**base), fail, steps)
+        if spec:  # churny regimes must actually detect something
+            assert int(ref.n_detected) > 0
+        for dissem in STRATEGIES:
+            st = _end_state(SwimParams(**base, dissem=dissem), fail, steps)
+            _assert_state_equal(ref, st, f"{regime}/{dissem} ")
+
+    def test_sharded8_parity(self):
+        """The halo-hop composition: fused/prefused through the
+        8-device shard_map lowering vs the UNSHARDED SWAR reference —
+        one comparison spanning both the strategy and the sharding."""
+        from consul_tpu.gossip.params import SwimParams
+
+        n, steps = 320, 200
+        fail = _fails(n, [(40, 20), (90, 35), (170, 50), (310, 65)])
+        base = dict(n=n, slots=16, probe_every=5, loss_rate=0.05)
+        ref = _end_state(SwimParams(**base), fail, steps)
+        assert int(ref.n_detected) > 0
+        for dissem in STRATEGIES:
+            st = _end_state(SwimParams(**base, dissem=dissem), fail,
+                            steps, ndev=8)
+            _assert_state_equal(ref, st, f"sharded8/{dissem} ")
+
+    def test_nemesis_parity(self):
+        """Fault-mask composition: _src_masks folds the nemesis edge
+        drops into the fused path in XLA; the asym_loss schedule must
+        leave all strategies bit-identical."""
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.gossip.kernel import init_state, run_rounds
+        from consul_tpu.gossip.nemesis import build
+        from consul_tpu.gossip.params import SwimParams
+
+        n, steps = 160, 120
+        sc = build("asym_loss", n)
+        key = jax.random.PRNGKey(13)
+        fail = jnp.asarray(sc.fail_round)
+
+        def end(dissem):
+            p = SwimParams(n=n, slots=16, probe_every=5, dissem=dissem)
+            st, _ = run_rounds(init_state(p), key, fail, p, steps,
+                               nem=sc.nem)
+            return st
+
+        ref = end("swar")
+        for dissem in STRATEGIES:
+            _assert_state_equal(ref, end(dissem), f"asym_loss/{dissem} ")
+
+
+@pytest.mark.slow
+class TestFusedParitySlow:
+    def test_fused_block_divisor_sweep(self):
+        """Every divisor of n as the grid's column-block count,
+        including nb=n (single-column blocks, maximal residue splicing)
+        — the index-map / residue algebra must hold at every Bn."""
+        from consul_tpu.gossip.params import SwimParams
+
+        S, N = 8, 120
+        heard, mf, rx_ok, cap = _random_round_inputs(S, N, seed=2)
+        ref = _dis(SwimParams(n=N, slots=S), heard, mf, rx_ok, cap)
+        divisors = [d for d in range(1, N + 1) if N % d == 0]
+        for nb in divisors:
+            p = SwimParams(n=N, slots=S, dissem="fused", fused_nb=nb)
+            np.testing.assert_array_equal(
+                _dis(p, heard, mf, rx_ok, cap), ref, err_msg=f"nb={nb}")
+
+    def test_full_round_fused_block_sweep(self):
+        """Block-size sweep through full round loops (slot recycling,
+        probe marks, refutes all live), not just one call."""
+        from consul_tpu.gossip.params import SwimParams
+
+        n, steps = 240, 150
+        fail = _fails(n, [(40, 20), (170, 50)])
+        base = dict(n=n, slots=16, probe_every=5, loss_rate=0.05)
+        ref = _end_state(SwimParams(**base), fail, steps)
+        for nb in (2, 8, 30, 240):
+            p = SwimParams(**base, dissem="fused", fused_nb=nb)
+            _assert_state_equal(ref, _end_state(p, fail, steps),
+                                f"nb={nb} ")
+
+    def test_sharded_ndev_sweep(self):
+        """Parity at every divisor device count, both strategies."""
+        from consul_tpu.gossip.params import SwimParams
+
+        n, steps = 320, 150
+        fail = _fails(n, [(40, 20), (170, 50)])
+        base = dict(n=n, slots=16, probe_every=5)
+        ref = _end_state(SwimParams(**base), fail, steps)
+        for ndev in (2, 4, 8):
+            for dissem in STRATEGIES:
+                st = _end_state(SwimParams(**base, dissem=dissem), fail,
+                                steps, ndev=ndev)
+                _assert_state_equal(ref, st, f"ndev={ndev}/{dissem} ")

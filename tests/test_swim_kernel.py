@@ -165,23 +165,26 @@ def test_quiescent_path_is_exact():
 
 
 def test_dissemination_strategies_bit_identical():
-    """dissem_swar is a pure execution-strategy switch: the SWAR merge
-    and the per-byte-plane merge must produce identical state."""
+    """dissem is a pure execution-strategy switch: the SWAR merge, the
+    per-byte-plane merge, the roll-commuted prefused tail, and the
+    Pallas fused kernel must all produce identical state (the deeper
+    per-regime matrix lives in tests/test_fused_parity.py)."""
     import numpy as np
     fail = np.full(256, NEVER, np.int32)
     for i in range(4):
         fail[50 * (i + 1)] = 20 + 9 * i
     outs = []
-    for swar in (True, False):
+    for dissem in ("swar", "planes", "prefused", "fused"):
         p = SwimParams(n=256, slots=16, probe_every=5, loss_rate=0.1,
-                       dissem_swar=swar)
+                       dissem=dissem)
         st, _ = run_rounds(init_state(p), jax.random.key(11),
                            jnp.asarray(fail), p, 200)
         outs.append(st)
-    for name in outs[0]._fields:
-        np.testing.assert_array_equal(
-            np.asarray(getattr(outs[0], name)),
-            np.asarray(getattr(outs[1], name)), err_msg=name)
+    for other in outs[1:]:
+        for name in outs[0]._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(outs[0], name)),
+                np.asarray(getattr(other, name)), err_msg=name)
 
 
 def run_with_joins(p, fail_round, join_round, steps, seed=0, trace=False):

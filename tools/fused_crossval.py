@@ -1,0 +1,133 @@
+"""Dissemination-strategy parity gate: every lowering bit-identical or bust.
+
+Runs the SWIM kernel's full round loop under every dissemination
+strategy (params.SwimParams.dissem — "swar" reference, the round-3
+"planes" loop, the roll-commuted "prefused" tail, and the Pallas
+one-pass "fused" kernel, interpret-mode on CPU) across a small regime
+matrix — healthy, churn+loss, push/pull, hot tier — and asserts the
+ENTIRE end state is bit-identical to the SWAR reference, field by
+field.  The sharded config runs every strategy through the
+8-CPU-device shard_map lowering (fused's halo-hop hybrid) against the
+sharded SWAR reference — which tests/test_shard_map_parity.py pins to
+the unsharded kernel — so a divergence anywhere in the halo/collective
+composition fails the gate too.
+
+Fast mode (the `make vet` hook) trims the matrix to a few seconds;
+full mode adds seeds, longer horizons, and a fused block-size sweep.
+
+Run: python -m tools.fused_crossval [--fast] [--seeds N]
+Exit 0 clean, 1 on any divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") +
+     " --xla_force_host_platform_device_count=8").strip())
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STRATEGIES = ("planes", "prefused", "fused")
+
+
+def _end_state(p, fail, steps, seed, ndev=0):
+    import jax
+    import jax.numpy as jnp
+
+    from consul_tpu.gossip.kernel import (init_state, run_rounds,
+                                          run_rounds_sharded, shard_state)
+    st = init_state(p)
+    if ndev > 1:
+        st, _ = run_rounds_sharded(shard_state(st, ndev),
+                                   jax.random.key(seed),
+                                   jnp.asarray(fail), p, steps, ndev=ndev)
+    else:
+        st, _ = run_rounds(st, jax.random.key(seed), jnp.asarray(fail),
+                           p, steps)
+    return st
+
+
+def _diff_fields(ref, other) -> list[str]:
+    import numpy as np
+    return [name for name in ref._fields
+            if not np.array_equal(np.asarray(getattr(ref, name)),
+                                  np.asarray(getattr(other, name)))]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="vet-gate sizing (a few seconds)")
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="seed count override")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import jax
+
+    from consul_tpu.gossip.kernel import NEVER
+    from consul_tpu.gossip.params import SwimParams
+
+    seeds = args.seeds or (1 if args.fast else 3)
+    steps = 120 if args.fast else 300
+    n = 240  # divisible by 8 devices and probe_every=5
+
+    def fails(spec):
+        f = np.full(n, NEVER, np.int32)
+        for idx, rnd in spec:
+            f[idx] = rnd
+        return f
+
+    churn = fails([(40, 20), (90, 35), (170, 50), (230, 65)])
+    configs = [
+        ("healthy", dict(), fails([]), 0),
+        ("churn_loss", dict(loss_rate=0.1), churn, 0),
+        ("pushpull", dict(pushpull_every=20, loss_rate=0.05), churn, 0),
+        ("hot_tier", dict(hot_slots=4), churn, 0),
+        ("sharded8", dict(loss_rate=0.1), churn, 8),
+    ]
+    base = dict(n=n, slots=16, probe_every=5)
+
+    print(f"[fused-crossval] backend={jax.default_backend()} "
+          f"devices={jax.device_count()} seeds={seeds} steps={steps}",
+          flush=True)
+    failures = 0
+    for name, kw, fail, ndev in configs:
+        for seed in range(seeds):
+            ref = _end_state(SwimParams(**base, **kw), fail, steps, seed,
+                             ndev=ndev)
+            for dissem in STRATEGIES:
+                nbs = ((1,) if args.fast or dissem != "fused"
+                       else (1, 2, 8))
+                for nb in nbs:
+                    p = SwimParams(**base, **kw, dissem=dissem,
+                                   fused_nb=nb)
+                    st = _end_state(p, fail, steps, seed, ndev=ndev)
+                    bad = _diff_fields(ref, st)
+                    tag = (f"{name} seed={seed} dissem={dissem}"
+                           + (f" nb={nb}" if dissem == "fused" else ""))
+                    if bad:
+                        failures += 1
+                        print(f"[fused-crossval] FAIL {tag}: diverged "
+                              f"fields {bad}", file=sys.stderr)
+                    else:
+                        print(f"[fused-crossval]   ok {tag}", flush=True)
+    if failures:
+        print(f"[fused-crossval] {failures} divergence(s)",
+              file=sys.stderr)
+        return 1
+    print(f"[fused-crossval] ok: all strategies bit-identical "
+          f"({len(configs)} configs x {seeds} seed(s), divergence 0)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,13 @@ def main():
                          "(gossip/nemesis.py catalog name; window widened "
                          "so the fault masks stay live) — the delta over "
                          "round_amortized_64 prices the scenario")
+    ap.add_argument("--dissem",
+                    choices=("swar", "planes", "prefused", "fused"),
+                    default="swar",
+                    help="dissemination strategy profiled by the main "
+                         "entries (params.dissem); non-swar runs suffix "
+                         "the strategy-dependent keys so captures from "
+                         "different strategies diff cleanly")
     args = ap.parse_args()
 
     from consul_tpu.gossip.kernel import (
@@ -75,9 +82,14 @@ def main():
     from consul_tpu.ops.feistel import gossip_sources
 
     n, S = args.n, args.slots
-    p = lan_profile(n, slots=S)
-    p_nopp = lan_profile(n, slots=S, pushpull_every=0)
-    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+    p = lan_profile(n, slots=S, dissem=args.dissem)
+    p_nopp = lan_profile(n, slots=S, pushpull_every=0, dissem=args.dissem)
+    # Strategy-dependent entries carry the strategy in their key; the
+    # default swar keys stay bare for trend continuity with older
+    # captures.
+    sfx = "" if args.dissem == "swar" else f"_{args.dissem}"
+    print(f"device: {jax.devices()[0]}  dissem: {args.dissem}",
+          file=sys.stderr)
 
     # Build a warm, realistically-populated state: run a few hundred
     # rounds with churn so slots are saturated like the bench steady state.
@@ -109,15 +121,18 @@ def main():
 
     # -- full round, amortized over a 64-round scan (the honest number) --
     f_scan = make_timed(lambda st: run_rounds(st, key, fail, p, steps=64)[0])
-    results["round_amortized_64"] = timed(f_scan, state, iters=2, warmup=1) / 64
+    results[f"round_amortized_64{sfx}"] = timed(
+        f_scan, state, iters=2, warmup=1) / 64
 
-    # -- dissemination-strategy A/B: SWAR single-pass (default) vs the
-    # round-3 per-byte-plane loop (params.dissem_swar) -------------------
-    p_planes = lan_profile(n, slots=S, dissem_swar=False)
-    f_scan_pl = make_timed(
-        lambda st: run_rounds(st, key, fail, p_planes, steps=64)[0])
-    results["round_amortized_64_planes"] = timed(
-        f_scan_pl, state, iters=2, warmup=1) / 64
+    # -- dissemination-strategy A/B: the profiled strategy (--dissem,
+    # default SWAR single-pass) vs the round-3 per-byte-plane loop
+    # (params.dissem) ----------------------------------------------------
+    if args.dissem != "planes":
+        p_planes = lan_profile(n, slots=S, dissem="planes")
+        f_scan_pl = make_timed(
+            lambda st: run_rounds(st, key, fail, p_planes, steps=64)[0])
+        results["round_amortized_64_planes"] = timed(
+            f_scan_pl, state, iters=2, warmup=1) / 64
 
     # -- nemesis injection overhead (--scenario): the identical scan
     # with the scenario's schedule compiled in.  The catalog windows
@@ -247,7 +262,13 @@ def main():
                                   state, key, fail)
 
     # -- phases -----------------------------------------------------------
-    results["age_tick"] = timed(make_timed(_age_tick), heard)
+    # Standalone age pass: a real production phase ONLY for the planes
+    # strategy.  The swar family merges it into dissemination (swar:
+    # inside the pack; prefused: commuted across the rolls into the
+    # per-pin chains; fused: inside the Pallas body), so for those this
+    # row is the ablation reference for what the merge saves, not a
+    # phase the round actually dispatches.
+    results["age_tick_standalone"] = timed(make_timed(_age_tick), heard)
 
     def f_probe_raw(st, mf_):
         keys = jax.random.split(key, 4)
@@ -257,7 +278,15 @@ def main():
         return _probe_tick(p, st.round, keys, mf_, carry)[0]
     results["probe_tick"] = timed(make_timed(f_probe_raw), state, mf)
 
-    results["disseminate"] = timed(
+    # Merged age+gossip phase: every swar-family strategy ages inside
+    # this call, so the row prices age+gossip+SWAR-merge as ONE phase
+    # (the pre-round-12 table listed it as "disseminate" next to a
+    # standalone "age_tick", reading as if the round paid both).
+    # planes keeps the old label — there the age pass really is
+    # separate.
+    dis_key = ("disseminate" if p.dissem == "planes"
+               else f"age_gossip_merge{sfx}")
+    results[dis_key] = timed(
         make_timed(lambda h, mf_, cc: _disseminate(p, rnd, key, h, mf_, rx_ok, cc)),
         heard, mf, conf_cap)
 
@@ -394,12 +423,16 @@ def main():
     # consul_kernel_roofline_utilization and bench.py persists, so all
     # three profiling paths agree on one figure instead of §1c prose.
     from consul_tpu.obs.devstats import (
-        EFFECTIVE_HBM_GBPS, dense_bytes_per_round, roofline_utilization)
-    util = roofline_utilization(dense_bytes_per_round(S, n),
-                                1.0 / results["round_amortized_64"])
+        EFFECTIVE_HBM_GBPS, DENSE_PASSES_BY_DISSEM, dense_bytes_per_round,
+        roofline_utilization)
+    dense_mb = dense_bytes_per_round(S, n, args.dissem) / 1e6
+    util = roofline_utilization(
+        dense_bytes_per_round(S, n, args.dissem),
+        1.0 / results[f"round_amortized_64{sfx}"])
     if util is not None:
-        print(f"\nroofline_utilization {util:.4f} "
-              f"(dense {dense_bytes_per_round(S, n) / 1e6:.1f} MB/round "
+        print(f"\nroofline_utilization{sfx} {util:.4f} "
+              f"(dense {dense_mb:.1f} MB/round = "
+              f"{DENSE_PASSES_BY_DISSEM[args.dissem]} S*N passes "
               f"@ {EFFECTIVE_HBM_GBPS:.0f} GB/s ceiling)", flush=True)
 
 
