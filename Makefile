@@ -17,7 +17,7 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet vet-fast obs-smoke bench bench-serve bench-watch dryrun clean
+.PHONY: default ci test integ vet vet-fast vet-diff vet-dyn obs-smoke bench bench-serve bench-watch dryrun clean
 
 default: test
 
@@ -33,15 +33,22 @@ test: vet
 integ:
 	$(PYTEST) tests/test_blackbox.py tests/test_linearizability.py
 
-# Static checks: byte-compile every source file, then the ten-pass
+# Static checks: byte-compile every source file, then the twelve-pass
 # analyzer (tools/vet/: names, async-safety, JAX tracer-purity,
 # wire-schema drift, exception hygiene, donation safety,
-# shard-exactness, carry-contract, overflow — the `go vet` role in an
-# image without a Python linter).  Exit codes: 0 clean, 1 findings, 2
-# parse error.  Suppress per line with `# noqa: CODE[,CODE]` or per
-# finding in tools/vet/baseline.txt.  `vet` writes the machine-readable
-# vet_report.json CI artifact; `vet-fast` skips the flow-sensitive JAX
-# passes for the inner loop.
+# shard-exactness, carry-contract, overflow, pallas-safety,
+# table-drift, fork-safety — the `go vet` role in an image without a
+# Python linter).  Exit codes: 0 clean, 1 findings, 2 parse error.
+# Suppress per line with `# noqa: CODE[,CODE]` or per finding in
+# tools/vet/baseline.txt.  `vet` writes the machine-readable
+# vet_report.json CI artifact (incl. per-pass wall times; the driver
+# prints the slowest pass); `vet-fast` skips the flow-sensitive JAX
+# passes for the inner loop; `vet-diff` vets only git-touched files
+# plus their cross-file partners (same exit-code contract) for
+# pre-commit; `vet-dyn` runs the dynamic sanitizer harness
+# (tools/vet/dyn.py: debug_nans + asyncio debug + warnings-as-errors
+# + fd/thread/task leak audit over the fast tier-1 slice, then a
+# checkify smoke of one dissemination round per strategy).
 VET_PATHS = consul_tpu tests tools demo bench.py __graft_entry__.py
 vet:
 	$(PYTHON) -m compileall -q $(VET_PATHS)
@@ -52,6 +59,12 @@ vet:
 
 vet-fast:
 	$(PYTHON) -m tools.vet $(VET_PATHS) --fast
+
+vet-diff:
+	$(PYTHON) -m tools.vet $(VET_PATHS) --changed
+
+vet-dyn:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.vet.dyn
 
 # Observability gate: boot a small CPU plane + one kernel-backed agent,
 # scrape /v1/agent/metrics?format=prometheus, and hold every line to

@@ -49,6 +49,15 @@ class NotLeaderError(Exception):
         self.leader = leader
 
 
+def _fail_abandoned(fut: asyncio.Future, err: Exception) -> None:
+    """Fail a future whose submitter may already be gone (timed out,
+    cancelled, disconnected).  Pre-retrieving the exception keeps loop
+    teardown from logging "exception was never retrieved"; a submitter
+    still awaiting the future receives the error unchanged."""
+    fut.set_exception(err)
+    fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+
+
 @dataclass
 class RaftConfig:
     """Timing knobs; the test tier compresses these the way the
@@ -317,7 +326,7 @@ class RaftNode:
         # acknowledged as durable).
         for _idx, fut in self._durable_waiters:
             if not fut.done():
-                fut.set_exception(NotLeaderError(None))
+                _fail_abandoned(fut, NotLeaderError(None))
         self._durable_waiters = []
         for t in self._repl_tasks + self._tasks:
             t.cancel()
@@ -488,7 +497,15 @@ class RaftNode:
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_event_loop().call_soon(self._flush_appends)
-        result = await asyncio.wait_for(fut, timeout)
+        try:
+            result = await asyncio.wait_for(fut, timeout)
+        except (asyncio.CancelledError, asyncio.TimeoutError):
+            # The submitter abandoned the entry; a later step-down may
+            # still set NotLeaderError on fut.  Mark it retrieved so
+            # loop teardown doesn't log "exception was never
+            # retrieved" for an entry nobody is waiting on.
+            fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+            raise
         return (result, entry.index) if with_index else result
 
     def _flush_appends(self) -> None:
@@ -500,7 +517,7 @@ class RaftNode:
                 fut = self._pending.pop(e.index, None)
                 self._trace_ctx.pop(e.index, None)
                 if fut is not None and not fut.done():
-                    fut.set_exception(NotLeaderError(self.leader_id))
+                    _fail_abandoned(fut, NotLeaderError(self.leader_id))
             return
         self.log.append(batch, sync=False)
         self._dirty_evt.set()
@@ -628,7 +645,7 @@ class RaftNode:
     def _fail_pending(self, err: Exception) -> None:
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(err)
+                _fail_abandoned(fut, err)
         self._pending.clear()
         self._trace_ctx.clear()
 
@@ -935,7 +952,7 @@ class RaftNode:
                         fut = self._pending.pop(i)
                         self._trace_ctx.pop(i, None)
                         if not fut.done():
-                            fut.set_exception(NotLeaderError(req.leader))
+                            _fail_abandoned(fut, NotLeaderError(req.leader))
                 local = None
             if local is None and e.index > self.log.last_index() + len(to_append):
                 to_append.append(e)

@@ -64,6 +64,7 @@ from consul_tpu.gossip.kernel import (_AGE_FRESH, _AGE_MASK, _CONF_MASK,
                                       _roll_sharded, _sloc, _sloc_roll,
                                       MSG_SUSPECT, gossip_offsets)
 from consul_tpu.gossip.params import SwimParams
+from consul_tpu.ops.divisibility import require_divisible
 
 
 @functools.lru_cache(maxsize=1)
@@ -140,10 +141,9 @@ def _src_masks(p: SwimParams, rnd, offs, mf, sc, nem, k_nem):
 def _fused_single(p: SwimParams, heard, offs, src, rx, cap) -> jnp.ndarray:
     S, N = heard.shape
     nb = p.fused_nb
-    if N % nb:
-        raise ValueError(
-            f"dissem='fused' needs n % fused_nb == 0 (n={N}, "
-            f"fused_nb={nb})")
+    # The shared contract (ops/divisibility.py): the vet P01 pass
+    # treats this exact call as the guard for the N // nb block width.
+    require_divisible(N, nb, what="n", by="fused_nb")
     Bn = N // nb
     fanout = p.fanout
 

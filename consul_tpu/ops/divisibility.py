@@ -1,0 +1,35 @@
+"""The block-divisibility contract, stated once.
+
+Pallas block windows only tile an axis cleanly when the block count
+divides the axis (``gossip/fused.py``: the observer axis splits into
+``fused_nb`` column blocks of width ``n // fused_nb``; a remainder
+column would silently fall off the grid).  Before PR 13 that contract
+lived in two places that could drift apart: a runtime ``ValueError``
+inside ``_fused_single`` and whatever the static analyzer happened to
+grep for.  Both now consume THIS module — the kernel calls
+:func:`require_divisible` as its runtime guard, and the vet P01 pass
+(``tools/vet/pallas_safety.py``) both recognizes that call as guard
+evidence and imports :func:`divides` to constant-fold statically known
+cases — so the static check and the runtime error cannot disagree
+(pinned by ``tests/test_vet.py::TestPallasSafety``).
+
+Host-only integer math: no jax imports, callable at trace time on
+static shape ints.
+"""
+
+from __future__ import annotations
+
+
+def divides(n: int, d: int) -> bool:
+    """True iff ``d`` is a positive exact divisor of ``n``."""
+    return d > 0 and n % d == 0
+
+
+def require_divisible(n: int, d: int, *, what: str = "n",
+                      by: str = "divisor") -> None:
+    """Raise ``ValueError`` unless ``divides(n, d)`` — the runtime half
+    of the block-window contract (module docstring)."""
+    if not divides(n, d):
+        raise ValueError(
+            f"{what}={n} must be divisible by {by}={d} "
+            f"(block windows must tile the axis exactly)")

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from consul_tpu.structs.structs import (
     SESSION_TTL_MULTIPLIER, Session, SessionOp, SessionRequest, MessageType,
@@ -35,6 +35,9 @@ class LeaderDuties:
         self._tombstone_task: Optional[asyncio.Task] = None
         self._establish_task: Optional[asyncio.Task] = None
         self._reconcile_task: Optional[asyncio.Task] = None
+        # revoke() is sync (called from the role-change callback), so
+        # cancelled tasks park here until stop() can await them out
+        self._cancelled: List[asyncio.Task] = []
         self._active = False
 
     # -- leadership transitions (monitorLeadership, leader.go:29-58) -------
@@ -93,15 +96,22 @@ class LeaderDuties:
         self._active = False
         self.srv.gc.set_enabled(False, time.monotonic())
         self.clear_all_session_timers()
-        if self._tombstone_task is not None:
-            self._tombstone_task.cancel()
-            self._tombstone_task = None
-        if self._reconcile_task is not None:
-            self._reconcile_task.cancel()
-            self._reconcile_task = None
-        if self._establish_task is not None:
-            self._establish_task.cancel()
-            self._establish_task = None
+        for attr in ("_tombstone_task", "_reconcile_task",
+                     "_establish_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                self._cancelled.append(task)
+                setattr(self, attr, None)
+
+    async def drain(self) -> None:
+        """Await every task revoke() cancelled.  cancel() only
+        schedules the CancelledError; without this, a loop that closes
+        right after step-down logs "Task was destroyed but it is
+        pending!" for each leader loop."""
+        tasks, self._cancelled = self._cancelled, []
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
 
     # -- session TTLs (consul/session_ttl.go) ------------------------------
 

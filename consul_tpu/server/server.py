@@ -201,6 +201,14 @@ class Server:
 
     async def stop(self) -> None:
         self.leader_duties.revoke()
+        await self.leader_duties.drain()
+        # The coalesced barrier task may still be in flight (its waiters
+        # are shielded and can all be gone); cancel and AWAIT it, or the
+        # loop closes over a pending task ("Task was destroyed ...").
+        fut, self._barrier_inflight = self._barrier_inflight, None
+        if fut is not None and not fut.done():
+            fut.cancel()
+            await asyncio.gather(fut, return_exceptions=True)
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self.pool is not None:

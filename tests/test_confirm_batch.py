@@ -113,6 +113,10 @@ class TestConfirmBatch:
 
             assert await asyncio.wait_for(
                 srv._confirm_batched("leader_ri", runner), 2.0) == 13
+            # retrieve the planted exception: the batcher must skip a
+            # failed prev without consuming its error (and an
+            # unretrieved future exception fails the vet-dyn harness)
+            assert isinstance(failed_prev.exception(), RuntimeError)
 
         loop.run_until_complete(body())
 

@@ -303,8 +303,9 @@ class TestWatchMatchAutoGate:
         b._w_groups = []
         assert b._use_device_match() is True
         with pytest.raises(ValueError):
+            # deliberately invalid backend name — the point of the test
             DeviceStoreBridge(capacity=64, stats=None,
-                              match_backend="maybe")
+                              match_backend="maybe")  # noqa: K02
 
     def test_forced_device_still_crosschecks(self):
         from consul_tpu.obs.storestats import StoreStats

@@ -113,7 +113,8 @@ class TestSingleCallParity:
         from consul_tpu.gossip.params import SwimParams
 
         with pytest.raises(ValueError, match="dissem"):
-            SwimParams(n=64, dissem="bogus")
+            # deliberately invalid strategy name — the point of the test
+            SwimParams(n=64, dissem="bogus")  # noqa: K02
         with pytest.raises(ValueError, match="fused_nb"):
             SwimParams(n=64, fused_nb=0)
 

@@ -1,4 +1,4 @@
-"""tools/vet — the ten-pass static analyzer.
+"""tools/vet — the twelve-pass static analyzer + dynamic harness.
 
 Each pass gets one known-bad snippet (the planted defect it must
 catch) and one clean snippet (the idiomatic fix it must NOT flag),
@@ -9,15 +9,20 @@ holds the analyzer to its own standard.
 """
 
 import json
+import subprocess
+import sys
 import textwrap
 from pathlib import Path
 
 import pytest
 
 from tools.vet import async_safety, carry_contract, donation, exceptions
-from tools.vet import names, overflow, shard_exact, tracer_purity
+from tools.vet import fork_safety, names, overflow, pallas_safety
+from tools.vet import shard_exact, table_drift, tracer_purity
 from tools.vet import wire_schema
+from tools.vet import dyn as vet_dyn
 from tools.vet.core import FileCtx, parse_noqa
+from tools.vet.driver import changed_paths, expand_partners
 from tools.vet.driver import main as vet_main
 from tools.vet.driver import run_vet
 
@@ -1109,6 +1114,558 @@ class TestOutputFormats:
         p.write_text(_OVERFLOW_DEFECT)
         assert vet_main([str(p), "--no-baseline"]) == 1
         assert vet_main([str(p), "--no-baseline", "--fast"]) == 0
+
+
+# -- pallas-safety (P01-P04) -------------------------------------------------
+
+
+_PALLAS_HEAD = (
+    "import jax\n"
+    "from jax.experimental import pallas as pl\n"
+    "from jax.experimental.pallas import tpu as pltpu\n")
+
+
+def _pallas_ctx(tmp_path, src):
+    return _ctx(tmp_path, "m.py", _PALLAS_HEAD + textwrap.dedent(src))
+
+
+class TestPallasSafety:
+    def test_unguarded_divisibility_fires(self, tmp_path):
+        # the acceptance-criteria defect: a runtime-unguarded
+        # `N // nb` block width feeding a pallas_call BlockSpec
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x, nb):
+                N = x.shape[1]
+                Bn = N // nb
+                def kern(x_ref, o_ref):
+                    o_ref[...] = x_ref[...]
+                return pl.pallas_call(
+                    kern, grid=(nb,),
+                    in_specs=[pl.BlockSpec((8, Bn), lambda j: (0, j))],
+                    out_specs=pl.BlockSpec((8, Bn), lambda j: (0, j)),
+                    out_shape=x, interpret=True)(x)
+            """)
+        assert "P01" in _codes(pallas_safety.check(ctx))
+
+    def test_statically_violated_divisibility_fires(self, tmp_path):
+        # constant-folded with the SAME divides() the runtime uses
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x):
+                Bn = 10 // 3
+                def kern(x_ref, o_ref):
+                    o_ref[...] = x_ref[...]
+                return pl.pallas_call(
+                    kern, grid=(3,),
+                    in_specs=[pl.BlockSpec((8, Bn), lambda j: (0, j))],
+                    out_shape=x, interpret=True)(x)
+            """)
+        found = [f for f in pallas_safety.check(ctx) if f.code == "P01"]
+        assert found and "does not tile" in found[0].message
+
+    def test_shared_helper_guard_is_clean(self, tmp_path):
+        ctx = _pallas_ctx(tmp_path, """\
+            from consul_tpu.ops.divisibility import require_divisible
+            def f(x, nb):
+                N = x.shape[1]
+                require_divisible(N, nb, what="n", by="nb")
+                Bn = N // nb
+                def kern(x_ref, o_ref):
+                    o_ref[...] = x_ref[...]
+                return pl.pallas_call(
+                    kern, grid=(nb,),
+                    in_specs=[pl.BlockSpec((8, Bn), lambda j: (0, j))],
+                    out_shape=x, interpret=True)(x)
+            """)
+        assert pallas_safety.check(ctx) == []
+
+    def test_missing_interpret_fires(self, tmp_path):
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x):
+                def kern(x_ref, o_ref):
+                    o_ref[...] = x_ref[...]
+                return pl.pallas_call(kern, out_shape=x)(x)
+            """)
+        assert _codes(pallas_safety.check(ctx)) == ["P02"]
+
+    def test_index_map_without_modulo_fires(self, tmp_path):
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x, qr, nb):
+                def kern(qr_ref, x_ref, o_ref):
+                    o_ref[...] = x_ref[...]
+                return pl.pallas_call(
+                    kern,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1, grid=(nb,),
+                        in_specs=[pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j - qr[0]))],
+                        out_specs=pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j)),
+                    ),
+                    out_shape=x, interpret=True)(qr, x)
+            """)
+        assert "P03" in _codes(pallas_safety.check(ctx))
+
+    def test_dynamic_slice_without_certificate_fires(self, tmp_path):
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x, offs, nb):
+                def kern(qr_ref, x_ref, o_ref):
+                    r = qr_ref[0]
+                    o_ref[...] = jax.lax.dynamic_slice(
+                        x_ref[...], (0, r), (8, 8))
+                return pl.pallas_call(
+                    kern,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1, grid=(nb,),
+                        in_specs=[pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j))],
+                        out_specs=pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j)),
+                    ),
+                    out_shape=x, interpret=True)(offs, x)
+            """)
+        assert "P03" in _codes(pallas_safety.check(ctx))
+
+    def test_residue_certificate_is_clean(self, tmp_path):
+        # the gossip/fused.py shape: the prefetch operand is built
+        # with `offs % Bn`, bounding the in-kernel splice
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x, offs, nb, Bn):
+                def kern(qr_ref, x_ref, o_ref):
+                    r = qr_ref[0]
+                    o_ref[...] = jax.lax.dynamic_slice(
+                        x_ref[...], (0, r), (8, 8))
+                qr = (offs % Bn).astype(int)
+                return pl.pallas_call(
+                    kern,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1, grid=(nb,),
+                        in_specs=[pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j))],
+                        out_specs=pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j)),
+                    ),
+                    out_shape=x, interpret=True)(qr, x)
+            """)
+        assert pallas_safety.check(ctx) == []
+
+    def test_prefetch_indexed_by_program_id_fires(self, tmp_path):
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x, qr, nb):
+                def kern(qr_ref, x_ref, o_ref):
+                    v = qr_ref[pl.program_id(0)]
+                    o_ref[...] = x_ref[...] + v
+                return pl.pallas_call(
+                    kern,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1, grid=(nb,),
+                        in_specs=[pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j))],
+                        out_specs=pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j)),
+                    ),
+                    out_shape=x, interpret=True)(qr, x)
+            """)
+        assert "P04" in _codes(pallas_safety.check(ctx))
+
+    def test_static_prefetch_reads_are_clean(self, tmp_path):
+        # Python-int indexing of the scalar ref (the fused.py idiom:
+        # qr_ref[fanout + f] with both names loop-static)
+        ctx = _pallas_ctx(tmp_path, """\
+            def f(x, qr, nb, fanout):
+                def kern(qr_ref, x_ref, o_ref):
+                    for g in range(fanout):
+                        v = qr_ref[fanout + g]
+                    o_ref[...] = x_ref[...]
+                return pl.pallas_call(
+                    kern,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1, grid=(nb,),
+                        in_specs=[pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j))],
+                        out_specs=pl.BlockSpec(
+                            (8, 8), lambda j, qr: (0, j)),
+                    ),
+                    out_shape=x, interpret=True)(qr, x)
+            """)
+        assert pallas_safety.check(ctx) == []
+
+    def test_real_fused_kernel_is_clean(self):
+        ctx = FileCtx.load(REPO / "consul_tpu/gossip/fused.py",
+                           "consul_tpu/gossip/fused.py")
+        assert pallas_safety.check(ctx) == []
+
+
+class TestDivisibilityHelper:
+    """The satellite contract: runtime guard and static pass consume
+    the SAME helper, so they cannot disagree."""
+
+    def test_require_divisible_agrees_with_divides(self):
+        from consul_tpu.ops.divisibility import divides, require_divisible
+        for n in range(0, 40):
+            for d in range(0, 8):
+                if divides(n, d):
+                    require_divisible(n, d)
+                else:
+                    with pytest.raises(ValueError):
+                        require_divisible(n, d)
+
+    def test_kernel_and_pass_share_the_helper(self):
+        fused_src = (REPO / "consul_tpu/gossip/fused.py").read_text()
+        assert ("from consul_tpu.ops.divisibility import "
+                "require_divisible") in fused_src
+        assert "require_divisible(N, nb" in fused_src
+        pass_src = (REPO / "tools/vet/pallas_safety.py").read_text()
+        assert ("from consul_tpu.ops.divisibility import divides"
+                in pass_src)
+
+
+# -- table-drift (K01-K02) ---------------------------------------------------
+
+
+_GOVERNING_DISSEM = """\
+    class SwimParams:
+        def __post_init__(self):
+            if self.dissem not in ("swar", "planes", "prefused", "fused"):
+                raise ValueError("dissem")
+    """
+
+
+class TestTableDrift:
+    def _ctxs(self, tmp_path, devstats_body):
+        return [
+            _ctx(tmp_path, "consul_tpu/gossip/params.py",
+                 _GOVERNING_DISSEM),
+            _ctx(tmp_path, "consul_tpu/obs/devstats.py", devstats_body),
+        ]
+
+    def test_synced_table_is_clean(self, tmp_path):
+        ctxs = self._ctxs(tmp_path, """\
+            DENSE_PASSES_BY_DISSEM = {"swar": 5, "planes": 5,
+                                      "prefused": 4, "fused": 2}
+            """)
+        assert table_drift.check_project(ctxs) == []
+
+    def test_desynced_table_fires(self, tmp_path):
+        ctxs = self._ctxs(tmp_path, """\
+            DENSE_PASSES_BY_DISSEM = {"swar": 5, "planes": 5,
+                                      "fused": 2, "xla": 9}
+            """)
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K01"]
+        assert found
+        assert "prefused" in found[0].message  # missing
+        assert "xla" in found[0].message       # extra
+
+    def test_renamed_table_fires(self, tmp_path):
+        # a silently-renamed table is drift, not absence
+        ctxs = self._ctxs(tmp_path, """\
+            PASSES_BY_STRATEGY = {"swar": 5}
+            """)
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K01"]
+        assert found and "not found" in found[0].message
+
+    def test_stray_dispatch_literal_fires(self, tmp_path):
+        ctxs = self._ctxs(tmp_path, """\
+            DENSE_PASSES_BY_DISSEM = {"swar": 5, "planes": 5,
+                                      "prefused": 4, "fused": 2}
+            """) + [_ctx(tmp_path, "caller.py", """\
+            def bench(params_cls):
+                return params_cls(n=64, dissem="florp")
+            """)]
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K02"]
+        assert found and "florp" in found[0].message
+
+    def test_valid_dispatch_literal_is_clean(self, tmp_path):
+        ctxs = self._ctxs(tmp_path, """\
+            DENSE_PASSES_BY_DISSEM = {"swar": 5, "planes": 5,
+                                      "prefused": 4, "fused": 2}
+            """) + [_ctx(tmp_path, "caller.py", """\
+            def bench(params_cls):
+                if params_cls.dissem == "fused":
+                    return params_cls(n=64, dissem="swar")
+            """)]
+        assert table_drift.check_project(ctxs) == []
+
+    def test_governing_file_absent_skips_group(self, tmp_path):
+        # subset runs (unit fixtures, --changed) must not false-fire
+        ctxs = [_ctx(tmp_path, "consul_tpu/obs/devstats.py", """\
+            DENSE_PASSES_BY_DISSEM = {"swar": 5}
+            """)]
+        assert table_drift.check_project(ctxs) == []
+
+    def test_gauge_help_mention_drift_fires(self, tmp_path):
+        ctxs = [
+            _ctx(tmp_path, "consul_tpu/state/device_store.py", """\
+                def pick(match_backend):
+                    if match_backend not in ("auto", "device", "host"):
+                        raise ValueError(match_backend)
+                """),
+            _ctx(tmp_path, "consul_tpu/obs/storestats.py", """\
+                def gauges(self):
+                    return [{
+                        "name": "consul_watch_match_backend",
+                        "help": "1 = device matcher selected.",
+                        "rows": [],
+                    }]
+                """),
+        ]
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K01"]
+        assert found and "host" in found[0].message
+
+    def test_desynced_copy_of_real_sources_fires(self, tmp_path):
+        """The K01 meta-test: copies of the REAL params.py + devstats.py
+        with DENSE_PASSES_BY_DISSEM deliberately desynced must fire —
+        pins that the extractors still parse the production idiom."""
+        params_src = (REPO / "consul_tpu/gossip/params.py").read_text()
+        dev_src = (REPO / "consul_tpu/obs/devstats.py").read_text()
+        assert '"prefused": 4, ' in dev_src
+        desynced = dev_src.replace('"prefused": 4, ', '', 1)
+        ctxs = [
+            _ctx(tmp_path, "consul_tpu/gossip/params.py", params_src),
+            _ctx(tmp_path, "consul_tpu/obs/devstats.py", desynced),
+        ]
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K01"]
+        assert found and "prefused" in found[0].message
+        # and the unmodified copies are in sync (the live contract)
+        ctxs = [
+            _ctx(tmp_path, "sync/consul_tpu/gossip/params.py",
+                 params_src),
+            _ctx(tmp_path, "sync/consul_tpu/obs/devstats.py", dev_src),
+        ]
+        assert [f for f in table_drift.check_project(ctxs)
+                if f.code == "K01"] == []
+
+
+# -- fork-safety (R01-R02) ---------------------------------------------------
+
+
+class TestForkSafety:
+    def test_thread_started_before_fork_fires(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import os, threading
+            def serve(work):
+                t = threading.Thread(target=work, daemon=True)
+                t.start()
+                return os.fork()
+            """)
+        assert _codes(fork_safety.check(ctx)) == ["R01"]
+
+    def test_module_level_thread_in_forking_module_fires(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import os, threading
+            def work():
+                pass
+            threading.Thread(target=work, daemon=True).start()
+            def serve():
+                return os.fork()
+            """)
+        assert _codes(fork_safety.check(ctx)) == ["R01"]
+
+    def test_fork_then_thread_is_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import os, threading
+            def serve(work):
+                pid = os.fork()
+                if pid == 0:
+                    t = threading.Thread(target=work, daemon=True)
+                    t.start()
+            """)
+        assert fork_safety.check(ctx) == []
+
+    def test_popen_is_exempt(self, tmp_path):
+        # the agent/workers.py shape: spawn-by-exec, not fork
+        ctx = _ctx(tmp_path, "m.py", """\
+            import subprocess, threading
+            def serve(work):
+                t = threading.Thread(target=work, daemon=True)
+                t.start()
+                return subprocess.Popen(["worker"])
+            """)
+        assert fork_safety.check(ctx) == []
+
+    def test_unlocked_cross_context_write_fires(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio, threading
+            REGISTRY = {}
+            def worker():
+                REGISTRY["k"] = 1
+            async def handler():
+                REGISTRY.update(k=2)
+            threading.Thread(target=worker).start()
+            """)
+        assert _codes(fork_safety.check(ctx)) == ["R02", "R02"]
+
+    def test_locked_cross_context_write_is_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio, threading
+            REGISTRY = {}
+            _LOCK = threading.Lock()
+            def worker():
+                with _LOCK:
+                    REGISTRY["k"] = 1
+            async def handler():
+                with _LOCK:
+                    REGISTRY["k"] = 2
+            threading.Thread(target=worker).start()
+            """)
+        assert fork_safety.check(ctx) == []
+
+    def test_single_context_write_is_clean(self, tmp_path):
+        # the repo norm: module state written only from the event loop
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+            REGISTRY = {}
+            async def handler():
+                REGISTRY["k"] = 2
+            """)
+        assert fork_safety.check(ctx) == []
+
+
+# -- driver: --changed, per-pass timings, stale listing ----------------------
+
+
+def _git(cwd, *args):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   capture_output=True)
+
+
+class TestChangedMode:
+    def test_expand_partners_pulls_group(self):
+        all_paths = ["consul_tpu/gossip/params.py",
+                     "consul_tpu/obs/devstats.py",
+                     "bench.py", "tools/profile_kernel.py",
+                     "consul_tpu/api/kv.py"]
+        only = expand_partners({"consul_tpu/obs/devstats.py"}, all_paths)
+        assert only == {"consul_tpu/gossip/params.py",
+                        "consul_tpu/obs/devstats.py",
+                        "bench.py", "tools/profile_kernel.py"}
+
+    def test_expand_partners_leaves_loners(self):
+        only = expand_partners({"consul_tpu/api/kv.py"},
+                               ["consul_tpu/api/kv.py", "bench.py"])
+        assert only == {"consul_tpu/api/kv.py"}
+
+    def test_changed_paths_and_only_filter(self, tmp_path, monkeypatch):
+        _git(tmp_path, "init", "-q")
+        defect = ("def f():\n    try:\n        return 1\n"
+                  "    except Exception:\n        pass\n")
+        (tmp_path / "a.py").write_text(defect)
+        (tmp_path / "b.py").write_text("x = 1\n")
+        _git(tmp_path, "add", ".")
+        _git(tmp_path, "-c", "user.email=v@t", "-c", "user.name=v",
+             "commit", "-q", "-m", "seed")
+        (tmp_path / "b.py").write_text(defect)       # tracked, modified
+        (tmp_path / "c.py").write_text(defect)       # untracked
+        monkeypatch.chdir(tmp_path)
+        changed = changed_paths()
+        assert changed == {"b.py", "c.py"}
+        result = run_vet(["."], baseline_path=None, only=changed)
+        # a.py has the same defect but was not touched -> not vetted
+        assert sorted({f.path for f in result.findings}) \
+            == ["b.py", "c.py"]
+        assert result.files == 2
+        # partial runs cannot judge baseline staleness
+        assert result.stale_baseline == []
+
+    def test_exit_code_contract_unchanged(self, tmp_path, monkeypatch):
+        _git(tmp_path, "init", "-q")
+        (tmp_path / "a.py").write_text("x = 1\n")
+        _git(tmp_path, "add", ".")
+        _git(tmp_path, "-c", "user.email=v@t", "-c", "user.name=v",
+             "commit", "-q", "-m", "seed")
+        monkeypatch.chdir(tmp_path)
+        assert vet_main([".", "--no-baseline", "--changed"]) == 0
+        (tmp_path / "a.py").write_text("def f():\n    return undefined\n")
+        assert vet_main([".", "--no-baseline", "--changed"]) == 1
+
+
+class TestPassTimings:
+    def test_per_pass_ms_recorded(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        result = run_vet([str(p)], baseline_path=None)
+        assert set(result.per_pass_ms) == set(result.per_pass)
+        assert all(ms >= 0 for ms in result.per_pass_ms.values())
+        assert "pallas-safety" in result.per_pass_ms
+        assert "table-drift" in result.per_pass_ms
+        assert "fork-safety" in result.per_pass_ms
+
+    def test_per_pass_ms_in_report(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        report = tmp_path / "vet_report.json"
+        vet_main([str(p), "--no-baseline", "--report", str(report)])
+        data = json.loads(report.read_text())
+        assert set(data["per_pass_ms"]) == set(data["per_pass"])
+
+    def test_slowest_pass_printed(self, tmp_path, capsys):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        vet_main([str(p), "--no-baseline"])
+        assert "slowest pass:" in capsys.readouterr().err
+
+
+class TestStaleBaselineListing:
+    def test_exact_stale_lines_printed(self, tmp_path, capsys):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        base = tmp_path / "baseline.txt"
+        base.write_text("gone.py|E02|no longer found\n")
+        rc = vet_main([str(p), "--baseline", str(base)])
+        err = capsys.readouterr().err
+        assert rc == 0
+        assert "stale baseline entry: gone.py|E02|no longer found" in err
+
+
+# -- dynamic sanitizer harness (tools/vet/dyn.py) ----------------------------
+
+
+class TestDynHarness:
+    def test_evaluate_leaks_clean(self):
+        assert vet_dyn.evaluate_leaks({
+            "fd_start": 10, "fd_end": 12,
+            "extra_threads": [], "asyncio_errors": []}) == []
+
+    def test_evaluate_leaks_fd_growth(self):
+        probs = vet_dyn.evaluate_leaks({
+            "fd_start": 10, "fd_end": 200,
+            "extra_threads": [], "asyncio_errors": []})
+        assert probs and "fd leak" in probs[0]
+
+    def test_evaluate_leaks_threads_and_asyncio(self):
+        probs = vet_dyn.evaluate_leaks({
+            "fd_start": 10, "fd_end": 10,
+            "extra_threads": ["worker-3"],
+            "asyncio_errors": ["Task was destroyed but it is pending!"]})
+        assert len(probs) == 2
+        assert "thread leak" in probs[0]
+        assert "asyncio error-log" in probs[1]
+
+    def test_evaluate_leaks_no_fd_accounting(self):
+        # non-Linux boxes report -1; no false fd finding
+        assert vet_dyn.evaluate_leaks({
+            "fd_start": -1, "fd_end": -1,
+            "extra_threads": [], "asyncio_errors": []}) == []
+
+    def test_plugin_writes_session_report(self, tmp_path):
+        (tmp_path / "test_tiny.py").write_text(
+            "def test_ok():\n    assert 1 + 1 == 2\n")
+        report = tmp_path / "dyn_report.json"
+        env = dict(__import__("os").environ)
+        env[vet_dyn.REPORT_ENV] = str(report)
+        env.pop(vet_dyn.NANS_ENV, None)   # keep jax out of this run
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", str(tmp_path), "-q",
+             "-p", "tools.vet.dyn", "-p", "no:cacheprovider"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        data = json.loads(report.read_text())
+        assert data["exitstatus"] == 0
+        assert vet_dyn.evaluate_leaks(data) == []
 
 
 # -- meta: the analyzer meets its own standard -------------------------------

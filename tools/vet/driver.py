@@ -10,13 +10,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.vet import (async_safety, carry_contract, donation, exceptions,
-                       names, overflow, shard_exact, tracer_purity,
+                       fork_safety, names, overflow, pallas_safety,
+                       shard_exact, table_drift, tracer_purity,
                        wire_schema)
 from tools.vet.core import (FileCtx, Finding, Pass, collect_files,
                             load_baseline, write_baseline)
@@ -40,6 +43,11 @@ PASSES: List[Pass] = [
     Pass("carry-contract", codes=("C01", "C02"),
          check=carry_contract.check),
     Pass("overflow", codes=("O01", "O02"), check=overflow.check),
+    Pass("pallas-safety", codes=("P01", "P02", "P03", "P04"),
+         check=pallas_safety.check),
+    Pass("table-drift", codes=("K01", "K02"),
+         check_project=table_drift.check_project),
+    Pass("fork-safety", codes=("R01", "R02"), check=fork_safety.check),
 ]
 
 # pyvet backwards-compat: the two legacy passes ride in "names"
@@ -57,6 +65,7 @@ class VetResult:
     stale_baseline: List[str] = field(default_factory=list)
     parse_errors: List[Finding] = field(default_factory=list)
     per_pass: Dict[str, int] = field(default_factory=dict)
+    per_pass_ms: Dict[str, float] = field(default_factory=dict)
     files: int = 0
 
     @property
@@ -66,10 +75,58 @@ class VetResult:
         return 1 if self.findings else 0
 
 
+def partner_groups() -> List[Tuple[str, ...]]:
+    """Path-suffix groups a cross-file pass compares as a unit: when
+    ``--changed`` touches one member, the whole group must be vetted
+    or the comparison is against thin air."""
+    groups: List[Tuple[str, ...]] = [tuple(wire_schema.WIRE_MODULES)]
+    for g in table_drift.GROUPS:
+        groups.append(tuple([g.governing.suffix]
+                            + [s.suffix for s in g.satellites]))
+    return groups
+
+
+def _suffix_match(path: str, suffix: str) -> bool:
+    return path == suffix or path.endswith("/" + suffix)
+
+
+def expand_partners(changed: Set[str],
+                    all_paths: Sequence[str]) -> Set[str]:
+    """The changed set plus every cross-file partner group any changed
+    file belongs to.  Donation tracking is deliberately NOT expanded
+    (donors can live anywhere jax is imported) — the full ``make vet``
+    stays the authority; ``--changed`` is the cheap pre-commit gate."""
+    only = {p for p in all_paths if p in changed}
+    for group in partner_groups():
+        members = [p for p in all_paths
+                   if any(_suffix_match(p, s) for s in group)]
+        if any(p in only for p in members):
+            only.update(members)
+    return only
+
+
+def changed_paths() -> Set[str]:
+    """Repo-relative .py files touched per git (worktree vs HEAD, plus
+    untracked).  Run from the repo root so the paths line up with the
+    vet display paths."""
+    out: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True)
+        except OSError:
+            continue
+        if r.returncode == 0:
+            out.update(line.strip() for line in r.stdout.splitlines()
+                       if line.strip())
+    return {p for p in out if p.endswith(".py")}
+
+
 def run_vet(roots: Sequence[str],
             passes: Optional[Sequence[str]] = None,
             baseline_path: Optional[Path] = DEFAULT_BASELINE,
-            update_baseline: bool = False) -> VetResult:
+            update_baseline: bool = False,
+            only: Optional[Set[str]] = None) -> VetResult:
     result = VetResult()
     selected = [p for p in PASSES if passes is None or p.name in passes]
     ctxs: List[FileCtx] = []
@@ -80,15 +137,30 @@ def run_vet(roots: Sequence[str],
         except SyntaxError as e:
             result.parse_errors.append(Finding(
                 display, e.lineno or 0, "P00", f"syntax error: {e.msg}"))
-    result.files = len(ctxs)
+    if only is not None:
+        only = expand_partners(only, [c.path for c in ctxs])
+        result.parse_errors = [f for f in result.parse_errors
+                               if f.path in only]
+    result.files = len(ctxs) if only is None else \
+        sum(1 for c in ctxs if c.path in only)
     by_path = {c.path: c for c in ctxs}
 
     raw: List[Finding] = []
     for p in selected:
-        found = p.run(ctxs)
+        t0 = time.perf_counter()
+        if only is not None and p.check is not None:
+            # per-file passes only need the changed files; project
+            # passes see everything and get their findings filtered
+            found = p.run([c for c in ctxs if c.path in only])
+        else:
+            found = p.run(ctxs)
+        if only is not None:
+            found = [f for f in found if f.path in only]
         kept = [f for f in found
                 if not by_path[f.path].suppressed(f.line, f.code)]
         result.per_pass[p.name] = len(kept)
+        result.per_pass_ms[p.name] = round(
+            (time.perf_counter() - t0) * 1000.0, 2)
         raw.extend(kept)
 
     baseline = load_baseline(baseline_path) if baseline_path else []
@@ -109,7 +181,10 @@ def run_vet(roots: Sequence[str],
                     break
         else:
             result.findings.append(f)
-    result.stale_baseline = [k for k in baseline if k not in matched]
+    # A partial run (--changed / explicit subset) cannot judge
+    # staleness: entries for un-vetted files would all look stale.
+    result.stale_baseline = [] if only is not None or passes is not None \
+        else [k for k in baseline if k not in matched]
     result.findings.sort(key=lambda f: (f.path, f.line, f.code))
     return result
 
@@ -126,6 +201,7 @@ def result_to_json(result: VetResult) -> Dict[str, object]:
         "findings": [enc(f) for f in result.findings],
         "parse_errors": [enc(f) for f in result.parse_errors],
         "per_pass": dict(result.per_pass),
+        "per_pass_ms": dict(result.per_pass_ms),
         "baselined": result.baselined,
         "stale_baseline": list(result.stale_baseline),
     }
@@ -144,6 +220,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--fast", action="store_true",
                     help="skip the flow-sensitive JAX passes ("
                          + ", ".join(FLOW_PASSES) + ") for inner-loop use")
+    ap.add_argument("--changed", action="store_true",
+                    help="vet only files touched per git (worktree vs "
+                         "HEAD + untracked) plus their cross-file pass "
+                         "partners (wire surface, dispatch-table "
+                         "groups); exit-code contract unchanged "
+                         "(0 clean / 1 findings / 2 parse error); run "
+                         "from the repo root")
     ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                     help="baseline file (default tools/vet/baseline.txt)")
     ap.add_argument("--no-baseline", action="store_true",
@@ -171,10 +254,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   if (passes is None or p.name in passes)
                   and p.name not in FLOW_PASSES]
 
+    only: Optional[Set[str]] = None
+    if args.changed:
+        only = changed_paths()
+
     result = run_vet(
         args.paths, passes=passes,
         baseline_path=None if args.no_baseline else Path(args.baseline),
-        update_baseline=args.write_baseline)
+        update_baseline=args.write_baseline, only=only)
 
     if args.report:
         Path(args.report).write_text(
@@ -194,15 +281,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if result.stale_baseline:
         extras.append(f"{len(result.stale_baseline)} stale baseline "
                       "entr(y/ies) — prune tools/vet/baseline.txt")
+        # the exact lines to delete, one per line, greppable verbatim
+        for key in result.stale_baseline:
+            print(f"vet: stale baseline entry: {key}", file=sys.stderr)
     tail = f" ({'; '.join(extras)})" if extras else ""
     status = "clean" if result.rc == 0 else \
         f"{len(result.findings) + len(result.parse_errors)} finding(s)"
+    if result.per_pass_ms:
+        slow_name, slow_ms = max(result.per_pass_ms.items(),
+                                 key=lambda kv: kv[1])
+        total_ms = sum(result.per_pass_ms.values())
+        print(f"vet: slowest pass: {slow_name} ({slow_ms:.0f} ms of "
+              f"{total_ms:.0f} ms total)", file=sys.stderr)
     print(f"vet: {result.files} files, {status}{tail}", file=sys.stderr)
     return result.rc
 
 
 __all__ = ["run_vet", "main", "VetResult", "PASSES", "LEGACY_PASSES",
-           "FLOW_PASSES", "result_to_json"]
+           "FLOW_PASSES", "result_to_json", "changed_paths",
+           "expand_partners", "partner_groups"]
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,266 @@
+"""Fork/concurrency safety pass for the serving plane.
+
+PR 7's multi-worker HTTP front spawns workers from the agent process;
+the classic way that goes wrong is state that exists *before* the
+child processes split off:
+
+- **R01 concurrency before fork**: a thread, event loop, or executor
+  started on a code path reachable before an ``os.fork()`` /
+  ``os.forkpty()``.  Only the forking thread survives in the child —
+  any other thread's locks are frozen mid-state (CPython's
+  ``os.fork`` warning made this a DeprecationWarning in 3.12).  The
+  pass flags (a) starts earlier in the same function as a fork call
+  and (b) module-level starts in any module that forks (import-time
+  threads precede every fork).  ``subprocess.Popen`` is exempt by
+  construction — it execs, it does not fork-without-exec — which is
+  why ``agent/workers.py`` is clean.
+- **R02 unlocked cross-context write**: mutable module-level state
+  (dict/list/set and friends) mutated from BOTH a coroutine context
+  (``async def``) and a thread context (a function handed to
+  ``threading.Thread(target=...)``, ``asyncio.to_thread``, or
+  ``run_in_executor``) where at least one of the writes holds no
+  module-level ``threading.Lock``/``RLock``.  The event loop and the
+  thread interleave arbitrarily; dict/list ops are atomic only by
+  CPython accident, and compound updates (check-then-set,
+  read-modify-write) are not atomic at all.
+
+Scope: R01 gates on ``fork`` appearing in the source; R02 on files
+that define module-level mutable containers AND start threads or
+define coroutines.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.vet.core import FileCtx, Finding, dotted_name
+from tools.vet.tracer_purity import _tail
+
+FORK_AFTER_START = "R01"
+UNLOCKED_SHARED_WRITE = "R02"
+
+_FORKS = {"os.fork", "os.forkpty"}
+_LOOP_STARTS = {"asyncio.run", "asyncio.new_event_loop",
+                "asyncio.get_event_loop"}
+_MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                  "Counter", "OrderedDict"}
+_MUTATORS = {"append", "add", "update", "pop", "popitem", "setdefault",
+             "extend", "remove", "discard", "clear", "insert"}
+_THREAD_HANDOFFS = {"to_thread", "run_in_executor"}
+
+
+def _enclosing_functions(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _tail(node.func) == "Thread"
+
+
+def _start_calls(scope: ast.AST) -> List[Tuple[int, str]]:
+    """(line, what) for every thread/loop/executor start in scope."""
+    thread_names: Set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    thread_names.add(t.id)
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+        if dn in _LOOP_STARTS:
+            out.append((node.lineno, f"{dn}()"))
+        elif _tail(node.func) == "ThreadPoolExecutor":
+            out.append((node.lineno, "ThreadPoolExecutor(...)"))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "start":
+            # .attr, not _tail(): the holder may be a Call expression
+            # (Thread(...).start()), which dotted-name helpers reject
+            holder = node.func.value
+            if _is_thread_ctor(holder):
+                out.append((node.lineno, "Thread(...).start()"))
+            elif isinstance(holder, ast.Name) \
+                    and holder.id in thread_names:
+                out.append((node.lineno, f"{holder.id}.start()"))
+    return out
+
+
+def _fork_calls(scope: ast.AST) -> List[int]:
+    return [n.lineno for n in ast.walk(scope)
+            if isinstance(n, ast.Call)
+            and dotted_name(n.func) in _FORKS]
+
+
+def _check_r01(ctx: FileCtx, out: List[Finding]) -> None:
+    if "fork" not in ctx.src:
+        return
+    all_forks = _fork_calls(ctx.tree)
+    if not all_forks:
+        return
+    # (a) starts earlier in the same function as a fork
+    for fn in _enclosing_functions(ctx.tree):
+        forks = _fork_calls(fn)
+        if not forks:
+            continue
+        first_fork = min(forks)
+        for line, what in _start_calls(fn):
+            if line < first_fork:
+                out.append(Finding(
+                    ctx.path, line, FORK_AFTER_START,
+                    f"{what} started before the os.fork() at line "
+                    f"{first_fork} — only the forking thread survives "
+                    "in the child; any lock another thread holds is "
+                    "frozen forever (start workers first, or exec)"))
+    # (b) module-level starts in a forking module (run at import time,
+    # before any fork can happen)
+    in_function: Set[int] = set()
+    for fn in _enclosing_functions(ctx.tree):
+        for sub in ast.walk(fn):
+            in_function.add(id(sub))
+    module_starts = [
+        (line, what) for line, what in _start_calls(ctx.tree)
+        if not any(id(node) in in_function
+                   for node in ast.walk(ctx.tree)
+                   if isinstance(node, ast.Call)
+                   and node.lineno == line)]
+    for line, what in module_starts:
+        out.append(Finding(
+            ctx.path, line, FORK_AFTER_START,
+            f"module-level {what} in a module that calls os.fork() — "
+            "import-time threads precede every fork; start them "
+            "lazily after the workers split"))
+
+
+def _module_mutables(tree: ast.Module) -> Dict[str, int]:
+    """Module-level ``NAME = <mutable container>`` -> line."""
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        v = node.value
+        if isinstance(v, (ast.Dict, ast.List, ast.Set)) \
+                or (isinstance(v, ast.Call)
+                    and _tail(v.func) in _MUTABLE_CTORS):
+            out[node.targets[0].id] = node.lineno
+    return out
+
+
+def _module_locks(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _tail(node.value.func) in ("Lock", "RLock"):
+            out.add(node.targets[0].id)
+    return out
+
+
+def _thread_entry_names(tree: ast.Module) -> Set[str]:
+    """Function names handed to a thread: Thread(target=f),
+    to_thread(f, ...), run_in_executor(None, f, ...)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_thread_ctor(node):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    name = _tail(kw.value)
+                    if name:
+                        out.add(name)
+        tail = _tail(node.func)
+        if tail == "to_thread" and node.args:
+            name = _tail(node.args[0])
+            if name:
+                out.add(name)
+        elif tail == "run_in_executor" and len(node.args) >= 2:
+            name = _tail(node.args[1])
+            if name:
+                out.add(name)
+    return out
+
+
+def _mutations(fn: ast.AST, globals_: Set[str],
+               locks: Set[str]) -> List[Tuple[str, int, bool]]:
+    """(name, line, locked) for every mutation of a module global
+    inside fn.  ``locked`` = the mutation sits under ``with <lock>:``
+    for a module-level Lock/RLock."""
+    lock_spans: List[Tuple[int, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = _tail(item.context_expr)
+                if name in locks:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    lock_spans.append((node.lineno, end))
+
+    def locked(line: int) -> bool:
+        return any(a <= line <= b for a, b in lock_spans)
+
+    out: List[Tuple[str, int, bool]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in globals_:
+                    out.append((t.value.id, node.lineno,
+                                locked(node.lineno)))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in globals_:
+            out.append((node.func.value.id, node.lineno,
+                        locked(node.lineno)))
+    return out
+
+
+def _check_r02(ctx: FileCtx, out: List[Finding]) -> None:
+    mutables = _module_mutables(ctx.tree)
+    if not mutables:
+        return
+    locks = _module_locks(ctx.tree)
+    thread_entries = _thread_entry_names(ctx.tree)
+    names = set(mutables)
+    # name -> context -> list of (line, locked)
+    writes: Dict[str, Dict[str, List[Tuple[int, bool]]]] = {}
+    for fn in _enclosing_functions(ctx.tree):
+        if isinstance(fn, ast.AsyncFunctionDef):
+            context = "async"
+        elif fn.name in thread_entries:
+            context = "thread"
+        else:
+            continue
+        for name, line, is_locked in _mutations(fn, names, locks):
+            writes.setdefault(name, {}).setdefault(
+                context, []).append((line, is_locked))
+    for name, by_ctx in sorted(writes.items()):
+        if "async" not in by_ctx or "thread" not in by_ctx:
+            continue
+        unlocked = [(line, c) for c in ("async", "thread")
+                    for line, is_locked in by_ctx[c] if not is_locked]
+        for line, context in sorted(unlocked):
+            out.append(Finding(
+                ctx.path, line, UNLOCKED_SHARED_WRITE,
+                f"module-level '{name}' (line {mutables[name]}) is "
+                f"written from both coroutine and thread contexts; "
+                f"this {context}-context write holds no module-level "
+                "threading.Lock — compound updates interleave with "
+                "the other context (guard every writer with one "
+                "lock)"))
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    _check_r01(ctx, out)
+    _check_r02(ctx, out)
+    return sorted(set(out), key=lambda f: (f.line, f.code, f.message))

@@ -1,0 +1,444 @@
+"""Pallas kernel-safety pass: the lowering contracts around every
+``pallas_call`` site that Mosaic enforces late (or never) and the
+interpreter not at all.
+
+The fused dissemination kernel (``gossip/fused.py``) established the
+conventions this pass audits.  Its failure modes are silent on the CPU
+mesh — interpret mode pads or wraps where the TPU lowering would
+corrupt or reject — so they belong to vet, not pytest:
+
+- **P01 unguarded block divisibility**: a BlockSpec block width (or
+  grid extent) derived by floor division ``B = X // Y`` whose
+  divisibility contract ``X % Y == 0`` has no guard in the enclosing
+  function.  A remainder column silently falls outside the grid.
+  Guard evidence, in agreement with the runtime (the shared helper
+  ``consul_tpu/ops/divisibility.py``): a ``require_divisible(X, Y)``
+  call, or an explicit ``X % Y`` test (``if``/``assert``/comparison).
+  When both operands are integer literals the pass constant-folds with
+  the SAME ``divides`` predicate the runtime guard uses: a statically
+  violated contract flags even if guarded (the guard would always
+  raise), a statically satisfied one is clean.
+- **P02 no interpret fallback**: a ``pallas_call`` without an
+  ``interpret=`` keyword.  Off-TPU (CPU CI, the 8-device virtual
+  mesh) such a call aborts in the Mosaic lowering — every kernel here
+  must stay runnable on this box (``fused._interpret()`` idiom).
+- **P03 unbounded window offset**: index arithmetic that can step
+  outside the block window. Two shapes: (a) a BlockSpec index-map
+  lambda that subscripts its scalar-prefetch parameter with no
+  modulo reduction around the use (block indices must wrap mod the
+  block count: ``(j - qr[f] - 1) % nb``); (b) an in-kernel
+  ``dynamic_slice`` whose start uses a value read out of a Ref with
+  no modulo evidence either at the use site or in the construction
+  of the scalar operand passed to the ``pallas_call`` (the residue
+  certificate: ``offs % Bn`` feeding the prefetch vector bounds the
+  in-kernel splice).
+- **P04 non-static scalar-prefetch consumption**: under a
+  ``PrefetchScalarGridSpec``, the first ``num_scalar_prefetch``
+  kernel parameters are scalar refs meant to be indexed statically
+  (Python ints, ``range()`` loop variables).  Indexing one with
+  ``program_id(...)`` or with a value read from another ref is a
+  data-dependent gather the Mosaic lowering handles differently from
+  the interpreter — exactly the class of divergence the parity suite
+  cannot sweep.
+
+Scope: files that import ``jax.experimental.pallas`` (source mention
+of ``pallas`` + a resolvable ``pallas_call`` call site).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from consul_tpu.ops.divisibility import divides
+from tools.vet.core import FileCtx, Finding
+from tools.vet.tracer_purity import _tail
+
+UNGUARDED_DIV = "P01"
+NO_INTERPRET = "P02"
+UNBOUNDED_OFFSET = "P03"
+NONSTATIC_PREFETCH = "P04"
+
+_GUARD_FUNCS = {"require_divisible"}
+
+
+def _enclosing_function(tree: ast.Module, node: ast.AST
+                        ) -> Optional[ast.AST]:
+    """Innermost FunctionDef/AsyncFunctionDef containing ``node``
+    (module itself when at top level)."""
+    best: Optional[ast.AST] = None
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(fn):
+                if sub is node:
+                    if best is None or (fn.lineno >= best.lineno):
+                        best = fn
+                    break
+    return best
+
+
+def _defs_by_name(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _int_const(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _expr_token(node: ast.expr) -> Optional[str]:
+    """A comparable token for a divisibility operand: dotted name or
+    int literal rendered as text."""
+    dn = _tail(node)
+    if dn is not None:
+        return dn
+    c = _int_const(node)
+    return str(c) if c is not None else None
+
+
+def _mod_pairs(scope: ast.AST) -> Set[Tuple[str, str]]:
+    """Every ``X % Y`` pair (by token) appearing anywhere in scope —
+    guard evidence for the (X, Y) divisibility contract."""
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            a, b = _expr_token(node.left), _expr_token(node.right)
+            if a and b:
+                out.add((a, b))
+    return out
+
+
+def _guard_calls(scope: ast.AST) -> Set[Tuple[str, str]]:
+    """(X, Y) token pairs passed to the shared require_divisible
+    helper inside scope."""
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and _tail(node.func) in _GUARD_FUNCS \
+                and len(node.args) >= 2:
+            a = _expr_token(node.args[0])
+            b = _expr_token(node.args[1])
+            if a and b:
+                out.add((a, b))
+    return out
+
+
+class _Site:
+    """One resolved ``pallas_call`` site."""
+
+    def __init__(self, call: ast.Call, scope: ast.AST,
+                 kernel: Optional[ast.FunctionDef],
+                 prefetch: int, grid_spec: Optional[ast.Call]) -> None:
+        self.call = call
+        self.scope = scope          # enclosing function (or module)
+        self.kernel = kernel        # the kernel def, when resolvable
+        self.prefetch = prefetch    # num_scalar_prefetch (0 = none)
+        self.grid_spec = grid_spec
+
+
+def _collect_sites(ctx: FileCtx) -> List[_Site]:
+    module_defs = _defs_by_name(ctx.tree)
+    sites: List[_Site] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _tail(node.func) == "pallas_call"):
+            continue
+        scope0 = _enclosing_function(ctx.tree, node) or ctx.tree
+        kernel = None
+        if node.args:
+            kname = _tail(node.args[0])
+            # Resolve within the enclosing scope first: every kernel
+            # body here is a closure named ``kern`` nested in its own
+            # wrapper, so the module-level map would alias them.
+            local_defs = _defs_by_name(scope0) \
+                if scope0 is not ctx.tree else module_defs
+            if kname in local_defs:
+                kernel = local_defs[kname]
+            elif kname in module_defs:
+                kernel = module_defs[kname]
+        prefetch = 0
+        grid_spec = None
+        gs = _kw(node, "grid_spec")
+        if isinstance(gs, ast.Call) \
+                and _tail(gs.func) == "PrefetchScalarGridSpec":
+            grid_spec = gs
+            nsp = _kw(gs, "num_scalar_prefetch")
+            c = _int_const(nsp) if nsp is not None else None
+            prefetch = c if c is not None else 1
+        sites.append(_Site(node, scope0, kernel, prefetch, grid_spec))
+    return sites
+
+
+# -- P01: block divisibility ------------------------------------------------
+
+
+def _blockish_names(site: _Site) -> Set[str]:
+    """Names used as BlockSpec shape elements or grid extents in the
+    site's enclosing scope — the values whose floor-division origin
+    must be guarded.  Walks the whole scope, not just the call
+    expression: the idiom builds ``in_specs = [...]`` as a separate
+    statement and passes the name (gossip/fused.py)."""
+    out: Set[str] = set()
+    for node in ast.walk(site.scope):
+        if isinstance(node, ast.Call) and _tail(node.func) == "BlockSpec" \
+                and node.args:
+            shape = node.args[0]
+            for el in ast.walk(shape):
+                if isinstance(el, ast.Name):
+                    out.add(el.id)
+        elif isinstance(node, ast.keyword) and node.arg == "grid":
+            for el in ast.walk(node.value):
+                if isinstance(el, ast.Name):
+                    out.add(el.id)
+    return out
+
+
+def _check_p01(ctx: FileCtx, site: _Site, out: List[Finding]) -> None:
+    wanted = _blockish_names(site)
+    if not wanted:
+        return
+    # floor-division assignments in the enclosing scope: B = X // Y
+    pairs: List[Tuple[str, ast.BinOp, int]] = []
+    for node in ast.walk(site.scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.BinOp) \
+                and isinstance(node.value.op, ast.FloorDiv):
+            name = node.targets[0].id
+            if name in wanted:
+                pairs.append((name, node.value, node.lineno))
+    if not pairs:
+        return
+    mods = _mod_pairs(site.scope)
+    guards = _guard_calls(site.scope)
+    for name, binop, lineno in pairs:
+        a, b = _expr_token(binop.left), _expr_token(binop.right)
+        if a is None or b is None:
+            continue
+        ca = _int_const(binop.left)
+        cb = _int_const(binop.right)
+        if ca is not None and cb is not None:
+            # constant-fold with the runtime's own predicate
+            if divides(ca, cb):
+                continue
+            out.append(Finding(
+                ctx.path, lineno, UNGUARDED_DIV,
+                f"block width '{name}' = {ca} // {cb} does not tile: "
+                f"{ca} % {cb} != 0 — the pallas_call grid drops the "
+                "remainder columns (divides() in "
+                "consul_tpu/ops/divisibility.py)"))
+            continue
+        if (a, b) in mods or (a, b) in guards:
+            continue
+        out.append(Finding(
+            ctx.path, lineno, UNGUARDED_DIV,
+            f"block width '{name}' = {a} // {b} feeds a pallas_call "
+            f"BlockSpec/grid but the divisibility contract "
+            f"{a} % {b} == 0 is unguarded in the enclosing function — "
+            f"call require_divisible({a}, {b}, ...) "
+            "(consul_tpu/ops/divisibility.py) so the remainder columns "
+            "cannot silently fall off the grid"))
+
+
+# -- P02: interpret fallback ------------------------------------------------
+
+
+def _check_p02(ctx: FileCtx, site: _Site, out: List[Finding]) -> None:
+    if _kw(site.call, "interpret") is None:
+        out.append(Finding(
+            ctx.path, site.call.lineno, NO_INTERPRET,
+            "pallas_call without an interpret= fallback — off-TPU "
+            "(CPU CI, the virtual mesh) this aborts in the Mosaic "
+            "lowering; gate it like gossip/fused.py's _interpret() "
+            "(interpret=True whenever the backend is not a TPU)"))
+
+
+# -- P03: window offsets ----------------------------------------------------
+
+
+def _under_mod(root: ast.expr, target: ast.AST) -> bool:
+    """True when ``target`` sits under a ``%`` BinOp within root."""
+    for node in ast.walk(root):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            for sub in ast.walk(node):
+                if sub is target:
+                    return True
+    return False
+
+
+def _check_index_maps(ctx: FileCtx, site: _Site,
+                      out: List[Finding]) -> None:
+    # Walk the whole enclosing scope: index maps are usually built in
+    # a separate ``in_specs = [...]`` statement (gossip/fused.py), not
+    # inline in the pallas_call expression.
+    for node in ast.walk(site.scope):
+        if not (isinstance(node, ast.Call)
+                and _tail(node.func) == "BlockSpec"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Lambda)):
+            continue
+        lam = node.args[1]
+        # scalar-prefetch param of the index map: every arg past
+        # the grid axes; with num_scalar_prefetch the convention
+        # is (j, ..., qr) — subscripting ANY lambda param is the
+        # prefetch-read shape we bound-check.
+        params = {a.arg for a in lam.args.args}
+        for sub in ast.walk(lam.body):
+            if isinstance(sub, ast.Subscript) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in params \
+                    and not _under_mod(lam.body, sub):
+                out.append(Finding(
+                    ctx.path, getattr(sub, "lineno", node.lineno),
+                    UNBOUNDED_OFFSET,
+                    f"BlockSpec index map reads prefetch scalar "
+                    f"'{ast.unparse(sub)}' without a modulo "
+                    "reduction — a shift >= the block count "
+                    "indexes a block outside the grid; wrap the "
+                    "expression mod the block count "
+                    "((j - qr[f] - 1) % nb)"))
+
+
+def _ref_read_names(kernel: ast.FunctionDef,
+                    ref_params: Set[str]) -> Set[str]:
+    """Names assigned from a subscript of a ref parameter inside the
+    kernel body (``r = qr_ref[...]``)."""
+    out: Set[str] = set()
+    for node in ast.walk(kernel):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Subscript) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id in ref_params:
+                    out.add(node.targets[0].id)
+    return out
+
+
+def _prefetch_operand_has_mod(ctx: FileCtx, site: _Site) -> bool:
+    """The residue certificate: the scalar operand handed to the
+    pallas_call invocation was built with a ``%`` (e.g. ``offs % Bn``
+    concatenated into the prefetch vector)."""
+    # the invocation wrapping the pallas_call result: find Call whose
+    # func IS site.call
+    operand: Optional[ast.expr] = None
+    for node in ast.walk(site.scope):
+        if isinstance(node, ast.Call) and node.func is site.call \
+                and node.args:
+            operand = node.args[0]
+            break
+    if operand is None:
+        return False
+    for sub in ast.walk(operand):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Mod):
+            return True
+    name = _tail(operand)
+    if name is None:
+        return False
+    for node in ast.walk(site.scope):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.BinOp) \
+                        and isinstance(sub.op, ast.Mod):
+                    return True
+    return False
+
+
+def _check_p03(ctx: FileCtx, site: _Site, out: List[Finding]) -> None:
+    _check_index_maps(ctx, site, out)
+    if site.kernel is None:
+        return
+    ref_params = {a.arg for a in site.kernel.args.args}
+    if site.kernel.args.vararg is not None:
+        ref_params.add(site.kernel.args.vararg.arg)
+    reads = _ref_read_names(site.kernel, ref_params)
+    if not reads:
+        return
+    certified = _prefetch_operand_has_mod(ctx, site)
+    for node in ast.walk(site.kernel):
+        if not (isinstance(node, ast.Call)
+                and _tail(node.func) == "dynamic_slice"
+                and len(node.args) >= 2):
+            continue
+        start = node.args[1]
+        for sub in ast.walk(start):
+            if isinstance(sub, ast.Name) and sub.id in reads:
+                if _under_mod(start, sub) or certified:
+                    break
+                out.append(Finding(
+                    ctx.path, node.lineno, UNBOUNDED_OFFSET,
+                    f"in-kernel dynamic_slice start uses '{sub.id}' "
+                    "read from a Ref with no modulo evidence — "
+                    "neither at the slice nor in the construction of "
+                    "the scalar-prefetch operand (the 'offs % Bn' "
+                    "residue certificate); an oversized offset reads "
+                    "past the block window"))
+                break
+
+
+# -- P04: static prefetch consumption ---------------------------------------
+
+
+def _check_p04(ctx: FileCtx, site: _Site, out: List[Finding]) -> None:
+    if site.kernel is None or site.prefetch <= 0:
+        return
+    posargs = [a.arg for a in site.kernel.args.args]
+    scalar_refs = set(posargs[:site.prefetch])
+    if not scalar_refs:
+        return
+    other_refs = set(posargs[site.prefetch:])
+    if site.kernel.args.vararg is not None:
+        other_refs.add(site.kernel.args.vararg.arg)
+    for node in ast.walk(site.kernel):
+        if not (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in scalar_refs):
+            continue
+        why = None
+        for sub in ast.walk(node.slice):
+            if isinstance(sub, ast.Call) \
+                    and _tail(sub.func) == "program_id":
+                why = "program_id(...)"
+                break
+            if isinstance(sub, ast.Subscript) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in (scalar_refs | other_refs):
+                why = f"a read of ref '{sub.value.id}'"
+                break
+        if why is not None:
+            out.append(Finding(
+                ctx.path, node.lineno, NONSTATIC_PREFETCH,
+                f"scalar-prefetch ref '{node.value.id}' indexed with "
+                f"{why} — prefetch operands must be consumed with "
+                "static (Python-int) indices; a data-dependent gather "
+                "lowers differently under Mosaic than under the "
+                "interpreter"))
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    if "pallas" not in ctx.src:
+        return []
+    sites = _collect_sites(ctx)
+    if not sites:
+        return []
+    findings: List[Finding] = []
+    for site in sites:
+        _check_p01(ctx, site, findings)
+        _check_p02(ctx, site, findings)
+        _check_p03(ctx, site, findings)
+        _check_p04(ctx, site, findings)
+    return sorted(set(findings), key=lambda f: (f.line, f.code, f.message))

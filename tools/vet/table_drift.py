@@ -1,0 +1,346 @@
+"""Dispatch-table drift pass: string-keyed strategy tables that
+duplicate one governing literal set across files, with nothing but
+review discipline pinning the key sets together.
+
+The repo dispatches two planes on string keys today:
+
+- ``SwimParams.dissem`` — governed by the membership check in
+  ``consul_tpu/gossip/params.py`` (``__post_init__``); duplicated by
+  ``DENSE_PASSES_BY_DISSEM`` in ``consul_tpu/obs/devstats.py`` (the
+  roofline's analytic pass counts), the ``--dissem`` argparse choices
+  in ``bench.py``, and the same flag in ``tools/profile_kernel.py``.
+  A strategy added to params but not devstats silently prices rounds
+  with the wrong pass count; missing argparse choices make it
+  unbenchable.
+- ``match_backend`` — governed by the membership check in
+  ``consul_tpu/state/device_store.py``; mirrored by the
+  ``consul_watch_match_backend`` gauge help in
+  ``consul_tpu/obs/storestats.py`` (which documents the legs an
+  operator can see on a scrape).
+
+Codes:
+
+- **K01 key-set divergence**: a satellite table's keys differ from the
+  governing set (or a registered table cannot be located at all —
+  a silently-renamed table is drift, not absence).
+- **K02 stray dispatch literal**: a string literal dispatched against
+  a governing keyword at a call site (``dissem="..."`` keyword arg,
+  ``obj.dissem = "..."`` assignment, ``dissem == "..."`` comparison or
+  ``in``-tuple membership) that is absent from the governing set —
+  a typo'd strategy name that no runtime check sees until that exact
+  line executes.
+
+The registry below is declarative so the meta-test in
+``tests/test_vet.py`` can run the pass over a *copy* of the real
+sources with a deliberately desynced table and assert K01 fires.
+Files are matched by path suffix; a group whose governing file is not
+among the vetted files is skipped (subset runs, unit fixtures).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.vet.core import FileCtx, Finding
+from tools.vet.tracer_purity import _tail
+
+KEYSET_DIVERGE = "K01"
+STRAY_LITERAL = "K02"
+
+
+# -- extractors: (keys, line) from a FileCtx, or None when absent -----------
+
+
+def _str_tuple(node: ast.AST) -> Optional[Set[str]]:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)) and node.elts:
+        vals = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            vals.add(el.value)
+        return vals
+    return None
+
+
+def extract_membership(ctx: FileCtx, keyword: str
+                       ) -> Optional[Tuple[Set[str], int]]:
+    """``<x>.keyword not in ("a", "b", ...)`` (or ``in``) — the
+    governing validation idiom (params.__post_init__, device_store)."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))):
+            continue
+        if _tail(node.left) != keyword:
+            continue
+        keys = _str_tuple(node.comparators[0])
+        if keys:
+            return keys, node.lineno
+    return None
+
+
+def extract_dict_keys(ctx: FileCtx, varname: str
+                      ) -> Optional[Tuple[Set[str], int]]:
+    """Module-level ``VARNAME = {"key": ..., ...}``."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == varname \
+                and isinstance(node.value, ast.Dict):
+            keys = set()
+            for k in node.value.keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    return None
+                keys.add(k.value)
+            return keys, node.lineno
+    return None
+
+
+def extract_argparse_choices(ctx: FileCtx, flag: str
+                             ) -> Optional[Tuple[Set[str], int]]:
+    """``ap.add_argument("--flag", choices=(...))``."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _tail(node.func) == "add_argument"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == flag):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "choices":
+                keys = _str_tuple(kw.value)
+                if keys:
+                    return keys, node.lineno
+    return None
+
+
+def extract_help_mentions(ctx: FileCtx, gauge: str
+                          ) -> Optional[Tuple[str, int]]:
+    """The ``help`` string of the gauge dict literal whose ``name``
+    is ``gauge`` — compared by *mention* (substring per key), since
+    gauge help is prose, not a key list."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        fields: Dict[str, ast.expr] = {}
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                fields[k.value] = v
+        name = fields.get("name")
+        if not (isinstance(name, ast.Constant) and name.value == gauge):
+            continue
+        h = fields.get("help")
+        if isinstance(h, ast.Constant) and isinstance(h.value, str):
+            return h.value, h.lineno
+    return None
+
+
+_EXTRACTORS = {
+    "membership": extract_membership,
+    "dict_keys": extract_dict_keys,
+    "argparse_choices": extract_argparse_choices,
+}
+
+
+@dataclass(frozen=True)
+class TableRef:
+    """One table location: a path suffix + how to read its key set."""
+
+    suffix: str          # matched via ctx.path.endswith(suffix)
+    kind: str            # extractor name, or "help_mentions"
+    arg: str             # field / var / flag / gauge name
+
+
+@dataclass(frozen=True)
+class TableGroup:
+    """A governing literal set and the satellite tables that must
+    stay in key-set agreement with it."""
+
+    name: str
+    keyword: str                       # the dispatched field name
+    governing: TableRef = None         # type: ignore[assignment]
+    satellites: Sequence[TableRef] = field(default_factory=tuple)
+    # keys legitimately absent from prose-mention satellites (e.g.
+    # "auto" resolves to device/host before the gauge reports)
+    mention_exempt: Sequence[str] = field(default_factory=tuple)
+
+
+GROUPS: Sequence[TableGroup] = (
+    TableGroup(
+        name="dissem",
+        keyword="dissem",
+        governing=TableRef("consul_tpu/gossip/params.py",
+                           "membership", "dissem"),
+        satellites=(
+            TableRef("consul_tpu/obs/devstats.py",
+                     "dict_keys", "DENSE_PASSES_BY_DISSEM"),
+            TableRef("bench.py", "argparse_choices", "--dissem"),
+            TableRef("tools/profile_kernel.py",
+                     "argparse_choices", "--dissem"),
+        ),
+    ),
+    TableGroup(
+        name="match-backend",
+        keyword="match_backend",
+        governing=TableRef("consul_tpu/state/device_store.py",
+                           "membership", "match_backend"),
+        satellites=(
+            TableRef("consul_tpu/obs/storestats.py",
+                     "help_mentions", "consul_watch_match_backend"),
+        ),
+        mention_exempt=("auto",),
+    ),
+)
+
+
+def _find_ctx(ctxs: Sequence[FileCtx], suffix: str) -> Optional[FileCtx]:
+    # component-boundary suffix match: "bench.py" must not claim
+    # "tools/http_bench.py"
+    for ctx in ctxs:
+        if ctx.path == suffix or ctx.path.endswith("/" + suffix):
+            return ctx
+    return None
+
+
+def _check_group(ctxs: Sequence[FileCtx], group: TableGroup,
+                 out: List[Finding]) -> Optional[Tuple[Set[str], str, int]]:
+    """K01 for one group; returns (governing keys, path, line) when the
+    governing set resolved (K02 needs it)."""
+    gctx = _find_ctx(ctxs, group.governing.suffix)
+    if gctx is None:
+        return None     # subset run: nothing to compare against
+    extractor = _EXTRACTORS[group.governing.kind]
+    got = extractor(gctx, group.governing.arg)
+    if got is None:
+        out.append(Finding(
+            gctx.path, 1, KEYSET_DIVERGE,
+            f"governing {group.keyword!r} set "
+            f"({group.governing.kind}: {group.governing.arg}) not "
+            "found — the validation idiom moved or was removed; "
+            "update tools/vet/table_drift.py GROUPS alongside it"))
+        return None
+    gov_keys, _gov_line = got
+
+    for sat in group.satellites:
+        sctx = _find_ctx(ctxs, sat.suffix)
+        if sctx is None:
+            continue    # subset run
+        if sat.kind == "help_mentions":
+            hit = extract_help_mentions(sctx, sat.arg)
+            if hit is None:
+                out.append(Finding(
+                    sctx.path, 1, KEYSET_DIVERGE,
+                    f"gauge {sat.arg!r} not found but registered as a "
+                    f"{group.keyword!r} satellite — update "
+                    "tools/vet/table_drift.py GROUPS alongside it"))
+                continue
+            text, line = hit
+            missing = sorted(k for k in gov_keys
+                             if k not in group.mention_exempt
+                             and k not in text)
+            if missing:
+                out.append(Finding(
+                    sctx.path, line, KEYSET_DIVERGE,
+                    f"gauge {sat.arg!r} help does not mention "
+                    f"{group.keyword!r} key(s) {missing} from the "
+                    f"governing set in {group.governing.suffix} — an "
+                    "operator reading the scrape cannot see those "
+                    "legs exist"))
+            continue
+        extractor = _EXTRACTORS[sat.kind]
+        got = extractor(sctx, sat.arg)
+        if got is None:
+            out.append(Finding(
+                sctx.path, 1, KEYSET_DIVERGE,
+                f"satellite table ({sat.kind}: {sat.arg}) not found "
+                f"but registered against the {group.keyword!r} "
+                "governing set — update tools/vet/table_drift.py "
+                "GROUPS alongside it"))
+            continue
+        sat_keys, line = got
+        missing = sorted(gov_keys - sat_keys)
+        extra = sorted(sat_keys - gov_keys)
+        if missing or extra:
+            detail = []
+            if missing:
+                detail.append(f"missing {missing}")
+            if extra:
+                detail.append(f"extra {extra}")
+            out.append(Finding(
+                sctx.path, line, KEYSET_DIVERGE,
+                f"{sat.kind}:{sat.arg} diverges from the governing "
+                f"{group.keyword!r} set in {group.governing.suffix}: "
+                + ", ".join(detail)))
+    return gov_keys, gctx.path, _gov_line
+
+
+def _check_strays(ctxs: Sequence[FileCtx], group: TableGroup,
+                  gov: Tuple[Set[str], str, int],
+                  out: List[Finding]) -> None:
+    gov_keys, gov_path, gov_line = gov
+    kw = group.keyword
+    for ctx in ctxs:
+        if kw not in ctx.src:
+            continue
+        for node in ast.walk(ctx.tree):
+            # keyword argument: SwimParams(dissem="florp")
+            if isinstance(node, ast.Call):
+                for k in node.keywords:
+                    if k.arg == kw and isinstance(k.value, ast.Constant) \
+                            and isinstance(k.value.value, str) \
+                            and k.value.value not in gov_keys:
+                        # anchor on the literal's line (where a noqa
+                        # naturally sits), not the call head
+                        out.append(Finding(
+                            ctx.path, k.value.lineno, STRAY_LITERAL,
+                            f"{kw}={k.value.value!r} is not in the "
+                            f"governing set {sorted(gov_keys)} "
+                            f"({gov_path})"))
+            # attribute/name assignment: p.dissem = "florp"
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and node.value.value not in gov_keys:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == kw:
+                        out.append(Finding(
+                            ctx.path, node.lineno, STRAY_LITERAL,
+                            f"{kw} assigned {node.value.value!r}, not "
+                            f"in the governing set {sorted(gov_keys)} "
+                            f"({gov_path})"))
+            # comparison / membership: p.dissem == "florp",
+            # dissem in ("swar", "florp")
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and _tail(node.left) == kw:
+                if ctx.path == gov_path and node.lineno == gov_line:
+                    continue    # the governing membership itself
+                comp = node.comparators[0]
+                bad: List[str] = []
+                if isinstance(node.ops[0], (ast.Eq, ast.NotEq)) \
+                        and isinstance(comp, ast.Constant) \
+                        and isinstance(comp.value, str) \
+                        and comp.value not in gov_keys:
+                    bad.append(comp.value)
+                elif isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                    keys = _str_tuple(comp) or set()
+                    bad.extend(sorted(keys - gov_keys))
+                for val in bad:
+                    out.append(Finding(
+                        ctx.path, node.lineno, STRAY_LITERAL,
+                        f"{kw} compared against {val!r}, not in the "
+                        f"governing set {sorted(gov_keys)} "
+                        f"({gov_path})"))
+
+
+def check_project(ctxs: List[FileCtx],
+                  groups: Sequence[TableGroup] = GROUPS) -> List[Finding]:
+    out: List[Finding] = []
+    for group in groups:
+        gov = _check_group(ctxs, group, out)
+        if gov is not None:
+            _check_strays(ctxs, group, gov, out)
+    return sorted(set(out), key=lambda f: (f.path, f.line, f.code,
+                                           f.message))
