@@ -17,11 +17,11 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet vet-fast vet-diff vet-dyn obs-smoke bench bench-serve bench-watch dryrun clean
+.PHONY: default ci test integ vet vet-fast vet-diff vet-dyn obs-smoke chaos chaos-fast bench bench-serve bench-watch dryrun clean
 
 default: test
 
-ci: vet test integ
+ci: vet test integ chaos-fast
 
 # Unit + in-process integration tests (multi-node simulated in one
 # process with compressed timers, SURVEY.md §4).
@@ -73,6 +73,33 @@ vet-dyn:
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m tools.obs_smoke
 
+# Consensus-plane chaos campaign (consul_tpu/chaos/): one fresh
+# 3-node cluster + seeded fault schedule per scenario, gated on
+# linearizability (tests/linearize.py), lease safety (single holder +
+# no deposed-leader serve), and fault *detectability* in the raft
+# observatory; per-scenario prom scrape held to tools/check_prom.py.
+# Report: CHAOS.json; debug bundles under chaos_debug/.  `chaos` runs
+# the full catalog (incl. the fork/exec worker-crash leg); chaos-fast
+# runs the cheap subset TWICE and insists the verdicts match — the
+# fixed-seed determinism guard CI rides on.
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.chaos_campaign --seed 1234 \
+	  --out CHAOS.json --debug-dir chaos_debug
+
+chaos-fast:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.chaos_campaign --fast --seed 1234 \
+	  --out CHAOS.json --debug-dir chaos_debug
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.chaos_campaign --fast --seed 1234 \
+	  --out CHAOS2.json --debug-dir chaos_debug
+	$(PYTHON) -c "import json; \
+	  v = lambda p: [(r.get('scenario'), r.get('pass'), r.get('gates'), \
+	  (r.get('detection') or {}).get('detected')) \
+	  for r in json.load(open(p))['scenarios']]; \
+	  assert v('CHAOS.json') == v('CHAOS2.json'), \
+	  'chaos-fast verdicts differ between seeded runs'; \
+	  print('chaos-fast: verdicts deterministic under seed 1234')"
+	rm -f CHAOS2.json
+
 # North-star benchmark (needs the real chip; emits one JSON line).
 bench:
 	$(PYTHON) bench.py
@@ -100,4 +127,5 @@ dryrun:
 clean:
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 	rm -rf .jax_cache
-	rm -f vet_report.json
+	rm -rf chaos_debug
+	rm -f vet_report.json CHAOS.json CHAOS2.json

@@ -193,6 +193,7 @@ class Agent:
         # `serve` command + the tracked worker Popen pool.
         self.worker_pool = None
         self._worker_gateway = None
+        self._worker_supervisor: Optional[asyncio.Task] = None
         self._left: Optional[asyncio.Event] = None  # armed in start()
         # Gossip keyring (setupKeyrings, agent.go:350-388): an encrypt key
         # or an existing keyring file arms it.
@@ -302,6 +303,29 @@ class Agent:
             # Spawn against the BOUND port (ephemeral :0 support).
             self.worker_pool.spawn(workers - 1, self.config.bind_addr,
                                    self.http.addr[1], gw_path, internal_unix)
+            self._worker_supervisor = self._spawn(self._supervise_workers())
+
+    async def _supervise_workers(self) -> None:
+        """Worker supervisor: poll the tracked PIDs and respawn dead
+        workers with the same argv (WorkerPool.respawn_dead bounds the
+        budget, so a crash loop degrades instead of fork-storming).
+        SO_REUSEPORT keeps the port serving through the gap — the
+        kernel just stops balancing onto the dead listener."""
+        try:
+            while self.worker_pool is not None:
+                await asyncio.sleep(0.5)
+                pool = self.worker_pool
+                if pool is None:
+                    return
+                dead = pool.reap_dead()
+                if dead:
+                    fresh = pool.respawn_dead()
+                    if fresh:
+                        self.log.warn(
+                            f"agent: worker(s) {dead} died; "
+                            f"respawned as {fresh}")
+        except asyncio.CancelledError:
+            pass
 
     def _serving_sock(self, name: str) -> str:
         """Unix-socket path for the worker plumbing: under data_dir when
@@ -423,6 +447,11 @@ class Agent:
         if self._retry_join_task is not None:
             self._retry_join_task.cancel()
         await self.ipc.stop()
+        if self._worker_supervisor is not None:
+            # Supervisor before workers, or SIGTERMed children would be
+            # "reaped" and respawned mid-shutdown.
+            self._worker_supervisor.cancel()
+            self._worker_supervisor = None
         if self.worker_pool is not None:
             # Workers first (by tracked PID), then their gateway — a
             # worker mid-request sees a clean connection close, not a

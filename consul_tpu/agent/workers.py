@@ -32,6 +32,7 @@ import argparse
 import asyncio
 import itertools
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -328,8 +329,15 @@ class WorkerPool:
     a name match can catch unrelated processes (including the test
     harness itself)."""
 
+    # Respawn budget: a worker that dies is restarted with the same
+    # argv, but a crash-looping worker must not fork-bomb the box.
+    MAX_RESPAWNS = 16
+
     def __init__(self) -> None:
         self.procs: List[subprocess.Popen] = []
+        self._cmds: Dict[int, List[str]] = {}   # pid -> argv for respawn
+        self._env: Optional[Dict[str, str]] = None
+        self.respawned = 0
 
     def spawn(self, count: int, host: str, port: int,
               gateway_path: str, upstream_path: str) -> None:
@@ -338,12 +346,58 @@ class WorkerPool:
         env = dict(os.environ)
         env["PYTHONPATH"] = repo_root + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        self._env = env
         for i in range(count):
             cmd = [sys.executable, "-m", "consul_tpu.agent.workers",
                    "--host", host, "--port", str(port),
                    "--gateway", gateway_path, "--upstream", upstream_path,
                    "--id", str(i + 1)]
-            self.procs.append(subprocess.Popen(cmd, env=env))
+            p = subprocess.Popen(cmd, env=env)
+            self.procs.append(p)
+            self._cmds[p.pid] = cmd
+
+    # -- fault-injection / supervision surface (chaos/) ----------------------
+
+    def pids(self) -> List[int]:
+        """PIDs of workers currently believed alive (poll()-checked)."""
+        return [p.pid for p in self.procs if p.poll() is None]
+
+    def kill_one(self, pid: Optional[int] = None,
+                 sig: int = signal.SIGKILL) -> Optional[int]:
+        """Signal ONE tracked worker (by pid, or the first live one).
+        Returns the signalled pid, or None when no live worker matches.
+        Tracked-PID only — same rule as stop(): never by name."""
+        for p in self.procs:
+            if p.poll() is not None:
+                continue
+            if pid is not None and p.pid != pid:
+                continue
+            p.send_signal(sig)
+            return p.pid
+        return None
+
+    def reap_dead(self) -> List[int]:
+        """PIDs of tracked workers that have exited (kept in ``procs``
+        so respawn_dead can replace them in place)."""
+        return [p.pid for p in self.procs if p.poll() is not None]
+
+    def respawn_dead(self) -> List[int]:
+        """Replace each dead worker with a fresh process running the
+        same argv.  Returns the new pids; respects MAX_RESPAWNS so a
+        crash loop degrades to a smaller pool instead of a fork storm."""
+        new_pids: List[int] = []
+        for i, p in enumerate(self.procs):
+            if p.poll() is None:
+                continue
+            cmd = self._cmds.pop(p.pid, None)
+            if cmd is None or self.respawned >= self.MAX_RESPAWNS:
+                continue
+            fresh = subprocess.Popen(cmd, env=self._env)
+            self.procs[i] = fresh
+            self._cmds[fresh.pid] = cmd
+            self.respawned += 1
+            new_pids.append(fresh.pid)
+        return new_pids
 
     async def stop(self, timeout: float = 5.0) -> None:
         for p in self.procs:
@@ -357,6 +411,7 @@ class WorkerPool:
                 p.kill()
                 p.wait()
         self.procs.clear()
+        self._cmds.clear()
 
 
 # -- worker process entry ---------------------------------------------------

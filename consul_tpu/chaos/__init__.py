@@ -1,0 +1,25 @@
+"""Host-plane fault injection for the consensus/serving stack.
+
+The gossip kernel got its nemesis in PR 6 (gossip/nemesis.py:
+compiled-in correlated faults cross-validated against the refmodel
+oracle).  This package is the symmetric subsystem for the HOST plane —
+raft, leader leases, the durability pump, the RPC mesh, and the
+SO_REUSEPORT worker front:
+
+- ``broker``   — the injectable fault broker threaded through the
+  seams (clock skew/jumps, fsync stalls/errors, directional message
+  drop/delay, worker kill/restart).
+- ``scenarios`` — the declarative scenario catalog (``ChaosParams``,
+  mirroring ``NemesisParams``): seven named faults with seeded
+  determinism.
+- ``campaign`` — the runner: boots a 3-node in-process cluster per
+  scenario, drives concurrent KV clients, checks linearizability and
+  the deposed-leader-never-serves invariant, and reads fault
+  *detection* out of the PR-9 raft observatory.
+"""
+
+from consul_tpu.chaos.broker import FaultBroker, FaultClock, NodeFaults
+from consul_tpu.chaos.scenarios import CATALOG, FAST_SCENARIOS, ChaosParams
+
+__all__ = ["FaultBroker", "FaultClock", "NodeFaults", "ChaosParams",
+           "CATALOG", "FAST_SCENARIOS"]

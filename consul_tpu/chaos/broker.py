@@ -1,0 +1,187 @@
+"""The fault broker: injectable failure seams for the host plane.
+
+Three independent fault surfaces, all OFF by default so a broker-less
+node (``faults=None`` everywhere) pays a single is-None test per seam:
+
+- **Clocks** — every ``RaftNode`` time read that feeds lease/election
+  safety goes through ``NodeFaults.clock`` (a :class:`FaultClock`)
+  instead of ``time.monotonic``.  The virtual clock can run at a
+  skewed *rate* (a slow or fast oscillator) or take step *jumps*
+  (NTP slew, VM migration) — the two failure modes the
+  ``lease_clock_skew`` discount exists to survive.
+- **Durability** — ``NodeFaults.wrap_fsync`` wraps the log store's
+  ``sync`` callable (Python segment log or the C++ mmap store alike —
+  the pump is the single choke point both backends share).  The wrapper
+  runs in the executor thread the durability pump already uses, so an
+  injected stall blocks exactly what a pathological disk would block:
+  the fsync, never the event loop (BENCH_NOTES §2 is the incident this
+  reproduces on demand).
+- **Links** — directional per-edge drop probability and delay,
+  consulted by ``MemoryTransport.call`` once for the request leg
+  (src→dst) and once for the reply leg (dst→src), so asymmetric
+  partitions ("acks die, probes arrive") are expressible the same way
+  ``NemesisParams.p_ab``/``p_ba`` express them for gossip.
+
+Worker kill/restart control needs no broker state: ``WorkerPool``
+(agent/workers.py) exposes ``kill_one``/``reap_dead``/``respawn_dead``
+by tracked PID and the campaign drives those directly.
+
+Determinism: the broker owns one seeded ``random.Random`` for link
+decisions (event-loop thread) and hands each node a *derived* seed for
+fsync error draws (executor threads), so no RNG is shared across
+threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from consul_tpu.consensus.raft import TransportError
+
+
+class FaultClock:
+    """A monotonic-ish virtual clock: ``virt = anchor + (real -
+    real_anchor) * rate``.  Rate changes re-anchor so the virtual time
+    is continuous across them; ``jump`` deliberately is NOT continuous
+    (that is the fault).  ``base`` is injectable for deterministic
+    tests."""
+
+    def __init__(self, base: Callable[[], float] = time.monotonic) -> None:
+        self._base = base
+        self._rate = 1.0
+        self._real_anchor = base()
+        self._virt_anchor = self._real_anchor
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
+    def monotonic(self) -> float:
+        return (self._virt_anchor
+                + (self._base() - self._real_anchor) * self._rate)
+
+    def set_rate(self, rate: float) -> None:
+        now_virt = self.monotonic()
+        self._real_anchor = self._base()
+        self._virt_anchor = now_virt
+        self._rate = float(rate)
+
+    def jump(self, dt: float) -> None:
+        """Step the clock by ``dt`` seconds (negative = backward — the
+        direction that eats the lease safety margin)."""
+        self._virt_anchor += dt
+
+    def drift(self) -> float:
+        """Accumulated virtual-minus-real offset, seconds.  The
+        campaign records this as ground truth of what was injected."""
+        return self.monotonic() - self._base()
+
+
+class NodeFaults:
+    """Per-node fault view handed to ``RaftNode`` via
+    ``ServerConfig.faults``.  Knobs are read at use time, so the
+    campaign can flip them mid-run."""
+
+    def __init__(self, broker: "FaultBroker", name: str) -> None:
+        self.broker = broker
+        self.name = name
+        self.clock = FaultClock()
+        self.fsync_stall_s = 0.0
+        self.fsync_err_p = 0.0
+        # Executor-thread RNG, derived seed: never shared with the
+        # broker's event-loop RNG.
+        self._fsync_rng = random.Random(f"{broker.seed}/{name}/fsync")
+
+    def wrap_fsync(self, sync_fn: Callable[[], None]) -> Callable[[], None]:
+        """Wrap a log store's ``sync`` for the durability pump.  The
+        wrapper body runs in the pump's executor thread — ``time.sleep``
+        here stalls the fsync exactly like a seized disk, and an
+        injected ``OSError`` rides the pump's existing retry path."""
+        def synced() -> None:
+            stall = self.fsync_stall_s
+            if stall > 0.0:
+                time.sleep(stall)
+            if self.fsync_err_p > 0.0 \
+                    and self._fsync_rng.random() < self.fsync_err_p:
+                raise OSError(f"chaos: injected fsync error on {self.name}")
+            sync_fn()
+        return synced
+
+
+class FaultBroker:
+    """Cluster-wide fault state: per-node views + the directional link
+    table.  One broker per (in-process) cluster; attach with
+    ``MemoryTransport(faults=broker)`` and
+    ``ServerConfig(faults=broker.node(name))``."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self._nodes: Dict[str, NodeFaults] = {}
+        # (src, dst) -> (drop probability, delay seconds)
+        self._links: Dict[Tuple[str, str], Tuple[float, float]] = {}
+
+    def node(self, name: str) -> NodeFaults:
+        nf = self._nodes.get(name)
+        if nf is None:
+            nf = self._nodes[name] = NodeFaults(self, name)
+        return nf
+
+    def nodes(self) -> Dict[str, NodeFaults]:
+        return dict(self._nodes)
+
+    # -- directional links --------------------------------------------------
+
+    def set_link(self, src: str, dst: str, drop: float = 0.0,
+                 delay_s: float = 0.0) -> None:
+        if drop <= 0.0 and delay_s <= 0.0:
+            self._links.pop((src, dst), None)
+        else:
+            self._links[(src, dst)] = (drop, delay_s)
+
+    def clear_links(self) -> None:
+        self._links.clear()
+
+    def isolate(self, name: str) -> None:
+        """Full bidirectional cut between ``name`` and every other
+        registered node (the leader_flap down-phase)."""
+        for other in self._nodes:
+            if other != name:
+                self.set_link(name, other, drop=1.0)
+                self.set_link(other, name, drop=1.0)
+
+    def rejoin(self, name: str) -> None:
+        for other in list(self._nodes):
+            self.set_link(name, other)
+            self.set_link(other, name)
+
+    async def on_message(self, src: str, dst: str) -> None:
+        """One directed message leg.  Raises ``TransportError`` on a
+        drop; sleeps the configured delay otherwise.  Called by the
+        transport for the request leg and again (reversed) for the
+        reply leg."""
+        entry = self._links.get((src, dst))
+        if entry is None:
+            return
+        drop, delay = entry
+        if drop > 0.0 and (drop >= 1.0 or self.rng.random() < drop):
+            raise TransportError(f"chaos: {src} -> {dst} dropped")
+        if delay > 0.0:
+            await asyncio.sleep(delay)
+
+
+def filter_from_broker(broker: Optional[FaultBroker], src: str,
+                       dst: str) -> Optional[Callable]:
+    """Adapt a broker edge into the TCP-layer ``fault_filter`` hook
+    shape (rpc/pool.py outbound, rpc/server.py inbound): an async
+    callable that drops or delays one exchange.  ``None`` broker →
+    ``None`` filter (the hooks stay cold)."""
+    if broker is None:
+        return None
+
+    async def _filter(*_a, **_kw) -> None:
+        await broker.on_message(src, dst)
+    return _filter

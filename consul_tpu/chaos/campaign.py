@@ -1,0 +1,765 @@
+"""Chaos campaign runner: one cluster per scenario, hard safety gates.
+
+For every scenario in :mod:`consul_tpu.chaos.scenarios` this module
+boots a fresh 3-server in-process cluster on the fault-injecting
+``MemoryTransport`` + :class:`~consul_tpu.chaos.broker.FaultBroker`
+pair, drives concurrent register clients through the fault window, and
+holds the run to three verdicts:
+
+* **linearizable** — the recorded client history passes the Wing&Gong
+  checker (``tests/linearize.py``, the same oracle as the jepsen tier).
+* **lease safety** — sampled continuously, at no instant do two nodes
+  both consider their leader lease valid; and a node whose term trails
+  the cluster maximum never serves a lease read (the deposed-leader
+  gate, watched by wrapping ``lease_read_index`` on every node).
+* **detected** — the injected fault must be *visible* in the PR-9 raft
+  observatory (lease-margin collapse, timeline lease/leadership events,
+  append-quorum tail growth, per-peer failure counters).  A fault the
+  telemetry cannot see is a fault an operator cannot page on.
+
+``worker_crash_under_load`` is the black-box leg: it forks the real
+agent (``tests/blackbox_util.TestServer``) with SO_REUSEPORT workers,
+SIGKILLs one worker PID mid-load, and requires the supervisor to
+respawn it while the HTTP front keeps serving.
+
+Everything is seeded: the per-scenario seed derives from the campaign
+seed via crc32 (not the salted ``hash()``), so two runs with the same
+``--seed`` produce the same fault schedule and the same verdicts —
+the property ``make chaos-fast`` pins in CI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import os
+import random
+import sys
+import time
+import zlib
+from dataclasses import asdict
+from typing import Any, Callable, Dict, List, Optional
+
+from consul_tpu.chaos.broker import FaultBroker
+from consul_tpu.chaos.scenarios import CATALOG, ChaosParams
+from consul_tpu.consensus.raft import MemoryTransport, RaftConfig
+from consul_tpu.obs import raftstats
+from consul_tpu.obs.prom import render_prometheus
+from consul_tpu.server.server import Server, ServerConfig
+from consul_tpu.structs.structs import DirEntry, KVSOp, KVSRequest, KeyRequest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+KEY = "chaos/register"
+NODE_NAMES = ("c0", "c1", "c2")
+
+
+def scenario_seed(seed: int, name: str) -> int:
+    """Stable per-scenario seed (crc32: deterministic across processes,
+    unlike ``hash()`` under PYTHONHASHSEED)."""
+    return (seed * 1_000_003 + zlib.crc32(name.encode())) & 0x7FFFFFFF
+
+
+def _checker() -> Callable[[List[Dict[str, Any]]], bool]:
+    """Borrow the single Wing&Gong implementation in the tree
+    (tests/linearize.py) instead of growing a second one."""
+    try:
+        from linearize import check_linearizable
+    except ImportError:
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "tests"))
+        from linearize import check_linearizable
+    return check_linearizable
+
+
+def _prom_errors(text: str) -> List[str]:
+    try:
+        from tools.check_prom import check_text
+    except ImportError:
+        sys.path.insert(0, _REPO_ROOT)
+        from tools.check_prom import check_text
+    return check_text(text)
+
+
+def _campaign_raft() -> RaftConfig:
+    # The tests/test_leases.py fast envelope: lease window =
+    # min(0.1, 0.1) * (1 - 0.15) = 85 ms, so sub-second fault windows
+    # move the lease margin through whole histogram buckets.
+    return RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.1,
+                      election_timeout_max=0.2, rpc_timeout=0.05)
+
+
+def _leader(servers: List[Server]) -> Optional[Server]:
+    for s in servers:
+        if s.is_leader():
+            return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry snapshots + histogram arithmetic (detection evidence).
+# ---------------------------------------------------------------------------
+
+
+def _hist_counts(h: raftstats.LatencyHist) -> Dict[str, Any]:
+    """De-cumulated bucket counts from the public family() shape."""
+    fam = h.family()
+    counts, prev = [], 0
+    for _le, cum in fam["buckets"]:
+        counts.append(cum - prev)
+        prev = cum
+    return {"edges": [le for le, _ in fam["buckets"]], "counts": counts,
+            "count": fam["count"], "overflow": fam["count"] - prev}
+
+
+def _hist_delta(before: Dict[str, Any], after: Dict[str, Any]
+                ) -> Dict[str, Any]:
+    return {"edges": after["edges"],
+            "counts": [a - b for a, b in zip(after["counts"],
+                                             before["counts"])],
+            "count": after["count"] - before["count"],
+            "overflow": after["overflow"] - before["overflow"]}
+
+
+def _hist_p50(snap: Dict[str, Any]) -> Optional[float]:
+    """Upper-edge p50 over a (possibly delta) bucket-count snapshot."""
+    total = snap["count"]
+    if total <= 0:
+        return None
+    need, cum = total / 2.0, 0
+    for edge, c in zip(snap["edges"], snap["counts"]):
+        cum += c
+        if cum >= need:
+            return float(edge)
+    return float(snap["edges"][-1])
+
+
+def _hist_tail(snap: Dict[str, Any], ge_edge_ms: float) -> int:
+    """Observations at/above ``ge_edge_ms`` (overflow included)."""
+    n = sum(c for edge, c in zip(snap["edges"], snap["counts"])
+            if float(edge) >= ge_edge_ms)
+    return n + snap["overflow"]
+
+
+def _hist_low_share(snap: Dict[str, Any], le_edge_ms: float
+                    ) -> Optional[float]:
+    """Fraction of observations in buckets at/below ``le_edge_ms``."""
+    if snap["count"] <= 0:
+        return None
+    low = sum(c for edge, c in zip(snap["edges"], snap["counts"])
+              if float(edge) <= le_edge_ms)
+    return low / snap["count"]
+
+
+def _telemetry_snapshot(servers: List[Server]) -> Dict[str, Any]:
+    snap: Dict[str, Any] = {}
+    for s in servers:
+        obs = s.raft.obs
+        if obs is None:
+            continue
+        snap[s.config.node_name] = {
+            "lease_margin": _hist_counts(obs.lease_margin),
+            "append_quorum": _hist_counts(obs.append_quorum),
+            "elections_started": obs.elections_started,
+            "leadership_gained": obs.leadership_gained,
+            "leadership_lost": obs.leadership_lost,
+            "peer_failed": {r["peer"]: r["rpc_failed"]
+                            for r in obs.peer_rows(s.raft)},
+        }
+    return snap
+
+
+def _timeline_since(server: Server, t_wall: float,
+                    kinds: Optional[tuple] = None) -> List[Dict[str, Any]]:
+    obs = server.raft.obs
+    if obs is None:
+        return []
+    return [ev for ev in obs.timeline()
+            if ev["t"] >= t_wall and (kinds is None or ev["kind"] in kinds)]
+
+
+# ---------------------------------------------------------------------------
+# Hard-gate monitors.
+# ---------------------------------------------------------------------------
+
+
+class _LeaseMonitors:
+    """Live watchers for the two lease hard gates.
+
+    Single-holder: every few milliseconds, count the nodes whose
+    ``lease_valid()`` is true — two simultaneous holders is a
+    split-brain lease.  Deposed-serve: wrap every node's
+    ``lease_read_index`` so a non-None return from a node whose term
+    trails the cluster max (a leader that has been deposed but does not
+    know it yet) is recorded as a violation.
+    """
+
+    def __init__(self, servers: List[Server]) -> None:
+        self.servers = servers
+        self.multi_holder: List[Dict[str, Any]] = []
+        self.deposed_serve: List[Dict[str, Any]] = []
+        self._task: Optional[asyncio.Task] = None
+        for s in servers:
+            self._wrap(s)
+
+    def _wrap(self, srv: Server) -> None:
+        orig = srv.raft.lease_read_index
+
+        def wrapped() -> Optional[int]:
+            idx = orig()
+            if idx is not None:
+                mx = max(x.raft.current_term for x in self.servers)
+                if srv.raft.current_term < mx:
+                    self.deposed_serve.append({
+                        "t": time.time(), "node": srv.config.node_name,
+                        "term": srv.raft.current_term, "max_term": mx,
+                        "read_index": idx})
+            return idx
+
+        srv.raft.lease_read_index = wrapped  # type: ignore[method-assign]
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._sample())
+
+    async def _sample(self) -> None:
+        while True:
+            holders = [s.config.node_name for s in self.servers
+                       if s.raft.lease_valid()]
+            if len(holders) > 1:
+                self.multi_holder.append(
+                    {"t": time.time(), "holders": holders})
+            await asyncio.sleep(0.004)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+# ---------------------------------------------------------------------------
+# Register clients (the jepsen-tier shape, time-bounded).
+# ---------------------------------------------------------------------------
+
+
+async def _write_any(servers: List[Server], val: int,
+                     rng: random.Random) -> None:
+    last: Optional[Exception] = None
+    for s in rng.sample(servers, len(servers)):
+        try:
+            await s.kvs.apply(KVSRequest(
+                datacenter="dc1", op=KVSOp.SET.value,
+                dir_ent=DirEntry(key=KEY, value=str(val).encode())))
+            return
+        except Exception as e:  # not leader / partitioned: try next
+            last = e
+            await asyncio.sleep(0.02)
+    assert last is not None
+    raise last
+
+
+async def _read_any(servers: List[Server], rng: random.Random
+                    ) -> Optional[int]:
+    last: Optional[Exception] = None
+    for s in rng.sample(servers, len(servers)):
+        try:
+            _, out = await s.kvs.get(KeyRequest(
+                datacenter="dc1", key=KEY, require_consistent=True))
+            if not out:
+                return None
+            return int(out[0].value.decode())
+        except Exception as e:
+            last = e
+            await asyncio.sleep(0.02)
+    assert last is not None
+    raise last
+
+
+async def _client(cid: int, servers: List[Server],
+                  clock: Callable[[], float],
+                  history: List[Dict[str, Any]], p: ChaosParams,
+                  rng: random.Random) -> None:
+    seq = 0
+    # Time-bounded so clients always straddle the fault window; the op
+    # cap is a runaway guard, not the planned volume.
+    while clock() < p.run_s and seq < p.ops_per_client * 6:
+        val = cid * 100_000 + seq
+        seq += 1
+        do_write = rng.random() < 0.5
+        t_inv = clock()
+        ok, ret = False, None
+        try:
+            if do_write:
+                await asyncio.wait_for(
+                    _write_any(servers, val, rng), timeout=2.0)
+            else:
+                ret = await asyncio.wait_for(
+                    _read_any(servers, rng), timeout=2.0)
+            ok = True
+        except Exception:
+            ok = False
+        history.append({
+            "op": "w" if do_write else "r",
+            "arg": val if do_write else None,
+            "ret": ret,
+            "t_inv": t_inv,
+            "t_ret": clock() if ok else math.inf,
+            "ok": ok,
+        })
+        await asyncio.sleep(rng.uniform(0.005, 0.03))
+
+
+# ---------------------------------------------------------------------------
+# Fault drivers: translate ChaosParams into broker/clock actions.
+# ---------------------------------------------------------------------------
+
+
+def _heal(broker: FaultBroker, servers: List[Server]) -> None:
+    broker.clear_links()
+    for s in servers:
+        nf = broker.node(s.config.node_name)
+        nf.clock.set_rate(1.0)
+        nf.fsync_stall_s = 0.0
+        nf.fsync_err_p = 0.0
+
+
+async def _drive_fault(name: str, p: ChaosParams, broker: FaultBroker,
+                       servers: List[Server], ev: Dict[str, Any]) -> None:
+    loop = asyncio.get_event_loop()
+    await asyncio.sleep(p.start)
+    leader = _leader(servers)
+    lname = (leader.config.node_name if leader is not None
+             else servers[0].config.node_name)
+    ev["leader_at_start"] = lname
+    ev["window_wall"] = [time.time(), None]
+    ev["baseline"] = _telemetry_snapshot(servers)
+    window = p.stop - p.start
+    try:
+        if name == "clock_skew":
+            broker.node(lname).clock.set_rate(p.clock_rate)
+            await asyncio.sleep(window)
+            broker.node(lname).clock.set_rate(1.0)
+        elif name == "clock_jump":
+            broker.node(lname).clock.jump(p.clock_jump_s)
+            await asyncio.sleep(window)
+        elif name == "fsync_stall":
+            # All nodes: a 3-node quorum commits on two follower acks,
+            # so stalling only the leader's pump stalls nothing.
+            for s in servers:
+                broker.node(s.config.node_name).fsync_stall_s = \
+                    p.fsync_stall_s
+                broker.node(s.config.node_name).fsync_err_p = p.fsync_err_p
+            await asyncio.sleep(window)
+            for s in servers:
+                broker.node(s.config.node_name).fsync_stall_s = 0.0
+                broker.node(s.config.node_name).fsync_err_p = 0.0
+        elif name == "leader_flap":
+            t_end = loop.time() + window
+            while loop.time() < t_end:
+                ld = _leader(servers)
+                if ld is not None:
+                    victim = ld.config.node_name
+                    broker.isolate(victim)
+                    await asyncio.sleep(p.flap_down_s)
+                    broker.rejoin(victim)
+                rest = min(max(p.flap_period_s - p.flap_down_s, 0.05),
+                           max(t_end - loop.time(), 0.0))
+                if rest <= 0:
+                    break
+                await asyncio.sleep(rest)
+        elif name in ("asym_partition", "slow_follower"):
+            followers = sorted(s.config.node_name for s in servers
+                               if s.config.node_name != lname)
+            victim = followers[0]
+            ev["victim"] = victim
+            # a = leader, b = victim (the scenarios.py convention).
+            if p.drop_ab or p.delay_ab_s:
+                broker.set_link(lname, victim, drop=p.drop_ab,
+                                delay_s=p.delay_ab_s)
+            if p.drop_ba or p.delay_ba_s:
+                broker.set_link(victim, lname, drop=p.drop_ba,
+                                delay_s=p.delay_ba_s)
+            await asyncio.sleep(window)
+            broker.clear_links()
+        else:  # pragma: no cover - catalog and driver move together
+            raise ValueError(f"no driver for scenario {name!r}")
+    finally:
+        ev["window_wall"][1] = time.time()
+
+
+# ---------------------------------------------------------------------------
+# Detection: the fault must be visible in the observatory.
+# ---------------------------------------------------------------------------
+
+
+def _detect(name: str, p: ChaosParams, servers: List[Server],
+            ev: Dict[str, Any]) -> Dict[str, Any]:
+    base = ev.get("baseline") or {}
+    lname = ev.get("leader_at_start")
+    t_start = (ev.get("window_wall") or [0.0, None])[0]
+    end = _telemetry_snapshot(servers)
+    by_name = {s.config.node_name: s for s in servers}
+    detected, evidence = False, {}
+
+    if name in ("clock_skew",):
+        # A fast leader oscillator burns the lease window early: the
+        # send-time margin samples slide into the low buckets (and,
+        # through heartbeat-paced gaps, under zero — lease-lost flips).
+        b, e = base.get(lname), end.get(lname)
+        if b and e:
+            delta = _hist_delta(b["lease_margin"], e["lease_margin"])
+            base_low = _hist_low_share(b["lease_margin"], 50.0)
+            win_low = _hist_low_share(delta, 50.0)
+            lost = _timeline_since(by_name[lname], t_start, ("lease-lost",))
+            detected = bool(
+                (win_low is not None and base_low is not None
+                 and win_low > base_low + 0.10) or lost)
+            evidence = {"baseline_low_share": base_low,
+                        "window_low_share": win_low,
+                        "window_samples": delta["count"],
+                        "lease_lost_events": len(lost)}
+    elif name == "clock_jump":
+        events = _timeline_since(
+            by_name[lname], t_start,
+            ("lease-lost", "lease-acquired", "leader-deposed"))
+        detected = any(ev_["kind"] == "lease-lost" for ev_ in events)
+        evidence = {"timeline": events}
+    elif name == "fsync_stall":
+        b, e = base.get(lname), end.get(lname)
+        if b and e:
+            delta = _hist_delta(b["append_quorum"], e["append_quorum"])
+            tail = _hist_tail(delta, 100.0)
+            lost = _timeline_since(by_name[lname], t_start, ("lease-lost",))
+            detected = tail >= 1
+            evidence = {"append_quorum_ge_100ms": tail,
+                        "window_appends": delta["count"],
+                        "lease_lost_events": len(lost)}
+    elif name == "leader_flap":
+        lost = sum(e["leadership_lost"] - base.get(n, e)["leadership_lost"]
+                   for n, e in end.items())
+        gained = sum(e["leadership_gained"]
+                     - base.get(n, e)["leadership_gained"]
+                     for n, e in end.items())
+        events: List[Dict[str, Any]] = []
+        for s in servers:
+            events += _timeline_since(
+                s, t_start, ("leader-deposed", "leader-elected"))
+        detected = lost >= 1 and gained >= 1
+        evidence = {"leadership_lost": lost, "leadership_gained": gained,
+                    "timeline": sorted(events, key=lambda x: x["t"])}
+    elif name in ("asym_partition", "slow_follower"):
+        victim = ev.get("victim")
+        b, e = base.get(lname), end.get(lname)
+        if b and e and victim:
+            failed = (e["peer_failed"].get(victim, 0)
+                      - b["peer_failed"].get(victim, 0))
+            v_elections = (end.get(victim, {}).get("elections_started", 0)
+                           - base.get(victim, {}).get("elections_started", 0))
+            obs = by_name[lname].raft.obs
+            rows = obs.peer_rows(by_name[lname].raft) if obs else []
+            row = next((r for r in rows if r["peer"] == victim), None)
+            detected = failed >= 3
+            if name == "slow_follower":
+                # Delayed-but-delivered heartbeats must keep the victim
+                # from starting elections: slow, not partitioned.
+                detected = detected and v_elections == 0
+            evidence = {"victim": victim, "rpc_failed_delta": failed,
+                        "victim_elections_delta": v_elections,
+                        "peer_row": row}
+    return {"detected": detected, "evidence": evidence}
+
+
+# ---------------------------------------------------------------------------
+# Per-scenario runs.
+# ---------------------------------------------------------------------------
+
+
+def _sanitize(obj: Any) -> Any:
+    """JSON-safe copy: math.inf (timed-out t_ret) -> None."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def _write_bundle(sdir: str, p: ChaosParams, history: List[Dict[str, Any]],
+                  telemetry: Dict[str, Any], prom_text: str,
+                  result: Dict[str, Any]) -> None:
+    os.makedirs(sdir, exist_ok=True)
+    with open(os.path.join(sdir, "params.json"), "w") as f:
+        json.dump(asdict(p), f, indent=2)
+    with open(os.path.join(sdir, "history.json"), "w") as f:
+        json.dump(_sanitize(history), f, indent=2)
+    with open(os.path.join(sdir, "telemetry.json"), "w") as f:
+        json.dump(_sanitize(telemetry), f, indent=2)
+    with open(os.path.join(sdir, "prom.txt"), "w") as f:
+        f.write(prom_text)
+    with open(os.path.join(sdir, "verdict.json"), "w") as f:
+        json.dump(_sanitize(result), f, indent=2)
+
+
+async def _scenario_main(name: str, p: ChaosParams, sseed: int,
+                         sdir: str) -> Dict[str, Any]:
+    check = _checker()
+    broker = FaultBroker(seed=sseed)
+    tr = MemoryTransport(faults=broker)
+    names = list(NODE_NAMES)
+    servers = [Server(ServerConfig(node_name=nm, peers=names,
+                                   raft=_campaign_raft(),
+                                   faults=broker.node(nm)), transport=tr)
+               for nm in names]
+    for s in servers:
+        await s.start()
+    deadline = asyncio.get_event_loop().time() + 10.0
+    while _leader(servers) is None:
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"{name}: no leader elected")
+        await asyncio.sleep(0.01)
+
+    monitors = _LeaseMonitors(servers)
+    monitors.start()
+    loop = asyncio.get_event_loop()
+    t0 = loop.time()
+    clock = lambda: loop.time() - t0  # noqa: E731
+
+    history: List[Dict[str, Any]] = []
+    ev: Dict[str, Any] = {}
+    driver = asyncio.create_task(_drive_fault(name, p, broker, servers, ev))
+    clients = [asyncio.create_task(
+        _client(cid, servers, clock, history, p,
+                random.Random(f"{sseed}/client/{cid}")))
+        for cid in range(p.clients)]
+    try:
+        await asyncio.wait_for(asyncio.gather(*clients), timeout=60.0)
+        await asyncio.wait_for(driver, timeout=30.0)
+    finally:
+        driver.cancel()
+        _heal(broker, servers)
+        await monitors.stop()
+    await asyncio.sleep(0.1)  # let post-heal lease transitions land
+
+    detection = _detect(name, p, servers, ev)
+    telemetry = {s.config.node_name:
+                 (s.raft.obs.wire(s.raft) if s.raft.obs is not None else None)
+                 for s in servers}
+    prom_node = _leader(servers) or servers[0]
+    hists, gauges, counters = raftstats.prom_families(prom_node.raft)
+    prom_text = render_prometheus([], histograms=hists,
+                                  labeled_gauges=gauges,
+                                  labeled_counters=counters)
+    prom_errs = _prom_errors(prom_text)
+    for s in servers:
+        await s.stop()
+
+    n_w = sum(1 for h in history if h["ok"] and h["op"] == "w")
+    n_r = sum(1 for h in history if h["ok"] and h["op"] == "r")
+    linearizable = check(history)
+    gates = {
+        "linearizable": bool(linearizable),
+        "single_lease_holder": not monitors.multi_holder,
+        "no_deposed_serve": not monitors.deposed_serve,
+        "progress": n_w >= 3 and n_r >= 3,
+        "prom_valid": not prom_errs,
+    }
+    result = {
+        "scenario": name,
+        "seed": sseed,
+        "mode": "in-process",
+        "ops": {"total": len(history), "writes_ok": n_w, "reads_ok": n_r,
+                "failed": sum(1 for h in history if not h["ok"])},
+        "gates": gates,
+        "violations": {"multi_holder": monitors.multi_holder,
+                       "deposed_serve": monitors.deposed_serve},
+        "detection": detection,
+        "prom_errors": prom_errs,
+        "fault_window": ev.get("window_wall"),
+        "leader_at_fault": ev.get("leader_at_start"),
+        "pass": all(gates.values()) and detection["detected"],
+    }
+    _write_bundle(sdir, p, history, telemetry, prom_text, result)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Black-box leg: kill a real SO_REUSEPORT worker under HTTP load.
+# ---------------------------------------------------------------------------
+
+
+def _worker_pids(agent_pid: int) -> List[int]:
+    """Live worker children of the forked agent, via /proc."""
+    try:
+        with open(f"/proc/{agent_pid}/task/{agent_pid}/children") as f:
+            kids = [int(x) for x in f.read().split()]
+    except OSError:
+        return []
+    out = []
+    for pid in kids:
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode()
+        except OSError:
+            continue
+        if "consul_tpu.agent.workers" in cmd:
+            out.append(pid)
+    return out
+
+
+def _run_worker_crash(name: str, p: ChaosParams, sseed: int,
+                      sdir: str) -> Dict[str, Any]:
+    import base64
+    import signal as _signal
+    import urllib.error
+
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "tests"))
+    from blackbox_util import TestServer
+
+    check = _checker()
+    rng = random.Random(f"{sseed}/bb")
+    history: List[Dict[str, Any]] = []
+    killed: Optional[int] = None
+    respawned: Optional[int] = None
+    before: List[int] = []
+    t_kill = t_respawn = None
+    ok_after_kill = 0
+
+    ts = TestServer(name="chaos-wc", config_extra={"http_workers": 3})
+    ts.start()
+    try:
+        ts.wait_for_api(30.0)
+        ts.wait_for_leader(30.0)
+        agent_pid = ts.proc.pid
+        # http_workers=3 forks workers-1 = 2 children.
+        deadline = time.monotonic() + 10.0
+        while len(_worker_pids(agent_pid)) < 2:
+            if time.monotonic() > deadline:
+                raise TimeoutError("worker children never appeared")
+            time.sleep(0.1)
+
+        t0 = time.monotonic()
+        clock = lambda: time.monotonic() - t0  # noqa: E731
+        seq = 0
+        while clock() < p.run_s:
+            now = clock()
+            if killed is None and now >= p.start:
+                before = sorted(_worker_pids(agent_pid))
+                killed = before[0]
+                os.kill(killed, _signal.SIGKILL)
+                t_kill = now
+            if killed is not None and respawned is None:
+                fresh = [pid for pid in _worker_pids(agent_pid)
+                         if pid not in before]
+                if fresh:
+                    respawned = fresh[0]
+                    t_respawn = clock()
+            do_write = rng.random() < 0.5
+            t_inv = clock()
+            ok, ret, val = False, None, seq
+            try:
+                if do_write:
+                    ts.http_put(f"/v1/kv/{KEY}", str(val).encode())
+                else:
+                    try:
+                        got = ts.http_get(f"/v1/kv/{KEY}?consistent")
+                        if got:
+                            ret = int(base64.b64decode(
+                                got[0]["Value"]).decode())
+                    except urllib.error.HTTPError as he:
+                        if he.code != 404:  # 404 = empty register
+                            raise
+                ok = True
+            except Exception:
+                ok = False
+            if ok and killed is not None:
+                ok_after_kill += 1
+            history.append({"op": "w" if do_write else "r",
+                            "arg": val if do_write else None, "ret": ret,
+                            "t_inv": t_inv,
+                            "t_ret": clock() if ok else math.inf, "ok": ok})
+            if do_write:
+                seq += 1
+            time.sleep(rng.uniform(0.01, 0.04))
+
+        # Give the 0.5 s supervisor poll one more beat if needed.
+        deadline = time.monotonic() + 3.0
+        while respawned is None and time.monotonic() < deadline:
+            fresh = [pid for pid in _worker_pids(agent_pid)
+                     if pid not in before]
+            if fresh:
+                respawned = fresh[0]
+                t_respawn = clock()
+            time.sleep(0.1)
+        agent_log = ts.output()[-4000:]
+    finally:
+        ts.stop()
+
+    n_w = sum(1 for h in history if h["ok"] and h["op"] == "w")
+    n_r = sum(1 for h in history if h["ok"] and h["op"] == "r")
+    linearizable = check(history)
+    detection = {
+        "detected": (killed is not None and respawned is not None
+                     and ok_after_kill >= 1),
+        "evidence": {"killed_pid": killed, "respawned_pid": respawned,
+                     "workers_before_kill": before,
+                     "t_kill_s": t_kill, "t_respawn_s": t_respawn,
+                     "ok_ops_after_kill": ok_after_kill},
+    }
+    gates = {
+        "linearizable": bool(linearizable),
+        # Single forked agent = single raft node; the lease gates are
+        # held by construction and by the in-process scenarios.
+        "single_lease_holder": True,
+        "no_deposed_serve": True,
+        "progress": n_w >= 3 and n_r >= 3,
+        "prom_valid": True,
+    }
+    result = {
+        "scenario": name,
+        "seed": sseed,
+        "mode": "blackbox",
+        "ops": {"total": len(history), "writes_ok": n_w, "reads_ok": n_r,
+                "failed": sum(1 for h in history if not h["ok"])},
+        "gates": gates,
+        "violations": {"multi_holder": [], "deposed_serve": []},
+        "detection": detection,
+        "prom_errors": [],
+        "pass": all(gates.values()) and detection["detected"],
+    }
+    _write_bundle(sdir, p, history, {"agent_log_tail": agent_log}, "", result)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Campaign entry point.
+# ---------------------------------------------------------------------------
+
+
+def run_campaign(scenarios: List[str], seed: int = 1234,
+                 out_dir: str = "chaos_debug") -> Dict[str, Any]:
+    """Run ``scenarios`` (names into CATALOG) and return the CHAOS.json
+    report dict.  Each scenario gets a fresh event loop, a fresh
+    cluster, and a crc32-derived per-scenario seed."""
+    os.environ["CONSUL_TPU_RAFT_OBS"] = "1"
+    results = []
+    for name in scenarios:
+        p = CATALOG[name]
+        sseed = scenario_seed(seed, name)
+        sdir = os.path.join(out_dir, name)
+        try:
+            if p.blackbox:
+                res = _run_worker_crash(name, p, sseed, sdir)
+            else:
+                res = asyncio.run(_scenario_main(name, p, sseed, sdir))
+        except Exception as e:
+            res = {"scenario": name, "seed": sseed, "pass": False,
+                   "error": f"{type(e).__name__}: {e}"}
+        results.append(res)
+    return {"campaign_seed": seed,
+            "scenarios": results,
+            "passed": all(r.get("pass") for r in results)}

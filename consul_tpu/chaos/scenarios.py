@@ -1,0 +1,135 @@
+"""Chaos scenario catalog: declarative fault schedules for the host plane.
+
+The consensus-plane mirror of ``gossip/nemesis.py``: one frozen
+:class:`ChaosParams` per scenario, scalars only, with the fault window
+``[start, stop)`` expressed in seconds of campaign wall time (the
+gossip catalog counts protocol rounds; the host plane has no round
+clock).  The campaign (chaos/campaign.py) interprets the schedule
+against a live 3-node cluster; nothing here touches asyncio.
+
+Every scenario is calibrated to sit INSIDE the safety envelope the
+stack claims to survive — e.g. ``clock_skew`` runs the leader's clock
+fast, the conservative direction (the lease expires early and reads
+fall back to the barrier path; a slow clock beyond
+``lease_clock_skew`` would genuinely break the invariant, and pinning
+that exact boundary is tests/test_leases.py's job, not the campaign's).
+The campaign therefore gates on linearizability + deposed-leader-
+never-serves for every scenario, and separately asserts the fault was
+*detected* in the raft observatory (lease-margin histogram shifts,
+leadership-timeline events, per-peer replication counters).
+
+The ``fault`` membership check in ``__post_init__`` is the governing
+key set for the table-drift vet pass (tools/vet/table_drift.py K01/K02):
+``CATALOG``'s keys and the campaign CLI's ``--scenario`` choices are
+drift-checked against it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class ChaosParams:
+    """One scenario's injection schedule + run shape.  Frozen scalars
+    only, mirroring ``NemesisParams`` — a schedule is a value, not a
+    process."""
+
+    fault: str = ""            # scenario name (governing set below)
+    start: float = 0.5         # fault window [start, stop), seconds
+    stop: float = 1.6
+    run_s: float = 2.4         # total client-traffic duration
+    clients: int = 3
+    ops_per_client: int = 22
+
+    # -- clock faults (applied to the elected leader's node clock) -------
+    clock_rate: float = 1.0    # virtual-clock rate during the window
+    clock_jump_s: float = 0.0  # one step jump at window start
+
+    # -- durability faults (applied to every node's fsync path) ----------
+    fsync_stall_s: float = 0.0   # per-fsync stall inside the window
+    fsync_err_p: float = 0.0     # P(injected OSError per fsync)
+
+    # -- link faults (leader -> victim follower = a->b direction) --------
+    drop_ab: float = 0.0
+    drop_ba: float = 0.0
+    delay_ab_s: float = 0.0
+    delay_ba_s: float = 0.0
+
+    # -- leader flapping (full isolate/heal square wave) -----------------
+    flap_period_s: float = 0.0   # isolate leader every period...
+    flap_down_s: float = 0.0     # ...for this long
+
+    # -- serving-front faults (blackbox worker plane) --------------------
+    worker_kills: int = 0        # SIGKILLed workers under HTTP load
+
+    def __post_init__(self) -> None:
+        if self.fault not in ("clock_skew", "clock_jump", "fsync_stall",
+                              "leader_flap", "asym_partition",
+                              "slow_follower", "worker_crash_under_load"):
+            raise ValueError(f"unknown chaos scenario {self.fault!r}")
+        if not 0.0 <= self.start <= self.stop:
+            raise ValueError("fault window must satisfy 0 <= start <= stop")
+
+    @property
+    def blackbox(self) -> bool:
+        """True when the scenario forks a real agent (worker plane)
+        instead of booting the in-process cluster."""
+        return self.worker_kills > 0
+
+
+# The catalog.  Timing is calibrated for the campaign's compressed raft
+# config (heartbeat 20ms, election 100-200ms, lease window
+# 100ms * (1 - 0.15) = 85ms):
+#
+# - clock_skew: leader oscillator 5x fast — virtual time between lease
+#   renewals (a 20ms heartbeat gap reads as 100ms > the 85ms window)
+#   eats the window, so the send-time lease-margin samples slide into
+#   the low buckets and heartbeat-paced gaps flip the lease invalid
+#   (the detection signals), while staying on the SAFE side (a fast
+#   clock only ever under-claims the lease).
+# - clock_jump: one +200ms step (> the whole window) invalidates the
+#   lease instantly — a lease-lost / lease-acquired pair on the
+#   leadership timeline is the detection signal.
+# - fsync_stall: 300ms per fsync on EVERY node (stalling only the
+#   leader does nothing in a 3-node cluster: the quorum-th match index
+#   comes from the two followers).  Commits stall behind durability,
+#   pushing append_quorum mass into the >=250ms buckets; empty
+#   heartbeats still renew leadership, so the cluster slows rather
+#   than flaps — exactly the BENCH_NOTES §2 disk incident, minus the
+#   leadership collapse the durability pump was built to prevent.
+# - leader_flap: isolate the current leader 250ms out of every 700ms —
+#   repeated depose/elect cycles on the timeline, the PR-13 shutdown
+#   fixes' natural habitat.
+# - asym_partition: victim->leader direction drops (acks die, appends
+#   arrive): the victim's log stays current but its match index
+#   freezes, so peer_rpc_failed and match-lag gauges carry the signal.
+# - slow_follower: 40ms each way to the victim pushes its replication
+#   round-trip past rpc_timeout (50ms): every round times out yet
+#   delivers, so the victim never misses a heartbeat while its
+#   rpc_failed counter climbs.
+# - worker_crash_under_load: blackbox — fork a real agent with 3
+#   SO_REUSEPORT workers, SIGKILL one mid-load, and require the
+#   supervisor to respawn it while HTTP traffic keeps succeeding.
+CATALOG = {
+    "clock_skew": ChaosParams(fault="clock_skew", clock_rate=5.0),
+    "clock_jump": ChaosParams(fault="clock_jump", clock_jump_s=0.2,
+                              run_s=2.0, stop=1.4),
+    "fsync_stall": ChaosParams(fault="fsync_stall", fsync_stall_s=0.3,
+                               ops_per_client=16),
+    "leader_flap": ChaosParams(fault="leader_flap", flap_period_s=0.7,
+                               flap_down_s=0.25, run_s=2.8, stop=2.2),
+    "asym_partition": ChaosParams(fault="asym_partition", drop_ba=1.0),
+    "slow_follower": ChaosParams(fault="slow_follower", delay_ab_s=0.04,
+                                 delay_ba_s=0.04),
+    "worker_crash_under_load": ChaosParams(
+        fault="worker_crash_under_load", worker_kills=1, run_s=6.0,
+        start=1.0, stop=5.0),
+}
+
+# The `make chaos-fast` slice: cheapest in-process scenarios with the
+# strongest per-second signal (one clock fault, the disk fault, the
+# partition-role fault).  Kept to ~8s wall so it rides in `make ci`.
+FAST_SCENARIOS: Tuple[str, ...] = ("clock_jump", "fsync_stall",
+                                   "leader_flap")

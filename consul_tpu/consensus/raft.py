@@ -130,12 +130,18 @@ class SnapResp:
 
 
 class MemoryTransport:
-    """In-process cluster fabric with partition injection for tests."""
+    """In-process cluster fabric with partition injection for tests.
 
-    def __init__(self, latency: float = 0.0) -> None:
+    ``faults`` (a chaos/broker.FaultBroker or None) adds directional
+    drop/delay on top of the binary partition set: the broker is
+    consulted once for the request leg and once for the reply leg, so
+    asymmetric faults ("acks die, appends arrive") are expressible."""
+
+    def __init__(self, latency: float = 0.0, faults: Any = None) -> None:
         self._nodes: Dict[str, "RaftNode"] = {}
         self._blocked: set[Tuple[str, str]] = set()
         self._latency = latency
+        self.faults = faults
 
     def register(self, node: "RaftNode") -> None:
         self._nodes[node.id] = node
@@ -162,12 +168,16 @@ class MemoryTransport:
             raise TransportError(f"{src} -> {dst} unreachable")
         if self._latency:
             await asyncio.sleep(self._latency)
+        if self.faults is not None:
+            await self.faults.on_message(src, dst)  # request leg
         target = self._nodes[dst]
         if target.role == SHUTDOWN:
             raise TransportError(f"{dst} is down")
         resp = await target.handle(method, msg)
         if (dst, src) in self._blocked:  # reply lost
             raise TransportError(f"{dst} -> {src} reply dropped")
+        if self.faults is not None:
+            await self.faults.on_message(dst, src)  # reply leg
         return resp
 
 
@@ -178,12 +188,21 @@ class RaftNode:
     def __init__(self, node_id: str, peers: List[str], fsm: Any,
                  transport: Any, config: Optional[RaftConfig] = None,
                  log_store: Optional[MemoryLogStore] = None,
-                 snap_store: Optional[Any] = None) -> None:
+                 snap_store: Optional[Any] = None,
+                 faults: Any = None) -> None:
         self.id = node_id
         self.peers = list(peers)  # includes self
         self.fsm = fsm
         self.transport = transport
         self.config = config or RaftConfig()
+        # Fault seam (chaos/broker.NodeFaults or None).  Every time
+        # read that feeds lease/election SAFETY goes through _now so a
+        # chaos campaign can skew or jump this node's clock; wall-clock
+        # measurements for the observatory stay on time.monotonic.
+        self.faults = faults
+        self._now: Callable[[], float] = (
+            faults.clock.monotonic if faults is not None
+            else time.monotonic)
         self.log = log_store if log_store is not None else MemoryLogStore()
         self.snaps = snap_store if snap_store is not None else MemorySnapshotStore()
 
@@ -218,7 +237,7 @@ class RaftNode:
         self._durable_waiters: List[Tuple[int, asyncio.Future]] = []
         # Staleness metadata: monotonic stamp of the last message from a
         # live leader (feeds QueryMeta.last_contact, consul/rpc.go:406).
-        self.last_leader_contact: float = time.monotonic()
+        self.last_leader_contact: float = self._now()
         self._heartbeat_evt = asyncio.Event()
         self._step_down_evt = asyncio.Event()
         self._peer_evts: Dict[str, asyncio.Event] = {}
@@ -280,6 +299,14 @@ class RaftNode:
         only), then advances durable_index, wakes durability waiters,
         and lets the leader's commit accounting move."""
         loop = asyncio.get_event_loop()
+        # Chaos seam: the fsync callable may be wrapped with injected
+        # stalls/errors (chaos/broker.NodeFaults.wrap_fsync).  The
+        # wrapper runs in the executor thread, so an injected stall
+        # blocks exactly what a seized disk would block — the fsync,
+        # never the event loop — and an injected OSError rides the
+        # retry path below.
+        sync_fn = (self.faults.wrap_fsync(self.log.sync)
+                   if self.faults is not None else self.log.sync)
         try:
             while self.role != SHUTDOWN:
                 await self._dirty_evt.wait()
@@ -288,7 +315,7 @@ class RaftNode:
                 if target <= self.durable_index:
                     continue
                 try:
-                    await loop.run_in_executor(None, self.log.sync)
+                    await loop.run_in_executor(None, sync_fn)
                 except Exception:
                     # fd can vanish mid-fsync when a truncation rewrite
                     # swaps the segment file under us; the rewrite is
@@ -415,7 +442,7 @@ class RaftNode:
         """Quorum-th most recent acked-round send time (0.0 = none)."""
         need = self._quorum() - 1  # self acknowledges implicitly
         if need <= 0:
-            return time.monotonic()  # single-node: always freshly anchored
+            return self._now()  # single-node: always freshly anchored
         acks = sorted((self._lease_ack.get(p, 0.0)
                        for p in self.peers if p != self.id), reverse=True)
         if len(acks) < need:
@@ -438,7 +465,7 @@ class RaftNode:
         if anchor <= 0.0:
             return False
         if now is None:
-            now = time.monotonic()
+            now = self._now()
         return now < anchor + dur
 
     def lease_read_index(self) -> Optional[int]:
@@ -453,7 +480,7 @@ class RaftNode:
         if not self.lease_valid():
             return 0.0
         return max(0.0, self._lease_anchor() + self._lease_duration()
-                   - time.monotonic())
+                   - self._now())
 
     async def add_peer(self, peer: str, timeout: float = 30.0) -> None:
         if peer in self.peers:
@@ -537,6 +564,14 @@ class RaftNode:
                 if self.role in (FOLLOWER, CANDIDATE):
                     timeout = random.uniform(self.config.election_timeout_min,
                                              self.config.election_timeout_max)
+                    # The election timer ticks on THIS node's (possibly
+                    # skewed) oscillator: a virtual duration T elapses
+                    # in T/rate real seconds, which is what wait_for
+                    # (real loop time) must be handed.
+                    if self.faults is not None:
+                        rate = self.faults.clock.rate
+                        if rate > 0.0:
+                            timeout /= rate
                     self._heartbeat_evt.clear()
                     try:
                         await asyncio.wait_for(self._heartbeat_evt.wait(), timeout)
@@ -699,8 +734,15 @@ class RaftNode:
             entries.append(e)
         req = AppendReq(self.current_term, self.id, prev_index, prev_term,
                         entries, self.commit_index)
-        sent = time.monotonic()
+        sent = self._now()  # lease anchor: the node's own oscillator
         term = self.current_term
+        if self.obs is not None:
+            # Send-time sample: the renewal-time sample below can never
+            # see an expired lease (the ack that triggers it has just
+            # re-anchored the window), so a lease lost *between*
+            # renewals — a clock jump, a stalled quorum — would leave
+            # no timeline trace without this pre-send observation.
+            self.obs.lease_observe(self.lease_remaining() * 1000.0, term)
         resp = await asyncio.wait_for(
             self.transport.call(self.id, peer, "append_entries", req),
             self.config.rpc_timeout)
@@ -924,7 +966,7 @@ class RaftNode:
             # _become_follower, so the timeline event lands here.
             self.obs.note_new_leader(self.current_term, req.leader)
         self.leader_id = req.leader
-        self.last_leader_contact = time.monotonic()
+        self.last_leader_contact = self._now()
         self._heartbeat_evt.set()
 
         if req.prev_log_index > 0:
@@ -977,7 +1019,7 @@ class RaftNode:
         if req.term < self.current_term:
             return SnapResp(self.current_term, False)
         self._become_follower(req.term, req.leader)
-        self.last_leader_contact = time.monotonic()
+        self.last_leader_contact = self._now()
         self._heartbeat_evt.set()
         if req.last_index <= self._snap_index:
             return SnapResp(self.current_term, True)

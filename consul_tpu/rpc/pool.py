@@ -16,8 +16,10 @@ onto port 8300.
 from __future__ import annotations
 
 import asyncio
+import random
 import ssl
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import msgpack
 
@@ -37,6 +39,17 @@ class RPCError(Exception):
     pass
 
 
+# Dial backoff (satellite of the chaos PR): repeated dial failures to
+# one address back off exponentially with jitter instead of hammering
+# the peer every rpc() — during a partition window every forwarded
+# request used to redial the dead address, and the heal then faced a
+# thundering herd of simultaneous reconnects.  Base doubles per
+# consecutive failure up to the cap; jitter decorrelates the herd.
+DIAL_BACKOFF_BASE = 0.05
+DIAL_BACKOFF_CAP = 2.0
+DIAL_BACKOFF_JITTER = 0.25  # +/- fraction of the computed delay
+
+
 class ConnPool:
     def __init__(self, tls_wrap: Optional[Any] = None,
                  dial_timeout: float = 5.0) -> None:
@@ -44,6 +57,26 @@ class ConnPool:
         self._locks: Dict[str, asyncio.Lock] = {}
         self._tls_wrap = tls_wrap  # callable(dc) -> ssl.SSLContext | None
         self._dial_timeout = dial_timeout
+        # addr -> (consecutive dial failures, monotonic not-before)
+        self._dial_backoff: Dict[str, Tuple[int, float]] = {}
+        # Chaos seam: optional async callable(addr, method) awaited
+        # before each exchange; may delay or raise to emulate
+        # directional partitions at the TCP layer (chaos/broker.py).
+        self.fault_filter: Optional[Callable] = None
+
+    def dial_backoff_remaining(self, addr: str) -> float:
+        """Seconds until the next dial to ``addr`` is permitted (0.0 =
+        no backoff in force)."""
+        _, not_before = self._dial_backoff.get(addr, (0, 0.0))
+        return max(0.0, not_before - time.monotonic())
+
+    def _dial_failed(self, addr: str) -> None:
+        fails, _ = self._dial_backoff.get(addr, (0, 0.0))
+        fails += 1
+        delay = min(DIAL_BACKOFF_CAP, DIAL_BACKOFF_BASE * (2 ** (fails - 1)))
+        delay *= 1.0 + random.uniform(-DIAL_BACKOFF_JITTER,
+                                      DIAL_BACKOFF_JITTER)
+        self._dial_backoff[addr] = (fails, time.monotonic() + delay)
 
     async def _session(self, addr: str, dc: str = "") -> MuxSession:
         sess = self._sessions.get(addr)
@@ -54,34 +87,47 @@ class ConnPool:
             sess = self._sessions.get(addr)
             if sess is not None and not sess.closed:
                 return sess
-            host, _, port = addr.rpartition(":")
-            ctx: Optional[ssl.SSLContext] = None
-            if self._tls_wrap is not None:
-                ctx = self._tls_wrap(dc)
-            if ctx is not None:
-                # TLS wrap: selector byte first in the clear, then the
-                # handshake (rpcTLS, consul/rpc.go:100-112).  Wait for
-                # the server's ack byte before sending the ClientHello —
-                # see RPCServer._handle for the upgrade-race rationale.
-                reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(host, int(port)),
-                    self._dial_timeout)
-                writer.write(bytes([RPC_TLS]))
-                await writer.drain()
-                ack = await asyncio.wait_for(reader.readexactly(1),
-                                             self._dial_timeout)
-                if ack[0] != RPC_TLS:
-                    raise ConnectionError("bad TLS upgrade ack")
-                await writer.start_tls(
-                    ctx, server_hostname=self._server_hostname(dc))
-                writer.write(bytes([RPC_MULTIPLEX]))
-                await writer.drain()
-            else:
-                reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(host, int(port)),
-                    self._dial_timeout)
-                writer.write(bytes([RPC_MULTIPLEX]))
-                await writer.drain()
+            remaining = self.dial_backoff_remaining(addr)
+            if remaining > 0.0:
+                # Fail fast inside the backoff window: the caller's
+                # retry policy (forward fallback, raft replication
+                # retry) decides what to do; this pool only refuses to
+                # open yet another doomed socket.
+                raise ConnectionError(
+                    f"dial backoff to {addr}: {remaining:.3f}s remaining")
+            try:
+                host, _, port = addr.rpartition(":")
+                ctx: Optional[ssl.SSLContext] = None
+                if self._tls_wrap is not None:
+                    ctx = self._tls_wrap(dc)
+                if ctx is not None:
+                    # TLS wrap: selector byte first in the clear, then the
+                    # handshake (rpcTLS, consul/rpc.go:100-112).  Wait for
+                    # the server's ack byte before sending the ClientHello —
+                    # see RPCServer._handle for the upgrade-race rationale.
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection(host, int(port)),
+                        self._dial_timeout)
+                    writer.write(bytes([RPC_TLS]))
+                    await writer.drain()
+                    ack = await asyncio.wait_for(reader.readexactly(1),
+                                                 self._dial_timeout)
+                    if ack[0] != RPC_TLS:
+                        raise ConnectionError("bad TLS upgrade ack")
+                    await writer.start_tls(
+                        ctx, server_hostname=self._server_hostname(dc))
+                    writer.write(bytes([RPC_MULTIPLEX]))
+                    await writer.drain()
+                else:
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection(host, int(port)),
+                        self._dial_timeout)
+                    writer.write(bytes([RPC_MULTIPLEX]))
+                    await writer.drain()
+            except (OSError, ConnectionError, asyncio.TimeoutError):
+                self._dial_failed(addr)
+                raise
+            self._dial_backoff.pop(addr, None)
             sess = MuxSession(reader, writer, client=True)
             self._sessions[addr] = sess
             return sess
@@ -110,6 +156,8 @@ class ConnPool:
         if span is not None:
             env["Trace"] = trace_to_wire(span.context)
         try:
+            if self.fault_filter is not None:
+                await self.fault_filter(addr, method)  # chaos: outbound leg
             for attempt in (0, 1):
                 sess = await self._session(addr, dc)
                 try:

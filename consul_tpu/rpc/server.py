@@ -71,6 +71,10 @@ class RPCServer:
         self._handlers = _build_handlers()
         self._conns: set = set()  # live connection writers, closed on stop
         self._stream_tasks: set = set()  # anchor mux stream servers
+        # Chaos seam: optional async callable(req) awaited before
+        # dispatch; may delay (slow server) or raise (inbound drop —
+        # surfaced to the caller as an RPC error).  None in production.
+        self.fault_filter = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._listener = await asyncio.start_server(self._serve, host, port)
@@ -150,6 +154,11 @@ class RPCServer:
         backhaul every span this node finished for that trace in the
         response's ``"Spans"`` field (the caller's tracer re-homes
         them, stitching the cross-process tree — see obs/trace.py)."""
+        if self.fault_filter is not None:
+            try:
+                await self.fault_filter(req)
+            except Exception as e:
+                return {"Error": f"{e}" or type(e).__name__}
         remote = trace_from_wire(req.get("Trace"))
         if remote is None:
             return await self._dispatch_inner(req)

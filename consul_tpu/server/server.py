@@ -66,6 +66,10 @@ class ServerConfig:
     # authoritative; the bridge cross-checks every verdict.
     device_store: bool = False
     device_store_capacity: int = 1 << 16
+    # Fault-injection seam (chaos/broker.NodeFaults): threads this
+    # node's virtual clock + fsync hooks into the RaftNode.  None in
+    # production — every seam then costs one is-None test.
+    faults: Any = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -135,7 +139,8 @@ class Server:
         self.raft = RaftNode(self.config.node_name, peers, self.fsm,
                              transport if transport is not None else MemoryTransport(),
                              self.config.raft, log_store=log_store,
-                             snap_store=snap_store)
+                             snap_store=snap_store,
+                             faults=self.config.faults)
         self.leader_duties = LeaderDuties(self)
         self.raft.on_leader_change(self.leader_duties.on_leader_change)
         # User-event delivery targets (the agent registers; the gossip

@@ -1,0 +1,423 @@
+"""Chaos subsystem: broker units, dial backoff, shutdown-under-fault.
+
+Three layers, mirroring the gossip plane's nemesis tier:
+
+  * broker units — the virtual clock, directional link faults, and the
+    fsync wrapper are deterministic under a fixed seed;
+  * dial backoff (rpc/pool.py satellite) — repeated dial failures back
+    off exponentially with jitter, fail fast inside the window, and
+    reset on the first successful dial;
+  * shutdown-under-fault regressions — the PR-13 lifecycle fixes
+    (LeaderDuties.drain, _fail_abandoned future hygiene, barrier-task
+    cleanup) hold while a fault is actively injected: a flapping
+    leader and a mid-fsync-stall stop must leave no pending futures,
+    no undrained leader tasks, and no durability waiters behind.
+
+The campaign smoke test runs one real scenario end-to-end (cluster,
+fault, linearizability gate, CHAOS verdict) with the CI seed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from consul_tpu.chaos.broker import FaultBroker, FaultClock
+from consul_tpu.chaos.scenarios import CATALOG, FAST_SCENARIOS, ChaosParams
+from consul_tpu.consensus.raft import (
+    MemoryTransport, RaftConfig, TransportError)
+from consul_tpu.rpc.pool import (
+    DIAL_BACKOFF_CAP, DIAL_BACKOFF_JITTER, ConnPool)
+from consul_tpu.rpc.server import RPCServer
+from consul_tpu.server.server import Server, ServerConfig
+
+
+def fast_raft(**kw) -> RaftConfig:
+    base = dict(heartbeat_interval=0.02, election_timeout_min=0.1,
+                election_timeout_max=0.2, rpc_timeout=0.05)
+    base.update(kw)
+    return RaftConfig(**base)
+
+
+def make_faulty_servers(n=3, seed=7, **raft_kw):
+    broker = FaultBroker(seed=seed)
+    tr = MemoryTransport(faults=broker)
+    names = [f"s{i}" for i in range(n)]
+    servers = [Server(ServerConfig(node_name=nm, peers=names,
+                                   raft=fast_raft(**raft_kw),
+                                   faults=broker.node(nm)), transport=tr)
+               for nm in names]
+    return broker, tr, servers
+
+
+async def start_and_elect(servers):
+    for s in servers:
+        await s.start()
+    deadline = asyncio.get_event_loop().time() + 5
+    while asyncio.get_event_loop().time() < deadline:
+        leaders = [s for s in servers if s.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        await asyncio.sleep(0.01)
+    raise AssertionError("no leader")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# FaultClock
+# ---------------------------------------------------------------------------
+
+
+class TestFaultClock:
+    def test_identity_by_default(self):
+        t = [100.0]
+        c = FaultClock(base=lambda: t[0])
+        assert c.monotonic() == pytest.approx(100.0)
+        t[0] += 5.0
+        assert c.monotonic() == pytest.approx(105.0)
+        assert c.drift() == pytest.approx(0.0)
+
+    def test_rate_scales_from_anchor(self):
+        t = [0.0]
+        c = FaultClock(base=lambda: t[0])
+        t[0] = 10.0            # 10s at rate 1
+        c.set_rate(3.0)
+        t[0] = 12.0            # +2s real at rate 3 = +6s virtual
+        assert c.monotonic() == pytest.approx(16.0)
+        c.set_rate(1.0)        # re-anchors: no discontinuity
+        before = c.monotonic()
+        t[0] = 13.0
+        assert c.monotonic() == pytest.approx(before + 1.0)
+        assert c.drift() == pytest.approx(4.0)
+
+    def test_jump_is_discontinuous(self):
+        t = [50.0]
+        c = FaultClock(base=lambda: t[0])
+        c.jump(0.25)
+        assert c.monotonic() == pytest.approx(50.25)
+        c.jump(-0.1)
+        assert c.monotonic() == pytest.approx(50.15)
+        assert c.drift() == pytest.approx(0.15)
+
+    def test_two_clocks_same_script_agree(self):
+        def script(c, t):
+            out = [c.monotonic()]
+            t[0] += 1.0
+            c.set_rate(2.5)
+            t[0] += 2.0
+            out.append(c.monotonic())
+            c.jump(0.5)
+            out.append(c.monotonic())
+            return out
+        ta, tb = [0.0], [0.0]
+        assert script(FaultClock(base=lambda: ta[0]), ta) == \
+            script(FaultClock(base=lambda: tb[0]), tb)
+
+
+# ---------------------------------------------------------------------------
+# Broker links + fsync wrapper
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerLinks:
+    def test_full_drop_is_directional(self):
+        async def main():
+            broker = FaultBroker(seed=1)
+            broker.set_link("a", "b", drop=1.0)
+            with pytest.raises(TransportError):
+                await broker.on_message("a", "b")
+            await broker.on_message("b", "a")  # reverse leg clean
+        run(main())
+
+    def test_delay_sleeps(self):
+        async def main():
+            broker = FaultBroker(seed=1)
+            broker.set_link("a", "b", delay_s=0.05)
+            t0 = time.monotonic()
+            await broker.on_message("a", "b")
+            assert time.monotonic() - t0 >= 0.04
+        run(main())
+
+    def test_isolate_and_rejoin(self):
+        async def main():
+            broker = FaultBroker(seed=1)
+            for nm in ("a", "b", "c"):
+                broker.node(nm)
+            broker.isolate("a")
+            with pytest.raises(TransportError):
+                await broker.on_message("a", "b")
+            with pytest.raises(TransportError):
+                await broker.on_message("c", "a")
+            await broker.on_message("b", "c")  # third parties untouched
+            broker.rejoin("a")
+            await broker.on_message("a", "b")
+            await broker.on_message("c", "a")
+        run(main())
+
+    def test_probabilistic_drop_deterministic_per_seed(self):
+        async def outcomes(seed):
+            broker = FaultBroker(seed=seed)
+            broker.set_link("a", "b", drop=0.5)
+            out = []
+            for _ in range(32):
+                try:
+                    await broker.on_message("a", "b")
+                    out.append(True)
+                except TransportError:
+                    out.append(False)
+            return out
+        a = run(outcomes(42))
+        b = run(outcomes(42))
+        assert a == b
+        assert True in a and False in a  # 0.5 actually flips both ways
+
+    def test_clear_links_heals(self):
+        async def main():
+            broker = FaultBroker(seed=1)
+            broker.set_link("a", "b", drop=1.0)
+            broker.clear_links()
+            await broker.on_message("a", "b")
+        run(main())
+
+
+class TestWrapFsync:
+    def test_stall_delays_then_syncs(self):
+        broker = FaultBroker(seed=3)
+        nf = broker.node("n")
+        calls = []
+        wrapped = nf.wrap_fsync(lambda: calls.append(1))
+        nf.fsync_stall_s = 0.05
+        t0 = time.monotonic()
+        wrapped()
+        assert time.monotonic() - t0 >= 0.04
+        assert calls == [1]
+        nf.fsync_stall_s = 0.0  # knobs are live, not bind-time
+        t0 = time.monotonic()
+        wrapped()
+        assert time.monotonic() - t0 < 0.04
+
+    def test_injected_error_skips_sync(self):
+        broker = FaultBroker(seed=3)
+        nf = broker.node("n")
+        calls = []
+        wrapped = nf.wrap_fsync(lambda: calls.append(1))
+        nf.fsync_err_p = 1.0
+        with pytest.raises(OSError):
+            wrapped()
+        assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# Scenario catalog hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioCatalog:
+    def test_catalog_keys_match_fault_field(self):
+        for name, p in CATALOG.items():
+            assert p.fault == name
+
+    def test_fast_subset_is_in_catalog(self):
+        assert set(FAST_SCENARIOS) <= set(CATALOG)
+
+    def test_unknown_fault_rejected(self):
+        with pytest.raises(ValueError):
+            ChaosParams(fault="split_brain_wish")  # noqa: K02
+
+    def test_window_must_fit_run(self):
+        with pytest.raises(ValueError):
+            ChaosParams(fault="clock_jump", start=1.0, stop=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Dial backoff (rpc/pool.py satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestDialBackoff:
+    def test_fail_fast_inside_window(self, monkeypatch):
+        async def main():
+            dials = []
+
+            async def refuse(host, port):
+                dials.append((host, port))
+                raise ConnectionRefusedError("refused")
+
+            monkeypatch.setattr(asyncio, "open_connection", refuse)
+            pool = ConnPool()
+            addr = "127.0.0.1:59999"
+            # rpc() retries once; the retry must hit the backoff gate,
+            # not the socket.
+            with pytest.raises(OSError):
+                await pool.rpc(addr, "Status.Ping", {}, timeout=0.5)
+            assert len(dials) == 1
+            assert pool.dial_backoff_remaining(addr) > 0.0
+            with pytest.raises(ConnectionError, match="dial backoff"):
+                await pool._session(addr)
+            assert len(dials) == 1  # still no new socket
+        run(main())
+
+    def test_exponential_growth_capped(self):
+        pool = ConnPool()
+        addr = "10.0.0.1:1"
+        prev = 0.0
+        for i in range(1, 12):
+            pool._dial_failed(addr)
+            fails, _ = pool._dial_backoff[addr]
+            assert fails == i
+            rem = pool.dial_backoff_remaining(addr)
+            if i >= 7:  # 0.05 * 2^6 = 3.2 > cap: clamped
+                lo = DIAL_BACKOFF_CAP * (1.0 - DIAL_BACKOFF_JITTER) - 0.01
+                hi = DIAL_BACKOFF_CAP * (1.0 + DIAL_BACKOFF_JITTER) + 0.01
+                assert lo <= rem <= hi
+            prev = rem
+        assert prev <= DIAL_BACKOFF_CAP * (1.0 + DIAL_BACKOFF_JITTER) + 0.01
+
+    def test_success_resets_backoff(self):
+        async def main():
+            server = await asyncio.start_server(
+                lambda r, w: None, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            addr = f"127.0.0.1:{port}"
+            pool = ConnPool()
+            # Expired backoff window with failure history: one good
+            # dial clears the slate.
+            pool._dial_backoff[addr] = (5, 0.0)
+            await pool._session(addr)
+            assert addr not in pool._dial_backoff
+            assert pool.dial_backoff_remaining(addr) == 0.0
+            await pool.close()
+            server.close()
+            await server.wait_closed()
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Fault-filter seams (pool outbound, rpc server inbound)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultFilterSeams:
+    def test_pool_outbound_filter_raises(self):
+        async def main():
+            pool = ConnPool()
+
+            async def cut(addr, method):
+                raise TransportError(f"chaos: {addr} {method} dropped")
+
+            pool.fault_filter = cut
+            with pytest.raises(TransportError):
+                await pool.rpc("127.0.0.1:1", "KVS.Apply", {})
+        run(main())
+
+    def test_rpc_server_inbound_filter_becomes_rpc_error(self):
+        async def main():
+            rpc = RPCServer(None)  # dispatch bails before touching srv
+
+            async def cut(req):
+                raise TransportError("chaos: inbound dropped")
+
+            rpc.fault_filter = cut
+            resp = await rpc._dispatch({"Method": "Status.Ping"})
+            assert "chaos: inbound dropped" in resp["Error"]
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Shutdown-under-fault regressions (PR-13 lifecycle fixes)
+# ---------------------------------------------------------------------------
+
+
+def _assert_clean_shutdown(servers):
+    for s in servers:
+        assert s.leader_duties._cancelled == [], \
+            f"{s.config.node_name}: undrained leader tasks"
+        assert s.raft._pending == {}, \
+            f"{s.config.node_name}: abandoned apply futures"
+        assert s.raft._durable_waiters == [], \
+            f"{s.config.node_name}: abandoned durability waiters"
+        assert s._barrier_inflight is None, \
+            f"{s.config.node_name}: leaked barrier task"
+
+
+class TestShutdownUnderFault:
+    def test_stop_during_leader_flap(self):
+        async def main():
+            broker, _, servers = make_faulty_servers()
+            leader = await start_and_elect(servers)
+            victim = leader.config.node_name
+            broker.isolate(victim)
+            # Wait for the isolated leader to be deposed (a new term
+            # exists it cannot see), then stop everything mid-flap.
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                others = [s for s in servers if s is not leader]
+                if any(s.is_leader() for s in others):
+                    break
+                await asyncio.sleep(0.01)
+            else:
+                raise AssertionError("no re-election under isolation")
+            for s in servers:
+                await s.stop()
+            broker.clear_links()
+            _assert_clean_shutdown(servers)
+        run(main())
+
+    def test_stop_mid_fsync_stall(self):
+        async def main():
+            from consul_tpu.structs.structs import (
+                DirEntry, KVSOp, KVSRequest)
+            broker, _, servers = make_faulty_servers()
+            leader = await start_and_elect(servers)
+            for s in servers:
+                broker.node(s.config.node_name).fsync_stall_s = 0.4
+            write = asyncio.ensure_future(leader.kvs.apply(KVSRequest(
+                op=KVSOp.SET.value,
+                dir_ent=DirEntry(key="stall", value=b"x"))))
+            await asyncio.sleep(0.05)  # entry in flight, pump stalled
+            t0 = asyncio.get_event_loop().time()
+            for s in servers:
+                await s.stop()
+            # Stop must not wait out the full stall chain to fail the
+            # pending apply.  (A hung write turns into TimeoutError and
+            # trips the elapsed-time assertion below.)
+            with pytest.raises(Exception):
+                await asyncio.wait_for(write, timeout=2.0)
+            assert asyncio.get_event_loop().time() - t0 < 2.0
+            _assert_clean_shutdown(servers)
+            for s in servers:
+                broker.node(s.config.node_name).fsync_stall_s = 0.0
+            # Drain the executor so no stall thread outlives the loop.
+            await asyncio.get_event_loop().shutdown_default_executor()
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Campaign smoke: one real scenario end-to-end with the CI seed.
+# ---------------------------------------------------------------------------
+
+
+class TestCampaignSmoke:
+    def test_clock_jump_scenario_passes(self, tmp_path):
+        from consul_tpu.chaos.campaign import run_campaign
+        report = run_campaign(["clock_jump"], seed=1234,
+                              out_dir=str(tmp_path))
+        [res] = report["scenarios"]
+        assert res["gates"]["linearizable"]
+        assert res["gates"]["single_lease_holder"]
+        assert res["gates"]["no_deposed_serve"]
+        assert res["detection"]["detected"]
+        assert report["passed"]
+        # The debug bundle is the operator's first stop.
+        assert (tmp_path / "clock_jump" / "verdict.json").exists()
+        assert (tmp_path / "clock_jump" / "prom.txt").exists()

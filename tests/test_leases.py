@@ -363,3 +363,95 @@ def _counter_sum(metrics, suffix: str) -> float:
             if k.endswith(suffix):
                 total += c["sum"]
     return total
+
+
+class TestLeaseClockSkewBounds:
+    """lease_clock_skew edge cases under an injected virtual oscillator.
+
+    The skew discount buys the leader a budget of
+    eto_min * lease_clock_skew seconds of clock error: with W =
+    eto_min * (1 - skew), a backward step (or a slow rate down to
+    1 - skew) still has the leader drop its lease before any follower
+    can possibly start an election at anchor + eto_min.  These tests
+    pin the acceptance flip EXACTLY at that bound, in both directions,
+    with a chaos FaultClock on a hand-driven time base.
+    """
+
+    @staticmethod
+    def _skewed_node(t):
+        from consul_tpu.chaos.broker import FaultBroker, FaultClock
+        broker = FaultBroker(seed=0)
+        nf = broker.node("a")
+        nf.clock = FaultClock(base=lambda: t[0])
+        node = RaftNode("a", ["a", "b", "c"], fsm=None,
+                        transport=MemoryTransport(), config=fast_raft(),
+                        faults=nf)
+        node.role = LEADER
+        node.commit_index = node._lease_guard_index = 0
+        return node, nf.clock
+
+    @staticmethod
+    def _anchor(node, clock):
+        a = clock.monotonic()
+        node._lease_ack = {"b": a, "c": a}
+        return a
+
+    def test_flip_exactly_at_window_edge(self):
+        t = [1000.0]
+        node, clock = self._skewed_node(t)
+        self._anchor(node, clock)
+        w = node._lease_duration()
+        assert w == pytest.approx(0.1 * 0.85)
+        t[0] = 1000.0 + w - 1e-6
+        assert node.lease_valid()
+        assert node.lease_remaining() == pytest.approx(1e-6, abs=1e-7)
+        t[0] = 1000.0 + w          # now < anchor + dur is strict
+        assert not node.lease_valid()
+        assert node.lease_remaining() == 0.0
+
+    def test_backward_jump_inside_budget_keeps_invariant(self):
+        # Budget = eto_min - W = eto_min * skew = 15ms.  A backward
+        # step strictly inside it: at the earliest possible follower
+        # election (real anchor + eto_min) the leader has ALREADY
+        # dropped its lease.
+        t = [1000.0]
+        node, clock = self._skewed_node(t)
+        self._anchor(node, clock)
+        w = node._lease_duration()
+        budget = node.config.election_timeout_min - w
+        clock.jump(-(budget - 0.001))
+        t[0] = 1000.0 + node.config.election_timeout_min
+        assert not node.lease_valid()
+
+    def test_backward_jump_beyond_budget_breaks_invariant(self):
+        # Just past the budget the lease OUTLIVES the election floor —
+        # the bound is tight, which is exactly why the campaign's
+        # clock faults stay on the safe side of it.
+        t = [1000.0]
+        node, clock = self._skewed_node(t)
+        self._anchor(node, clock)
+        w = node._lease_duration()
+        budget = node.config.election_timeout_min - w
+        clock.jump(-(budget + 0.001))
+        t[0] = 1000.0 + node.config.election_timeout_min
+        assert node.lease_valid()  # stale claim: the unsafe direction
+
+    def test_forward_jump_only_expires_early(self):
+        t = [1000.0]
+        node, clock = self._skewed_node(t)
+        self._anchor(node, clock)
+        clock.jump(0.2)            # bigger than the whole window
+        assert not node.lease_valid()
+
+    def test_slow_rate_acceptance_flips_at_one_minus_skew(self):
+        # Sustained slow oscillator: safe iff rate > 1 - skew = 0.85
+        # (virtual W elapses within real eto_min).  Check both sides
+        # of the flip at real time anchor + eto_min.
+        for rate, still_claims in ((0.84, True), (0.86, False)):
+            t = [1000.0]
+            node, clock = self._skewed_node(t)
+            self._anchor(node, clock)
+            clock.set_rate(rate)
+            t[0] = 1000.0 + node.config.election_timeout_min
+            assert node.lease_valid() is still_claims, (
+                f"rate {rate}: lease_valid should be {still_claims}")

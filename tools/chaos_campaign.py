@@ -1,0 +1,80 @@
+"""Chaos campaign CLI: seeded fault scenarios against a live cluster.
+
+Usage:
+    python -m tools.chaos_campaign --fast --seed 1234 --out CHAOS.json
+    python -m tools.chaos_campaign --scenario leader_flap
+    python -m tools.chaos_campaign            # the full catalog
+
+Each scenario boots its own 3-node cluster (or forks the real agent for
+the black-box worker-crash leg), injects one fault through the
+consul_tpu.chaos broker, and gates on linearizability, lease safety,
+and fault *detectability* in the raft observatory.  The report lands in
+``--out`` (CHAOS.json) and per-scenario debug bundles under
+``--debug-dir``.  Same seed, same verdicts: ``make chaos-fast`` runs
+this twice in CI lockstep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consul_tpu.chaos.campaign import run_campaign            # noqa: E402
+from consul_tpu.chaos.scenarios import CATALOG, FAST_SCENARIOS  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="chaos_campaign",
+        description="Deterministic consensus-plane fault campaign.")
+    ap.add_argument(
+        "--scenario", action="append",
+        choices=["clock_skew", "clock_jump", "fsync_stall", "leader_flap",
+                 "asym_partition", "slow_follower",
+                 "worker_crash_under_load"],
+        help="scenario to run (repeatable); default: the full catalog")
+    ap.add_argument("--fast", action="store_true",
+                    help="run only the fast subset (the make chaos-fast / "
+                         "CI tier)")
+    ap.add_argument("--seed", type=int, default=1234,
+                    help="campaign seed; fixes the whole fault schedule")
+    ap.add_argument("--out", default="CHAOS.json",
+                    help="report path (default: CHAOS.json)")
+    ap.add_argument("--debug-dir", default="chaos_debug",
+                    help="per-scenario debug bundle root")
+    args = ap.parse_args(argv)
+
+    if args.scenario:
+        scenarios = args.scenario
+    elif args.fast:
+        scenarios = list(FAST_SCENARIOS)
+    else:
+        scenarios = list(CATALOG)
+
+    report = run_campaign(scenarios, seed=args.seed, out_dir=args.debug_dir)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+
+    wide = max(len(s) for s in scenarios)
+    for r in report["scenarios"]:
+        if "error" in r:
+            line = f"ERROR  {r['error']}"
+        else:
+            g = r["gates"]
+            line = ("PASS" if r["pass"] else "FAIL") + \
+                (f"  lin={g['linearizable']} lease={g['single_lease_holder']}"
+                 f" deposed_ok={g['no_deposed_serve']}"
+                 f" detected={r['detection']['detected']}"
+                 f" ops={r['ops']['total']}")
+        print(f"{r['scenario']:<{wide}}  {line}")
+    print(f"campaign: {'PASS' if report['passed'] else 'FAIL'}"
+          f" (seed {args.seed}, report {args.out})")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,18 @@ GROUPS: Sequence[TableGroup] = (
         ),
     ),
     TableGroup(
+        name="chaos-fault",
+        keyword="fault",
+        governing=TableRef("consul_tpu/chaos/scenarios.py",
+                           "membership", "fault"),
+        satellites=(
+            TableRef("consul_tpu/chaos/scenarios.py",
+                     "dict_keys", "CATALOG"),
+            TableRef("tools/chaos_campaign.py",
+                     "argparse_choices", "--scenario"),
+        ),
+    ),
+    TableGroup(
         name="match-backend",
         keyword="match_backend",
         governing=TableRef("consul_tpu/state/device_store.py",
