@@ -17,11 +17,11 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet vet-fast vet-diff vet-dyn obs-smoke chaos chaos-fast bench bench-serve bench-watch dryrun clean
+.PHONY: default ci test integ vet vet-fast vet-diff vet-dyn obs-smoke chaos chaos-fast tune tune-check bench bench-serve bench-watch dryrun clean
 
 default: test
 
-ci: vet test integ chaos-fast
+ci: vet test integ chaos-fast tune-check
 
 # Unit + in-process integration tests (multi-node simulated in one
 # process with compressed timers, SURVEY.md §4).
@@ -100,6 +100,27 @@ chaos-fast:
 	  print('chaos-fast: verdicts deterministic under seed 1234')"
 	rm -f CHAOS2.json
 
+# Autotune control plane (obs/tuner.py + tools/autotune.py): settle
+# the knob registry against the checked-in observatory artifacts
+# (bench regime cache, BENCH_WATCH.json, BENCH_SERVE.json, CHAOS.json)
+# and persist the per-platform verdict next to the XLA compile cache.
+# Planes/agents boot with explicit flag > persisted verdict > default.
+tune:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.autotune
+
+# Determinism gate CI rides on (mirrors chaos-fast): two independent
+# settles over the same artifacts must be byte-identical.
+tune-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.autotune --platform cpu \
+	  --devices 8 --out TUNE1.json
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.autotune --platform cpu \
+	  --devices 8 --out TUNE2.json
+	$(PYTHON) -c "a = open('TUNE1.json','rb').read(); \
+	  b = open('TUNE2.json','rb').read(); \
+	  assert a == b, 'tune-check: verdicts differ between settles'; \
+	  print('tune-check: verdict deterministic (%d bytes)' % len(a))"
+	rm -f TUNE1.json TUNE2.json
+
 # North-star benchmark (needs the real chip; emits one JSON line).
 bench:
 	$(PYTHON) bench.py
@@ -128,4 +149,4 @@ clean:
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 	rm -rf .jax_cache
 	rm -rf chaos_debug
-	rm -f vet_report.json CHAOS.json CHAOS2.json
+	rm -f vet_report.json CHAOS.json CHAOS2.json TUNE1.json TUNE2.json

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import sys
 import tempfile
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
@@ -109,6 +110,12 @@ class AgentConfig:
     # compressed-timer overrides for tests (SerfConfig field -> value)
     serf_timing: Dict[str, float] = field(default_factory=dict)
     raft_config: Optional[Any] = None   # RaftConfig override (tests)
+    # Lease-timeout floor resolved by the autotune verdict (obs/tuner.py
+    # "lease_timeout_floor_s": 0 = auto lease window, negative disables
+    # lease reads).  None = auto; an explicit float wins over the
+    # verdict.  Only applied when raft_config is None — a full
+    # RaftConfig override (tests) is already explicit about leases.
+    lease_timeout_floor_s: Optional[float] = None
     reconcile_interval: float = 60.0    # leader full-reconcile cadence
     enable_debug: bool = False  # route /debug/pprof/* (http.go:259-264)
     # Serving-plane fan-out: total HTTP serving processes on the public
@@ -123,11 +130,49 @@ class AgentConfig:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
+# AgentConfig knobs resolved through the autotune verdict — the serving
+# plane's consumer-side claim for the ``autotune-knob`` vet group
+# (tools/vet/table_drift.py): the union of every TUNED_FIELDS literal
+# must equal the obs/tuner.py KNOBS key set.
+TUNED_FIELDS = ("http_workers", "device_store", "lease_timeout_floor_s")
+
+# The per-field AUTO sentinel (the dataclass default): any other value
+# is an explicit operator setting and skips the verdict.
+_TUNED_AUTO = {"http_workers": 1, "device_store": False,
+               "lease_timeout_floor_s": None}
+
+
 class Agent:
     def __init__(self, config: Optional[AgentConfig] = None) -> None:
         self.config = config or AgentConfig()
         if not self.config.advertise_addr:
             self.config.advertise_addr = self.config.bind_addr
+        # Resolve the autotuned serving knobs before anything consumes
+        # them: explicit config value > persisted per-platform verdict >
+        # registry default (obs/tuner.py).  jax is never imported here —
+        # a chipless serving host resolves against "cpu"; when jax is
+        # already up (device_store, tests) the live backend wins.
+        from consul_tpu.obs import tuner
+        if "jax" in sys.modules:
+            _jx = sys.modules["jax"]
+            _plat, _ndev = _jx.default_backend(), len(_jx.devices())
+        else:
+            _plat, _ndev = "cpu", 1
+        explicit = {f: getattr(self.config, f) for f in TUNED_FIELDS
+                    if getattr(self.config, f) != _TUNED_AUTO[f]}
+        self.autotune = tuner.resolve(list(TUNED_FIELDS), explicit,
+                                      platform=_plat, device_count=_ndev)
+        # Resolved values are written back so every downstream reader
+        # (worker pool sizing, ServerConfig, bundle config dump) sees
+        # what the agent actually runs.
+        self.config.http_workers = int(self.autotune.value("http_workers"))
+        self.config.device_store = bool(self.autotune.value("device_store"))
+        raft_override = self.config.raft_config
+        if raft_override is None:
+            floor = float(self.autotune.value("lease_timeout_floor_s") or 0.0)
+            if floor != 0.0:
+                from consul_tpu.consensus.raft import RaftConfig
+                raft_override = RaftConfig(lease_timeout=floor)
         if self.config.server:
             # Embedded full server: Raft + state store + endpoints
             # (consul.NewServer, agent.go:63-66 server branch).
@@ -139,8 +184,8 @@ class Agent:
                 bootstrap_expect=self.config.bootstrap_expect,
                 data_dir=(os.path.join(self.config.data_dir, "server")
                           if self.config.data_dir else ""),
-                **({"raft": self.config.raft_config}
-                   if self.config.raft_config is not None else {}),
+                **({"raft": raft_override}
+                   if raft_override is not None else {}),
                 reconcile_interval=self.config.reconcile_interval,
                 acl_datacenter=self.config.acl_datacenter,
                 acl_ttl=self.config.acl_ttl,
@@ -1042,6 +1087,11 @@ class Agent:
         # leadership/lease event timeline.  Operator surface like
         # /v1/agent/slo — always on (empty-ish in client mode).
         router.add_get("/v1/operator/raft/telemetry", h(self._raft_telemetry))
+        # Autotune observatory (obs/tuner.py): the knob resolution this
+        # agent (and its gossip plane) actually booted with — per-knob
+        # value, resolution source (flag | verdict | default), evidence
+        # keys, reason.  Operator surface like /v1/agent/slo — always on.
+        router.add_get("/v1/operator/autotune", h(self._autotune))
         # Observability surfaces, gated like /debug/pprof/* (http.go
         # EnableDebug): finished traces, the kernel flight recorder,
         # on-demand device profiling, and the one-shot incident bundle.
@@ -1130,6 +1180,16 @@ class Agent:
             hists += fams.get("histograms") or []
             labeled_gauges += fams.get("gauges") or []
             labeled_counters += fams.get("counters") or []
+        # Autotune observatory (obs/tuner.py): per-knob value/source
+        # gauges, evidence age, re-settle counter over the merged
+        # agent + plane resolution.
+        import time as _time
+
+        from consul_tpu.obs import tuner
+        at_gauges, at_counters = tuner.prom_families(
+            await self._autotune_merged(), _time.time())
+        labeled_gauges += at_gauges
+        labeled_counters += at_counters
         # Standard scrape hygiene, never gated: build identity + liveness.
         from consul_tpu.obs import devstats
         bi_gauges = devstats.build_info_families(self.config.gossip_backend)
@@ -1164,6 +1224,46 @@ class Agent:
                                  summaries=summaries,
                                  labeled_counters=labeled_counters,
                                  labeled_gauges=labeled_gauges or None)
+
+    async def _autotune_merged(self) -> Dict[str, Any]:
+        """The full autotune picture for this node: the agent's own
+        serving-knob resolution, the gossip plane's kernel-knob
+        resolution pulled over the bridge, and a fill-in resolve for
+        registry knobs neither process applies directly (the
+        device-store matcher floor) — so the operator route and the
+        ``consul_autotune_*`` families always cover the whole registry."""
+        from consul_tpu.obs import tuner
+        out = dict(self.autotune.wire())
+        out["knobs"] = dict(out.get("knobs") or {})
+        getter = getattr(self.lan_pool, "plane_autotune", None)
+        if getter is not None:
+            pl = dict(await getter(timeout=2.0))
+            pl.pop("t", None)
+            out["knobs"].update(pl.get("knobs") or {})
+            # The kernel session's fingerprint/verdict metadata is the
+            # authoritative chip identity when a plane is attached.
+            for k in ("fingerprint", "verdict_path", "verdict_found",
+                      "evidence_epoch_unix"):
+                if pl.get(k) is not None:
+                    out[k] = pl[k]
+            out["resettles"] = max(int(out.get("resettles", 0)),
+                                   int(pl.get("resettles", 0)))
+        missing = [k for k in sorted(tuner.KNOBS) if k not in out["knobs"]]
+        if missing:
+            fp = out.get("fingerprint") or {}
+            fill = tuner.resolve(missing, {},
+                                 platform=fp.get("platform") or "cpu",
+                                 device_count=fp.get("device_count") or 1)
+            out["knobs"].update(fill.rows)
+        return out
+
+    async def _autotune(self, request):
+        """Autotune observatory JSON (/v1/operator/autotune): each
+        registry knob's resolved value, source, evidence keys and
+        reason, plus the backend fingerprint and verdict location."""
+        out = await self._autotune_merged()
+        out.setdefault("backend", self.config.gossip_backend)
+        return out
 
     async def _raft_telemetry(self, request):
         """Consensus-plane telemetry JSON: raft stats, latency

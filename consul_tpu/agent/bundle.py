@@ -18,6 +18,9 @@ agent over the window and returns a gzipped tarball of:
   + the leadership/election/lease event timeline
 * ``device/telemetry.json`` — device/kernel observatory: dispatch
   hists, HBM occupancy, compile + roofline telemetry (obs/devstats.py)
+* ``autotune/verdict.json`` — autotune observatory: the knob
+  resolution this node booted with (obs/tuner.py) — per-knob value,
+  source, evidence keys + the backend fingerprint
 * ``tasks.txt``             — thread + asyncio task dump (agent/debug.py)
 * ``config.json``           — agent config with secrets redacted
 
@@ -42,7 +45,7 @@ from consul_tpu.version import VERSION
 SECRET_FIELDS = ("encrypt", "acl_master_token", "acl_token")
 
 SECTIONS = ("metrics", "slo", "traces", "flight", "raft", "device",
-            "tasks", "config")
+            "autotune", "tasks", "config")
 
 
 def redacted_config(config: Any) -> Dict[str, Any]:
@@ -80,6 +83,7 @@ async def capture(agent: Any, seconds: float) -> bytes:
     put_json("raft/telemetry.json", raftstats.telemetry(
         getattr(agent.server, "raft", None), local=agent.local))
     put_json("device/telemetry.json", await agent._device(None))
+    put_json("autotune/verdict.json", await agent._autotune(None))
     files["tasks.txt"] = debug.task_dump().encode()
     put_json("config.json", redacted_config(agent.config))
     put_json("manifest.json", {

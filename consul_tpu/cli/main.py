@@ -269,7 +269,8 @@ def cmd_gossipd(args) -> int:
         capacity=args.capacity, sim_nodes=args.sim_nodes,
         gossip_interval_s=args.gossip_interval,
         hb_lapse_s=args.hb_lapse, suspicion_mult=args.suspicion_mult,
-        slots=args.slots, encrypt_keys=keys, nemesis=args.nemesis)
+        slots=args.slots, encrypt_keys=keys, nemesis=args.nemesis,
+        dissem=args.dissem, shard_devices=args.shard_devices)
 
     async def serve() -> None:
         plane = GossipPlane(cfg)
@@ -695,6 +696,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-suspicion-mult", dest="suspicion_mult", type=float,
                    default=4.0)
     p.add_argument("-slots", type=int, default=64)
+    p.add_argument("-dissem", default="",
+                   choices=("swar", "planes", "prefused", "fused"),
+                   help="dissemination strategy; omit to take the "
+                        "autotune verdict (obs/tuner.py), falling back "
+                        "to swar when no verdict applies")
+    p.add_argument("-shard-devices", dest="shard_devices", type=int,
+                   default=-1,
+                   help="device shards for the kernel round: -1 takes "
+                        "the autotune verdict, 0 uses the all-devices "
+                        "heuristic, >=1 forces that shard count")
     p.add_argument("-encrypt", action="append", default=[],
                    help="gossip key (base64); registrations must carry "
                         "a keyring HMAC proof (repeatable for rotation)")

@@ -128,9 +128,11 @@ class PlaneConfig:
     # Devices the SWIM round is shard_map'd over (kernel.py "ICI
     # sharding").  1 = single-device; >1 = explicit (start() raises if
     # the universe size is not divisible by shard_devices and
-    # probe_every); 0 = auto: all local devices when the alignment
-    # constraints hold, else fall back to single-device.
-    shard_devices: int = 1
+    # probe_every); 0 = all local devices when the alignment
+    # constraints hold, else fall back to single-device; -1 = resolve
+    # through the persisted autotune verdict (obs/tuner.py), with a
+    # misaligned verdict degrading to single-device instead of raising.
+    shard_devices: int = -1
     # Detection-latency SLO objective in kernel rounds (obs/slo.py).
     # 0 = auto: the params' worst-case Lifeguard suspicion window plus
     # one probe-selection period (the latest round a clean detection
@@ -146,11 +148,41 @@ class PlaneConfig:
     # SLO observatory a per-failure-mode breakdown (/v1/agent/slo
     # ``scenarios``, scenario-labeled Prometheus histograms).
     nemesis: str = ""
+    # Autotuned kernel knobs (obs/tuner.py).  Each field below defaults
+    # to an AUTO sentinel: left there, the value resolves through the
+    # persisted per-platform autotune verdict at start() (explicit
+    # config value > verdict > registry default); any other value is an
+    # explicit operator setting and wins over the verdict.  TUNED_FIELDS
+    # below is the consumer-side claim for the autotune-knob vet group.
+    #
     # Dissemination merge strategy for the kernel round
     # (params.SwimParams.dissem: swar | planes | prefused | fused —
-    # all bit-identical; see gossip/params.py).  The live-plane default
-    # stays "swar" until §5c's chip session settles the A/B.
-    dissem: str = "swar"
+    # all bit-identical; see gossip/params.py).  "" = auto.
+    dissem: str = ""
+    # Active-rumor top-k short-circuit (params.SwimParams.hot_slots;
+    # 0 = full sweep).  -1 = auto.
+    hot_slots: int = -1
+    # Fused-kernel column-block count (params.SwimParams.fused_nb,
+    # min 1).  0 = auto.
+    fused_nb: int = 0
+    # Kernel rounds fused per scan iteration (kernel.run_rounds unroll,
+    # min 1).  0 = auto.
+    unroll: int = 0
+    # Dispatches between flight-ring host drains (min 1).  0 = auto.
+    flight_drain_every: int = 0
+
+
+# PlaneConfig knobs resolved through the autotune verdict — the
+# plane's consumer-side claim for the ``autotune-knob`` vet group
+# (tools/vet/table_drift.py): the union of every TUNED_FIELDS literal
+# must equal the obs/tuner.py KNOBS key set.
+TUNED_FIELDS = ("dissem", "hot_slots", "fused_nb", "shard_devices",
+                "unroll", "flight_drain_every")
+
+# The per-field AUTO sentinel (the dataclass default): any other value
+# is an explicit operator setting and skips the verdict.
+_TUNED_AUTO = {"dissem": "", "hot_slots": -1, "fused_nb": 0,
+               "shard_devices": -1, "unroll": 0, "flight_drain_every": 0}
 
 
 @dataclass
@@ -214,6 +246,11 @@ class GossipPlane:
         self._t0 = 0.0
         self._ndev = 1       # resolved in start() (config.shard_devices)
         self._run = None     # bound round-runner (sharded or not)
+        # Autotune resolution (obs/tuner.py), bound in start(); the
+        # pre-start defaults keep operator queries and stop() safe.
+        self._autotune = None
+        self._unroll = 4
+        self._drain_every = FLIGHT_DRAIN_EVERY
         # Events-kernel session: fires queue between dispatches; slot
         # metadata (payloads never enter device arrays) + delivery
         # bookkeeping live host-side, keyed by (slot, start_round).
@@ -279,11 +316,27 @@ class GossipPlane:
 
         c = self.config
         n = self.n_universe
+        # Resolve the autotuned knobs before any kernel object exists:
+        # explicit config value > persisted per-platform verdict >
+        # registry default (obs/tuner.py).  The resolution rows are
+        # served on the ``autotune`` bridge frame for the agent's
+        # operator route and prom families.
+        from consul_tpu.obs import tuner
+        explicit = {f: getattr(c, f) for f in TUNED_FIELDS
+                    if getattr(c, f) != _TUNED_AUTO[f]}
+        self._autotune = tuner.resolve(
+            list(TUNED_FIELDS), explicit,
+            platform=jax.default_backend(),
+            device_count=len(jax.devices()))
+        knob = self._autotune.value
         self._p = SwimParams(
             n=n, slots=c.slots, probe_every=c.probe_every,
             suspicion_mult=c.suspicion_mult,
             gossip_interval_s=c.gossip_interval_s,
-            dissem=c.dissem)
+            dissem=knob("dissem"), hot_slots=int(knob("hot_slots")),
+            fused_nb=int(knob("fused_nb")))
+        self._unroll = max(1, int(knob("unroll")))
+        self._drain_every = max(1, int(knob("flight_drain_every")))
         self._state = init_state(self._p)
         # Only registered agents (and live sim nodes) are members; start
         # with an empty membership and admit on register.
@@ -339,15 +392,29 @@ class GossipPlane:
             if sc.nem.needs_state:
                 self._nem_state = init_nem_state(n)
         # Resolve the device count for the sharded round (config
-        # docstring: 1 = off, >1 = explicit/strict, 0 = auto when the
-        # alignment constraints hold).
+        # docstring: 1 = off, >1 = explicit/strict, 0 = all devices
+        # when the alignment constraints hold, -1 = verdict).
         ndev = c.shard_devices
+        tuned_shard = ndev < 0
+        if tuned_shard:
+            ndev = int(knob("shard_devices"))
         if ndev == 0:
             ndev = len(jax.devices())
             if n % ndev or n % self._p.probe_every:
                 ndev = 1
         if ndev > 1:
-            _check_shardable(self._p, ndev)  # raises with the constraint
+            if tuned_shard:
+                # A verdict settled on another topology must not brick
+                # the boot: misaligned => degrade to single-device.
+                try:
+                    if ndev > len(jax.devices()):
+                        raise ValueError("fewer devices than verdict")
+                    _check_shardable(self._p, ndev)
+                except ValueError:
+                    ndev = 1
+            else:
+                _check_shardable(self._p, ndev)  # raises, constraint
+        if ndev > 1:
             self._state = shard_state(self._state, ndev)
         self._ndev = ndev
         if ndev > 1:
@@ -356,20 +423,22 @@ class GossipPlane:
                 return run_rounds_sharded(
                     state, key, fail, self._p, steps=steps, trace=True,
                     join_round=join_round, flight=flight, hist=hist,
-                    nem=self._nem, nem_state=nem_state, ndev=self._ndev)
+                    nem=self._nem, nem_state=nem_state, ndev=self._ndev,
+                    unroll=self._unroll)
         else:
             def _run(state, key, fail, steps, join_round, flight, hist,
                      nem_state=None):
                 return run_rounds(
                     state, key, fail, self._p, steps=steps, trace=True,
                     join_round=join_round, flight=flight, hist=hist,
-                    nem=self._nem, nem_state=nem_state)
+                    nem=self._nem, nem_state=nem_state,
+                    unroll=self._unroll)
         self._run = _run
         # Flight ring sized so a full drain interval fits with headroom
         # (bounded-burst catch-up can run up to max_burst extra
         # dispatches before the drain counter trips).
         self._flight = init_flight(
-            ring_rounds=4 * FLIGHT_DRAIN_EVERY * STEPS_PER_TICK)
+            ring_rounds=4 * self._drain_every * STEPS_PER_TICK)
         self._flight_recorder = FlightRecorder()
         self._dispatches_since_drain = 0
         # Observatory banks ride the same dispatch: cumulative on-device
@@ -390,7 +459,7 @@ class GossipPlane:
         if self._dev is not None:
             self._dev.set_session(slots=c.slots, n=n,
                                   steps_per_dispatch=STEPS_PER_TICK,
-                                  ndev=ndev, dissem=c.dissem)
+                                  ndev=ndev, dissem=self._p.dissem)
         # run_rounds donates state+flight+hist (+nem_state): warm up on
         # copies so the session arrays survive the throwaway compile
         # dispatch.  The wall time around each warmup is the compile
@@ -614,10 +683,11 @@ class GossipPlane:
             state, self._flight, self._hist = out
         self._state = state
         self._rounds_done += STEPS_PER_TICK
-        # Amortized drain: one host transfer per FLIGHT_DRAIN_EVERY
-        # dispatches (>= 64 rounds), never per round.
+        # Amortized drain: one host transfer per resolved drain cadence
+        # (default FLIGHT_DRAIN_EVERY dispatches, >= 64 rounds), never
+        # per round.
         self._dispatches_since_drain += 1
-        if self._dispatches_since_drain >= FLIGHT_DRAIN_EVERY:
+        if self._dispatches_since_drain >= self._drain_every:
             self._drain_flight()
 
         # Joins the kernel admitted this dispatch: the EV_JOIN the
@@ -921,6 +991,15 @@ class GossipPlane:
                                "counters": counters}
         return out
 
+    def _autotune_wire(self) -> Dict[str, Any]:
+        """``autotune`` bridge frame: the knob resolution this plane
+        booted with (obs/tuner.py Resolution.wire — per-knob value,
+        source, evidence keys, reason + verdict metadata)."""
+        out: Dict[str, Any] = {"t": "autotune"}
+        if self._autotune is not None:
+            out.update(self._autotune.wire())
+        return out
+
     def _profile_wire(self, steps: int, phases: bool = False
                       ) -> Dict[str, Any]:
         """On-demand device profiling: run ``steps`` kernel rounds on
@@ -1114,6 +1193,11 @@ class GossipPlane:
                     # dispatch hists, HBM rows, compile + roofline
                     # telemetry (same keyring gate as stats).
                     self._send(writer, self._device_wire())
+                elif t == "autotune":
+                    # Autotune observatory query (obs/tuner.py): the
+                    # knob resolution this plane booted with (same
+                    # keyring gate as stats).
+                    self._send(writer, self._autotune_wire())
                 elif t == "profile":
                     # On-demand device profiling of K kernel rounds.
                     # Blocks this connection's loop while capturing —

@@ -345,6 +345,10 @@ class TpuSerfPool:
             fut = getattr(self, "_device_future", None)
             if fut is not None and not fut.done():
                 fut.set_result(m)
+        elif t == "autotune":
+            fut = getattr(self, "_autotune_future", None)
+            if fut is not None and not fut.done():
+                fut.set_result(m)
         elif t == "user":
             ltime = int(m.get("ltime", 0))
             self.event_ltime = max(self.event_ltime, ltime)
@@ -482,6 +486,23 @@ class TpuSerfPool:
             fut = self._device_future = \
                 asyncio.get_event_loop().create_future()
             self._bridge.send({"t": "device"})
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            return {}
+
+    async def plane_autotune(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Autotune observatory from the plane (the agent side of
+        /v1/operator/autotune): the knob resolution the kernel session
+        booted with — per-knob value, source, evidence keys, reason.
+        Same shared-future discipline as plane_stats."""
+        if self._bridge is None:
+            return {}
+        fut = getattr(self, "_autotune_future", None)
+        if fut is None or fut.done():
+            fut = self._autotune_future = \
+                asyncio.get_event_loop().create_future()
+            self._bridge.send({"t": "autotune"})
         try:
             return await asyncio.wait_for(asyncio.shield(fut), timeout)
         except asyncio.TimeoutError:

@@ -1,0 +1,927 @@
+"""Observatory-driven autotuning control plane (ROADMAP item 2).
+
+Four PRs of observatories (detection-latency SLO, raft/replication
+telemetry, device/kernel devstats, store stats) feed humans; this
+module makes them feed the system, generalizing Lifeguard's pattern —
+a failure detector that consumes its own local observability to adapt
+its timeouts — to every standing chip-decidable knob in the plane.
+
+Three pieces, all deterministic and offline-capable:
+
+1. **Knob registry** (``KNOBS``): every standing knob with its default,
+   the evidence it is decided from, and a pure decision rule.  The
+   registry is the governing table for the ``autotune-knob`` vet group
+   (tools/vet/table_drift.py): every consumer declares the knobs it
+   applies in a ``TUNED_FIELDS`` literal, and the union must equal this
+   dict's key set — a knob added anywhere without tuner coverage fails
+   ``make vet``.
+
+2. **Evidence adapters**: parse the existing artifacts — the bench
+   regime cache (``.bench_last_success.json`` + ``BENCH_r*.json``
+   last-known-good payloads, incl. the ``_Timeline`` phase records and
+   ``roofline_utilization``), ``BENCH_WATCH.json`` (watch-match A/B +
+   crossover sweep), ``BENCH_SERVE.json`` (serving-plane worker A/B),
+   ``CHAOS.json`` (fault-detectability verdicts), and the live
+   device/reqstats JSON twins — into one uniform evidence table where
+   every row carries a platform stamp and a freshness stamp.
+
+3. **Decision engine** (``settle``): evidence table + backend
+   fingerprint -> a per-platform verdict file persisted next to the
+   XLA compile cache.  Same inputs => byte-identical verdict (``make
+   tune-check`` insists).  Consumed at plane/server boot via
+   ``resolve`` with a strict resolution order — explicit flag >
+   persisted verdict > registry default — and re-settled automatically
+   when the backend fingerprint (platform x topology x jax version)
+   changes.
+
+Staleness is judged against the *evidence epoch* (the newest stamp in
+the table), not the wall clock, so settling twice over the same
+artifacts cannot disagree across a date boundary.  Platform stamps are
+compared by class: ``axon``/``tpu`` are one chip class (the bench
+cache convention), and a CPU smoke measurement never decides a chip
+knob (or vice versa).
+
+Observability of the tuner itself: ``/v1/operator/autotune`` JSON (the
+agent merges its own resolution with the plane's ``autotune`` bridge
+frame), ``consul_autotune_*`` Prometheus families (``prom_families``),
+and the ``autotune/verdict.json`` debug-bundle member.
+
+Kill switch: ``CONSUL_TPU_AUTOTUNE=0`` ignores persisted verdicts
+everywhere (flags and defaults still resolve).  ``CONSUL_TPU_AUTOTUNE_DIR``
+overrides the verdict directory (tests point it at a temp dir so a
+developer's ``make tune`` verdict never leaks into a unit boot).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+VERDICT_FORMAT = 1
+
+# Evidence older than this relative to the newest row in the SAME table
+# is rejected — a stale measurement must not outvote a fresh one taken
+# after a kernel rewrite.  Judged against the evidence epoch, never the
+# wall clock (determinism).
+MAX_EVIDENCE_AGE_S = 90 * 24 * 3600.0
+
+# Platform classes: the bench cache treats axon/tpu/untagged as one
+# chip class (bench.py _same_platform_class); "" stamps are neutral
+# (host-side measurements like the serving A/B or chaos detectability).
+_CHIP_PLATFORMS = ("axon", "tpu")
+
+# Valid dissemination strategies a verdict may carry (mirrors the
+# governing membership in gossip/params.py __post_init__; the vet
+# dissem group's K02 pass pins stray literals).
+DISSEM_CHOICES = ("swar", "planes", "prefused", "fused")
+
+# Hardcoded CPU floor for the device watch matcher, duplicated from
+# state/device_store.WATCH_DEVICE_MIN_CPU (importing it would pull jax
+# into every resolve).  Used only when no measured sweep artifact
+# exists; the bridge passes its own constant as the fallback anyway.
+DEFAULT_WATCH_DEVICE_MIN = 1 << 16
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# -- evidence ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Evidence:
+    """One measured fact: a flat key, a JSON-able value, the artifact
+    it came from, and the platform/freshness stamps admission is
+    judged on."""
+
+    key: str
+    value: Any
+    source: str            # artifact basename or adapter name
+    platform: str = ""     # "" = platform-neutral (host-side)
+    stamp_unix: float = 0.0
+
+
+def _same_platform_class(a: str, b: str) -> bool:
+    return a == b or (a in _CHIP_PLATFORMS and b in _CHIP_PLATFORMS)
+
+
+class EvidenceTable:
+    """Admission-filtered evidence for one fingerprint: foreign-platform
+    rows and stale rows are rejected (and counted), duplicates resolve
+    newest-stamp-wins, lookups are deterministic."""
+
+    def __init__(self, rows: Sequence[Evidence], platform: str) -> None:
+        self.platform = platform
+        rows = sorted(rows, key=lambda r: (r.key, r.source, r.stamp_unix))
+        self.epoch = max((r.stamp_unix for r in rows), default=0.0)
+        self.rejected: List[Tuple[Evidence, str]] = []
+        admissible: Dict[str, Evidence] = {}
+        for r in rows:
+            if r.platform and not _same_platform_class(r.platform, platform):
+                self.rejected.append((r, "foreign-platform"))
+                continue
+            if r.stamp_unix < self.epoch - MAX_EVIDENCE_AGE_S:
+                self.rejected.append((r, "stale"))
+                continue
+            prev = admissible.get(r.key)
+            if prev is None or r.stamp_unix >= prev.stamp_unix:
+                admissible[r.key] = r
+        self.rows: Dict[str, Evidence] = admissible
+
+    def get(self, key: str) -> Optional[Evidence]:
+        return self.rows.get(key)
+
+    def value(self, key: str, default: Any = None) -> Any:
+        r = self.rows.get(key)
+        return default if r is None else r.value
+
+    def match(self, prefix: str) -> List[Evidence]:
+        return [self.rows[k] for k in sorted(self.rows)
+                if k.startswith(prefix)]
+
+
+# -- evidence adapters -------------------------------------------------------
+
+# bench.py metric-name shape (bench _METRIC_RE, kept in lockstep there):
+# swim_{gossip|multidc}_rounds_per_sec_{n}_nodes[_churn{p}ppm][_{d}dc]
+# [_hot{h}][_planes|_prefused|_fused][_flight][_shard{d}][_nem_{scn}]
+_BENCH_RE = re.compile(
+    r"^swim_(gossip|multidc)_rounds_per_sec_(\d+)_nodes"
+    r"(?:_churn(\d+)ppm)?(?:_(\d+)dc)?(?:_hot(\d+))?"
+    r"(_planes|_prefused|_fused)?(_flight)?"
+    r"(?:_shard(\d+))?(?:_nem_([a-z0-9_]+))?$")
+
+
+def parse_bench_metric(name: str) -> Optional[Dict[str, Any]]:
+    """Bench metric name -> regime properties (None = not a bench
+    rounds/s metric)."""
+    name = name.rpartition(":")[2]  # strip a non-chip platform prefix
+    m = _BENCH_RE.match(name)
+    if m is None:
+        return None
+    return {
+        "variant": m.group(1),
+        "n": int(m.group(2)),
+        "churn_ppm": int(m.group(3)) if m.group(3) is not None else 1000,
+        "strategy": (m.group(6).lstrip("_") if m.group(6) is not None
+                     else "swar"),
+        "hot": int(m.group(5)) if m.group(5) is not None else 0,
+        "flight": m.group(7) is not None,
+        "shard": int(m.group(8)) if m.group(8) is not None else 0,
+        "nemesis": m.group(9) or "",
+    }
+
+
+def _bench_rows(metric: str, entry: Dict[str, Any],
+                source: str) -> List[Evidence]:
+    """One bench result dict -> evidence rows (rounds/s + compile +
+    roofline + per-phase _Timeline totals)."""
+    plat = str(entry.get("platform", "") or "")
+    stamp = float(entry.get("measured_unix", 0) or 0)
+    tail = metric.rpartition(":")[2]
+    rows = [Evidence(f"bench.rps.{tail}", float(entry.get("value", 0.0)),
+                     source, plat, stamp)]
+    if entry.get("compile_s") is not None:
+        rows.append(Evidence(f"bench.compile_s.{tail}",
+                             float(entry["compile_s"]), source, plat, stamp))
+    if entry.get("roofline_utilization") is not None:
+        rows.append(Evidence(f"bench.roofline.{tail}",
+                             float(entry["roofline_utilization"]),
+                             source, plat, stamp))
+    phases: Dict[str, float] = {}
+    for ev in entry.get("phases") or []:
+        if isinstance(ev, dict) and "phase" in ev:
+            phases[str(ev["phase"])] = (phases.get(str(ev["phase"]), 0.0)
+                                        + float(ev.get("dur_s", 0.0)))
+    for phase in sorted(phases):
+        rows.append(Evidence(f"bench.phase_s.{tail}.{phase}",
+                             round(phases[phase], 6), source, plat, stamp))
+    return rows
+
+
+def adapt_bench_cache(root: str = REPO_ROOT) -> List[Evidence]:
+    """`.bench_last_success.json` (the per-regime last-known-good cache
+    bench.py maintains) + the BENCH_r*.json round payloads' embedded
+    ``regimes`` / ``regimes_last_known_good`` tables."""
+    rows: List[Evidence] = []
+    path = os.path.join(root, ".bench_last_success.json")
+    cache = _read_json(path)
+    if isinstance(cache, dict) and "metric" not in cache:
+        for metric in sorted(cache):
+            entry = cache[metric]
+            if isinstance(entry, dict) and "value" in entry:
+                rows += _bench_rows(metric, entry, os.path.basename(path))
+    for rpath in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        payload = _read_json(rpath)
+        parsed = (payload or {}).get("parsed") or {}
+        for tab in ("regimes", "regimes_last_known_good"):
+            for _regime, entry in sorted((parsed.get(tab) or {}).items()):
+                if isinstance(entry, dict) and entry.get("metric"):
+                    rows += _bench_rows(str(entry["metric"]), entry,
+                                        os.path.basename(rpath))
+    return rows
+
+
+def adapt_watch(root: str = REPO_ROOT) -> List[Evidence]:
+    """BENCH_WATCH.json: per-tier host/device ms-per-batch medians plus
+    the ``--sweep`` crossover record (tools/watchstorm.py)."""
+    path = os.path.join(root, "BENCH_WATCH.json")
+    payload = _read_json(path)
+    if not isinstance(payload, dict):
+        return []
+    src = os.path.basename(path)
+    plat = str(payload.get("platform", "") or "")
+    stamp = _mtime(path)
+    rows: List[Evidence] = []
+    for tier in payload.get("tiers") or []:
+        w = tier.get("watches")
+        if w is None:
+            continue
+        for k in ("host_ms_per_batch", "device_ms_per_batch"):
+            if tier.get(k) is not None:
+                rows.append(Evidence(f"watch.{k}.{int(w)}",
+                                     float(tier[k]), src, plat, stamp))
+    sweep = payload.get("sweep")
+    if isinstance(sweep, dict):
+        rows.append(Evidence("watch.sweep_max",
+                             int(sweep.get("hi", 0) or 0), src, plat, stamp))
+        if sweep.get("crossover_watches") is not None:
+            rows.append(Evidence("watch.crossover_watches",
+                                 int(sweep["crossover_watches"]),
+                                 src, plat, stamp))
+    return rows
+
+
+def adapt_serve(root: str = REPO_ROOT) -> List[Evidence]:
+    """BENCH_SERVE.json (tools/bench_serve.py): per-worker-count KV
+    throughput + tail latency.  Host-side serving — platform-neutral."""
+    path = os.path.join(root, "BENCH_SERVE.json")
+    payload = _read_json(path)
+    if not isinstance(payload, dict):
+        return []
+    src, stamp = os.path.basename(path), _mtime(path)
+    rows: List[Evidence] = []
+    for run, ops in sorted((payload.get("runs") or {}).items()):
+        m = re.match(r"^workers=(\d+)$", run)
+        if m is None or not isinstance(ops, dict):
+            continue
+        w = int(m.group(1))
+        get = ops.get("kv_get") or {}
+        if get.get("req_per_sec") is not None:
+            rows.append(Evidence(f"serve.kv_get_rps.workers{w}",
+                                 float(get["req_per_sec"]), src, "", stamp))
+        if get.get("p99_ms") is not None:
+            rows.append(Evidence(f"serve.kv_get_p99_ms.workers{w}",
+                                 float(get["p99_ms"]), src, "", stamp))
+    return rows
+
+
+def adapt_chaos(root: str = REPO_ROOT) -> List[Evidence]:
+    """CHAOS.json (tools/chaos_campaign.py): per-scenario pass/detected
+    verdicts.  The campaign runs on the CPU harness but exercises
+    host-side raft timing — platform-neutral."""
+    path = os.path.join(root, "CHAOS.json")
+    payload = _read_json(path)
+    if not isinstance(payload, dict):
+        return []
+    src, stamp = os.path.basename(path), _mtime(path)
+    rows: List[Evidence] = []
+    for sc in payload.get("scenarios") or []:
+        name = sc.get("scenario")
+        if not name:
+            continue
+        det = sc.get("detection") or {}
+        rows.append(Evidence(f"chaos.detected.{name}",
+                             bool(det.get("detected")), src, "", stamp))
+        rows.append(Evidence(f"chaos.pass.{name}", bool(sc.get("pass")),
+                             src, "", stamp))
+    if payload.get("passed") is not None:
+        rows.append(Evidence("chaos.passed", bool(payload["passed"]),
+                             src, "", stamp))
+    return rows
+
+
+def adapt_device_telemetry(payload: Dict[str, Any], platform: str = "",
+                           stamp_unix: float = 0.0,
+                           source: str = "device_telemetry",
+                           ) -> List[Evidence]:
+    """The device/kernel observatory JSON twin (/v1/agent/device body
+    or a bundle's device/telemetry.json): compile wall census, HBM
+    occupancy, rounds/s EWMA, roofline."""
+    rows: List[Evidence] = []
+    if not isinstance(payload, dict):
+        return rows
+    compile_ = payload.get("compile") or {}
+    for what, wall in sorted((compile_.get("wall_s") or {}).items()):
+        rows.append(Evidence(f"device.compile_s.{what}", float(wall),
+                             source, platform, stamp_unix))
+    if payload.get("rounds_per_sec_ewma") is not None:
+        rows.append(Evidence("device.rounds_per_sec_ewma",
+                             float(payload["rounds_per_sec_ewma"]),
+                             source, platform, stamp_unix))
+    roof = payload.get("roofline") or {}
+    if isinstance(roof, dict) and roof.get("utilization") is not None:
+        rows.append(Evidence("device.roofline_utilization",
+                             float(roof["utilization"]),
+                             source, platform, stamp_unix))
+    for i, dev in enumerate(payload.get("devices") or []):
+        if isinstance(dev, dict) and dev.get("bytes_in_use") is not None:
+            rows.append(Evidence(f"device.hbm_bytes_in_use.{i}",
+                                 float(dev["bytes_in_use"]),
+                                 source, platform, stamp_unix))
+    return rows
+
+
+def adapt_reqstats(payload: Dict[str, Any], stamp_unix: float = 0.0,
+                   source: str = "reqstats") -> List[Evidence]:
+    """A reqstats snapshot ({endpoint: {count, p50_ms, p99_ms, ...}},
+    obs/reqstats.py): serving-plane tail latency census."""
+    rows: List[Evidence] = []
+    if not isinstance(payload, dict):
+        return rows
+    for endpoint in sorted(payload):
+        st = payload[endpoint]
+        if not isinstance(st, dict):
+            continue
+        for k in ("p50_ms", "p99_ms"):
+            if st.get(k) is not None:
+                rows.append(Evidence(f"req.{k}.{endpoint}", float(st[k]),
+                                     source, "", stamp_unix))
+    return rows
+
+
+def gather_evidence(root: str = REPO_ROOT) -> List[Evidence]:
+    """Every offline artifact adapter over one repo checkout.  Missing
+    artifacts contribute nothing (the rules fall back to defaults)."""
+    return (adapt_bench_cache(root) + adapt_watch(root)
+            + adapt_serve(root) + adapt_chaos(root))
+
+
+def _read_json(path: str) -> Any:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _mtime(path: str) -> float:
+    try:
+        return round(os.stat(path).st_mtime, 3)
+    except OSError:
+        return 0.0
+
+
+# -- decision rules ----------------------------------------------------------
+#
+# Each rule is pure: (EvidenceTable, fingerprint) -> (value, [evidence
+# keys used], reason) or None when the table holds nothing admissible
+# for it (the engine then records the registry default).  Rules compare
+# regimes AT THE SAME UNIVERSE SIZE, largest size first — a 640-node
+# smoke must not decide against a 16384-node measurement.
+
+_MIN_GAIN = 1.02  # >=2% measured improvement to move off a default
+
+
+def _rps_by(table: EvidenceTable, want: Callable[[Dict[str, Any]], bool],
+            group: Callable[[Dict[str, Any]], Any],
+            ) -> Dict[int, Dict[Any, Tuple[float, str]]]:
+    """Admissible bench rounds/s rows matching ``want``, bucketed by
+    universe size then by ``group(props)`` -> (value, evidence key)."""
+    out: Dict[int, Dict[Any, Tuple[float, str]]] = {}
+    for r in table.match("bench.rps."):
+        props = parse_bench_metric(r.key[len("bench.rps."):])
+        if props is None or not want(props):
+            continue
+        out.setdefault(props["n"], {})[group(props)] = (float(r.value),
+                                                        r.key)
+    return out
+
+
+def _lan_baseline(p: Dict[str, Any]) -> bool:
+    """The standing-LAN regime family A/B rules compare within: gossip
+    variant, default churn, no flight/nemesis riders."""
+    return (p["variant"] == "gossip" and p["churn_ppm"] == 1000
+            and not p["flight"] and not p["nemesis"])
+
+
+def _rule_dissem(table: EvidenceTable, fp: Dict[str, Any]):
+    by_n = _rps_by(table,
+                   lambda p: (_lan_baseline(p) and p["hot"] == 0
+                              and p["shard"] == 0),
+                   lambda p: p["strategy"])
+    for n in sorted(by_n, reverse=True):
+        cands = by_n[n]
+        if len(cands) < 2:
+            continue
+        best = max(sorted(cands), key=lambda s: cands[s][0])
+        base = cands.get("swar", cands[best])
+        if best != "swar" and cands[best][0] < base[0] * _MIN_GAIN:
+            best = "swar"   # not a measured win — keep the default
+        used = [cands[s][1] for s in sorted(cands)]
+        return (best, used,
+                f"best rounds/s among {sorted(cands)} at n={n}: "
+                f"{cands[best][0]:.1f}")
+    return None
+
+
+def _rule_hot_slots(table: EvidenceTable, fp: Dict[str, Any]):
+    by_n = _rps_by(table,
+                   lambda p: (p["variant"] == "gossip"
+                              and p["churn_ppm"] == 10
+                              and p["strategy"] == "swar"
+                              and p["shard"] == 0 and not p["flight"]
+                              and not p["nemesis"]),
+                   lambda p: p["hot"])
+    for n in sorted(by_n, reverse=True):
+        cands = by_n[n]
+        if 0 not in cands or len(cands) < 2:
+            continue
+        base = cands[0]
+        best = max(sorted(cands), key=lambda h: cands[h][0])
+        if best != 0 and cands[best][0] < base[0] * _MIN_GAIN:
+            best = 0        # within noise of the full-sweep default
+        used = [cands[h][1] for h in sorted(cands)]
+        return (int(best), used,
+                f"hot-slot A/B at n={n}: " + ", ".join(
+                    f"hot{h}={cands[h][0]:.1f}" for h in sorted(cands)))
+    return None
+
+
+def _rule_shard_devices(table: EvidenceTable, fp: Dict[str, Any]):
+    by_n = _rps_by(table,
+                   lambda p: (_lan_baseline(p) and p["hot"] == 0
+                              and p["strategy"] == "swar"),
+                   lambda p: p["shard"] or 1)
+    for n in sorted(by_n, reverse=True):
+        cands = by_n[n]
+        if len(cands) < 2:
+            continue
+        best = max(sorted(cands), key=lambda d: cands[d][0])
+        if best != 1 and cands[best][0] < cands.get(
+                1, cands[best])[0] * _MIN_GAIN:
+            best = 1
+        used = [cands[d][1] for d in sorted(cands)]
+        return (int(best), used,
+                f"shard ladder at n={n}: " + ", ".join(
+                    f"d{d}={cands[d][0]:.1f}" for d in sorted(cands)))
+    return None
+
+
+def _rule_fused_nb(table: EvidenceTable, fp: Dict[str, Any]):
+    # No standing fused_nb sweep artifact exists yet; a future bench
+    # regime family ("bench.fused_nb.<nb>" rows) decides this.
+    cands = {int(r.key.rpartition(".")[2]): (float(r.value), r.key)
+             for r in table.match("bench.fused_nb.")
+             if r.key.rpartition(".")[2].isdigit()}
+    if len(cands) < 2:
+        return None
+    best = max(sorted(cands), key=lambda nb: cands[nb][0])
+    return (int(best), [cands[nb][1] for nb in sorted(cands)],
+            f"fused column-block sweep: nb={best} fastest")
+
+
+def _rule_unroll(table: EvidenceTable, fp: Dict[str, Any]):
+    # Same contract as fused_nb: decided only once an unroll sweep
+    # artifact exists ("bench.unroll.<k>" rows).
+    cands = {int(r.key.rpartition(".")[2]): (float(r.value), r.key)
+             for r in table.match("bench.unroll.")
+             if r.key.rpartition(".")[2].isdigit()}
+    if len(cands) < 2:
+        return None
+    best = max(sorted(cands), key=lambda k: cands[k][0])
+    return (int(best), [cands[k][1] for k in sorted(cands)],
+            f"scan unroll sweep: unroll={best} fastest")
+
+
+def _rule_flight_drain_every(table: EvidenceTable, fp: Dict[str, Any]):
+    """Flight-recorder A/B (churn0 quiescent regime, with/without the
+    ring): if the recorder costs >5% rounds/s, halve the host-transfer
+    cadence by doubling the dispatch interval."""
+    by_n = _rps_by(table,
+                   lambda p: (p["variant"] == "gossip"
+                              and p["churn_ppm"] == 0
+                              and p["strategy"] == "swar"
+                              and p["hot"] == 0 and p["shard"] == 0
+                              and not p["nemesis"]),
+                   lambda p: p["flight"])
+    for n in sorted(by_n, reverse=True):
+        cands = by_n[n]
+        if True not in cands or False not in cands:
+            continue
+        off, on = cands[False][0], cands[True][0]
+        overhead = 0.0 if off <= 0 else max(0.0, 1.0 - on / off)
+        every = 32 if overhead > 0.05 else 16
+        return (every, [cands[False][1], cands[True][1]],
+                f"flight overhead {overhead * 100:.1f}% at n={n} "
+                f"(off={off:.1f}, on={on:.1f} rounds/s)")
+    return None
+
+
+def _rule_http_workers(table: EvidenceTable, fp: Dict[str, Any]):
+    cands = {int(r.key.rpartition("workers")[2]): (float(r.value), r.key)
+             for r in table.match("serve.kv_get_rps.workers")}
+    if len(cands) < 2:
+        return None
+    best = max(sorted(cands), key=lambda w: cands[w][0])
+    if best != 1 and cands[best][0] < cands.get(1, cands[best])[0] * _MIN_GAIN:
+        best = 1
+    return (int(best), [cands[w][1] for w in sorted(cands)],
+            "serving A/B: " + ", ".join(
+                f"workers={w} {cands[w][0]:.0f} req/s"
+                for w in sorted(cands)))
+
+
+def _rule_device_store(table: EvidenceTable, fp: Dict[str, Any]):
+    """Chip-class backends take the device store (batched apply + the
+    device matcher amortize); on CPU the host walk wins at every
+    measured watch tier, so it stays off unless flagged."""
+    on = fp.get("platform") not in ("cpu", "")
+    return (bool(on), ["fingerprint.platform"],
+            f"platform {fp.get('platform')!r} is "
+            + ("chip-class" if on else "host-class"))
+
+
+def _rule_watch_device_min(table: EvidenceTable, fp: Dict[str, Any]):
+    cross = table.get("watch.crossover_watches")
+    if cross is not None:
+        return (int(cross.value), [cross.key],
+                "measured host/device crossover (watchstorm --sweep)")
+    hi = table.get("watch.sweep_max")
+    if hi is not None and int(hi.value) > 0:
+        floor = max(DEFAULT_WATCH_DEVICE_MIN, 2 * int(hi.value))
+        return (floor, [hi.key],
+                f"device never won below the sweep cap ({int(hi.value)}); "
+                "floor set above it")
+    return None
+
+
+def _rule_lease_timeout_floor(table: EvidenceTable, fp: Dict[str, Any]):
+    """Lease-timeout floor vs the chaos detection floor: the lease fast
+    path is only safe while the raft observatory demonstrably DETECTS
+    clock faults burning the lease window (CHAOS.json).  All lease
+    scenarios detected => the auto lease window stands (floor 0);
+    any undetected => disable the lease read path (-1, the RaftConfig
+    sentinel) until detectability is restored."""
+    lease_scenarios = ("clock_skew", "clock_jump", "fsync_stall")
+    rows = [table.get(f"chaos.detected.{s}") for s in lease_scenarios]
+    rows = [r for r in rows if r is not None]
+    if not rows:
+        return None
+    undetected = sorted(r.key.rpartition(".")[2] for r in rows
+                        if not bool(r.value))
+    if undetected:
+        return (-1.0, [r.key for r in rows],
+                f"lease-burn scenarios {undetected} NOT detected by the "
+                "raft observatory — lease reads disabled")
+    return (0.0, [r.key for r in rows],
+            f"all {len(rows)} lease-burn scenarios detected; auto lease "
+            "window (election_timeout_min) stands")
+
+
+# -- knob registry -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One standing chip-decidable knob: default, where it lands, the
+    evidence consulted, and the pure decision rule."""
+
+    default: Any
+    kind: str                       # str | int | float | bool
+    target: str                     # the config field the value lands on
+    rule: Callable[[EvidenceTable, Dict[str, Any]], Optional[tuple]]
+    evidence: Tuple[str, ...] = ()  # evidence key prefixes consulted
+    doc: str = ""
+    choices: Tuple[str, ...] = ()   # for kind=str: valid values
+
+
+# The registry — governing key set for the ``autotune-knob`` vet group.
+# Every key is claimed by exactly one consumer-side TUNED_FIELDS
+# literal (gossip/plane.py, agent/agent.py, state/device_store.py);
+# tools/vet/table_drift.py holds the union equal to this key set.
+KNOBS: Dict[str, Knob] = {
+    "dissem": Knob(
+        default="swar", kind="str", choices=DISSEM_CHOICES,
+        target="PlaneConfig.dissem", rule=_rule_dissem,
+        evidence=("bench.rps.",),
+        doc="Dissemination merge strategy for the kernel round."),
+    "fused_nb": Knob(
+        default=1, kind="int", target="PlaneConfig.fused_nb",
+        rule=_rule_fused_nb, evidence=("bench.fused_nb.",),
+        doc="Column-block count for the fused Pallas kernel's grid."),
+    "hot_slots": Knob(
+        default=0, kind="int", target="PlaneConfig.hot_slots",
+        rule=_rule_hot_slots, evidence=("bench.rps.",),
+        doc="Active-rumor top-k short-circuit in the dissemination "
+            "sweep (0 = full sweep)."),
+    "shard_devices": Knob(
+        default=1, kind="int", target="PlaneConfig.shard_devices",
+        rule=_rule_shard_devices, evidence=("bench.rps.",),
+        doc="Devices the SWIM round is shard_map'd over."),
+    "unroll": Knob(
+        default=4, kind="int", target="PlaneConfig.unroll",
+        rule=_rule_unroll, evidence=("bench.unroll.",),
+        doc="Kernel rounds fused per scan iteration."),
+    "flight_drain_every": Knob(
+        default=16, kind="int", target="PlaneConfig.flight_drain_every",
+        rule=_rule_flight_drain_every, evidence=("bench.rps.",),
+        doc="Dispatches between flight-ring host drains."),
+    "http_workers": Knob(
+        default=1, kind="int", target="AgentConfig.http_workers",
+        rule=_rule_http_workers, evidence=("serve.",),
+        doc="Serving-plane HTTP worker processes."),
+    "device_store": Knob(
+        default=False, kind="bool", target="AgentConfig.device_store",
+        rule=_rule_device_store, evidence=("fingerprint.",),
+        doc="Device-resident state store (batched FSM apply + device "
+            "watch matching)."),
+    "watch_device_min": Knob(
+        default=DEFAULT_WATCH_DEVICE_MIN, kind="int",
+        target="DeviceStoreBridge watch matcher floor (CPU)",
+        rule=_rule_watch_device_min, evidence=("watch.",),
+        doc="Standing-watch count where the device matcher beats the "
+            "host radix walk on CPU."),
+    "lease_timeout_floor_s": Knob(
+        default=0.0, kind="float",
+        target="RaftConfig.lease_timeout (when not overridden)",
+        rule=_rule_lease_timeout_floor, evidence=("chaos.",),
+        doc="Lease-timeout floor vs the chaos detectability verdicts "
+            "(0 = auto window; -1 = lease reads disabled)."),
+}
+
+
+def _valid(knob: Knob, value: Any) -> bool:
+    """A persisted verdict is operator input from disk: type- and
+    domain-check before a boot applies it (a corrupted file must
+    degrade to defaults, not crash SwimParams validation)."""
+    if knob.kind == "str":
+        return isinstance(value, str) and (
+            not knob.choices or value in knob.choices)
+    if knob.kind == "bool":
+        return isinstance(value, bool)
+    if knob.kind == "int":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if knob.kind == "float":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    return False
+
+
+# -- fingerprint + persistence -----------------------------------------------
+
+
+def fingerprint(platform: Optional[str] = None,
+                device_count: Optional[int] = None) -> Dict[str, Any]:
+    """Backend identity a verdict is scoped to: platform x topology x
+    jax version.  Imports jax only when the caller did not supply the
+    platform/topology (the offline CLI passes both to stay chip-free)."""
+    from consul_tpu.obs import devstats
+    if platform is None or device_count is None:
+        import jax
+        platform = platform or jax.default_backend()
+        if device_count is None:
+            device_count = jax.device_count()
+    return {"platform": str(platform), "device_count": int(device_count),
+            "jax": devstats.jax_version()}
+
+
+def cache_dir() -> str:
+    """The XLA compile-cache directory the verdict lives next to (same
+    resolution as gossip/plane.py start())."""
+    return os.environ.get(
+        "CONSUL_TPU_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "consul_tpu_jax_cache"))
+
+
+def verdict_dir() -> str:
+    return os.environ.get("CONSUL_TPU_AUTOTUNE_DIR",
+                          os.path.join(cache_dir(), "autotune"))
+
+
+def verdict_path(platform: str) -> str:
+    return os.path.join(verdict_dir(), f"verdict-{platform}.json")
+
+
+def enabled() -> bool:
+    return os.environ.get("CONSUL_TPU_AUTOTUNE", "1") != "0"
+
+
+def _round_floats(value: Any) -> Any:
+    if isinstance(value, float):
+        return round(value, 6)
+    return value
+
+
+def settle(rows: Sequence[Evidence], fp: Dict[str, Any]) -> Dict[str, Any]:
+    """Evidence + fingerprint -> verdict dict.  Pure and deterministic:
+    identical inputs produce identical output (no wall-clock reads —
+    freshness is judged against the evidence epoch)."""
+    table = EvidenceTable(rows, fp.get("platform", ""))
+    knobs: Dict[str, Any] = {}
+    for name in sorted(KNOBS):
+        knob = KNOBS[name]
+        try:
+            got = knob.rule(table, fp)
+        except Exception:  # noqa: E02 — one bad rule must not void the rest
+            got = None
+        if got is None:
+            knobs[name] = {"value": _round_floats(knob.default),
+                           "source": "default", "evidence": [],
+                           "reason": "no admissible evidence"}
+        else:
+            value, used, reason = got
+            knobs[name] = {"value": _round_floats(value),
+                           "source": "evidence",
+                           "evidence": sorted(used), "reason": reason}
+    return {
+        "format": VERDICT_FORMAT,
+        "fingerprint": dict(fp),
+        "evidence_epoch_unix": round(table.epoch, 3),
+        "evidence_rows": len(table.rows),
+        "rejected_rows": sorted(
+            f"{r.key} [{why}]" for r, why in table.rejected),
+        "knobs": knobs,
+    }
+
+
+def verdict_bytes(verdict: Dict[str, Any]) -> bytes:
+    """Canonical serialization — ``make tune-check`` byte-compares two
+    independent settles of the same artifacts."""
+    return (json.dumps(verdict, indent=1, sort_keys=True) + "\n").encode()
+
+
+def save_verdict(verdict: Dict[str, Any],
+                 path: Optional[str] = None) -> Optional[str]:
+    path = path or verdict_path(verdict["fingerprint"]["platform"])
+    try:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(verdict_bytes(verdict))
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def load_verdict(platform: str,
+                 path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    payload = _read_json(path or verdict_path(platform))
+    if not isinstance(payload, dict) \
+            or payload.get("format") != VERDICT_FORMAT \
+            or not isinstance(payload.get("knobs"), dict):
+        return None
+    return payload
+
+
+# -- boot-time resolution ----------------------------------------------------
+
+# Per-process count of fingerprint-change re-settles (the
+# consul_autotune_resettles_total counter).
+_RESETTLES = 0
+
+
+def resettles() -> int:
+    return _RESETTLES
+
+
+def _resettle(fp: Dict[str, Any], root: str) -> Optional[Dict[str, Any]]:
+    """The persisted verdict no longer matches this backend: settle a
+    fresh one from whatever artifacts this checkout holds and persist
+    it (best-effort — an unwritable cache dir still yields a usable
+    in-memory verdict)."""
+    global _RESETTLES
+    _RESETTLES += 1
+    verdict = settle(gather_evidence(root), fp)
+    save_verdict(verdict)
+    return verdict
+
+
+@dataclass
+class Resolution:
+    """One boot's knob resolution: per-knob rows + the metadata the
+    operator surfaces (/v1/operator/autotune, prom families) report."""
+
+    rows: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    def value(self, name: str) -> Any:
+        return self.rows[name]["value"]
+
+    def wire(self) -> Dict[str, Any]:
+        return {"knobs": dict(self.rows), **self.meta,
+                "resettles": resettles()}
+
+
+def resolve(names: Sequence[str], explicit: Dict[str, Any],
+            platform: Optional[str] = None,
+            device_count: Optional[int] = None,
+            root: str = REPO_ROOT) -> Resolution:
+    """Strict resolution order per knob: explicit flag > persisted
+    verdict > registry default.  ``explicit`` maps knob name -> value
+    for knobs the operator actually set (absent/None = unset).  A
+    verdict whose fingerprint no longer matches this backend is
+    re-settled from the repo artifacts and re-persisted."""
+    fp = fingerprint(platform, device_count)
+    verdict = None
+    vpath = verdict_path(fp["platform"])
+    if enabled():
+        verdict = load_verdict(fp["platform"])
+        if verdict is not None and verdict.get("fingerprint") != fp:
+            verdict = _resettle(fp, root)
+    res = Resolution(meta={
+        "fingerprint": fp,
+        "verdict_path": vpath,
+        "verdict_found": verdict is not None,
+        "autotune_enabled": enabled(),
+        "evidence_epoch_unix": (verdict or {}).get(
+            "evidence_epoch_unix", 0.0),
+    })
+    vknobs = (verdict or {}).get("knobs", {})
+    for name in names:
+        knob = KNOBS[name]
+        if explicit.get(name) is not None:
+            res.rows[name] = {
+                "value": explicit[name], "source": "flag",
+                "evidence": [], "reason": "explicit configuration"}
+            continue
+        vk = vknobs.get(name)
+        if isinstance(vk, dict) and _valid(knob, vk.get("value")):
+            res.rows[name] = {
+                "value": vk["value"],
+                # A verdict row that merely restates the registry
+                # default carries no evidence — report it as such.
+                "source": ("verdict" if vk.get("source") == "evidence"
+                           else "default"),
+                "evidence": list(vk.get("evidence") or []),
+                "reason": str(vk.get("reason", ""))}
+        else:
+            res.rows[name] = {
+                "value": knob.default, "source": "default", "evidence": [],
+                "reason": ("autotune disabled" if not enabled()
+                           else "no verdict for this knob")}
+    return res
+
+
+def resolved_value(name: str, default: Any = None,
+                   platform: Optional[str] = None,
+                   device_count: Optional[int] = None) -> Any:
+    """One-knob convenience for leaf consumers (the device-store
+    bridge): verdict value when present and valid, else ``default``
+    (falling back to the registry default when None)."""
+    res = resolve([name], {}, platform=platform, device_count=device_count)
+    row = res.rows[name]
+    if row["source"] in ("verdict",):
+        return row["value"]
+    return KNOBS[name].default if default is None else default
+
+
+# -- observability -----------------------------------------------------------
+
+
+def prom_families(wire: Dict[str, Any], now: float,
+                  ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """``consul_autotune_*`` families from a merged wire payload:
+    (labeled_gauges, labeled_counters) in obs/prom.py family shape."""
+    rows = wire.get("knobs") or {}
+    info_rows, value_rows = [], []
+    for name in sorted(rows):
+        row = rows[name]
+        info_rows.append((
+            {"knob": name, "value": str(row.get("value")),
+             "source": str(row.get("source", "default"))}, 1.0))
+        value = row.get("value")
+        if isinstance(value, bool):
+            value_rows.append(({"knob": name}, 1.0 if value else 0.0))
+        elif isinstance(value, (int, float)):
+            value_rows.append(({"knob": name}, float(value)))
+    epoch = float(wire.get("evidence_epoch_unix") or 0.0)
+    age = (now - epoch) if epoch > 0 else -1.0
+    gauges = [
+        {"name": "consul_autotune_knob_info",
+         "help": "Resolved autotune knobs: value + resolution source "
+                 "(flag | verdict | default).",
+         "rows": info_rows or [({"knob": "none", "value": "",
+                                 "source": "default"}, 0.0)]},
+        {"name": "consul_autotune_knob_value",
+         "help": "Resolved numeric knob values (bool as 0/1; "
+                 "string-valued knobs appear only in knob_info).",
+         "rows": value_rows or [({"knob": "none"}, 0.0)]},
+        {"name": "consul_autotune_evidence_age_seconds",
+         "help": "Age of the newest evidence behind the persisted "
+                 "verdict (-1 = no evidence-backed verdict).",
+         "rows": [({}, round(age, 3))]},
+    ]
+    counters = [
+        {"name": "consul_autotune_resettles_total",
+         "help": "Fingerprint-change re-settles since process start.",
+         "rows": [({}, float(wire.get("resettles", 0)))]},
+    ]
+    return gauges, counters

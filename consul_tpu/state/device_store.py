@@ -303,6 +303,13 @@ class DeviceKVTable:
 # device matcher is taken unconditionally.
 WATCH_DEVICE_MIN_CPU = 1 << 16
 
+# Knobs this module resolves through the autotune verdict — the
+# consumer-side claim for the ``autotune-knob`` vet group
+# (tools/vet/table_drift.py): the constant above is only the fallback;
+# a measured crossover (tools/watchstorm.py --sweep, settled by
+# obs/tuner.py) replaces it per platform.
+TUNED_FIELDS = ("watch_device_min",)
+
 
 class DeviceStoreBridge:
     """Glue between the host store/FSM and the device twin.
@@ -339,6 +346,17 @@ class DeviceStoreBridge:
         self.max_batch = int(max_batch)
         self.match_backend = match_backend
         self._platform = jax.default_backend()
+        # CPU break-even for the "auto" matcher: the measured crossover
+        # from the persisted autotune verdict when one exists
+        # (obs/tuner.py "watch_device_min"), else the constant above.
+        try:
+            from consul_tpu.obs import tuner
+            self._min_cpu = int(tuner.resolved_value(
+                "watch_device_min", default=WATCH_DEVICE_MIN_CPU,
+                platform=self._platform,
+                device_count=len(jax.devices())))
+        except Exception:  # noqa: E02 — tuning is advisory, never fatal
+            self._min_cpu = WATCH_DEVICE_MIN_CPU
         self._match = _build_match(jnp, lax, jax, self.lmax)
         if stats is None:
             from consul_tpu.obs import storestats
@@ -503,7 +521,8 @@ class DeviceStoreBridge:
 
         "auto" picks the device matcher off-CPU, or on CPU once the
         standing-watch population is large enough that the O(W x B)
-        evaluation dominates dispatch overhead (WATCH_DEVICE_MIN_CPU;
+        evaluation dominates dispatch overhead (the verdict-resolved
+        ``watch_device_min`` crossover, WATCH_DEVICE_MIN_CPU fallback;
         BENCH_WATCH.json medians).  Below that, the host radix walk —
         which runs anyway as the authoritative path — is strictly
         cheaper and the device leg is skipped entirely."""
@@ -511,7 +530,7 @@ class DeviceStoreBridge:
             return self.match_backend == "device"
         if self._platform != "cpu":
             return True
-        return len(self._w_groups) >= WATCH_DEVICE_MIN_CPU
+        return len(self._w_groups) >= self._min_cpu
 
     def _fire_watches(self, cap, store) -> None:
         """Device bitmask ∪ host walk → NotifyGroup firing + prune."""

@@ -29,6 +29,17 @@ try:  # jax was already imported by the interpreter-start hook
 except Exception:
     pass
 
+# Hermetic autotune: a developer's `make tune` verdict next to the
+# compile cache must not leak knob values into unit boots.  Point the
+# verdict dir at an empty per-run temp dir (no verdict => registry
+# defaults, no re-settle writes outside it); tests that exercise the
+# verdict path override this themselves via monkeypatch.
+import tempfile
+
+os.environ.setdefault(
+    "CONSUL_TPU_AUTOTUNE_DIR",
+    tempfile.mkdtemp(prefix="consul_tpu_autotune_test_"))
+
 # -- per-test watchdog -------------------------------------------------------
 # One hung test must not eat the whole suite (round-1 failure: a single
 # deadlocked RPC test blocked the run for the full pool timeout).

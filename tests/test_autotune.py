@@ -1,0 +1,444 @@
+"""Autotune control plane (obs/tuner.py): evidence admission, decision
+rules, verdict determinism, resolution precedence, fingerprint
+re-settles, and a plane boot that resolves its kernel knobs from a
+persisted verdict on the CPU mesh.
+
+The verdict directory is a per-session temp dir (tests/conftest.py sets
+CONSUL_TPU_AUTOTUNE_DIR) so a developer's real ``make tune`` verdict
+never leaks into these boots; tests that need a private dir repoint
+the env var at their own tmp_path.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from consul_tpu.obs import tuner
+from consul_tpu.obs.tuner import Evidence, EvidenceTable
+
+CPU_FP = {"platform": "cpu", "device_count": 8, "jax": "0.0.test"}
+
+
+def _rps(tail, value, platform="", stamp=100.0):
+    return Evidence(f"bench.rps.{tail}", value, "test", platform, stamp)
+
+
+def _baseline_rows(stamp=100.0):
+    """A small admissible evidence set where swar wins the dissemination
+    A/B by >2% and one-device sharding wins the ladder."""
+    return [
+        _rps("swim_gossip_rounds_per_sec_4096_nodes", 120.0, stamp=stamp),
+        _rps("swim_gossip_rounds_per_sec_4096_nodes_planes", 90.0,
+             stamp=stamp),
+        _rps("swim_gossip_rounds_per_sec_4096_nodes_shard4", 80.0,
+             stamp=stamp),
+    ]
+
+
+@pytest.fixture
+def autotune_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("CONSUL_TPU_AUTOTUNE_DIR", str(tmp_path))
+    return tmp_path
+
+
+# -- evidence admission ------------------------------------------------------
+
+
+class TestEvidenceTable:
+    def test_foreign_platform_rejected_both_directions(self):
+        rows = [Evidence("k1", 1.0, "s", "axon", 10.0),
+                Evidence("k2", 2.0, "s", "cpu", 10.0),
+                Evidence("k3", 3.0, "s", "", 10.0)]
+        cpu = EvidenceTable(rows, "cpu")
+        assert set(cpu.rows) == {"k2", "k3"}
+        assert [why for _, why in cpu.rejected] == ["foreign-platform"]
+        chip = EvidenceTable(rows, "axon")
+        assert set(chip.rows) == {"k1", "k3"}
+
+    def test_chip_platforms_are_one_class(self):
+        rows = [Evidence("k", 1.0, "s", "axon", 10.0)]
+        assert "k" in EvidenceTable(rows, "tpu").rows
+
+    def test_stale_vs_epoch_rejected(self):
+        fresh = Evidence("fresh", 1.0, "s", "", 1e9)
+        stale = Evidence("old", 2.0, "s", "",
+                         1e9 - tuner.MAX_EVIDENCE_AGE_S - 1)
+        table = EvidenceTable([fresh, stale], "cpu")
+        assert "fresh" in table.rows and "old" not in table.rows
+        assert [why for _, why in table.rejected] == ["stale"]
+
+    def test_duplicate_keys_newest_wins(self):
+        rows = [Evidence("k", 1.0, "a", "", 10.0),
+                Evidence("k", 2.0, "b", "", 20.0)]
+        assert EvidenceTable(rows, "cpu").value("k") == 2.0
+        assert EvidenceTable(list(reversed(rows)), "cpu").value("k") == 2.0
+
+
+# -- decision rules ----------------------------------------------------------
+
+
+class TestRules:
+    def _table(self, rows):
+        return EvidenceTable(rows, "cpu")
+
+    def test_dissem_needs_two_strategies(self):
+        t = self._table([_rps("swim_gossip_rounds_per_sec_4096_nodes",
+                              100.0)])
+        assert tuner._rule_dissem(t, CPU_FP) is None
+
+    def test_dissem_argmax_with_clear_win(self):
+        t = self._table([
+            _rps("swim_gossip_rounds_per_sec_4096_nodes", 100.0),
+            _rps("swim_gossip_rounds_per_sec_4096_nodes_fused", 110.0)])
+        value, used, _reason = tuner._rule_dissem(t, CPU_FP)
+        assert value == "fused" and len(used) == 2
+
+    def test_dissem_within_noise_ties_to_swar(self):
+        t = self._table([
+            _rps("swim_gossip_rounds_per_sec_4096_nodes", 100.0),
+            _rps("swim_gossip_rounds_per_sec_4096_nodes_fused", 101.0)])
+        value, _used, _reason = tuner._rule_dissem(t, CPU_FP)
+        assert value == "swar"
+
+    def test_hot_slots_threshold(self):
+        mk = lambda h, v: _rps(  # noqa: E731
+            f"swim_gossip_rounds_per_sec_2000_nodes_churn10ppm_hot{h}"
+            if h else "swim_gossip_rounds_per_sec_2000_nodes_churn10ppm",
+            v)
+        value, _, _ = tuner._rule_hot_slots(
+            self._table([mk(0, 100.0), mk(8, 110.0)]), CPU_FP)
+        assert value == 8
+        value, _, _ = tuner._rule_hot_slots(
+            self._table([mk(0, 100.0), mk(8, 101.0)]), CPU_FP)
+        assert value == 0
+
+    def test_shard_ladder_argmax(self):
+        t = self._table(_baseline_rows())
+        value, _, _ = tuner._rule_shard_devices(t, CPU_FP)
+        assert value == 1
+
+    def test_flight_drain_overhead(self):
+        mk = lambda flight, v: _rps(  # noqa: E731
+            "swim_gossip_rounds_per_sec_2000_nodes_churn0ppm"
+            + ("_flight" if flight else ""), v)
+        value, _, _ = tuner._rule_flight_drain_every(
+            self._table([mk(False, 100.0), mk(True, 90.0)]), CPU_FP)
+        assert value == 32  # 10% overhead -> halve the cadence
+        value, _, _ = tuner._rule_flight_drain_every(
+            self._table([mk(False, 100.0), mk(True, 99.0)]), CPU_FP)
+        assert value == 16
+
+    def test_http_workers_argmax(self):
+        rows = [Evidence("serve.kv_get_rps.workers1", 4000.0, "s", "", 1.0),
+                Evidence("serve.kv_get_rps.workers4", 5000.0, "s", "", 1.0)]
+        value, _, _ = tuner._rule_http_workers(self._table(rows), CPU_FP)
+        assert value == 4
+
+    def test_device_store_by_platform_class(self):
+        on, _, _ = tuner._rule_device_store(self._table([]), CPU_FP)
+        assert on is False
+        on, _, _ = tuner._rule_device_store(
+            self._table([]), {"platform": "axon", "device_count": 8})
+        assert on is True
+
+    def test_watch_device_min_prefers_measured_crossover(self):
+        rows = [Evidence("watch.crossover_watches", 40000, "s", "", 1.0),
+                Evidence("watch.sweep_max", 65536, "s", "", 1.0)]
+        value, used, _ = tuner._rule_watch_device_min(
+            self._table(rows), CPU_FP)
+        assert value == 40000 and used == ["watch.crossover_watches"]
+
+    def test_watch_device_min_floors_above_sweep_cap(self):
+        rows = [Evidence("watch.sweep_max", 65536, "s", "", 1.0)]
+        value, _, _ = tuner._rule_watch_device_min(self._table(rows), CPU_FP)
+        assert value == max(tuner.DEFAULT_WATCH_DEVICE_MIN, 2 * 65536)
+        assert tuner._rule_watch_device_min(self._table([]), CPU_FP) is None
+
+    def test_lease_floor_detectability(self):
+        mk = lambda s, det: Evidence(  # noqa: E731
+            f"chaos.detected.{s}", det, "s", "", 1.0)
+        all_det = [mk(s, True) for s in ("clock_skew", "clock_jump",
+                                         "fsync_stall")]
+        value, _, _ = tuner._rule_lease_timeout_floor(
+            self._table(all_det), CPU_FP)
+        assert value == 0.0
+        one_miss = all_det[:2] + [mk("fsync_stall", False)]
+        value, _, reason = tuner._rule_lease_timeout_floor(
+            self._table(one_miss), CPU_FP)
+        assert value == -1.0 and "fsync_stall" in reason
+        assert tuner._rule_lease_timeout_floor(
+            self._table([]), CPU_FP) is None
+
+
+# -- settle determinism + verdict hygiene ------------------------------------
+
+
+class TestSettle:
+    def test_settle_is_byte_deterministic(self):
+        rows = _baseline_rows()
+        a = tuner.settle(rows, CPU_FP)
+        b = tuner.settle(list(reversed(rows)), CPU_FP)
+        assert tuner.verdict_bytes(a) == tuner.verdict_bytes(b)
+
+    def test_settle_covers_whole_registry(self):
+        verdict = tuner.settle([], CPU_FP)
+        assert set(verdict["knobs"]) == set(tuner.KNOBS)
+        assert verdict["format"] == tuner.VERDICT_FORMAT
+        for name, row in verdict["knobs"].items():
+            if name == "device_store":
+                # decided from the fingerprint itself, never starved
+                assert row["source"] == "evidence"
+                assert row["evidence"] == ["fingerprint.platform"]
+            else:
+                assert row["source"] == "default"
+
+    def test_settle_records_rejections(self):
+        rows = _baseline_rows() + [
+            Evidence("bench.rps.swim_gossip_rounds_per_sec_8_nodes",
+                     1.0, "s", "axon", 100.0)]
+        verdict = tuner.settle(rows, CPU_FP)
+        assert any("foreign-platform" in r
+                   for r in verdict["rejected_rows"])
+
+    def test_one_bad_rule_degrades_to_default(self, monkeypatch):
+        knob = tuner.KNOBS["dissem"]
+        def boom(table, fp):
+            raise RuntimeError("rule crashed")
+        monkeypatch.setitem(
+            tuner.KNOBS, "dissem",
+            tuner.Knob(default=knob.default, kind=knob.kind,
+                       choices=knob.choices, target=knob.target,
+                       rule=boom, evidence=knob.evidence, doc=knob.doc))
+        verdict = tuner.settle(_baseline_rows(), CPU_FP)
+        assert verdict["knobs"]["dissem"]["source"] == "default"
+        # the other rules still ran
+        assert verdict["knobs"]["shard_devices"]["source"] == "evidence"
+
+    def test_valid_domain_checks(self):
+        assert tuner._valid(tuner.KNOBS["dissem"], "swar")
+        assert not tuner._valid(tuner.KNOBS["dissem"], "florp")
+        assert not tuner._valid(tuner.KNOBS["dissem"], 3)
+        assert tuner._valid(tuner.KNOBS["hot_slots"], 8)
+        assert not tuner._valid(tuner.KNOBS["hot_slots"], True)
+        assert not tuner._valid(tuner.KNOBS["hot_slots"], "8")
+        assert tuner._valid(tuner.KNOBS["device_store"], False)
+        assert not tuner._valid(tuner.KNOBS["device_store"], 1)
+        assert tuner._valid(tuner.KNOBS["lease_timeout_floor_s"], -1.0)
+
+
+# -- persistence + resolution precedence -------------------------------------
+
+
+class TestResolve:
+    def _persist(self, fp=None, rows=None):
+        # The REAL fingerprint for cpu x8 (conftest mesh): a fake jax
+        # version would mismatch at resolve() and trigger a re-settle.
+        verdict = tuner.settle(
+            _baseline_rows() if rows is None else rows,
+            fp or tuner.fingerprint("cpu", 8))
+        path = tuner.save_verdict(verdict)
+        assert path is not None
+        return verdict, path
+
+    def test_save_load_roundtrip(self, autotune_dir):
+        verdict, path = self._persist()
+        assert os.path.dirname(path) == str(autotune_dir)
+        assert tuner.load_verdict("cpu") == verdict
+
+    def test_flag_beats_verdict_beats_default(self, autotune_dir):
+        self._persist()
+        res = tuner.resolve(
+            ["dissem", "shard_devices", "unroll"],
+            {"dissem": "planes"},
+            platform="cpu", device_count=8)
+        assert res.rows["dissem"] == {
+            "value": "planes", "source": "flag", "evidence": [],
+            "reason": "explicit configuration"}
+        # evidence-backed verdict row resolves as "verdict"
+        assert res.rows["shard_devices"]["source"] == "verdict"
+        assert res.rows["shard_devices"]["value"] == 1
+        # default-restating verdict row reports "default"
+        assert res.rows["unroll"]["source"] == "default"
+        assert res.rows["unroll"]["value"] == tuner.KNOBS["unroll"].default
+        assert res.meta["verdict_found"] is True
+
+    def test_invalid_verdict_value_degrades_to_default(self, autotune_dir):
+        verdict, path = self._persist()
+        verdict["knobs"]["shard_devices"]["value"] = "four"
+        with open(path, "wb") as f:
+            f.write(tuner.verdict_bytes(verdict))
+        res = tuner.resolve(["shard_devices"], {},
+                            platform="cpu", device_count=8)
+        assert res.rows["shard_devices"]["source"] == "default"
+        assert res.rows["shard_devices"]["value"] == 1
+
+    def test_corrupt_verdict_file_degrades_to_default(self, autotune_dir):
+        path = tuner.verdict_path("cpu")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("{not json")
+        res = tuner.resolve(["dissem"], {}, platform="cpu", device_count=8)
+        assert res.rows["dissem"]["source"] == "default"
+        assert res.meta["verdict_found"] is False
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"format": 999, "knobs": {}}, f)
+        res = tuner.resolve(["dissem"], {}, platform="cpu", device_count=8)
+        assert res.meta["verdict_found"] is False
+
+    def test_kill_switch_ignores_verdict(self, autotune_dir, monkeypatch):
+        self._persist()
+        monkeypatch.setenv("CONSUL_TPU_AUTOTUNE", "0")
+        res = tuner.resolve(["shard_devices"], {},
+                            platform="cpu", device_count=8)
+        assert res.rows["shard_devices"]["source"] == "default"
+        assert res.rows["shard_devices"]["reason"] == "autotune disabled"
+        assert res.meta["autotune_enabled"] is False
+        # flags still win under the kill switch
+        res = tuner.resolve(["shard_devices"], {"shard_devices": 2},
+                            platform="cpu", device_count=8)
+        assert res.rows["shard_devices"]["source"] == "flag"
+
+    def test_fingerprint_change_resettles(self, autotune_dir, tmp_path):
+        # persist a verdict for a DIFFERENT topology of the same
+        # platform; resolving on this one must re-settle and re-persist
+        fp_old = tuner.fingerprint("cpu", 2)
+        self._persist(fp=fp_old)
+        before = tuner.resettles()
+        empty_root = tmp_path / "no-artifacts"
+        empty_root.mkdir()
+        res = tuner.resolve(["dissem"], {}, platform="cpu",
+                            device_count=8, root=str(empty_root))
+        assert tuner.resettles() == before + 1
+        new = tuner.load_verdict("cpu")
+        assert new["fingerprint"] == res.meta["fingerprint"]
+        assert new["fingerprint"]["device_count"] == 8
+        # no artifacts behind the re-settle -> all defaults
+        assert res.rows["dissem"]["source"] == "default"
+
+    def test_matching_fingerprint_does_not_resettle(self, autotune_dir):
+        _, path = self._persist(fp=tuner.fingerprint("cpu", 8))
+        before = tuner.resettles()
+        res = tuner.resolve(["shard_devices"], {},
+                            platform="cpu", device_count=8)
+        assert tuner.resettles() == before
+        assert res.rows["shard_devices"]["source"] == "verdict"
+
+    def test_resolved_value_only_trusts_evidence(self, autotune_dir):
+        self._persist(rows=[
+            Evidence("watch.crossover_watches", 40000, "s", "", 1.0)])
+        got = tuner.resolved_value("watch_device_min", default=12345,
+                                   platform="cpu", device_count=8)
+        assert got == 40000
+        # default-restating verdict rows fall back to the caller's value
+        assert tuner.resolved_value("unroll", default=7, platform="cpu",
+                                    device_count=8) == 7
+
+
+# -- prometheus families -----------------------------------------------------
+
+
+class TestPromFamilies:
+    def test_family_shape(self, autotune_dir):
+        verdict = tuner.settle(_baseline_rows(), tuner.fingerprint("cpu", 8))
+        tuner.save_verdict(verdict)
+        res = tuner.resolve(list(tuner.KNOBS), {},
+                            platform="cpu", device_count=8)
+        gauges, counters = tuner.prom_families(res.wire(), now=200.0)
+        by_name = {f["name"]: f for f in gauges + counters}
+        assert set(by_name) == {
+            "consul_autotune_knob_info", "consul_autotune_knob_value",
+            "consul_autotune_evidence_age_seconds",
+            "consul_autotune_resettles_total"}
+        info = by_name["consul_autotune_knob_info"]["rows"]
+        assert {labels["knob"] for labels, _ in info} == set(tuner.KNOBS)
+        assert all(labels["source"] in ("flag", "verdict", "default")
+                   for labels, _ in info)
+        value_rows = dict(
+            (labels["knob"], v) for labels, v in
+            by_name["consul_autotune_knob_value"]["rows"])
+        assert "dissem" not in value_rows      # string-valued: info only
+        assert value_rows["device_store"] in (0.0, 1.0)
+        assert value_rows["shard_devices"] == 1.0
+        (_, age), = by_name["consul_autotune_evidence_age_seconds"]["rows"]
+        assert age == pytest.approx(200.0 - verdict["evidence_epoch_unix"])
+
+    def test_evidence_age_without_verdict(self):
+        gauges, _ = tuner.prom_families({"knobs": {}}, now=50.0)
+        by_name = {f["name"]: f for f in gauges}
+        (_, age), = by_name["consul_autotune_evidence_age_seconds"]["rows"]
+        assert age == -1.0
+
+    def test_families_render_clean(self, autotune_dir):
+        from consul_tpu.obs.prom import render_prometheus
+        from tools.check_prom import check_text
+        res = tuner.resolve(list(tuner.KNOBS), {},
+                            platform="cpu", device_count=8)
+        gauges, counters = tuner.prom_families(res.wire(), now=10.0)
+        text = render_prometheus([], labeled_gauges=gauges,
+                                 labeled_counters=counters)
+        assert check_text(text) == []
+
+
+# -- boot-with-verdict on the CPU mesh ---------------------------------------
+
+
+class TestPlaneBoot:
+    def _settle_for_this_backend(self):
+        """A verdict whose fingerprint matches THIS process (the
+        conftest 8-device CPU mesh), with evidence-backed dissem/shard
+        rows that restate safe values."""
+        fp = tuner.fingerprint()
+        verdict = tuner.settle(_baseline_rows(), fp)
+        assert verdict["knobs"]["dissem"]["source"] == "evidence"
+        assert tuner.save_verdict(verdict) is not None
+        return verdict
+
+    @pytest.mark.timeout_s(120)
+    def test_plane_boots_with_verdict_sources(self, autotune_dir):
+        from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+        self._settle_for_this_backend()
+
+        async def body():
+            plane = GossipPlane(PlaneConfig(
+                bind_port=0, capacity=16, slots=16,
+                gossip_interval_s=0.02, suspicion_mult=1.0,
+                hb_lapse_s=0.3))
+            await plane.start()
+            try:
+                rows = plane._autotune.rows
+                assert rows["dissem"]["source"] == "verdict"
+                assert rows["dissem"]["value"] == "swar"
+                assert rows["shard_devices"]["source"] == "verdict"
+                assert plane._p.dissem == "swar"
+                assert plane._ndev == 1
+                # knobs without evidence rode the registry defaults
+                assert rows["unroll"]["source"] == "default"
+                assert plane._unroll == tuner.KNOBS["unroll"].default
+                frame = plane._autotune_wire()
+                assert frame["t"] == "autotune"
+                assert frame["verdict_found"] is True
+            finally:
+                await plane.stop()
+
+        asyncio.run(body())
+
+    @pytest.mark.timeout_s(120)
+    def test_explicit_config_beats_verdict(self, autotune_dir):
+        from consul_tpu.gossip.plane import GossipPlane, PlaneConfig
+        self._settle_for_this_backend()
+
+        async def body():
+            plane = GossipPlane(PlaneConfig(
+                bind_port=0, capacity=16, slots=16,
+                gossip_interval_s=0.02, suspicion_mult=1.0,
+                hb_lapse_s=0.3, dissem="planes"))
+            await plane.start()
+            try:
+                row = plane._autotune.rows["dissem"]
+                assert row["source"] == "flag"
+                assert plane._p.dissem == "planes"
+            finally:
+                await plane.stop()
+
+        asyncio.run(body())

@@ -1439,6 +1439,102 @@ class TestTableDrift:
         assert [f for f in table_drift.check_project(ctxs)
                 if f.code == "K01"] == []
 
+    # -- union groups (the autotune-knob registry) ---------------------------
+
+    _KNOBS_GOV = """\
+        KNOBS = {"dissem": 1, "hot_slots": 2, "http_workers": 3,
+                 "watch_device_min": 4}
+        """
+
+    # device_store.py is also the match-backend group's governing file,
+    # so its fixture must carry that membership idiom or the group
+    # fires "governing not found" at the fixture copy.
+    _STORE_PREAMBLE = (
+        'def pick(match_backend):\n'
+        '    if match_backend not in ("auto", "device", "host"):\n'
+        '        raise ValueError(match_backend)\n')
+
+    def _union_ctxs(self, tmp_path, plane=None, agent=None, store=None):
+        ctxs = [_ctx(tmp_path, "consul_tpu/obs/tuner.py",
+                     self._KNOBS_GOV)]
+        for relpath, fields in (
+                ("consul_tpu/gossip/plane.py", plane),
+                ("consul_tpu/agent/agent.py", agent),
+                ("consul_tpu/state/device_store.py", store)):
+            if fields is not None:
+                body = f"TUNED_FIELDS = {fields!r}\n"
+                if relpath.endswith("device_store.py"):
+                    body = self._STORE_PREAMBLE + body
+                ctxs.append(_ctx(tmp_path, relpath, body))
+        return ctxs
+
+    def test_union_group_synced_is_clean(self, tmp_path):
+        ctxs = self._union_ctxs(
+            tmp_path, plane=("dissem", "hot_slots"),
+            agent=("http_workers",), store=("watch_device_min",))
+        assert table_drift.check_project(ctxs) == []
+
+    def test_union_satellite_extra_key_fires(self, tmp_path):
+        # a consumer claiming a knob the registry doesn't define
+        ctxs = self._union_ctxs(
+            tmp_path, plane=("dissem", "hot_slots", "florp"),
+            agent=("http_workers",), store=("watch_device_min",))
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K01"]
+        assert found and "florp" in found[0].message
+
+    def test_union_unclaimed_knob_fires(self, tmp_path):
+        # a registry knob no consumer resolves — dead tuning surface
+        ctxs = self._union_ctxs(
+            tmp_path, plane=("dissem", "hot_slots"),
+            agent=("http_workers",), store=("hot_slots",))
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K01"]
+        assert found and "watch_device_min" in found[0].message
+
+    def test_union_subset_run_skips_completeness(self, tmp_path):
+        # with a satellite file absent (unit fixtures, --changed) the
+        # union-coverage check must not false-fire; subset claims are
+        # still validated
+        ctxs = self._union_ctxs(tmp_path, plane=("dissem", "hot_slots"))
+        assert table_drift.check_project(ctxs) == []
+
+    def test_union_group_skips_stray_literals(self, tmp_path):
+        # K02 is about dispatched keywords; knob names are registry
+        # keys, so a stray knob="..." kwarg is not the same contract
+        ctxs = self._union_ctxs(
+            tmp_path, plane=("dissem", "hot_slots"),
+            agent=("http_workers",), store=("watch_device_min",))
+        ctxs.append(_ctx(tmp_path, "caller.py", """\
+            def f(g):
+                return g(knob="florp")
+            """))
+        assert table_drift.check_project(ctxs) == []
+
+    def test_union_desynced_copy_of_real_sources_fires(self, tmp_path):
+        """Union K01 meta-test over copies of the REAL tuner registry
+        and consumer TUNED_FIELDS tuples — pins that the extractors
+        still parse the production idiom."""
+        srcs = {p: (REPO / p).read_text() for p in (
+            "consul_tpu/obs/tuner.py",
+            "consul_tpu/gossip/plane.py",
+            "consul_tpu/agent/agent.py",
+            "consul_tpu/state/device_store.py")}
+        plane_src = srcs["consul_tpu/gossip/plane.py"]
+        assert 'TUNED_FIELDS = ("dissem", ' in plane_src
+        desynced = dict(srcs)
+        desynced["consul_tpu/gossip/plane.py"] = plane_src.replace(
+            'TUNED_FIELDS = ("dissem", ', 'TUNED_FIELDS = (', 1)
+        ctxs = [_ctx(tmp_path, p, src) for p, src in desynced.items()]
+        found = [f for f in table_drift.check_project(ctxs)
+                 if f.code == "K01"]
+        assert found and "dissem" in found[0].message
+        # and the unmodified copies are in sync (the live contract)
+        ctxs = [_ctx(tmp_path, "sync/" + p, src)
+                for p, src in srcs.items()]
+        assert [f for f in table_drift.check_project(ctxs)
+                if f.code == "K01"] == []
+
 
 # -- fork-safety (R01-R02) ---------------------------------------------------
 

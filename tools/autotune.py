@@ -1,0 +1,87 @@
+"""Offline autotune settle: observatory artifacts -> persisted verdict.
+
+The closing of the observability loop (obs/tuner.py): this CLI gathers
+every evidence artifact the checkout holds — the bench regime cache
+(``.bench_last_success.json`` / ``BENCH_r*.json``), the watch-storm
+A/B + crossover sweep (``BENCH_WATCH.json``), the serving-plane
+microbench (``BENCH_SERVE.json``), and the chaos campaign report
+(``CHAOS.json``) — settles the knob registry against them, and
+persists the per-platform verdict next to the XLA compile cache
+(``~/.cache/consul_tpu_jax_cache/autotune/verdict-<platform>.json``,
+or ``$CONSUL_TPU_AUTOTUNE_DIR``).
+
+Planes and agents pick the verdict up at boot with explicit flag >
+persisted verdict > registry default resolution, and re-settle it
+automatically when the backend fingerprint (platform x topology x jax
+version) changes.
+
+Run (the `make tune` target):
+    python -m tools.autotune
+Offline/CI (no jax import; fingerprint supplied by hand):
+    python -m tools.autotune --platform cpu --devices 8 --out TUNE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from consul_tpu.obs import tuner  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--platform", default="",
+                    help="settle for this backend platform without "
+                         "importing jax (offline/CI mode)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="device count for the fingerprint (only with "
+                         "--platform; default 1)")
+    ap.add_argument("--repo", default=REPO,
+                    help="artifact root to gather evidence from")
+    ap.add_argument("--out", default="",
+                    help="write the verdict here instead of the "
+                         "per-platform file next to the compile cache")
+    ap.add_argument("--print", dest="print_verdict", action="store_true",
+                    help="dump the full verdict JSON to stdout")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        fp = tuner.fingerprint(args.platform, args.devices or 1)
+    else:
+        # Imports jax: the verdict is scoped to the backend that will
+        # consume it.
+        fp = tuner.fingerprint()
+
+    rows = tuner.gather_evidence(args.repo)
+    verdict = tuner.settle(rows, fp)
+
+    print(f"[autotune] fingerprint: {fp['platform']} "
+          f"x{fp['device_count']} jax {fp['jax']}")
+    print(f"[autotune] evidence: {verdict['evidence_rows']} admissible "
+          f"row(s), {len(verdict['rejected_rows'])} rejected "
+          f"(stale/foreign-platform)")
+    for name in sorted(verdict["knobs"]):
+        row = verdict["knobs"][name]
+        print(f"[autotune]   {name:<22} = {row['value']!r:<10} "
+              f"[{row['source']}] {row['reason']}")
+
+    if args.print_verdict:
+        sys.stdout.write(tuner.verdict_bytes(verdict).decode())
+
+    path = tuner.save_verdict(verdict, args.out or None)
+    if path is None:
+        print("[autotune] WARNING: verdict not persisted "
+              "(cache dir unwritable); boot resolution will re-settle",
+              file=sys.stderr)
+        return 1
+    print(f"[autotune] wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

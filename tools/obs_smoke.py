@@ -14,6 +14,15 @@ checks the device/kernel observatory (obs/devstats.py): the
 ``consul_build_info``/``consul_up`` in the scrape, the
 ``/v1/agent/device`` JSON twin, and the bundle's ``device/`` member.
 
+The deep boot also exercises the autotune control plane (obs/tuner.py)
+end to end: a verdict is pre-settled into a throwaway
+``CONSUL_TPU_AUTOTUNE_DIR`` before the plane boots, so the boot must
+resolve its knobs from it (source ``verdict`` for every
+evidence-backed row), report the whole registry at
+``/v1/operator/autotune``, carry the strict ``consul_autotune_*``
+families in the scrape, and ship ``autotune/verdict.json`` in the
+debug bundle.
+
 A second boot runs the plane under a live nemesis scenario
 (``PlaneConfig(nemesis="block_kill")``, gossip/nemesis.py) and holds
 the scrape to the scenario-labeled contract: labeled histogram series
@@ -78,9 +87,18 @@ REQUIRED_RAFT = [
     "consul_consistent_reads_total",
 ]
 
+# Autotune observatory families (obs/tuner.py prom_families) — the
+# knob resolution must be scrapeable on every agent.
+REQUIRED_AUTOTUNE = [
+    "consul_autotune_knob_info",
+    "consul_autotune_knob_value",
+    "consul_autotune_evidence_age_seconds",
+    "consul_autotune_resettles_total",
+]
+
 # Bundle manifest sections the acceptance contract names.
 REQUIRED_SECTIONS = {"metrics", "slo", "traces", "flight", "raft",
-                     "device", "tasks"}
+                     "device", "autotune", "tasks"}
 
 # Device state-store observatory families (obs/storestats.py), present
 # on the third boot (device_store=True) after a little KV traffic with
@@ -161,7 +179,9 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
             _get, f"{base}/v1/agent/slo"))
         device = json.loads(await asyncio.to_thread(
             _get, f"{base}/v1/agent/device"))
-        return text, slo, telemetry, bundle, device
+        autotune = json.loads(await asyncio.to_thread(
+            _get, f"{base}/v1/operator/autotune"))
+        return text, slo, telemetry, bundle, device, autotune
     finally:
         if agent is not None:
             await agent.stop()
@@ -242,7 +262,8 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
             errors.append(f"bundle manifest missing sections {sorted(missing)}")
         for want in ("metrics/prometheus.txt", "metrics/snapshot_start.json",
                      "metrics/snapshot_end.json", "raft/telemetry.json",
-                     "device/telemetry.json", "tasks.txt", "config.json",
+                     "device/telemetry.json", "autotune/verdict.json",
+                     "tasks.txt", "config.json",
                      "slo.json", "traces.json", "flight.json"):
             if want not in names:
                 errors.append(f"bundle missing file {want}")
@@ -257,6 +278,11 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
             dt = json.load(tar.extractfile("device/telemetry.json"))
             if "enabled" not in dt:
                 errors.append("bundled device telemetry has no 'enabled'")
+        if "autotune/verdict.json" in names:
+            at = json.load(tar.extractfile("autotune/verdict.json"))
+            for key in ("knobs", "fingerprint"):
+                if key not in at:
+                    errors.append(f"bundled autotune verdict has no {key!r}")
         if "config.json" in names:
             cfg = json.load(tar.extractfile("config.json"))
             for k in ("encrypt", "acl_master_token", "acl_token"):
@@ -265,19 +291,66 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
 
 
 async def main() -> int:
+    import tempfile
+
+    from consul_tpu.obs import tuner
     from tools.check_prom import _iter_series, _require_ok, check_text
 
     errors = []
 
+    # Pre-settle an autotune verdict into a throwaway dir (hermetic:
+    # never the developer's real cache) so the boots below exercise the
+    # persisted-verdict resolution path, not just registry defaults.
+    os.environ["CONSUL_TPU_AUTOTUNE_DIR"] = tempfile.mkdtemp(
+        prefix="obs_smoke_autotune_")
+    verdict = tuner.settle(tuner.gather_evidence(REPO), tuner.fingerprint())
+    vpath = tuner.save_verdict(verdict)
+    print(f"[obs-smoke] pre-settled autotune verdict "
+          f"({verdict['evidence_rows']} evidence rows) at {vpath}",
+          flush=True)
+
     print("[obs-smoke] starting plane (first boot compiles the kernel)...",
           flush=True)
-    text, slo, telemetry, bundle, device = await _boot_and_scrape(deep=True)
+    text, slo, telemetry, bundle, device, autotune = \
+        await _boot_and_scrape(deep=True)
     errors += check_text(text)
     series = list(_iter_series(text))
     names = {n for n, _ in series}
-    for want in REQUIRED + REQUIRED_RAFT + REQUIRED_DEVICE:
+    for want in REQUIRED + REQUIRED_RAFT + REQUIRED_DEVICE + REQUIRED_AUTOTUNE:
         if want not in names:
             errors.append(f"required metric {want} not in scrape")
+    # Autotune observatory: the route must cover the whole registry
+    # with well-formed rows, the boot must have found the pre-settled
+    # verdict, and every evidence-backed verdict row must have resolved
+    # with source "verdict" (flag > verdict > default, nothing set).
+    aknobs = autotune.get("knobs") or {}
+    missing_knobs = set(tuner.KNOBS) - set(aknobs)
+    if missing_knobs:
+        errors.append(f"/v1/operator/autotune missing knobs "
+                      f"{sorted(missing_knobs)}")
+    for kname in sorted(aknobs):
+        row = aknobs[kname]
+        for key in ("value", "source", "evidence", "reason"):
+            if key not in row:
+                errors.append(f"autotune knob {kname} row missing {key!r}")
+        if row.get("source") not in ("flag", "verdict", "default"):
+            errors.append(f"autotune knob {kname} has source "
+                          f"{row.get('source')!r}")
+    if not isinstance(autotune.get("fingerprint"), dict):
+        errors.append("/v1/operator/autotune missing fingerprint")
+    if not autotune.get("verdict_found"):
+        errors.append("boot did not pick up the pre-settled verdict")
+    for kname, vrow in sorted(verdict["knobs"].items()):
+        if vrow["source"] != "evidence":
+            continue
+        got = (aknobs.get(kname) or {}).get("source")
+        if got != "verdict":
+            errors.append(f"knob {kname} is evidence-backed in the "
+                          f"verdict but booted with source {got!r}")
+    if not _require_ok('consul_autotune_knob_info{knob="dissem"}',
+                       series, errors):
+        errors.append('scrape missing consul_autotune_knob_info'
+                      '{knob="dissem"}')
     # Device observatory JSON twin: the bridge `device` frame rendered
     # at /v1/agent/device, plus the agent's build row.
     if not device.get("enabled"):
@@ -321,7 +394,7 @@ async def main() -> int:
     # detection fires.
     print(f"[obs-smoke] rebooting plane under nemesis={NEMESIS!r} "
           "(new static schedule recompiles)...", flush=True)
-    ntext, nslo, _, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
+    ntext, nslo, _, _, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
     nerrors = check_text(ntext)
     for fam in REQUIRED[:4]:
         want = fam + f'{{scenario="{NEMESIS}"}}'

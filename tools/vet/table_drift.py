@@ -18,6 +18,16 @@ The repo dispatches two planes on string keys today:
   ``consul_tpu/obs/storestats.py`` (which documents the legs an
   operator can see on a scrape).
 
+One UNION group guards the autotune registry (``union=True``): the
+``KNOBS`` dict in ``consul_tpu/obs/tuner.py`` governs, and every
+consumer claims the knobs it applies in a module-level
+``TUNED_FIELDS`` tuple (gossip/plane.py, agent/agent.py,
+state/device_store.py).  Each claim must be a subset of the registry
+(a claimed-but-unregistered knob resolves to nothing), and with every
+consumer present the union must cover the registry exactly — a knob
+added anywhere without tuner coverage, or registered without a
+consumer, fails ``make vet``.
+
 Codes:
 
 - **K01 key-set divergence**: a satellite table's keys differ from the
@@ -83,11 +93,15 @@ def extract_membership(ctx: FileCtx, keyword: str
 
 def extract_dict_keys(ctx: FileCtx, varname: str
                       ) -> Optional[Tuple[Set[str], int]]:
-    """Module-level ``VARNAME = {"key": ..., ...}``."""
+    """Module-level ``VARNAME = {"key": ..., ...}`` (annotated or not)."""
     for node in ctx.tree.body:
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and node.targets[0].id == varname \
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if isinstance(target, ast.Name) and target.id == varname \
                 and isinstance(node.value, ast.Dict):
             keys = set()
             for k in node.value.keys:
@@ -138,10 +152,25 @@ def extract_help_mentions(ctx: FileCtx, gauge: str
     return None
 
 
+def extract_str_tuple_var(ctx: FileCtx, varname: str
+                          ) -> Optional[Tuple[Set[str], int]]:
+    """Module-level ``VARNAME = ("a", "b", ...)`` string tuple/list —
+    the TUNED_FIELDS consumer-claim idiom."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == varname:
+            keys = _str_tuple(node.value)
+            if keys is not None:
+                return keys, node.lineno
+    return None
+
+
 _EXTRACTORS = {
     "membership": extract_membership,
     "dict_keys": extract_dict_keys,
     "argparse_choices": extract_argparse_choices,
+    "str_tuple_var": extract_str_tuple_var,
 }
 
 
@@ -166,6 +195,11 @@ class TableGroup:
     # keys legitimately absent from prose-mention satellites (e.g.
     # "auto" resolves to device/host before the gauge reports)
     mention_exempt: Sequence[str] = field(default_factory=tuple)
+    # Union semantics: each satellite claims a SUBSET of the governing
+    # set, and — when every registered satellite is present — their
+    # union must cover it exactly (the autotune-knob group).  K02 does
+    # not apply (the keys are knob names, not a dispatched keyword).
+    union: bool = False
 
 
 GROUPS: Sequence[TableGroup] = (
@@ -180,6 +214,10 @@ GROUPS: Sequence[TableGroup] = (
             TableRef("bench.py", "argparse_choices", "--dissem"),
             TableRef("tools/profile_kernel.py",
                      "argparse_choices", "--dissem"),
+            TableRef("consul_tpu/cli/main.py",
+                     "argparse_choices", "-dissem"),
+            TableRef("consul_tpu/obs/tuner.py",
+                     "str_tuple_var", "DISSEM_CHOICES"),
         ),
     ),
     TableGroup(
@@ -192,6 +230,21 @@ GROUPS: Sequence[TableGroup] = (
                      "dict_keys", "CATALOG"),
             TableRef("tools/chaos_campaign.py",
                      "argparse_choices", "--scenario"),
+        ),
+    ),
+    TableGroup(
+        name="autotune-knob",
+        keyword="knob",
+        union=True,
+        governing=TableRef("consul_tpu/obs/tuner.py",
+                           "dict_keys", "KNOBS"),
+        satellites=(
+            TableRef("consul_tpu/gossip/plane.py",
+                     "str_tuple_var", "TUNED_FIELDS"),
+            TableRef("consul_tpu/agent/agent.py",
+                     "str_tuple_var", "TUNED_FIELDS"),
+            TableRef("consul_tpu/state/device_store.py",
+                     "str_tuple_var", "TUNED_FIELDS"),
         ),
     ),
     TableGroup(
@@ -235,6 +288,10 @@ def _check_group(ctxs: Sequence[FileCtx], group: TableGroup,
             "update tools/vet/table_drift.py GROUPS alongside it"))
         return None
     gov_keys, _gov_line = got
+
+    if group.union:
+        _check_union(ctxs, group, gov_keys, gctx, out)
+        return gov_keys, gctx.path, _gov_line
 
     for sat in group.satellites:
         sctx = _find_ctx(ctxs, sat.suffix)
@@ -287,6 +344,52 @@ def _check_group(ctxs: Sequence[FileCtx], group: TableGroup,
                 f"{group.keyword!r} set in {group.governing.suffix}: "
                 + ", ".join(detail)))
     return gov_keys, gctx.path, _gov_line
+
+
+def _check_union(ctxs: Sequence[FileCtx], group: TableGroup,
+                 gov_keys: Set[str], gctx: FileCtx,
+                 out: List[Finding]) -> None:
+    """Union semantics (the autotune-knob group): every satellite's
+    claim must be a subset of the governing registry, and — when all
+    registered satellites are present — the union must cover the
+    registry exactly."""
+    claimed: Set[str] = set()
+    all_present = True
+    for sat in group.satellites:
+        sctx = _find_ctx(ctxs, sat.suffix)
+        if sctx is None:
+            all_present = False   # subset run: skip completeness below
+            continue
+        extractor = _EXTRACTORS[sat.kind]
+        got = extractor(sctx, sat.arg)
+        if got is None:
+            out.append(Finding(
+                sctx.path, 1, KEYSET_DIVERGE,
+                f"satellite table ({sat.kind}: {sat.arg}) not found "
+                f"but registered against the {group.keyword!r} "
+                "governing set — update tools/vet/table_drift.py "
+                "GROUPS alongside it"))
+            all_present = False
+            continue
+        sat_keys, line = got
+        extra = sorted(sat_keys - gov_keys)
+        if extra:
+            out.append(Finding(
+                sctx.path, line, KEYSET_DIVERGE,
+                f"{sat.kind}:{sat.arg} claims {group.keyword}(s) "
+                f"{extra} absent from the governing registry in "
+                f"{group.governing.suffix} — the claim resolves to "
+                "nothing at boot"))
+        claimed |= sat_keys
+    if all_present:
+        unclaimed = sorted(gov_keys - claimed)
+        if unclaimed:
+            out.append(Finding(
+                gctx.path, 1, KEYSET_DIVERGE,
+                f"governing {group.keyword!r} registry key(s) "
+                f"{unclaimed} are claimed by no consumer TUNED_FIELDS "
+                "— a registered knob nothing applies is dead "
+                "configuration"))
 
 
 def _check_strays(ctxs: Sequence[FileCtx], group: TableGroup,
@@ -352,7 +455,7 @@ def check_project(ctxs: List[FileCtx],
     out: List[Finding] = []
     for group in groups:
         gov = _check_group(ctxs, group, out)
-        if gov is not None:
+        if gov is not None and not group.union:
             _check_strays(ctxs, group, gov, out)
     return sorted(set(out), key=lambda f: (f.path, f.line, f.code,
                                            f.message))

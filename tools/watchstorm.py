@@ -33,6 +33,9 @@ Run (the `make bench-watch` target):
     python -m tools.watchstorm --watches 10000
 Storm tiers (slow, gated behind explicit opt-in):
     python -m tools.watchstorm --watches 10000,100000,1000000
+Crossover sweep (replaces the WATCH_DEVICE_MIN_CPU guess with a
+measurement; consumed by obs/tuner.py as ``watch_device_min``):
+    python -m tools.watchstorm --sweep
 """
 
 from __future__ import annotations
@@ -160,6 +163,54 @@ def run_tier(n_watches: int, batch: int, n_batches: int, trials: int,
     }
 
 
+def _sweep(lo: int, hi: int, batch: int, n_batches: int, trials: int,
+           seed: int) -> dict:
+    """Host-vs-device crossover search: geometric climb from ``lo``
+    (doubling) until the device pass first beats the host walk, then
+    bisect the bracketing interval.  Every measured tier is recorded so
+    the evidence behind the verdict stays auditable.  ``crossover``
+    stays null when the device never wins below ``hi`` — the tuner then
+    floors ``watch_device_min`` above the sweep cap instead of
+    pretending it measured a break-even."""
+    tiers = []
+
+    def wins(w: int) -> bool:
+        r = run_tier(w, batch, n_batches, trials, seed)
+        tiers.append(r)
+        side = ("device" if r["device_ms_per_batch"]
+                <= r["host_ms_per_batch"] else "host")
+        print(f"[watchstorm]   sweep W={w}: host "
+              f"{r['host_ms_per_batch']}ms device "
+              f"{r['device_ms_per_batch']}ms/batch -> {side}", flush=True)
+        return side == "device"
+
+    first_win, prev = None, None
+    w = lo
+    while w <= hi:
+        if wins(w):
+            first_win = w
+            break
+        prev = w
+        w *= 2
+    cross = None
+    if first_win is not None:
+        cross = first_win
+        if prev is not None:
+            # Bisect (prev, first_win]; stop once the bracket is within
+            # ~12% (or 1024 watches) — crossover precision beyond that
+            # is noise on a shared host.
+            lo_w, hi_w = prev, first_win
+            while hi_w - lo_w > max(lo_w // 8, 1024):
+                mid = (lo_w + hi_w) // 2
+                if wins(mid):
+                    hi_w = mid
+                else:
+                    lo_w = mid
+            cross = hi_w
+    return {"lo": lo, "hi": hi, "crossover_watches": cross,
+            "tiers": tiers}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--watches", default="10000",
@@ -169,6 +220,14 @@ def main(argv=None) -> int:
     ap.add_argument("--batches", type=int, default=20)
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sweep", action="store_true",
+                    help="also binary-search the host-vs-device "
+                         "crossover and record it (tuner evidence)")
+    ap.add_argument("--sweep-lo", type=int, default=8192,
+                    help="sweep start watch count (doubles upward)")
+    ap.add_argument("--sweep-max", type=int, default=65536,
+                    help="sweep cap; no device win below it records "
+                         "crossover null")
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_WATCH.json"))
     args = ap.parse_args(argv)
 
@@ -191,6 +250,17 @@ def main(argv=None) -> int:
         "device_count": jax.device_count(),
         "tiers": results,
     }
+    if args.sweep:
+        print(f"[watchstorm] crossover sweep {args.sweep_lo}.."
+              f"{args.sweep_max}...", flush=True)
+        sweep = _sweep(args.sweep_lo, args.sweep_max, args.events,
+                       args.batches, args.trials, args.seed)
+        cross = sweep["crossover_watches"]
+        print(f"[watchstorm]   crossover: "
+              + (f"{cross} watches" if cross is not None
+                 else f"none below {args.sweep_max} (host wins the "
+                      "whole sweep)"), flush=True)
+        out["sweep"] = sweep
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
