@@ -33,26 +33,31 @@ test: vet
 integ:
 	$(PYTEST) tests/test_blackbox.py tests/test_linearizability.py
 
-# Static checks: byte-compile every source file, then the twelve-pass
+# Static checks: byte-compile every source file, then the fourteen-pass
 # analyzer (tools/vet/: names, async-safety, JAX tracer-purity,
 # wire-schema drift, exception hygiene, donation safety,
 # shard-exactness, carry-contract, overflow, pallas-safety,
-# table-drift, fork-safety — the `go vet` role in an image without a
-# Python linter).  Exit codes: 0 clean, 1 findings, 2 parse error.
-# Suppress per line with `# noqa: CODE[,CODE]` or per finding in
-# tools/vet/baseline.txt.  `vet` writes the machine-readable
-# vet_report.json CI artifact (incl. per-pass wall times; the driver
-# prints the slowest pass); `vet-fast` skips the flow-sensitive JAX
-# passes for the inner loop; `vet-diff` vets only git-touched files
-# plus their cross-file partners (same exit-code contract) for
-# pre-commit; `vet-dyn` runs the dynamic sanitizer harness
-# (tools/vet/dyn.py: debug_nans + asyncio debug + warnings-as-errors
-# + fd/thread/task leak audit over the fast tier-1 slice, then a
-# checkify smoke of one dissemination round per strategy).
+# table-drift, fork-safety, interleave, role-transition — the `go vet`
+# role in an image without a Python linter).  Exit codes: 0 clean,
+# 1 findings, 2 parse error or time-guard trip.  Suppress per line
+# with `# noqa: CODE[,CODE]` or per finding in tools/vet/baseline.txt.
+# `vet` writes the machine-readable vet_report.json CI artifact (incl.
+# per-pass wall times; the driver prints the slowest passes) and arms
+# --time-guard: exit 2 when total analyzer time exceeds 1.5x the
+# previously recorded report's total, naming the two slowest passes;
+# `vet-fast` skips the flow-sensitive JAX passes for the inner loop;
+# `vet-diff` vets only git-touched files plus their cross-file
+# partners (same exit-code contract) for pre-commit; `vet-dyn` runs
+# the dynamic sanitizer harness (tools/vet/dyn.py: debug_nans +
+# asyncio debug + warnings-as-errors + fd/thread/task leak audit over
+# the fast tier-1 slice, a forced-interleave re-run of the
+# lease/barrier + anti-entropy slices with a task switch at every
+# await, then a checkify smoke of one dissemination round per
+# strategy).
 VET_PATHS = consul_tpu tests tools demo bench.py __graft_entry__.py
 vet:
 	$(PYTHON) -m compileall -q $(VET_PATHS)
-	$(PYTHON) -m tools.vet $(VET_PATHS) --report vet_report.json
+	$(PYTHON) -m tools.vet $(VET_PATHS) --report vet_report.json --time-guard
 	JAX_PLATFORMS=cpu $(PYTHON) -m tools.store_crossval --fast
 	JAX_PLATFORMS=cpu $(PYTHON) -m tools.fused_crossval --fast
 	$(MAKE) obs-smoke

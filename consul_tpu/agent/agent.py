@@ -497,12 +497,15 @@ class Agent:
             # "reaped" and respawned mid-shutdown.
             self._worker_supervisor.cancel()
             self._worker_supervisor = None
-        if self.worker_pool is not None:
+        # Claim the pool before the first await: a concurrent stop()
+        # (signal handler racing a test teardown) must see None, not a
+        # half-stopped pool it would try to stop again.
+        pool, self.worker_pool = self.worker_pool, None
+        if pool is not None:
             # Workers first (by tracked PID), then their gateway — a
             # worker mid-request sees a clean connection close, not a
             # half-up master.
-            await self.worker_pool.stop()
-            self.worker_pool = None
+            await pool.stop()
         if self._worker_gateway is not None:
             await self._worker_gateway.stop()
             gw_path = self._worker_gateway.unix_path

@@ -227,7 +227,11 @@ class LocalState:
             except Exception:
                 raftstats.aestats.failure("service_deregister")
                 raise
-            self._deregister_services.discard(sid)
+            # An intent re-added during the await targets the same
+            # catalog entry this in-flight call just removed (deregister
+            # is idempotent; a concurrent re-register only syncs later in
+            # this same coroutine), so consuming it is safe.
+            self._deregister_services.discard(sid)  # noqa: X01
         for cid in list(self._deregister_checks):
             try:
                 await self.agent.catalog_deregister(DeregisterRequest(
@@ -236,27 +240,43 @@ class LocalState:
             except Exception:
                 raftstats.aestats.failure("check_deregister")
                 raise
-            self._deregister_checks.discard(cid)
+            self._deregister_checks.discard(cid)  # noqa: X01 — same as above
 
         for sid, in_sync in list(self._service_sync.items()):
             if in_sync or sid not in self.services:
                 continue
+            service = self.services[sid]
             try:
                 await self.agent.catalog_register(RegisterRequest(
-                    node=node, address=addr, service=self.services[sid],
+                    node=node, address=addr, service=service,
                     token=self.service_tokens.get(sid, "")))
             except Exception:
                 raftstats.aestats.failure("service_register")
                 raise
-            self._service_sync[sid] = True
+            # The register round-trip is a scheduling point: add_service()
+            # may have swapped in a newer definition while it was in
+            # flight.  Marking THAT synced would silently drop the update
+            # until the next full anti-entropy pass (up to ae_scale
+            # minutes) — only the definition we actually pushed counts.
+            if self.services.get(sid) is service:
+                self._service_sync[sid] = True
         for cid, in_sync in list(self._check_sync.items()):
             if in_sync or cid not in self.checks:
                 continue
+            check = self.checks[cid]
+            pushed = (check.status, check.output)
             try:
                 await self.agent.catalog_register(RegisterRequest(
-                    node=node, address=addr, check=self.checks[cid],
+                    node=node, address=addr, check=check,
                     token=self.check_tokens.get(cid, "")))
             except Exception:
                 raftstats.aestats.failure("check_register")
                 raise
-            self._check_sync[cid] = True
+            # update_check() mutates the check object in place, so the
+            # identity test alone cannot see a status flip that landed
+            # during the await — compare the pushed (status, output) too,
+            # or a check that went critical mid-register would read
+            # "passing" in the catalog until the next full pass.
+            if self.checks.get(cid) is check and (check.status,
+                                                  check.output) == pushed:
+                self._check_sync[cid] = True

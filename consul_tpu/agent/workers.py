@@ -316,9 +316,12 @@ class WorkerFront:
 
     async def close(self) -> None:
         await self.gw.close()
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        # Swap-then-close: a second close() arriving while this one is
+        # suspended in session.close() must see None, not a session
+        # mid-teardown.
+        session, self._session = self._session, None
+        if session is not None:
+            await session.close()
 
 
 class WorkerPool:

@@ -232,13 +232,15 @@ class _LeaseMonitors:
             await asyncio.sleep(0.004)
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        # Swap-then-cancel: overlapping stop() calls would otherwise
+        # both await the same task and both try to null it afterwards.
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
             try:
-                await self._task
+                await task
             except asyncio.CancelledError:
                 pass
-            self._task = None
 
 
 # ---------------------------------------------------------------------------

@@ -586,10 +586,7 @@ class RaftNode:
             pass
 
     async def _start_election(self) -> None:
-        self.role = CANDIDATE
-        self.current_term += 1
-        self.voted_for = self.id
-        self._persist_term()
+        self._become_candidate()
         term = self.current_term
         if self.obs is not None:
             self.obs.note_election(term)
@@ -623,6 +620,17 @@ class RaftNode:
 
     def _quorum(self) -> int:
         return len(self.peers) // 2 + 1
+
+    def _become_candidate(self) -> None:
+        """The candidate transition ritual: bump the term, vote for
+        self, persist BOTH before any RPC leaves (Raft §5.1 — a vote
+        that does not survive a restart can be cast twice), and drop
+        any lease state a prior leadership left behind."""
+        self.role = CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.id
+        self._persist_term()
+        self._lease_ack = {}  # a candidate holds no lease
 
     def _become_leader(self) -> None:
         self.role = LEADER
@@ -666,6 +674,11 @@ class RaftNode:
             self.voted_for = None
             self._persist_term()
         self.role = FOLLOWER
+        # Deposed-leader-never-serves: drop the lease acks HERE, not
+        # just in _stop_leading — the role loop runs _stop_leading a
+        # scheduling turn later, and a lease_valid() caller in between
+        # must not count a dead quorum as fresh.
+        self._lease_ack = {}
         if leader is not None:
             if self.obs is not None and leader != self.leader_id:
                 self.obs.note_new_leader(self.current_term, leader)

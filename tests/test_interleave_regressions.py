@@ -1,0 +1,219 @@
+"""Regression tests for the interleaving races the X/T vet passes
+surfaced (tools/vet/interleave.py, tools/vet/role_transition.py).
+
+Each test pins one production fix:
+
+- anti-entropy lost update (agent/local.py sync_changes): a service or
+  check mutated while its register RPC is in flight must stay marked
+  out-of-sync, or the newer definition silently waits a full ae_scale
+  interval.
+- deposed-leader-never-serves (consensus/raft.py): both transition
+  helpers must drop ``_lease_ack`` so a lease_valid() caller scheduled
+  between the role flip and ``_stop_leading`` cannot count a dead
+  quorum as fresh.
+- swap-then-act teardown (agent/workers.py, tools/bench_serve.py):
+  two concurrent close() calls suspended at the same await must not
+  both act on the one shared handle.
+
+The mutations here are injected synchronously from inside the awaited
+stub — the exact schedule the forced-interleave dyn leg
+(CONSUL_TPU_DYN_INTERLEAVE=1) produces at every await point, made
+deterministic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from consul_tpu.agent.local import LocalState
+from consul_tpu.agent.workers import WorkerFront
+from consul_tpu.consensus.raft import (
+    CANDIDATE, FOLLOWER, MemoryTransport, RaftNode)
+from consul_tpu.structs.structs import HealthCheck, NodeService
+
+from tools.bench_serve import KeepAliveConn
+
+
+class StubCatalogAgent:
+    """The minimal agent surface LocalState syncs against, with an
+    injection hook that fires inside the register await — i.e. while
+    sync_changes() is suspended."""
+
+    node_name = "n1"
+    advertise_addr = "127.0.0.1"
+
+    def __init__(self):
+        self.registered = []
+        self.on_register = None
+
+    def cluster_size(self):
+        return 1
+
+    async def catalog_node_services(self, node):
+        return {}
+
+    async def catalog_node_checks(self, node):
+        return []
+
+    async def catalog_deregister(self, req):
+        pass
+
+    async def catalog_register(self, req):
+        self.registered.append(req)
+        if self.on_register is not None:
+            hook, self.on_register = self.on_register, None
+            hook()
+
+
+# -- anti-entropy lost update (agent/local.py) -------------------------------
+
+
+def test_service_replaced_mid_register_stays_out_of_sync():
+    async def run():
+        agent = StubCatalogAgent()
+        ls = LocalState(agent)
+        ls.add_service(NodeService(id="web", service="web", port=80))
+        newer = NodeService(id="web", service="web", port=81)
+        agent.on_register = lambda: ls.add_service(newer)
+
+        await ls.sync_changes()
+        # The pass pushed port 80; the port-81 definition landed during
+        # the await and must NOT be marked synced by it.
+        assert ls._service_sync["web"] is False
+        assert ls.pending_ops() == 1
+
+        await ls.sync_changes()
+        assert ls._service_sync["web"] is True
+        assert agent.registered[-1].service.port == 81
+
+    asyncio.run(run())
+
+
+def test_check_flip_mid_register_stays_out_of_sync():
+    async def run():
+        agent = StubCatalogAgent()
+        ls = LocalState(agent)
+        ls.add_check(HealthCheck(check_id="c1", name="ping",
+                                 status="passing"))
+        # update_check mutates the check IN PLACE, so an identity test
+        # alone cannot catch this — the (status, output) pair must.
+        agent.on_register = lambda: ls.update_check("c1", "critical",
+                                                    "conn refused")
+
+        await ls.sync_changes()
+        assert ls._check_sync["c1"] is False
+
+        await ls.sync_changes()
+        assert ls._check_sync["c1"] is True
+        assert agent.registered[-1].check.status == "critical"
+
+    asyncio.run(run())
+
+
+def test_unchanged_entries_marked_synced_in_one_pass():
+    # The guard must not over-fire: with no concurrent mutation a
+    # single pass converges.
+    async def run():
+        agent = StubCatalogAgent()
+        ls = LocalState(agent)
+        ls.add_service(NodeService(id="web", service="web", port=80))
+        ls.add_check(HealthCheck(check_id="c1", name="ping",
+                                 status="passing"))
+        await ls.sync_changes()
+        assert ls._service_sync["web"] is True
+        assert ls._check_sync["c1"] is True
+        assert ls.pending_ops() == 0
+
+    asyncio.run(run())
+
+
+# -- deposed-leader-never-serves (consensus/raft.py) -------------------------
+
+
+def _node(peers=("s0", "s1", "s2")):
+    return RaftNode("s0", list(peers), fsm=None,
+                    transport=MemoryTransport())
+
+
+def test_become_candidate_drops_stale_lease():
+    async def run():
+        node = _node()
+        node._lease_ack = {"s1": 123.0, "s2": 124.0}
+        node._become_candidate()
+        assert node._lease_ack == {}
+        assert node.role == CANDIDATE
+        assert node.current_term == 1
+        assert node.voted_for == "s0"
+        # the vote must survive a restart (Raft §5.1)
+        assert node.log.get_stable("term", 0) == 1
+        assert node.log.get_stable("voted_for", None) == "s0"
+
+    asyncio.run(run())
+
+
+def test_become_follower_drops_lease_before_stop_leading():
+    async def run():
+        node = _node()
+        node._lease_ack = {"s1": 123.0, "s2": 124.0}
+        node._become_follower(5, "s1")
+        # cleared HERE, not a scheduling turn later in _stop_leading —
+        # a lease check interleaved between the two must see nothing.
+        assert node._lease_ack == {}
+        assert node.role == FOLLOWER
+        assert node.current_term == 5
+        assert node.leader_id == "s1"
+
+    asyncio.run(run())
+
+
+# -- swap-then-act teardown --------------------------------------------------
+
+
+class _CountingWriter:
+    def __init__(self):
+        self.closed = 0
+
+    def close(self):
+        self.closed += 1
+
+    async def wait_closed(self):
+        await asyncio.sleep(0)   # a real suspension point
+
+
+class _CountingSession:
+    def __init__(self):
+        self.closed = 0
+
+    async def close(self):
+        self.closed += 1
+        await asyncio.sleep(0)
+
+
+class _NullGateway:
+    async def close(self):
+        await asyncio.sleep(0)
+
+
+def test_bench_conn_concurrent_close_closes_once():
+    async def run():
+        conn = KeepAliveConn("127.0.0.1", 1)
+        writer = _CountingWriter()
+        conn.writer = writer
+        await asyncio.gather(conn.close(), conn.close())
+        assert writer.closed == 1
+        assert conn.writer is None
+
+    asyncio.run(run())
+
+
+def test_worker_front_concurrent_close_closes_session_once():
+    async def run():
+        front = object.__new__(WorkerFront)   # skip the network setup
+        front.gw = _NullGateway()
+        front._session = _CountingSession()
+        session = front._session
+        await asyncio.gather(front.close(), front.close())
+        assert session.closed == 1
+        assert front._session is None
+
+    asyncio.run(run())

@@ -17,14 +17,16 @@ from pathlib import Path
 import pytest
 
 from tools.vet import async_safety, carry_contract, donation, exceptions
-from tools.vet import fork_safety, names, overflow, pallas_safety
-from tools.vet import shard_exact, table_drift, tracer_purity
-from tools.vet import wire_schema
+from tools.vet import fork_safety, interleave, names, overflow
+from tools.vet import pallas_safety, role_transition, shard_exact
+from tools.vet import table_drift, tracer_purity, wire_schema
 from tools.vet import dyn as vet_dyn
 from tools.vet.core import FileCtx, parse_noqa
-from tools.vet.driver import changed_paths, expand_partners
+from tools.vet.driver import ROLE_TRANSITION_GROUP, changed_paths
+from tools.vet.driver import expand_partners
 from tools.vet.driver import main as vet_main
-from tools.vet.driver import run_vet
+from tools.vet.driver import prior_total_ms, run_vet, slowest_passes
+from tools.vet.driver import time_guard_exceeded
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -1018,10 +1020,12 @@ class TestSuppression:
         base = tmp_path / "baseline.txt"
         base.write_text("gone.py|D01|old donation finding\n"
                         "gone.py|S02|old scatter finding\n"
-                        "gone.py|O01|old overflow finding\n")
+                        "gone.py|O01|old overflow finding\n"
+                        "gone.py|X01|old interleave finding\n"
+                        "gone.py|T02|old lease-leak finding\n")
         result = run_vet([str(p)], baseline_path=base)
         assert sorted(k.split("|")[1] for k in result.stale_baseline) \
-            == ["D01", "O01", "S02"]
+            == ["D01", "O01", "S02", "T02", "X01"]
         assert result.rc == 0  # stale entries warn, they don't fail
 
     def test_write_baseline_roundtrip(self, tmp_path):
@@ -1624,6 +1628,382 @@ class TestForkSafety:
 # -- driver: --changed, per-pass timings, stale listing ----------------------
 
 
+# -- interleave (X01-X04) ----------------------------------------------------
+
+
+class TestInterleave:
+    def test_x01_branch_rmw_across_await(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Plane:
+                def __init__(self):
+                    self.pending = {}
+                    self.net = None
+
+                def peek(self):
+                    return self.pending
+
+                async def flush(self, key):
+                    if key in self.pending:
+                        await self.net.send(key)
+                        self.pending.pop(key)
+            """)
+        found = interleave.check(ctx)
+        assert _codes(found) == ["X01"]
+        assert "every other coroutine may run" in found[0].message
+
+    def test_x01_clean_when_revalidated_after_await(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Plane:
+                def __init__(self):
+                    self.pending = {}
+                    self.net = None
+
+                def peek(self):
+                    return self.pending
+
+                async def flush(self, key):
+                    if key in self.pending:
+                        await self.net.send(key)
+                        if key in self.pending:
+                            self.pending.pop(key)
+            """)
+        assert interleave.check(ctx) == []
+
+    def test_x01_rmw_expression_with_inline_await(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Counter:
+                def __init__(self):
+                    self.count = 0
+                    self.net = None
+
+                def snapshot(self):
+                    return self.count
+
+                async def bump(self):
+                    self.count = self.count + await self.net.fetch()
+            """)
+        assert "X01" in _codes(interleave.check(ctx))
+
+    def test_x01_clean_when_await_precedes_read(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Counter:
+                def __init__(self):
+                    self.count = 0
+                    self.net = None
+
+                def snapshot(self):
+                    return self.count
+
+                async def bump(self):
+                    delta = await self.net.fetch()
+                    self.count = self.count + delta
+            """)
+        assert interleave.check(ctx) == []
+
+    def test_x01_swap_then_act_teardown_is_clean(self, tmp_path):
+        # The sanctioned teardown idiom: claim the reference
+        # synchronously, then await on the local — nothing shared is
+        # read after the suspension point.
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Agent:
+                def __init__(self):
+                    self.pool = None
+
+                def ready(self):
+                    return self.pool is not None
+
+                async def stop(self):
+                    pool, self.pool = self.pool, None
+                    if pool is not None:
+                        await pool.stop()
+            """)
+        assert interleave.check(ctx) == []
+
+    def test_x02_unguarded_write_to_lock_dominated_field(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Store:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+                    self.items = {}
+
+                async def put(self, k, v):
+                    async with self._lock:
+                        self.items[k] = v
+
+                async def drop(self, k):
+                    async with self._lock:
+                        self.items.pop(k, None)
+
+                async def get(self, k):
+                    async with self._lock:
+                        return self.items.get(k)
+
+                async def reset(self):
+                    self.items = {}
+            """)
+        found = interleave.check(ctx)
+        assert _codes(found) == ["X02"]
+        assert "_lock" in found[0].message
+
+    def test_x02_clean_when_every_write_guarded(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Store:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+                    self.items = {}
+
+                async def put(self, k, v):
+                    async with self._lock:
+                        self.items[k] = v
+
+                async def drop(self, k):
+                    async with self._lock:
+                        self.items.pop(k, None)
+
+                async def get(self, k):
+                    async with self._lock:
+                        return self.items.get(k)
+
+                async def reset(self):
+                    async with self._lock:
+                        self.items = {}
+            """)
+        assert interleave.check(ctx) == []
+
+    def test_x03_reentrant_acquire_via_self_call(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class S:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+
+                async def outer(self):
+                    async with self._lock:
+                        await self.inner()
+
+                async def inner(self):
+                    async with self._lock:
+                        pass
+            """)
+        found = interleave.check(ctx)
+        assert _codes(found) == ["X03"]
+
+    def test_x03_clean_when_callee_does_not_lock(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class S:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+
+                async def outer(self):
+                    async with self._lock:
+                        await self._unlocked()
+
+                async def _unlocked(self):
+                    pass
+            """)
+        assert interleave.check(ctx) == []
+
+    def test_x04_thread_and_coroutine_write_unlocked(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import threading
+
+            class M:
+                def __init__(self):
+                    self.buf = []
+                    self._t = threading.Thread(target=self._pump)
+
+                def _pump(self):
+                    self.buf.append(1)
+
+                async def drain(self):
+                    self.buf = []
+            """)
+        assert "X04" in _codes(interleave.check(ctx))
+
+    def test_x04_clean_when_coroutine_only_reads(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import threading
+
+            class M:
+                def __init__(self):
+                    self.buf = []
+                    self._t = threading.Thread(target=self._pump)
+
+                def _pump(self):
+                    self.buf.append(1)
+
+                async def drain(self):
+                    return len(self.buf)
+            """)
+        assert interleave.check(ctx) == []
+
+    def test_x01_noqa_suppresses(self, tmp_path):
+        src = textwrap.dedent("""\
+            class Plane:
+                def __init__(self):
+                    self.pending = {}
+                    self.net = None
+
+                def peek(self):
+                    return self.pending
+
+                async def flush(self, key):
+                    if key in self.pending:
+                        await self.net.send(key)
+                        self.pending.pop(key)  # noqa: X01
+            """)
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        result = run_vet([str(p)], baseline_path=None)
+        assert "X01" not in _codes(result.findings)
+
+
+# -- role-transition (T01-T02) -----------------------------------------------
+
+
+class TestRoleTransition:
+    def test_t01_out_of_band_term_write(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Raft:
+                def __init__(self):
+                    self.role = "Follower"
+                    self.current_term = 0
+                    self._lease_ack = {}
+
+                def _become_follower(self, term):
+                    self.role = "Follower"
+                    self.current_term = term
+                    self._lease_ack = {}
+
+                async def handle_vote(self, term):
+                    self.current_term = term
+            """)
+        found = role_transition.check(ctx)
+        assert _codes(found) == ["T01"]
+        assert "handle_vote" in found[0].message
+
+    def test_t01_clean_when_routed_through_helper(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Raft:
+                def __init__(self):
+                    self.role = "Follower"
+                    self.current_term = 0
+                    self._lease_ack = {}
+
+                def _become_follower(self, term):
+                    self.role = "Follower"
+                    self.current_term = term
+                    self._lease_ack = {}
+
+                async def handle_vote(self, term):
+                    self._become_follower(term)
+            """)
+        assert role_transition.check(ctx) == []
+
+    def test_t02_helper_keeps_stale_lease(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Raft:
+                def __init__(self):
+                    self.role = "Follower"
+                    self.current_term = 0
+                    self._lease_ack = {}
+
+                def _become_leader(self):
+                    self.role = "Leader"
+            """)
+        found = role_transition.check(ctx)
+        assert _codes(found) == ["T02"]
+        assert "_lease_ack" in found[0].message
+
+    def test_t02_clean_when_helper_resets_lease(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Raft:
+                def __init__(self):
+                    self.role = "Follower"
+                    self.current_term = 0
+                    self._lease_ack = {}
+
+                def _become_leader(self):
+                    self.role = "Leader"
+                    self._lease_ack = {}
+            """)
+        assert role_transition.check(ctx) == []
+
+    def test_classes_without_become_helpers_exempt(self, tmp_path):
+        # role/current_term are common words; only consensus-shaped
+        # classes (ones defining _become_*) are held to the discipline.
+        ctx = _ctx(tmp_path, "m.py", """\
+            class Actor:
+                def __init__(self):
+                    self.role = "extra"
+
+                def promote(self):
+                    self.role = "lead"
+            """)
+        assert role_transition.check(ctx) == []
+
+    def test_real_raft_is_role_transition_clean(self):
+        p = REPO / "consul_tpu" / "consensus" / "raft.py"
+        ctx = FileCtx.load(p, "consul_tpu/consensus/raft.py")
+        assert role_transition.check(ctx) == []
+
+
+# -- time guard (the `make vet` wall-time regression gate) -------------------
+
+
+class TestTimeGuard:
+    def test_prior_total_ms_sums_report(self, tmp_path):
+        r = tmp_path / "vet_report.json"
+        r.write_text(json.dumps(
+            {"per_pass_ms": {"names": 10.0, "donation": 5.5}}))
+        assert prior_total_ms(r) == 15.5
+
+    def test_prior_total_ms_disarms_on_missing_or_bad(self, tmp_path):
+        assert prior_total_ms(tmp_path / "nope.json") == 0.0
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert prior_total_ms(bad) == 0.0
+        nolist = tmp_path / "nolist.json"
+        nolist.write_text(json.dumps({"per_pass_ms": "oops"}))
+        assert prior_total_ms(nolist) == 0.0
+
+    def test_threshold_factor_and_slack(self):
+        assert not time_guard_exceeded(0.0, 99999.0)   # first run: disarmed
+        assert not time_guard_exceeded(1000.0, 1999.0)  # under 1.5x + slack
+        assert time_guard_exceeded(1000.0, 2001.0)
+
+    def test_slowest_passes_ranks(self):
+        top = slowest_passes({"a": 5.0, "b": 20.0, "c": 10.0})
+        assert top == [("b", 20.0), ("c", 10.0)]
+
+    def test_guard_trips_end_to_end(self, tmp_path, capsys, monkeypatch):
+        import tools.vet.driver as driver
+        monkeypatch.setattr(driver, "TIME_GUARD_SLACK_MS", 0.0)
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        report = tmp_path / "vet_report.json"
+        report.write_text(json.dumps({"per_pass_ms": {"names": 0.0001}}))
+        rc = vet_main([str(p), "--no-baseline",
+                       "--report", str(report), "--time-guard"])
+        assert rc == 2
+        assert "time guard" in capsys.readouterr().err
+
+    def test_guard_quiet_without_prior_report(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        report = tmp_path / "vet_report.json"
+        rc = vet_main([str(p), "--no-baseline",
+                       "--report", str(report), "--time-guard"])
+        assert rc == 0    # first run records a baseline, never trips
+
+
 def _git(cwd, *args):
     subprocess.run(["git", *args], cwd=cwd, check=True,
                    capture_output=True)
@@ -1644,6 +2024,14 @@ class TestChangedMode:
         only = expand_partners({"consul_tpu/api/kv.py"},
                                ["consul_tpu/api/kv.py", "bench.py"])
         assert only == {"consul_tpu/api/kv.py"}
+
+    def test_role_transition_partner_group(self):
+        # A touch to the server (or the hotpath that drives lease
+        # reads) must pull the raft core back under the T passes.
+        all_paths = list(ROLE_TRANSITION_GROUP) + ["bench.py"]
+        only = expand_partners({"consul_tpu/server/server.py"}, all_paths)
+        assert set(ROLE_TRANSITION_GROUP) <= only
+        assert "bench.py" not in only
 
     def test_changed_paths_and_only_filter(self, tmp_path, monkeypatch):
         _git(tmp_path, "init", "-q")
@@ -1689,6 +2077,8 @@ class TestPassTimings:
         assert "pallas-safety" in result.per_pass_ms
         assert "table-drift" in result.per_pass_ms
         assert "fork-safety" in result.per_pass_ms
+        assert "interleave" in result.per_pass_ms
+        assert "role-transition" in result.per_pass_ms
 
     def test_per_pass_ms_in_report(self, tmp_path):
         p = tmp_path / "m.py"
@@ -1698,11 +2088,11 @@ class TestPassTimings:
         data = json.loads(report.read_text())
         assert set(data["per_pass_ms"]) == set(data["per_pass"])
 
-    def test_slowest_pass_printed(self, tmp_path, capsys):
+    def test_slowest_passes_printed(self, tmp_path, capsys):
         p = tmp_path / "m.py"
         p.write_text("x = 1\n")
         vet_main([str(p), "--no-baseline"])
-        assert "slowest pass:" in capsys.readouterr().err
+        assert "slowest pass" in capsys.readouterr().err
 
 
 class TestStaleBaselineListing:
@@ -1746,6 +2136,80 @@ class TestDynHarness:
         assert vet_dyn.evaluate_leaks({
             "fd_start": -1, "fd_end": -1,
             "extra_threads": [], "asyncio_errors": []}) == []
+
+    def test_interleave_slice_files_exist(self):
+        for t in vet_dyn.INTERLEAVE_SLICE:
+            assert (REPO / t).is_file(), t
+
+    def test_forced_interleave_switches_at_done_future(self, tmp_path):
+        # With the shim, awaiting an already-done future is a real task
+        # switch: coroutine b runs between a's read and a's write.
+        (tmp_path / "test_forced.py").write_text(textwrap.dedent("""\
+            import asyncio
+
+            def test_switch_at_done_future_await():
+                async def main():
+                    order = []
+
+                    async def a():
+                        fut = asyncio.get_event_loop().create_future()
+                        fut.set_result(1)
+                        order.append("a:pre")
+                        await fut
+                        order.append("a:post")
+
+                    async def b():
+                        order.append("b")
+
+                    await asyncio.gather(a(), b())
+                    return order
+
+                assert asyncio.run(main()) == ["a:pre", "b", "a:post"]
+            """))
+        env = dict(__import__("os").environ)
+        env[vet_dyn.INTERLEAVE_ENV] = "1"
+        env.pop(vet_dyn.NANS_ENV, None)
+        env.pop(vet_dyn.REPORT_ENV, None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", str(tmp_path), "-q",
+             "-p", "tools.vet.dyn", "-p", "no:cacheprovider"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_vanilla_loop_does_not_switch_at_done_future(self, tmp_path):
+        # The negative twin: without the env var the plugin leaves
+        # asyncio alone, and a done-future await completes inline.
+        (tmp_path / "test_vanilla.py").write_text(textwrap.dedent("""\
+            import asyncio
+
+            def test_no_switch_at_done_future_await():
+                async def main():
+                    order = []
+
+                    async def a():
+                        fut = asyncio.get_event_loop().create_future()
+                        fut.set_result(1)
+                        order.append("a:pre")
+                        await fut
+                        order.append("a:post")
+
+                    async def b():
+                        order.append("b")
+
+                    await asyncio.gather(a(), b())
+                    return order
+
+                assert asyncio.run(main()) == ["a:pre", "a:post", "b"]
+            """))
+        env = dict(__import__("os").environ)
+        env.pop(vet_dyn.INTERLEAVE_ENV, None)
+        env.pop(vet_dyn.NANS_ENV, None)
+        env.pop(vet_dyn.REPORT_ENV, None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", str(tmp_path), "-q",
+             "-p", "tools.vet.dyn", "-p", "no:cacheprovider"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
 
     def test_plugin_writes_session_report(self, tmp_path):
         (tmp_path / "test_tiny.py").write_text(

@@ -56,13 +56,15 @@ class KeepAliveConn:
             self.host, self.port)
 
     async def close(self) -> None:
-        if self.writer is not None:
-            self.writer.close()
+        # Swap-then-close so overlapping close() calls cannot both
+        # wait on (then re-null) the same writer.
+        writer, self.writer = self.writer, None
+        if writer is not None:
+            writer.close()
             try:
-                await self.writer.wait_closed()
+                await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
-            self.writer = None
 
     def _frame(self, method: str, path: str, body: bytes | None) -> bytes:
         head = (f"{method} {path} HTTP/1.1\r\n"

@@ -18,9 +18,9 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.vet import (async_safety, carry_contract, donation, exceptions,
-                       fork_safety, names, overflow, pallas_safety,
-                       shard_exact, table_drift, tracer_purity,
-                       wire_schema)
+                       fork_safety, interleave, names, overflow,
+                       pallas_safety, role_transition, shard_exact,
+                       table_drift, tracer_purity, wire_schema)
 from tools.vet.core import (FileCtx, Finding, Pass, collect_files,
                             load_baseline, write_baseline)
 
@@ -48,6 +48,10 @@ PASSES: List[Pass] = [
     Pass("table-drift", codes=("K01", "K02"),
          check_project=table_drift.check_project),
     Pass("fork-safety", codes=("R01", "R02"), check=fork_safety.check),
+    Pass("interleave", codes=("X01", "X02", "X03", "X04"),
+         check=interleave.check),
+    Pass("role-transition", codes=("T01", "T02"),
+         check=role_transition.check),
 ]
 
 # pyvet backwards-compat: the two legacy passes ride in "names"
@@ -56,6 +60,22 @@ LEGACY_PASSES = ("names",)
 # the flow-sensitive JAX-semantics passes: `--fast` (make vet-fast)
 # skips these for inner-loop runs
 FLOW_PASSES = ("donation", "shard-exact", "carry-contract", "overflow")
+
+# role-transition invariant spans the raft core and its lease/read
+# consumers: touching a consumer must re-vet the core (and vice versa)
+ROLE_TRANSITION_GROUP = (
+    "consul_tpu/consensus/raft.py",
+    "consul_tpu/server/server.py",
+    "consul_tpu/agent/hotpath.py",
+)
+
+# `make vet` refuses to let the growing pass count rot the inner loop:
+# total analyzer time above this multiple of the previous recorded run
+# (the vet_report.json artifact) fails the build
+TIME_GUARD_FACTOR = 1.5
+# absolute slack so a near-zero baseline (tiny --changed run recorded
+# by accident) or scheduler jitter cannot flake the guard
+TIME_GUARD_SLACK_MS = 500.0
 
 
 @dataclass
@@ -83,6 +103,7 @@ def partner_groups() -> List[Tuple[str, ...]]:
     for g in table_drift.GROUPS:
         groups.append(tuple([g.governing.suffix]
                             + [s.suffix for s in g.satellites]))
+    groups.append(ROLE_TRANSITION_GROUP)
     return groups
 
 
@@ -207,6 +228,36 @@ def result_to_json(result: VetResult) -> Dict[str, object]:
     }
 
 
+def prior_total_ms(report_path: Path) -> float:
+    """Total analyzer time recorded by the previous run's report
+    artifact, or 0.0 when there is none (first run: guard disarmed)."""
+    try:
+        data = json.loads(report_path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return 0.0
+    per_pass_ms = data.get("per_pass_ms")
+    if not isinstance(per_pass_ms, dict):
+        return 0.0
+    try:
+        return float(sum(per_pass_ms.values()))
+    except TypeError:
+        return 0.0
+
+
+def time_guard_exceeded(prior_ms: float, total_ms: float) -> bool:
+    """True when this run blew the wall-time budget: more than
+    TIME_GUARD_FACTOR × the previous recorded total (plus absolute
+    slack).  A zero/absent baseline disarms the guard."""
+    if prior_ms <= 0.0:
+        return False
+    return total_ms > prior_ms * TIME_GUARD_FACTOR + TIME_GUARD_SLACK_MS
+
+
+def slowest_passes(per_pass_ms: Dict[str, float], n: int = 2
+                   ) -> List[Tuple[str, float]]:
+    return sorted(per_pass_ms.items(), key=lambda kv: -kv[1])[:n]
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.vet",
@@ -238,6 +289,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--report", default=None, metavar="PATH",
                     help="also write the JSON report to PATH "
                          "(the vet_report.json CI artifact)")
+    ap.add_argument("--time-guard", action="store_true",
+                    help="fail (exit 2) when total analyzer time "
+                         f"exceeds {TIME_GUARD_FACTOR}x the previous "
+                         "run recorded at --report, so the pass count "
+                         "can grow without rotting the inner loop")
     args = ap.parse_args(argv)
 
     passes = None
@@ -258,6 +314,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.changed:
         only = changed_paths()
 
+    prior_ms = prior_total_ms(Path(args.report)) \
+        if args.time_guard and args.report else 0.0
+
     result = run_vet(
         args.paths, passes=passes,
         baseline_path=None if args.no_baseline else Path(args.baseline),
@@ -267,9 +326,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         Path(args.report).write_text(
             json.dumps(result_to_json(result), indent=2) + "\n",
             encoding="utf-8")
+    total_ms = sum(result.per_pass_ms.values())
+    guard_tripped = args.time_guard and time_guard_exceeded(prior_ms,
+                                                            total_ms)
+    if guard_tripped:
+        top = ", ".join(f"{name} ({ms:.0f} ms)" for name, ms
+                        in slowest_passes(result.per_pass_ms))
+        print(f"vet: time guard: {total_ms:.0f} ms total exceeds "
+              f"{TIME_GUARD_FACTOR}x the recorded {prior_ms:.0f} ms "
+              f"baseline; slowest passes: {top}", file=sys.stderr)
     if args.format == "json":
         print(json.dumps(result_to_json(result), indent=2))
-        return result.rc
+        return 2 if guard_tripped else result.rc
 
     for f in result.parse_errors + result.findings:
         print(f.render())
@@ -288,18 +356,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     status = "clean" if result.rc == 0 else \
         f"{len(result.findings) + len(result.parse_errors)} finding(s)"
     if result.per_pass_ms:
-        slow_name, slow_ms = max(result.per_pass_ms.items(),
-                                 key=lambda kv: kv[1])
-        total_ms = sum(result.per_pass_ms.values())
-        print(f"vet: slowest pass: {slow_name} ({slow_ms:.0f} ms of "
-              f"{total_ms:.0f} ms total)", file=sys.stderr)
+        top = slowest_passes(result.per_pass_ms)
+        shown = ", ".join(f"{name} ({ms:.0f} ms)" for name, ms in top)
+        print(f"vet: slowest pass{'es' if len(top) > 1 else ''}: "
+              f"{shown} of {total_ms:.0f} ms total", file=sys.stderr)
     print(f"vet: {result.files} files, {status}{tail}", file=sys.stderr)
-    return result.rc
+    return 2 if guard_tripped else result.rc
 
 
 __all__ = ["run_vet", "main", "VetResult", "PASSES", "LEGACY_PASSES",
-           "FLOW_PASSES", "result_to_json", "changed_paths",
-           "expand_partners", "partner_groups"]
+           "FLOW_PASSES", "ROLE_TRANSITION_GROUP", "result_to_json",
+           "changed_paths", "expand_partners", "partner_groups",
+           "prior_total_ms", "time_guard_exceeded", "slowest_passes",
+           "TIME_GUARD_FACTOR", "TIME_GUARD_SLACK_MS"]
 
 if __name__ == "__main__":
     sys.exit(main())

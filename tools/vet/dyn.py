@@ -26,6 +26,15 @@ what only execution shows:
   error set — the dynamic twin of the P03 window-bounds pass
   (an in-kernel offset past the block window surfaces here as a
   checkify OOB error instead of silent wraparound).
+- **forced-interleave leg** (``CONSUL_TPU_DYN_INTERLEAVE=1``): the
+  lease/barrier and anti-entropy slices re-run under a Future shim
+  whose ``__await__`` yields once before delivering even an
+  already-done result, so EVERY await point is a real task switch.
+  Awaits that normal scheduling never suspends at (done futures,
+  uncontended locks) become suspension points, and any
+  read-await-write sequence whose correctness depends on "nothing ran
+  in between" trips its own assertions — the dynamic twin of the
+  static X01 pass.
 
 Dual-role module: ``python -m tools.vet.dyn`` is the runner;
 ``-p tools.vet.dyn`` loads it as the pytest plugin inside the child
@@ -64,6 +73,17 @@ SLICE: Sequence[str] = (
 
 REPORT_ENV = "CONSUL_TPU_DYN_REPORT"
 NANS_ENV = "CONSUL_TPU_DYN_NANS"
+INTERLEAVE_ENV = "CONSUL_TPU_DYN_INTERLEAVE"
+
+# The interleaving-stress slice (the dynamic twin of the static X01
+# pass): the lease/barrier and anti-entropy suites — the paths whose
+# correctness arguments are happens-before arguments — re-run under an
+# event loop that forces a task switch at every await point.
+INTERLEAVE_SLICE: Sequence[str] = (
+    "tests/test_leases.py",
+    "tests/test_confirm_batch.py",
+    "tests/test_agent_checks.py",
+)
 
 # /proc/self/fd churn an interpreter produces on its own (lazy imports,
 # epoll fds, pipes pytest owns) — a real leak in a 100+-test slice is
@@ -74,6 +94,45 @@ FD_SLACK = 32
 # -- plugin role -------------------------------------------------------------
 
 _state: Dict[str, object] = {}
+
+
+def install_forced_interleave() -> None:
+    """Replace ``asyncio.Future`` with a subclass whose ``__await__``
+    yields once unconditionally before the normal protocol.
+
+    ``Task.__step`` treats a bare ``yield None`` as "reschedule me via
+    call_soon", so every ``await`` — including awaits on already-done
+    futures and uncontended locks that vanilla asyncio completes
+    without suspending — becomes a genuine task switch.  That is the
+    maximally hostile (but still legal) scheduler for TOCTOU hunting:
+    any coroutine relying on "no one ran between my read and my write"
+    loses that property at every await point, not just the ones the
+    wall clock happens to contend.
+
+    Patching ``asyncio.futures.Future`` (not instances — the C
+    accelerator class rejects attribute assignment) is sufficient:
+    ``loop.create_future()`` resolves the name at call time, so locks,
+    events, ``sleep``, ``wrap_future`` and friends all mint shimmed
+    futures, and ``Task`` remains untouched (a Task IS a Future; only
+    awaits *on* futures need the extra hop).
+    """
+    import asyncio.futures
+
+    base = asyncio.futures._PyFuture
+
+    class _ForcedSwitchFuture(base):  # type: ignore[valid-type, misc]
+        def __await__(self):
+            yield self._force_marker  # one mandatory trip through the loop
+            return (yield from super().__await__())
+
+        # Task.__step special-cases None: anything else raises. The
+        # class attr documents intent; the value must stay None.
+        _force_marker = None
+
+        __iter__ = __await__
+
+    asyncio.futures.Future = _ForcedSwitchFuture
+    asyncio.Future = _ForcedSwitchFuture
 
 
 def _fd_count() -> int:
@@ -101,6 +160,8 @@ def pytest_configure(config) -> None:
     if os.environ.get(NANS_ENV) == "1":
         import jax
         jax.config.update("jax_debug_nans", True)
+    if os.environ.get(INTERLEAVE_ENV) == "1":
+        install_forced_interleave()
     _state["fd0"] = _fd_count()
     _state["threads0"] = {t.name for t in threading.enumerate()}
     handler = _AsyncioLogCapture()
@@ -242,6 +303,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             problems.append("dyn plugin wrote no session report — the "
                             "run died before teardown")
 
+    # Interleaving-stress leg: only when running the default slice (an
+    # explicit test list means the caller is bisecting one suite).
+    # Asyncio debug mode stays OFF here — the forced switches multiply
+    # callback counts ~10x and debug bookkeeping turns signal to noise;
+    # the oracle for this leg is the tests' own assertions.
+    if not argv:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env[INTERLEAVE_ENV] = "1"
+        cmd = [sys.executable, "-m", "pytest", *INTERLEAVE_SLICE, "-q",
+               "-p", "tools.vet.dyn", "-p", "no:cacheprovider"]
+        print("dyn: forced-interleave slice (task switch at every "
+              "await):", " ".join(INTERLEAVE_SLICE), file=sys.stderr)
+        proc = subprocess.run(cmd, env=env)
+        if proc.returncode != 0:
+            problems.append(
+                f"forced-interleave run failed (rc={proc.returncode}) — "
+                "an await-atomicity assumption broke when every await "
+                "became a real task switch (dynamic twin of vet X01)")
+
     print("dyn: checkify smoke (index+float oracle over one round per "
           "strategy)", file=sys.stderr)
     err = checkify_smoke()
@@ -251,7 +332,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     for p in problems:
         print(f"dyn: FAIL: {p}", file=sys.stderr)
     if not problems:
-        print("dyn: clean (slice + leak audit + checkify)",
+        print("dyn: clean (slice + leak audit + interleave + checkify)",
               file=sys.stderr)
     return 1 if problems else 0
 

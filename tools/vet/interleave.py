@@ -1,0 +1,588 @@
+"""Interleaving-race pass: asyncio happens-before bugs on shared
+instance state in the agent/raft/serving planes.
+
+An ``await`` is a scheduling point: every other runnable coroutine —
+and, through executors, every worker thread — may run between the
+statement before it and the statement after it.  State that was
+checked before the ``await`` is unverified folklore after it.  The
+chaos campaign (PR 15) only provokes the interleavings its scenario
+catalog seeds; these passes catch the rest statically:
+
+- **X01 await-separated read-modify-write**: a write to ``self.attr``
+  whose *deciding read* of the same attribute (an ``if``/``while``
+  test, a ``for`` iterator, or the RHS of the assignment itself) is
+  separated from the write by an ``await`` — the canonical
+  check-then-act TOCTOU.  Scope-limited to *shared* attributes
+  (accessed from two or more methods of the class) and exempting the
+  two idioms that make the pattern safe: a re-read/re-test of the
+  attribute after the last ``await``, and a write under an
+  ``async with <lock>:`` span (the double-checked-lock shape in
+  ``rpc/pool.py::_session``).
+- **X02 lock-discipline drift**: when the accesses to a field are
+  majority-dominated by ``(async) with self.<lock>:`` spans, an
+  unguarded *write* to that field is almost always a site someone
+  forgot, not a site someone exempted.  Inference, not annotation:
+  the clustering is recomputed from the code on every run.
+- **X03 lock re-entrance via await**: an ``async with self.<lock>:``
+  span that transitively calls (through ``self.*`` methods) back into
+  an acquisition of the same lock.  ``asyncio.Lock`` is not
+  reentrant — the task deadlocks against itself, and every other
+  user of the lock convoys behind it.
+- **X04 thread/coroutine attribute race**: ``self.attr`` mutated from
+  both a thread context (``Thread(target=...)``, ``to_thread``,
+  ``run_in_executor`` — including nested closures handed off from a
+  method) and a coroutine, with at least one side holding no
+  ``threading.Lock``.  The instance-attribute generalization of
+  fork_safety's module-global R02.
+
+Suppression conventions: a ``# noqa: X01``-style pragma must carry a
+justification comment explaining the happens-before argument (single
+writer task, idempotent re-apply, etc.) — see README §static analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.vet.core import FileCtx, Finding
+from tools.vet.tracer_purity import _tail
+
+AWAIT_RMW = "X01"
+LOCK_DISCIPLINE = "X02"
+LOCK_REENTRANT = "X03"
+THREAD_COROUTINE = "X04"
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_MUTATORS = {"append", "add", "update", "pop", "popitem", "setdefault",
+             "extend", "remove", "discard", "clear", "insert"}
+_THREAD_HANDOFFS = {"to_thread", "run_in_executor"}
+
+Pos = Tuple[int, int]
+
+
+def _pos(node: ast.AST) -> Pos:
+    return (node.lineno, node.col_offset)
+
+
+def _end(node: ast.AST) -> int:
+    return getattr(node, "end_lineno", node.lineno) or node.lineno
+
+
+def _walk_local(root: ast.AST) -> Iterator[ast.AST]:
+    """Descendants of root in source order, NOT crossing into nested
+    function/lambda bodies (their awaits and writes belong to another
+    task's timeline)."""
+    for child in ast.iter_child_nodes(root):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+            yield from _walk_local(child)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _functions(cls: ast.ClassDef) -> List[ast.AST]:
+    return [n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _walk_local_inc(root: ast.AST) -> Iterator[ast.AST]:
+    """_walk_local plus the root itself (a caller may hand us a single
+    statement — its own targets must still be classified)."""
+    yield root
+    yield from _walk_local(root)
+
+
+def _mutated_attr_nodes(root: ast.AST) -> Set[int]:
+    """ids of the ``self.attr`` Attribute nodes that are *mutation
+    roots*: subscript-store targets (``self.d[k] = v``), mutator-method
+    receivers (``self.s.add(x)``), and ``del self.d[k]`` holders.
+    Direct stores carry ast.Store ctx and need no override."""
+    out: Set[int] = set()
+
+    def mark_target(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                mark_target(e)
+        elif isinstance(t, ast.Subscript) and _self_attr(t.value):
+            out.add(id(t.value))
+        elif isinstance(t, ast.Starred):
+            mark_target(t.value)
+
+    for n in _walk_local_inc(root):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                mark_target(t)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            mark_target(n.target)
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                mark_target(t)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATORS \
+                and _self_attr(n.func.value):
+            out.add(id(n.func.value))
+    return out
+
+
+def _end_pos(node: ast.AST) -> Pos:
+    return (getattr(node, "end_lineno", node.lineno) or node.lineno,
+            getattr(node, "end_col_offset", node.col_offset) or 0)
+
+
+def _attr_events(root: ast.AST) -> List[Tuple[Pos, Pos, str, Optional[str]]]:
+    """(pos, end_pos, kind, attr) in source order; kind in
+    read/write/await.  Writes = direct stores + subscript stores +
+    mutator calls.  An await's end_pos covers its whole operand —
+    reads lexically inside the awaited expression happen *before* the
+    suspension, not after it."""
+    mutated = _mutated_attr_nodes(root)
+    events: List[Tuple[Pos, Pos, str, Optional[str]]] = []
+    for n in _walk_local_inc(root):
+        if isinstance(n, ast.Await):
+            events.append((_pos(n), _end_pos(n), "await", None))
+            continue
+        attr = _self_attr(n)
+        if attr is None:
+            continue
+        if isinstance(n.ctx, (ast.Store, ast.Del)) or id(n) in mutated:  # type: ignore[attr-defined]
+            events.append((_pos(n), _end_pos(n), "write", attr))
+        else:
+            events.append((_pos(n), _end_pos(n), "read", attr))
+    events.sort(key=lambda e: e[0])
+    return events
+
+
+def _attrs_read(expr: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(expr):
+        attr = _self_attr(n)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+def _lock_attrs_by_kind(cls: ast.ClassDef,
+                        imports: Dict[str, str]
+                        ) -> Tuple[Set[str], Set[str]]:
+    """(all lock attrs, threading-only lock attrs) assigned from a lock
+    factory anywhere in the class, resolved through the module's
+    imports (one subtree walk)."""
+    all_locks: Set[str] = set()
+    threading_locks: Set[str] = set()
+    for n in ast.walk(cls):
+        if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+            continue
+        v = n.value
+        if not (isinstance(v, ast.Call) and _tail(v.func) in _LOCK_FACTORIES):
+            continue
+        if isinstance(v.func, ast.Attribute) and isinstance(v.func.value,
+                                                            ast.Name):
+            origin = imports.get(v.func.value.id, v.func.value.id)
+        elif isinstance(v.func, ast.Name):
+            origin = imports.get(v.func.id, "")
+        else:
+            origin = ""
+        root = origin.split(".")[0]
+        targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                all_locks.add(attr)
+                if root == "threading":
+                    threading_locks.add(attr)
+    return all_locks, threading_locks
+
+
+def _lock_spans(fn: ast.AST, locks: Set[str]) -> List[Tuple[str, int, int]]:
+    """(lock_attr, first_line, last_line) of every (async) with
+    ``self.<lock>:`` inside fn."""
+    spans: List[Tuple[str, int, int]] = []
+    for n in _walk_local(fn):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                attr = _self_attr(item.context_expr)
+                if attr in locks:
+                    spans.append((attr, n.lineno, _end(n)))
+    return spans
+
+
+def _in_any_span(line: int, spans: Sequence[Tuple[str, int, int]]) -> bool:
+    return any(a <= line <= b for _, a, b in spans)
+
+
+def _attr_use_counts(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    """attr -> method names that touch it (read or write)."""
+    out: Dict[str, Set[str]] = {}
+    for fn in _functions(cls):
+        for n in ast.walk(fn):
+            attr = _self_attr(n)
+            if attr is not None:
+                out.setdefault(attr, set()).add(fn.name)
+    return out
+
+
+# -- X01 ---------------------------------------------------------------------
+
+
+def _check_x01(ctx: FileCtx, scan: _ClassScan,
+               out: List[Finding]) -> None:
+    shared = {a for a, fns in _attr_use_counts(scan.cls).items()
+              if len(fns) >= 2}
+    if not shared:
+        return
+    for fn in scan.fns:
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        spans = scan.spans(fn)
+        flagged: Set[Tuple[str, int]] = set()
+
+        def emit(attr: str, write_pos: Pos, read_line: int) -> None:
+            key = (attr, write_pos[0])
+            if key in flagged:
+                return
+            flagged.add(key)
+            out.append(Finding(
+                ctx.path, write_pos[0], AWAIT_RMW,
+                f"write to shared 'self.{attr}' decided by the read at "
+                f"line {read_line}, with an await between them — every "
+                "other coroutine may run at the await and invalidate the "
+                "check (re-validate after the await, or serialize under "
+                "one asyncio.Lock)"))
+
+        # case A: single-statement RMW with an await inside the RHS
+        for n in _walk_local(fn):
+            if isinstance(n, ast.Assign):
+                reads = _attrs_read(n.value)
+                has_await = any(isinstance(s, ast.Await)
+                                for s in ast.walk(n.value))
+                if not has_await:
+                    continue
+                for t in n.targets:
+                    attr = _self_attr(t)
+                    if attr in shared and attr in reads \
+                            and not _in_any_span(n.lineno, spans):
+                        emit(attr, _pos(n), n.lineno)
+            elif isinstance(n, ast.AugAssign):
+                attr = _self_attr(n.target)
+                if attr in shared \
+                        and any(isinstance(s, ast.Await)
+                                for s in ast.walk(n.value)) \
+                        and not _in_any_span(n.lineno, spans):
+                    emit(attr, _pos(n), n.lineno)
+
+        # case B: guard (if/while test, for iterator) → await → write
+        fn_events = scan.events(fn)
+        for n in _walk_local(fn):
+            if isinstance(n, (ast.If, ast.While)):
+                guard_expr: ast.AST = n.test
+                region: List[ast.stmt] = list(n.body) + list(n.orelse)
+            elif isinstance(n, ast.For):
+                guard_expr = n.iter
+                region = list(n.body)
+            else:
+                continue
+            guard_attrs = _attrs_read(guard_expr) & shared
+            if not guard_attrs:
+                continue
+            ranges = [(stmt.lineno, _end(stmt)) for stmt in region]
+
+            def in_region(line: int) -> bool:
+                return any(a <= line <= b for a, b in ranges)
+
+            events = [e for e in fn_events if in_region(e[0][0])]
+            awaits = [(p, pe) for p, pe, kind, _ in events
+                      if kind == "await"]
+            if not awaits:
+                continue
+            # a guard re-established after the suspension: an if/while
+            # inside the region whose test re-reads instance state —
+            # writes under it were re-decided post-await
+            recheck_spans = [
+                (_pos(g), _end(g)) for g in _walk_local(n)
+                if isinstance(g, (ast.If, ast.While))
+                and in_region(g.lineno) and _attrs_read(g.test)]
+            for attr in guard_attrs:
+                for p, _pe, kind, a in events:
+                    if kind != "write" or a != attr:
+                        continue
+                    before = [(w, we) for w, we in awaits if w < p]
+                    if not before:
+                        continue
+                    await_end = max(we for _, we in before)
+                    reread = any(
+                        kind2 == "read" and a2 == attr
+                        and await_end < p2 < p
+                        for p2, _pe2, kind2, a2 in events)
+                    rechecked = any(
+                        gp > await_end and gp < p and p[0] <= ge
+                        for gp, ge in recheck_spans)
+                    revalidated = reread or rechecked
+                    if revalidated or _in_any_span(p[0], spans):
+                        continue
+                    emit(attr, p, n.lineno)
+
+
+# -- X02 ---------------------------------------------------------------------
+
+
+def _check_x02(ctx: FileCtx, scan: _ClassScan,
+               out: List[Finding]) -> None:
+    locks = scan.locks
+    if not locks:
+        return
+    # attr -> lock -> guarded access count; attr -> unguarded (pos, is_write)
+    guarded: Dict[str, Dict[str, int]] = {}
+    unguarded: Dict[str, List[Tuple[Pos, bool]]] = {}
+    for fn in scan.fns:
+        if fn.name == "__init__":
+            continue
+        spans = scan.spans(fn)
+        for p, _pe, kind, attr in scan.events(fn):
+            if attr is None or attr in locks:
+                continue
+            holder = next((L for L, a, b in spans if a <= p[0] <= b), None)
+            if holder is not None:
+                guarded.setdefault(attr, {})[holder] = \
+                    guarded.setdefault(attr, {}).get(holder, 0) + 1
+            else:
+                unguarded.setdefault(attr, []).append((p, kind == "write"))
+    for attr, by_lock in sorted(guarded.items()):
+        lock, count = max(by_lock.items(), key=lambda kv: kv[1])
+        outside = unguarded.get(attr, [])
+        if count < 3 or count <= len(outside):
+            continue  # not majority-dominated: no inferred discipline
+        for p, is_write in sorted(outside):
+            if not is_write:
+                continue
+            out.append(Finding(
+                ctx.path, p[0], LOCK_DISCIPLINE,
+                f"'self.{attr}' is accessed under 'async with "
+                f"self.{lock}:' at {count} sites but written here "
+                "without it — either take the lock or document why "
+                "this writer is exempt from the inferred discipline"))
+
+
+# -- X03 ---------------------------------------------------------------------
+
+
+def _check_x03(ctx: FileCtx, scan: _ClassScan,
+               out: List[Finding]) -> None:
+    locks = scan.locks
+    if not locks:
+        return
+    fns = {fn.name: fn for fn in scan.fns}
+    acquires: Dict[str, Set[str]] = {}   # method -> locks it takes
+    calls: Dict[str, Set[str]] = {}      # method -> self.* methods called
+    for name, fn in fns.items():
+        acquires[name] = {L for L, _, _ in scan.spans(fn)}
+        calls[name] = set()
+        for n in _walk_local(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "acquire":
+                attr = _self_attr(n.func.value)
+                if attr in locks:
+                    acquires[name].add(attr)  # type: ignore[arg-type]
+            callee = _self_attr(n.func)
+            if callee in fns:
+                calls[name].add(callee)  # type: ignore[arg-type]
+
+    def reacquires(start: str, lock: str) -> Optional[str]:
+        seen: Set[str] = set()
+        frontier = [start]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            if lock in acquires.get(m, set()):
+                return m
+            frontier.extend(calls.get(m, set()))
+        return None
+
+    for name, fn in fns.items():
+        spans = scan.spans(fn)
+        if not spans:
+            continue
+        self_calls: List[Tuple[int, str]] = []
+        with_locks: List[Tuple[int, str]] = []
+        for n in _walk_local(fn):
+            if isinstance(n, ast.Call):
+                callee = _self_attr(n.func)
+                if callee in fns:
+                    self_calls.append((n.lineno, callee))  # type: ignore[arg-type]
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in locks:
+                        with_locks.append((n.lineno, attr))  # type: ignore[arg-type]
+        for lock, a, b in spans:
+            for line, callee in self_calls:
+                if not a < line <= b:
+                    continue
+                culprit = reacquires(callee, lock)
+                if culprit is not None:
+                    out.append(Finding(
+                        ctx.path, line, LOCK_REENTRANT,
+                        f"call to self.{callee}() inside 'async with "
+                        f"self.{lock}:' reaches self.{culprit}(), which "
+                        f"acquires self.{lock} again — asyncio.Lock is "
+                        "not reentrant; the task deadlocks against "
+                        "itself (hoist the call out of the critical "
+                        "section or split the lock)"))
+            # lexically nested re-acquisition of the same lock
+            for line, attr in with_locks:
+                if attr == lock and a < line <= b:
+                    out.append(Finding(
+                        ctx.path, line, LOCK_REENTRANT,
+                        f"'async with self.{lock}:' nested inside the "
+                        f"same lock's span (line {a}) — asyncio.Lock "
+                        "is not reentrant; this deadlocks "
+                        "unconditionally"))
+
+
+# -- X04 ---------------------------------------------------------------------
+
+
+def _module_prescan(tree: ast.Module
+                    ) -> Tuple[Dict[str, str], Set[str], List[ast.ClassDef]]:
+    """One whole-tree walk: (imports local→origin-root, names handed to
+    a thread via Thread(target=X)/to_thread(X)/run_in_executor(E, X),
+    class definitions)."""
+    imports: Dict[str, str] = {}
+    thread_targets: Set[str] = set()
+    classes: List[ast.ClassDef] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            classes.append(node)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                imports[(a.asname or a.name).split(".")[0]] = \
+                    a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        imports[a.asname or a.name] = \
+                            f"{node.module.split('.')[0]}.{a.name}"
+        elif isinstance(node, ast.Call):
+            tail = _tail(node.func)
+            if tail == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        name = _tail(kw.value)
+                        if name:
+                            thread_targets.add(name)
+            elif tail == "to_thread" and node.args:
+                name = _tail(node.args[0])
+                if name:
+                    thread_targets.add(name)
+            elif tail == "run_in_executor" and len(node.args) >= 2:
+                name = _tail(node.args[1])
+                if name:
+                    thread_targets.add(name)
+    return imports, thread_targets, classes
+
+
+class _ClassScan:
+    """Per-class shared computations, each done once: lock attrs by
+    kind, per-method attr events, per-method lock spans."""
+
+    def __init__(self, cls: ast.ClassDef, imports: Dict[str, str]) -> None:
+        self.cls = cls
+        self.locks, self.tlocks = _lock_attrs_by_kind(cls, imports)
+        self.fns = _functions(cls)
+        self._events: Dict[int, List[Tuple[Pos, Pos, str,
+                                           Optional[str]]]] = {}
+        self._spans: Dict[Tuple[int, bool],
+                          List[Tuple[str, int, int]]] = {}
+
+    def events(self, fn: ast.AST) -> List[Tuple[Pos, str, Optional[str]]]:
+        key = id(fn)
+        if key not in self._events:
+            self._events[key] = _attr_events(fn)
+        return self._events[key]
+
+    def spans(self, fn: ast.AST,
+              threading_only: bool = False) -> List[Tuple[str, int, int]]:
+        key = (id(fn), threading_only)
+        if key not in self._spans:
+            self._spans[key] = _lock_spans(
+                fn, self.tlocks if threading_only else self.locks)
+        return self._spans[key]
+
+
+def _check_x04(ctx: FileCtx, scan: _ClassScan, thread_targets: Set[str],
+               out: List[Finding]) -> None:
+    tlocks = scan.tlocks
+    # attr -> context -> [(pos, locked)]
+    writes: Dict[str, Dict[str, List[Tuple[Pos, bool]]]] = {}
+
+    def visit_fn(fn: ast.AST, context: Optional[str],
+                 cached: bool) -> None:
+        if context is not None:
+            spans = scan.spans(fn, threading_only=True) if cached \
+                else _lock_spans(fn, tlocks)
+            events = scan.events(fn) if cached else _attr_events(fn)
+            for p, _pe, kind, attr in events:
+                if kind == "write" and attr is not None \
+                        and attr not in tlocks:
+                    writes.setdefault(attr, {}).setdefault(
+                        context, []).append((p, _in_any_span(p[0], spans)))
+        # nested defs: a closure handed to a thread runs on that thread
+        for n in ast.iter_child_nodes(fn):
+            _visit_nested(n, context)
+
+    def _visit_nested(node: ast.AST, context: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = "thread" if node.name in thread_targets else context
+            visit_fn(node, inner, cached=False)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        for child in ast.iter_child_nodes(node):
+            _visit_nested(child, context)
+
+    for fn in scan.fns:
+        if fn.name in thread_targets:
+            context: Optional[str] = "thread"
+        elif isinstance(fn, ast.AsyncFunctionDef):
+            context = "async"
+        else:
+            context = None
+        visit_fn(fn, context, cached=True)
+
+    for attr, by_ctx in sorted(writes.items()):
+        if "thread" not in by_ctx or "async" not in by_ctx:
+            continue
+        unlocked = [(p, c) for c in ("async", "thread")
+                    for p, locked in by_ctx[c] if not locked]
+        for p, context in sorted(unlocked):
+            out.append(Finding(
+                ctx.path, p[0], THREAD_COROUTINE,
+                f"'self.{attr}' is mutated from both a thread context "
+                f"and a coroutine; this {context}-side write holds no "
+                "threading.Lock — the loop and the thread interleave "
+                "arbitrarily (guard both sides with one lock, or "
+                "marshal through call_soon_threadsafe)"))
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    imports, thread_targets, classes = _module_prescan(ctx.tree)
+    for node in classes:
+        scan = _ClassScan(node, imports)
+        _check_x01(ctx, scan, out)
+        _check_x02(ctx, scan, out)
+        _check_x03(ctx, scan, out)
+        _check_x04(ctx, scan, thread_targets, out)
+    return sorted(set(out), key=lambda f: (f.line, f.code, f.message))

@@ -1,0 +1,139 @@
+"""Role-transition pass: raft protocol-state exhaustiveness.
+
+The raft role machine is only safe when every transition runs its
+full ritual — persist the term, reset the vote, tear down replication,
+and (since PR 7's leader leases) drop the lease state.  A bare
+``self.role = ...`` somewhere else is a transition that skipped the
+ritual; the chaos gate catches the ones its scenarios provoke, this
+pass catches them all:
+
+- **T01 out-of-band role/term write**: in a class that defines
+  ``_become_*`` transition helpers, an assignment to ``self.role`` or
+  ``self.current_term`` anywhere outside those helpers (plus
+  ``_stop_leading``, ``__init__``, and ``shutdown``).  Term and role
+  must move together with persistence (``_persist_term``) and
+  observer notification; an inline write forks the state machine.
+- **T02 transition helper leaks the lease**: a transition helper that
+  does not reset ``self._lease_ack``.  The leader lease
+  (``_lease_ack`` quorum-ack map + ``_lease_guard_index``) is what
+  lets a leader serve reads without a barrier; a deposed or
+  re-electing node that keeps stale acks can count a dead quorum as
+  fresh — the deposed-leader-never-serves invariant, enforced today
+  only dynamically by the chaos gate's stale-read checker.
+
+Scope gate: both checks fire only in classes that define at least one
+``_become_*`` method (T02 additionally requires the class to touch
+``_lease_ack`` at all), so agent/demo code never pays the pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.vet.core import FileCtx, Finding
+
+OUT_OF_BAND_WRITE = "T01"
+LEASE_LEAK = "T02"
+
+# state that may only move inside a transition helper
+ROLE_STATE_ATTRS = ("role", "current_term")
+# lease state every transition helper must reset (clear or reassign)
+LEASE_ATTRS = ("_lease_ack",)
+# methods allowed to write role state directly
+_ALLOWED_EXTRA = ("_stop_leading", "__init__", "shutdown")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_transition_helper(name: str) -> bool:
+    return name.startswith("_become_") or name == "_stop_leading"
+
+
+def _methods(cls: ast.ClassDef):
+    return [n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _role_state_writes(fn: ast.AST) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        else:
+            continue
+        for t in targets:
+            if _self_attr(t) in ROLE_STATE_ATTRS:
+                out.append(n)
+                break
+    return out
+
+
+def _resets_lease(fn: ast.AST) -> bool:
+    """True when fn assigns a lease attr or calls ``.clear()`` on it."""
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            if any(_self_attr(t) in LEASE_ATTRS for t in targets):
+                return True
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "clear" \
+                and _self_attr(n.func.value) in LEASE_ATTRS:
+            return True
+    return False
+
+
+def _touches_lease(cls: ast.ClassDef) -> bool:
+    return any(_self_attr(n) in LEASE_ATTRS for n in ast.walk(cls))
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    if "_become_" not in ctx.src:
+        return []
+    out: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = _methods(cls)
+        helpers = [m for m in methods if m.name.startswith("_become_")]
+        if not helpers:
+            continue
+        allowed: Set[str] = {m.name for m in methods
+                             if _is_transition_helper(m.name)}
+        allowed.update(_ALLOWED_EXTRA)
+        for m in methods:
+            if m.name in allowed:
+                continue
+            for node in _role_state_writes(m):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]  # type: ignore[attr-defined]
+                attr = next(a for a in map(_self_attr, targets)
+                            if a in ROLE_STATE_ATTRS)
+                out.append(Finding(
+                    ctx.path, node.lineno, OUT_OF_BAND_WRITE,
+                    f"'self.{attr}' assigned in {cls.name}.{m.name}() "
+                    "outside the _become_*/_stop_leading transition "
+                    "helpers — role and term must move through one "
+                    "helper so persistence, replication teardown, and "
+                    "lease reset cannot be skipped"))
+        if _touches_lease(cls):
+            for m in methods:
+                if not _is_transition_helper(m.name):
+                    continue
+                if not _resets_lease(m):
+                    out.append(Finding(
+                        ctx.path, m.lineno, LEASE_LEAK,
+                        f"transition helper {cls.name}.{m.name}() does "
+                        "not reset self._lease_ack — stale quorum acks "
+                        "survive the transition and a deposed/"
+                        "re-electing node can serve lease reads it no "
+                        "longer holds (clear _lease_ack, and re-anchor "
+                        "_lease_guard_index when taking leadership)"))
+    return sorted(set(out), key=lambda f: (f.line, f.code, f.message))
