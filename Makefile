@@ -17,11 +17,11 @@ PYTEST ?= $(PYTHON) -m pytest -q
 # the role of scripts/verify_no_uuid.sh).
 UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.py
 
-.PHONY: default ci test integ vet vet-fast vet-diff vet-dyn obs-smoke chaos chaos-fast tune tune-check bench bench-serve bench-watch dryrun clean
+.PHONY: default ci test integ vet vet-fast vet-diff vet-dyn obs-smoke chaos chaos-fast tune tune-check bench bench-serve bench-watch bench-fuse bench-fuse-fast dryrun clean
 
 default: test
 
-ci: vet test integ chaos-fast tune-check
+ci: vet test integ chaos-fast tune-check bench-fuse-fast
 
 # Unit + in-process integration tests (multi-node simulated in one
 # process with compressed timers, SURVEY.md §4).
@@ -143,6 +143,18 @@ bench-serve:
 # medians-of-3 land in BENCH_WATCH.json (BENCH_NOTES.md section 12).
 bench-watch:
 	JAX_PLATFORMS=cpu $(PYTHON) -m tools.watchstorm --watches 10000
+
+# Fused-planes reconcile A/B (CPU-only): batched vs per-agent catalog
+# writes over an in-process 3-node cluster; entries/transition +
+# detection->visible p50/p99 land in BENCH_FUSE.json (feeds the
+# reconcile_batch_max autotune rule; BENCH_NOTES.md section 16).
+bench-fuse:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_fuse.py
+
+# CI smoke: 2 rounds, batch=64 only, gates the >=10x raft-entry
+# reduction without touching the BENCH_FUSE.json artifact.
+bench-fuse-fast:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_fuse.py --fast
 
 # Multi-chip sharding dry-run on the 8-device virtual CPU mesh —
 # exactly what the driver validates.

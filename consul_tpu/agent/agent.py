@@ -127,19 +127,31 @@ class AgentConfig:
     # FSM apply + device-side watch matching, host authoritative.
     device_store: bool = False
     device_store_capacity: int = 1 << 16
+    # Batched reconcile (PR 18): max catalog writes folded into one
+    # BATCH raft envelope per flush (0 = autotune verdict > default),
+    # and the plane drain cadence the reconcile linger couples to
+    # (0 = autotune verdict > kernel default; the same knob the plane
+    # resolves for its flight-ring drain).
+    reconcile_batch_max: int = 0
+    flight_drain_every: int = 0
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
 # AgentConfig knobs resolved through the autotune verdict — the serving
 # plane's consumer-side claim for the ``autotune-knob`` vet group
 # (tools/vet/table_drift.py): the union of every TUNED_FIELDS literal
-# must equal the obs/tuner.py KNOBS key set.
-TUNED_FIELDS = ("http_workers", "device_store", "lease_timeout_floor_s")
+# must equal the obs/tuner.py KNOBS key set.  ``flight_drain_every``
+# is ALSO claimed by the gossip plane (gossip/plane.py) — the union
+# check permits the overlap; the agent's read only drives the
+# reconcile-linger cadence coupling, never the kernel.
+TUNED_FIELDS = ("http_workers", "device_store", "lease_timeout_floor_s",
+                "reconcile_batch_max", "flight_drain_every")
 
 # The per-field AUTO sentinel (the dataclass default): any other value
 # is an explicit operator setting and skips the verdict.
 _TUNED_AUTO = {"http_workers": 1, "device_store": False,
-               "lease_timeout_floor_s": None}
+               "lease_timeout_floor_s": None,
+               "reconcile_batch_max": 0, "flight_drain_every": 0}
 
 
 class Agent:
@@ -167,6 +179,10 @@ class Agent:
         # what the agent actually runs.
         self.config.http_workers = int(self.autotune.value("http_workers"))
         self.config.device_store = bool(self.autotune.value("device_store"))
+        self.config.reconcile_batch_max = int(
+            self.autotune.value("reconcile_batch_max") or 0)
+        self.config.flight_drain_every = int(
+            self.autotune.value("flight_drain_every") or 0)
         raft_override = self.config.raft_config
         if raft_override is None:
             floor = float(self.autotune.value("lease_timeout_floor_s") or 0.0)
@@ -176,6 +192,17 @@ class Agent:
         if self.config.server:
             # Embedded full server: Raft + state store + endpoints
             # (consul.NewServer, agent.go:63-66 server branch).
+            # Reconcile linger rides the plane's drain cadence: a slower
+            # flight drain means membership verdicts surface in coarser
+            # bursts, so the leader waits proportionally longer to fold
+            # a whole burst into one BATCH envelope (capped at 250ms so
+            # detection latency never hides behind coalescing).
+            from consul_tpu.agent.reconcile import DEFAULT_LINGER_S
+            from consul_tpu.gossip.plane import FLIGHT_DRAIN_EVERY
+            _drain = (self.config.flight_drain_every
+                      or FLIGHT_DRAIN_EVERY)
+            _linger = min(0.25, DEFAULT_LINGER_S
+                          * (_drain / float(FLIGHT_DRAIN_EVERY)))
             self.server = Server(ServerConfig(
                 node_name=self.config.node_name,
                 datacenter=self.config.datacenter,
@@ -194,13 +221,25 @@ class Agent:
                 acl_master_token=self.config.acl_master_token,
                 device_store=self.config.device_store,
                 device_store_capacity=self.config.device_store_capacity,
+                extra={"reconcile_batch_max":
+                       self.config.reconcile_batch_max,
+                       "reconcile_linger_s": _linger,
+                       **self.config.extra.get("server_extra", {})},
             ))
+            from consul_tpu.agent import hotpath
+            # Health endpoint bytes render at the FSM batch boundary
+            # (fsm.health_render_hook) so they are hot before the first
+            # watcher wakes — device store or not.
+            hotpath.attach_health_cache(self.server)
+            # Server mode exposes the one-raft-entry batched catalog
+            # path; LocalState.sync_changes folds its dirty entries
+            # through it when armed (client mode stays sequential).
+            self.catalog_apply_batch = self._catalog_apply_batch
             if self.config.device_store:
                 bridge = self.server.fsm.device
                 if bridge is not None:
                     # Device watch verdicts invalidate + refresh the KV
                     # byte cache (hotpath.py) right at the batch boundary.
-                    from consul_tpu.agent import hotpath
                     hotpath.attach_kv_cache(self.server, bridge)
         else:
             # Client mode: no Raft, no store — LAN gossip + RPC
@@ -766,6 +805,37 @@ class Agent:
     async def catalog_deregister(self, req) -> None:
         await self.server.catalog.deregister(req)
 
+    async def _catalog_apply_batch(self, ops):
+        """Fold N catalog writes into ONE raft entry (PR 18).
+
+        ``ops`` is a list of ``(MessageType, request)`` pairs.  Each op
+        gets the same normalization + ACL gate Catalog.register /
+        deregister would apply, then the whole list rides a single
+        BATCH envelope through consensus — append + quorum paid once.
+        Returns the per-sub result list (None = applied, str = the
+        sub's error); armed as ``self.catalog_apply_batch`` in server
+        mode only, so callers probe with getattr and fall back to the
+        sequential per-request path.
+        """
+        from consul_tpu.agent.reconcile import normalize_register
+        from consul_tpu.server.endpoints import EndpointError
+        from consul_tpu.structs.structs import MessageType
+        for t, req in ops:
+            if t == MessageType.REGISTER:
+                try:
+                    normalize_register(req)
+                except ValueError as e:
+                    raise EndpointError(str(e)) from e
+                svc = req.service
+                if svc is not None and svc.service != CONSUL_SERVICE_NAME:
+                    acl = await self.server.resolve_token(req.token)
+                    if acl is not None and not acl.service_write(svc.service):
+                        raise PermissionError("Permission denied")
+            elif t == MessageType.DEREGISTER:
+                if not req.node:
+                    raise EndpointError("Must provide node")
+        return await self.server.raft_apply_batch(list(ops))
+
     async def catalog_node_services(self, node: str):
         _, services = await self.server.catalog.node_services(
             node, QueryOptions(allow_stale=True))
@@ -1162,6 +1232,14 @@ class Agent:
         ae_hists, ae_counters = raftstats.aestats.families()
         hists += ae_hists
         labeled_counters += ae_counters
+        # Batched reconcile observatory (agent/reconcile.py): batch
+        # shape, coalescing yield, detection→watcher-visible latency.
+        from consul_tpu.agent import reconcile as _reconcile
+        rc_hists, rc_summaries, rc_counters = \
+            _reconcile.reconstats.families()
+        hists += rc_hists
+        summaries += rc_summaries
+        labeled_counters += rc_counters
         # Device state-store observatory (obs/storestats.py): apply/match
         # dispatch ladders, batch shape, table health.  Present only when
         # device_store is on AND the CONSUL_TPU_DEV_OBS gate left the

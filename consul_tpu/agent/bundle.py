@@ -16,6 +16,8 @@ agent over the window and returns a gzipped tarball of:
 * ``flight.json``           — kernel flight-recorder drain
 * ``raft/telemetry.json``   — raft stats + histograms + per-peer rows
   + the leadership/election/lease event timeline
+* ``reconcile/telemetry.json`` — batched-reconcile observatory: batch
+  shape, coalescing yield, detection→visible latency (agent/reconcile.py)
 * ``device/telemetry.json`` — device/kernel observatory: dispatch
   hists, HBM occupancy, compile + roofline telemetry (obs/devstats.py)
 * ``autotune/verdict.json`` — autotune observatory: the knob
@@ -44,8 +46,8 @@ from consul_tpu.version import VERSION
 # bundle (gossip key, ACL tokens).
 SECRET_FIELDS = ("encrypt", "acl_master_token", "acl_token")
 
-SECTIONS = ("metrics", "slo", "traces", "flight", "raft", "device",
-            "autotune", "tasks", "config")
+SECTIONS = ("metrics", "slo", "traces", "flight", "raft", "reconcile",
+            "device", "autotune", "tasks", "config")
 
 
 def redacted_config(config: Any) -> Dict[str, Any]:
@@ -82,6 +84,12 @@ async def capture(agent: Any, seconds: float) -> bytes:
     put_json("flight.json", await agent._flight(None))
     put_json("raft/telemetry.json", raftstats.telemetry(
         getattr(agent.server, "raft", None), local=agent.local))
+    from consul_tpu.agent.reconcile import reconstats
+    rc = reconstats.wire()
+    leader = getattr(agent.server, "leader_duties", None)
+    rc["reconciler_armed"] = bool(
+        leader is not None and getattr(leader, "reconciler", None))
+    put_json("reconcile/telemetry.json", rc)
     put_json("device/telemetry.json", await agent._device(None))
     put_json("autotune/verdict.json", await agent._autotune(None))
     files["tasks.txt"] = debug.task_dump().encode()

@@ -141,6 +141,88 @@ def attach_kv_cache(srv, bridge, max_entries: int = _KV_CACHE_MAX):
     return cache
 
 
+# -- health byte cache (PR 18: fused detect→render pipeline) -----------------
+
+
+class HealthByteCache:
+    """Rendered-bytes cache for the hot health-service endpoint, the
+    last stage of the fused membership→catalog pipeline.
+
+    Same validity contract as KVByteCache, over the catalog tables: a
+    row rendered at ``last_index(nodes, services, checks)`` == I serves
+    only while that index holds, so any catalog write invalidates
+    implicitly — stale bytes are unservable by construction.  The FSM's
+    batch-boundary render hook (consensus/fsm.py ``health_render_hook``)
+    re-renders the cached variants of every service a committed BATCH
+    envelope touched, synchronously inside the apply — watch waiters
+    only run on the next event-loop iteration, so the bytes are hot
+    before the first woken watcher re-reads.
+
+    Byte parity with the generic path is the whole point: render() is
+    exactly Health.service_nodes' pipeline (store join → passing filter,
+    header index sampled pre-filter) followed by ``_dumps(to_api(...))``
+    (tests/test_reconcile.py asserts identity against the cold path).
+    Consulted only for default-consistency reads with ACLs disabled —
+    consistent reads need their barrier, ACL'd reads their filter.
+    """
+
+    __slots__ = ("srv", "max_entries", "entries", "hits", "misses")
+
+    def __init__(self, srv, max_entries: int = _KV_CACHE_MAX) -> None:
+        self.srv = srv
+        self.max_entries = max_entries
+        # (service, tag, passing) -> (valid_at_index, status, ctype,
+        #                             body, header_index)
+        self.entries: Dict[Tuple[str, str, bool],
+                           Tuple[int, int, str, bytes, int]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def _store_index(self) -> int:
+        return self.srv.store.last_index("nodes", "services", "checks")
+
+    def lookup(self, key: Tuple[str, str, bool]
+               ) -> Optional[Tuple[int, int, str, bytes, int]]:
+        row = self.entries.get(key)
+        if row is None or row[0] != self._store_index():
+            self.misses += 1
+            return None
+        self.hits += 1
+        return row
+
+    def render(self, service: str, tag: str = "",
+               passing: bool = False) -> Tuple[int, int, str, bytes, int]:
+        """One service variant through the store join, bytes remembered."""
+        from consul_tpu.agent.http_api import to_api
+        from consul_tpu.structs.structs import HEALTH_PASSING
+        idx, csns = self.srv.store.check_service_nodes(service, tag)
+        if passing:
+            csns = [c for c in csns
+                    if all(ch.status == HEALTH_PASSING for ch in c.checks)]
+        row = (idx, 200, _JSON, _dumps(to_api(csns)), idx)
+        key = (service, tag, passing)
+        if key not in self.entries and len(self.entries) >= self.max_entries:
+            self.entries.pop(next(iter(self.entries)))  # FIFO bound
+        self.entries[key] = row
+        return row
+
+    def refresh(self, services) -> None:
+        """FSM batch-boundary render hook: re-render every cached
+        variant of the services a committed batch touched."""
+        for key in list(self.entries):
+            if key[0] in services:
+                self.render(*key)
+
+
+def attach_health_cache(srv, max_entries: int = _KV_CACHE_MAX):
+    """Hang a HealthByteCache off the server and point the FSM's
+    batch-boundary render hook at it (called by Agent in server mode)."""
+    cache = HealthByteCache(srv, max_entries)
+    srv.health_byte_cache = cache
+    srv.fsm.health_render_hook = cache.refresh
+    return cache
+
+
 # -- hot operations ---------------------------------------------------------
 
 async def kv_get(srv, key: str, *, stale: bool = False,
@@ -222,6 +304,15 @@ async def health_service(srv, service: str, *, tag: str = "",
                          consistent: bool = False,
                          token: str = "") -> HotResponse:
     from consul_tpu.agent.http_api import to_api
+    cache = getattr(srv, "health_byte_cache", None)
+    if cache is not None and service and not consistent \
+            and not srv.acl_resolver.enabled:
+        # Index-validated rendered bytes, pre-warmed at the batch
+        # boundary by the FSM render hook (fused pipeline, PR 18).
+        row = cache.lookup((service, tag, passing)) \
+            or cache.render(service, tag, passing)
+        _vidx, status, ctype, body, hidx = row
+        return status, _index_headers(srv, hidx), ctype, body
     opts = QueryOptions(token=token, allow_stale=stale,
                         require_consistent=consistent)
     meta, csns = await srv.health.service_nodes(service, opts, tag, passing)

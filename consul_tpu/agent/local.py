@@ -24,8 +24,8 @@ from typing import Dict, Optional
 # swap the singleton under us.
 from consul_tpu.obs import raftstats
 from consul_tpu.structs.structs import (
-    DeregisterRequest, HealthCheck, NodeService, RegisterRequest,
-    SERF_CHECK_ID)
+    DeregisterRequest, HealthCheck, MessageType, NodeService,
+    RegisterRequest, SERF_CHECK_ID)
 
 AE_BASE_INTERVAL = 60.0   # sync interval floor (agent.go aeInterval)
 AE_SCALE_THRESHOLD = 128  # nodes before the interval starts growing
@@ -48,12 +48,17 @@ class LocalState:
         self.checks: Dict[str, HealthCheck] = {}
         self.service_tokens: Dict[str, str] = {}
         self.check_tokens: Dict[str, str] = {}
-        # sync bookkeeping: id -> in_sync; separate deregister sets for
-        # remote entries we no longer own (local.go syncStatus)
+        # sync bookkeeping: id -> in_sync; separate deregister maps for
+        # remote entries we no longer own (local.go syncStatus).  Each
+        # deregister intent carries the epoch it was queued under so the
+        # consume after the catalog round-trip can tell "the intent I
+        # pushed" from "a newer intent re-queued mid-flight" — the
+        # snapshot-compare convention the register paths already use.
         self._service_sync: Dict[str, bool] = {}
         self._check_sync: Dict[str, bool] = {}
-        self._deregister_services: set = set()
-        self._deregister_checks: set = set()
+        self._deregister_services: Dict[str, int] = {}
+        self._deregister_checks: Dict[str, int] = {}
+        self._dereg_epoch = 0
         self._paused = False
         self._trigger = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -64,21 +69,22 @@ class LocalState:
         self.services[service.id] = service
         self.service_tokens[service.id] = token
         self._service_sync[service.id] = False
-        self._deregister_services.discard(service.id)
+        self._deregister_services.pop(service.id, None)
         self.changed()
 
     def remove_service(self, service_id: str) -> None:
         self.services.pop(service_id, None)
         self.service_tokens.pop(service_id, None)
         self._service_sync.pop(service_id, None)
-        self._deregister_services.add(service_id)
+        self._dereg_epoch += 1
+        self._deregister_services[service_id] = self._dereg_epoch
         self.changed()
 
     def add_check(self, check: HealthCheck, token: str = "") -> None:
         self.checks[check.check_id] = check
         self.check_tokens[check.check_id] = token
         self._check_sync[check.check_id] = False
-        self._deregister_checks.discard(check.check_id)
+        self._deregister_checks.pop(check.check_id, None)
         self.changed()
 
     def remove_check(self, check_id: str) -> None:
@@ -90,7 +96,8 @@ class LocalState:
         self.checks.pop(check_id, None)
         self.check_tokens.pop(check_id, None)
         self._check_sync.pop(check_id, None)
-        self._deregister_checks.add(check_id)
+        self._dereg_epoch += 1
+        self._deregister_checks[check_id] = self._dereg_epoch
         self.changed()
 
     def update_check(self, check_id: str, status: str, output: str) -> None:
@@ -187,7 +194,8 @@ class LocalState:
                 continue  # the embedded server's own entry is leader-owned
             local = self.services.get(sid)
             if local is None:
-                self._deregister_services.add(sid)
+                self._dereg_epoch += 1
+                self._deregister_services[sid] = self._dereg_epoch
             else:
                 in_sync = (local.service == remote.service
                            and sorted(local.tags) == sorted(remote.tags)
@@ -204,7 +212,8 @@ class LocalState:
                 continue  # serfHealth belongs to the leader reconcile loop
             local = self.checks.get(cid)
             if local is None:
-                self._deregister_checks.add(cid)
+                self._dereg_epoch += 1
+                self._deregister_checks[cid] = self._dereg_epoch
             else:
                 self._check_sync[cid] = (local.status == remote.status
                                          and local.output == remote.output
@@ -216,10 +225,17 @@ class LocalState:
     # -- push the deltas (syncChanges, local.go:434-476) --------------------
 
     async def sync_changes(self) -> None:
+        # Server-mode agents expose the one-raft-entry batched catalog
+        # path (PR 18): fold every dirty entry into a single BATCH
+        # envelope so anti-entropy pays append + quorum once per pass.
+        submit = getattr(self.agent, "catalog_apply_batch", None)
+        if submit is not None:
+            await self._sync_changes_batched(submit)
+            return
         node = self.agent.node_name
         addr = self.agent.advertise_addr
 
-        for sid in list(self._deregister_services):
+        for sid, epoch in list(self._deregister_services.items()):
             try:
                 await self.agent.catalog_deregister(DeregisterRequest(
                     node=node, service_id=sid,
@@ -227,12 +243,12 @@ class LocalState:
             except Exception:
                 raftstats.aestats.failure("service_deregister")
                 raise
-            # An intent re-added during the await targets the same
-            # catalog entry this in-flight call just removed (deregister
-            # is idempotent; a concurrent re-register only syncs later in
-            # this same coroutine), so consuming it is safe.
-            self._deregister_services.discard(sid)  # noqa: X01
-        for cid in list(self._deregister_checks):
+            # Only consume the intent we actually pushed: an intent
+            # re-queued during the await carries a newer epoch and must
+            # survive for the next pass.
+            if self._deregister_services.get(sid) == epoch:
+                self._deregister_services.pop(sid, None)
+        for cid, epoch in list(self._deregister_checks.items()):
             try:
                 await self.agent.catalog_deregister(DeregisterRequest(
                     node=node, check_id=cid,
@@ -240,7 +256,8 @@ class LocalState:
             except Exception:
                 raftstats.aestats.failure("check_deregister")
                 raise
-            self._deregister_checks.discard(cid)  # noqa: X01 — same as above
+            if self._deregister_checks.get(cid) == epoch:
+                self._deregister_checks.pop(cid, None)
 
         for sid, in_sync in list(self._service_sync.items()):
             if in_sync or sid not in self.services:
@@ -280,3 +297,96 @@ class LocalState:
             if self.checks.get(cid) is check and (check.status,
                                                   check.output) == pushed:
                 self._check_sync[cid] = True
+
+    async def _sync_changes_batched(self, submit) -> None:
+        """syncChanges through ONE raft entry (PR 18).
+
+        Builds the same op sequence the sequential loops would issue —
+        deregisters first, then registers, preserving their relative
+        order — and submits it as a single BATCH envelope.  Each op
+        carries a finalize closure holding the pre-await snapshot
+        (deregister epoch / service identity / pushed check state) so
+        success bookkeeping follows the exact snapshot-compare
+        convention of the sequential path.  Per-sub failures count the
+        same aestats kinds and re-raise so the caller's retry tick
+        stays armed.
+        """
+        node = self.agent.node_name
+        addr = self.agent.advertise_addr
+        ops = []        # (MessageType, request) pairs, submit order
+        kinds = []      # aestats failure kind per op
+        finalizers = []  # success bookkeeping per op
+
+        for sid, epoch in list(self._deregister_services.items()):
+            ops.append((MessageType.DEREGISTER, DeregisterRequest(
+                node=node, service_id=sid,
+                token=self.service_tokens.get(sid, ""))))
+            kinds.append("service_deregister")
+
+            def _fin(sid=sid, epoch=epoch):
+                if self._deregister_services.get(sid) == epoch:
+                    self._deregister_services.pop(sid, None)
+            finalizers.append(_fin)
+        for cid, epoch in list(self._deregister_checks.items()):
+            ops.append((MessageType.DEREGISTER, DeregisterRequest(
+                node=node, check_id=cid,
+                token=self.check_tokens.get(cid, ""))))
+            kinds.append("check_deregister")
+
+            def _fin(cid=cid, epoch=epoch):
+                if self._deregister_checks.get(cid) == epoch:
+                    self._deregister_checks.pop(cid, None)
+            finalizers.append(_fin)
+        for sid, in_sync in list(self._service_sync.items()):
+            if in_sync or sid not in self.services:
+                continue
+            service = self.services[sid]
+            ops.append((MessageType.REGISTER, RegisterRequest(
+                node=node, address=addr, service=service,
+                token=self.service_tokens.get(sid, ""))))
+            kinds.append("service_register")
+
+            def _fin(sid=sid, service=service):
+                if self.services.get(sid) is service:
+                    self._service_sync[sid] = True
+            finalizers.append(_fin)
+        for cid, in_sync in list(self._check_sync.items()):
+            if in_sync or cid not in self.checks:
+                continue
+            check = self.checks[cid]
+            pushed = (check.status, check.output)
+            ops.append((MessageType.REGISTER, RegisterRequest(
+                node=node, address=addr, check=check,
+                token=self.check_tokens.get(cid, ""))))
+            kinds.append("check_register")
+
+            def _fin(cid=cid, check=check, pushed=pushed):
+                if self.checks.get(cid) is check and (
+                        check.status, check.output) == pushed:
+                    self._check_sync[cid] = True
+            finalizers.append(_fin)
+
+        if not ops:
+            return
+        try:
+            results = await submit(ops)
+        except Exception:
+            # Transport/consensus failure: the whole batch is in doubt.
+            # Count each kind once (the sequential path would have died
+            # on its first op of that kind) and let the retry tick run.
+            for kind in dict.fromkeys(kinds):
+                raftstats.aestats.failure(kind)
+            raise
+        if not isinstance(results, (list, tuple)):
+            results = [None] * len(ops)
+        failed = 0
+        for i, fin in enumerate(finalizers):
+            err = results[i] if i < len(results) else None
+            if err is None:
+                fin()
+            else:
+                failed += 1
+                raftstats.aestats.failure(kinds[i])
+        if failed:
+            raise RuntimeError(
+                f"{failed}/{len(ops)} catalog ops failed in batch")

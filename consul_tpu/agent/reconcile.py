@@ -1,0 +1,306 @@
+"""Batched serf→catalog reconcile: the fused-planes write path (PR 18).
+
+The per-agent loop the reference runs (consul/leader.go:310-339) pays
+one raft append→quorum round per health transition; at gossip-plane
+scale a drain cadence can surface hundreds of transitions at once and
+the consensus plane becomes the serialization point.  This module
+collects one drain cadence's worth of member transitions (plus the
+agent's dirty local-state entries — agent/local.py routes its
+sync_changes deltas through the same submit), folds them into ONE
+``MessageType.BATCH`` raft envelope (consensus/fsm.py
+``_apply_batch_envelope``), and lets the FSM's batch-boundary render
+hook warm the health byte cache (agent/hotpath.py) before the first
+watch waiter wakes.  Append→quorum is paid once per cadence, not once
+per transition — the pipelined drain→apply→render shape of "The
+Algorithm of Pipelined Gossiping" (PAPERS.md) rather than a barrier
+per event.
+
+Semantics match the sequential handlers exactly (the lockstep
+equivalence suite in tests/test_reconcile.py holds batched and
+sequential to byte-identical store snapshots + fired watch sets):
+
+* latest-wins per member — a refute arriving after a detect within the
+  same cadence coalesces to the final state, exactly what the
+  sequential loop would leave behind after processing both;
+* raft peer-set changes (add_peer/remove_peer) stay host-side awaits —
+  they are consensus-membership ops, not catalog writes;
+* a failed flush drops the pending set, the same repair contract as
+  the sequential loop's swallowed exception (consul/leader.go:115):
+  the periodic full reconcile re-derives the truth.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+from consul_tpu.obs.raftstats import LatencyHist
+from consul_tpu.structs.structs import (
+    CONSUL_SERVICE_ID,
+    CONSUL_SERVICE_NAME,
+    HEALTH_CRITICAL,
+    HEALTH_PASSING,
+    DeregisterRequest,
+    HealthCheck,
+    MessageType,
+    NodeService,
+    RegisterRequest,
+    SERF_ALIVE_OUTPUT,
+    SERF_CHECK_ID,
+    SERF_CHECK_NAME,
+)
+
+# Entry-count edges (not milliseconds): the batch-size distribution
+# reuses the LatencyHist bank/render machinery the apply-batch shape
+# histograms already ride (obs/raftstats.py).
+BATCH_EDGES: Tuple[float, ...] = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                                  128.0, 256.0, 512.0)
+
+DEFAULT_BATCH_MAX = 64     # knob default mirrored in obs/tuner.py KNOBS
+DEFAULT_LINGER_S = 0.05    # event-burst linger when no cadence coupling
+
+
+def normalize_register(req: RegisterRequest) -> RegisterRequest:
+    """The non-ACL half of Catalog.register's normalization
+    (server/endpoints.py catalog_endpoint.go:18-75), applied in place:
+    batched submits bypass the endpoint object, so the envelope's subs
+    must carry the same shape the sequential path would have encoded."""
+    if not req.node or not req.address:
+        raise ValueError("Must provide node and address")
+    if req.service is not None:
+        if not req.service.id and req.service.service:
+            req.service.id = req.service.service
+        if req.service.id and not req.service.service:
+            raise ValueError("Must provide service name with ID")
+    if req.check is not None:
+        req.checks.append(req.check)
+        req.check = None
+    for check in req.checks:
+        if not check.check_id and check.name:
+            check.check_id = check.name
+        if not check.node:
+            check.node = req.node
+    return req
+
+
+class ReconcileStats:
+    """Batched-reconcile observatory: batch shape, coalescing win, and
+    the end-to-end detection→watcher-visible latency the fused pipeline
+    exists to shrink.  Families always render (zeros included) so the
+    scrape schema is stable from the first scrape — the obs_smoke gate
+    and the autotune evidence rules both key off these names."""
+
+    def __init__(self) -> None:
+        self.batch_size = LatencyHist(
+            "consul_reconcile_batch_size",
+            "Catalog writes carried per reconcile batch envelope.",
+            edges=BATCH_EDGES)
+        # Internal bank; rendered as a quantile summary, not a
+        # histogram — the ISSUE's operator-facing contract is p50/p99.
+        self.visible = LatencyHist(
+            "consul_reconcile_visible_ms",
+            "Detection to watcher-visible latency, milliseconds.")
+        self.batches_total = 0
+        self.entries_coalesced = 0   # subs that skipped their own append
+        self.events_merged = 0       # latest-wins overwrites within a cadence
+        self.submit_failures = 0
+
+    def batch_done(self, n_entries: int) -> None:
+        self.batches_total += 1
+        self.batch_size.observe(float(n_entries))
+        # Every sub past the first rode an append→quorum round it would
+        # otherwise have paid for itself.
+        self.entries_coalesced += max(0, n_entries - 1)
+
+    def visible_observe(self, ms: float) -> None:
+        self.visible.observe(ms)
+
+    def families(self) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]],
+                                List[Dict[str, Any]]]:
+        """(histograms, summaries, labeled_counters) for the scrape."""
+        v = self.visible
+        summaries = [{
+            "name": "consul_reconcile_visible_latency_ms",
+            "help": "Detection to watcher-visible latency through the "
+                    "batched reconcile, milliseconds.",
+            "quantiles": [("0.5", v.quantile_ms(0.50) or 0.0),
+                          ("0.99", v.quantile_ms(0.99) or 0.0)],
+            "sum": round(v._sum, 3), "count": v.count,
+        }]
+        counters = [{
+            "name": "consul_reconcile_entries_coalesced_total",
+            "help": "Catalog writes that shared a batch envelope's "
+                    "append instead of paying their own quorum round.",
+            "rows": [({}, float(self.entries_coalesced))],
+        }, {
+            "name": "consul_reconcile_batches_total",
+            "help": "Reconcile batch envelopes submitted through raft.",
+            "rows": [({}, float(self.batches_total))],
+        }, {
+            "name": "consul_reconcile_events_merged_total",
+            "help": "Member transitions coalesced latest-wins before "
+                    "submit (refute-after-detect within one cadence).",
+            "rows": [({}, float(self.events_merged))],
+        }, {
+            "name": "consul_reconcile_submit_failures_total",
+            "help": "Batch envelope submits that failed (repaired by "
+                    "the periodic full reconcile).",
+            "rows": [({}, float(self.submit_failures))],
+        }]
+        return [self.batch_size.family()], summaries, counters
+
+    def wire(self) -> Dict[str, Any]:
+        """reconcile/telemetry.json debug-bundle member."""
+        return {
+            "batch_size": self.batch_size.wire(),
+            "visible_latency": self.visible.wire(),
+            "batches_total": self.batches_total,
+            "entries_coalesced": self.entries_coalesced,
+            "events_merged": self.events_merged,
+            "submit_failures": self.submit_failures,
+        }
+
+    def reset(self) -> None:
+        self.__init__()
+
+
+# Process-global, mirroring obs.raftstats.aestats (one agent per
+# process; call sites use the module attribute so tests can swap it).
+reconstats = ReconcileStats()
+
+
+class Reconciler:
+    """Collects member transitions across one cadence and flushes them
+    as a single BATCH envelope.  Owned by the leader's reconcile loop
+    (server/leader.py); the op builders mirror the sequential handlers
+    (_handle_alive/_handle_failed/_handle_left) decision for decision,
+    including the store-compare skips."""
+
+    def __init__(self, server, batch_max: int = DEFAULT_BATCH_MAX) -> None:
+        self.srv = server
+        self.batch_max = max(1, int(batch_max))
+        # name -> (member, t_detect); dict order is arrival order, and
+        # a latest-wins overwrite keeps the member's original slot —
+        # final state per member matches the sequential loop.
+        self.pending: Dict[str, Tuple[Any, float]] = {}
+
+    def __len__(self) -> int:
+        return len(self.pending)
+
+    def note(self, member) -> None:
+        name = member.name
+        if name in self.pending:
+            reconstats.events_merged += 1
+            # The first sighting's detection stamp is the honest one:
+            # the coalesced write makes BOTH transitions visible.
+            t0 = self.pending[name][1]
+        else:
+            t0 = time.monotonic()
+        self.pending[name] = (member, t0)
+
+    async def flush(self) -> int:
+        """Build ops for every pending member and submit one envelope.
+        Returns the number of catalog writes shipped (0 = all skipped
+        by the store-compare fast paths, or nothing pending)."""
+        pending, self.pending = self.pending, {}
+        if not pending:
+            return 0
+        ops: List[Tuple[MessageType, Any]] = []
+        stamps: List[float] = []
+        for member, t0 in pending.values():
+            try:
+                member_ops = await self._member_ops(member)
+            except Exception:
+                # Host-side peer-set change failed (lost leadership
+                # mid-flight): same swallow as the sequential loop —
+                # the next leader's full reconcile repairs.
+                continue
+            if member_ops:
+                ops.extend(member_ops)
+                stamps.append(t0)
+        if not ops:
+            return 0
+        try:
+            await self.srv.raft_apply_batch(ops)
+        except Exception:
+            reconstats.submit_failures += 1
+            return 0
+        now = time.monotonic()
+        for t0 in stamps:
+            reconstats.visible_observe((now - t0) * 1000.0)
+        reconstats.batch_done(len(ops))
+        return len(ops)
+
+    # -- op builders (mirror server/leader.py handlers 1:1) ----------------
+
+    async def _member_ops(self, member) -> List[Tuple[MessageType, Any]]:
+        from consul_tpu.membership.swim import (
+            STATE_ALIVE, STATE_DEAD, STATE_LEFT, STATE_SUSPECT)
+        state = getattr(member, "state", STATE_ALIVE)
+        if state in (STATE_ALIVE, STATE_SUSPECT):
+            return await self._alive_ops(member)
+        if state == STATE_DEAD:
+            return self._failed_ops(member)
+        if state == STATE_LEFT:
+            return await self._left_ops(member.name)
+        return []
+
+    async def _alive_ops(self, member) -> List[Tuple[MessageType, Any]]:
+        """_handle_alive (leader.go:354-421) as an op builder; the raft
+        join for a new server is NOT a catalog write and stays a
+        host-side await."""
+        from consul_tpu.membership.serf import parse_server
+        if not member.addr:
+            return []  # sequential path rejects at Catalog.register
+        sp = parse_server(member)
+        if sp is not None and sp["dc"] == self.srv.config.datacenter and \
+                member.name != self.srv.config.node_name and \
+                member.name not in self.srv.raft.peers:
+            await self.srv.raft.add_peer(member.name)
+        _, addr = self.srv.store.get_node(member.name)
+        if addr == member.addr:
+            _, checks = self.srv.store.node_checks(member.name)
+            serf_ok = any(c.check_id == SERF_CHECK_ID
+                          and c.status == HEALTH_PASSING for c in checks)
+            _, svcs = self.srv.store.node_services(member.name)
+            svc_ok = (sp is None or sp["dc"] != self.srv.config.datacenter
+                      or bool(svcs and CONSUL_SERVICE_ID in svcs))
+            if serf_ok and svc_ok:
+                return []
+        req = RegisterRequest(
+            node=member.name, address=member.addr,
+            check=HealthCheck(node=member.name, check_id=SERF_CHECK_ID,
+                              name=SERF_CHECK_NAME, status=HEALTH_PASSING,
+                              output=SERF_ALIVE_OUTPUT))
+        if sp is not None and sp["dc"] == self.srv.config.datacenter:
+            req.service = NodeService(id=CONSUL_SERVICE_ID,
+                                      service=CONSUL_SERVICE_NAME,
+                                      port=sp["port"])
+        return [(MessageType.REGISTER, normalize_register(req))]
+
+    def _failed_ops(self, member) -> List[Tuple[MessageType, Any]]:
+        """_handle_failed (leader.go:423-460) as an op builder."""
+        if not member.addr:
+            return []
+        _, checks = self.srv.store.node_checks(member.name)
+        if any(c.check_id == SERF_CHECK_ID and c.status == HEALTH_CRITICAL
+               for c in checks):
+            return []
+        req = RegisterRequest(
+            node=member.name, address=member.addr,
+            check=HealthCheck(node=member.name, check_id=SERF_CHECK_ID,
+                              name=SERF_CHECK_NAME, status=HEALTH_CRITICAL,
+                              output="Agent not live or unreachable"))
+        return [(MessageType.REGISTER, normalize_register(req))]
+
+    async def _left_ops(self, name: str) -> List[Tuple[MessageType, Any]]:
+        """_handle_left (leader.go:462-501) as an op builder; the raft
+        peer removal stays a host-side await."""
+        if name == self.srv.config.node_name:
+            return []
+        if name in self.srv.raft.peers:
+            await self.srv.raft.remove_peer(name)
+        _, addr = self.srv.store.get_node(name)
+        if addr is None:
+            return []
+        return [(MessageType.DEREGISTER, DeregisterRequest(node=name))]

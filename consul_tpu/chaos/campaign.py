@@ -47,7 +47,9 @@ from consul_tpu.consensus.raft import MemoryTransport, RaftConfig
 from consul_tpu.obs import raftstats
 from consul_tpu.obs.prom import render_prometheus
 from consul_tpu.server.server import Server, ServerConfig
-from consul_tpu.structs.structs import DirEntry, KVSOp, KVSRequest, KeyRequest
+from consul_tpu.structs.structs import (
+    DirEntry, HEALTH_CRITICAL, HEALTH_PASSING, KVSOp, KVSRequest,
+    KeyRequest, SERF_CHECK_ID)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -359,6 +361,43 @@ async def _drive_fault(name: str, p: ChaosParams, broker: FaultBroker,
             for s in servers:
                 broker.node(s.config.node_name).fsync_stall_s = 0.0
                 broker.node(s.config.node_name).fsync_err_p = 0.0
+        elif name == "reconcile_fsync_stall":
+            # The fused write path (PR 18) under the disk fault: stream
+            # synthetic member transitions into the leader's reconcile
+            # queue while every fsync stalls — the batched reconciler
+            # must coalesce each burst into one BATCH envelope and every
+            # ghost must still land in the catalog.
+            from consul_tpu.agent.reconcile import reconstats
+            from consul_tpu.membership.swim import (
+                STATE_ALIVE, STATE_DEAD, Node as GossipNode)
+            ev["reconcile_base"] = {
+                "batches_total": reconstats.batches_total,
+                "entries_coalesced": reconstats.entries_coalesced,
+                "submit_failures": reconstats.submit_failures,
+            }
+            ghosts = [f"ghost{i}" for i in range(8)]
+            ev["ghosts"] = ghosts
+            ev["ghost_failed"] = ghosts[:4]
+            for s in servers:
+                broker.node(s.config.node_name).fsync_stall_s = \
+                    p.fsync_stall_s
+            ld = _leader(servers) or servers[0]
+            # One synchronous burst of put_nowait's: the whole join wave
+            # is queued before the reconcile loop wakes, so it must
+            # share one append.
+            for i, g in enumerate(ghosts):
+                ld.membership_notify("member-join", GossipNode(
+                    name=g, addr=f"10.99.0.{i + 1}", port=8301,
+                    state=STATE_ALIVE))
+            await asyncio.sleep(window / 2)
+            ld = _leader(servers) or ld
+            for i, g in enumerate(ev["ghost_failed"]):
+                ld.membership_notify("member-failed", GossipNode(
+                    name=g, addr=f"10.99.0.{i + 1}", port=8301,
+                    state=STATE_DEAD))
+            await asyncio.sleep(window / 2)
+            for s in servers:
+                broker.node(s.config.node_name).fsync_stall_s = 0.0
         elif name == "leader_flap":
             t_end = loop.time() + window
             while loop.time() < t_end:
@@ -440,6 +479,49 @@ def _detect(name: str, p: ChaosParams, servers: List[Server],
             evidence = {"append_quorum_ge_100ms": tail,
                         "window_appends": delta["count"],
                         "lease_lost_events": len(lost)}
+    elif name == "reconcile_fsync_stall":
+        # Three-way evidence: the batched reconciler coalesced (its
+        # counters moved), every injected ghost reached the catalog with
+        # the right serfHealth verdict, and the disk fault itself shows
+        # in the append_quorum tail like plain fsync_stall.
+        from consul_tpu.agent.reconcile import reconstats
+        base_rc = ev.get("reconcile_base") or {}
+        ld = _leader(servers) or by_name.get(lname) or servers[0]
+        batches = (reconstats.batches_total
+                   - base_rc.get("batches_total", 0))
+        coalesced = (reconstats.entries_coalesced
+                     - base_rc.get("entries_coalesced", 0))
+        failures = (reconstats.submit_failures
+                    - base_rc.get("submit_failures", 0))
+        ghosts = ev.get("ghosts") or []
+        failed_set = set(ev.get("ghost_failed") or [])
+        landed = states_ok = 0
+        for g in ghosts:
+            _, addr = ld.store.get_node(g)
+            if addr is None:
+                continue
+            landed += 1
+            _, checks = ld.store.node_checks(g)
+            serf = next((c for c in checks
+                         if c.check_id == SERF_CHECK_ID), None)
+            want = (HEALTH_CRITICAL if g in failed_set
+                    else HEALTH_PASSING)
+            if serf is not None and serf.status == want:
+                states_ok += 1
+        b, e = base.get(lname), end.get(lname)
+        tail = 0
+        if b and e:
+            delta = _hist_delta(b["append_quorum"], e["append_quorum"])
+            tail = _hist_tail(delta, 100.0)
+        detected = (batches >= 1 and coalesced >= 1
+                    and landed == len(ghosts)
+                    and states_ok == len(ghosts) and tail >= 1)
+        evidence = {"batches_delta": batches,
+                    "entries_coalesced_delta": coalesced,
+                    "submit_failures_delta": failures,
+                    "ghosts": len(ghosts), "ghosts_in_catalog": landed,
+                    "ghost_states_correct": states_ok,
+                    "append_quorum_ge_100ms": tail}
     elif name == "leader_flap":
         lost = sum(e["leadership_lost"] - base.get(n, e)["leadership_lost"]
                    for n, e in end.items())

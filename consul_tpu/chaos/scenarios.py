@@ -67,7 +67,8 @@ class ChaosParams:
     def __post_init__(self) -> None:
         if self.fault not in ("clock_skew", "clock_jump", "fsync_stall",
                               "leader_flap", "asym_partition",
-                              "slow_follower", "worker_crash_under_load"):
+                              "slow_follower", "worker_crash_under_load",
+                              "reconcile_fsync_stall"):
             raise ValueError(f"unknown chaos scenario {self.fault!r}")
         if not 0.0 <= self.start <= self.stop:
             raise ValueError("fault window must satisfy 0 <= start <= stop")
@@ -112,6 +113,13 @@ class ChaosParams:
 # - worker_crash_under_load: blackbox — fork a real agent with 3
 #   SO_REUSEPORT workers, SIGKILL one mid-load, and require the
 #   supervisor to respawn it while HTTP traffic keeps succeeding.
+# - reconcile_fsync_stall: the PR-18 fused write path under the disk
+#   fault — synthetic membership transitions stream into the leader's
+#   reconcile queue while every fsync stalls 300ms.  The stall widens
+#   the batched reconciler's linger window, so transitions MUST
+#   coalesce (entries_coalesced climbs) and every injected node must
+#   still land in the catalog with a serfHealth verdict; append_quorum
+#   tail shows the stall like plain fsync_stall.
 CATALOG = {
     "clock_skew": ChaosParams(fault="clock_skew", clock_rate=5.0),
     "clock_jump": ChaosParams(fault="clock_jump", clock_jump_s=0.2,
@@ -126,6 +134,9 @@ CATALOG = {
     "worker_crash_under_load": ChaosParams(
         fault="worker_crash_under_load", worker_kills=1, run_s=6.0,
         start=1.0, stop=5.0),
+    "reconcile_fsync_stall": ChaosParams(
+        fault="reconcile_fsync_stall", fsync_stall_s=0.3,
+        ops_per_client=16),
 }
 
 # The `make chaos-fast` slice: cheapest in-process scenarios with the

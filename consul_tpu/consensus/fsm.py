@@ -77,6 +77,12 @@ class ConsulFSM:
         # when attached, apply_batch ships each committed batch to the
         # device as one scatter + one watch-match dispatch.
         self.device: Optional[Any] = None
+        # Batch-boundary health render hook (PR 18): called with the set
+        # of service names a BATCH envelope touched, synchronously inside
+        # the apply path — watch waiters only run at the next event-loop
+        # iteration, so bytes rendered here are hot before the first
+        # watcher wakes.  Observational only; never allowed to fail apply.
+        self.health_render_hook: Optional[Callable[[Any], None]] = None
         self._handlers: Dict[int, Callable[[int, bytes], Any]] = {
             MessageType.REGISTER: self._apply_register,
             MessageType.DEREGISTER: self._apply_deregister,
@@ -84,6 +90,7 @@ class ConsulFSM:
             MessageType.SESSION: self._apply_session,
             MessageType.ACL: self._apply_acl,
             MessageType.TOMBSTONE: self._apply_tombstone,
+            MessageType.BATCH: self._apply_batch_envelope,
         }
 
     def _new_backend(self):
@@ -159,6 +166,67 @@ class ConsulFSM:
                 # cap stays unconsumed → scope exit host-fires it.
                 metrics.incr_counter(("consul", "fsm", "device_batch_error"))
         return results
+
+    def _apply_batch_envelope(self, index: int, payload: bytes) -> Any:
+        """BATCH envelope (PR 18): a msgpack list of sub-entry buffers
+        applied in order at the envelope's single raft index — the
+        batched reconcile pass pays append→quorum once per cadence
+        instead of once per transition.  Per-sub failures are isolated:
+        the result list carries an error string in that slot (wire-safe
+        for the leader-forward hop) and the remaining subs still apply,
+        mirroring how N independent sequential entries would behave.
+
+        With a device twin attached the envelope runs inside the run's
+        ``capture_apply`` scope (apply_batch → _apply_one → here), so
+        the whole batch is still one device scatter.  BATCH never
+        appears in snapshots — the sub-effects are plain store records.
+        """
+        subs = msgpack.unpackb(payload, raw=False)
+        touched = self._batch_touched_services(subs)
+        results: list = []
+        for sub in subs:
+            sub = bytes(sub)
+            try:
+                results.append(self.apply(index, sub))
+            except Exception as exc:
+                results.append(f"{type(exc).__name__}: {exc}")
+        hook = self.health_render_hook
+        if hook is not None:
+            self._batch_touched_services(subs, touched)
+            try:
+                hook(touched)
+            except Exception:
+                metrics.incr_counter(("consul", "fsm", "render_hook_error"))
+        return results
+
+    def _batch_touched_services(self, subs, acc: Optional[set] = None) -> set:
+        """Service names a batch's catalog subs affect: explicit service
+        registrations plus every service on a node whose node-level
+        state (address, serfHealth) the batch writes.  Called before
+        apply (pre-image: services a node deregister removes) and again
+        after (post-image: services the batch created)."""
+        out: set = set() if acc is None else acc
+        nodes: set = set()
+        for sub in subs:
+            sub = bytes(sub)
+            t = sub[0] & ~IGNORE_UNKNOWN_FLAG
+            try:
+                if t == MessageType.REGISTER:
+                    req = codec.decode_payload(sub[1:], RegisterRequest)
+                    if req.service is not None and req.service.service:
+                        out.add(req.service.service)
+                    nodes.add(req.node)
+                elif t == MessageType.DEREGISTER:
+                    req = codec.decode_payload(sub[1:], DeregisterRequest)
+                    nodes.add(req.node)
+            except Exception:
+                continue  # malformed sub fails in apply(), not here
+        for node in nodes:
+            _, svcs = self.store.node_services(node)
+            for svc in (svcs or {}).values():
+                if svc.service:
+                    out.add(svc.service)
+        return out
 
     def _apply_register(self, index: int, payload: bytes) -> Any:
         req = codec.decode_payload(payload, RegisterRequest)

@@ -262,6 +262,16 @@ class GossipPlane:
         self._flight = None                  # FlightRing (device)
         self._flight_recorder = None         # obs.flight.FlightRecorder
         self._dispatches_since_drain = 0
+        # Structured membership-event batch (PR 18): detect/refute/join
+        # verdicts accumulate per drain cadence with the node-id →
+        # catalog identity resolved ONCE at queue time via the
+        # admission table (_member_wire snapshots name/addr/tags/state),
+        # then ship as one ``evbatch`` frame instead of per-event host
+        # dicts.  Own counter, not _dispatches_since_drain: the flight
+        # drain early-returns on flightless planes and must not gate
+        # event delivery.
+        self._pending_events: List[Dict[str, Any]] = []
+        self._dispatches_since_event_flush = 0
         # Detection-latency observatory: on-device histogram banks
         # accumulated inside the same jit step, drained on the flight
         # cadence into the host recorder + SLO burn-rate tracker.
@@ -441,6 +451,8 @@ class GossipPlane:
             ring_rounds=4 * self._drain_every * STEPS_PER_TICK)
         self._flight_recorder = FlightRecorder()
         self._dispatches_since_drain = 0
+        self._pending_events = []
+        self._dispatches_since_event_flush = 0
         # Observatory banks ride the same dispatch: cumulative on-device
         # histograms drained on the flight cadence, feeding the live SLO.
         self._hist = init_hist()
@@ -702,7 +714,7 @@ class GossipPlane:
                 elif mem[i]:
                     self._pending_join.pop(i, None)
                     node.status = "alive"
-                    self._broadcast_member_event(EV_JOIN, node)
+                    self._queue_member_event(EV_JOIN, node)
 
         # Dead verdicts declared during this dispatch (trace carries the
         # per-round slot registers: subject + phase).
@@ -724,7 +736,18 @@ class GossipPlane:
             self._declared_dead.add(node.id)
             node.status = "failed"
             self._alive_mask[node.id] = False
-            self._broadcast_member_event(EV_FAILED, node)
+            self._queue_member_event(EV_FAILED, node)
+
+        # Ship the cadence's structured batch: the counter only runs
+        # while events are queued, so the first event of a quiet period
+        # waits at most one drain cadence, and a steady trickle still
+        # coalesces a full cadence's worth per frame.
+        if not self._pending_events:
+            self._dispatches_since_event_flush = 0
+        else:
+            self._dispatches_since_event_flush += 1
+            if self._dispatches_since_event_flush >= self._drain_every:
+                self._flush_member_events()
 
         self._dispatch_events()
 
@@ -1297,7 +1320,29 @@ class GossipPlane:
                 "state": ("dead" if node.status == "failed" else
                           "left" if node.status == "left" else "alive")}
 
+    def _queue_member_event(self, kind: str, node: PlaneNode) -> None:
+        """Accumulate one kernel-verdict transition into the cadence's
+        structured batch.  Identity is resolved NOW (the admission
+        table may reuse the id before the flush), so a detect queued
+        before a same-cadence refute keeps its own snapshot."""
+        self._pending_events.append(
+            {"kind": kind, "node": self._member_wire(node)})
+
+    def _flush_member_events(self) -> None:
+        """Ship the queued transitions as one ``evbatch`` frame — one
+        msgpack encode + one write per connection for the whole
+        cadence, the wire half of the fused detect→catalog pipeline."""
+        self._dispatches_since_event_flush = 0
+        if not self._pending_events:
+            return
+        events, self._pending_events = self._pending_events, []
+        self._broadcast({"t": "evbatch", "events": events})
+
     def _broadcast_member_event(self, kind: str, node: PlaneNode) -> None:
+        # Host-driven transitions (leave/force-leave/tags) broadcast
+        # immediately; the queued batch flushes FIRST so an agent never
+        # sees a leave before the failure that preceded it.
+        self._flush_member_events()
         self._broadcast({"t": "ev", "kind": kind,
                          "node": self._member_wire(node)})
 

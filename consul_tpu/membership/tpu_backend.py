@@ -310,21 +310,16 @@ class TpuSerfPool:
                     self.on_event(EV_JOIN, node)
             self._registered.set()
         elif t == "ev":
-            kind = m.get("kind")
-            node = self._node_from_wire(m.get("node") or {})
-            if self.member_filter is not None and \
-                    not self.member_filter(node):
-                return  # merge delegate (consul/merge.go) still applies
-            if kind == EV_LEAVE:
-                node.state = STATE_LEFT
-                self._nodes.pop(node.name, None)
-            elif kind == EV_FAILED:
-                node.state = STATE_DEAD
-                if node.name in self._nodes:
-                    self._nodes[node.name].state = STATE_DEAD
-            else:
-                self._nodes[node.name] = node
-            self.on_event(kind, node)
+            self._handle_member_event(m.get("kind"), m.get("node") or {})
+        elif t == "evbatch":
+            # One drain cadence's structured batch (PR 18): apply the
+            # per-event logic in order.  on_event is synchronous, so
+            # every transition lands in the server's reconcile queue
+            # before the leader's batched reconcile task next wakes —
+            # the burst coalesces into one raft envelope downstream.
+            for ev in m.get("events") or []:
+                self._handle_member_event(ev.get("kind"),
+                                          ev.get("node") or {})
         elif t == "stats":
             fut = getattr(self, "_stats_future", None)
             if fut is not None and not fut.done():
@@ -356,6 +351,24 @@ class TpuSerfPool:
                 "t": "uev", "ltime": ltime, "name": m.get("name", ""),
                 "payload": m.get("payload", b""),
                 "cc": m.get("coalesce", True)})
+
+    def _handle_member_event(self, kind: str, wire: Dict[str, Any]) -> None:
+        """Shared by the single-event and batched frames: merge-gate,
+        membership table update, agent notification."""
+        node = self._node_from_wire(wire)
+        if self.member_filter is not None and \
+                not self.member_filter(node):
+            return  # merge delegate (consul/merge.go) still applies
+        if kind == EV_LEAVE:
+            node.state = STATE_LEFT
+            self._nodes.pop(node.name, None)
+        elif kind == EV_FAILED:
+            node.state = STATE_DEAD
+            if node.name in self._nodes:
+                self._nodes[node.name].state = STATE_DEAD
+        else:
+            self._nodes[node.name] = node
+        self.on_event(kind, node)
 
     @staticmethod
     def _node_from_wire(w: Dict[str, Any]) -> Node:

@@ -280,6 +280,32 @@ def adapt_serve(root: str = REPO_ROOT) -> List[Evidence]:
     return rows
 
 
+def adapt_fuse(root: str = REPO_ROOT) -> List[Evidence]:
+    """BENCH_FUSE.json (tools/bench_fuse.py): batched-reconcile A/B —
+    raft entries per health transition and detection→watcher-visible
+    latency per batch tier vs the sequential per-agent loop.  Host-side
+    raft + rendering — platform-neutral."""
+    path = os.path.join(root, "BENCH_FUSE.json")
+    payload = _read_json(path)
+    if not isinstance(payload, dict):
+        return []
+    src, stamp = os.path.basename(path), _mtime(path)
+    rows: List[Evidence] = []
+    for run, st in sorted((payload.get("runs") or {}).items()):
+        if not isinstance(st, dict):
+            continue
+        m = re.match(r"^batch=(\d+)$", run)
+        tier = f"batch{int(m.group(1))}" if m else (
+            "sequential" if run == "sequential" else None)
+        if tier is None:
+            continue
+        for k in ("entries_per_transition", "p50_ms", "p99_ms"):
+            if st.get(k) is not None:
+                rows.append(Evidence(f"fuse.{k}.{tier}", float(st[k]),
+                                     src, "", stamp))
+    return rows
+
+
 def adapt_chaos(root: str = REPO_ROOT) -> List[Evidence]:
     """CHAOS.json (tools/chaos_campaign.py): per-scenario pass/detected
     verdicts.  The campaign runs on the CPU harness but exercises
@@ -358,7 +384,7 @@ def gather_evidence(root: str = REPO_ROOT) -> List[Evidence]:
     """Every offline artifact adapter over one repo checkout.  Missing
     artifacts contribute nothing (the rules fall back to defaults)."""
     return (adapt_bench_cache(root) + adapt_watch(root)
-            + adapt_serve(root) + adapt_chaos(root))
+            + adapt_serve(root) + adapt_fuse(root) + adapt_chaos(root))
 
 
 def _read_json(path: str) -> Any:
@@ -583,6 +609,43 @@ def _rule_lease_timeout_floor(table: EvidenceTable, fp: Dict[str, Any]):
             "window (election_timeout_min) stands")
 
 
+def _rule_reconcile_batch_max(table: EvidenceTable, fp: Dict[str, Any]):
+    """Batched-reconcile tier vs the sequential loop (BENCH_FUSE.json):
+    take the largest measured batch tier that holds BOTH acceptance
+    bars — ≥10× fewer raft entries per transition AND a p99 no worse
+    than the sequential loop (5% noise allowance).  No tier holding
+    both ⇒ the default stands, explicitly recorded as a measured
+    decision."""
+    seq = table.get("fuse.p99_ms.sequential")
+    cands: Dict[int, Tuple[float, str]] = {}
+    for r in table.match("fuse.entries_per_transition.batch"):
+        suffix = r.key.rpartition("batch")[2]
+        if suffix.isdigit():
+            cands[int(suffix)] = (float(r.value), r.key)
+    if seq is None or not cands:
+        return None
+    used = [seq.key]
+    ok: List[int] = []
+    for n in sorted(cands):
+        p99 = table.get(f"fuse.p99_ms.batch{n}")
+        if p99 is None:
+            continue
+        used += [cands[n][1], p99.key]
+        if cands[n][0] <= 0.1 and float(p99.value) <= float(seq.value) * 1.05:
+            ok.append(n)
+    if len(used) < 3:
+        return None  # no tier has both metrics — nothing admissible
+    if not ok:
+        return (64, used,
+                "no batch tier held >=10x entry reduction at a "
+                "non-regressed p99; default stands")
+    best = max(ok)
+    return (best, used,
+            f"batch={best}: {cands[best][0]:.3f} entries/transition, "
+            f"p99 {table.get(f'fuse.p99_ms.batch{best}').value:.1f} ms "
+            f"vs sequential {float(seq.value):.1f} ms")
+
+
 # -- knob registry -----------------------------------------------------------
 
 
@@ -646,6 +709,12 @@ KNOBS: Dict[str, Knob] = {
         rule=_rule_watch_device_min, evidence=("watch.",),
         doc="Standing-watch count where the device matcher beats the "
             "host radix walk on CPU."),
+    "reconcile_batch_max": Knob(
+        default=64, kind="int", target="AgentConfig.reconcile_batch_max",
+        rule=_rule_reconcile_batch_max, evidence=("fuse.",),
+        doc="Catalog writes folded into one BATCH raft envelope per "
+            "reconcile flush (agent/reconcile.py); cadence coupling "
+            "rides flight_drain_every."),
     "lease_timeout_floor_s": Knob(
         default=0.0, kind="float",
         target="RaftConfig.lease_timeout (when not overridden)",

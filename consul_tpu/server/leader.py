@@ -35,6 +35,9 @@ class LeaderDuties:
         self._tombstone_task: Optional[asyncio.Task] = None
         self._establish_task: Optional[asyncio.Task] = None
         self._reconcile_task: Optional[asyncio.Task] = None
+        # Armed by the batched _reconcile_loop; bundle + chaos campaign
+        # read its stats surface (agent/reconcile.py).
+        self.reconciler = None
         # revoke() is sync (called from the role-change callback), so
         # cancelled tasks park here until stop() can await them out
         self._cancelled: List[asyncio.Task] = []
@@ -179,7 +182,66 @@ class LeaderDuties:
     async def _reconcile_loop(self) -> None:
         """Drain gossip member events; on idle, run the periodic full
         reconcile (leaderLoop's select over reconcileCh + the
-        ReconcileInterval ticker, leader.go:104-117)."""
+        ReconcileInterval ticker, leader.go:104-117).
+
+        Batched by default (PR 18): one drain cadence's worth of member
+        transitions coalesces into a single BATCH raft envelope
+        (agent/reconcile.py) so append→quorum is paid once per cadence.
+        ``extra["reconcile_batched"] = False`` keeps the per-member
+        sequential loop — the A side of tools/bench_fuse.py."""
+        extra = self.srv.config.extra
+        if not extra.get("reconcile_batched", True):
+            await self._reconcile_loop_sequential()
+            return
+        from consul_tpu.agent.reconcile import (
+            DEFAULT_BATCH_MAX, DEFAULT_LINGER_S, Reconciler)
+        interval = self.srv.config.reconcile_interval
+        batch_max = int(extra.get("reconcile_batch_max", 0)
+                        or DEFAULT_BATCH_MAX)
+        linger = float(extra.get("reconcile_linger_s", DEFAULT_LINGER_S))
+        rec = Reconciler(self.srv, batch_max=batch_max)
+        self.reconciler = rec  # introspection: bundle + chaos detect
+        try:
+            while self._active:
+                ch = self.srv.reconcile_ch
+                if ch is None:
+                    await asyncio.sleep(interval)
+                    continue
+                try:
+                    _kind, member = await asyncio.wait_for(
+                        ch.get(), timeout=interval)
+                except asyncio.TimeoutError:
+                    await self._reconcile_full()
+                    continue
+                rec.note(member)
+                # Greedy drain + linger: a gossip evbatch lands as a
+                # burst of put_nowait's; collect the whole burst (and
+                # any stragglers inside the cadence-coupled linger
+                # window) before paying the one append.
+                deadline = time.monotonic() + linger
+                while len(rec) < rec.batch_max:
+                    try:
+                        _k, m = ch.get_nowait()
+                    except asyncio.QueueEmpty:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        try:
+                            _k, m = await asyncio.wait_for(
+                                ch.get(), timeout=remaining)
+                        except asyncio.TimeoutError:
+                            break
+                    rec.note(m)
+                try:
+                    await rec.flush()
+                except Exception:  # noqa: E02 — lost leadership mid-apply
+                    pass  # next leader repairs
+        except asyncio.CancelledError:
+            pass
+
+    async def _reconcile_loop_sequential(self) -> None:
+        """The pre-batching loop: one catalog write per member event.
+        Retained as the bench baseline and the escape hatch."""
         interval = self.srv.config.reconcile_interval
         try:
             while self._active:

@@ -282,6 +282,36 @@ class Server:
         finally:
             obs_trace.finish_span(span)
 
+    async def raft_apply_batch(self, ops: List[tuple]) -> Any:
+        """Apply N writes through consensus as ONE log entry (PR 18):
+        a BATCH envelope carrying the encoded sub-entries.  The batched
+        reconcile pass pays append→quorum once per drain cadence instead
+        of once per transition.  Returns the per-sub result list (error
+        strings in failed slots, mirroring raft_apply's FSM-error
+        surfacing); the NotLeader forward ships the same envelope bytes,
+        so a mid-batch leader change retries the whole batch atomically.
+        """
+        import msgpack as _msgpack
+
+        from consul_tpu.utils.telemetry import metrics
+        metrics.incr_counter(("consul", "raft", "apply"))
+        subs = [codec.encode(int(t), req) for t, req in ops]
+        buf = bytes([int(MessageType.BATCH)]) + _msgpack.packb(
+            subs, use_bin_type=True)
+        span = obs_trace.child_span("raft-apply",
+                                    tags={"type": "batch", "subs": len(subs)})
+        try:
+            return await self.raft.apply(buf, timeout=ENQUEUE_LIMIT)
+        except RaftNotLeaderError as e:
+            if self.pool is not None:
+                leader_addr = self.route_table.get(self.raft.leader_id or "")
+                if leader_addr:
+                    return await self.pool.rpc(leader_addr, "Server.Apply",
+                                               {"buf": buf})
+            raise NotLeaderError(str(e)) from e
+        finally:
+            obs_trace.finish_span(span)
+
     async def raft_apply_raw(self, buf: bytes) -> Any:
         """Leader-side target of the Server.Apply forward."""
         try:

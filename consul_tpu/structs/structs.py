@@ -51,6 +51,11 @@ class MessageType(enum.IntEnum):
     SESSION = 3
     ACL = 4
     TOMBSTONE = 5
+    # Batched reconcile envelope (PR 18): one log entry carrying a
+    # msgpack list of sub-entry buffers, each itself a type byte +
+    # payload.  Append->quorum is paid once for the whole batch; the
+    # FSM applies the sub-entries in order at the envelope's index.
+    BATCH = 6
 
     @staticmethod
     def ignore_unknown(t: int) -> int:

@@ -1,0 +1,385 @@
+"""Lockstep equivalence for the fused reconcile write path (PR 18).
+
+The batched reconcile (agent/reconcile.py) folds one drain cadence's
+member transitions into a single ``MessageType.BATCH`` raft envelope
+(consensus/fsm.py ``_apply_batch_envelope``).  Its correctness claim is
+*equivalence*: the envelope applied at index N leaves the store
+byte-identical to the same sub-entries applied sequentially at N, fires
+the same watch tables, and returns the same per-sub results.  The
+through-raft tier then holds a live 3-node cluster to convergence
+across a leader change, and the byte-cache tier holds the FSM render
+hook's pre-warmed bytes to identity with the cold health path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import msgpack
+import pytest
+
+from consul_tpu.agent.reconcile import Reconciler, reconstats
+from consul_tpu.consensus.fsm import ConsulFSM
+from consul_tpu.membership.swim import (
+    STATE_ALIVE, STATE_DEAD, STATE_LEFT, Node)
+from consul_tpu.structs import codec
+from consul_tpu.structs.structs import (
+    HEALTH_CRITICAL,
+    HEALTH_PASSING,
+    DeregisterRequest,
+    HealthCheck,
+    KVSRequest,
+    DirEntry,
+    MessageType,
+    NodeService,
+    QueryOptions,
+    RegisterRequest,
+    SERF_CHECK_ID,
+    SERF_CHECK_NAME,
+)
+
+# -- helpers ---------------------------------------------------------------
+
+
+def enc(msg_type, req) -> bytes:
+    return codec.encode(int(msg_type), req)
+
+
+def envelope(ops) -> bytes:
+    """Exactly server.raft_apply_batch's encoding."""
+    subs = [enc(t, r) for t, r in ops]
+    return bytes([int(MessageType.BATCH)]) + msgpack.packb(
+        subs, use_bin_type=True)
+
+
+def serf_register(name: str, addr: str, status: str,
+                  service: NodeService = None) -> RegisterRequest:
+    req = RegisterRequest(
+        node=name, address=addr, service=service,
+        check=HealthCheck(node=name, check_id=SERF_CHECK_ID,
+                          name=SERF_CHECK_NAME, status=status))
+    # Same normalization the batched submit applies (check -> checks).
+    req.checks.append(req.check)
+    req.check = None
+    return req
+
+
+class RecordingWaiter:
+    def __init__(self) -> None:
+        self.fired = False
+
+    def set(self) -> None:
+        self.fired = True
+
+
+def fired_tables(store, fn):
+    """Run ``fn`` with a waiter parked on every catalog table; return
+    the set of tables whose NotifyGroup fired."""
+    tables = ("nodes", "services", "checks")
+    waiters = {t: RecordingWaiter() for t in tables}
+    for t, w in waiters.items():
+        store.watch([t], w)
+    fn()
+    for t, w in waiters.items():
+        store.stop_watch([t], w)
+    return {t for t, w in waiters.items() if w.fired}
+
+
+def assert_lockstep(seed_ops, batch_ops, index=40):
+    """Envelope at ``index`` == the same subs applied sequentially at
+    ``index``: byte-identical snapshot, same fired watch tables, same
+    per-sub results."""
+    fsm_seq, fsm_bat = ConsulFSM(), ConsulFSM()
+    for fsm in (fsm_seq, fsm_bat):
+        for i, (t, req) in enumerate(seed_ops):
+            fsm.apply(10 + i, enc(t, req))
+
+    seq_results = []
+
+    def run_seq():
+        for t, req in batch_ops:
+            try:
+                seq_results.append(fsm_seq.apply(index, enc(t, req)))
+            except Exception as exc:
+                seq_results.append(f"{type(exc).__name__}: {exc}")
+
+    seq_fired = fired_tables(fsm_seq.store, run_seq)
+    bat_results = []
+    bat_fired = fired_tables(
+        fsm_bat.store,
+        lambda: bat_results.extend(
+            fsm_bat.apply(index, envelope(batch_ops))))
+
+    assert bat_results == seq_results
+    assert bat_fired == seq_fired
+    assert fsm_bat.snapshot(index) == fsm_seq.snapshot(index)
+    return fsm_bat
+
+
+# -- envelope lockstep -----------------------------------------------------
+
+
+class TestEnvelopeLockstep:
+    def test_healthy_join_burst(self):
+        ops = [(MessageType.REGISTER,
+                serf_register(f"n{i}", f"10.0.0.{i + 1}", HEALTH_PASSING))
+               for i in range(8)]
+        fsm = assert_lockstep([], ops)
+        assert len(fsm.store.nodes()[1]) == 8
+
+    def test_churn_mixed_batch(self):
+        seed = [(MessageType.REGISTER,
+                 serf_register(f"n{i}", f"10.0.0.{i + 1}", HEALTH_PASSING,
+                               service=NodeService(id="web", service="web",
+                                                   port=80)))
+                for i in range(3)]
+        ops = [
+            (MessageType.REGISTER,
+             serf_register("n9", "10.0.0.99", HEALTH_PASSING)),
+            (MessageType.REGISTER,
+             serf_register("n0", "10.0.0.1", HEALTH_CRITICAL)),
+            (MessageType.DEREGISTER, DeregisterRequest(node="n2")),
+        ]
+        fsm = assert_lockstep(seed, ops)
+        _, checks = fsm.store.node_checks("n0")
+        assert any(c.check_id == SERF_CHECK_ID
+                   and c.status == HEALTH_CRITICAL for c in checks)
+        assert fsm.store.get_node("n2")[1] is None
+
+    def test_refute_after_detect_same_batch(self):
+        """Detect + refute for the same member inside one cadence: the
+        envelope applies both in arrival order, landing on the refuted
+        (passing) verdict exactly like the sequential loop."""
+        seed = [(MessageType.REGISTER,
+                 serf_register("n0", "10.0.0.1", HEALTH_PASSING))]
+        ops = [
+            (MessageType.REGISTER,
+             serf_register("n0", "10.0.0.1", HEALTH_CRITICAL)),
+            (MessageType.REGISTER,
+             serf_register("n0", "10.0.0.1", HEALTH_PASSING)),
+        ]
+        fsm = assert_lockstep(seed, ops)
+        _, checks = fsm.store.node_checks("n0")
+        assert [c.status for c in checks
+                if c.check_id == SERF_CHECK_ID] == [HEALTH_PASSING]
+
+    def test_failed_sub_is_isolated(self):
+        """A sub that raises yields a wire-safe error string in its
+        result slot; the other subs still apply (N independent
+        sequential entries would behave the same)."""
+        bad = KVSRequest(op=99, dir_ent=DirEntry(key="k"))
+        ops = [
+            (MessageType.REGISTER,
+             serf_register("n0", "10.0.0.1", HEALTH_PASSING)),
+            (MessageType.KVS, bad),
+            (MessageType.REGISTER,
+             serf_register("n1", "10.0.0.2", HEALTH_PASSING)),
+        ]
+        fsm = assert_lockstep([], ops)
+        results = fsm.apply(41, envelope(ops))
+        assert results[0] is None and results[2] is None
+        assert isinstance(results[1], str) and "ValueError" in results[1]
+        assert fsm.store.get_node("n1")[1] == "10.0.0.2"
+
+
+# -- reconciler coalescing (op builders against a stub server) -------------
+
+
+class _StubRaft:
+    def __init__(self):
+        self.peers = set()
+
+    async def add_peer(self, name):
+        self.peers.add(name)
+
+    async def remove_peer(self, name):
+        self.peers.discard(name)
+
+
+class _StubConfig:
+    node_name = "leader0"
+    datacenter = "dc1"
+
+
+class _StubServer:
+    """Just enough server for Reconciler: a real FSM behind
+    raft_apply_batch, applying each envelope at the next index."""
+
+    def __init__(self):
+        self.fsm = ConsulFSM()
+        self.store = self.fsm.store
+        self.raft = _StubRaft()
+        self.config = _StubConfig()
+        self.index = 100
+        self.batches = []
+
+    async def raft_apply_batch(self, ops):
+        self.batches.append(list(ops))
+        self.index += 1
+        return self.fsm.apply(self.index, envelope(ops))
+
+
+class TestReconcilerCoalesce:
+    def test_latest_wins_refute_after_detect(self):
+        async def main():
+            srv = _StubServer()
+            rec = Reconciler(srv)
+            merged0 = reconstats.events_merged
+            rec.note(Node(name="n0", addr="10.0.0.1", port=8301,
+                          state=STATE_DEAD))
+            rec.note(Node(name="n0", addr="10.0.0.1", port=8301,
+                          state=STATE_ALIVE))
+            assert len(rec) == 1
+            assert reconstats.events_merged == merged0 + 1
+            assert await rec.flush() == 1
+            assert len(srv.batches) == 1 and len(srv.batches[0]) == 1
+            _, checks = srv.store.node_checks("n0")
+            assert [c.status for c in checks
+                    if c.check_id == SERF_CHECK_ID] == [HEALTH_PASSING]
+        asyncio.run(main())
+
+    def test_store_compare_skips_clean_members(self):
+        async def main():
+            srv = _StubServer()
+            rec = Reconciler(srv)
+            rec.note(Node(name="n0", addr="10.0.0.1", port=8301,
+                          state=STATE_ALIVE))
+            assert await rec.flush() == 1
+            # Same member, same state, same addr: every op builder's
+            # store compare skips — nothing submitted.
+            rec.note(Node(name="n0", addr="10.0.0.1", port=8301,
+                          state=STATE_ALIVE))
+            assert await rec.flush() == 0
+            assert len(srv.batches) == 1
+        asyncio.run(main())
+
+    def test_left_member_deregisters(self):
+        async def main():
+            srv = _StubServer()
+            rec = Reconciler(srv)
+            rec.note(Node(name="n0", addr="10.0.0.1", port=8301,
+                          state=STATE_ALIVE))
+            await rec.flush()
+            rec.note(Node(name="n0", addr="10.0.0.1", port=8301,
+                          state=STATE_LEFT))
+            assert await rec.flush() == 1
+            assert srv.store.get_node("n0")[1] is None
+        asyncio.run(main())
+
+    def test_submit_failure_drops_pending(self):
+        async def main():
+            srv = _StubServer()
+
+            async def boom(ops):
+                raise RuntimeError("lost leadership")
+
+            srv.raft_apply_batch = boom
+            rec = Reconciler(srv)
+            fail0 = reconstats.submit_failures
+            rec.note(Node(name="n0", addr="10.0.0.1", port=8301,
+                          state=STATE_ALIVE))
+            assert await rec.flush() == 0
+            assert reconstats.submit_failures == fail0 + 1
+            # Pending was consumed, not retried: the periodic full
+            # reconcile owns the repair, same as the sequential loop.
+            assert len(rec) == 0
+        asyncio.run(main())
+
+
+# -- through-raft convergence (live cluster) -------------------------------
+
+from tests.test_server_cluster import (  # noqa: E402
+    make_servers, start_and_elect, stop_all, wait_until)
+
+
+def _serf_status(srv, name):
+    _, checks = srv.store.node_checks(name)
+    for c in checks:
+        if c.check_id == SERF_CHECK_ID:
+            return c.status
+    return None
+
+
+def test_batched_reconcile_converges_across_leader_change():
+    """Members injected into the batched reconcile land identically on
+    every server, and a leader change mid-stream hands the stream to
+    the new leader's reconciler without losing members."""
+    async def main():
+        _, servers = make_servers(3)
+        leader = await start_and_elect(servers)
+        first = [f"g{i}" for i in range(6)]
+        for i, g in enumerate(first):
+            leader.membership_notify("member-join", Node(
+                name=g, addr=f"10.9.0.{i + 1}", port=8301,
+                state=STATE_ALIVE))
+        await wait_until(
+            lambda: all(_serf_status(s, g) == HEALTH_PASSING
+                        for s in servers for g in first),
+            msg="first batch replicated everywhere")
+
+        # Depose the leader; the stream continues on its successor.
+        await leader.stop()
+        rest = [s for s in servers if s is not leader]
+        await wait_until(
+            lambda: any(s.is_leader() for s in rest), msg="re-election")
+        leader2 = next(s for s in rest if s.is_leader())
+        for i, g in enumerate(first):
+            leader2.membership_notify("member-failed", Node(
+                name=g, addr=f"10.9.0.{i + 1}", port=8301,
+                state=STATE_DEAD))
+        await wait_until(
+            lambda: all(_serf_status(s, g) == HEALTH_CRITICAL
+                        for s in rest for g in first),
+            msg="post-failover batch replicated")
+        # Byte-identical stores on the survivors.
+        assert rest[0].fsm.snapshot(0) == rest[1].fsm.snapshot(0)
+        await stop_all(rest)
+    asyncio.run(main())
+
+
+def test_health_cache_byte_parity_with_cold_path():
+    """The FSM batch-boundary render hook pre-warms bytes that are
+    IDENTICAL to the cold Health.service_nodes pipeline, and the next
+    lookup serves them without re-rendering."""
+    async def main():
+        from consul_tpu.agent.hotpath import _dumps, attach_health_cache
+        from consul_tpu.agent.http_api import to_api
+
+        _, servers = make_servers(1)
+        leader = await start_and_elect(servers)
+        cache = attach_health_cache(leader)
+        await leader.catalog.register(RegisterRequest(
+            node="web1", address="10.9.1.1",
+            service=NodeService(id="web", service="web", port=80),
+            check=HealthCheck(node="web1", check_id=SERF_CHECK_ID,
+                              name=SERF_CHECK_NAME,
+                              status=HEALTH_PASSING)))
+        # Populate the cached variant, then flip the node through the
+        # batched reconcile: the hook must re-render it at the batch
+        # boundary.
+        cache.render("web", "", False)
+        leader.membership_notify("member-failed", Node(
+            name="web1", addr="10.9.1.1", port=8301, state=STATE_DEAD))
+        await wait_until(
+            lambda: _serf_status(leader, "web1") == HEALTH_CRITICAL,
+            msg="failed transition applied")
+
+        hits0 = cache.hits
+        row = cache.lookup(("web", "", False))
+        assert row is not None, "hook-rendered bytes were not index-valid"
+        assert cache.hits == hits0 + 1
+        _vidx, status, _ctype, body, hidx = row
+        meta, csns = await leader.health.service_nodes(
+            "web", QueryOptions(), "", False)
+        assert status == 200
+        assert body == _dumps(to_api(csns))
+        assert hidx == meta.index
+        # The hot bytes carry the fused verdict, not the stale one.
+        assert HEALTH_CRITICAL.encode() in body
+        await stop_all(servers)
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

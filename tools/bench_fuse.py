@@ -1,0 +1,220 @@
+"""Fused-planes reconcile bench: batched vs per-agent catalog writes.
+
+Boots a 3-node in-process cluster (the chaos-campaign cluster shape:
+MemoryTransport, compressed raft timings) per leg and drives synthetic
+gossip member transitions into the leader's reconcile queue — N
+simulated agents flipping alive/failed per round against held blocking
+watches on each agent's serfHealth check.  Per leg it reports:
+
+    entries_per_transition  raft log entries appended / transitions
+    p50_ms / p99_ms         detection -> watcher-visible latency (the
+                            membership_notify stamp to the blocking
+                            query waking with the new verdict)
+
+Legs: ``sequential`` (extra["reconcile_batched"]=False — the per-agent
+loop, one append+quorum per transition) and ``batch=N`` for each
+``--batch-sizes`` tier (the PR-18 fused path: one BATCH envelope per
+drain cadence).  The PR-18 acceptance bar is checked in-process: the
+batch>=64 tier must cut raft entries per transition >=10x below
+sequential without regressing p99 (the p99 gate is skipped under
+``--fast`` — smoke boxes are too noisy to pin a latency bar).
+
+Output is one JSON object shaped for obs/tuner.py's ``adapt_fuse``
+evidence adapter; ``--out`` (default BENCH_FUSE.json, '' skips —
+``--fast`` skips unless --out is explicit) feeds the
+``reconcile_batch_max`` autotune rule.
+
+Run:    python tools/bench_fuse.py [--agents 64] [--rounds 8]
+                                   [--batch-sizes 8,64] [--fast]
+                                   [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from consul_tpu.consensus.raft import MemoryTransport, RaftConfig  # noqa: E402
+from consul_tpu.membership.swim import (                           # noqa: E402
+    STATE_ALIVE, STATE_DEAD, Node)
+from consul_tpu.server.server import Server, ServerConfig          # noqa: E402
+from consul_tpu.structs.structs import (                           # noqa: E402
+    HEALTH_CRITICAL, HEALTH_PASSING, QueryOptions, SERF_CHECK_ID)
+
+NODE_NAMES = ("b0", "b1", "b2")
+
+
+def _bench_raft() -> RaftConfig:
+    # The chaos-campaign compressed envelope: election settles in
+    # ~0.2s, appends commit in single-digit milliseconds, so the
+    # coalescing win dominates the measurement rather than timeouts.
+    return RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.1,
+                      election_timeout_max=0.2, rpc_timeout=0.05)
+
+
+def _leader(servers):
+    for s in servers:
+        if s.is_leader():
+            return s
+    return None
+
+
+async def _boot(extra: dict):
+    tr = MemoryTransport()
+    names = list(NODE_NAMES)
+    servers = [Server(ServerConfig(node_name=nm, peers=names,
+                                   raft=_bench_raft(), extra=dict(extra)),
+                      transport=tr)
+               for nm in names]
+    for s in servers:
+        await s.start()
+    deadline = time.monotonic() + 10.0
+    while _leader(servers) is None:
+        if time.monotonic() > deadline:
+            raise TimeoutError("no leader elected")
+        await asyncio.sleep(0.01)
+    # Let the leader's establish barrier land so the reconcile loop is
+    # armed before the first injection.
+    await asyncio.sleep(0.3)
+    return servers
+
+
+async def _watch(srv, name: str, want_status: str, t0s: dict,
+                 lats: list) -> None:
+    """Hold a blocking query on ``name``'s checks until serfHealth
+    reads ``want_status``; stamp detection->visible on wake.  The index
+    floor is 1, never 0: min_query_index=0 is the non-blocking fast
+    path, and an empty store reports index 0 — looping on it would spin
+    without ever yielding."""
+    idx = 1
+    while True:
+        meta, checks = await srv.health.node_checks(name, QueryOptions(
+            min_query_index=idx, max_query_time=2.0))
+        serf = next((c for c in checks if c.check_id == SERF_CHECK_ID),
+                    None)
+        if serf is not None and serf.status == want_status:
+            lats.append((time.monotonic() - t0s[name]) * 1000.0)
+            return
+        idx = max(idx, meta.index, 1)
+
+
+async def _run_leg(extra: dict, agents: int, rounds: int) -> dict:
+    servers = await _boot(extra)
+    try:
+        names = [f"sim{i:03d}" for i in range(agents)]
+        addrs = {nm: f"10.77.{i // 250}.{i % 250 + 1}"
+                 for i, nm in enumerate(names)}
+        lats: list = []
+        transitions = 0
+        entries = 0
+        for r in range(rounds):
+            ld = _leader(servers)
+            if ld is None:
+                raise RuntimeError("lost leader mid-bench")
+            alive = r % 2 == 0
+            want = HEALTH_PASSING if alive else HEALTH_CRITICAL
+            state = STATE_ALIVE if alive else STATE_DEAD
+            kind = "member-join" if alive else "member-failed"
+            t0s: dict = {}
+            watchers = [asyncio.create_task(
+                _watch(ld, nm, want, t0s, lats)) for nm in names]
+            await asyncio.sleep(0.05)  # watchers parked on min_index
+            before = ld.raft.last_log_index()
+            # One synchronous burst, the gossip evbatch shape: every
+            # put_nowait lands before the reconcile loop wakes.
+            for nm in names:
+                t0s[nm] = time.monotonic()
+                ld.membership_notify(kind, Node(
+                    name=nm, addr=addrs[nm], port=8301, state=state))
+            await asyncio.wait_for(asyncio.gather(*watchers),
+                                   timeout=30.0)
+            entries += ld.raft.last_log_index() - before
+            transitions += agents
+        lat = sorted(lats) or [0.0]
+
+        def pct(q: float) -> float:
+            return lat[min(len(lat) - 1, int(q / 100 * len(lat)))]
+
+        return {
+            "transitions": transitions,
+            "raft_entries": entries,
+            "entries_per_transition": round(entries / max(1, transitions),
+                                            4),
+            "p50_ms": round(pct(50), 2),
+            "p99_ms": round(pct(99), 2),
+        }
+    finally:
+        for s in servers:
+            await s.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--agents", type=int, default=64,
+                    help="simulated agents flipping state per round")
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--batch-sizes", default="8,64",
+                    help="comma list of reconcile_batch_max tiers")
+    ap.add_argument("--fast", action="store_true",
+                    help="smoke shape: 2 rounds, sequential + batch=64 "
+                         "only, p99 gate skipped, no artifact unless "
+                         "--out is explicit")
+    ap.add_argument("--out", default=None,
+                    help="JSON artifact path (default BENCH_FUSE.json; "
+                         "'' skips; --fast defaults to '')")
+    args = ap.parse_args()
+
+    rounds = 2 if args.fast else args.rounds
+    tiers = [64] if args.fast else sorted(
+        {int(b) for b in args.batch_sizes.split(",") if b.strip()})
+    out_path = args.out
+    if out_path is None:
+        out_path = "" if args.fast else os.path.join(REPO,
+                                                     "BENCH_FUSE.json")
+
+    runs = {}
+    print(f"[bench-fuse] sequential: {args.agents} agents x{rounds}",
+          file=sys.stderr)
+    runs["sequential"] = asyncio.run(_run_leg(
+        {"reconcile_batched": False}, args.agents, rounds))
+    for n in tiers:
+        print(f"[bench-fuse] batch={n}: {args.agents} agents x{rounds}",
+              file=sys.stderr)
+        runs[f"batch={n}"] = asyncio.run(_run_leg(
+            {"reconcile_batch_max": n}, args.agents, rounds))
+
+    out = {"agents": args.agents, "rounds": rounds, "runs": runs}
+    text = json.dumps(out, indent=1)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(text + "\n")
+
+    # The PR-18 acceptance gate, checked where the numbers are made.
+    seq = runs["sequential"]
+    big = max((n for n in tiers if n >= 64), default=None)
+    if big is None:
+        return 0
+    b = runs[f"batch={big}"]
+    ratio = (seq["entries_per_transition"]
+             / max(b["entries_per_transition"], 1e-9))
+    ok = ratio >= 10.0
+    if not args.fast:
+        ok = ok and b["p99_ms"] <= seq["p99_ms"] * 1.05
+    print(f"[bench-fuse] batch={big}: {ratio:.1f}x fewer raft entries "
+          f"per transition ({seq['entries_per_transition']} -> "
+          f"{b['entries_per_transition']}), p99 "
+          f"{seq['p99_ms']}ms -> {b['p99_ms']}ms: "
+          f"{'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

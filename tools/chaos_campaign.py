@@ -35,7 +35,7 @@ def main(argv=None) -> int:
         "--scenario", action="append",
         choices=["clock_skew", "clock_jump", "fsync_stall", "leader_flap",
                  "asym_partition", "slow_follower",
-                 "worker_crash_under_load"],
+                 "worker_crash_under_load", "reconcile_fsync_stall"],
         help="scenario to run (repeatable); default: the full catalog")
     ap.add_argument("--fast", action="store_true",
                     help="run only the fast subset (the make chaos-fast / "

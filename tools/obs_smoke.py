@@ -13,6 +13,10 @@ checks the device/kernel observatory (obs/devstats.py): the
 ``consul_device_*``/``consul_kernel_*`` families plus
 ``consul_build_info``/``consul_up`` in the scrape, the
 ``/v1/agent/device`` JSON twin, and the bundle's ``device/`` member.
+It also drives a synthetic member burst through the leader's batched
+reconcile (agent/reconcile.py) and holds the scrape to the
+``consul_reconcile_*`` families plus the bundle's ``reconcile/``
+member.
 
 The deep boot also exercises the autotune control plane (obs/tuner.py)
 end to end: a verdict is pre-settled into a throwaway
@@ -96,9 +100,21 @@ REQUIRED_AUTOTUNE = [
     "consul_autotune_resettles_total",
 ]
 
+# Batched-reconcile observatory families (agent/reconcile.py
+# reconstats) — the deep boot drives synthetic member transitions
+# through the leader's fused reconcile loop so these carry content.
+REQUIRED_RECONCILE = [
+    "consul_reconcile_batch_size_bucket",
+    "consul_reconcile_visible_latency_ms",
+    "consul_reconcile_batches_total",
+    "consul_reconcile_entries_coalesced_total",
+    "consul_reconcile_events_merged_total",
+    "consul_reconcile_submit_failures_total",
+]
+
 # Bundle manifest sections the acceptance contract names.
 REQUIRED_SECTIONS = {"metrics", "slo", "traces", "flight", "raft",
-                     "device", "autotune", "tasks"}
+                     "reconcile", "device", "autotune", "tasks"}
 
 # Device state-store observatory families (obs/storestats.py), present
 # on the third boot (device_store=True) after a little KV traffic with
@@ -159,6 +175,7 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
         host, port = agent.http.addr
         base = f"http://{host}:{port}"
         telemetry = bundle = None
+        rc_landed = 0
         if deep:
             # KV writes through raft group-commit populate the
             # append→quorum and commit→apply ladders; a ?consistent
@@ -169,6 +186,25 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
                     _put, f"{base}/v1/kv/obs-smoke/k{i}", b"v")
             await asyncio.to_thread(
                 _get, f"{base}/v1/kv/obs-smoke/k0?consistent")
+            # Fused-planes reconcile: a synchronous burst of synthetic
+            # member transitions into the leader's reconcile queue must
+            # coalesce into BATCH envelopes and land every node in the
+            # catalog (consul_reconcile_* families carry the evidence).
+            from consul_tpu.membership.swim import STATE_ALIVE
+            from consul_tpu.membership.swim import Node as GossipNode
+            ghosts = [f"obs-ghost{i}" for i in range(4)]
+            for i, g in enumerate(ghosts):
+                agent.server.membership_notify("member-join", GossipNode(
+                    name=g, addr=f"10.88.0.{i + 1}", port=8301,
+                    state=STATE_ALIVE))
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while asyncio.get_running_loop().time() < deadline:
+                rc_landed = sum(
+                    1 for g in ghosts
+                    if agent.server.store.get_node(g)[1] is not None)
+                if rc_landed == len(ghosts):
+                    break
+                await asyncio.sleep(0.05)
             telemetry = json.loads(await asyncio.to_thread(
                 _get, f"{base}/v1/operator/raft/telemetry"))
             bundle = await asyncio.to_thread(
@@ -181,7 +217,7 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
             _get, f"{base}/v1/agent/device"))
         autotune = json.loads(await asyncio.to_thread(
             _get, f"{base}/v1/operator/autotune"))
-        return text, slo, telemetry, bundle, device, autotune
+        return text, slo, telemetry, bundle, device, autotune, rc_landed
     finally:
         if agent is not None:
             await agent.stop()
@@ -262,8 +298,8 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
             errors.append(f"bundle manifest missing sections {sorted(missing)}")
         for want in ("metrics/prometheus.txt", "metrics/snapshot_start.json",
                      "metrics/snapshot_end.json", "raft/telemetry.json",
-                     "device/telemetry.json", "autotune/verdict.json",
-                     "tasks.txt", "config.json",
+                     "reconcile/telemetry.json", "device/telemetry.json",
+                     "autotune/verdict.json", "tasks.txt", "config.json",
                      "slo.json", "traces.json", "flight.json"):
             if want not in names:
                 errors.append(f"bundle missing file {want}")
@@ -274,6 +310,13 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
             rt = json.load(tar.extractfile("raft/telemetry.json"))
             if "timeline" not in rt:
                 errors.append("bundled raft telemetry has no timeline")
+        if "reconcile/telemetry.json" in names:
+            rt = json.load(tar.extractfile("reconcile/telemetry.json"))
+            for key in ("batches_total", "entries_coalesced",
+                        "reconciler_armed"):
+                if key not in rt:
+                    errors.append(f"bundled reconcile telemetry has no "
+                                  f"{key!r}")
         if "device/telemetry.json" in names:
             dt = json.load(tar.extractfile("device/telemetry.json"))
             if "enabled" not in dt:
@@ -311,14 +354,29 @@ async def main() -> int:
 
     print("[obs-smoke] starting plane (first boot compiles the kernel)...",
           flush=True)
-    text, slo, telemetry, bundle, device, autotune = \
+    text, slo, telemetry, bundle, device, autotune, rc_landed = \
         await _boot_and_scrape(deep=True)
     errors += check_text(text)
     series = list(_iter_series(text))
     names = {n for n, _ in series}
-    for want in REQUIRED + REQUIRED_RAFT + REQUIRED_DEVICE + REQUIRED_AUTOTUNE:
+    for want in (REQUIRED + REQUIRED_RAFT + REQUIRED_DEVICE +
+                 REQUIRED_AUTOTUNE + REQUIRED_RECONCILE):
         if want not in names:
             errors.append(f"required metric {want} not in scrape")
+    # Batched-reconcile ground truth behind the scraped families: every
+    # synthetic member must have landed in the catalog, through at
+    # least one BATCH envelope (reconstats is process-global, so the
+    # deep boot's counters are readable here).
+    from consul_tpu.agent.reconcile import reconstats
+    if rc_landed != 4:
+        errors.append(f"reconcile phase landed {rc_landed}/4 synthetic "
+                      "members in the catalog")
+    if reconstats.batches_total < 1:
+        errors.append("reconcile phase submitted no batch envelopes "
+                      f"(batches_total={reconstats.batches_total})")
+    if reconstats.submit_failures:
+        errors.append(f"reconcile phase had {reconstats.submit_failures} "
+                      "submit failures")
     # Autotune observatory: the route must cover the whole registry
     # with well-formed rows, the boot must have found the pre-settled
     # verdict, and every evidence-backed verdict row must have resolved
@@ -394,7 +452,7 @@ async def main() -> int:
     # detection fires.
     print(f"[obs-smoke] rebooting plane under nemesis={NEMESIS!r} "
           "(new static schedule recompiles)...", flush=True)
-    ntext, nslo, _, _, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
+    ntext, nslo, _, _, _, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
     nerrors = check_text(ntext)
     for fam in REQUIRED[:4]:
         want = fam + f'{{scenario="{NEMESIS}"}}'

@@ -69,6 +69,15 @@ ROLE_TRANSITION_GROUP = (
     "consul_tpu/agent/hotpath.py",
 )
 
+# fused write path (PR 18): the batched reconciler mirrors the
+# sequential leader handlers against the plane's event batches and the
+# FSM's BATCH envelope — touching any leg must re-vet all three
+FUSED_RECONCILE_GROUP = (
+    "consul_tpu/agent/reconcile.py",
+    "consul_tpu/gossip/plane.py",
+    "consul_tpu/consensus/fsm.py",
+)
+
 # `make vet` refuses to let the growing pass count rot the inner loop:
 # total analyzer time above this multiple of the previous recorded run
 # (the vet_report.json artifact) fails the build
@@ -104,6 +113,7 @@ def partner_groups() -> List[Tuple[str, ...]]:
         groups.append(tuple([g.governing.suffix]
                             + [s.suffix for s in g.satellites]))
     groups.append(ROLE_TRANSITION_GROUP)
+    groups.append(FUSED_RECONCILE_GROUP)
     return groups
 
 
@@ -365,7 +375,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
 
 __all__ = ["run_vet", "main", "VetResult", "PASSES", "LEGACY_PASSES",
-           "FLOW_PASSES", "ROLE_TRANSITION_GROUP", "result_to_json",
+           "FLOW_PASSES", "ROLE_TRANSITION_GROUP",
+           "FUSED_RECONCILE_GROUP", "result_to_json",
            "changed_paths", "expand_partners", "partner_groups",
            "prior_total_ms", "time_guard_exceeded", "slowest_passes",
            "TIME_GUARD_FACTOR", "TIME_GUARD_SLACK_MS"]
