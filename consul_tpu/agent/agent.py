@@ -1165,6 +1165,11 @@ class Agent:
         # value, resolution source (flag | verdict | default), evidence
         # keys, reason.  Operator surface like /v1/agent/slo — always on.
         router.add_get("/v1/operator/autotune", h(self._autotune))
+        # Transition journey ledger (obs/journey.py): per-stage latency
+        # banks + end-to-end SLO over the fused membership→catalog
+        # path.  Operator surface like /v1/agent/slo — always on
+        # (reports enabled=false when CONSUL_TPU_JOURNEY=0).
+        router.add_get("/v1/operator/journey", h(self._journey))
         # Observability surfaces, gated like /debug/pprof/* (http.go
         # EnableDebug): finished traces, the kernel flight recorder,
         # on-demand device profiling, and the one-shot incident bundle.
@@ -1240,6 +1245,15 @@ class Agent:
         hists += rc_hists
         summaries += rc_summaries
         labeled_counters += rc_counters
+        # Transition journey ledger (obs/journey.py): stage-labeled
+        # latency ladder + end-to-end detection→visible histogram over
+        # the fused membership→catalog path.  Absent families mean the
+        # ledger is compiled out (CONSUL_TPU_JOURNEY=0).
+        from consul_tpu.obs import journey as _journey
+        if _journey.journey is not None:
+            jy_hists, jy_counters = _journey.journey.families()
+            hists += jy_hists
+            labeled_counters += jy_counters
         # Device state-store observatory (obs/storestats.py): apply/match
         # dispatch ladders, batch shape, table health.  Present only when
         # device_store is on AND the CONSUL_TPU_DEV_OBS gate left the
@@ -1345,6 +1359,15 @@ class Agent:
         out = await self._autotune_merged()
         out.setdefault("backend", self.config.gossip_backend)
         return out
+
+    async def _journey(self, request):
+        """Transition journey ledger JSON (/v1/operator/journey):
+        per-stage latency banks, end-to-end histogram + SLO burn rate,
+        and the recent per-transition record ring (obs/journey.py)."""
+        from consul_tpu.obs import journey as _journey
+        if _journey.journey is None:
+            return _journey.disabled_wire()
+        return _journey.journey.wire()
 
     async def _raft_telemetry(self, request):
         """Consensus-plane telemetry JSON: raft stats, latency

@@ -18,6 +18,9 @@ agent over the window and returns a gzipped tarball of:
   + the leadership/election/lease event timeline
 * ``reconcile/telemetry.json`` — batched-reconcile observatory: batch
   shape, coalescing yield, detection→visible latency (agent/reconcile.py)
+* ``journey/telemetry.json`` — transition journey ledger: per-stage
+  latency banks, end-to-end SLO, recent per-transition records
+  (obs/journey.py)
 * ``device/telemetry.json`` — device/kernel observatory: dispatch
   hists, HBM occupancy, compile + roofline telemetry (obs/devstats.py)
 * ``autotune/verdict.json`` — autotune observatory: the knob
@@ -47,7 +50,7 @@ from consul_tpu.version import VERSION
 SECRET_FIELDS = ("encrypt", "acl_master_token", "acl_token")
 
 SECTIONS = ("metrics", "slo", "traces", "flight", "raft", "reconcile",
-            "device", "autotune", "tasks", "config")
+            "journey", "device", "autotune", "tasks", "config")
 
 
 def redacted_config(config: Any) -> Dict[str, Any]:
@@ -90,6 +93,7 @@ async def capture(agent: Any, seconds: float) -> bytes:
     rc["reconciler_armed"] = bool(
         leader is not None and getattr(leader, "reconciler", None))
     put_json("reconcile/telemetry.json", rc)
+    put_json("journey/telemetry.json", await agent._journey(None))
     put_json("device/telemetry.json", await agent._device(None))
     put_json("autotune/verdict.json", await agent._autotune(None))
     files["tasks.txt"] = debug.task_dump().encode()

@@ -27,6 +27,7 @@ import json
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from consul_tpu.obs import journey as _journey
 from consul_tpu.structs.structs import (
     KVSOp, KVSRequest, DirEntry, QueryOptions)
 
@@ -209,9 +210,13 @@ class HealthByteCache:
     def refresh(self, services) -> None:
         """FSM batch-boundary render hook: re-render every cached
         variant of the services a committed batch touched."""
+        jy = _journey.journey
+        t0 = time.monotonic() if jy is not None else 0.0
         for key in list(self.entries):
             if key[0] in services:
                 self.render(*key)
+        if jy is not None:
+            jy.note_render((time.monotonic() - t0) * 1000.0)
 
 
 def attach_health_cache(srv, max_entries: int = _KV_CACHE_MAX):

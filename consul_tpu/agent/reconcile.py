@@ -34,6 +34,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Tuple
 
+from consul_tpu.obs import journey as _journey
 from consul_tpu.obs.raftstats import LatencyHist
 from consul_tpu.structs.structs import (
     CONSUL_SERVICE_ID,
@@ -192,8 +193,12 @@ class Reconciler:
         if name in self.pending:
             reconstats.events_merged += 1
             # The first sighting's detection stamp is the honest one:
-            # the coalesced write makes BOTH transitions visible.
-            t0 = self.pending[name][1]
+            # the coalesced write makes BOTH transitions visible.  The
+            # journey record travels with the stamp for the same reason.
+            old, t0 = self.pending[name]
+            oj = getattr(old, "_journey", None)
+            if oj is not None:
+                member._journey = oj
         else:
             t0 = time.monotonic()
         self.pending[name] = (member, t0)
@@ -205,8 +210,10 @@ class Reconciler:
         pending, self.pending = self.pending, {}
         if not pending:
             return 0
+        jy = _journey.journey
         ops: List[Tuple[MessageType, Any]] = []
         stamps: List[float] = []
+        jrecs: List[Dict[str, Any]] = []
         for member, t0 in pending.values():
             try:
                 member_ops = await self._member_ops(member)
@@ -218,17 +225,32 @@ class Reconciler:
             if member_ops:
                 ops.extend(member_ops)
                 stamps.append(t0)
+                if jy is not None:
+                    rec = getattr(member, "_journey", None)
+                    if rec is None:
+                        rec = {"t0": t0, "t_enq": t0, "stages": {}}
+                    rec["name"] = member.name
+                    jrecs.append(rec)
         if not ops:
             return 0
+        # Arm the journey's single in-flight batch: the consensus/FSM/
+        # render/wake hooks stamp into it while the submit is in flight
+        # (one reconcile loop per leader — no overlap).
+        if jy is not None:
+            jy.arm(jrecs, time.monotonic())
         try:
             await self.srv.raft_apply_batch(ops)
         except Exception:
             reconstats.submit_failures += 1
+            if jy is not None:
+                jy.abort()
             return 0
         now = time.monotonic()
         for t0 in stamps:
             reconstats.visible_observe((now - t0) * 1000.0)
         reconstats.batch_done(len(ops))
+        if jy is not None:
+            jy.close()
         return len(ops)
 
     # -- op builders (mirror server/leader.py handlers 1:1) ----------------

@@ -375,6 +375,14 @@ async def _drive_fault(name: str, p: ChaosParams, broker: FaultBroker,
                 "entries_coalesced": reconstats.entries_coalesced,
                 "submit_failures": reconstats.submit_failures,
             }
+            # Journey-ledger baseline: the detect pass diffs the
+            # per-stage sums across the fault window and requires the
+            # stalled append->quorum stage to dominate the delta.
+            # None when the ledger is compiled out (gate skipped).
+            from consul_tpu.obs import journey as _journey
+            ev["journey_base"] = (
+                _journey.journey.stage_sums()
+                if _journey.journey is not None else None)
             ghosts = [f"ghost{i}" for i in range(8)]
             ev["ghosts"] = ghosts
             ev["ghost_failed"] = ghosts[:4]
@@ -513,15 +521,34 @@ def _detect(name: str, p: ChaosParams, servers: List[Server],
         if b and e:
             delta = _hist_delta(b["append_quorum"], e["append_quorum"])
             tail = _hist_tail(delta, 100.0)
+        # Journey detectability: across the fault window the ledger's
+        # stage-sum delta must be DOMINATED by append_quorum — the
+        # stalled disk is where the transition time went, and the
+        # ledger must say so.  Skipped (vacuously true) when the
+        # ledger is compiled out.
+        from consul_tpu.obs import journey as _journey
+        jbase = ev.get("journey_base")
+        journey_ok = True
+        jev: Dict[str, Any] = {"journey_dominant_stage": None}
+        if jbase is not None and _journey.journey is not None:
+            sums = _journey.journey.stage_sums()
+            jdelta = {s: round(sums[s] - jbase.get(s, 0.0), 3)
+                      for s in sums}
+            dominant = max(jdelta, key=lambda s: jdelta[s])
+            journey_ok = (dominant == "append_quorum"
+                          and jdelta["append_quorum"] > 0.0)
+            jev = {"journey_dominant_stage": dominant,
+                   "journey_stage_delta_ms": jdelta}
         detected = (batches >= 1 and coalesced >= 1
                     and landed == len(ghosts)
-                    and states_ok == len(ghosts) and tail >= 1)
+                    and states_ok == len(ghosts) and tail >= 1
+                    and journey_ok)
         evidence = {"batches_delta": batches,
                     "entries_coalesced_delta": coalesced,
                     "submit_failures_delta": failures,
                     "ghosts": len(ghosts), "ghosts_in_catalog": landed,
                     "ghost_states_correct": states_ok,
-                    "append_quorum_ge_100ms": tail}
+                    "append_quorum_ge_100ms": tail, **jev}
     elif name == "leader_flap":
         lost = sum(e["leadership_lost"] - base.get(n, e)["leadership_lost"]
                    for n, e in end.items())

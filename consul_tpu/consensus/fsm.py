@@ -40,6 +40,7 @@ from consul_tpu.structs.structs import (
 
 from time import monotonic as _monotonic
 
+from consul_tpu.obs import journey as _journey
 from consul_tpu.obs import trace as obs_trace
 from consul_tpu.utils.telemetry import metrics
 
@@ -181,6 +182,8 @@ class ConsulFSM:
         the whole batch is still one device scatter.  BATCH never
         appears in snapshots — the sub-effects are plain store records.
         """
+        jy = _journey.journey
+        t_j0 = _monotonic() if jy is not None else 0.0
         subs = msgpack.unpackb(payload, raw=False)
         touched = self._batch_touched_services(subs)
         results: list = []
@@ -190,6 +193,8 @@ class ConsulFSM:
                 results.append(self.apply(index, sub))
             except Exception as exc:
                 results.append(f"{type(exc).__name__}: {exc}")
+        if jy is not None:
+            jy.note_fsm_apply((_monotonic() - t_j0) * 1000.0)
         hook = self.health_render_hook
         if hook is not None:
             self._batch_touched_services(subs, touched)

@@ -74,6 +74,8 @@ from typing import Any, Dict, List, Optional, Set
 import msgpack
 import numpy as np
 
+from consul_tpu.obs import journey as _journey
+
 EV_JOIN = "member-join"
 EV_LEAVE = "member-leave"
 EV_FAILED = "member-failed"
@@ -272,6 +274,10 @@ class GossipPlane:
         # event delivery.
         self._pending_events: List[Dict[str, Any]] = []
         self._dispatches_since_event_flush = 0
+        # Journey ledger: the round-start stamp of the dispatch whose
+        # verdicts are being queued (detect stage = device round to
+        # host-visible verdict).  0.0 while no dispatch is in flight.
+        self._journey_round0 = 0.0
         # Detection-latency observatory: on-device histogram banks
         # accumulated inside the same jit step, drained on the flight
         # cadence into the host recorder + SLO burn-rate tracker.
@@ -680,6 +686,11 @@ class GossipPlane:
 
         dev = self._dev
         t_disp = time.monotonic() if dev is not None else 0.0
+        # The journey's detect stage anchors on the same round-start
+        # stamp; take one when the device recorder didn't already.
+        self._journey_round0 = (
+            t_disp if t_disp else
+            (time.monotonic() if _journey.journey is not None else 0.0))
         fail = self._fail
         if self._nem_fail is not None:
             # Scenario-scheduled kills (absolute kernel rounds) override
@@ -1325,8 +1336,18 @@ class GossipPlane:
         structured batch.  Identity is resolved NOW (the admission
         table may reuse the id before the flush), so a detect queued
         before a same-cadence refute keeps its own snapshot."""
-        self._pending_events.append(
-            {"kind": kind, "node": self._member_wire(node)})
+        ev: Dict[str, Any] = {"kind": kind, "node": self._member_wire(node)}
+        jy = _journey.journey
+        if jy is not None:
+            now = time.monotonic()
+            detect_ms = ((now - self._journey_round0) * 1000.0
+                         if self._journey_round0 else -1.0)
+            jy.stage_observe("detect", detect_ms)
+            # Stamp carriage for downstream stages: [t_detect, t_flush,
+            # detect_ms] — monotonic floats, in-process comparisons only
+            # (the decode hook drops cross-process deltas).
+            ev["jt"] = [now, 0.0, round(detect_ms, 3)]
+        self._pending_events.append(ev)
 
     def _flush_member_events(self) -> None:
         """Ship the queued transitions as one ``evbatch`` frame — one
@@ -1336,6 +1357,14 @@ class GossipPlane:
         if not self._pending_events:
             return
         events, self._pending_events = self._pending_events, []
+        jy = _journey.journey
+        if jy is not None:
+            now = time.monotonic()
+            for ev in events:
+                jt = ev.get("jt")
+                if jt:
+                    jy.stage_observe("drain", (now - jt[0]) * 1000.0)
+                    jt[1] = now
         self._broadcast({"t": "evbatch", "events": events})
 
     def _broadcast_member_event(self, kind: str, node: PlaneNode) -> None:

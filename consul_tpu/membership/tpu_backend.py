@@ -34,6 +34,7 @@ import msgpack
 from consul_tpu.membership.serf import SerfConfig
 from consul_tpu.membership.swim import (
     EV_FAILED, EV_JOIN, EV_LEAVE, Node, STATE_ALIVE, STATE_DEAD, STATE_LEFT)
+from consul_tpu.obs import journey as _journey
 
 EV_USER = "user"
 
@@ -319,7 +320,8 @@ class TpuSerfPool:
             # the burst coalesces into one raft envelope downstream.
             for ev in m.get("events") or []:
                 self._handle_member_event(ev.get("kind"),
-                                          ev.get("node") or {})
+                                          ev.get("node") or {},
+                                          ev.get("jt"))
         elif t == "stats":
             fut = getattr(self, "_stats_future", None)
             if fut is not None and not fut.done():
@@ -352,13 +354,37 @@ class TpuSerfPool:
                 "payload": m.get("payload", b""),
                 "cc": m.get("coalesce", True)})
 
-    def _handle_member_event(self, kind: str, wire: Dict[str, Any]) -> None:
+    def _handle_member_event(self, kind: str, wire: Dict[str, Any],
+                             jt: Optional[List[float]] = None) -> None:
         """Shared by the single-event and batched frames: merge-gate,
-        membership table update, agent notification."""
+        membership table update, agent notification.  ``jt`` is the
+        journey stamp carriage from the evbatch frame ([t_detect,
+        t_flush, detect_ms], obs/journey.py) — folded and re-attached
+        to the Node so the reconcile path can keep the chain going."""
         node = self._node_from_wire(wire)
         if self.member_filter is not None and \
                 not self.member_filter(node):
             return  # merge delegate (consul/merge.go) still applies
+        jy = _journey.journey
+        if jy is not None and jt:
+            now = time.monotonic()
+            t_flush = jt[1] if len(jt) > 1 else 0.0
+            stages: Dict[str, float] = {}
+            if len(jt) > 2 and jt[2] >= 0.0:
+                stages["detect"] = jt[2]
+            if t_flush:
+                drain_ms = round((t_flush - jt[0]) * 1000.0, 3)
+                decode_ms = round((now - t_flush) * 1000.0, 3)
+                jy.stage_observe("decode", decode_ms)
+                if drain_ms >= 0.0:
+                    stages["drain"] = drain_ms
+                if decode_ms >= 0.0:
+                    stages["decode"] = decode_ms
+            # Monotonic stamps only compare in-process: a cross-process
+            # plane yields a bogus t0, so anchor the journey at decode
+            # time unless the detect stamp is plausibly ours.
+            t0 = jt[0] if 0.0 <= (now - jt[0]) else now
+            node._journey = {"t0": t0, "prev": now, "stages": stages}
         if kind == EV_LEAVE:
             node.state = STATE_LEFT
             self._nodes.pop(node.name, None)

@@ -50,6 +50,14 @@ MS_EDGES: Tuple[float, ...] = (0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
 TIMELINE_CAP = 256    # leadership/election/lease events retained
 _PENDING_CAP = 1024   # in-flight append/commit stamps (leak guard)
 
+# Forward sink for the journey ledger (obs/journey.py): journey owns
+# the append→quorum measurement made HERE rather than re-stamping the
+# raft path — obs/journey.py sets this at import (it imports us, so
+# the reverse import would be a cycle).  None when the ledger is
+# compiled out or never imported; note_commit's forward is then one
+# None test.
+journey_sink: Optional[Any] = None
+
 
 def enabled() -> bool:
     """Observatory switch: CONSUL_TPU_RAFT_OBS=0 compiles it out (the
@@ -166,8 +174,10 @@ class RaftStats:
         now = time.monotonic()
         if self._append_pending:
             for idx in [i for i in self._append_pending if i <= commit_index]:
-                self.append_quorum.observe(
-                    (now - self._append_pending.pop(idx)) * 1000.0)
+                ms = (now - self._append_pending.pop(idx)) * 1000.0
+                self.append_quorum.observe(ms)
+                if journey_sink is not None:
+                    journey_sink.note_quorum(ms)
         if len(self._commit_pending) < _PENDING_CAP:
             self._commit_pending.append((commit_index, now))
 

@@ -303,6 +303,22 @@ def adapt_fuse(root: str = REPO_ROOT) -> List[Evidence]:
             if st.get(k) is not None:
                 rows.append(Evidence(f"fuse.{k}.{tier}", float(st[k]),
                                      src, "", stamp))
+        # Journey stage attribution (obs/journey.py): where a
+        # transition's end-to-end time went, per tier — lets the knob
+        # rules reason about the dominant stage instead of only the
+        # headline p99.  Absent for runs recorded before the ledger
+        # (or with CONSUL_TPU_JOURNEY=0).
+        jy = st.get("journey")
+        if isinstance(jy, dict):
+            for k in ("e2e_p50_ms", "e2e_p99_ms"):
+                if jy.get(k) is not None:
+                    rows.append(Evidence(f"fuse.journey_{k}.{tier}",
+                                         float(jy[k]), src, "", stamp))
+            for sname, share in sorted(
+                    (jy.get("stage_share") or {}).items()):
+                rows.append(Evidence(
+                    f"fuse.journey_stage_share.{sname}.{tier}",
+                    float(share), src, "", stamp))
     return rows
 
 
@@ -527,7 +543,19 @@ def _rule_unroll(table: EvidenceTable, fp: Dict[str, Any]):
 def _rule_flight_drain_every(table: EvidenceTable, fp: Dict[str, Any]):
     """Flight-recorder A/B (churn0 quiescent regime, with/without the
     ring): if the recorder costs >5% rounds/s, halve the host-transfer
-    cadence by doubling the dispatch interval."""
+    cadence by doubling the dispatch interval.  The journey ledger's
+    drain-stage attribution (fuse.journey_stage_share.drain.*) argues
+    the other direction: transitions spending most of their end-to-end
+    time queued for the event flush want a SHORTER cadence regardless
+    of recorder overhead."""
+    jr = None
+    jtiers: Dict[int, Any] = {}
+    for r in table.match("fuse.journey_stage_share.drain.batch"):
+        suffix = r.key.rpartition("batch")[2]
+        if suffix.isdigit():
+            jtiers[int(suffix)] = r
+    if jtiers:
+        jr = jtiers[max(jtiers)]
     by_n = _rps_by(table,
                    lambda p: (p["variant"] == "gossip"
                               and p["churn_ppm"] == 0
@@ -542,9 +570,21 @@ def _rule_flight_drain_every(table: EvidenceTable, fp: Dict[str, Any]):
         off, on = cands[False][0], cands[True][0]
         overhead = 0.0 if off <= 0 else max(0.0, 1.0 - on / off)
         every = 32 if overhead > 0.05 else 16
-        return (every, [cands[False][1], cands[True][1]],
-                f"flight overhead {overhead * 100:.1f}% at n={n} "
-                f"(off={off:.1f}, on={on:.1f} rounds/s)")
+        used = [cands[False][1], cands[True][1]]
+        reason = (f"flight overhead {overhead * 100:.1f}% at n={n} "
+                  f"(off={off:.1f}, on={on:.1f} rounds/s)")
+        if jr is not None and float(jr.value) > 0.5:
+            every = max(8, every // 2)
+            used.append(jr.key)
+            reason += (f"; journey: drain stage carries "
+                       f"{float(jr.value) * 100:.0f}% of transition "
+                       "time — cadence halved")
+        return (every, used, reason)
+    if jr is not None and float(jr.value) > 0.5:
+        return (8, [jr.key],
+                f"journey: drain stage carries "
+                f"{float(jr.value) * 100:.0f}% of transition time (no "
+                "recorder A/B measured) — cadence cut to 8")
     return None
 
 
@@ -640,10 +680,21 @@ def _rule_reconcile_batch_max(table: EvidenceTable, fp: Dict[str, Any]):
                 "no batch tier held >=10x entry reduction at a "
                 "non-regressed p99; default stands")
     best = max(ok)
-    return (best, used,
-            f"batch={best}: {cands[best][0]:.3f} entries/transition, "
-            f"p99 {table.get(f'fuse.p99_ms.batch{best}').value:.1f} ms "
-            f"vs sequential {float(seq.value):.1f} ms")
+    reason = (f"batch={best}: {cands[best][0]:.3f} entries/transition, "
+              f"p99 {table.get(f'fuse.p99_ms.batch{best}').value:.1f} ms "
+              f"vs sequential {float(seq.value):.1f} ms")
+    # Journey stage attribution at the chosen tier (obs/journey.py):
+    # name the dominant stage so the verdict records WHERE the batch
+    # tier's remaining latency lives, not just that the bar held.
+    shares = {r.key.split(".")[2]: float(r.value)
+              for r in table.match("fuse.journey_stage_share.")
+              if r.key.endswith(f".batch{best}")}
+    if shares:
+        dom = max(sorted(shares), key=lambda s: shares[s])
+        used.append(f"fuse.journey_stage_share.{dom}.batch{best}")
+        reason += (f"; journey: {shares[dom] * 100:.0f}% of the "
+                   f"remaining latency is the {dom} stage")
+    return (best, used, reason)
 
 
 # -- knob registry -----------------------------------------------------------
@@ -692,7 +743,8 @@ KNOBS: Dict[str, Knob] = {
         doc="Kernel rounds fused per scan iteration."),
     "flight_drain_every": Knob(
         default=16, kind="int", target="PlaneConfig.flight_drain_every",
-        rule=_rule_flight_drain_every, evidence=("bench.rps.",),
+        rule=_rule_flight_drain_every,
+        evidence=("bench.rps.", "fuse.journey_stage_share.drain."),
         doc="Dispatches between flight-ring host drains."),
     "http_workers": Knob(
         default=1, kind="int", target="AgentConfig.http_workers",

@@ -14,6 +14,7 @@ import asyncio
 import random
 from typing import Awaitable, Callable, Iterable, Optional
 
+from consul_tpu.obs import journey as _journey
 from consul_tpu.state.store import StateStore
 from consul_tpu.structs.structs import QueryMeta, QueryOptions
 
@@ -98,6 +99,16 @@ async def blocking_query(
         try:
             await run()
             if meta.index > opts.min_query_index:
+                # Journey wake stage: the first long-poll that RETURNS
+                # fresh data after a reconcile batch arms is "a watcher
+                # saw it" — stamped here (post re-query, the same point
+                # an external client measures) rather than at the
+                # waiter signal, which fires before any watcher task
+                # has actually resumed.  One None test when the ledger
+                # is off or nothing is armed (obs/journey.py).
+                jy = _journey.journey
+                if jy is not None:
+                    jy.note_wake()
                 return
             remaining = deadline - loop.time()
             if remaining <= 0:

@@ -25,6 +25,7 @@ from consul_tpu.consensus.log import FileLogStore, MemoryLogStore
 from consul_tpu.consensus.raft import (
     MemoryTransport, NotLeaderError as RaftNotLeaderError, RaftConfig, RaftNode)
 from consul_tpu.consensus.snapshot import FileSnapshotStore, MemorySnapshotStore
+from consul_tpu.obs import journey as _journey
 from consul_tpu.obs import trace as obs_trace
 from consul_tpu.server.leader import LeaderDuties
 from consul_tpu.state.tombstone_gc import TombstoneGC
@@ -199,6 +200,21 @@ class Server:
         drops on overflow — the periodic full reconcile repairs."""
         if self.reconcile_ch is None:
             return
+        jy = _journey.journey
+        if jy is not None:
+            now = time.monotonic()
+            rec = getattr(member, "_journey", None)
+            if rec is None:
+                # Direct injection (bench/chaos/tests): the journey
+                # starts here — which is the harness's own t0, so the
+                # e2e histogram matches the harness measurement.
+                member._journey = {"t0": now, "t_enq": now, "stages": {}}
+            else:
+                enq_ms = (now - rec["prev"]) * 1000.0
+                jy.stage_observe("enqueue", enq_ms)
+                if enq_ms >= 0.0:
+                    rec["stages"]["enqueue"] = round(enq_ms, 3)
+                rec["t_enq"] = now
         try:
             self.reconcile_ch.put_nowait((kind, member))
         except asyncio.QueueFull:

@@ -131,6 +131,7 @@ REGIMES = {
 
 class TestFullRoundParity:
     @pytest.mark.parametrize("regime", sorted(REGIMES))
+    @pytest.mark.slow
     def test_regime_parity(self, regime):
         """200 full rounds per regime: the entire SwimState — heard
         matrix, slot registers, counters — bit-identical to SWAR."""
@@ -162,6 +163,8 @@ class TestFullRoundParity:
             st = _end_state(SwimParams(**base, dissem=dissem), fail,
                             steps, ndev=8)
             _assert_state_equal(ref, st, f"sharded8/{dissem} ")
+
+    @pytest.mark.slow
 
     def test_nemesis_parity(self):
         """Fault-mask composition: _src_masks folds the nemesis edge

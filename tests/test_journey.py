@@ -1,0 +1,310 @@
+"""Transition-journey observatory tests (obs/journey.py, ISSUE 19).
+
+Covers the ledger at three levels:
+
+* unit — the armed-batch protocol (arm / stage notes / close / parked
+  wake / wakeless fallback / abort) and the family exposition against
+  tools/check_prom's strict checker;
+* hook — the membership backend's decode-stage stamping from the
+  evbatch ``jt`` carriage, including the cross-process clock guard,
+  and the compiled-out leg (``journey.journey is None`` must make
+  every hook a no-op on a live cluster);
+* end-to-end — a 3-node in-process cluster where the ledger's
+  end-to-end latency must agree with an independent harness
+  measurement of the same event (detection to first watcher served
+  fresh data), the acceptance bar bench_fuse enforces at scale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from consul_tpu.membership.serf import SerfConfig
+from consul_tpu.membership.swim import STATE_ALIVE, Node
+from consul_tpu.membership.tpu_backend import TpuSerfPool
+from consul_tpu.obs import journey as journey_mod
+from consul_tpu.obs import raftstats
+from consul_tpu.obs.journey import STAGES, JourneyStats
+from consul_tpu.structs.structs import (
+    HEALTH_PASSING, QueryOptions, SERF_CHECK_ID)
+
+from tests.test_server_cluster import (
+    make_servers, start_and_elect, stop_all, wait_until)
+
+# Mirror of the governing obs/journey.py STAGES tuple — pinned by the
+# vet table-drift pass (journey-stage union group).
+JOURNEY_STAGES = ("detect", "drain", "decode", "enqueue", "submit",
+                  "append_quorum", "fsm_apply", "render", "wake")
+
+
+def _rec(name: str, t0: float) -> dict:
+    return {"name": name, "t0": t0, "t_enq": t0, "stages": {}}
+
+
+def test_stage_enum_mirrors_governing_tuple():
+    assert JOURNEY_STAGES == STAGES
+
+
+# -- armed-batch protocol --------------------------------------------------
+
+
+class TestArmedBatch:
+    def test_wake_midflight_finalizes_at_close(self):
+        j = JourneyStats(budget=250.0)
+        t0 = time.monotonic()
+        j.arm([_rec("a", t0), _rec("b", t0)], time.monotonic())
+        j.note_quorum(3.0)
+        j.note_fsm_apply(1.0)
+        j.note_render(0.2)
+        j.note_wake()          # a watcher woke while the batch is armed
+        j.close()
+        assert j.transitions_total == 2
+        assert j.wakeless_total == 0
+        assert j.stage["wake"].wire()["count"] == 1
+        recs = j.records()
+        assert [r["name"] for r in recs] == ["a", "b"]
+        for r in recs:
+            assert r["e2e_ms"] >= 0.0
+            for s in ("submit", "append_quorum", "fsm_apply", "render",
+                      "wake"):
+                assert s in r["stages"], f"record missing stage {s}"
+
+    def test_parked_batch_finalized_by_wake(self):
+        """close() before any watcher ran parks the batch; the first
+        fresh-data long-poll return finalizes it with the wake stamp."""
+        j = JourneyStats(budget=250.0)
+        j.arm([_rec("a", time.monotonic())], time.monotonic())
+        j.close()
+        assert j.transitions_total == 0     # parked, nothing folded yet
+        j.note_wake()
+        assert j.transitions_total == 1
+        assert j.wakeless_total == 0
+        assert j.stage["wake"].wire()["count"] == 1
+        assert j.records()[0]["name"] == "a"
+
+    def test_parked_batch_wakeless_fallback_on_next_arm(self):
+        j = JourneyStats(budget=250.0)
+        j.arm([_rec("a", time.monotonic())], time.monotonic())
+        j.close()
+        j.arm([_rec("b", time.monotonic())], time.monotonic())
+        assert j.transitions_total == 1     # "a" folded, bounded at close
+        assert j.wakeless_total == 1
+        assert j.stage["wake"].wire()["count"] == 0
+
+    def test_abort_discards_armed_batch(self):
+        j = JourneyStats(budget=250.0)
+        j.arm([_rec("a", time.monotonic())], time.monotonic())
+        j.abort()
+        j.note_wake()                       # nothing armed or parked
+        assert j.transitions_total == 0
+        assert j.aborted_total == 1
+        assert j.records() == []
+
+    def test_negative_stage_deltas_dropped(self):
+        j = JourneyStats(budget=250.0)
+        j.stage_observe("decode", -1.0)
+        assert j.stage["decode"].wire()["count"] == 0
+        j.stage_observe("decode", 0.5)
+        assert j.stage["decode"].wire()["count"] == 1
+
+    def test_wire_shape(self):
+        j = JourneyStats(budget=250.0)
+        w = j.wire()
+        assert w["enabled"] is True
+        assert w["budget_ms"] == 250.0
+        assert set(w["stages"]) == set(STAGES)
+        for key in ("e2e", "slo", "transitions_total", "wakeless_total",
+                    "aborted_total", "records"):
+            assert key in w, f"wire missing {key!r}"
+        assert journey_mod.disabled_wire()["enabled"] is False
+
+
+# -- exposition ------------------------------------------------------------
+
+
+def test_families_pass_check_prom():
+    from consul_tpu.obs.prom import render_prometheus
+    from tools.check_prom import check_text
+
+    j = JourneyStats(budget=250.0)
+    j.stage_observe("detect", 1.0)
+    j.arm([_rec("x", time.monotonic())], time.monotonic())
+    j.note_quorum(2.0)
+    j.note_wake()
+    j.close()
+    hists, counters = j.families()
+    text = render_prometheus([], histograms=hists,
+                             labeled_counters=counters)
+    assert check_text(text) == []
+    # The stage ladder renders every label, zero-count stages included.
+    for s in STAGES:
+        assert f'consul_journey_stage_ms_bucket{{stage="{s}"' in text, \
+            f"stage {s} ladder missing from exposition"
+    assert "consul_journey_e2e_ms_bucket" in text
+    assert 'consul_journey_transitions_total{outcome="visible"}' in text
+    assert "consul_journey_wakeless_total" in text
+
+
+# -- backend decode-stage hook ---------------------------------------------
+
+
+class TestDecodeHook:
+    def _pool(self, events):
+        return TpuSerfPool(SerfConfig(node_name="jt-test"),
+                           on_event=lambda k, n: events.append((k, n)))
+
+    def test_evbatch_jt_carriage_stamps_and_reattaches(self):
+        saved = journey_mod.journey
+        journey_mod.journey = j = JourneyStats(budget=250.0)
+        try:
+            events = []
+            pool = self._pool(events)
+            t_detect = time.monotonic() - 0.010
+            t_flush = time.monotonic() - 0.002
+            pool._handle_member_event(
+                "member-join", {"name": "n0", "addr": "10.0.0.1",
+                                "port": 8301, "state": "alive"},
+                [t_detect, t_flush, 1.25])
+            assert len(events) == 1
+            node = events[0][1]
+            rec = node._journey
+            assert rec["t0"] == t_detect
+            assert rec["stages"]["detect"] == 1.25
+            assert rec["stages"]["drain"] >= 0.0
+            assert rec["stages"]["decode"] >= 0.0
+            assert j.stage["decode"].wire()["count"] == 1
+        finally:
+            journey_mod.journey = saved
+
+    def test_cross_process_clock_guard_reanchors_t0(self):
+        """A jt stamped by another process's monotonic clock can sit in
+        our future; the decode hook must re-anchor t0 at decode time
+        instead of producing a negative journey."""
+        saved = journey_mod.journey
+        journey_mod.journey = JourneyStats(budget=250.0)
+        try:
+            events = []
+            pool = self._pool(events)
+            future = time.monotonic() + 3600.0
+            pool._handle_member_event(
+                "member-join", {"name": "n1", "addr": "10.0.0.2",
+                                "port": 8301, "state": "alive"},
+                [future, future, 1.0])
+            rec = events[0][1]._journey
+            assert rec["t0"] <= time.monotonic()
+        finally:
+            journey_mod.journey = saved
+
+    def test_sink_installed_on_reset(self):
+        saved = journey_mod.journey
+        try:
+            j = JourneyStats(budget=250.0)
+            journey_mod._install(j)
+            assert raftstats.journey_sink is j
+            j.note_quorum(5.0)      # what note_commit forwards
+            assert j.stage["append_quorum"].wire()["count"] == 1
+        finally:
+            journey_mod.journey = saved
+            journey_mod._install(saved)
+
+
+# -- compiled-out leg ------------------------------------------------------
+
+
+def test_compiled_out_hooks_are_noops_on_live_cluster():
+    """With the ledger compiled out (CONSUL_TPU_JOURNEY=0 makes the
+    module singleton None), every hook along the fused path must reduce
+    to its attribute test: a transition still lands in the catalog."""
+    saved_j, saved_sink = journey_mod.journey, raftstats.journey_sink
+    journey_mod.journey = None
+    raftstats.journey_sink = None
+
+    async def main():
+        _, servers = make_servers(3)
+        leader = await start_and_elect(servers)
+        leader.membership_notify("member-join", Node(
+            name="dark0", addr="10.5.0.1", port=8301, state=STATE_ALIVE))
+
+        def landed():
+            _, checks = leader.store.node_checks("dark0")
+            return any(c.check_id == SERF_CHECK_ID
+                       and c.status == HEALTH_PASSING for c in checks)
+
+        await wait_until(landed, msg="transition applied with ledger off")
+        await stop_all(servers)
+
+    try:
+        asyncio.run(main())
+    finally:
+        journey_mod.journey = saved_j
+        raftstats.journey_sink = saved_sink
+
+
+# -- end-to-end agreement --------------------------------------------------
+
+
+@pytest.mark.skipif(journey_mod.journey is None,
+                    reason="journey ledger compiled out")
+def test_e2e_agrees_with_harness_measurement():
+    """One member burst against a 3-node cluster with a held watcher
+    per member: the ledger's worst per-record e2e must agree with the
+    harness's first-visible stamp (same two endpoints: the notify call
+    and the first long-poll served fresh data) — the in-process twin of
+    the bench_fuse 20% acceptance gate, with an absolute floor so a
+    sub-millisecond jitter can't flake the relative bar."""
+    async def main():
+        jy = journey_mod.journey
+        _, servers = make_servers(3)
+        leader = await start_and_elect(servers)
+        await asyncio.sleep(0.3)   # boot reconciles settle
+        jy.reset()
+        names = [f"jm{i}" for i in range(8)]
+        t0s: dict = {}
+        harness: list = []
+
+        async def watch(nm: str) -> None:
+            idx = 1
+            while True:
+                meta, checks = await leader.health.node_checks(
+                    nm, QueryOptions(min_query_index=idx,
+                                     max_query_time=2.0))
+                serf = next((c for c in checks
+                             if c.check_id == SERF_CHECK_ID), None)
+                if serf is not None and serf.status == HEALTH_PASSING:
+                    harness.append((time.monotonic() - t0s[nm]) * 1000.0)
+                    return
+                idx = max(idx, meta.index, 1)
+
+        watchers = [asyncio.create_task(watch(nm)) for nm in names]
+        await asyncio.sleep(0.1)   # watchers parked on min_index
+        for nm in names:
+            t0s[nm] = time.monotonic()
+            leader.membership_notify("member-join", Node(
+                name=nm, addr="10.5.1.1", port=8301, state=STATE_ALIVE))
+        await asyncio.wait_for(asyncio.gather(*watchers), timeout=15.0)
+
+        recs = [r for r in jy.records() if r["name"] in set(names)]
+        assert len(recs) == len(names), \
+            f"ledger closed {len(recs)}/{len(names)} burst records"
+        ledger_ms = max(r["e2e_ms"] for r in recs)
+        first_visible_ms = min(harness)
+        tol = max(0.25 * first_visible_ms, 5.0)
+        assert abs(ledger_ms - first_visible_ms) <= tol, \
+            (f"journey e2e {ledger_ms:.2f}ms vs harness first-visible "
+             f"{first_visible_ms:.2f}ms exceeds ±{tol:.2f}ms")
+        # The pipeline stages behind that number must all have fired.
+        sums = jy.stage_sums()
+        for s in ("submit", "append_quorum", "fsm_apply"):
+            assert sums[s] > 0.0, f"stage {s} never folded"
+        assert jy.stage["wake"].wire()["count"] >= 1
+        await stop_all(servers)
+
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -491,6 +491,8 @@ class TestKernelHist:
         assert int(np.asarray(hb.detect).sum()) == 1
         assert int(np.asarray(hb.dwell).sum()) == 1
 
+    @pytest.mark.slow
+
     def test_detect_bank_matches_crossval_oracle(self):
         """ISSUE 4 acceptance core: percentiles computed from the
         in-kernel detect bank equal the crossval oracle's ``pct`` over

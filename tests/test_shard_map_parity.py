@@ -74,12 +74,16 @@ class TestShardedParity:
         (ref, _), (out, _) = _run_both(640, 400)[:2]
         _assert_state_equal(ref, out)
 
+    @pytest.mark.slow
+
     def test_state_parity_loss_pushpull_hot(self):
         """The branchy regimes at once: iid packet loss, periodic
         push-pull anti-entropy, and the hot-tier tail dispatch."""
         (ref, _), (out, _) = _run_both(
             640, 400, hot_slots=4, loss_rate=0.02, pushpull_every=50)[:2]
         _assert_state_equal(ref, out)
+
+    @pytest.mark.slow
 
     def test_trace_and_flight_parity(self):
         """RoundTrace series and the FlightRing rows are derived from
@@ -116,6 +120,8 @@ class TestShardedParity:
         assert int(np.asarray(ref_hb.detect).sum()) >= 5
         assert int(np.asarray(ref_hb.spread).sum()) > 0
 
+    @pytest.mark.slow
+
     def test_hist_bank_parity_loss_pushpull_hot(self):
         """Banks stay bit-identical through the branchy regimes too:
         iid packet loss, push-pull anti-entropy, the hot tail."""
@@ -125,6 +131,8 @@ class TestShardedParity:
         _assert_state_equal(ref[0], out[0])
         _assert_hist_equal(ref[1], out[1])
         assert int(np.asarray(ref[1].detect).sum()) > 0
+
+    @pytest.mark.slow
 
     def test_hist_flight_trace_triple_carry(self):
         """All three observability carriers at once — (state, flight,
@@ -178,6 +186,8 @@ class TestShardedParity:
             _check_shardable(lan_profile(8 * 13), 8)  # 104 % probe_every(5)
         _check_shardable(lan_profile(640), 8)  # aligned: no raise
 
+    @pytest.mark.slow
+
     def test_hot_default_parity(self):
         """Satellite: lan_profile now defaults hot_slots=8; the hot
         tail must engage (few live episodes, S > hot_slots) and stay
@@ -197,6 +207,8 @@ class TestShardedParity:
         a, _ = run_rounds(init_state(p_hot), key, fail, p_hot, steps=300)
         b, _ = run_rounds(init_state(p_full), key, fail, p_full, steps=300)
         _assert_state_equal(a, b)
+
+    @pytest.mark.slow
 
     def test_multidc_lan_devices_parity(self):
         """DC x shard composition: multidc with lan_devices=8 equals
@@ -222,6 +234,8 @@ class TestShardedParity:
         _assert_state_equal(a.lan, b.lan, "lan ")
         _assert_state_equal(a.wan, b.wan, "wan ")
         assert np.array_equal(np.asarray(cov_a), np.asarray(cov_b))
+
+    @pytest.mark.slow
 
     def test_multidc_hist_parity(self):
         """Per-DC observatory banks through the DC x shard composition:
@@ -309,6 +323,8 @@ class TestNemesisParity:
     Tier-1 runs the maximal-carry scenario (degraded_observer: state +
     hist + NemState) at compile-budget scale; the rest of the catalog
     (including partition_heal's dwell coverage) is @slow."""
+
+    @pytest.mark.slow
 
     def test_degraded_observer_parity(self):
         ref, out, nem = _run_both_nemesis("degraded_observer", n=160,

@@ -7,6 +7,7 @@ seeded PRNG, compressed timers, assertions on protocol invariants.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from consul_tpu.gossip.kernel import NEVER, PHASE_FREE, init_state, run_rounds
 from consul_tpu.gossip.params import SwimParams
@@ -119,6 +120,9 @@ def test_determinism():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
+
+
 def test_detection_time_scales_with_suspicion_mult():
     fail = None
     times = []
@@ -162,6 +166,9 @@ def test_quiescent_path_is_exact():
     # All slots recycled after the episode: back to quiescent.
     assert int(jnp.sum((st.slot_phase != PHASE_FREE).astype(jnp.int32))) == 0
     assert int(jnp.sum(st.heard)) == 0
+
+
+@pytest.mark.slow
 
 
 def test_dissemination_strategies_bit_identical():

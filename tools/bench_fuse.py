@@ -10,6 +10,10 @@ watches on each agent's serfHealth check.  Per leg it reports:
     p50_ms / p99_ms         detection -> watcher-visible latency (the
                             membership_notify stamp to the blocking
                             query waking with the new verdict)
+    first_visible_*_ms      per-burst minimum of the same stamps — the
+                            first watcher served fresh data, which is
+                            what the journey ledger's wake stage
+                            measures (cross-checked in the gate below)
 
 Legs: ``sequential`` (extra["reconcile_batched"]=False — the per-agent
 loop, one append+quorum per transition) and ``batch=N`` for each
@@ -44,6 +48,7 @@ sys.path.insert(0, REPO)
 from consul_tpu.consensus.raft import MemoryTransport, RaftConfig  # noqa: E402
 from consul_tpu.membership.swim import (                           # noqa: E402
     STATE_ALIVE, STATE_DEAD, Node)
+from consul_tpu.obs import journey as _journey                     # noqa: E402
 from consul_tpu.server.server import Server, ServerConfig          # noqa: E402
 from consul_tpu.structs.structs import (                           # noqa: E402
     HEALTH_CRITICAL, HEALTH_PASSING, QueryOptions, SERF_CHECK_ID)
@@ -105,13 +110,44 @@ async def _watch(srv, name: str, want_status: str, t0s: dict,
         idx = max(idx, meta.index, 1)
 
 
+def _journey_leg() -> dict:
+    """Stage breakdown of the leg just run (obs/journey.py): exact
+    percentiles over the record ring's raw e2e values plus per-stage
+    banks and each stage's share of the total ledger time.  None when
+    the ledger is compiled out or never closed a batch (the sequential
+    loop never arms one)."""
+    jy = _journey.journey
+    if jy is None or jy.transitions_total == 0:
+        return None
+    vals = sorted(r["e2e_ms"] for r in jy.records())
+
+    def pct(q: float) -> float:
+        return vals[min(len(vals) - 1, int(q / 100 * len(vals)))]
+
+    sums = jy.stage_sums()
+    total = sum(sums.values()) or 1.0
+    return {
+        "transitions": jy.transitions_total,
+        "e2e_p50_ms": round(pct(50), 2),
+        "e2e_p99_ms": round(pct(99), 2),
+        "stages": {s: jy.stage[s].wire() for s in _journey.STAGES},
+        "stage_share": {s: round(sums[s] / total, 4)
+                        for s in _journey.STAGES},
+    }
+
+
 async def _run_leg(extra: dict, agents: int, rounds: int) -> dict:
     servers = await _boot(extra)
     try:
+        # Isolate the leg's ledger AFTER boot so the servers' own
+        # join reconciles don't ride the measurement.
+        if _journey.journey is not None:
+            _journey.journey.reset()
         names = [f"sim{i:03d}" for i in range(agents)]
         addrs = {nm: f"10.77.{i // 250}.{i % 250 + 1}"
                  for i, nm in enumerate(names)}
         lats: list = []
+        firsts: list = []
         transitions = 0
         entries = 0
         for r in range(rounds):
@@ -133,23 +169,38 @@ async def _run_leg(extra: dict, agents: int, rounds: int) -> dict:
                 t0s[nm] = time.monotonic()
                 ld.membership_notify(kind, Node(
                     name=nm, addr=addrs[nm], port=8301, state=state))
+            n0 = len(lats)
             await asyncio.wait_for(asyncio.gather(*watchers),
                                    timeout=30.0)
+            # First watcher served fresh data this burst — the harness
+            # twin of the journey ledger's wake stamp (per-watcher p99
+            # additionally carries the N-coroutine resume fan-out the
+            # pipeline ledger deliberately does not measure).
+            if len(lats) > n0:
+                firsts.append(min(lats[n0:]))
             entries += ld.raft.last_log_index() - before
             transitions += agents
         lat = sorted(lats) or [0.0]
+        fv = sorted(firsts) or [0.0]
 
-        def pct(q: float) -> float:
-            return lat[min(len(lat) - 1, int(q / 100 * len(lat)))]
+        def pct(q: float, vals=None) -> float:
+            vals = lat if vals is None else vals
+            return vals[min(len(vals) - 1, int(q / 100 * len(vals)))]
 
-        return {
+        out = {
             "transitions": transitions,
             "raft_entries": entries,
             "entries_per_transition": round(entries / max(1, transitions),
                                             4),
             "p50_ms": round(pct(50), 2),
             "p99_ms": round(pct(99), 2),
+            "first_visible_p50_ms": round(pct(50, fv), 2),
+            "first_visible_p99_ms": round(pct(99, fv), 2),
         }
+        jleg = _journey_leg()
+        if jleg is not None:
+            out["journey"] = jleg
+        return out
     finally:
         for s in servers:
             await s.stop()
@@ -213,6 +264,27 @@ def main() -> int:
           f"{b['entries_per_transition']}), p99 "
           f"{seq['p99_ms']}ms -> {b['p99_ms']}ms: "
           f"{'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    # Journey ledger gate: the always-on ledger must have seen every
+    # harness transition, and (full runs — smoke boxes are too noisy
+    # for a latency bar) its end-to-end p99 must agree within 20% with
+    # the harness's independent first-visible measurement (both stamp
+    # detect -> first watcher served fresh data; the per-watcher p99
+    # additionally carries the N-coroutine resume fan-out).
+    jb = b.get("journey")
+    if jb is not None:
+        hv = b["first_visible_p99_ms"]
+        jok = jb["transitions"] >= b["transitions"]
+        if not args.fast:
+            jok = jok and (abs(jb["e2e_p99_ms"] - hv)
+                           <= 0.2 * max(hv, 1e-9))
+        ok = ok and jok
+        print(f"[bench-fuse] journey: {jb['transitions']} transitions, "
+              f"e2e p99 {jb['e2e_p99_ms']}ms vs harness first-visible "
+              f"p99 {hv}ms: {'PASS' if jok else 'FAIL'}", file=sys.stderr)
+    elif _journey.journey is not None:
+        ok = False
+        print("[bench-fuse] journey: ledger enabled but recorded no "
+              "transitions: FAIL", file=sys.stderr)
     return 0 if ok else 1
 
 

@@ -112,9 +112,25 @@ REQUIRED_RECONCILE = [
     "consul_reconcile_submit_failures_total",
 ]
 
+# Journey-ledger stage labels (obs/journey.py STAGES) — mirrored here
+# so the vet table-drift pass pins this enumeration to the governing
+# tuple; every stage's labeled ladder must render (zeros included).
+JOURNEY_STAGES = ("detect", "drain", "decode", "enqueue", "submit",
+                  "append_quorum", "fsm_apply", "render", "wake")
+
+# Transition-journey observatory families (obs/journey.py) — the deep
+# boot's member burst closes at least one journey batch behind them.
+REQUIRED_JOURNEY = [
+    "consul_journey_stage_ms_bucket",
+    "consul_journey_e2e_ms_bucket",
+    "consul_journey_transitions_total",
+    "consul_journey_wakeless_total",
+]
+
 # Bundle manifest sections the acceptance contract names.
 REQUIRED_SECTIONS = {"metrics", "slo", "traces", "flight", "raft",
-                     "reconcile", "device", "autotune", "tasks"}
+                     "reconcile", "journey", "device", "autotune",
+                     "tasks"}
 
 # Device state-store observatory families (obs/storestats.py), present
 # on the third boot (device_store=True) after a little KV traffic with
@@ -174,7 +190,7 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
         await asyncio.sleep(1.0)
         host, port = agent.http.addr
         base = f"http://{host}:{port}"
-        telemetry = bundle = None
+        telemetry = bundle = journey = None
         rc_landed = 0
         if deep:
             # KV writes through raft group-commit populate the
@@ -205,8 +221,18 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
                 if rc_landed == len(ghosts):
                     break
                 await asyncio.sleep(0.05)
+            # One trailing transition arms a second journey batch,
+            # which finalizes the ghost burst's parked batch (no
+            # long-poller runs in this smoke, so nothing else would
+            # wake it) — making transitions_total deterministic below.
+            agent.server.membership_notify("member-join", GossipNode(
+                name="obs-ghost-flush", addr="10.88.0.250", port=8301,
+                state=STATE_ALIVE))
+            await asyncio.sleep(0.3)
             telemetry = json.loads(await asyncio.to_thread(
                 _get, f"{base}/v1/operator/raft/telemetry"))
+            journey = json.loads(await asyncio.to_thread(
+                _get, f"{base}/v1/operator/journey"))
             bundle = await asyncio.to_thread(
                 _get, f"{base}/v1/agent/debug/bundle?seconds=1")
         text = (await asyncio.to_thread(
@@ -217,7 +243,8 @@ async def _boot_and_scrape(nemesis: str = "", deep: bool = False):
             _get, f"{base}/v1/agent/device"))
         autotune = json.loads(await asyncio.to_thread(
             _get, f"{base}/v1/operator/autotune"))
-        return text, slo, telemetry, bundle, device, autotune, rc_landed
+        return (text, slo, telemetry, bundle, device, autotune, journey,
+                rc_landed)
     finally:
         if agent is not None:
             await agent.stop()
@@ -298,9 +325,10 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
             errors.append(f"bundle manifest missing sections {sorted(missing)}")
         for want in ("metrics/prometheus.txt", "metrics/snapshot_start.json",
                      "metrics/snapshot_end.json", "raft/telemetry.json",
-                     "reconcile/telemetry.json", "device/telemetry.json",
-                     "autotune/verdict.json", "tasks.txt", "config.json",
-                     "slo.json", "traces.json", "flight.json"):
+                     "reconcile/telemetry.json", "journey/telemetry.json",
+                     "device/telemetry.json", "autotune/verdict.json",
+                     "tasks.txt", "config.json", "slo.json", "traces.json",
+                     "flight.json"):
             if want not in names:
                 errors.append(f"bundle missing file {want}")
         if "metrics/prometheus.txt" in names:
@@ -316,6 +344,12 @@ def _check_bundle(bundle: bytes, errors: list) -> None:
                         "reconciler_armed"):
                 if key not in rt:
                     errors.append(f"bundled reconcile telemetry has no "
+                                  f"{key!r}")
+        if "journey/telemetry.json" in names:
+            jt = json.load(tar.extractfile("journey/telemetry.json"))
+            for key in ("enabled", "stages", "transitions_total"):
+                if key not in jt:
+                    errors.append(f"bundled journey telemetry has no "
                                   f"{key!r}")
         if "device/telemetry.json" in names:
             dt = json.load(tar.extractfile("device/telemetry.json"))
@@ -354,13 +388,14 @@ async def main() -> int:
 
     print("[obs-smoke] starting plane (first boot compiles the kernel)...",
           flush=True)
-    text, slo, telemetry, bundle, device, autotune, rc_landed = \
+    text, slo, telemetry, bundle, device, autotune, journey, rc_landed = \
         await _boot_and_scrape(deep=True)
     errors += check_text(text)
     series = list(_iter_series(text))
     names = {n for n, _ in series}
     for want in (REQUIRED + REQUIRED_RAFT + REQUIRED_DEVICE +
-                 REQUIRED_AUTOTUNE + REQUIRED_RECONCILE):
+                 REQUIRED_AUTOTUNE + REQUIRED_RECONCILE +
+                 REQUIRED_JOURNEY):
         if want not in names:
             errors.append(f"required metric {want} not in scrape")
     # Batched-reconcile ground truth behind the scraped families: every
@@ -377,6 +412,32 @@ async def main() -> int:
     if reconstats.submit_failures:
         errors.append(f"reconcile phase had {reconstats.submit_failures} "
                       "submit failures")
+    # Transition-journey observatory: every stage's labeled ladder must
+    # render (zero-count stages included — the ladder is always
+    # complete), and the /v1/operator/journey shell must carry the
+    # contract keys with at least one transition closed (the boot's own
+    # member reconcile; the ghost batch may still be parked awaiting a
+    # wake, which is fine — read surfaces lag by at most one batch).
+    for s in JOURNEY_STAGES:
+        want = f'consul_journey_stage_ms_bucket{{stage="{s}"}}'
+        if not _require_ok(want, series, errors):
+            errors.append(f"scrape missing journey stage ladder {want}")
+    if not (journey or {}).get("enabled"):
+        errors.append(f"/v1/operator/journey enabled = "
+                      f"{(journey or {}).get('enabled')!r}")
+    else:
+        for key in ("budget_ms", "stages", "e2e", "slo",
+                    "transitions_total", "wakeless_total", "records"):
+            if key not in journey:
+                errors.append(f"/v1/operator/journey missing key {key!r}")
+        jmissing = set(JOURNEY_STAGES) - set(journey.get("stages") or {})
+        if jmissing:
+            errors.append(f"/v1/operator/journey stages missing "
+                          f"{sorted(jmissing)}")
+        if journey.get("transitions_total", 0) < 4:
+            errors.append("journey ledger closed fewer transitions than "
+                          "the ghost burst (transitions_total="
+                          f"{journey.get('transitions_total')!r} < 4)")
     # Autotune observatory: the route must cover the whole registry
     # with well-formed rows, the boot must have found the pre-settled
     # verdict, and every evidence-backed verdict row must have resolved
@@ -452,7 +513,7 @@ async def main() -> int:
     # detection fires.
     print(f"[obs-smoke] rebooting plane under nemesis={NEMESIS!r} "
           "(new static schedule recompiles)...", flush=True)
-    ntext, nslo, _, _, _, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
+    ntext, nslo, _, _, _, _, _, _ = await _boot_and_scrape(nemesis=NEMESIS)
     nerrors = check_text(ntext)
     for fam in REQUIRED[:4]:
         want = fam + f'{{scenario="{NEMESIS}"}}'

@@ -28,6 +28,14 @@ consumer present the union must cover the registry exactly — a knob
 added anywhere without tuner coverage, or registered without a
 consumer, fails ``make vet``.
 
+A second UNION group pins the journey-ledger stage enumeration: the
+``STAGES`` tuple in ``consul_tpu/obs/journey.py`` governs, and the
+``JOURNEY_STAGES`` mirrors in ``tools/obs_smoke.py`` and
+``tests/test_journey.py`` (which enumerate the stage-labeled scrape
+ladder) must each cover it exactly.  Union semantics because "stage"
+is a label value, not a dispatched keyword — K02's stray scan would
+false-positive on unrelated ``stage=`` keywords.
+
 Codes:
 
 - **K01 key-set divergence**: a satellite table's keys differ from the
@@ -155,11 +163,18 @@ def extract_help_mentions(ctx: FileCtx, gauge: str
 def extract_str_tuple_var(ctx: FileCtx, varname: str
                           ) -> Optional[Tuple[Set[str], int]]:
     """Module-level ``VARNAME = ("a", "b", ...)`` string tuple/list —
-    the TUNED_FIELDS consumer-claim idiom."""
+    the TUNED_FIELDS consumer-claim idiom.  Annotated assignments
+    (``VARNAME: Tuple[str, ...] = (...)``) count too."""
     for node in ctx.tree.body:
+        target = None
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and node.targets[0].id == varname:
+                and isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target = node.target.id
+        if target == varname:
             keys = _str_tuple(node.value)
             if keys is not None:
                 return keys, node.lineno
@@ -245,6 +260,19 @@ GROUPS: Sequence[TableGroup] = (
                      "str_tuple_var", "TUNED_FIELDS"),
             TableRef("consul_tpu/state/device_store.py",
                      "str_tuple_var", "TUNED_FIELDS"),
+        ),
+    ),
+    TableGroup(
+        name="journey-stage",
+        keyword="stage",
+        union=True,
+        governing=TableRef("consul_tpu/obs/journey.py",
+                           "str_tuple_var", "STAGES"),
+        satellites=(
+            TableRef("tools/obs_smoke.py",
+                     "str_tuple_var", "JOURNEY_STAGES"),
+            TableRef("tests/test_journey.py",
+                     "str_tuple_var", "JOURNEY_STAGES"),
         ),
     ),
     TableGroup(
