@@ -21,7 +21,7 @@ UNIT_ARGS = --ignore=tests/test_blackbox.py --ignore=tests/test_linearizability.
 
 default: test
 
-ci: vet test integ chaos-fast tune-check bench-fuse-fast
+ci: vet vet-dyn test integ chaos-fast tune-check bench-fuse-fast
 
 # Unit + in-process integration tests (multi-node simulated in one
 # process with compressed timers, SURVEY.md §4).
@@ -33,11 +33,12 @@ test: vet
 integ:
 	$(PYTEST) tests/test_blackbox.py tests/test_linearizability.py
 
-# Static checks: byte-compile every source file, then the fourteen-pass
-# analyzer (tools/vet/: names, async-safety, JAX tracer-purity,
-# wire-schema drift, exception hygiene, donation safety,
-# shard-exactness, carry-contract, overflow, pallas-safety,
-# table-drift, fork-safety, interleave, role-transition — the `go vet`
+# Static checks: byte-compile every source file, then the
+# eighteen-pass analyzer (tools/vet/: names, async-safety, JAX
+# tracer-purity, wire-schema drift, exception hygiene, donation
+# safety, shard-exactness, carry-contract, overflow, pallas-safety,
+# table-drift, fork-safety, interleave, role-transition, and the four
+# cancel-safety passes Q01-Q04 — the `go vet`
 # role in an image without a Python linter).  Exit codes: 0 clean,
 # 1 findings, 2 parse error or time-guard trip.  Suppress per line
 # with `# noqa: CODE[,CODE]` or per finding in tools/vet/baseline.txt.
@@ -52,8 +53,10 @@ integ:
 # asyncio debug + warnings-as-errors + fd/thread/task leak audit over
 # the fast tier-1 slice, a forced-interleave re-run of the
 # lease/barrier + anti-entropy slices with a task switch at every
-# await, then a checkify smoke of one dissemination round per
-# strategy).
+# await, a cancel-injection sweep cancelling a victim task at every
+# distinct await point over the confirm-batch / reconcile-flush /
+# blocking-query scenarios, then a checkify smoke of one dissemination
+# round per strategy).  `make ci` runs vet-dyn right after vet.
 VET_PATHS = consul_tpu tests tools demo bench.py __graft_entry__.py
 vet:
 	$(PYTHON) -m compileall -q $(VET_PATHS)

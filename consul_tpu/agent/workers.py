@@ -121,10 +121,13 @@ class GatewayClient:
                 body = await _next_obj(reader, unpacker)
                 if fut is not None and not fut.done():
                     fut.set_result(body)
-        except asyncio.CancelledError:
-            self._fail_pending()
-            raise
         except (ConnectionError, asyncio.IncompleteReadError):
+            pass    # normal gateway loss; the finally fails callers
+        finally:
+            # This loop is the ONLY resolver of self._pending futures,
+            # so any exit — cancellation, connection loss, or an
+            # unexpected decode error — must fail the in-flight
+            # callers, or request() hangs forever on a dead reader.
             self._fail_pending()
 
     def _fail_pending(self) -> None:

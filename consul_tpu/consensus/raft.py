@@ -337,6 +337,16 @@ class RaftNode:
                     self._maybe_advance_commit()
         except asyncio.CancelledError:
             pass
+        finally:
+            # The pump is the only resolver of durability waiters while
+            # the node runs; if it dies — cancellation, or a bug
+            # escaping the retry path — every _wait_durable caller
+            # would hang until shutdown() drains the list.  Fail them
+            # now: the append was never acknowledged as durable.
+            for _idx, fut in self._durable_waiters:
+                if not fut.done():
+                    _fail_abandoned(fut, NotLeaderError(None))
+            self._durable_waiters = []
 
     async def _wait_durable(self, index: int) -> None:
         if index <= self.durable_index:

@@ -1,0 +1,47 @@
+"""Governing registry of every ``CONSUL_TPU_*`` environment gate.
+
+One place to answer "what knobs does this process read from the
+environment?" — the table-drift vet pass (tools/vet/table_drift.py,
+``check_env_gates``) holds the rest of the tree to it:
+
+- every ``CONSUL_TPU_*`` string literal anywhere in the tree must be a
+  registered gate (a typo'd gate name reads as "unset" forever and no
+  runtime check ever notices);
+- each gate's canonical reader module must still reference it (a gate
+  whose reader moved or died is dead configuration);
+- the README's environment-gate table must document exactly this set.
+
+Keep descriptions to one line; the authoritative semantics live at the
+reader, named in each description.
+"""
+
+from typing import Dict
+
+ENV_GATES: Dict[str, str] = {
+    "CONSUL_TPU_DEV_OBS":
+        "=0 compiles out the device/kernel observatory (obs/devstats.py)",
+    "CONSUL_TPU_RAFT_OBS":
+        "=0 compiles out the consensus observatory (obs/raftstats.py)",
+    "CONSUL_TPU_JOURNEY":
+        "=0 compiles out the transition-journey ledger (obs/journey.py)",
+    "CONSUL_TPU_JOURNEY_BUDGET_MS":
+        "journey wake-budget threshold in ms, default 250 (obs/journey.py)",
+    "CONSUL_TPU_AUTOTUNE":
+        "=0 ignores persisted autotune verdicts at boot (obs/tuner.py)",
+    "CONSUL_TPU_AUTOTUNE_DIR":
+        "overrides where autotune artifacts are read/written (obs/tuner.py)",
+    "CONSUL_TPU_COMPILE_CACHE":
+        "overrides the persistent jax compile-cache dir (gossip/plane.py)",
+    "CONSUL_TPU_DYN_REPORT":
+        "path the vet-dyn pytest plugin writes its leak report to "
+        "(tools/vet/dyn.py)",
+    "CONSUL_TPU_DYN_NANS":
+        "=1 turns on jax debug_nans in the vet-dyn sanitized slice "
+        "(tools/vet/dyn.py)",
+    "CONSUL_TPU_DYN_INTERLEAVE":
+        "=1 installs the forced-interleave Future shim: a task switch "
+        "at every await (tools/vet/dyn.py)",
+    "CONSUL_TPU_DYN_CANCEL":
+        "=1 runs the cancel-injection sweep: cancel a victim task at "
+        "each await point (tools/vet/dyn.py)",
+}

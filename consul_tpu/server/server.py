@@ -230,6 +230,16 @@ class Server:
         if fut is not None and not fut.done():
             fut.cancel()
             await asyncio.gather(fut, return_exceptions=True)
+        # Same obligation for the confirm-batch runners: they are
+        # spawned fire-and-forget, so stop() must cancel and AWAIT
+        # them.  Cancellation rides each runner's BaseException
+        # handler, which resolves its batch future before re-raising —
+        # joiners get an exception, never a hang.
+        runners = list(self._confirm_tasks)
+        for t in runners:
+            t.cancel()
+        if runners:
+            await asyncio.gather(*runners, return_exceptions=True)
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self.pool is not None:
@@ -443,7 +453,11 @@ class Server:
         future itself and poison its batchmates (matching
         ``_leader_confirm``'s shield)."""
         b = self._confirm_batches.get(key)
-        if b is None or b["fired"]:
+        if b is None or b["fired"] or b["fut"].done():
+            # fut done while unfired ⇒ the batch died before its work
+            # started (runner cancelled awaiting its predecessor) and
+            # the record is a tombstone: joining it would return the
+            # canceller's error to every future caller on this key.
             b = self._confirm_batches[key] = {
                 "fut": asyncio.get_event_loop().create_future(),
                 "fired": False}
@@ -460,14 +474,22 @@ class Server:
             if prev is not None and not prev.done():
                 try:
                     # Serialize batches; the previous batch's failure —
-                    # including cancellation — is its own.  Catching
-                    # BaseException here is load-bearing: a cancelled
-                    # prev would otherwise unwind THIS runner before it
-                    # fires, stranding an unfired batch whose joiners
+                    # including cancellation — is its own.  The shield
+                    # is load-bearing: ``prev`` is the PREVIOUS batch's
+                    # shared future, so awaiting it bare would let a
+                    # cancelled runner (server stop) cancel prev itself
+                    # and poison the predecessor's joiners.  Catching
+                    # BaseException is equally load-bearing: a failed
+                    # or cancelled prev must not unwind THIS runner
+                    # before it fires, or an unfired batch's joiners
                     # wait forever.
-                    await prev
+                    await asyncio.shield(prev)
                 except BaseException:  # noqa: E02,E03 — see comment above
-                    pass
+                    if not prev.done():
+                        # prev still pending ⇒ the CancelledError is
+                        # OURS (shield kept prev alive): bail through
+                        # the outer handler, which resolves b["fut"].
+                        raise
             b["fired"] = True   # new arrivals form the next batch
             self._confirm_prev[key] = b["fut"]
             result = await runner()

@@ -1,0 +1,234 @@
+"""Cancellation-safety regressions pinned by the vet Q-tier (Q01-Q04)
+and the vet-dyn cancel-injection sweep.
+
+Each test reproduces a real cancellation schedule the tier caught on
+this tree and asserts the hand-off contract that was broken:
+
+- a successor confirm-batch runner cancelled while serializing on its
+  predecessor must neither cancel the predecessor's shared future
+  (``asyncio.shield``) nor strand its own joiners (BaseException
+  handler resolves ``b["fut"]`` before re-raising);
+- a batch killed before it fired is a tombstone: new requests on the
+  key must form a fresh batch, not inherit the canceller's error;
+- ``Server.stop()`` must cancel AND await the fire-and-forget runners;
+- raft's ``_sync_pump`` is the only resolver of durability waiters, so
+  any pump exit — cancellation or an escaped bug — must fail them;
+- the gateway read loop is the only resolver of in-flight request
+  futures, so any exit must fail them (``request()`` would otherwise
+  hang forever on a dead reader).
+"""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from consul_tpu.agent.workers import GatewayClient
+from consul_tpu.consensus.raft import (
+    NotLeaderError as RaftNotLeaderError, RaftConfig, RaftNode)
+from consul_tpu.server.server import Server, ServerConfig
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def _bare_server() -> Server:
+    """Just the batching state — no raft, no pool, no store."""
+    srv = object.__new__(Server)
+    srv._confirm_batches = {}
+    srv._confirm_prev = {}
+    srv._confirm_tasks = set()
+    return srv
+
+
+class TestSuccessorRunnerCancellation:
+    """Batch A is in flight; batch B's runner awaits shield(prev)."""
+
+    async def _two_batches(self, srv, gate_a):
+        async def runner_a():
+            await gate_a.wait()
+            return "a"
+
+        async def runner_b():
+            return "b"
+
+        a_joiners = [asyncio.ensure_future(
+            srv._confirm_batched("ri", runner_a)) for _ in range(2)]
+        await asyncio.sleep(0.01)  # batch A fires, parks on gate_a
+        before = set(srv._confirm_tasks)
+        b_joiners = [asyncio.ensure_future(
+            srv._confirm_batched("ri", runner_b)) for _ in range(2)]
+        await asyncio.sleep(0.01)  # batch B's runner blocks on prev
+        runner_task = next(
+            t for t in srv._confirm_tasks if t not in before)
+        return a_joiners, b_joiners, runner_task
+
+    def test_cancelled_successor_spares_predecessor(self, loop):
+        async def body():
+            srv = _bare_server()
+            gate_a = asyncio.Event()
+            a_joiners, b_joiners, runner_b = (
+                await self._two_batches(srv, gate_a))
+            prev = srv._confirm_prev["ri"]  # batch A's shared future
+
+            runner_b.cancel()
+            await asyncio.sleep(0.01)
+            # The shield spared the predecessor: batch A is untouched
+            # and its joiners still resolve normally.
+            assert not prev.cancelled()
+            gate_a.set()
+            assert await asyncio.wait_for(a_joiners[0], 2.0) == "a"
+            assert await asyncio.wait_for(a_joiners[1], 2.0) == "a"
+            # Batch B's joiners were RESOLVED (with the cancellation),
+            # never stranded on an unfired batch.
+            for w in b_joiners:
+                with pytest.raises(asyncio.CancelledError):
+                    await asyncio.wait_for(w, 2.0)
+
+        loop.run_until_complete(body())
+
+    def test_dead_unfired_batch_is_a_tombstone(self, loop):
+        """A batch killed before it fired keeps ``fired=False`` with a
+        resolved future; joining it would hand the canceller's error to
+        every future caller on the key, forever."""
+        async def body():
+            srv = _bare_server()
+            gate_a = asyncio.Event()
+            a_joiners, b_joiners, runner_b = (
+                await self._two_batches(srv, gate_a))
+            runner_b.cancel()
+            gate_a.set()
+            await asyncio.gather(*a_joiners, *b_joiners, runner_b,
+                                 return_exceptions=True)
+            rec = srv._confirm_batches["ri"]
+            assert rec["fut"].done() and not rec["fired"]
+
+            async def fresh():
+                return "fresh"
+
+            got = await asyncio.wait_for(
+                srv._confirm_batched("ri", fresh), 2.0)
+            assert got == "fresh"
+
+        loop.run_until_complete(body())
+
+
+class TestStopDrainsConfirmRunners:
+    def test_stop_cancels_and_awaits_parked_runner(self, loop):
+        """A runner parked mid-confirmation when stop() is called must
+        be cancelled, awaited, and must resolve its batch future —
+        joiners get an exception, never a hang or a destroyed-pending
+        task at loop close."""
+        async def body():
+            srv = Server(ServerConfig(
+                node_name="solo",
+                raft=RaftConfig(heartbeat_interval=0.02,
+                                election_timeout_min=0.1,
+                                election_timeout_max=0.2,
+                                rpc_timeout=0.05)))
+            await srv.start()
+            await srv.wait_for_leader()
+            parked = asyncio.Event()
+
+            async def runner():
+                parked.set()
+                await asyncio.Event().wait()  # parks until cancelled
+
+            w = asyncio.ensure_future(
+                srv._confirm_batched("leader_ri", runner))
+            await asyncio.wait_for(parked.wait(), 2.0)
+            await asyncio.wait_for(srv.stop(), 5.0)
+            assert not srv._confirm_tasks
+            with pytest.raises(asyncio.CancelledError):
+                await asyncio.wait_for(w, 2.0)
+
+        loop.run_until_complete(body())
+
+
+class TestSyncPumpFailsDurabilityWaiters:
+    def _node(self) -> RaftNode:
+        return RaftNode("n0", ["n0"], fsm=None, transport=None)
+
+    def test_pump_cancellation_fails_waiters(self, loop):
+        async def body():
+            node = self._node()
+            pump = asyncio.ensure_future(node._sync_pump())
+            waiter = asyncio.ensure_future(node._wait_durable(5))
+            await asyncio.sleep(0.02)
+            assert not waiter.done()
+            pump.cancel()
+            await asyncio.gather(pump, return_exceptions=True)
+            with pytest.raises(RaftNotLeaderError):
+                await asyncio.wait_for(waiter, 2.0)
+
+        loop.run_until_complete(body())
+
+    def test_pump_escaped_bug_fails_waiters(self, loop):
+        """An exception escaping the pump's retry path (only fsync
+        errors are retried) must not leave waiters hanging until
+        shutdown."""
+        async def body():
+            node = self._node()
+
+            def boom():
+                raise ValueError("log store gone")
+
+            node.log.last_index = boom
+            pump = asyncio.ensure_future(node._sync_pump())
+            waiter = asyncio.ensure_future(node._wait_durable(5))
+            with pytest.raises(RaftNotLeaderError):
+                await asyncio.wait_for(waiter, 2.0)
+            await asyncio.gather(pump, return_exceptions=True)
+            assert isinstance(pump.exception(), ValueError)
+
+        loop.run_until_complete(body())
+
+
+class TestGatewayReadLoopFailsPending:
+    def test_unexpected_reader_error_fails_pending(self, loop):
+        """A decode/read error outside the expected connection-loss
+        classes must still fail in-flight requests — the read loop is
+        their only resolver."""
+        async def body():
+            gc = GatewayClient("/tmp/does-not-exist.sock")
+            fut = asyncio.get_event_loop().create_future()
+            gc._pending[7] = fut
+
+            class _Corrupt:
+                async def read(self, n):
+                    raise ValueError("corrupt frame")
+
+            task = asyncio.ensure_future(
+                gc._read_loop(_Corrupt(), msgpack.Unpacker(raw=False)))
+            with pytest.raises(ConnectionError):
+                await asyncio.wait_for(fut, 2.0)
+            await asyncio.gather(task, return_exceptions=True)
+            assert not gc._pending
+
+        loop.run_until_complete(body())
+
+    def test_reader_cancellation_fails_pending(self, loop):
+        async def body():
+            gc = GatewayClient("/tmp/does-not-exist.sock")
+            fut = asyncio.get_event_loop().create_future()
+            gc._pending[7] = fut
+
+            class _Hang:
+                async def read(self, n):
+                    await asyncio.Event().wait()
+
+            task = asyncio.ensure_future(
+                gc._read_loop(_Hang(), msgpack.Unpacker(raw=False)))
+            await asyncio.sleep(0.01)
+            task.cancel()
+            with pytest.raises(ConnectionError):
+                await asyncio.wait_for(fut, 2.0)
+            await asyncio.gather(task, return_exceptions=True)
+            assert not gc._pending
+
+        loop.run_until_complete(body())

@@ -1,4 +1,4 @@
-"""tools/vet — the twelve-pass static analyzer + dynamic harness.
+"""tools/vet — the eighteen-pass static analyzer + dynamic harness.
 
 Each pass gets one known-bad snippet (the planted defect it must
 catch) and one clean snippet (the idiomatic fix it must NOT flag),
@@ -8,6 +8,7 @@ baseline), the exit-code contract, and the ``--format json`` /
 holds the analyzer to its own standard.
 """
 
+import asyncio
 import json
 import subprocess
 import sys
@@ -16,7 +17,8 @@ from pathlib import Path
 
 import pytest
 
-from tools.vet import async_safety, carry_contract, donation, exceptions
+from tools.vet import async_safety, cancel_safety, carry_contract
+from tools.vet import donation, exceptions
 from tools.vet import fork_safety, interleave, names, overflow
 from tools.vet import pallas_safety, role_transition, shard_exact
 from tools.vet import table_drift, tracer_purity, wire_schema
@@ -1955,6 +1957,490 @@ class TestRoleTransition:
         assert role_transition.check(ctx) == []
 
 
+# -- cancellation safety (Q01-Q04) -------------------------------------------
+
+
+class TestCancelShield:
+    """Q01: bare await of a shared future propagates cancellation."""
+
+    def test_bare_await_of_shared_attr_future(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Batcher:
+                def __init__(self):
+                    self._fut = None
+
+                def arm(self):
+                    self._fut = asyncio.get_event_loop().create_future()
+
+                def fire(self, val):
+                    self._fut.set_result(val)
+
+                async def join(self):
+                    return await self._fut
+            """)
+        found = cancel_safety.check_q01(ctx)
+        assert _codes(found) == ["Q01"]
+        assert "poisons" in found[0].message
+
+    def test_shielded_await_is_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Batcher:
+                def __init__(self):
+                    self._fut = None
+
+                def arm(self):
+                    self._fut = asyncio.get_event_loop().create_future()
+
+                def fire(self, val):
+                    self._fut.set_result(val)
+
+                async def join(self):
+                    return await asyncio.shield(self._fut)
+            """)
+        assert cancel_safety.check_q01(ctx) == []
+
+    def test_bare_await_of_batch_record_future(self, tmp_path):
+        # the confirm-batch shape: a dict-of-dicts whose records carry
+        # the shared future under a "fut" key, fetched into a local
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Srv:
+                def __init__(self):
+                    self._batches = {}
+
+                async def confirm(self, key):
+                    b = self._batches.get(key)
+                    if b is None:
+                        b = self._batches[key] = {
+                            "fut": asyncio.get_event_loop()
+                            .create_future()}
+                    return await b["fut"]
+            """)
+        assert _codes(cancel_safety.check_q01(ctx)) == ["Q01"]
+
+    def test_teardown_join_after_own_cancel_is_clean(self, tmp_path):
+        # swap-then-cancel stop() idiom: the function reaps a task it
+        # itself cancelled — awaiting it bare IS the supervision
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class W:
+                def start(self):
+                    self._task = asyncio.ensure_future(self._run())
+
+                async def _run(self):
+                    await asyncio.sleep(1)
+
+                async def stop(self):
+                    t, self._task = self._task, None
+                    t.cancel()
+                    await t
+            """)
+        assert cancel_safety.check_q01(ctx) == []
+
+
+class TestFutureResolution:
+    """Q02: a created future must be resolved on every path."""
+
+    def test_local_future_never_resolved_never_escapes(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            def f():
+                fut = asyncio.get_event_loop().create_future()
+                return 1
+            """)
+        found = cancel_safety.check_q02(ctx)
+        assert _codes(found) == ["Q02"]
+        assert "never escapes" in found[0].message
+
+    def test_escaping_future_is_clean(self, tmp_path):
+        # returning the future hands resolution responsibility away
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            def f():
+                fut = asyncio.get_event_loop().create_future()
+                return fut
+            """)
+        assert cancel_safety.check_q02(ctx) == []
+
+    def test_await_escape_skips_resolution(self, tmp_path):
+        # a CancelledError out of _fetch() strands fut's waiters
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Pump:
+                async def run(self, fut):
+                    val = await self._fetch()
+                    fut.set_result(val)
+            """)
+        found = cancel_safety.check_q02(ctx)
+        assert _codes(found) == ["Q02"]
+        assert "stranded" in found[0].message
+
+    def test_base_exception_resolve_and_reraise_is_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Pump:
+                async def run(self, fut):
+                    try:
+                        val = await self._fetch()
+                    except BaseException as e:
+                        fut.set_exception(e)
+                        raise
+                    fut.set_result(val)
+            """)
+        assert cancel_safety.check_q02(ctx) == []
+
+    def test_shared_slot_future_nobody_resolves(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Reg:
+                def register(self, key):
+                    self._waiters[key] = (
+                        asyncio.get_event_loop().create_future())
+                    return self._waiters[key]
+            """)
+        found = cancel_safety.check_q02(ctx)
+        assert _codes(found) == ["Q02"]
+        assert "_waiters" in found[0].message
+
+    def test_sibling_resolver_discharges_shared_slot(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Reg:
+                def register(self, key):
+                    self._waiters[key] = (
+                        asyncio.get_event_loop().create_future())
+                    return self._waiters[key]
+
+                def resolve(self, key, val):
+                    self._waiters[key].set_result(val)
+            """)
+        assert cancel_safety.check_q02(ctx) == []
+
+
+class TestCancelHandoff:
+    """Q03: 'except Exception' around an await lets CancelledError
+    skip a must-happen hand-off."""
+
+    def test_exception_guard_over_handoff(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Confirm:
+                async def run(self, fut):
+                    try:
+                        val = await self._leader_confirm()
+                        fut.set_result(val)
+                    except Exception as e:
+                        fut.set_exception(e)
+            """)
+        found = cancel_safety.check_q03(ctx)
+        assert _codes(found) == ["Q03"]
+        assert "CancelledError escapes this handler" in found[0].message
+
+    def test_base_exception_split_is_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Confirm:
+                async def run(self, fut):
+                    try:
+                        val = await self._leader_confirm()
+                        fut.set_result(val)
+                    except BaseException as e:
+                        fut.set_exception(e)
+                        raise
+            """)
+        assert cancel_safety.check_q03(ctx) == []
+
+    def test_finally_handoff_is_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Confirm:
+                async def run(self, fut):
+                    val = None
+                    try:
+                        val = await self._leader_confirm()
+                    except Exception:
+                        pass
+                    finally:
+                        fut.set_result(val)
+            """)
+        assert cancel_safety.check_q03(ctx) == []
+
+
+class TestHandoffSupervision:
+    """Q04: a task spawned to perform a hand-off must be supervised
+    or self-supervising."""
+
+    def test_unsupervised_handoff_task(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Runner:
+                def kick(self):
+                    asyncio.ensure_future(self._work())
+
+                async def _work(self):
+                    await self._compute()
+                    self._batch["fired"] = True
+            """)
+        found = cancel_safety.check_q04(ctx)
+        assert _codes(found) == ["Q04"]
+        assert "_work" in found[0].message
+
+    def test_done_callback_supervises(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Runner:
+                def kick(self):
+                    t = asyncio.ensure_future(self._work())
+                    t.add_done_callback(self._reap)
+
+                async def _work(self):
+                    await self._compute()
+                    self._batch["fired"] = True
+            """)
+        assert cancel_safety.check_q04(ctx) == []
+
+    def test_self_supervising_body_is_clean(self, tmp_path):
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Runner:
+                def kick(self):
+                    asyncio.ensure_future(self._work())
+
+                async def _work(self):
+                    try:
+                        await self._compute()
+                    finally:
+                        self._batch["fired"] = True
+            """)
+        assert cancel_safety.check_q04(ctx) == []
+
+
+class TestCancelSuppression:
+    """noqa / baseline plumbing works for the Q codes."""
+
+    _Q01_SRC = """\
+        import asyncio
+
+        class Batcher:
+            def __init__(self):
+                self._fut = None
+
+            def arm(self):
+                self._fut = asyncio.get_event_loop().create_future()
+
+            def fire(self, val):
+                self._fut.set_result(val)
+
+            async def join(self):
+                return await self._fut{noqa}
+        """
+
+    def test_noqa_q01_suppresses(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent(self._Q01_SRC.format(noqa="")))
+        assert _codes(run_vet([str(p)], baseline_path=None).findings) \
+            == ["Q01"]
+        p.write_text(textwrap.dedent(
+            self._Q01_SRC.format(noqa="  # noqa: Q01")))
+        assert run_vet([str(p)], baseline_path=None).findings == []
+
+    def test_baseline_suppresses_q02(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent("""\
+            import asyncio
+
+            def f():
+                fut = asyncio.get_event_loop().create_future()
+                return 1
+            """))
+        unsuppressed = run_vet([str(p)], baseline_path=None)
+        assert _codes(unsuppressed.findings) == ["Q02"]
+        base = tmp_path / "baseline.txt"
+        base.write_text("# justified: fixture\n"
+                        + unsuppressed.findings[0].baseline_key() + "\n")
+        result = run_vet([str(p)], baseline_path=base)
+        assert result.findings == []
+        assert result.baselined == 1 and result.rc == 0
+
+    def test_stale_baseline_across_q_codes(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        base = tmp_path / "baseline.txt"
+        base.write_text("gone.py|Q01|old shield finding\n"
+                        "gone.py|Q02|old resolution finding\n"
+                        "gone.py|Q03|old guard finding\n"
+                        "gone.py|Q04|old supervision finding\n")
+        result = run_vet([str(p)], baseline_path=base)
+        assert sorted(k.split("|")[1] for k in result.stale_baseline) \
+            == ["Q01", "Q02", "Q03", "Q04"]
+        assert result.rc == 0
+
+    def test_real_server_is_q_clean(self):
+        # the production file the tier was built against, post-fix
+        p = REPO / "consul_tpu" / "server" / "server.py"
+        ctx = FileCtx.load(p, "consul_tpu/server/server.py")
+        assert cancel_safety.check(ctx) == []
+
+    def test_prefix_confirm_batch_shape_is_caught(self, tmp_path):
+        # the ADVICE r5 high finding, reduced: _run_confirm_batch
+        # awaits its predecessor bare (Q01 — cancelling this runner
+        # cancels the predecessor's shared future) under an
+        # 'except Exception' guard whose continuation fires the batch
+        # (Q03 — a CancelledError skips the hand-off and strands every
+        # joiner).  This is the pre-fix server.py shape.
+        ctx = _ctx(tmp_path, "m.py", """\
+            import asyncio
+
+            class Server:
+                def __init__(self):
+                    self._confirm_batches = {}
+                    self._confirm_prev = {}
+
+                async def _confirm_batched(self, key, runner):
+                    b = self._confirm_batches.get(key)
+                    if b is None or b["fired"]:
+                        b = self._confirm_batches[key] = {
+                            "fut": asyncio.get_event_loop()
+                            .create_future(),
+                            "fired": False}
+                        asyncio.get_event_loop().create_task(
+                            self._run_confirm_batch(key, b, runner))
+                    return await asyncio.shield(b["fut"])
+
+                async def _run_confirm_batch(self, key, b, runner):
+                    try:
+                        prev = self._confirm_prev.get(key)
+                        if prev is not None and not prev.done():
+                            await prev
+                        b["fired"] = True
+                        self._confirm_prev[key] = b["fut"]
+                        result = await runner()
+                        if not b["fut"].done():
+                            b["fut"].set_result(result)
+                    except Exception as exc:
+                        if not b["fut"].done():
+                            b["fut"].set_exception(exc)
+            """)
+        assert _codes(cancel_safety.check_q01(ctx)) == ["Q01"]
+        assert _codes(cancel_safety.check_q03(ctx)) == ["Q03"]
+
+
+# -- environment-gate union group (table_drift.check_env_gates) --------------
+
+
+class TestEnvGates:
+    """The CONSUL_TPU_* registry vs usage sites vs README table."""
+
+    REAL_GATES = sorted(table_drift.ENV_GATE_SITES)
+
+    def _gov(self, tmp_path, gates):
+        src = "ENV_GATES = {\n" + "".join(
+            '    "%s": "d",\n' % g for g in sorted(gates)) + "}\n"
+        return _ctx(tmp_path, "consul_tpu/obs/envgates.py", src)
+
+    def _readme(self, gates):
+        return "".join("| `%s` | x |\n" % g for g in sorted(gates))
+
+    def test_synced_registry_and_readme_are_clean(self, tmp_path):
+        gov = self._gov(tmp_path, self.REAL_GATES)
+        assert table_drift.check_env_gates(
+            [gov], readme_text=self._readme(self.REAL_GATES)) == []
+
+    def test_unregistered_literal_fires(self, tmp_path):
+        gov = self._gov(tmp_path, self.REAL_GATES)
+        user = _ctx(tmp_path, "consul_tpu/obs/extra.py", """\
+            import os
+            FLAG = os.environ.get("CONSUL_TPU_BOGUS_GATE")
+            """)
+        found = table_drift.check_env_gates(
+            [gov, user], readme_text=self._readme(self.REAL_GATES))
+        assert _codes(found) == ["K01"]
+        assert found[0].line == 2
+        assert "not registered" in found[0].message
+
+    def test_dead_canonical_site_fires(self, tmp_path):
+        # the journey reader is present but only reads one of its two
+        # registered gates — the other is dead configuration
+        gov = self._gov(tmp_path, self.REAL_GATES)
+        site = _ctx(tmp_path, "consul_tpu/obs/journey.py", """\
+            import os
+            ON = os.environ.get("CONSUL_TPU_JOURNEY", "1")
+            """)
+        found = table_drift.check_env_gates(
+            [gov, site], readme_text=self._readme(self.REAL_GATES))
+        assert _codes(found) == ["K01"]
+        assert "CONSUL_TPU_JOURNEY_BUDGET_MS" in found[0].message
+        assert "dead configuration" in found[0].message
+
+    def test_readme_missing_gate_fires(self, tmp_path):
+        gov = self._gov(tmp_path, self.REAL_GATES)
+        docs = self._readme(
+            [g for g in self.REAL_GATES if g != "CONSUL_TPU_AUTOTUNE"])
+        found = table_drift.check_env_gates([gov], readme_text=docs)
+        assert _codes(found) == ["K01"]
+        assert found[0].path == "README.md"
+        assert "CONSUL_TPU_AUTOTUNE is registered" in found[0].message
+        assert "never mentioned" in found[0].message
+
+    def test_readme_stale_gate_fires(self, tmp_path):
+        gov = self._gov(tmp_path, self.REAL_GATES)
+        docs = self._readme(self.REAL_GATES) \
+            + "| `CONSUL_TPU_NOT_A_GATE` | x |\n"
+        found = table_drift.check_env_gates([gov], readme_text=docs)
+        assert _codes(found) == ["K01"]
+        assert found[0].line == len(docs.splitlines())
+        assert "stale docs" in found[0].message
+
+    def test_registry_site_mirror_divergence(self, tmp_path):
+        # a registered gate with no declared canonical reader; the
+        # fixture names are deliberately unregistered — exactly what
+        # the project-wide literal sweep exists to flag
+        extra = ["CONSUL_TPU_EXTRA_GATE"]  # noqa: K01 — fixture gate
+        gov = self._gov(tmp_path, self.REAL_GATES + extra)
+        docs = self._readme(self.REAL_GATES + extra)
+        found = table_drift.check_env_gates([gov], readme_text=docs)
+        assert _codes(found) == ["K01"]
+        assert "no canonical reader" in found[0].message
+        # and the converse: a declared reader whose gate vanished
+        reduced = [g for g in self.REAL_GATES
+                   if g != "CONSUL_TPU_DEV_OBS"]
+        gov = self._gov(tmp_path / "b", reduced)
+        found = table_drift.check_env_gates(
+            [gov], readme_text=self._readme(reduced))
+        assert _codes(found) == ["K01"]
+        assert "missing from the ENV_GATES registry" in found[0].message
+
+    def test_subset_without_registry_skips(self, tmp_path):
+        user = _ctx(tmp_path, "consul_tpu/obs/other.py", "x = 1\n")
+        assert table_drift.check_env_gates([user], readme_text="") == []
+
+    def test_real_tree_registry_matches_sites(self):
+        # the live contract: the shipped registry and the vet-side
+        # mirror agree, and every declared reader file exists
+        from consul_tpu.obs.envgates import ENV_GATES
+        assert sorted(ENV_GATES) == self.REAL_GATES
+        for site in set(table_drift.ENV_GATE_SITES.values()):
+            assert (REPO / site).is_file(), site
+
+
 # -- time guard (the `make vet` wall-time regression gate) -------------------
 
 
@@ -2226,6 +2712,57 @@ class TestDynHarness:
         data = json.loads(report.read_text())
         assert data["exitstatus"] == 0
         assert vet_dyn.evaluate_leaks(data) == []
+
+    def test_cancel_injector_counts_only_victim_awaits(self):
+        # k=2: the first noted await arms nothing, the second cancels
+        # the victim; awaits by other tasks never advance the count
+        async def main():
+            inj = vet_dyn._CancelInjector(2)
+            cancelled = []
+
+            async def bystander():
+                inj.note_await()   # not the victim: ignored
+
+            async def victim_body():
+                inj.victim = asyncio.current_task()
+                inj.note_await()
+                assert not inj.fired and inj.seen == 1
+                inj.note_await()
+                assert inj.fired and inj.seen == 2
+                try:
+                    await asyncio.sleep(1)
+                except asyncio.CancelledError:
+                    cancelled.append(True)
+                    raise
+
+            await bystander()
+            assert inj.seen == 0
+            t = asyncio.ensure_future(victim_body())
+            await asyncio.gather(t, return_exceptions=True)
+            assert cancelled and t.cancelled()
+
+        asyncio.run(main())
+
+    def test_cancel_scenarios_cover_the_three_slices(self):
+        names = [name for name, _victims, _fn in vet_dyn._CANCEL_SCENARIOS]
+        assert names == ["confirm-batch", "reconcile-flush",
+                         "blocking-query"]
+        assert vet_dyn.CANCEL_ENV == "CONSUL_TPU_DYN_CANCEL"
+
+    def test_cancel_injection_leg_is_clean(self):
+        # the full sweep over the real production objects: every
+        # (scenario, victim, k) combination must leave no future
+        # pending and no batch unfired
+        env = dict(__import__("os").environ)
+        env[vet_dyn.CANCEL_ENV] = "1"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.vet.dyn", "--cancel"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "cancel-injection leg clean" in proc.stderr
+        for name, victims, _fn in vet_dyn._CANCEL_SCENARIOS:
+            for victim in victims:
+                assert f"cancel[{name}/{victim}]: swept" in proc.stderr
 
 
 # -- meta: the analyzer meets its own standard -------------------------------

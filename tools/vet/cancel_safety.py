@@ -1,0 +1,996 @@
+"""Cancellation-safety tier: future-resolution flow analysis over the
+host plane (four passes, codes Q01-Q04).
+
+An ``await`` on a future is a two-way coupling: cancellation of the
+awaiting task propagates INTO the future (``Task.cancel`` cancels the
+future the task is blocked on), and an exception escaping the awaiting
+frame can skip the continuation that would have resolved some OTHER
+future.  Both directions killed real code here: the ADVICE r5 high
+finding (pre-fix ``server/server.py``) let one cancelled aiohttp reader
+cancel a ReadIndex batch future shared by every batchmate, and let a
+cancelled predecessor batch unwind the next batch's runner before it
+fired — stranding joiners forever, the exact silent-unsafety class
+"Scaling Strongly Consistent Replication" (PAPERS.md) warns read-
+scaling schemes about.  These passes turn that bug class into checked
+invariants over the whole tree; ``tools/vet/dyn.py``'s cancel-injection
+harness (``CONSUL_TPU_DYN_CANCEL=1``) is the dynamic twin.
+
+- **Q01 bare await of a shared future**: ``await f`` where ``f`` has
+  *shared-future provenance* — stored on ``self.*`` / a module-level
+  dict (directly, as dict values, or as values of dicts whose entries
+  hold a ``"fut"``-style key), with the slot touched from two or more
+  functions — and the await is not wrapped in ``asyncio.shield``.
+  Cancelling the waiter cancels the shared future and poisons every
+  other waiter (the ``_confirm_batched`` vs ``_leader_confirm``
+  asymmetry).  Killed by shielding the await; a deliberate
+  propagate-cancellation-to-peers design earns a ``# noqa: Q01`` with
+  the ownership argument in a comment.
+- **Q02 future-resolution completeness**: a function that *owns
+  resolution* of a created future (calls ``set_result``/
+  ``set_exception``/``cancel`` on a slot some function created via
+  ``create_future()``/``Future()``) must resolve it on ALL paths —
+  including a ``CancelledError``/``BaseException`` escaping one of its
+  awaits.  An await with no enclosing ``finally``-resolution and no
+  ``BaseException``-catching handler that resolves lets an escape
+  strand the future: every waiter hangs forever.  Also flags futures
+  created and stored to shared state that NO function ever resolves,
+  and locally-created futures that never escape and are never
+  resolved.  Killed by resolving in a ``finally``, by an
+  ``except BaseException`` handler that resolves before re-raising, or
+  by handing the slot to a resolver function.
+- **Q03 Exception-guard across a must-happen hand-off**: a ``try``
+  whose broadest handler is ``except Exception`` (no ``BaseException``
+  / ``CancelledError`` split, no ``finally`` hand-off), whose body
+  awaits, and whose continuation — later statements in the body, the
+  handler itself, or the statements after the ``try`` — performs a
+  hand-off another task is waiting on (resolves a future, flips a
+  ``fired``-style flag, sets an ``asyncio.Event``).  ``CancelledError``
+  derives from ``BaseException`` precisely so broad handlers don't eat
+  it — which means it sails PAST this handler and the hand-off never
+  happens.  Demands the ``BaseException`` split or a ``finally``.
+- **Q04 unsupervised hand-off task**: ``create_task``/``ensure_future``
+  of a coroutine whose body performs a hand-off, where the task handle
+  gets no ``add_done_callback`` and is never awaited/gathered, and the
+  coroutine body does not self-supervise (no ``finally`` / broad-
+  ``BaseException`` hand-off).  If the task dies — cancellation at
+  teardown, a bug — its death is invisible and the hand-off's waiters
+  hang.
+
+Suppression conventions mirror the interleave tier: a ``# noqa: Q0x``
+must carry the cancellation-containment argument in an adjacent
+comment (sole-waiter ownership, teardown-only path, etc.).
+
+The passes ride the PR-17 per-class caches: ``interleave.class_scans``
+memoizes the module prescan + per-class scans on the FileCtx, and this
+module memoizes its own future-provenance scan the same way, so the
+four Q passes cost ONE provenance walk per file between them.
+"""
+
+from __future__ import annotations
+
+import ast
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.vet.core import FileCtx, Finding
+from tools.vet.interleave import (_attr_use_counts, _self_attr, _walk_local,
+                                  class_scans)
+from tools.vet.tracer_purity import _tail
+
+UNSHIELDED_SHARED = "Q01"
+UNRESOLVED_FUTURE = "Q02"
+EXCEPTION_GUARD_HANDOFF = "Q03"
+UNSUPERVISED_HANDOFF_TASK = "Q04"
+
+# Factories minting a bare Future the creator must see resolved.
+_FUTURE_FACTORIES = {"create_future", "Future"}
+# Task-flavored futures: self-resolving (the coroutine's return/raise
+# resolves them), so Q02's completeness obligation does not apply —
+# but awaiting a SHARED one bare still propagates cancellation (Q01).
+_TASK_FACTORIES = {"ensure_future", "create_task", "wrap_future",
+                   "run_coroutine_threadsafe"}
+_RESOLVERS = {"set_result", "set_exception", "cancel"}
+_SPAWNERS = {"create_task", "ensure_future"}
+# Event factories: `.set()` on one of these attrs is a waiter hand-off.
+_EVENT_FACTORIES = {"Event"}
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    """Trailing name of a call target, surviving chained calls
+    (``asyncio.get_event_loop().create_future`` -> ``create_future``)
+    where ``dotted_name``/``_tail`` give up."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return _tail(func)
+
+
+def _is_future_factory(node: ast.AST, include_tasks: bool = True) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    tail = _call_name(node.func)
+    if tail in _FUTURE_FACTORIES:
+        return True
+    return include_tasks and tail in _TASK_FACTORIES
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _functions_of(tree: ast.AST) -> List[ast.AST]:
+    """Direct function children (module level or class body)."""
+    return [n for n in ast.iter_child_nodes(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+# Four passes each visit every function several times; re-walking the
+# AST dominated the tier's cost (the _walk_local recursion, not the
+# analysis).  One flat node list per function, weakly keyed so entries
+# die with the FileCtx's tree.
+_NODES_MEMO: "weakref.WeakKeyDictionary[ast.AST, List[ast.AST]]" = \
+    weakref.WeakKeyDictionary()
+
+
+def _nodes(fn: ast.AST) -> List[ast.AST]:
+    """``list(_walk_local(fn))``, memoized per function node."""
+    nodes = _NODES_MEMO.get(fn)
+    if nodes is None:
+        nodes = _NODES_MEMO[fn] = list(_walk_local(fn))
+    return nodes
+
+
+@dataclass
+class _Slots:
+    """Future-provenance facts for one scope (a class, or the module).
+
+    ``future_attrs``    self.A (or module NAME) holding a future
+    ``future_dicts``    self.D (or NAME) mapping keys -> futures
+    ``batch_dicts``     self.D mapping keys -> dicts that carry futures
+                        under ``future_keys`` (the confirm-batch shape)
+    ``future_keys``     dict-literal / subscript-store keys observed
+                        holding a future ("fut")
+    ``event_attrs``     self.E assigned from asyncio.Event()
+    ``resolved_slots``  attr/key names some function resolves
+                        (set_result/set_exception/cancel receiver
+                        provenance)
+    ``creations``       [(fn, assign node, slot or None, escapes)]
+    """
+
+    future_attrs: Set[str] = field(default_factory=set)
+    future_dicts: Set[str] = field(default_factory=set)
+    batch_dicts: Set[str] = field(default_factory=set)
+    future_keys: Set[str] = field(default_factory=set)
+    event_attrs: Set[str] = field(default_factory=set)
+    resolved_slots: Set[str] = field(default_factory=set)
+    use_counts: Dict[str, Set[str]] = field(default_factory=dict)
+    # names of functions (this scope ∪ module level) whose body
+    # directly resolves a future — calls to them discharge hand-offs
+    resolver_fns: Set[str] = field(default_factory=set)
+
+
+def _dict_future_keys(d: ast.Dict) -> Set[str]:
+    out: Set[str] = set()
+    for k, v in zip(d.keys, d.values):
+        key = _const_str(k) if k is not None else None
+        if key and _is_future_factory(v):
+            out.add(key)
+    return out
+
+
+def _scope_root_attr(node: ast.AST, module_dicts: Set[str]
+                     ) -> Optional[str]:
+    """The slot name for an expression rooted at ``self.A`` or at a
+    module-level dict NAME; None otherwise."""
+    attr = _self_attr(node)
+    if attr is not None:
+        return attr
+    if isinstance(node, ast.Name) and node.id in module_dicts:
+        return node.id
+    return None
+
+
+def _module_dict_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for n in tree.body:
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            targets = [n.target]
+        else:
+            continue
+        if isinstance(n.value, (ast.Dict, ast.DictComp)) or (
+                isinstance(n.value, ast.Call)
+                and _tail(n.value.func) == "dict"):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _local_slot_aliases(fn: ast.AST, module_dicts: Set[str]
+                        ) -> Dict[str, Set[str]]:
+    """Local names that alias a self/module slot in this function:
+    ``fut = getattr(self, "_stats_future", None)``, ``fut = self.x``,
+    and the chained-assign ``fut = self._stats_future = factory()``.
+    A resolution through the alias resolves the slot(s) — multi-valued
+    because dispatch functions rebind one local from several getattrs
+    (tpu_backend._handle), and crediting only the last binding would
+    leave the others looking unresolved."""
+    out: Dict[str, Set[str]] = {}
+    for n in _nodes(fn):
+        if not isinstance(n, ast.Assign):
+            continue
+        v = n.value
+        slot: Optional[str] = _scope_root_attr(v, module_dicts)
+        if slot is None and isinstance(v, ast.Call) \
+                and isinstance(v.func, ast.Name) \
+                and v.func.id == "getattr" and len(v.args) >= 2 \
+                and isinstance(v.args[0], ast.Name) \
+                and v.args[0].id == "self":
+            slot = _const_str(v.args[1])
+        if slot is None:
+            # chained assign: a sibling attr target names the slot
+            for t in n.targets:
+                s = _scope_root_attr(t, module_dicts)
+                if s is not None:
+                    slot = s
+        if slot is None:
+            continue
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                out.setdefault(t.id, set()).add(slot)
+    return out
+
+
+def _scan_scope(scope: ast.AST, fns: Sequence[ast.AST],
+                module_dicts: Set[str],
+                use_counts: Dict[str, Set[str]]) -> _Slots:
+    """One walk over a class (or module) collecting future provenance."""
+    slots = _Slots(use_counts=use_counts)
+    # Pass 1: direct evidence — factory assigns, dict-literal keys,
+    # event attrs, resolver receivers.
+    for fn in fns:
+        aliases = _local_slot_aliases(fn, module_dicts)
+        for n in _nodes(fn):
+            if isinstance(n, ast.Assign):
+                v = n.value
+                for t in n.targets:
+                    slot = _scope_root_attr(t, module_dicts)
+                    sub_slot = _scope_root_attr(t.value, module_dicts) \
+                        if isinstance(t, ast.Subscript) else None
+                    if _is_future_factory(v):
+                        if slot is not None:
+                            slots.future_attrs.add(slot)
+                        if sub_slot is not None:
+                            slots.future_dicts.add(sub_slot)
+                    elif isinstance(v, ast.Call) \
+                            and _call_name(v.func) in _EVENT_FACTORIES:
+                        if slot is not None:
+                            slots.event_attrs.add(slot)
+                    if isinstance(v, ast.Dict):
+                        fkeys = _dict_future_keys(v)
+                        if fkeys:
+                            slots.future_keys |= fkeys
+                            if sub_slot is not None:
+                                slots.batch_dicts.add(sub_slot)
+            elif isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and n.func.attr in _RESOLVERS:
+                recv = n.func.value
+                slot = _scope_root_attr(recv, module_dicts)
+                if slot is not None:
+                    slots.resolved_slots.add(slot)
+                elif isinstance(recv, ast.Subscript):
+                    key = _const_str(recv.slice)
+                    if key is not None:
+                        slots.resolved_slots.add(key)
+                    root = _scope_root_attr(recv.value, module_dicts)
+                    if root is not None:
+                        slots.resolved_slots.add(root)
+                elif isinstance(recv, ast.Name):
+                    slots.resolved_slots |= aliases.get(
+                        recv.id, {recv.id})
+            elif isinstance(n, ast.Dict):
+                slots.future_keys |= _dict_future_keys(n)
+    # Pass 2: provenance chains — a store of an already-future value
+    # into a self/module dict makes that dict a future dict
+    # (self._confirm_prev[key] = b["fut"]).
+    for fn in fns:
+        for n in _nodes(fn):
+            if not isinstance(n, ast.Assign):
+                continue
+            v = n.value
+            value_is_future = (
+                _is_future_factory(v)
+                or (isinstance(v, ast.Subscript)
+                    and _const_str(v.slice) in slots.future_keys)
+                or (_scope_root_attr(v, module_dicts)
+                    in slots.future_attrs))
+            if not value_is_future:
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Subscript):
+                    root = _scope_root_attr(t.value, module_dicts)
+                    if root is not None:
+                        slots.future_dicts.add(root)
+                    key = _const_str(t.slice)
+                    if key is not None:
+                        slots.future_keys.add(key)
+    return slots
+
+
+def _slots_for(ctx: FileCtx) -> Tuple[_Slots, Dict[int, _Slots]]:
+    """(module-scope slots, per-class slots by class node id) — one
+    provenance walk per file, memoized on the FileCtx (the Q passes and
+    the driver share FileCtx instances)."""
+    cached = getattr(ctx, "_cancel_slots", None)
+    if cached is None:
+        module_dicts = _module_dict_names(ctx.tree)
+        mod_fns = _functions_of(ctx.tree)
+        mod_slots = _scan_scope(ctx.tree, mod_fns, module_dicts, {})
+        mod_slots.resolver_fns = _resolver_fn_names(mod_fns)
+        per_class: Dict[int, _Slots] = {}
+        _imports, _targets, scans = class_scans(ctx)
+        for scan in scans:
+            s = _scan_scope(scan.cls, scan.fns, module_dicts,
+                            _attr_use_counts(scan.cls))
+            s.resolver_fns = _resolver_fn_names(scan.fns) \
+                | mod_slots.resolver_fns
+            per_class[id(scan.cls)] = s
+        cached = (mod_slots, per_class, module_dicts)
+        ctx._cancel_slots = cached  # type: ignore[attr-defined]
+    return cached[0], cached[1]
+
+
+def _module_dicts_of(ctx: FileCtx) -> Set[str]:
+    _slots_for(ctx)
+    return ctx._cancel_slots[2]  # type: ignore[attr-defined]
+
+
+def _scopes(ctx: FileCtx) -> Iterator[Tuple[ast.AST, List[ast.AST], _Slots]]:
+    """Yield (scope node, functions, slots) for the module scope and
+    every class."""
+    mod_slots, per_class = _slots_for(ctx)
+    yield ctx.tree, _functions_of(ctx.tree), mod_slots
+    _imports, _targets, scans = class_scans(ctx)
+    for scan in scans:
+        yield scan.cls, list(scan.fns), per_class[id(scan.cls)]
+
+
+def _is_shared(slot: str, slots: _Slots, scope: ast.AST) -> bool:
+    """Module-level slots are shared by construction; class attrs are
+    shared when two or more methods touch them."""
+    if isinstance(scope, ast.Module):
+        return True
+    return len(slots.use_counts.get(slot, set())) >= 2
+
+
+def _contains_shield(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n.func) == "shield":
+            return True
+    return False
+
+
+# -- Q01 ---------------------------------------------------------------------
+
+
+def _local_handles(fn: ast.AST, slots: _Slots, module_dicts: Set[str]
+                   ) -> Tuple[Set[str], Set[str]]:
+    """(future-handle locals, batch-dict-handle locals) for one
+    function: names bound from a shared future slot / batch dict —
+    directly (``f = self.fut``), by subscript (``p = self.d[k]``), by
+    ``.get`` (``p = self.d.get(k)``), or via a chained store whose
+    sibling target is a slot subscript
+    (``b = self._batches[key] = {...}``)."""
+    fut_handles: Set[str] = set()
+    dict_handles: Set[str] = set()
+
+    def source_kind(v: ast.AST) -> Optional[str]:
+        root = _scope_root_attr(v, module_dicts)
+        if root in slots.future_attrs:
+            return "future"
+        if isinstance(v, ast.Subscript):
+            root = _scope_root_attr(v.value, module_dicts)
+            if root in slots.batch_dicts:
+                return "dict"
+            if root in slots.future_dicts:
+                return "future"
+            if _const_str(v.slice) in slots.future_keys:
+                return "future"
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                and v.func.attr == "get":
+            root = _scope_root_attr(v.func.value, module_dicts)
+            if root in slots.batch_dicts:
+                return "dict"
+            if root in slots.future_dicts:
+                return "future"
+        return None
+
+    for n in _nodes(fn):
+        if not isinstance(n, ast.Assign):
+            continue
+        kind = source_kind(n.value)
+        # chained store: `b = self._batches[key] = {...}` — the dict
+        # literal IS the batch record; classify via the sibling target
+        if kind is None and isinstance(n.value, ast.Dict) \
+                and _dict_future_keys(n.value):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) and _scope_root_attr(
+                        t.value, module_dicts) in slots.batch_dicts:
+                    kind = "dict"
+        # tuple-unpack from a shared slot swap: x, self.a = self.a, None
+        if kind is None and isinstance(n.value, ast.Tuple):
+            for t in n.targets:
+                if isinstance(t, ast.Tuple) \
+                        and len(t.elts) == len(n.value.elts):
+                    for te, ve in zip(t.elts, n.value.elts):
+                        k = source_kind(ve)
+                        if k == "future" and isinstance(te, ast.Name):
+                            fut_handles.add(te.id)
+                        elif k == "dict" and isinstance(te, ast.Name):
+                            dict_handles.add(te.id)
+            continue
+        if kind is None:
+            continue
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                (fut_handles if kind == "future" else dict_handles).add(
+                    t.id)
+    return fut_handles, dict_handles
+
+
+def check_q01(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    module_dicts = _module_dicts_of(ctx)
+    for scope, fns, slots in _scopes(ctx):
+        if not (slots.future_attrs or slots.future_dicts
+                or slots.batch_dicts):
+            continue
+        for fn in fns:
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            fut_handles, dict_handles = _local_handles(
+                fn, slots, module_dicts)
+            # teardown joins: a handle this function itself .cancel()s
+            # is being reaped, not waited on for a result — awaiting it
+            # bare is the swap-then-cancel stop() idiom, not a leak of
+            # cancellation into live waiters
+            cancelled_here: Set[str] = set()
+            for n in _nodes(fn):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "cancel":
+                    recv = n.func.value
+                    if isinstance(recv, ast.Name):
+                        cancelled_here.add(recv.id)
+                    r = _scope_root_attr(recv, module_dicts)
+                    if r is not None:
+                        cancelled_here.add(r)
+            for n in _nodes(fn):
+                if not isinstance(n, ast.Await):
+                    continue
+                op = n.value
+                if _contains_shield(op):
+                    continue
+                op_root = _scope_root_attr(op, module_dicts) or (
+                    op.id if isinstance(op, ast.Name) else None)
+                if op_root in cancelled_here:
+                    continue
+                slot: Optional[str] = None
+                desc = ""
+                root = _scope_root_attr(op, module_dicts)
+                if root in slots.future_attrs \
+                        and _is_shared(root, slots, scope):
+                    slot, desc = root, f"'{root}'"
+                elif isinstance(op, ast.Name):
+                    if op.id in fut_handles:
+                        slot, desc = op.id, \
+                            f"'{op.id}' (bound from a shared slot)"
+                elif isinstance(op, ast.Subscript):
+                    sroot = _scope_root_attr(op.value, module_dicts)
+                    key = _const_str(op.slice)
+                    if sroot in slots.future_dicts \
+                            and _is_shared(sroot, slots, scope):
+                        slot, desc = sroot, f"'{sroot}[...]'"
+                    elif isinstance(op.value, ast.Name) \
+                            and op.value.id in dict_handles \
+                            and (key is None or key in slots.future_keys):
+                        slot = key or op.value.id
+                        desc = f"'{op.value.id}[{key!r}]' " \
+                            "(a shared batch record)"
+                if slot is None:
+                    continue
+                out.append(Finding(
+                    ctx.path, n.lineno, UNSHIELDED_SHARED,
+                    f"bare await of shared future {desc} — cancelling "
+                    "this waiter cancels the future itself and poisons "
+                    "every other waiter (client disconnect cancels the "
+                    "whole batch); wrap in asyncio.shield(...), or "
+                    "noqa with the sole-waiter ownership argument"))
+    return out
+
+
+# -- shared escape-protection machinery (Q02/Q03) ----------------------------
+
+
+def _stmt_contains(stmts: Sequence[ast.stmt], pred) -> bool:
+    for s in stmts:
+        for n in ast.walk(s):
+            if pred(n):
+                return True
+    return False
+
+
+def _handler_catches_base(h: ast.ExceptHandler) -> bool:
+    """Bare except, BaseException, or CancelledError in the caught
+    set — i.e. the handler sees a cancellation escape."""
+    if h.type is None:
+        return True
+    nodes = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    names = {_tail(n) for n in nodes}
+    return bool(names & {"BaseException", "CancelledError"})
+
+
+def _enclosing_trys(fn: ast.AST, target: ast.AST) -> List[ast.Try]:
+    """Try statements (inside fn, innermost last) whose body lexically
+    contains target."""
+    chain: List[ast.Try] = []
+
+    def descend(node: ast.AST) -> bool:
+        if node is target:
+            return True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Try):
+                if any(descend(s) for s in child.body):
+                    chain.append(child)
+                    return True
+                # target in a handler/else/finally: the try no longer
+                # guards it, keep descending without recording
+                rest = (list(child.handlers) + list(child.orelse)
+                        + list(child.finalbody))
+                if any(descend(s) for s in rest):
+                    return True
+            elif descend(child):
+                return True
+        return False
+
+    descend(fn)
+    chain.reverse()   # outermost first
+    return chain
+
+
+def _escape_protected(fn: ast.AST, await_node: ast.AST, pred) -> bool:
+    """True when an exception escaping ``await_node`` is guaranteed to
+    pass a ``pred``-satisfying statement inside ``fn``: a finally block
+    containing one, or a BaseException/CancelledError/bare handler
+    containing one."""
+    for t in _enclosing_trys(fn, await_node):
+        if _stmt_contains(t.finalbody, pred):
+            return True
+        for h in t.handlers:
+            if _handler_catches_base(h) and _stmt_contains(h.body, pred):
+                return True
+    return False
+
+
+# -- Q02 ---------------------------------------------------------------------
+
+
+def _resolver_fn_names(fns: Sequence[ast.AST]) -> Set[str]:
+    """Functions whose body directly resolves a future: a call to one
+    of these (``self._fail_pending()``) is itself a resolution — the
+    canonical drain-helper shape."""
+    out: Set[str] = set()
+    for fn in fns:
+        for n in _nodes(fn):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _RESOLVERS:
+                out.add(fn.name)
+                break
+    return out
+
+
+def _resolution_pred(slots: _Slots, module_dicts: Set[str],
+                     locals_ok: Optional[Set[str]] = None,
+                     resolver_fns: Set[str] = frozenset()):
+    def pred(n: ast.AST) -> bool:
+        if not isinstance(n, ast.Call):
+            return False
+        callee = _self_attr(n.func) or (
+            n.func.id if isinstance(n.func, ast.Name) else None)
+        if callee in resolver_fns:
+            return True
+        if not (isinstance(n.func, ast.Attribute)
+                and n.func.attr in _RESOLVERS):
+            return False
+        recv = n.func.value
+        if locals_ok is not None and isinstance(recv, ast.Name) \
+                and recv.id in locals_ok:
+            return True
+        if _scope_root_attr(recv, module_dicts) is not None:
+            return True
+        if isinstance(recv, ast.Subscript):
+            return True
+        return locals_ok is None and isinstance(recv, ast.Name)
+    return pred
+
+
+def _escapes_function(fn: ast.AST, name: str) -> bool:
+    """A local future escapes when returned, yielded, stored to
+    self/module state, put in a container literal, or passed to a
+    call — resolution responsibility moved elsewhere."""
+    for n in _nodes(fn):
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and n.value is not None:
+            for c in ast.walk(n.value):
+                if isinstance(c, ast.Name) and c.id == name:
+                    return True
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                if not isinstance(t, ast.Name):   # attr/subscript store
+                    for c in ast.walk(t):
+                        if isinstance(c, ast.Name) and c.id == name \
+                                and isinstance(c.ctx, ast.Load):
+                            return True
+            if isinstance(n.value, (ast.Dict, ast.List, ast.Tuple,
+                                    ast.Set)):
+                for c in ast.walk(n.value):
+                    if isinstance(c, ast.Name) and c.id == name:
+                        return True
+        elif isinstance(n, ast.Call):
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                for c in ast.walk(a):
+                    if isinstance(c, ast.Name) and c.id == name:
+                        return True
+    return False
+
+
+def check_q02(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    module_dicts = _module_dicts_of(ctx)
+    for scope, fns, slots in _scopes(ctx):
+        resolver_fns = slots.resolver_fns
+        for fn in fns:
+            # (a) locally-created, never-escaping, never-resolved
+            created_locals: Dict[str, int] = {}
+            for n in _nodes(fn):
+                if isinstance(n, ast.Assign) \
+                        and _is_future_factory(n.value,
+                                               include_tasks=False):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            created_locals[t.id] = n.lineno
+            for name, line in sorted(created_locals.items()):
+                resolved = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _RESOLVERS
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == name
+                    for n in _nodes(fn))
+                if not resolved and not _escapes_function(fn, name):
+                    out.append(Finding(
+                        ctx.path, line, UNRESOLVED_FUTURE,
+                        f"future '{name}' is created here but no path "
+                        "resolves it (set_result/set_exception/cancel) "
+                        "and it never escapes this function — every "
+                        "awaiter would hang forever"))
+
+            # (b) resolver functions: an await whose escape skips every
+            # resolution strands the future
+            res_pred = _resolution_pred(slots, module_dicts,
+                                        resolver_fns=resolver_fns)
+            res_calls = [n for n in _nodes(fn) if res_pred(n)]
+            # the obligation must be established by set_result /
+            # set_exception: a function whose only "resolutions" are
+            # .cancel() calls is tearing tasks down (swap-then-cancel,
+            # stop paths), not completing a future others await
+            if not any(isinstance(n, ast.Call)
+                       and isinstance(n.func, ast.Attribute)
+                       and n.func.attr in ("set_result", "set_exception")
+                       for n in res_calls):
+                continue
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            first_res = min(n.lineno for n in res_calls)
+            for n in _nodes(fn):
+                if not isinstance(n, ast.Await):
+                    continue
+                if n.lineno > max(x.lineno for x in res_calls):
+                    continue    # awaits after the last resolution
+                if _escape_protected(fn, n, res_pred):
+                    continue
+                # the await itself may BE the resolved-value producer
+                # inside a protected region only; anything else flags
+                out.append(Finding(
+                    ctx.path, n.lineno, UNRESOLVED_FUTURE,
+                    "a CancelledError/BaseException escaping this "
+                    "await skips the future resolution at line "
+                    f"{first_res} — the future is stranded and its "
+                    "waiters hang; resolve in a finally, or catch "
+                    "BaseException, resolve, and re-raise"))
+                break   # one finding per function is enough signal
+
+        # (c) stored-to-shared futures nothing ever resolves
+        for fn in fns:
+            for n in _nodes(fn):
+                if not (isinstance(n, ast.Assign)
+                        and _is_future_factory(n.value,
+                                               include_tasks=False)):
+                    continue
+                for t in n.targets:
+                    slot = _scope_root_attr(t, module_dicts)
+                    if isinstance(t, ast.Subscript):
+                        slot = _scope_root_attr(t.value, module_dicts) \
+                            or _const_str(t.slice)
+                    if slot is None:
+                        continue
+                    if slot in slots.resolved_slots:
+                        continue
+                    # a batch-record store under a future key counts as
+                    # resolved when the KEY is a resolved slot
+                    out.append(Finding(
+                        ctx.path, n.lineno, UNRESOLVED_FUTURE,
+                        f"future stored to shared slot '{slot}' but no "
+                        "function in this scope ever resolves that "
+                        "slot — waiters that join it hang forever"))
+    return out
+
+
+# -- Q03 ---------------------------------------------------------------------
+
+
+def _self_waited_events(fn: ast.AST, module_dicts: Set[str]) -> Set[str]:
+    """Event attrs this function awaits via ``.wait()``: a ``.set()``
+    on one of these inside the same function is a self-rearm trigger
+    (sync-loop retry patterns), not a hand-off to another task."""
+    out: Set[str] = set()
+    for n in _nodes(fn):
+        if isinstance(n, ast.Await) and isinstance(n.value, ast.Call):
+            f = n.value.func
+            # allow wait_for(self.E.wait(), t) wrapping
+            for c in ast.walk(n.value):
+                if isinstance(c, ast.Call) \
+                        and isinstance(c.func, ast.Attribute) \
+                        and c.func.attr == "wait":
+                    root = _scope_root_attr(c.func.value, module_dicts)
+                    if root is not None:
+                        out.add(root)
+            del f
+    return out
+
+
+def _handoff_pred(slots: _Slots, module_dicts: Set[str],
+                  self_waited: Set[str] = frozenset()):
+    """A statement-level predicate for 'another task observes this':
+    future resolution, a fired-style flag flip, or an Event.set()."""
+    def pred(n: ast.AST) -> bool:
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr in ("set_result", "set_exception"):
+                return True
+            if n.func.attr == "set" and not n.args:
+                root = _scope_root_attr(n.func.value, module_dicts)
+                if root in slots.event_attrs and root not in self_waited:
+                    return True
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                key = _const_str(t.slice) if isinstance(t, ast.Subscript) \
+                    else None
+                name = t.attr if isinstance(t, ast.Attribute) else key
+                if name and ("fired" in name or name.endswith("_done")):
+                    return True
+        return False
+    return pred
+
+
+def _protect_pred(pred, resolver_fns: Set[str]):
+    """Protection contexts (finally blocks, BaseException handlers)
+    also discharge the hand-off through a drain helper — a call to a
+    sibling function that itself resolves futures
+    (``self._fail_pending()``).  Detection contexts keep the narrow
+    pred: a helper CALL is not itself evidence a hand-off is owed."""
+    def protected(n: ast.AST) -> bool:
+        if pred(n):
+            return True
+        if isinstance(n, ast.Call):
+            callee = _self_attr(n.func) or (
+                n.func.id if isinstance(n.func, ast.Name) else None)
+            return callee in resolver_fns
+        return False
+    return protected
+
+
+def _describe_handoff(n: ast.AST) -> str:
+    if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+        return f"{n.func.attr}() at line {n.lineno}"
+    return f"hand-off at line {n.lineno}"
+
+
+def check_q03(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    module_dicts = _module_dicts_of(ctx)
+    for _scope, fns, slots in _scopes(ctx):
+        resolver_fns = slots.resolver_fns
+        for fn in fns:
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            pred = _handoff_pred(slots, module_dicts,
+                                 _self_waited_events(fn, module_dicts))
+            protect = _protect_pred(pred, resolver_fns)
+            body_stmts = list(fn.body)
+            for t in _nodes(fn):
+                if not isinstance(t, ast.Try):
+                    continue
+                # guard shape: broadest handler is Exception; no
+                # BaseException/CancelledError split; no finally
+                # hand-off
+                catches_exc = any(
+                    h.type is not None and "Exception" in {
+                        _tail(x) for x in (
+                            h.type.elts if isinstance(h.type, ast.Tuple)
+                            else [h.type])}
+                    for h in t.handlers)
+                if not catches_exc:
+                    continue
+                if any(_handler_catches_base(h) for h in t.handlers):
+                    continue
+                if _stmt_contains(t.finalbody, protect):
+                    continue
+                awaits = [n for s in t.body for n in ast.walk(s)
+                          if isinstance(n, ast.Await)]
+                awaits = [a for a in awaits
+                          if not any(a in set(ast.walk(s))
+                                     for s in t.finalbody)]
+                if not awaits:
+                    continue
+                first_await = min(a.lineno for a in awaits)
+                # continuation hand-offs: later in the try body, in a
+                # handler, or after the try inside the function
+                handoff: Optional[ast.AST] = None
+                for s in t.body:
+                    for n in ast.walk(s):
+                        if pred(n) and n.lineno > first_await:
+                            handoff = handoff or n
+                for h in t.handlers:
+                    for s in h.body:
+                        for n in ast.walk(s):
+                            if pred(n):
+                                handoff = handoff or n
+                t_end = getattr(t, "end_lineno", t.lineno) or t.lineno
+                for s in body_stmts:
+                    if s.lineno <= t_end:
+                        continue
+                    for n in ast.walk(s):
+                        if pred(n):
+                            handoff = handoff or n
+                if handoff is None:
+                    continue
+                # an outer protector (finally / BaseException handler
+                # performing the hand-off) absolves this try
+                probe = awaits[0]
+                if _escape_protected(fn, probe, protect):
+                    continue
+                out.append(Finding(
+                    ctx.path, t.lineno, EXCEPTION_GUARD_HANDOFF,
+                    "'except Exception' guards the await at line "
+                    f"{first_await} but the continuation performs a "
+                    f"must-happen hand-off ({_describe_handoff(handoff)})"
+                    " — a CancelledError escapes this handler and the "
+                    "hand-off never runs, stranding its waiters; catch "
+                    "BaseException (resolve, re-raise) or move the "
+                    "hand-off to a finally"))
+    return out
+
+
+# -- Q04 ---------------------------------------------------------------------
+
+
+def _self_supervising(fn: ast.AST, pred) -> bool:
+    """The coroutine's own body guarantees the hand-off on death: a
+    finally containing one, or a BaseException/bare handler containing
+    one, at the top level of some try enclosing its awaits."""
+    for t in _nodes(fn):
+        if not isinstance(t, ast.Try):
+            continue
+        if _stmt_contains(t.finalbody, pred):
+            return True
+        for h in t.handlers:
+            if _handler_catches_base(h) and _stmt_contains(h.body, pred):
+                return True
+    return False
+
+
+def check_q04(ctx: FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    module_dicts = _module_dicts_of(ctx)
+    for _scope, fns, slots in _scopes(ctx):
+        by_name = {f.name: f for f in fns}
+        resolver_fns = slots.resolver_fns
+        # names with a done-callback or an await anywhere in the scope
+        supervised_names: Set[str] = set()
+        awaited_names: Set[str] = set()
+        for fn in fns:
+            for n in _nodes(fn):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "add_done_callback":
+                    recv = n.func.value
+                    if isinstance(recv, ast.Name):
+                        supervised_names.add(recv.id)
+                    attr = _self_attr(recv)
+                    if attr:
+                        supervised_names.add(attr)
+                elif isinstance(n, ast.Await):
+                    for c in ast.walk(n.value):
+                        if isinstance(c, ast.Name):
+                            awaited_names.add(c.id)
+                        attr = _self_attr(c)
+                        if attr:
+                            awaited_names.add(attr)
+        for fn in fns:
+            for n in _nodes(fn):
+                if not (isinstance(n, ast.Call)
+                        and _call_name(n.func) in _SPAWNERS and n.args):
+                    continue
+                coro = n.args[0]
+                if not isinstance(coro, ast.Call):
+                    continue
+                callee = _self_attr(coro.func) or (
+                    coro.func.id if isinstance(coro.func, ast.Name)
+                    else None)
+                target = by_name.get(callee or "")
+                if target is None:
+                    continue
+                tpred = _handoff_pred(
+                    slots, module_dicts,
+                    _self_waited_events(target, module_dicts))
+                if not any(tpred(x) for x in _nodes(target)):
+                    continue
+                if _self_supervising(target,
+                                     _protect_pred(tpred, resolver_fns)):
+                    continue
+                # handle bound where?
+                handle: Optional[str] = None
+                parent_assign = getattr(n, "_q04_parent", None)
+                # find the assignment statement containing this call
+                for fn2 in (fn,):
+                    for s in _nodes(fn2):
+                        if isinstance(s, ast.Assign) and any(
+                                c is n for c in ast.walk(s.value)):
+                            for t in s.targets:
+                                if isinstance(t, ast.Name):
+                                    handle = t.id
+                                attr = _self_attr(t)
+                                if attr:
+                                    handle = attr
+                del parent_assign
+                if handle is not None and (
+                        handle in supervised_names
+                        or handle in awaited_names):
+                    continue
+                out.append(Finding(
+                    ctx.path, n.lineno, UNSUPERVISED_HANDOFF_TASK,
+                    f"task spawned to run '{callee}' — whose body "
+                    "performs a hand-off other tasks wait on — but the "
+                    "handle gets no add_done_callback and is never "
+                    "awaited, and the body does not self-supervise "
+                    "(finally / BaseException hand-off): if the task "
+                    "dies its waiters hang silently; supervise the "
+                    "handle or make the body resolve on all paths"))
+    return out
+
+
+def check(ctx: FileCtx) -> List[Finding]:
+    """All four Q passes at once (unit-test convenience; the driver
+    registers them individually so per-pass timings stay honest)."""
+    out = (check_q01(ctx) + check_q02(ctx) + check_q03(ctx)
+           + check_q04(ctx))
+    return sorted(set(out), key=lambda f: (f.line, f.code, f.message))

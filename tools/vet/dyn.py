@@ -35,6 +35,20 @@ what only execution shows:
   read-await-write sequence whose correctness depends on "nothing ran
   in between" trips its own assertions — the dynamic twin of the
   static X01 pass.
+- **cancel-injection leg** (``CONSUL_TPU_DYN_CANCEL=1``): the dynamic
+  twin of the static Q01–Q04 tier.  Dedicated scenarios drive the
+  REAL production objects behind the lease/barrier (ReadIndex confirm
+  batching), reconcile-flush, and blocking-query slices — scenarios
+  rather than pytest re-runs, because cancellation must land on a
+  chosen VICTIM task at a chosen await point and the oracles
+  (no future left pending, no batch left unfired, no waiter leaked)
+  live on object internals a test run doesn't expose.  A Future shim
+  counts the awaits the victim task enters and cancels it at the
+  k-th; k sweeps 1, 2, ... until a run completes before the k-th
+  await, so every distinct await point in the victim gets exactly one
+  run where cancellation lands there.  After each run the scenario
+  asserts the hand-off invariants and that a fresh probe request
+  still resolves (the system is not wedged).
 
 Dual-role module: ``python -m tools.vet.dyn`` is the runner;
 ``-p tools.vet.dyn`` loads it as the pytest plugin inside the child
@@ -47,6 +61,7 @@ leak, or checkify error).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -74,6 +89,7 @@ SLICE: Sequence[str] = (
 REPORT_ENV = "CONSUL_TPU_DYN_REPORT"
 NANS_ENV = "CONSUL_TPU_DYN_NANS"
 INTERLEAVE_ENV = "CONSUL_TPU_DYN_INTERLEAVE"
+CANCEL_ENV = "CONSUL_TPU_DYN_CANCEL"
 
 # The interleaving-stress slice (the dynamic twin of the static X01
 # pass): the lease/barrier and anti-entropy suites — the paths whose
@@ -270,10 +286,389 @@ def checkify_smoke() -> Optional[str]:
     return None
 
 
+# -- cancel-injection leg ----------------------------------------------------
+#
+# Scenario harness, not a pytest re-run: cancellation has to land on a
+# specific task at a specific await point, and the invariants live on
+# production-object internals (_confirm_batches records, NotifyGroup
+# waiter sets) that a test run doesn't expose.  The scenarios build the
+# real objects the tier-1 slices exercise — Server's confirm-batch
+# state, a Reconciler over a real StateStore, blocking_query over a
+# real StateStore — pick one task as the victim, and sweep k over its
+# await points.
+
+
+class _CancelInjector:
+    """Cancels the registered victim task at its ``k``-th await.
+
+    ``note_await`` is called by the patched Future at every
+    ``__await__`` entry; awaits by any other task are ignored, so the
+    count is exactly "await expressions the victim entered".  A sweep
+    ends when a run finishes with ``fired`` still False: the victim
+    completed with fewer than k awaits, so every point has been hit."""
+
+    def __init__(self, k: int) -> None:
+        self.k = k
+        self.seen = 0
+        self.fired = False
+        self.victim: Optional[asyncio.Task] = None
+
+    def note_await(self) -> None:
+        if self.victim is None or self.fired:
+            return
+        try:
+            cur = asyncio.current_task()
+        except RuntimeError:
+            return
+        if cur is not self.victim or self.victim.done():
+            return
+        self.seen += 1
+        if self.seen >= self.k:
+            self.fired = True
+            # We are inside the victim's own frame, pre-yield: cancel()
+            # sets _must_cancel, and the forced yield below hands
+            # control back to Task.__step, which delivers the
+            # CancelledError AT this await point.
+            self.victim.cancel()
+
+
+_cancel_injector: Optional[_CancelInjector] = None
+
+
+def install_cancel_injection() -> None:
+    """Patch ``asyncio.Future`` with a shim that reports every await to
+    the active injector, then yields once (the forced-interleave trick
+    — without the unconditional yield, an await on a done future never
+    suspends and the cancel would slide to a LATER point, collapsing
+    distinct k values onto one schedule)."""
+    import asyncio.futures
+
+    base = asyncio.futures._PyFuture
+
+    class _InjectFuture(base):  # type: ignore[valid-type, misc]
+        def __await__(self):
+            inj = _cancel_injector
+            if inj is not None:
+                inj.note_await()
+            yield None  # deliver a pending cancel exactly here
+            return (yield from super().__await__())
+
+        __iter__ = __await__
+
+    asyncio.futures.Future = _InjectFuture
+    asyncio.Future = _InjectFuture
+
+
+async def _settle(cycles: int = 20) -> None:
+    """Let every ready task run to its next suspension point.
+    ``sleep(0)`` never mints a future, so settling adds no counted
+    awaits even when the caller is the victim's parent."""
+    for _ in range(cycles):
+        await asyncio.sleep(0)
+
+
+def _retrieve(fut: "asyncio.Future") -> Optional[BaseException]:
+    """Mark a done future's exception retrieved (keeps the leg's output
+    free of never-retrieved noise) and return it."""
+    if not fut.done() or fut.cancelled():
+        return None
+    return fut.exception()
+
+
+async def _scenario_confirm_batch(victim: str,
+                                  inj: _CancelInjector) -> List[str]:
+    """Two serialized ReadIndex confirmation batches; the victim is a
+    batch-B joiner or batch B's runner (whose first await is the
+    shield on batch A's future — the exact point of the r5 finding)."""
+    from consul_tpu.server.server import Server
+
+    srv = object.__new__(Server)
+    srv._confirm_batches = {}
+    srv._confirm_prev = {}
+    srv._confirm_tasks = set()
+
+    gate_a = asyncio.Event()
+    gate_b = asyncio.Event()
+
+    async def runner_a():
+        await gate_a.wait()
+        return "a"
+
+    async def runner_b():
+        await gate_b.wait()
+        return "b"
+
+    problems: List[str] = []
+
+    # Batch A forms and fires; its runner parks on gate_a.
+    a_joiners = [asyncio.ensure_future(srv._confirm_batched("ri", runner_a))
+                 for _ in range(2)]
+    await _settle()
+    before = set(srv._confirm_tasks)
+
+    # Batch B forms behind it; its runner serializes on batch A's
+    # future.  ONE bare cycle: _confirm_batched has created the runner
+    # task but the runner has not yet entered its first await, so
+    # marking it now makes k=1 land on ``await asyncio.shield(prev)``.
+    b_joiners = [asyncio.ensure_future(srv._confirm_batched("ri", runner_b))
+                 for _ in range(2)]
+    if victim == "joiner":
+        inj.victim = b_joiners[0]
+    await asyncio.sleep(0)
+    if victim == "runner":
+        fresh = [t for t in srv._confirm_tasks if t not in before]
+        if fresh:
+            inj.victim = fresh[0]
+
+    await _settle()
+    gate_a.set()
+    await _settle()
+    gate_b.set()
+
+    everyone = a_joiners + b_joiners
+    done, pending = await asyncio.wait(everyone, timeout=5.0)
+    for t in pending:
+        problems.append("joiner left pending after both batches "
+                        "released — a hand-off was dropped")
+        t.cancel()
+    for t in done:
+        try:
+            t.result()
+        except BaseException:  # noqa: E02,E03 — the harness's oracle
+            # is "resolved, not hung"; the victim's CancelledError and
+            # a poisoned batch's error are both expected outcomes
+            pass
+
+    for key, b in srv._confirm_batches.items():
+        _retrieve(b["fut"])
+        if not b["fut"].done():
+            problems.append(
+                f"batch {key!r} future left pending "
+                f"(fired={b['fired']}) — joiners would hang forever")
+
+    # The system must not be wedged: a fresh request forms a new batch,
+    # serializes on whatever _confirm_prev holds, and resolves.
+    async def probe():
+        return "probe"
+
+    try:
+        got = await asyncio.wait_for(
+            srv._confirm_batched("ri", probe), timeout=2.0)
+        if got != "probe":
+            problems.append(f"probe returned {got!r}, expected 'probe'")
+    except asyncio.TimeoutError:
+        problems.append("probe request hung — the _confirm_prev chain "
+                        "is wedged on an unresolved batch")
+    except BaseException as e:  # noqa: E02,E03 — any escape IS the
+        # probe's verdict; it is reported as a finding, not swallowed
+        problems.append(f"probe request failed: {type(e).__name__}: {e}")
+
+    leftovers = list(srv._confirm_tasks)
+    if leftovers:
+        gathered = asyncio.gather(*leftovers, return_exceptions=True)
+        try:
+            await asyncio.wait_for(gathered, timeout=2.0)
+        except asyncio.TimeoutError:
+            problems.append("confirm-batch runner task never finished")
+    return problems
+
+
+async def _scenario_reconcile_flush(victim: str,
+                                    inj: _CancelInjector) -> List[str]:
+    """A reconcile flush cancelled mid-submit.  A cancelled flush may
+    drop its drained pending set (the periodic full reconcile
+    re-derives it — that is the documented contract), but it must not
+    wedge the reconciler: a follow-up note+flush must ship."""
+    from consul_tpu.agent.reconcile import Reconciler
+    from consul_tpu.membership.swim import STATE_ALIVE, Node
+    from consul_tpu.state.store import StateStore
+
+    class _Raft:
+        def __init__(self):
+            self.peers = set()
+
+        async def add_peer(self, name):
+            self.peers.add(name)
+
+        async def remove_peer(self, name):
+            self.peers.discard(name)
+
+    class _Config:
+        node_name = "leader0"
+        datacenter = "dc1"
+
+    class _Srv:
+        def __init__(self):
+            self.store = StateStore()
+            self.raft = _Raft()
+            self.config = _Config()
+            self.gate = asyncio.Event()
+            self.batches: List[list] = []
+
+        async def raft_apply_batch(self, ops):
+            await self.gate.wait()
+            self.batches.append(list(ops))
+
+    problems: List[str] = []
+    srv = _Srv()
+    rec = Reconciler(srv)
+
+    def member(i: int) -> Node:
+        return Node(name=f"n{i}", addr=f"10.0.0.{i + 1}", port=8301,
+                    state=STATE_ALIVE)
+
+    rec.note(member(0))
+    rec.note(member(1))
+    flusher = asyncio.ensure_future(rec.flush())
+    inj.victim = flusher
+    await _settle()
+    srv.gate.set()
+    done, pending = await asyncio.wait({flusher}, timeout=5.0)
+    if pending:
+        problems.append("flush never returned after the submit gate "
+                        "opened — cancellation wedged it mid-envelope")
+        flusher.cancel()
+    else:
+        try:
+            flusher.result()
+        except BaseException:  # noqa: E02,E03 — the victim's own
+            # CancelledError is the expected outcome; the oracle is
+            # only that the task RESOLVED
+            pass
+
+    # Not-wedged oracle: the next cadence works end to end.
+    rec.note(member(2))
+    try:
+        shipped = await asyncio.wait_for(rec.flush(), timeout=5.0)
+        if shipped < 1:
+            problems.append(
+                f"follow-up flush shipped {shipped} ops for a brand-new "
+                "alive member — the reconciler lost its write path")
+        if rec.pending:
+            problems.append("follow-up flush left members pending")
+    except BaseException as e:  # noqa: E02,E03 — any escape IS the
+        # verdict; it is reported as a finding, not swallowed
+        problems.append(
+            f"follow-up flush failed: {type(e).__name__}: {e}")
+    return problems
+
+
+async def _scenario_blocking_query(victim: str,
+                                   inj: _CancelInjector) -> List[str]:
+    """A long-poller cancelled at each await inside blocking_query.
+    The oracle is the try/finally deregistration contract: however the
+    poller exits, no AsyncWaiter may stay registered on the store's
+    table NotifyGroups or the KV watch tree (a leaked waiter is woken
+    forever and pins its event loop objects)."""
+    from consul_tpu.server.blocking import blocking_query
+    from consul_tpu.state.store import StateStore
+    from consul_tpu.structs.structs import QueryMeta, QueryOptions
+
+    problems: List[str] = []
+    store = StateStore()
+    meta = QueryMeta()
+
+    async def run():
+        meta.index = 1  # never passes min_query_index: keep polling
+
+    opts = QueryOptions(min_query_index=5, max_query_time=0.5)
+    poller = asyncio.ensure_future(blocking_query(
+        store, opts, meta, run, tables=("nodes",), kv_prefix="kv/"))
+    inj.victim = poller
+    done, pending = await asyncio.wait({poller}, timeout=5.0)
+    if pending:
+        problems.append("long-poller still running well past its "
+                        "max_query_time")
+        poller.cancel()
+        await asyncio.wait({poller}, timeout=1.0)
+    else:
+        try:
+            poller.result()
+        except BaseException:  # noqa: E02,E03 — the victim's own
+            # CancelledError is the expected outcome; the oracle is
+            # only that the task RESOLVED
+            pass
+
+    leaked = sum(len(g) for g in store._watch.values())
+    if leaked:
+        problems.append(
+            f"{leaked} waiter(s) left on table NotifyGroups after the "
+            "poller exited — stop_watch was skipped on this path")
+    kv_left = [p for p, g in store._kv_watch.registered() if len(g)]
+    if kv_left:
+        problems.append(
+            f"KV watch groups still registered for {kv_left} after the "
+            "poller exited — stop_watch_kv was skipped on this path")
+    return problems
+
+
+# (scenario name, victim labels, coroutine fn)
+_CANCEL_SCENARIOS = (
+    ("confirm-batch", ("joiner", "runner"), _scenario_confirm_batch),
+    ("reconcile-flush", ("flusher",), _scenario_reconcile_flush),
+    ("blocking-query", ("poller",), _scenario_blocking_query),
+)
+
+_CANCEL_SWEEP_CAP = 64  # no victim here has remotely this many awaits
+
+
+def cancel_injection_main() -> int:
+    """Child entry for the cancel leg (``--cancel``): sweep every
+    (scenario, victim, k) and report.  Runs in its own process because
+    the Future patch is global and must not leak into the parent."""
+    global _cancel_injector
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    install_cancel_injection()
+    problems: List[str] = []
+    for name, victims, fn in _CANCEL_SCENARIOS:
+        for victim in victims:
+            k = 1
+            while True:
+                inj = _CancelInjector(k)
+                _cancel_injector = inj
+                try:
+                    found = asyncio.run(fn(victim, inj))
+                except BaseException as e:
+                    problems.append(
+                        f"{name}/{victim} k={k}: scenario crashed: "
+                        f"{type(e).__name__}: {e}")
+                    break
+                finally:
+                    _cancel_injector = None
+                for p in found:
+                    problems.append(f"{name}/{victim} k={k}: {p}")
+                if inj.victim is None:
+                    problems.append(
+                        f"{name}/{victim}: victim task never marked — "
+                        "the scenario is vacuous")
+                    break
+                if not inj.fired:
+                    # Victim finished with < k awaits: sweep complete,
+                    # and this last run doubles as the uninjected
+                    # baseline for the oracles.
+                    print(f"dyn: cancel[{name}/{victim}]: swept "
+                          f"{k - 1} await point(s)", file=sys.stderr)
+                    break
+                k += 1
+                if k > _CANCEL_SWEEP_CAP:
+                    problems.append(
+                        f"{name}/{victim}: sweep passed k={k} — the "
+                        "victim's await count should be tiny; the "
+                        "scenario is runaway")
+                    break
+    for p in problems:
+        print(f"dyn: FAIL: {p}", file=sys.stderr)
+    if not problems:
+        print("dyn: cancel-injection leg clean", file=sys.stderr)
+    return 1 if problems else 0
+
+
 # -- runner role -------------------------------------------------------------
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    if argv and list(argv) == ["--cancel"]:
+        return cancel_injection_main()
     tests = list(argv) if argv else list(SLICE)
     problems: List[str] = []
 
@@ -323,6 +718,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "an await-atomicity assumption broke when every await "
                 "became a real task switch (dynamic twin of vet X01)")
 
+    # Cancel-injection leg: subprocessed because the injection patch
+    # replaces asyncio.Future process-wide.  Same bisect rule as the
+    # interleave leg: an explicit test list skips it.
+    if not argv:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env[CANCEL_ENV] = "1"
+        cmd = [sys.executable, "-m", "tools.vet.dyn", "--cancel"]
+        print("dyn: cancel-injection sweep (cancel the victim at every "
+              "await point; confirm-batch / reconcile-flush / "
+              "blocking-query)", file=sys.stderr)
+        proc = subprocess.run(cmd, env=env)
+        if proc.returncode != 0:
+            problems.append(
+                f"cancel-injection sweep failed (rc={proc.returncode}) "
+                "— a cancellation schedule left a future pending, a "
+                "batch unfired, or a waiter registered (dynamic twin "
+                "of vet Q01-Q04)")
+
     print("dyn: checkify smoke (index+float oracle over one round per "
           "strategy)", file=sys.stderr)
     err = checkify_smoke()
@@ -332,8 +746,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     for p in problems:
         print(f"dyn: FAIL: {p}", file=sys.stderr)
     if not problems:
-        print("dyn: clean (slice + leak audit + interleave + checkify)",
-              file=sys.stderr)
+        print("dyn: clean (slice + leak audit + interleave + "
+              "cancel-injection + checkify)", file=sys.stderr)
     return 1 if problems else 0
 
 

@@ -576,11 +576,26 @@ def _check_x04(ctx: FileCtx, scan: _ClassScan, thread_targets: Set[str],
                 "marshal through call_soon_threadsafe)"))
 
 
+def class_scans(ctx: FileCtx
+                ) -> Tuple[Dict[str, str], Set[str], List["_ClassScan"]]:
+    """(imports, thread targets, one _ClassScan per class) for a file,
+    memoized on the FileCtx instance: the driver shares one FileCtx per
+    file across every pass, so the cancel-safety tier (cancel_safety.py)
+    rides the same per-class event/lock-span caches this pass builds
+    instead of re-walking each class."""
+    cached = getattr(ctx, "_class_scans", None)
+    if cached is None:
+        imports, thread_targets, classes = _module_prescan(ctx.tree)
+        cached = (imports, thread_targets,
+                  [_ClassScan(c, imports) for c in classes])
+        ctx._class_scans = cached  # type: ignore[attr-defined]
+    return cached
+
+
 def check(ctx: FileCtx) -> List[Finding]:
     out: List[Finding] = []
-    imports, thread_targets, classes = _module_prescan(ctx.tree)
-    for node in classes:
-        scan = _ClassScan(node, imports)
+    _imports, thread_targets, scans = class_scans(ctx)
+    for scan in scans:
         _check_x01(ctx, scan, out)
         _check_x02(ctx, scan, out)
         _check_x03(ctx, scan, out)

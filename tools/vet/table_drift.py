@@ -36,6 +36,13 @@ ladder) must each cover it exactly.  Union semantics because "stage"
 is a label value, not a dispatched keyword — K02's stray scan would
 false-positive on unrelated ``stage=`` keywords.
 
+A third UNION group pins the ``CONSUL_TPU_*`` environment gates
+(``check_env_gates`` below): the ``ENV_GATES`` registry in
+``consul_tpu/obs/envgates.py`` governs; every full-string gate literal
+in the tree must be registered, each gate's canonical reader module
+must still reference it, and the README's environment-gate table must
+document the registry exactly.
+
 Codes:
 
 - **K01 key-set divergence**: a satellite table's keys differ from the
@@ -58,7 +65,9 @@ among the vetted files is skipped (subset runs, unit fixtures).
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.vet.core import FileCtx, Finding
@@ -478,6 +487,147 @@ def _check_strays(ctxs: Sequence[FileCtx], group: TableGroup,
                         f"({gov_path})"))
 
 
+# -- environment-gate union group -------------------------------------------
+#
+# A third table shape: the set of CONSUL_TPU_* environment variables
+# the process reads.  The governing registry is ENV_GATES in
+# consul_tpu/obs/envgates.py (name -> one-line description); the
+# "satellites" are the usage sites themselves — a typo'd gate name at a
+# read site resolves to "unset" forever with no runtime check — plus
+# the README's environment-gate table.  Union semantics throughout:
+# every used name must be registered, every registered name must still
+# be read by its canonical reader, and the README must document exactly
+# the registry.
+
+ENV_GATE_REGISTRY = TableRef("consul_tpu/obs/envgates.py",
+                             "dict_keys", "ENV_GATES")
+
+# Canonical reader per gate: the module whose presence without the
+# literal means the gate is dead configuration.  Subset-safe the same
+# way satellites are: a gate whose reader isn't among the vetted files
+# is skipped.
+ENV_GATE_SITES: Dict[str, str] = {
+    "CONSUL_TPU_DEV_OBS": "consul_tpu/obs/devstats.py",
+    "CONSUL_TPU_RAFT_OBS": "consul_tpu/obs/raftstats.py",
+    "CONSUL_TPU_JOURNEY": "consul_tpu/obs/journey.py",
+    "CONSUL_TPU_JOURNEY_BUDGET_MS": "consul_tpu/obs/journey.py",
+    "CONSUL_TPU_AUTOTUNE": "consul_tpu/obs/tuner.py",
+    "CONSUL_TPU_AUTOTUNE_DIR": "consul_tpu/obs/tuner.py",
+    "CONSUL_TPU_COMPILE_CACHE": "consul_tpu/gossip/plane.py",
+    "CONSUL_TPU_DYN_REPORT": "tools/vet/dyn.py",
+    "CONSUL_TPU_DYN_NANS": "tools/vet/dyn.py",
+    "CONSUL_TPU_DYN_INTERLEAVE": "tools/vet/dyn.py",
+    "CONSUL_TPU_DYN_CANCEL": "tools/vet/dyn.py",
+}
+
+# Partner suffixes for --changed expansion (driver.partner_groups).
+ENV_GATE_PARTNERS: Tuple[str, ...] = tuple(
+    [ENV_GATE_REGISTRY.suffix] + sorted(set(ENV_GATE_SITES.values())))
+
+_ENV_GATE_RE = re.compile(r"CONSUL_TPU_[A-Z0-9_]+")
+
+
+def _env_literals(ctx: FileCtx) -> List[Tuple[str, int]]:
+    """Every full-string CONSUL_TPU_* constant in the file.  Full-match
+    only: prose mentions inside docstrings carry surrounding text and
+    do not count as usage."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _ENV_GATE_RE.fullmatch(node.value):
+            out.append((node.value, node.lineno))
+    return out
+
+
+def check_env_gates(ctxs: Sequence[FileCtx],
+                    readme_text: Optional[str] = None) -> List[Finding]:
+    """The env-gate union group; ``readme_text`` overrides reading
+    README.md from the working directory (unit fixtures).  No README
+    present means the README leg is skipped, not failed — subset runs
+    and bare checkouts."""
+    out: List[Finding] = []
+    gctx = _find_ctx(ctxs, ENV_GATE_REGISTRY.suffix)
+    if gctx is None:
+        return out      # subset run: nothing to compare against
+    got = extract_dict_keys(gctx, ENV_GATE_REGISTRY.arg)
+    if got is None:
+        out.append(Finding(
+            gctx.path, 1, KEYSET_DIVERGE,
+            "governing ENV_GATES registry not found — the dict moved "
+            "or was renamed; update tools/vet/table_drift.py alongside "
+            "it"))
+        return out
+    gates, gov_line = got
+
+    # Registry <-> canonical-site mirror (both live in this repo).
+    for name in sorted(gates - set(ENV_GATE_SITES)):
+        out.append(Finding(
+            gctx.path, gov_line, KEYSET_DIVERGE,
+            f"env gate {name} registered in ENV_GATES but has no "
+            "canonical reader in tools/vet/table_drift.py "
+            "ENV_GATE_SITES — declare where it is read"))
+    for name in sorted(set(ENV_GATE_SITES) - gates):
+        out.append(Finding(
+            gctx.path, gov_line, KEYSET_DIVERGE,
+            f"env gate {name} has a canonical reader declared in "
+            "ENV_GATE_SITES but is missing from the ENV_GATES "
+            "registry"))
+
+    # Usage sweep: every full-string literal must be registered, and
+    # each gate's canonical reader must still reference it.
+    seen_at_site: Set[str] = set()
+    for ctx in ctxs:
+        if ctx is gctx:
+            continue
+        for name, line in _env_literals(ctx):
+            if name not in gates:
+                out.append(Finding(
+                    ctx.path, line, KEYSET_DIVERGE,
+                    f"env gate {name} is read here but not registered "
+                    "in consul_tpu/obs/envgates.py ENV_GATES — a "
+                    "typo'd gate name reads as unset forever"))
+            elif _suffix_eq(ctx.path, ENV_GATE_SITES.get(name, "")):
+                seen_at_site.add(name)
+    for name in sorted(gates & set(ENV_GATE_SITES)):
+        site = ENV_GATE_SITES[name]
+        sctx = _find_ctx(ctxs, site)
+        if sctx is not None and name not in seen_at_site:
+            out.append(Finding(
+                sctx.path, 1, KEYSET_DIVERGE,
+                f"env gate {name} is registered with this module as "
+                "its canonical reader, but the literal no longer "
+                "appears here — the gate is dead configuration or the "
+                "reader moved"))
+
+    # README leg: the environment-gate table must document the
+    # registry exactly.
+    if readme_text is None:
+        p = Path("README.md")
+        if not p.is_file():
+            return out
+        readme_text = p.read_text(encoding="utf-8")
+    mentioned: Dict[str, int] = {}
+    for i, line in enumerate(readme_text.splitlines(), start=1):
+        for m in _ENV_GATE_RE.finditer(line):
+            mentioned.setdefault(m.group(0), i)
+    for name in sorted(gates - set(mentioned)):
+        out.append(Finding(
+            "README.md", 1, KEYSET_DIVERGE,
+            f"env gate {name} is registered in ENV_GATES but never "
+            "mentioned in README.md — operators cannot discover it"))
+    for name in sorted(set(mentioned) - gates):
+        out.append(Finding(
+            "README.md", mentioned[name], KEYSET_DIVERGE,
+            f"README.md documents env gate {name}, which is not in "
+            "the ENV_GATES registry — stale docs or a typo"))
+    return out
+
+
+def _suffix_eq(path: str, suffix: str) -> bool:
+    return bool(suffix) and (path == suffix
+                             or path.endswith("/" + suffix))
+
+
 def check_project(ctxs: List[FileCtx],
                   groups: Sequence[TableGroup] = GROUPS) -> List[Finding]:
     out: List[Finding] = []
@@ -485,5 +635,6 @@ def check_project(ctxs: List[FileCtx],
         gov = _check_group(ctxs, group, out)
         if gov is not None and not group.union:
             _check_strays(ctxs, group, gov, out)
+    out.extend(check_env_gates(ctxs))
     return sorted(set(out), key=lambda f: (f.path, f.line, f.code,
                                            f.message))
